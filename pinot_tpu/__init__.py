@@ -1,0 +1,28 @@
+"""pinot-tpu: a TPU-native real-time distributed OLAP framework.
+
+A ground-up rebuild of the capabilities of Apache Pinot (y-scope fork,
+reference at /root/reference) designed for TPU execution: columnar immutable
+segments whose scan/filter/aggregation hot path runs as jit'd JAX/Pallas
+kernels sharded across a device mesh, with a host-side control plane
+(SQL compilation, routing, scatter-gather reduce, ingestion, cluster
+management) in Python/C++.
+
+Layer map (mirrors SURVEY.md):
+  models/    - logical data model: FieldSpec/Schema/TableConfig
+               (ref: pinot-spi .../spi/data/FieldSpec.java, Schema.java,
+                config/table/TableConfig.java)
+  segment/   - columnar segment format: buffers, dictionaries, forward &
+               auxiliary indexes, creator, loader
+               (ref: pinot-segment-spi + pinot-segment-local)
+  query/     - SQL front-end, per-segment planning, operators, executors,
+               broker reduce (ref: pinot-core/src/.../core/{plan,operator,query})
+  ops/       - JAX/Pallas device kernels (the TPU execution backend)
+  parallel/  - device-mesh sharding of segment batches, collective combines
+  server/    - server role: data managers, query scheduler, transport
+  broker/    - broker role: routing, scatter-gather, reduce
+  controller/- cluster-lite control plane (assignment, retention, tasks)
+  ingest/    - batch + realtime ingestion (stream SPI, record transforms)
+  utils/     - config, metrics, tracing, resource accounting
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,38 @@
+"""Logical data model: field specs, schemas, table configs.
+
+Reference parity: pinot-spi/src/main/java/org/apache/pinot/spi/data/FieldSpec.java,
+Schema.java, and config/table/TableConfig.java.
+"""
+from pinot_tpu.models.field_spec import DataType, FieldType, FieldSpec
+from pinot_tpu.models.schema import Schema
+from pinot_tpu.models.table_config import (
+    TableConfig,
+    TableType,
+    IndexingConfig,
+    StarTreeIndexConfig,
+    IngestionConfig,
+    StreamIngestionConfig,
+    UpsertConfig,
+    DedupConfig,
+    RoutingConfig,
+    QueryConfig,
+    RetentionConfig,
+)
+
+__all__ = [
+    "DataType",
+    "FieldType",
+    "FieldSpec",
+    "Schema",
+    "TableConfig",
+    "TableType",
+    "IndexingConfig",
+    "StarTreeIndexConfig",
+    "IngestionConfig",
+    "StreamIngestionConfig",
+    "UpsertConfig",
+    "DedupConfig",
+    "RoutingConfig",
+    "QueryConfig",
+    "RetentionConfig",
+]

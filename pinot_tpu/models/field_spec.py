@@ -1,0 +1,219 @@
+"""Field specifications: the per-column logical type system.
+
+Reference parity: pinot-spi/src/main/java/org/apache/pinot/spi/data/FieldSpec.java:70
+(DataType enum, FieldType enum, default null values, single/multi-value flag).
+
+TPU-first notes: every DataType carries its numpy storage dtype so segment
+creation and device upload are zero-ambiguity. STRING/BYTES/JSON are always
+dictionary-encoded before they reach the device; numeric types may be either
+dictionary-encoded (dictIds on device) or raw (values on device).
+"""
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import numpy as np
+
+
+class DataType(enum.Enum):
+    """Column storage types (ref FieldSpec.java DataType enum)."""
+
+    INT = "INT"
+    LONG = "LONG"
+    FLOAT = "FLOAT"
+    DOUBLE = "DOUBLE"
+    BIG_DECIMAL = "BIG_DECIMAL"
+    BOOLEAN = "BOOLEAN"
+    TIMESTAMP = "TIMESTAMP"
+    STRING = "STRING"
+    JSON = "JSON"
+    BYTES = "BYTES"
+
+    @property
+    def is_numeric(self) -> bool:
+        return self in _NUMERIC
+
+    @property
+    def is_fixed_width(self) -> bool:
+        return self in _FIXED_WIDTH
+
+    @property
+    def stored_type(self) -> "DataType":
+        """The physical type used for storage (ref: BOOLEAN stored as INT,
+        TIMESTAMP as LONG millis, JSON as STRING)."""
+        if self is DataType.BOOLEAN:
+            return DataType.INT
+        if self is DataType.TIMESTAMP:
+            return DataType.LONG
+        if self is DataType.JSON:
+            return DataType.STRING
+        return self
+
+    @property
+    def np_dtype(self) -> np.dtype:
+        """Numpy dtype of the stored representation (object for var-width)."""
+        return _NP_DTYPES[self.stored_type]
+
+    @property
+    def size_bytes(self) -> int:
+        """Fixed storage width in bytes; raises for var-width types."""
+        st = self.stored_type
+        if st not in _FIXED_WIDTH:
+            raise ValueError(f"{self} is not fixed-width")
+        return _NP_DTYPES[st].itemsize
+
+    def convert(self, value: Any) -> Any:
+        """Coerce an ingested python value to this type's stored python value."""
+        st = self.stored_type
+        if value is None:
+            return None
+        if st is DataType.INT:
+            if isinstance(value, str) and value.lower() in ("true", "false"):
+                return 1 if value.lower() == "true" else 0  # BOOLEAN ingest form
+            return int(value)
+        if st is DataType.LONG:
+            return int(value)
+        if st in (DataType.FLOAT, DataType.DOUBLE):
+            return float(value)
+        if st is DataType.BIG_DECIMAL:
+            return float(value)
+        if st is DataType.STRING:
+            return value if isinstance(value, str) else str(value)
+        if st is DataType.BYTES:
+            return bytes(value)
+        raise ValueError(f"unsupported type {self}")
+
+
+_NUMERIC = {
+    DataType.INT,
+    DataType.LONG,
+    DataType.FLOAT,
+    DataType.DOUBLE,
+    DataType.BIG_DECIMAL,
+}
+_FIXED_WIDTH = {
+    DataType.INT,
+    DataType.LONG,
+    DataType.FLOAT,
+    DataType.DOUBLE,
+    DataType.BIG_DECIMAL,
+    DataType.BOOLEAN,
+    DataType.TIMESTAMP,
+}
+_NP_DTYPES = {
+    DataType.INT: np.dtype(np.int32),
+    DataType.LONG: np.dtype(np.int64),
+    DataType.FLOAT: np.dtype(np.float32),
+    DataType.DOUBLE: np.dtype(np.float64),
+    # BIG_DECIMAL approximated as float64 host-side (exact decimal kept in
+    # dictionary string form when dictionary-encoded).
+    DataType.BIG_DECIMAL: np.dtype(np.float64),
+    DataType.STRING: np.dtype(object),
+    DataType.BYTES: np.dtype(object),
+}
+
+# Default null placeholder values (ref FieldSpec.java DEFAULT_* constants:
+# dimension INT null = Integer.MIN_VALUE, metric null = 0, string null = "null").
+_DEFAULT_DIMENSION_NULL = {
+    DataType.INT: np.iinfo(np.int32).min,
+    DataType.LONG: np.iinfo(np.int64).min,
+    DataType.FLOAT: float(np.finfo(np.float32).min),
+    DataType.DOUBLE: float(np.finfo(np.float64).min),
+    DataType.BIG_DECIMAL: 0.0,
+    DataType.BOOLEAN: 0,
+    DataType.TIMESTAMP: 0,
+    DataType.STRING: "null",
+    DataType.JSON: "null",
+    DataType.BYTES: b"",
+}
+_DEFAULT_METRIC_NULL = {
+    DataType.INT: 0,
+    DataType.LONG: 0,
+    DataType.FLOAT: 0.0,
+    DataType.DOUBLE: 0.0,
+    DataType.BIG_DECIMAL: 0.0,
+    DataType.BOOLEAN: 0,
+    DataType.TIMESTAMP: 0,
+    DataType.STRING: "null",
+    DataType.JSON: "null",
+    DataType.BYTES: b"",
+}
+
+
+class FieldType(enum.Enum):
+    """Role of a field (ref FieldSpec.java FieldType enum)."""
+
+    DIMENSION = "DIMENSION"
+    METRIC = "METRIC"
+    TIME = "TIME"
+    DATE_TIME = "DATE_TIME"
+    COMPLEX = "COMPLEX"
+
+
+@dataclass
+class FieldSpec:
+    name: str
+    data_type: DataType
+    field_type: FieldType = FieldType.DIMENSION
+    single_value: bool = True
+    default_null_value: Optional[Any] = None
+    # DATE_TIME extras (ref DateTimeFieldSpec): format/granularity strings.
+    format: Optional[str] = None
+    granularity: Optional[str] = None
+    max_length: int = 512
+    # Virtual columns ($docId, $segmentName) are not stored.
+    virtual: bool = False
+
+    def __post_init__(self):
+        if isinstance(self.data_type, str):
+            self.data_type = DataType(self.data_type)
+        if isinstance(self.field_type, str):
+            self.field_type = FieldType(self.field_type)
+        if self.default_null_value is None:
+            if self.field_type is FieldType.METRIC:
+                self.default_null_value = _DEFAULT_METRIC_NULL[self.data_type]
+            else:
+                self.default_null_value = _DEFAULT_DIMENSION_NULL[self.data_type]
+        else:
+            self.default_null_value = self.data_type.convert(self.default_null_value)
+
+    # -- (de)serialization --------------------------------------------------
+    def to_dict(self) -> dict:
+        d = {
+            "name": self.name,
+            "dataType": self.data_type.value,
+            "fieldType": self.field_type.value,
+            "singleValueField": self.single_value,
+            "defaultNullValue": _json_safe(self.default_null_value),
+        }
+        if self.format:
+            d["format"] = self.format
+        if self.granularity:
+            d["granularity"] = self.granularity
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FieldSpec":
+        return cls(
+            name=d["name"],
+            data_type=DataType(d["dataType"]),
+            field_type=FieldType(d.get("fieldType", "DIMENSION")),
+            single_value=d.get("singleValueField", True),
+            default_null_value=d.get("defaultNullValue"),
+            format=d.get("format"),
+            granularity=d.get("granularity"),
+        )
+
+
+def _json_safe(v: Any) -> Any:
+    if isinstance(v, bytes):
+        return v.hex()
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        v = float(v)
+    if isinstance(v, float) and not np.isfinite(v):
+        return None  # NaN/inf are not valid JSON
+    return v

@@ -1,0 +1,112 @@
+"""Table schema: ordered collection of FieldSpecs.
+
+Reference parity: pinot-spi/src/main/java/org/apache/pinot/spi/data/Schema.java:65
+(dimension/metric/dateTime field grouping, JSON serde, primary-key columns).
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from pinot_tpu.models.field_spec import DataType, FieldSpec, FieldType
+
+
+@dataclass
+class Schema:
+    name: str
+    fields: List[FieldSpec] = field(default_factory=list)
+    primary_key_columns: List[str] = field(default_factory=list)
+
+    def __post_init__(self):
+        self._by_name: Dict[str, FieldSpec] = {f.name: f for f in self.fields}
+
+    # -- builder-style API --------------------------------------------------
+    def add_field(self, spec: FieldSpec) -> "Schema":
+        if spec.name in self._by_name:
+            raise ValueError(f"duplicate field {spec.name!r} in schema {self.name!r}")
+        self.fields.append(spec)
+        self._by_name[spec.name] = spec
+        return self
+
+    def add_dimension(self, name: str, data_type: DataType, **kw) -> "Schema":
+        return self.add_field(FieldSpec(name, data_type, FieldType.DIMENSION, **kw))
+
+    def add_metric(self, name: str, data_type: DataType, **kw) -> "Schema":
+        return self.add_field(FieldSpec(name, data_type, FieldType.METRIC, **kw))
+
+    def add_date_time(self, name: str, data_type: DataType, fmt: str = "1:MILLISECONDS:EPOCH",
+                      granularity: str = "1:MILLISECONDS", **kw) -> "Schema":
+        return self.add_field(
+            FieldSpec(name, data_type, FieldType.DATE_TIME, format=fmt,
+                      granularity=granularity, **kw))
+
+    # -- lookups ------------------------------------------------------------
+    def field_spec(self, name: str) -> FieldSpec:
+        spec = self._by_name.get(name)
+        if spec is None:
+            raise KeyError(f"column {name!r} not in schema {self.name!r}")
+        return spec
+
+    def has_column(self, name: str) -> bool:
+        return name in self._by_name
+
+    @property
+    def column_names(self) -> List[str]:
+        return [f.name for f in self.fields]
+
+    @property
+    def dimension_names(self) -> List[str]:
+        return [f.name for f in self.fields if f.field_type is FieldType.DIMENSION]
+
+    @property
+    def metric_names(self) -> List[str]:
+        return [f.name for f in self.fields if f.field_type is FieldType.METRIC]
+
+    @property
+    def date_time_names(self) -> List[str]:
+        return [f.name for f in self.fields
+                if f.field_type in (FieldType.TIME, FieldType.DATE_TIME)]
+
+    # -- serde --------------------------------------------------------------
+    def to_dict(self) -> dict:
+        d: dict = {"schemaName": self.name}
+        dims, mets, dts = [], [], []
+        for f in self.fields:
+            if f.field_type is FieldType.METRIC:
+                mets.append(f.to_dict())
+            elif f.field_type in (FieldType.TIME, FieldType.DATE_TIME):
+                dts.append(f.to_dict())
+            else:
+                dims.append(f.to_dict())
+        if dims:
+            d["dimensionFieldSpecs"] = dims
+        if mets:
+            d["metricFieldSpecs"] = mets
+        if dts:
+            d["dateTimeFieldSpecs"] = dts
+        if self.primary_key_columns:
+            d["primaryKeyColumns"] = self.primary_key_columns
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Schema":
+        schema = cls(name=d.get("schemaName", ""))
+        for fd in d.get("dimensionFieldSpecs", []):
+            fd.setdefault("fieldType", "DIMENSION")
+            schema.add_field(FieldSpec.from_dict(fd))
+        for fd in d.get("metricFieldSpecs", []):
+            fd["fieldType"] = "METRIC"
+            schema.add_field(FieldSpec.from_dict(fd))
+        for fd in d.get("dateTimeFieldSpecs", []):
+            fd["fieldType"] = "DATE_TIME"
+            schema.add_field(FieldSpec.from_dict(fd))
+        schema.primary_key_columns = d.get("primaryKeyColumns", [])
+        return schema
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2)
+
+    @classmethod
+    def from_json(cls, s: str) -> "Schema":
+        return cls.from_dict(json.loads(s))

@@ -1,0 +1,314 @@
+"""Table configuration: indexing, ingestion, upsert/dedup, routing, retention.
+
+Reference parity: pinot-spi/src/main/java/org/apache/pinot/spi/config/table/
+TableConfig.java:38 and its sub-configs (IndexingConfig, UpsertConfig,
+DedupConfig, RoutingConfig, TenantConfig, StarTreeIndexConfig...).
+"""
+from __future__ import annotations
+
+import enum
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+class TableType(enum.Enum):
+    OFFLINE = "OFFLINE"
+    REALTIME = "REALTIME"
+
+
+@dataclass
+class StarTreeIndexConfig:
+    """Ref: spi/config/table/StarTreeIndexConfig.java."""
+
+    dimensions_split_order: List[str] = field(default_factory=list)
+    skip_star_node_creation: List[str] = field(default_factory=list)
+    function_column_pairs: List[str] = field(default_factory=list)  # e.g. "SUM__revenue"
+    max_leaf_records: int = 10_000
+
+    def to_dict(self) -> dict:
+        return {
+            "dimensionsSplitOrder": self.dimensions_split_order,
+            "skipStarNodeCreationForDimensions": self.skip_star_node_creation,
+            "functionColumnPairs": self.function_column_pairs,
+            "maxLeafRecords": self.max_leaf_records,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "StarTreeIndexConfig":
+        return cls(
+            dimensions_split_order=d.get("dimensionsSplitOrder", []),
+            skip_star_node_creation=d.get("skipStarNodeCreationForDimensions", []),
+            function_column_pairs=d.get("functionColumnPairs", []),
+            max_leaf_records=d.get("maxLeafRecords", 10_000),
+        )
+
+
+@dataclass
+class IndexingConfig:
+    """Ref: spi/config/table/IndexingConfig.java."""
+
+    inverted_index_columns: List[str] = field(default_factory=list)
+    range_index_columns: List[str] = field(default_factory=list)
+    bloom_filter_columns: List[str] = field(default_factory=list)
+    sorted_column: Optional[str] = None
+    no_dictionary_columns: List[str] = field(default_factory=list)
+    on_heap_dictionary_columns: List[str] = field(default_factory=list)
+    json_index_columns: List[str] = field(default_factory=list)
+    text_index_columns: List[str] = field(default_factory=list)
+    fst_index_columns: List[str] = field(default_factory=list)
+    vector_index_columns: List[str] = field(default_factory=list)
+    clp_columns: List[str] = field(default_factory=list)
+    star_tree_configs: List[StarTreeIndexConfig] = field(default_factory=list)
+    # Chunk compression for raw (no-dictionary) columns.
+    compression: str = "LZ4"  # PASS_THROUGH | LZ4 | GZIP | ZSTANDARD
+    segment_flush_rows: int = 500_000  # realtime seal threshold
+    segment_flush_seconds: int = 6 * 3600
+
+    def to_dict(self) -> dict:
+        return {
+            "invertedIndexColumns": self.inverted_index_columns,
+            "rangeIndexColumns": self.range_index_columns,
+            "bloomFilterColumns": self.bloom_filter_columns,
+            "sortedColumn": self.sorted_column,
+            "noDictionaryColumns": self.no_dictionary_columns,
+            "onHeapDictionaryColumns": self.on_heap_dictionary_columns,
+            "jsonIndexColumns": self.json_index_columns,
+            "textIndexColumns": self.text_index_columns,
+            "fstIndexColumns": self.fst_index_columns,
+            "vectorIndexColumns": self.vector_index_columns,
+            "clpColumns": self.clp_columns,
+            "starTreeIndexConfigs": [c.to_dict() for c in self.star_tree_configs],
+            "compression": self.compression,
+            "segmentFlushRows": self.segment_flush_rows,
+            "segmentFlushSeconds": self.segment_flush_seconds,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "IndexingConfig":
+        return cls(
+            inverted_index_columns=d.get("invertedIndexColumns", []),
+            range_index_columns=d.get("rangeIndexColumns", []),
+            bloom_filter_columns=d.get("bloomFilterColumns", []),
+            sorted_column=d.get("sortedColumn"),
+            no_dictionary_columns=d.get("noDictionaryColumns", []),
+            on_heap_dictionary_columns=d.get("onHeapDictionaryColumns", []),
+            json_index_columns=d.get("jsonIndexColumns", []),
+            text_index_columns=d.get("textIndexColumns", []),
+            fst_index_columns=d.get("fstIndexColumns", []),
+            vector_index_columns=d.get("vectorIndexColumns", []),
+            clp_columns=d.get("clpColumns", []),
+            star_tree_configs=[StarTreeIndexConfig.from_dict(c)
+                               for c in d.get("starTreeIndexConfigs", [])],
+            compression=d.get("compression", "LZ4"),
+            segment_flush_rows=d.get("segmentFlushRows", 500_000),
+            segment_flush_seconds=d.get("segmentFlushSeconds", 6 * 3600),
+        )
+
+
+@dataclass
+class StreamIngestionConfig:
+    """Ref: spi/stream/StreamConfig.java — stream type + consumer factory props."""
+
+    stream_type: str = "memory"  # memory | kafka | file
+    topic: str = ""
+    consumer_factory: str = ""
+    properties: Dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {"streamType": self.stream_type, "topic": self.topic,
+                "consumerFactory": self.consumer_factory, "properties": self.properties}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "StreamIngestionConfig":
+        return cls(stream_type=d.get("streamType", "memory"), topic=d.get("topic", ""),
+                   consumer_factory=d.get("consumerFactory", ""),
+                   properties=d.get("properties", {}))
+
+
+@dataclass
+class IngestionConfig:
+    """Ref: spi/config/table/ingestion/IngestionConfig.java — transforms + filters."""
+
+    # list of {"columnName": ..., "transformFunction": ...}
+    transform_configs: List[Dict[str, str]] = field(default_factory=list)
+    filter_function: Optional[str] = None
+    stream: Optional[StreamIngestionConfig] = None
+
+    def to_dict(self) -> dict:
+        d: dict = {"transformConfigs": self.transform_configs}
+        if self.filter_function:
+            d["filterFunction"] = self.filter_function
+        if self.stream:
+            d["streamIngestionConfig"] = self.stream.to_dict()
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "IngestionConfig":
+        stream = d.get("streamIngestionConfig")
+        return cls(
+            transform_configs=d.get("transformConfigs", []),
+            filter_function=d.get("filterFunction"),
+            stream=StreamIngestionConfig.from_dict(stream) if stream else None,
+        )
+
+
+@dataclass
+class UpsertConfig:
+    """Ref: spi/config/table/UpsertConfig.java — FULL or PARTIAL mode."""
+
+    mode: str = "FULL"  # FULL | PARTIAL
+    comparison_column: Optional[str] = None
+    partial_upsert_strategies: Dict[str, str] = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {"mode": self.mode, "comparisonColumn": self.comparison_column,
+                "partialUpsertStrategies": self.partial_upsert_strategies}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "UpsertConfig":
+        return cls(mode=d.get("mode", "FULL"), comparison_column=d.get("comparisonColumn"),
+                   partial_upsert_strategies=d.get("partialUpsertStrategies", {}))
+
+
+@dataclass
+class DedupConfig:
+    """Ref: spi/config/table/DedupConfig.java."""
+
+    enabled: bool = True
+    hash_function: str = "NONE"
+
+    def to_dict(self) -> dict:
+        return {"dedupEnabled": self.enabled, "hashFunction": self.hash_function}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "DedupConfig":
+        return cls(enabled=d.get("dedupEnabled", True),
+                   hash_function=d.get("hashFunction", "NONE"))
+
+
+@dataclass
+class RoutingConfig:
+    """Ref: spi/config/table/RoutingConfig.java — segment pruner + selector types."""
+
+    segment_pruner_types: List[str] = field(default_factory=lambda: ["time", "partition"])
+    instance_selector_type: str = "balanced"  # balanced | replicaGroup | adaptive
+
+    def to_dict(self) -> dict:
+        return {"segmentPrunerTypes": self.segment_pruner_types,
+                "instanceSelectorType": self.instance_selector_type}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "RoutingConfig":
+        return cls(segment_pruner_types=d.get("segmentPrunerTypes", ["time", "partition"]),
+                   instance_selector_type=d.get("instanceSelectorType", "balanced"))
+
+
+@dataclass
+class QueryConfig:
+    """Ref: spi/config/table/QueryConfig.java — per-table query overrides."""
+
+    timeout_ms: Optional[int] = None
+    max_rows_in_join: Optional[int] = None
+    expression_override_map: Dict[str, str] = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {"timeoutMs": self.timeout_ms, "maxRowsInJoin": self.max_rows_in_join,
+                "expressionOverrideMap": self.expression_override_map}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "QueryConfig":
+        return cls(timeout_ms=d.get("timeoutMs"), max_rows_in_join=d.get("maxRowsInJoin"),
+                   expression_override_map=d.get("expressionOverrideMap", {}))
+
+
+@dataclass
+class RetentionConfig:
+    """Ref: SegmentsValidationAndRetentionConfig — retention + replication."""
+
+    retention_time_unit: str = "DAYS"
+    retention_time_value: Optional[int] = None
+    replication: int = 1
+    time_column: Optional[str] = None
+
+    def to_dict(self) -> dict:
+        return {"retentionTimeUnit": self.retention_time_unit,
+                "retentionTimeValue": self.retention_time_value,
+                "replication": self.replication, "timeColumnName": self.time_column}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "RetentionConfig":
+        return cls(retention_time_unit=d.get("retentionTimeUnit", "DAYS"),
+                   retention_time_value=d.get("retentionTimeValue"),
+                   replication=d.get("replication", 1),
+                   time_column=d.get("timeColumnName"))
+
+
+@dataclass
+class TableConfig:
+    """Ref: spi/config/table/TableConfig.java:38."""
+
+    name: str  # raw table name, without type suffix
+    table_type: TableType = TableType.OFFLINE
+    indexing: IndexingConfig = field(default_factory=IndexingConfig)
+    ingestion: IngestionConfig = field(default_factory=IngestionConfig)
+    routing: RoutingConfig = field(default_factory=RoutingConfig)
+    query: QueryConfig = field(default_factory=QueryConfig)
+    retention: RetentionConfig = field(default_factory=RetentionConfig)
+    upsert: Optional[UpsertConfig] = None
+    dedup: Optional[DedupConfig] = None
+    # partition column -> (function, numPartitions), ref SegmentPartitionConfig
+    partition_config: Dict[str, Dict[str, Any]] = field(default_factory=dict)
+    tier_configs: List[Dict[str, Any]] = field(default_factory=list)
+    task_configs: Dict[str, Dict[str, str]] = field(default_factory=dict)
+
+    def __post_init__(self):
+        if isinstance(self.table_type, str):
+            self.table_type = TableType(self.table_type)
+
+    @property
+    def table_name_with_type(self) -> str:
+        return f"{self.name}_{self.table_type.value}"
+
+    def to_dict(self) -> dict:
+        d = {
+            "tableName": self.name,
+            "tableType": self.table_type.value,
+            "segmentsConfig": self.retention.to_dict(),
+            "tableIndexConfig": self.indexing.to_dict(),
+            "ingestionConfig": self.ingestion.to_dict(),
+            "routing": self.routing.to_dict(),
+            "query": self.query.to_dict(),
+            "segmentPartitionConfig": self.partition_config,
+            "tierConfigs": self.tier_configs,
+            "task": self.task_configs,
+        }
+        if self.upsert:
+            d["upsertConfig"] = self.upsert.to_dict()
+        if self.dedup:
+            d["dedupConfig"] = self.dedup.to_dict()
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TableConfig":
+        return cls(
+            name=d["tableName"].rsplit("_OFFLINE", 1)[0].rsplit("_REALTIME", 1)[0],
+            table_type=TableType(d.get("tableType", "OFFLINE")),
+            indexing=IndexingConfig.from_dict(d.get("tableIndexConfig", {})),
+            ingestion=IngestionConfig.from_dict(d.get("ingestionConfig", {})),
+            routing=RoutingConfig.from_dict(d.get("routing", {})),
+            query=QueryConfig.from_dict(d.get("query", {})),
+            retention=RetentionConfig.from_dict(d.get("segmentsConfig", {})),
+            upsert=UpsertConfig.from_dict(d["upsertConfig"]) if d.get("upsertConfig") else None,
+            dedup=DedupConfig.from_dict(d["dedupConfig"]) if d.get("dedupConfig") else None,
+            partition_config=d.get("segmentPartitionConfig", {}),
+            tier_configs=d.get("tierConfigs", []),
+            task_configs=d.get("task", {}),
+        )
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2)
+
+    @classmethod
+    def from_json(cls, s: str) -> "TableConfig":
+        return cls.from_dict(json.loads(s))

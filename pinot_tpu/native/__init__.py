@@ -1,0 +1,68 @@
+"""Native (C++) fast paths, loaded via ctypes.
+
+Holds the host-side native runtime pieces: LZ4 block codec, fixed-bit
+unpack, CLP-style log encoding. Analog of the reference's native-adjacent
+layer (com.yscope.clp:clp-ffi JNI, sun.misc.Unsafe buffers — SURVEY.md §2.8).
+
+`lib` is None when the shared library hasn't been built; every caller has a
+pure-python/numpy fallback. Build with: `python -m pinot_tpu.native.build`.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+_SO_PATH = os.path.join(os.path.dirname(__file__), "libpinot_tpu_native.so")
+
+
+class _NativeLib:
+    """ctypes wrapper over libpinot_tpu_native.so."""
+
+    def __init__(self, dll: ctypes.CDLL):
+        self._dll = dll
+        dll.lz4_compress_bound.restype = ctypes.c_int
+        dll.lz4_compress_bound.argtypes = [ctypes.c_int]
+        dll.lz4_compress_default.restype = ctypes.c_int
+        dll.lz4_compress_default.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
+        dll.lz4_decompress_safe.restype = ctypes.c_int
+        dll.lz4_decompress_safe.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
+        dll.bitunpack32.restype = None
+        dll.bitunpack32.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int32), ctypes.c_long, ctypes.c_int]
+
+    def lz4_compress(self, data: bytes) -> bytes:
+        bound = self._dll.lz4_compress_bound(len(data))
+        out = ctypes.create_string_buffer(bound)
+        n = self._dll.lz4_compress_default(data, out, len(data), bound)
+        if n <= 0:
+            raise RuntimeError("lz4 compression failed")
+        return out.raw[:n]
+
+    def lz4_decompress(self, data: bytes, raw_size: int) -> bytes:
+        out = ctypes.create_string_buffer(raw_size)
+        n = self._dll.lz4_decompress_safe(data, out, len(data), raw_size)
+        if n != raw_size:
+            raise RuntimeError(f"lz4 decompression failed ({n} != {raw_size})")
+        return out.raw
+
+    def bitunpack32(self, buf: bytes, n: int, bits: int):
+        import numpy as np
+        out = np.empty(n, dtype=np.int32)
+        self._dll.bitunpack32(
+            buf, out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), n, bits)
+        return out
+
+
+def _load() -> Optional[_NativeLib]:
+    if not os.path.exists(_SO_PATH):
+        return None
+    try:
+        return _NativeLib(ctypes.CDLL(_SO_PATH))
+    except OSError:
+        return None
+
+
+lib: Optional[_NativeLib] = _load()

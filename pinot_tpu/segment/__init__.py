@@ -1,0 +1,18 @@
+"""Columnar segment format: the storage engine.
+
+Reference parity: pinot-segment-spi (contracts: IndexSegment, DataSource:41,
+ForwardIndexReader:38, Dictionary:37, PinotDataBuffer:60) and
+pinot-segment-local (readers/creators).
+
+Design (TPU-first): every index is a contiguous, 64-byte-aligned slice of one
+packed per-segment file (analog of the v3 `columns.psf` + `index_map` layout,
+ref segment/store/SingleFileIndexDirectory.java:69). Dict-encoded columns are
+fixed-bit packed little-endian words so the hot path — bulk unpack to int32
+dictIds — is a single vectorized pass (numpy host-side, Pallas device-side),
+then block-copied to TPU HBM.
+"""
+from pinot_tpu.segment.bitmap import Bitmap
+from pinot_tpu.segment.creator import SegmentCreator, build_segment
+from pinot_tpu.segment.loader import ImmutableSegment, load_segment
+
+__all__ = ["Bitmap", "SegmentCreator", "build_segment", "ImmutableSegment", "load_segment"]

@@ -1,0 +1,139 @@
+"""Doc-id bitmaps — the filter-result currency.
+
+Reference parity: RoaringBitmap usage across the reference (inverted indexes,
+null-value vectors, upsert validDocIds; e.g. BitmapInvertedIndexReader,
+filter/BitmapBasedFilterOperator.java:32). TPU-first substitution: a dense
+bitset over the segment's doc-id space. Segments are bounded (millions of
+docs), so dense is small (1M docs = 125KB), composes with numpy bitwise ops
+host-side, and converts losslessly to the dense 0/1 mask tensors the device
+kernels consume — no run-length decode on the hot path.
+"""
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+import numpy as np
+
+_POPCOUNT8 = np.array([bin(i).count("1") for i in range(256)], dtype=np.int64)
+
+
+class Bitmap:
+    """Fixed-size dense bitset over [0, num_docs)."""
+
+    __slots__ = ("num_docs", "_bytes")
+
+    def __init__(self, num_docs: int, buf: Optional[np.ndarray] = None):
+        self.num_docs = num_docs
+        nbytes = (num_docs + 7) // 8
+        if buf is None:
+            self._bytes = np.zeros(nbytes, dtype=np.uint8)
+        else:
+            b = np.frombuffer(buf, dtype=np.uint8, count=nbytes) \
+                if isinstance(buf, (bytes, bytearray, memoryview)) else np.asarray(buf, dtype=np.uint8)
+            self._bytes = b.copy() if b.base is not None else b
+
+    # -- constructors -------------------------------------------------------
+    @classmethod
+    def from_indices(cls, num_docs: int, indices: Iterable[int]) -> "Bitmap":
+        idx = np.asarray(list(indices) if not isinstance(indices, np.ndarray) else indices,
+                         dtype=np.int64)
+        bm = cls(num_docs)
+        if len(idx):
+            bits = np.zeros(((num_docs + 7) // 8) * 8, dtype=np.uint8)
+            bits[idx] = 1
+            bm._bytes = np.packbits(bits)
+        return bm
+
+    @classmethod
+    def from_mask(cls, mask: np.ndarray) -> "Bitmap":
+        mask = np.asarray(mask, dtype=bool)
+        bm = cls(len(mask))
+        bm._bytes = np.packbits(mask)
+        return bm
+
+    @classmethod
+    def all_set(cls, num_docs: int) -> "Bitmap":
+        bm = cls(num_docs)
+        bm._bytes[:] = 0xFF
+        bm._trim()
+        return bm
+
+    @classmethod
+    def from_range(cls, num_docs: int, start: int, end: int) -> "Bitmap":
+        """Set docs in [start, end)."""
+        mask = np.zeros(num_docs, dtype=bool)
+        mask[start:end] = True
+        return cls.from_mask(mask)
+
+    def _trim(self):
+        """Zero out padding bits beyond num_docs.
+
+        packbits is MSB-first: doc i is bit (7 - i%8) of byte i//8, so the
+        valid bits of the final byte are its top (8 - extra) bits.
+        """
+        extra = (8 - self.num_docs % 8) % 8
+        if extra:
+            self._bytes[-1] &= np.uint8(0xFF & (0xFF << extra))
+
+    # -- ops ----------------------------------------------------------------
+    def __and__(self, other: "Bitmap") -> "Bitmap":
+        out = Bitmap(self.num_docs)
+        out._bytes = self._bytes & other._bytes
+        return out
+
+    def __or__(self, other: "Bitmap") -> "Bitmap":
+        out = Bitmap(self.num_docs)
+        out._bytes = self._bytes | other._bytes
+        return out
+
+    def __xor__(self, other: "Bitmap") -> "Bitmap":
+        out = Bitmap(self.num_docs)
+        out._bytes = self._bytes ^ other._bytes
+        return out
+
+    def invert(self) -> "Bitmap":
+        out = Bitmap(self.num_docs)
+        out._bytes = ~self._bytes
+        out._trim()
+        return out
+
+    def andnot(self, other: "Bitmap") -> "Bitmap":
+        out = Bitmap(self.num_docs)
+        out._bytes = self._bytes & ~other._bytes
+        return out
+
+    # -- accessors ----------------------------------------------------------
+    def cardinality(self) -> int:
+        return int(_POPCOUNT8[self._bytes].sum())
+
+    def is_empty(self) -> bool:
+        return not self._bytes.any()
+
+    def contains(self, doc_id: int) -> bool:
+        return bool((self._bytes[doc_id >> 3] >> (7 - (doc_id & 7))) & 1)
+
+    def set(self, doc_id: int) -> None:
+        self._bytes[doc_id >> 3] |= np.uint8(1 << (7 - (doc_id & 7)))
+
+    def to_mask(self) -> np.ndarray:
+        """Dense bool mask of length num_docs (device-kernel input)."""
+        return np.unpackbits(self._bytes, count=self.num_docs).astype(bool)
+
+    def to_indices(self) -> np.ndarray:
+        """Sorted int32 doc ids (BlockDocIdIterator analog)."""
+        return np.flatnonzero(self.to_mask()).astype(np.int32)
+
+    # -- serde --------------------------------------------------------------
+    def to_bytes(self) -> bytes:
+        return self._bytes.tobytes()
+
+    @classmethod
+    def from_bytes(cls, num_docs: int, data: bytes) -> "Bitmap":
+        return cls(num_docs, data)
+
+    def __eq__(self, other) -> bool:
+        return (isinstance(other, Bitmap) and self.num_docs == other.num_docs
+                and np.array_equal(self._bytes, other._bytes))
+
+    def __repr__(self) -> str:
+        return f"Bitmap(num_docs={self.num_docs}, cardinality={self.cardinality()})"

@@ -1,0 +1,79 @@
+"""Chunk compression codecs for raw (no-dictionary) forward indexes.
+
+Reference parity: pinot-segment-spi compression/ChunkCompressionType.java:21
+(PASS_THROUGH/SNAPPY/ZSTANDARD/LZ4/GZIP). Here: PASS_THROUGH, GZIP via zlib,
+ZSTANDARD via the `zstandard` wheel when present, and LZ4 (block format) via
+the native C++ library (pinot_tpu/native) when built. Codecs unavailable in
+the environment fall back to GZIP at *write* time (the chunk header records
+the codec actually used, so readers never guess).
+"""
+from __future__ import annotations
+
+import zlib
+
+_ZSTD = None
+try:  # optional wheel
+    import zstandard as _ZSTD  # type: ignore
+except ImportError:
+    _ZSTD = None
+
+PASS_THROUGH = 0
+GZIP = 1
+ZSTANDARD = 2
+LZ4 = 3
+
+_NAMES = {"PASS_THROUGH": PASS_THROUGH, "GZIP": GZIP, "ZSTANDARD": ZSTANDARD, "LZ4": LZ4}
+_IDS = {v: k for k, v in _NAMES.items()}
+
+
+def codec_id(name: str) -> int:
+    return _NAMES[name.upper()]
+
+
+def codec_name(cid: int) -> str:
+    return _IDS[cid]
+
+
+def _native_lz4():
+    from pinot_tpu.native import lib  # lazy; may be None
+    return lib
+
+
+def resolve(cid: int) -> int:
+    """Resolve the codec actually usable in this environment."""
+    if cid == ZSTANDARD and _ZSTD is None:
+        return GZIP
+    if cid == LZ4 and _native_lz4() is None:
+        return GZIP
+    return cid
+
+
+def compress(data: bytes, cid: int) -> tuple[int, bytes]:
+    """Returns (actual_codec_id, compressed)."""
+    cid = resolve(cid)
+    if cid == PASS_THROUGH:
+        return cid, data
+    if cid == GZIP:
+        return cid, zlib.compress(data, level=1)
+    if cid == ZSTANDARD:
+        return cid, _ZSTD.ZstdCompressor(level=3).compress(data)
+    if cid == LZ4:
+        return cid, _native_lz4().lz4_compress(data)
+    raise ValueError(f"unknown codec {cid}")
+
+
+def decompress(data: bytes, cid: int, raw_size: int) -> bytes:
+    if cid == PASS_THROUGH:
+        return bytes(data)
+    if cid == GZIP:
+        return zlib.decompress(bytes(data))
+    if cid == ZSTANDARD:
+        if _ZSTD is None:
+            raise RuntimeError("segment written with ZSTANDARD but wheel missing")
+        return _ZSTD.ZstdDecompressor().decompress(bytes(data), max_output_size=raw_size)
+    if cid == LZ4:
+        lib = _native_lz4()
+        if lib is None:
+            raise RuntimeError("segment written with LZ4 but native lib missing")
+        return lib.lz4_decompress(bytes(data), raw_size)
+    raise ValueError(f"unknown codec {cid}")

@@ -1,0 +1,245 @@
+"""Segment creation: columnar rows -> immutable packed segment.
+
+Reference parity: pinot-segment-local
+segment/creator/impl/SegmentIndexCreationDriverImpl.java:93,231 — stats pass
+(cardinality/min/max/sortedness), dictionary creation, per-column index
+writing, v3 packing, metadata. Single-pass here because input is already
+columnar in memory (the ingestion layer materializes columns).
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from pinot_tpu.models import (DataType, FieldSpec, FieldType, Schema, TableConfig)
+from pinot_tpu.segment import bitpack, fwd, index_types as it
+from pinot_tpu.segment.bitmap import Bitmap
+from pinot_tpu.segment.dictionary import Dictionary
+from pinot_tpu.segment.indexes import BloomFilter, InvertedIndex, RangeIndex, SortedIndex
+from pinot_tpu.segment.meta import ColumnMetadata, SegmentMetadata
+from pinot_tpu.segment.store import index_key, write_segment
+
+ColumnData = Union[np.ndarray, Sequence]
+
+
+class SegmentCreator:
+    def __init__(self, table_config: TableConfig, schema: Schema):
+        self.table_config = table_config
+        self.schema = schema
+
+    def build(self, columns: Dict[str, ColumnData], out_dir: str,
+              segment_name: str, partition_id: Optional[int] = None) -> str:
+        """columns: name -> values (SV: flat array/list, may contain None;
+        MV: list of lists). Returns out_dir."""
+        idx_cfg = self.table_config.indexing
+        num_docs = _num_docs(columns, self.schema)
+        for cname, cdata in columns.items():
+            if cdata is not None and len(cdata) != num_docs:
+                raise ValueError(
+                    f"column {cname!r} has {len(cdata)} values, expected {num_docs}")
+        buffers: Dict[str, bytes] = {}
+        col_meta: Dict[str, ColumnMetadata] = {}
+
+        for spec in self.schema.fields:
+            if spec.virtual:
+                continue
+            data = columns.get(spec.name)
+            if spec.single_value:
+                meta = self._build_sv(spec, data, num_docs, idx_cfg, buffers)
+            else:
+                meta = self._build_mv(spec, data, num_docs, idx_cfg, buffers)
+            if partition_id is not None and spec.name in self.table_config.partition_config:
+                pc = self.table_config.partition_config[spec.name]
+                meta.partition_function = pc.get("functionName", "Modulo")
+                meta.num_partitions = pc.get("numPartitions", 1)
+                meta.partitions = [partition_id]
+            col_meta[spec.name] = meta
+
+        time_col = self.table_config.retention.time_column
+        start_t = end_t = None
+        if time_col and time_col in col_meta:
+            start_t = col_meta[time_col].min_value
+            end_t = col_meta[time_col].max_value
+
+        metadata = SegmentMetadata(
+            segment_name=segment_name,
+            table_name=self.table_config.table_name_with_type,
+            num_docs=num_docs, columns=col_meta, time_column=time_col,
+            start_time=start_t, end_time=end_t,
+            creation_time_ms=int(time.time() * 1000),
+        )
+
+        # Star-tree build happens before packing (ref
+        # SegmentIndexCreationDriverImpl.java:396 buildStarTreeV2IfNecessary).
+        if idx_cfg.star_tree_configs:
+            try:
+                from pinot_tpu.segment.startree import build_star_trees
+            except ImportError as e:
+                raise NotImplementedError(
+                    "star-tree index build is not available in this build") from e
+            build_star_trees(self.table_config, self.schema, columns, metadata, buffers)
+
+        write_segment(out_dir, metadata, buffers)
+        return out_dir
+
+    # ------------------------------------------------------------------
+    def _build_sv(self, spec: FieldSpec, data: Optional[ColumnData], num_docs: int,
+                  idx_cfg, buffers: Dict[str, bytes]) -> ColumnMetadata:
+        name = spec.name
+        values, null_bm = _normalize_sv(spec, data, num_docs)
+        meta = ColumnMetadata(name=name, data_type=spec.data_type,
+                              field_type=spec.field_type, single_value=True,
+                              total_entries=num_docs, has_nulls=not null_bm.is_empty())
+        if not null_bm.is_empty():
+            buffers[index_key(name, it.NULLVECTOR)] = null_bm.to_bytes()
+            meta.indexes.append(it.NULLVECTOR)
+
+        use_dict = name not in idx_cfg.no_dictionary_columns
+        if use_dict:
+            dictionary, dict_ids = Dictionary.build(spec.data_type, values)
+            card = dictionary.cardinality
+            bits = bitpack.num_bits(card)
+            meta.has_dictionary = True
+            meta.cardinality = card
+            meta.bits_per_element = bits
+            meta.min_value = dictionary.min_value
+            meta.max_value = dictionary.max_value
+            meta.is_sorted = bool(num_docs <= 1 or np.all(dict_ids[1:] >= dict_ids[:-1]))
+            buffers[index_key(name, it.DICTIONARY)] = dictionary.to_bytes()
+            buffers[index_key(name, it.FORWARD)] = fwd.write_sv_dict(dict_ids, bits)
+            meta.indexes += [it.DICTIONARY, it.FORWARD]
+
+            if meta.is_sorted:
+                buffers[index_key(name, it.SORTED)] = \
+                    SortedIndex.build(dict_ids, card).to_bytes()
+                meta.indexes.append(it.SORTED)
+            if name in idx_cfg.inverted_index_columns and not meta.is_sorted:
+                buffers[index_key(name, it.INVERTED)] = \
+                    InvertedIndex.build(dict_ids, card, num_docs).to_bytes()
+                meta.indexes.append(it.INVERTED)
+            if name in idx_cfg.range_index_columns and not meta.is_sorted:
+                buffers[index_key(name, it.RANGE)] = \
+                    RangeIndex.build(dict_ids, card, num_docs).to_bytes()
+                meta.indexes.append(it.RANGE)
+            if name in idx_cfg.bloom_filter_columns:
+                buffers[index_key(name, it.BLOOM)] = \
+                    BloomFilter.build(list(dictionary.values)).to_bytes()
+                meta.indexes.append(it.BLOOM)
+        else:
+            meta.has_dictionary = False
+            st = spec.data_type.stored_type
+            if st.is_fixed_width:
+                arr = np.asarray(values, dtype=spec.data_type.np_dtype)
+                meta.min_value = arr.min().item() if num_docs else None
+                meta.max_value = arr.max().item() if num_docs else None
+                buffers[index_key(name, it.FORWARD)] = \
+                    fwd.write_raw_fixed(arr, idx_cfg.compression)
+            else:
+                is_bytes = st is DataType.BYTES
+                if num_docs:
+                    meta.min_value = min(values)
+                    meta.max_value = max(values)
+                buffers[index_key(name, it.FORWARD)] = \
+                    fwd.write_raw_var(list(values), idx_cfg.compression, is_bytes)
+            meta.indexes.append(it.FORWARD)
+            if name in idx_cfg.bloom_filter_columns:
+                buffers[index_key(name, it.BLOOM)] = \
+                    BloomFilter.build(list(dict.fromkeys(values))).to_bytes()
+                meta.indexes.append(it.BLOOM)
+        return meta
+
+    # ------------------------------------------------------------------
+    def _build_mv(self, spec: FieldSpec, data: Optional[ColumnData], num_docs: int,
+                  idx_cfg, buffers: Dict[str, bytes]) -> ColumnMetadata:
+        name = spec.name
+        rows: List[list] = []
+        default = spec.default_null_value
+        src = data if data is not None else [None] * num_docs
+        null_docs = []
+        for i, row in enumerate(src):
+            if row is None or (isinstance(row, (list, tuple, np.ndarray)) and len(row) == 0):
+                rows.append([default])
+                null_docs.append(i)
+            elif isinstance(row, (list, tuple, np.ndarray)):
+                rows.append([spec.data_type.convert(v) for v in row])
+            else:
+                rows.append([spec.data_type.convert(row)])
+        flat = np.array([v for r in rows for v in r],
+                        dtype=spec.data_type.np_dtype if spec.data_type.np_dtype != np.dtype(object) else object)
+        dictionary, flat_ids = Dictionary.build(spec.data_type, flat)
+        card = dictionary.cardinality
+        bits = bitpack.num_bits(card)
+        ids_per_doc = []
+        pos = 0
+        for r in rows:
+            ids_per_doc.append(flat_ids[pos:pos + len(r)])
+            pos += len(r)
+        meta = ColumnMetadata(
+            name=name, data_type=spec.data_type, field_type=spec.field_type,
+            single_value=False, has_dictionary=True, cardinality=card,
+            bits_per_element=bits, min_value=dictionary.min_value,
+            max_value=dictionary.max_value, is_sorted=False,
+            total_entries=len(flat),
+            max_num_multi_values=max((len(r) for r in rows), default=0),
+            has_nulls=bool(null_docs),
+        )
+        buffers[index_key(name, it.DICTIONARY)] = dictionary.to_bytes()
+        buffers[index_key(name, it.FORWARD)] = fwd.write_mv_dict(ids_per_doc, bits)
+        meta.indexes += [it.DICTIONARY, it.FORWARD]
+        if null_docs:
+            buffers[index_key(name, it.NULLVECTOR)] = \
+                Bitmap.from_indices(num_docs, null_docs).to_bytes()
+            meta.indexes.append(it.NULLVECTOR)
+        if name in idx_cfg.inverted_index_columns:
+            offsets = np.zeros(num_docs + 1, dtype=np.int32)
+            np.cumsum([len(r) for r in rows], out=offsets[1:])
+            buffers[index_key(name, it.INVERTED)] = \
+                InvertedIndex.build_mv(offsets, flat_ids, card, num_docs).to_bytes()
+            meta.indexes.append(it.INVERTED)
+        return meta
+
+
+def _num_docs(columns: Dict[str, ColumnData], schema: Schema) -> int:
+    for name in schema.column_names:
+        if name in columns and columns[name] is not None:
+            return len(columns[name])
+    raise ValueError("no columns provided")
+
+
+def _normalize_sv(spec: FieldSpec, data: Optional[ColumnData], num_docs: int):
+    """Replace nulls with the default null value; return (values, null bitmap).
+
+    Ref: record transformer null handling + NullValueVectorCreator.
+    """
+    default = spec.default_null_value
+    if data is None:
+        return (np.full(num_docs, default, dtype=spec.data_type.np_dtype),
+                Bitmap.all_set(num_docs))
+    npdt = spec.data_type.np_dtype
+    if isinstance(data, np.ndarray) and data.dtype != np.dtype(object):
+        arr = np.ascontiguousarray(data, dtype=npdt)
+        if np.issubdtype(arr.dtype, np.floating):
+            nan_mask = np.isnan(arr)
+            if nan_mask.any():
+                arr = arr.copy()
+                arr[nan_mask] = default
+                return arr, Bitmap.from_mask(nan_mask)
+        return arr, Bitmap(num_docs)
+    null_idx = []
+    out = []
+    for i, v in enumerate(data):
+        if v is None or (isinstance(v, float) and np.isnan(v)):
+            out.append(default)
+            null_idx.append(i)
+        else:
+            out.append(spec.data_type.convert(v))
+    arr = np.array(out, dtype=npdt)
+    return arr, Bitmap.from_indices(num_docs, null_idx)
+
+
+def build_segment(table_config: TableConfig, schema: Schema,
+                  columns: Dict[str, ColumnData], out_dir: str,
+                  segment_name: str, **kw) -> str:
+    return SegmentCreator(table_config, schema).build(columns, out_dir, segment_name, **kw)

@@ -1,0 +1,22 @@
+"""Index-type registry keys.
+
+Reference parity: pinot-segment-spi index/StandardIndexes.java — the canonical
+set of index types a column may carry. Extension indexes register here too
+(ref IndexPlugin/IndexService ServiceLoader mechanism).
+"""
+DICTIONARY = "dictionary"
+FORWARD = "forward_index"
+INVERTED = "inverted_index"
+RANGE = "range_index"
+SORTED = "sorted_index"
+BLOOM = "bloom_filter"
+NULLVECTOR = "nullvalue_vector"
+JSON = "json_index"
+TEXT = "text_index"
+FST = "fst_index"
+VECTOR = "vector_index"
+STARTREE = "startree_index"
+STARTREE_DATA = "startree_data"
+
+ALL = [DICTIONARY, FORWARD, INVERTED, RANGE, SORTED, BLOOM, NULLVECTOR,
+       JSON, TEXT, FST, VECTOR, STARTREE, STARTREE_DATA]

@@ -1,0 +1,178 @@
+"""Immutable segment loading + per-column DataSource.
+
+Reference parity: pinot-segment-local
+indexsegment/immutable/ImmutableSegmentLoader.java:57 (mmap load) and
+pinot-segment-spi datasource/DataSource.java:41 (per-column access point:
+getForwardIndex:58, getDictionary:71, per-index getters:77-132).
+
+The DataSource decodes lazily and caches: `dict_ids()` (the int32 block the
+TPU kernels consume) and `values()` (materialized raw values for the CPU
+reference path / var-width columns).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from pinot_tpu.models.field_spec import DataType
+from pinot_tpu.segment import fwd, index_types as it
+from pinot_tpu.segment.bitmap import Bitmap
+from pinot_tpu.segment.dictionary import Dictionary
+from pinot_tpu.segment.indexes import BloomFilter, InvertedIndex, RangeIndex, SortedIndex
+from pinot_tpu.segment.meta import ColumnMetadata, SegmentMetadata
+from pinot_tpu.segment.store import SegmentDirectory
+
+
+class DataSource:
+    """Per-column access point (ref DataSource.java:41)."""
+
+    def __init__(self, seg: "ImmutableSegment", meta: ColumnMetadata):
+        self._seg = seg
+        self.metadata = meta
+        self._dictionary: Optional[Dictionary] = None
+        self._dict_ids: Optional[np.ndarray] = None
+        self._values: Optional[np.ndarray] = None
+        self._mv_offsets: Optional[np.ndarray] = None
+        self._inverted: Optional[InvertedIndex] = None
+        self._range: Optional[RangeIndex] = None
+        self._sorted: Optional[SortedIndex] = None
+        self._bloom: Optional[BloomFilter] = None
+        self._nullvec: Optional[Bitmap] = None
+
+    # -- dictionary ---------------------------------------------------------
+    @property
+    def dictionary(self) -> Optional[Dictionary]:
+        if self._dictionary is None and self.metadata.has_dictionary:
+            buf = self._seg.dir.get_buffer(self.metadata.name, it.DICTIONARY)
+            self._dictionary = Dictionary.from_bytes(
+                self.metadata.data_type, buf, self.metadata.cardinality)
+        return self._dictionary
+
+    # -- forward index ------------------------------------------------------
+    def dict_ids(self) -> np.ndarray:
+        """Whole-column int32 dictIds (SV dict-encoded columns)."""
+        if self._dict_ids is None:
+            m = self.metadata
+            if not m.has_dictionary:
+                raise ValueError(f"column {m.name} is raw-encoded")
+            buf = self._seg.dir.get_buffer(m.name, it.FORWARD)
+            if m.single_value:
+                self._dict_ids = fwd.read_sv_dict(buf, self._seg.num_docs,
+                                                  m.bits_per_element)
+            else:
+                self._mv_offsets, self._dict_ids = fwd.read_mv_dict(
+                    buf, self._seg.num_docs, m.bits_per_element)
+        return self._dict_ids
+
+    def mv_offsets(self) -> np.ndarray:
+        if self._mv_offsets is None:
+            self.dict_ids()
+        return self._mv_offsets
+
+    def values(self) -> np.ndarray:
+        """Whole-column materialized values (dictionary take or raw decode)."""
+        if self._values is None:
+            m = self.metadata
+            if m.has_dictionary:
+                self._values = self.dictionary.get_values(self.dict_ids())
+            else:
+                buf = self._seg.dir.get_buffer(m.name, it.FORWARD)
+                st = m.data_type.stored_type
+                if st.is_fixed_width:
+                    self._values = fwd.read_raw_fixed(
+                        buf, self._seg.num_docs, m.data_type.np_dtype)
+                else:
+                    self._values = fwd.read_raw_var(
+                        buf, self._seg.num_docs, st is DataType.BYTES)
+        return self._values
+
+    # -- auxiliary indexes (ref DataSource getters :77-132) ------------------
+    @property
+    def inverted_index(self) -> Optional[InvertedIndex]:
+        if self._inverted is None and self._has(it.INVERTED):
+            self._inverted = InvertedIndex.from_bytes(
+                self._seg.dir.get_buffer(self.metadata.name, it.INVERTED))
+        return self._inverted
+
+    @property
+    def range_index(self) -> Optional[RangeIndex]:
+        if self._range is None and self._has(it.RANGE):
+            self._range = RangeIndex.from_bytes(
+                self._seg.dir.get_buffer(self.metadata.name, it.RANGE))
+        return self._range
+
+    @property
+    def sorted_index(self) -> Optional[SortedIndex]:
+        if self._sorted is None and self._has(it.SORTED):
+            self._sorted = SortedIndex.from_bytes(
+                self._seg.dir.get_buffer(self.metadata.name, it.SORTED))
+        return self._sorted
+
+    @property
+    def bloom_filter(self) -> Optional[BloomFilter]:
+        if self._bloom is None and self._has(it.BLOOM):
+            self._bloom = BloomFilter.from_bytes(
+                self._seg.dir.get_buffer(self.metadata.name, it.BLOOM))
+        return self._bloom
+
+    @property
+    def null_value_vector(self) -> Optional[Bitmap]:
+        if self._nullvec is None and self._has(it.NULLVECTOR):
+            self._nullvec = Bitmap.from_bytes(
+                self._seg.num_docs,
+                self._seg.dir.get_buffer(self.metadata.name, it.NULLVECTOR))
+        return self._nullvec
+
+    def _has(self, index_type: str) -> bool:
+        return self._seg.dir.has_index(self.metadata.name, index_type)
+
+
+class ImmutableSegment:
+    """Loaded immutable segment (ref IndexSegment/ImmutableSegmentImpl)."""
+
+    def __init__(self, seg_dir: str):
+        self.dir = SegmentDirectory(seg_dir)
+        self.metadata: SegmentMetadata = self.dir.metadata
+        self._sources: Dict[str, DataSource] = {}
+        self._star_tree = None
+
+    @property
+    def name(self) -> str:
+        return self.metadata.segment_name
+
+    @property
+    def num_docs(self) -> int:
+        return self.metadata.num_docs
+
+    @property
+    def column_names(self) -> List[str]:
+        return list(self.metadata.columns.keys())
+
+    def data_source(self, column: str) -> DataSource:
+        ds = self._sources.get(column)
+        if ds is None:
+            cmeta = self.metadata.columns.get(column)
+            if cmeta is None:
+                raise KeyError(f"column {column!r} not in segment {self.name}")
+            ds = DataSource(self, cmeta)
+            self._sources[column] = ds
+        return ds
+
+    def has_column(self, column: str) -> bool:
+        return column in self.metadata.columns
+
+    @property
+    def star_tree(self):
+        if self._star_tree is None and self.metadata.star_tree:
+            from pinot_tpu.segment.startree import StarTreeReader
+            self._star_tree = StarTreeReader(self)
+        return self._star_tree
+
+    def destroy(self) -> None:
+        self._sources.clear()
+
+
+def load_segment(seg_dir: str) -> ImmutableSegment:
+    """Ref ImmutableSegmentLoader.load(indexDir, readMode) — mmap read mode."""
+    return ImmutableSegment(seg_dir)

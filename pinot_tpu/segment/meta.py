@@ -1,0 +1,118 @@
+"""Segment + column metadata.
+
+Reference parity: pinot-segment-spi ColumnMetadata / SegmentMetadata and the
+`metadata.properties` file written by SegmentIndexCreationDriverImpl (here a
+single metadata.json per segment).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from pinot_tpu.models.field_spec import DataType, FieldType, _json_safe
+
+
+@dataclass
+class ColumnMetadata:
+    name: str
+    data_type: DataType
+    field_type: FieldType = FieldType.DIMENSION
+    single_value: bool = True
+    has_dictionary: bool = True
+    cardinality: int = 0
+    bits_per_element: int = 0
+    min_value: Any = None
+    max_value: Any = None
+    is_sorted: bool = False
+    total_entries: int = 0       # == num_docs for SV; total flattened for MV
+    max_num_multi_values: int = 0
+    has_nulls: bool = False
+    partition_function: Optional[str] = None
+    num_partitions: int = 0
+    partitions: List[int] = field(default_factory=list)
+    indexes: List[str] = field(default_factory=list)  # index types present
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "dataType": self.data_type.value,
+            "fieldType": self.field_type.value,
+            "singleValue": self.single_value,
+            "hasDictionary": self.has_dictionary,
+            "cardinality": self.cardinality,
+            "bitsPerElement": self.bits_per_element,
+            "minValue": _json_safe(self.min_value),
+            "maxValue": _json_safe(self.max_value),
+            "isSorted": self.is_sorted,
+            "totalEntries": self.total_entries,
+            "maxNumMultiValues": self.max_num_multi_values,
+            "hasNulls": self.has_nulls,
+            "partitionFunction": self.partition_function,
+            "numPartitions": self.num_partitions,
+            "partitions": self.partitions,
+            "indexes": self.indexes,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ColumnMetadata":
+        dt = DataType(d["dataType"])
+        mn, mx = d.get("minValue"), d.get("maxValue")
+        if dt.stored_type is DataType.BYTES:
+            mn = bytes.fromhex(mn) if isinstance(mn, str) else mn
+            mx = bytes.fromhex(mx) if isinstance(mx, str) else mx
+        return cls(
+            name=d["name"], data_type=dt, field_type=FieldType(d["fieldType"]),
+            single_value=d["singleValue"], has_dictionary=d["hasDictionary"],
+            cardinality=d["cardinality"], bits_per_element=d["bitsPerElement"],
+            min_value=mn, max_value=mx, is_sorted=d["isSorted"],
+            total_entries=d["totalEntries"],
+            max_num_multi_values=d.get("maxNumMultiValues", 0),
+            has_nulls=d.get("hasNulls", False),
+            partition_function=d.get("partitionFunction"),
+            num_partitions=d.get("numPartitions", 0),
+            partitions=d.get("partitions", []),
+            indexes=d.get("indexes", []),
+        )
+
+
+@dataclass
+class SegmentMetadata:
+    segment_name: str
+    table_name: str
+    num_docs: int
+    columns: Dict[str, ColumnMetadata] = field(default_factory=dict)
+    time_column: Optional[str] = None
+    start_time: Optional[int] = None
+    end_time: Optional[int] = None
+    creation_time_ms: int = 0
+    crc: int = 0
+    format_version: int = 1
+    star_tree: Optional[dict] = None  # star-tree metadata when present
+
+    def to_dict(self) -> dict:
+        return {
+            "segmentName": self.segment_name,
+            "tableName": self.table_name,
+            "totalDocs": self.num_docs,
+            "timeColumn": self.time_column,
+            "startTime": self.start_time,
+            "endTime": self.end_time,
+            "creationTimeMs": self.creation_time_ms,
+            "crc": self.crc,
+            "formatVersion": self.format_version,
+            "starTree": self.star_tree,
+            "columns": {k: v.to_dict() for k, v in self.columns.items()},
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "SegmentMetadata":
+        return cls(
+            segment_name=d["segmentName"], table_name=d["tableName"],
+            num_docs=d["totalDocs"], time_column=d.get("timeColumn"),
+            start_time=d.get("startTime"), end_time=d.get("endTime"),
+            creation_time_ms=d.get("creationTimeMs", 0), crc=d.get("crc", 0),
+            format_version=d.get("formatVersion", 1),
+            star_tree=d.get("starTree"),
+            columns={k: ColumnMetadata.from_dict(v)
+                     for k, v in d.get("columns", {}).items()},
+        )
