@@ -1,0 +1,539 @@
+"""TpuOperatorExecutor: stages segment columns into HBM and runs the
+fused query kernel across segments.
+
+Reference parity: this replaces the reference's per-segment
+operator chain + combine fan-out (SURVEY.md §3.2 hot loop:
+AggregationOperator/GroupByOperator over ProjectionOperator/DocIdSetOperator
+with per-thread segment tasks, combine/BaseCombineOperator.java:54) with
+ONE device program over stacked [num_segments, padded_docs] blocks.
+
+Responsibilities:
+  * supports(ctx): structural check — which query shapes offload
+  * plan: QueryContext -> DevicePlan IR (ops/plan_ir.py)
+  * staging: per-(segment, column) device arrays, cached in HBM across
+    queries (the segment-cache SURVEY.md §7.5 calls for), padded to
+    power-of-two doc buckets to bound retraces
+  * per-segment predicate resolution -> kernel parameter arrays
+  * multi-device: inputs sharded over the mesh's `segments` axis
+  * result assembly back into AggregationResult/GroupByResult intermediates
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from pinot_tpu.ops import kernels
+from pinot_tpu.ops.plan_ir import DeviceLeaf, DevicePlan
+from pinot_tpu.query.context import QueryContext
+from pinot_tpu.query.expressions import (
+    Expression, Function, Identifier, Literal)
+from pinot_tpu.query.filter import resolve_predicate
+from pinot_tpu.query.results import (
+    AggregationResult, ExecutionStats, GroupByResult)
+from pinot_tpu.segment.loader import DataSource, ImmutableSegment
+
+MAX_DEVICE_GROUPS = 65536
+_LEAF_RANGE_FUNCS = {
+    "equals", "between", "greater_than", "greater_than_or_equal",
+    "less_than", "less_than_or_equal",
+}
+_LEAF_LUT_FUNCS = {"in", "not_in", "like", "regexp_like"}
+
+
+def _pow2(n: int, floor: int = 128) -> int:
+    v = floor
+    while v < n:
+        v *= 2
+    return v
+
+
+class TpuOperatorExecutor:
+    def __init__(self, devices: Optional[Sequence] = None):
+        self.devices = list(devices) if devices is not None else jax.devices()
+        self._mesh = None
+        if len(self.devices) > 1:
+            from jax.sharding import Mesh
+            self._mesh = Mesh(np.array(self.devices), ("segments",))
+
+    # ------------------------------------------------------------------
+    # capability check (structural)
+    # ------------------------------------------------------------------
+    def supports(self, ctx: QueryContext) -> bool:
+        if not ctx.aggregations or ctx.distinct:
+            return False
+        if any(f is not None for f in ctx.agg_filters):
+            return False  # FILTER aggs run host-side for now
+        if any(fn.device_spec is None for fn in ctx.agg_functions):
+            return False
+        for node in ctx.aggregations:
+            if node.args and not (isinstance(node.args[0], Identifier)
+                                  and node.args[0].name == "*"):
+                if self._value_ir_shape(node.args[0]) is None:
+                    return False
+            if node.name == "countmv":
+                return False
+        for g in ctx.group_by:
+            if not isinstance(g, Identifier):
+                return False
+        if ctx.filter is not None and not self._filter_shape_ok(ctx.filter):
+            return False
+        return True
+
+    def _filter_shape_ok(self, e: Expression) -> bool:
+        if not isinstance(e, Function):
+            return False
+        if e.name in ("and", "or"):
+            return all(self._filter_shape_ok(a) for a in e.args)
+        if e.name == "not":
+            return self._filter_shape_ok(e.args[0])
+        if e.name in _LEAF_RANGE_FUNCS | _LEAF_LUT_FUNCS | {"not_equals"}:
+            return bool(e.args) and isinstance(e.args[0], Identifier) and all(
+                isinstance(a, Literal) for a in e.args[1:])
+        return False
+
+    def _value_ir_shape(self, e: Expression) -> Optional[tuple]:
+        """Structural value IR (column stagability checked at execute)."""
+        if isinstance(e, Identifier):
+            return ("col", e.name)
+        if isinstance(e, Literal):
+            if isinstance(e.value, (int, float)) and not isinstance(e.value, bool):
+                return ("lit", float(e.value))
+            return None
+        if isinstance(e, Function):
+            ops = {"plus": "add", "minus": "sub", "times": "mul", "divide": "div"}
+            if e.name in ops and len(e.args) == 2:
+                a = self._value_ir_shape(e.args[0])
+                b = self._value_ir_shape(e.args[1])
+                if a is not None and b is not None:
+                    return (ops[e.name], a, b)
+        return None
+
+    # ------------------------------------------------------------------
+    # execution
+    # ------------------------------------------------------------------
+    def execute(self, segments: List[ImmutableSegment], ctx: QueryContext
+                ) -> Tuple[List[Any], List[ImmutableSegment]]:
+        """Returns (device results, segments to fall back to host)."""
+        plan_info = self._plan(segments, ctx)
+        if plan_info is None:
+            return [], segments
+        plan, slots_of_fn = plan_info
+        try:
+            cols, params, num_docs, S_real, D = self._stage(segments, ctx, plan)
+        except _NotStageable:
+            return [], segments
+        kernel = kernels.compiled_kernel(plan)
+        out = kernel(cols, params, num_docs, D=D)
+        out = {k: np.asarray(v) for k, v in out.items()}
+        results = self._assemble(segments, ctx, plan, out, S_real, slots_of_fn)
+        return results, []
+
+    # ------------------------------------------------------------------
+    def _plan(self, segments, ctx: QueryContext):
+        """Build the DevicePlan from the query + first segment's schema."""
+        seg0 = segments[0]
+        dict_cols: set = set()
+        raw_cols: set = set()
+
+        def classify(col: str) -> bool:
+            if not seg0.has_column(col):
+                return False
+            m = seg0.metadata.columns[col]
+            if not m.single_value:
+                return False
+            if m.has_dictionary:
+                # ids usable for filters/group-by regardless of value type;
+                # value math additionally needs a numeric dictionary
+                dict_cols.add(col)
+                return True
+            if m.data_type.np_dtype.kind in "iuf":
+                raw_cols.add(col)
+                return True
+            return False
+
+        # value IRs for aggregation inputs
+        value_irs: List[Optional[tuple]] = []
+        ir_index: Dict[tuple, int] = {}
+
+        def intern_ir(ir: Optional[tuple]) -> Optional[int]:
+            if ir is None:
+                return None
+            if ir not in ir_index:
+                ir_index[ir] = len(value_irs)
+                value_irs.append(ir)
+            return ir_index[ir]
+
+        def check_value_cols(ir) -> bool:
+            if ir[0] == "col":
+                col = ir[1]
+                if not classify(col):
+                    return False
+                m = seg0.metadata.columns[col]
+                return m.data_type.np_dtype.kind in "iuf" 
+            if ir[0] == "lit":
+                return True
+            return all(check_value_cols(c) for c in ir[1:] if isinstance(c, tuple))
+
+        # aggregation slots
+        agg_ops: List[Tuple[str, Optional[int]]] = []
+        slot_index: Dict[Tuple[str, Optional[int]], int] = {}
+        slots_of_fn: List[Dict[str, int]] = []
+        for node, fn in zip(ctx.aggregations, ctx.agg_functions):
+            arg_ir = None
+            if node.args and not (isinstance(node.args[0], Identifier)
+                                  and node.args[0].name == "*"):
+                arg_ir = self._value_ir_shape(node.args[0])
+                if arg_ir is None or not check_value_cols(arg_ir):
+                    return None
+            vidx = intern_ir(arg_ir)
+            mapping = {}
+            for op in fn.device_spec.ops:
+                key = (op, None if op == "count" else vidx)
+                if op != "count" and vidx is None:
+                    return None
+                if key not in slot_index:
+                    slot_index[key] = len(agg_ops)
+                    agg_ops.append(key)
+                mapping[op] = slot_index[key]
+            slots_of_fn.append(mapping)
+
+        # group-by
+        group_cols: List[str] = []
+        group_strides: List[int] = []
+        num_groups = 0
+        if ctx.group_by:
+            card_pads = []
+            for g in ctx.group_by:
+                col = g.name  # Identifier, checked in supports
+                if not classify(col):
+                    return None
+                m0 = seg0.metadata.columns[col]
+                if not m0.has_dictionary:
+                    return None
+                card = max(seg.metadata.columns[col].cardinality
+                           for seg in segments)
+                group_cols.append(col)
+                card_pads.append(max(card, 1))
+            num_groups = 1
+            for c in card_pads:
+                num_groups *= c
+            if num_groups > MAX_DEVICE_GROUPS:
+                return None
+            stride = num_groups
+            for c in card_pads:
+                stride //= c
+                group_strides.append(stride)
+            # group-by always needs a count slot to detect present groups
+            if ("count", None) not in slot_index:
+                slot_index[("count", None)] = len(agg_ops)
+                agg_ops.append(("count", None))
+
+        # filter IR
+        leaves: List[DeviceLeaf] = []
+        filter_ir = None
+        if ctx.filter is not None:
+            filter_ir = self._build_filter_ir(ctx.filter, seg0, leaves,
+                                              classify)
+            if filter_ir is None:
+                return None
+
+        plan = DevicePlan(
+            filter_ir=filter_ir,
+            leaves=tuple(leaves),
+            value_irs=tuple(value_irs),
+            agg_ops=tuple(agg_ops),
+            group_cols=tuple(group_cols),
+            group_strides=tuple(group_strides),
+            num_groups=num_groups,
+            dict_cols=tuple(sorted(dict_cols)),
+            raw_cols=tuple(sorted(raw_cols)),
+        )
+        return plan, slots_of_fn
+
+    def _build_filter_ir(self, e: Function, seg0, leaves, classify):
+        if e.name in ("and", "or"):
+            children = []
+            for a in e.args:
+                c = self._build_filter_ir(a, seg0, leaves, classify)
+                if c is None:
+                    return None
+                children.append(c)
+            return (e.name, *children)
+        if e.name == "not":
+            c = self._build_filter_ir(e.args[0], seg0, leaves, classify)
+            return None if c is None else ("not", c)
+        if not e.args or not isinstance(e.args[0], Identifier):
+            return None
+        col = e.args[0].name
+        if not classify(col):
+            return None
+        m = seg0.metadata.columns[col]
+        if m.has_dictionary:
+            if e.name in _LEAF_RANGE_FUNCS:
+                kind = "range"
+            elif e.name == "not_equals":
+                kind = "neq"
+            elif e.name in _LEAF_LUT_FUNCS:
+                kind = "lut"
+            else:
+                return None
+        else:
+            if e.name not in _LEAF_RANGE_FUNCS:
+                return None
+            kind = "vrange"
+        leaves.append(DeviceLeaf(kind, col))
+        return ("leaf", len(leaves) - 1)
+
+    # ------------------------------------------------------------------
+    def _stage(self, segments, ctx: QueryContext, plan: DevicePlan):
+        S_real = len(segments)
+        S = S_real
+        if self._mesh is not None:
+            n = len(self.devices)
+            S = ((S_real + n - 1) // n) * n
+        D = _pow2(max(s.num_docs for s in segments))
+
+        cols: Dict[str, jnp.ndarray] = {}
+        params: Dict[str, jnp.ndarray] = {}
+        vdt = np.float64 if jax.config.read("jax_enable_x64") else np.float32
+
+        for col in plan.dict_cols:
+            cols["ids:" + col] = self._stacked(
+                segments, S, D, col, "ids",
+                lambda ds: ds.dict_ids().astype(np.int32), np.int32)
+        for col in plan.raw_cols:
+            cols["val:" + col] = self._stacked(
+                segments, S, D, col, "val",
+                lambda ds: ds.values().astype(vdt), vdt)
+
+        # dictionary value tables for value IR gathers
+        value_cols = set()
+        for ir in plan.value_irs:
+            value_cols |= self._ir_cols(ir)
+        for col in value_cols & set(plan.dict_cols):
+            C = _pow2(max(s.metadata.columns[col].cardinality for s in segments),
+                      floor=8)
+            table = np.zeros((S, C), dtype=vdt)
+            for i, seg in enumerate(segments):
+                vals = seg.data_source(col).dictionary.values_as_f64()
+                if vals is None:
+                    raise _NotStageable()
+                table[i, :len(vals)] = vals.astype(vdt)
+            params["dict:" + col] = self._put(table)
+
+        # per-leaf predicate parameters
+        leaf_exprs = self._collect_leaf_exprs(ctx.filter, plan) \
+            if ctx.filter is not None else []
+        for i, (leaf, expr) in enumerate(zip(plan.leaves, leaf_exprs)):
+            if leaf.kind == "vrange":
+                lo, hi = _vrange_bounds(expr)
+                params[f"leaf{i}:lo"] = self._put(np.full(S, lo, dtype=vdt))
+                params[f"leaf{i}:hi"] = self._put(np.full(S, hi, dtype=vdt))
+                continue
+            if leaf.kind == "range":
+                lo = np.zeros(S, dtype=np.int32)
+                hi = np.full(S, -1, dtype=np.int32)
+                for s, seg in enumerate(segments):
+                    p = resolve_predicate(seg, expr)
+                    if p is None:
+                        raise _NotStageable()
+                    if p.kind == "range":
+                        lo[s], hi[s] = p.lo, p.hi
+                    elif p.kind == "all":
+                        lo[s], hi[s] = 0, 2**31 - 1
+                    elif p.kind == "none":
+                        lo[s], hi[s] = 0, -1
+                    elif p.kind == "set" and len(p.ids) == 1:
+                        lo[s] = hi[s] = int(p.ids[0])
+                    else:
+                        raise _NotStageable()
+                params[f"leaf{i}:lo"] = self._put(lo)
+                params[f"leaf{i}:hi"] = self._put(hi)
+            elif leaf.kind == "neq":
+                idx = np.full(S, -1, dtype=np.int32)
+                for s, seg in enumerate(segments):
+                    p = resolve_predicate(seg, expr)
+                    if p is None:
+                        raise _NotStageable()
+                    if p.kind == "notset" and len(p.ids) == 1:
+                        idx[s] = int(p.ids[0])
+                    elif p.kind == "all":
+                        idx[s] = -1
+                    else:
+                        raise _NotStageable()
+                params[f"leaf{i}:idx"] = self._put(idx)
+            elif leaf.kind == "lut":
+                C = _pow2(max(s.metadata.columns[leaf.column].cardinality
+                              for s in segments), floor=8)
+                table = np.zeros((S, C), dtype=bool)
+                for s, seg in enumerate(segments):
+                    p = resolve_predicate(seg, expr)
+                    if p is None:
+                        raise _NotStageable()
+                    card = seg.metadata.columns[leaf.column].cardinality
+                    if p.kind == "all":
+                        table[s, :card] = True
+                    elif p.kind == "none":
+                        pass
+                    elif p.kind == "range":
+                        table[s, p.lo:p.hi + 1] = True
+                    elif p.kind == "set":
+                        table[s, p.ids] = True
+                    elif p.kind == "notset":
+                        table[s, :card] = True
+                        table[s, p.ids] = False
+                    else:
+                        raise _NotStageable()
+                params[f"leaf{i}:lut"] = self._put(table)
+
+        num_docs = np.zeros(S, dtype=np.int32)
+        num_docs[:S_real] = [s.num_docs for s in segments]
+        return cols, params, self._put(num_docs), S_real, D
+
+    def _stacked(self, segments, S, D, col, kind, fetch, dtype):
+        """Stacked per-segment column block, cached on each segment."""
+        rows = []
+        for seg in segments:
+            cache = seg.__dict__.setdefault("_device_stage_cache", {})
+            key = (kind, col, D)
+            arr = cache.get(key)
+            if arr is None:
+                if not seg.has_column(col):
+                    raise _NotStageable()
+                raw = fetch(seg.data_source(col))
+                arr = np.zeros(D, dtype=dtype)
+                arr[:len(raw)] = raw
+                cache[key] = arr
+            rows.append(arr)
+        block = np.stack(rows) if len(rows) == S else \
+            np.concatenate([np.stack(rows),
+                            np.zeros((S - len(rows), D), dtype=dtype)])
+        return self._put(block)
+
+    def _put(self, arr: np.ndarray):
+        if self._mesh is None:
+            return jnp.asarray(arr)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        spec = P("segments", *([None] * (arr.ndim - 1)))
+        return jax.device_put(arr, NamedSharding(self._mesh, spec))
+
+    @staticmethod
+    def _ir_cols(ir) -> set:
+        if ir is None:
+            return set()
+        if ir[0] == "col":
+            return {ir[1]}
+        out = set()
+        for c in ir[1:]:
+            if isinstance(c, tuple):
+                out |= TpuOperatorExecutor._ir_cols(c)
+        return out
+
+    def _collect_leaf_exprs(self, e: Expression, plan: DevicePlan) -> List[Function]:
+        """Leaf expressions in the same order _build_filter_ir assigned
+        indexes (depth-first, left-to-right)."""
+        out: List[Function] = []
+
+        def walk(node):
+            assert isinstance(node, Function)
+            if node.name in ("and", "or"):
+                for a in node.args:
+                    walk(a)
+            elif node.name == "not":
+                walk(node.args[0])
+            else:
+                out.append(node)
+        walk(e)
+        return out
+
+    # ------------------------------------------------------------------
+    def _assemble(self, segments, ctx: QueryContext, plan: DevicePlan,
+                  out: Dict[str, np.ndarray], S_real: int,
+                  mappings: List[Dict[str, int]]) -> List[Any]:
+        filter_cols = len(set(ctx.filter_columns()))
+        results = []
+        for s, seg in enumerate(segments[:S_real]):
+            matched = int(out["matched"][s])
+            stats = ExecutionStats(
+                num_docs_scanned=matched,
+                num_entries_scanned_in_filter=(
+                    seg.num_docs * filter_cols if ctx.filter is not None else 0),
+                num_entries_scanned_post_filter=matched * len(ctx.aggregations),
+                num_segments_processed=1,
+                num_segments_matched=1 if matched else 0,
+                total_docs=seg.num_docs)
+            if plan.num_groups:
+                results.append(self._assemble_group(
+                    seg, s, ctx, plan, out, mappings, stats))
+            else:
+                inters = []
+                for fn, mapping in zip(ctx.agg_functions, mappings):
+                    slots = {op: out[f"slot{j}"][s] for op, j in mapping.items()}
+                    inters.append(fn.from_device_slots(slots))
+                results.append(AggregationResult(inters, stats))
+        return results
+
+    def _assemble_group(self, seg, s, ctx, plan, out, mappings, stats):
+        # find any count slot to detect present groups
+        count_j = None
+        for j, (op, vidx) in enumerate(plan.agg_ops):
+            if op == "count":
+                count_j = j
+                break
+        assert count_j is not None  # _plan guarantees a count slot
+        present = np.nonzero(out[f"slot{count_j}"][s] > 0)[0]
+
+        # decode combined keys (mixed radix) -> per-column local dictIds
+        dicts = [seg.data_source(c).dictionary for c in plan.group_cols]
+        cards = [seg.metadata.columns[c].cardinality for c in plan.group_cols]
+        rem = present.copy()
+        ids_per_col = []
+        for stride in plan.group_strides:
+            ids_per_col.append(rem // stride)
+            rem = rem % stride
+        valid = np.ones(len(present), dtype=bool)
+        for ids, card in zip(ids_per_col, cards):
+            valid &= ids < card
+        present = present[valid]
+        ids_per_col = [ids[valid] for ids in ids_per_col]
+
+        key_cols = [d.get_values(ids) for d, ids in zip(dicts, ids_per_col)]
+        groups: Dict[tuple, list] = {}
+        for gi, g in enumerate(present):
+            key = tuple(_py(col[gi]) for col in key_cols)
+            inters = []
+            for fn, mapping in zip(ctx.agg_functions, mappings):
+                slots = {op: out[f"slot{j}"][s][g] for op, j in mapping.items()}
+                inters.append(fn.from_device_slots(slots))
+            groups[key] = inters
+        return GroupByResult(groups, stats)
+
+
+class _NotStageable(Exception):
+    pass
+
+
+def _vrange_bounds(e: Function) -> Tuple[float, float]:
+    def lv(i):
+        return float(e.args[i].value)  # type: ignore[union-attr]
+    if e.name == "equals":
+        return lv(1), lv(1)
+    if e.name == "between":
+        return lv(1), lv(2)
+    if e.name == "greater_than":
+        return np.nextafter(lv(1), np.inf), np.inf
+    if e.name == "greater_than_or_equal":
+        return lv(1), np.inf
+    if e.name == "less_than":
+        return -np.inf, np.nextafter(lv(1), -np.inf)
+    if e.name == "less_than_or_equal":
+        return -np.inf, lv(1)
+    raise _NotStageable()
+
+
+def _py(v):
+    return v.item() if isinstance(v, np.generic) else v

@@ -1,0 +1,245 @@
+"""jit'd device kernels built from a DevicePlan.
+
+The kernel computes, for stacked segment blocks [S, D]:
+  mask  = filter tree over dictId compares / LUT gathers     (VPU, fused)
+  vals  = dictionary-value gathers + arithmetic              (fused)
+  out   = masked reductions (sum/min/max/count/sumsq) or
+          group-keyed scatter-add / one-hot matmul partials  (MXU for matmul)
+returning per-segment partials — the host (or a psum over the mesh) merges.
+
+Everything is shape-static: jit re-specializes per (S, D, C, G) bucket and
+the engine pads inputs to bucketed sizes to bound recompiles
+(SURVEY.md §7 hard-parts note on retrace storms).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from pinot_tpu.ops.plan_ir import DeviceLeaf, DevicePlan
+
+# group-by cardinality below which the one-hot matmul path (MXU-friendly)
+# is used instead of scatter-add
+ONEHOT_MAX_GROUPS = 1024
+_ONEHOT_CHUNK = 4096
+
+
+def _value_dtype() -> jnp.dtype:
+    return jnp.float64 if jax.config.read("jax_enable_x64") else jnp.float32
+
+
+# ---------------------------------------------------------------------------
+# IR evaluation (runs at trace time)
+# ---------------------------------------------------------------------------
+
+def _eval_filter(node, plan: DevicePlan, cols: Dict[str, jnp.ndarray],
+                 params: Dict[str, jnp.ndarray]) -> jnp.ndarray:
+    op = node[0]
+    if op == "and":
+        out = _eval_filter(node[1], plan, cols, params)
+        for child in node[2:]:
+            out = out & _eval_filter(child, plan, cols, params)
+        return out
+    if op == "or":
+        out = _eval_filter(node[1], plan, cols, params)
+        for child in node[2:]:
+            out = out | _eval_filter(child, plan, cols, params)
+        return out
+    if op == "not":
+        return ~_eval_filter(node[1], plan, cols, params)
+    assert op == "leaf"
+    i = node[1]
+    leaf = plan.leaves[i]
+    if leaf.kind == "range":
+        ids = cols["ids:" + leaf.column]
+        lo = params[f"leaf{i}:lo"][:, None]
+        hi = params[f"leaf{i}:hi"][:, None]
+        return (ids >= lo) & (ids <= hi)
+    if leaf.kind == "neq":
+        ids = cols["ids:" + leaf.column]
+        return ids != params[f"leaf{i}:idx"][:, None]
+    if leaf.kind == "lut":
+        ids = cols["ids:" + leaf.column]
+        table = params[f"leaf{i}:lut"]  # [S, C] bool
+        return jnp.take_along_axis(table, ids, axis=1)
+    if leaf.kind == "vrange":
+        vals = cols["val:" + leaf.column]
+        lo = params[f"leaf{i}:lo"][:, None]
+        hi = params[f"leaf{i}:hi"][:, None]
+        return (vals >= lo) & (vals <= hi)
+    raise ValueError(f"unknown leaf kind {leaf.kind}")
+
+
+def _eval_value(ir, cols: Dict[str, jnp.ndarray],
+                params: Dict[str, jnp.ndarray]) -> jnp.ndarray:
+    op = ir[0]
+    if op == "col":
+        name = ir[1]
+        key = "val:" + name
+        if key in cols:
+            return cols[key]
+        # dictionary gather: value_table[s, dictId]
+        ids = cols["ids:" + name]
+        table = params["dict:" + name]  # [S, C]
+        return jnp.take_along_axis(table, ids, axis=1)
+    if op == "ids":
+        return cols["ids:" + ir[1]]
+    if op == "lit":
+        return jnp.asarray(ir[1], dtype=_value_dtype())
+    a = _eval_value(ir[1], cols, params)
+    if op == "neg":
+        return -a
+    b = _eval_value(ir[2], cols, params)
+    if op == "add":
+        return a + b
+    if op == "sub":
+        return a - b
+    if op == "mul":
+        return a * b
+    if op == "div":
+        return a / b
+    raise ValueError(f"unknown value ir op {ir[0]}")
+
+
+# ---------------------------------------------------------------------------
+# Reductions
+# ---------------------------------------------------------------------------
+
+def _masked_reduce(op: str, vals: Optional[jnp.ndarray], mask: jnp.ndarray,
+                   valid: jnp.ndarray) -> jnp.ndarray:
+    """[S, D] -> [S] masked reduction. `valid` excludes padding docs."""
+    m = mask & valid
+    dt = _value_dtype()
+    if op == "count":
+        return jnp.sum(m, axis=1).astype(dt)
+    assert vals is not None
+    if op == "sum":
+        return jnp.sum(jnp.where(m, vals, 0), axis=1, dtype=dt)
+    if op == "sumsq":
+        return jnp.sum(jnp.where(m, vals * vals, 0), axis=1, dtype=dt)
+    if op == "min":
+        return jnp.min(jnp.where(m, vals, jnp.inf), axis=1)
+    if op == "max":
+        return jnp.max(jnp.where(m, vals, -jnp.inf), axis=1)
+    raise ValueError(f"unknown reduction {op}")
+
+
+def _grouped_reduce(op: str, vals: Optional[jnp.ndarray], keys: jnp.ndarray,
+                    mask: jnp.ndarray, valid: jnp.ndarray,
+                    num_groups: int) -> jnp.ndarray:
+    """[S, D] + keys [S, D] -> [S, G] per-group partials."""
+    m = mask & valid
+    dt = _value_dtype()
+    safe_keys = jnp.where(m, keys, 0)
+    if op == "count":
+        contrib = m.astype(dt)
+        return _scatter_sum(contrib, safe_keys, num_groups)
+    assert vals is not None
+    if op == "sum":
+        contrib = jnp.where(m, vals, 0).astype(dt)
+        return _scatter_sum(contrib, safe_keys, num_groups)
+    if op == "min":
+        init = jnp.full((vals.shape[0], num_groups), jnp.inf, dtype=vals.dtype)
+        v = jnp.where(m, vals, jnp.inf)
+        return _vmap_scatter(init, safe_keys, v, "min")
+    if op == "max":
+        init = jnp.full((vals.shape[0], num_groups), -jnp.inf, dtype=vals.dtype)
+        v = jnp.where(m, vals, -jnp.inf)
+        return _vmap_scatter(init, safe_keys, v, "max")
+    raise ValueError(f"unknown grouped reduction {op}")
+
+
+def _scatter_sum(contrib: jnp.ndarray, keys: jnp.ndarray,
+                 num_groups: int) -> jnp.ndarray:
+    """Sum contributions per group key.
+
+    Small key spaces ride the MXU as a chunked one-hot matmul
+    (SURVEY.md §7: group-bys become one-hot/segment-sum scatter-adds);
+    large ones fall back to XLA scatter-add.
+    """
+    S, D = contrib.shape
+    if num_groups <= ONEHOT_MAX_GROUPS and D >= _ONEHOT_CHUNK:
+        nchunk = D // _ONEHOT_CHUNK
+        main = nchunk * _ONEHOT_CHUNK
+
+        def body(carry, xs):
+            k, c = xs  # [S, CH]
+            onehot = jax.nn.one_hot(k, num_groups, dtype=c.dtype, axis=-1)
+            return carry + jnp.einsum("sdg,sd->sg", onehot, c), None
+
+        k_chunks = keys[:, :main].reshape(S, nchunk, _ONEHOT_CHUNK).swapaxes(0, 1)
+        c_chunks = contrib[:, :main].reshape(S, nchunk, _ONEHOT_CHUNK).swapaxes(0, 1)
+        out, _ = jax.lax.scan(body, jnp.zeros((S, num_groups), contrib.dtype),
+                              (k_chunks, c_chunks))
+        if main < D:
+            out = _vmap_scatter(out, keys[:, main:], contrib[:, main:], "add")
+        return out
+    return _vmap_scatter(jnp.zeros((S, num_groups), contrib.dtype), keys,
+                         contrib, "add")
+
+
+def _vmap_scatter(init: jnp.ndarray, keys: jnp.ndarray, vals: jnp.ndarray,
+                  mode: str) -> jnp.ndarray:
+    def one(acc, k, v):
+        if mode == "add":
+            return acc.at[k].add(v)
+        if mode == "min":
+            return acc.at[k].min(v)
+        return acc.at[k].max(v)
+    return jax.vmap(one)(init, keys, vals)
+
+
+# ---------------------------------------------------------------------------
+# Kernel assembly
+# ---------------------------------------------------------------------------
+
+def make_kernel(plan: DevicePlan):
+    """Build the traced kernel fn(cols, params, num_docs) -> outputs dict.
+
+    cols:    dict of 'ids:<col>' int32 [S, D] / 'val:<col>' float [S, D]
+    params:  dict of per-leaf arrays, 'dict:<col>' value tables [S, C],
+    num_docs: int32 [S] actual docs per segment (for the padding mask).
+
+    Outputs: {'slot<j>': [S] or [S, G] per agg op} plus 'matched': [S].
+    """
+
+    def kernel(cols, params, num_docs, D):
+        S = num_docs.shape[0]
+        valid = jnp.arange(D, dtype=jnp.int32)[None, :] < num_docs[:, None]
+        if plan.filter_ir is not None:
+            mask = _eval_filter(plan.filter_ir, plan, cols, params)
+        else:
+            mask = jnp.ones((S, D), dtype=bool)
+
+        values = []
+        for ir in plan.value_irs:
+            values.append(None if ir is None else _eval_value(ir, cols, params))
+
+        out: Dict[str, jnp.ndarray] = {}
+        out["matched"] = jnp.sum(mask & valid, axis=1).astype(jnp.int32)
+        if plan.num_groups:
+            keys = jnp.zeros((S, D), dtype=jnp.int32)
+            for col, stride in zip(plan.group_cols, plan.group_strides):
+                keys = keys + cols["ids:" + col] * jnp.int32(stride)
+            for j, (op, vidx) in enumerate(plan.agg_ops):
+                vals = None if vidx is None else values[vidx]
+                out[f"slot{j}"] = _grouped_reduce(op, vals, keys, mask, valid,
+                                                  plan.num_groups)
+        else:
+            for j, (op, vidx) in enumerate(plan.agg_ops):
+                vals = None if vidx is None else values[vidx]
+                out[f"slot{j}"] = _masked_reduce(op, vals, mask, valid)
+        return out
+
+    return kernel
+
+
+@functools.lru_cache(maxsize=256)
+def compiled_kernel(plan: DevicePlan):
+    """jit-compiled kernel for a plan structure (shape specialization is
+    handled inside jit's own cache; D is static because a filterless
+    COUNT(*) stages no columns to infer it from)."""
+    return jax.jit(make_kernel(plan), static_argnames=("D",))

@@ -1,0 +1,51 @@
+"""Device plan IR: the hashable structure a compiled kernel is keyed by.
+
+Reference parity: the role of pinot-core's per-segment Plan tree
+(plan/maker/InstancePlanMakerImplV2.java:270 chooses the operator chain per
+query shape) — but here the "plan" is a pure-data IR handed to
+ops/kernels.build_kernel, and compiled-function caching is keyed by it
+(SURVEY.md §7 hard-parts: cache compiled kernels keyed by plan shape).
+
+Filter IR nodes (nested tuples, hashable):
+    ('and', n1, n2, ...) / ('or', ...) / ('not', n)
+    ('leaf', i)        -- i-th entry of DevicePlan.leaves
+
+Leaf kinds (resolved per-segment into parameter arrays, see ops/engine.py):
+    'range' : lo[S], hi[S] int32     -- lo <= dictId <= hi  (equals folds here)
+    'neq'   : idx[S] int32           -- dictId != idx (idx=-1 matches all)
+    'lut'   : table[S, C] bool       -- table[s, dictId] (in/not-in/like/regex)
+    'vrange': lo[S], hi[S] float     -- lo <= value <= hi (raw numeric columns)
+
+Value IR (aggregation inputs / in-kernel transforms):
+    ('col', name)       -- column values (dict gather or raw staged block)
+    ('ids', name)       -- raw dictIds of a column (group keys)
+    ('lit', v)
+    ('add'|'sub'|'mul'|'div', a, b)
+    ('neg', a)
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+
+@dataclass(frozen=True)
+class DeviceLeaf:
+    kind: str         # 'range' | 'neq' | 'lut' | 'vrange'
+    column: str
+
+
+@dataclass(frozen=True)
+class DevicePlan:
+    """Hashable kernel-structure signature."""
+    filter_ir: Optional[tuple]            # nested tuple tree or None
+    leaves: Tuple[DeviceLeaf, ...]
+    value_irs: Tuple[Optional[tuple], ...]  # one per agg slot input (None = count(*))
+    agg_ops: Tuple[Tuple[str, Optional[int]], ...]  # (op, value_ir index or None)
+    group_cols: Tuple[str, ...] = ()
+    group_strides: Tuple[int, ...] = ()   # mixed-radix strides over padded cards
+    num_groups: int = 0                   # padded combined-key space (0 = no group-by)
+    #: columns staged as dictIds with a dictionary value table
+    dict_cols: Tuple[str, ...] = ()
+    #: columns staged as raw numeric value blocks
+    raw_cols: Tuple[str, ...] = ()

@@ -1,0 +1,20 @@
+"""Query engine: SQL front-end, planning, execution, reduce.
+
+Reference parity: pinot-common sql parser front-end
+(org.apache.pinot.sql.parsers.CalciteSqlParser), pinot-core
+core/plan (per-segment physical planning), core/operator (operator tree),
+core/query/aggregation, core/query/reduce (broker-side merge).
+
+The TPU execution backend lives in pinot_tpu.ops; this package owns the
+host-side logic: parsing, query context, per-segment plan selection, the
+CPU reference executor (correctness oracle + fallback for shapes the
+device path doesn't cover), and the broker reduce.
+"""
+from pinot_tpu.query.expressions import Expression, ExpressionType, Literal, Identifier, Function
+from pinot_tpu.query.parser import parse_sql
+from pinot_tpu.query.context import QueryContext
+
+__all__ = [
+    "Expression", "ExpressionType", "Literal", "Identifier", "Function",
+    "parse_sql", "QueryContext",
+]

@@ -1,0 +1,22 @@
+"""Aggregation functions: registry + base contract.
+
+Reference parity: pinot-core
+query/aggregation/function/AggregationFunction.java:42 — the contract is
+aggregate(block) -> intermediate, aggregateGroupBySV, merge(a, b),
+extractFinalResult; AggregationFunctionFactory resolves names.
+
+Each function here exposes BOTH a numpy host path (the correctness oracle /
+fallback) and, where possible, a device descriptor the TPU engine composes
+into its fused kernel (ops/kernels.py): SUM/COUNT/MIN/MAX are device-native
+masked reductions; AVG = SUM+COUNT pair; the sketch family (HLL, TDigest,
+distinct sets) stays host-side, as SURVEY.md §7.6 plans.
+"""
+from pinot_tpu.query.aggregation.base import (
+    AggregationFunction, DeviceAggSpec, get_aggregation, is_aggregation,
+    REGISTRY)
+from pinot_tpu.query.aggregation import functions as _functions  # registers
+
+__all__ = [
+    "AggregationFunction", "DeviceAggSpec", "get_aggregation",
+    "is_aggregation", "REGISTRY",
+]

@@ -1,0 +1,110 @@
+"""Aggregation function base contract + registry."""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Sequence, Type
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class DeviceAggSpec:
+    """How the TPU kernel computes this aggregation's intermediate.
+
+    op: one of 'sum' | 'min' | 'max' | 'count' | 'sumsq' — the masked
+    reduction the fused device kernel emits. Functions whose intermediate is
+    a tuple of these (AVG = sum+count) list several slots. Functions with no
+    spec run host-side.
+    """
+    ops: tuple  # e.g. ('sum',), ('sum', 'count')
+
+
+class AggregationFunction:
+    """One aggregation instance bound to its argument expressions."""
+
+    #: canonical lower-case name(s) to register under
+    names: Sequence[str] = ()
+    #: device kernel composition, or None for host-only
+    device_spec: Optional[DeviceAggSpec] = None
+
+    def __init__(self, args: tuple):
+        self.args = args  # tuple[Expression]
+
+    # -- host (numpy) path --------------------------------------------------
+    def aggregate(self, values: Optional[np.ndarray], mask: np.ndarray) -> Any:
+        """Whole-block aggregate -> intermediate result.
+
+        values: materialized argument column (None for COUNT(*));
+        mask: boolean filter mask over docs.
+        """
+        raise NotImplementedError
+
+    def aggregate_grouped(self, values: Optional[np.ndarray],
+                          keys: np.ndarray, num_groups: int,
+                          mask: np.ndarray) -> list:
+        """Group-by aggregate: returns list of intermediates per group key.
+
+        keys: int group-key per doc (only where mask); num_groups: key space.
+        Default implementation loops groups via sorting; subclasses override
+        with vectorized bincount-style paths.
+        """
+        out = []
+        for g in range(num_groups):
+            gmask = mask & (keys == g)
+            out.append(self.aggregate(values, gmask))
+        return out
+
+    # -- merge/extract (ref merge / extractFinalResult) ---------------------
+    def merge(self, a: Any, b: Any) -> Any:
+        raise NotImplementedError
+
+    def identity(self) -> Any:
+        """Intermediate for an empty input (merge identity)."""
+        raise NotImplementedError
+
+    def extract_final(self, intermediate: Any) -> Any:
+        return intermediate
+
+    # -- device path --------------------------------------------------------
+    def from_device_slots(self, slots: Dict[str, Any]) -> Any:
+        """Build the intermediate from this function's device reduction
+        outputs; slots maps op-name -> scalar/array for this function's
+        DeviceAggSpec.ops."""
+        raise NotImplementedError
+
+    # -- metadata -----------------------------------------------------------
+    @property
+    def result_name(self) -> str:
+        a = ",".join(str(x) for x in self.args)
+        return f"{self.names[0]}({a})"
+
+    @property
+    def final_dtype(self) -> str:
+        return "DOUBLE"
+
+
+REGISTRY: Dict[str, Type[AggregationFunction]] = {}
+
+
+def register(cls: Type[AggregationFunction]) -> Type[AggregationFunction]:
+    for name in cls.names:
+        REGISTRY[name.lower()] = cls
+    return cls
+
+
+def is_aggregation(name: str) -> bool:
+    if name.lower() in REGISTRY:
+        return True
+    from pinot_tpu.query.aggregation.functions import resolve_percentile_suffix
+    return resolve_percentile_suffix(name, ()) is not None
+
+
+def get_aggregation(name: str, args: tuple) -> AggregationFunction:
+    cls = REGISTRY.get(name.lower())
+    if cls is not None:
+        return cls(args)
+    from pinot_tpu.query.aggregation.functions import resolve_percentile_suffix
+    inst = resolve_percentile_suffix(name, args)
+    if inst is None:
+        raise ValueError(f"unknown aggregation function: {name}")
+    return inst

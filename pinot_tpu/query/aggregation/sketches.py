@@ -1,0 +1,155 @@
+"""Host-side cardinality/quantile sketches.
+
+Reference parity: the reference uses library sketches
+(com.clearspring HyperLogLog, com.tdunning TDigest, Apache DataSketches) —
+pinot-core query/aggregation/function/DistinctCountHLLAggregationFunction,
+PercentileTDigestAggregationFunction. These are clean-room numpy
+implementations of the standard algorithms (Flajolet et al. HLL with the
+usual bias corrections; Dunning's t-digest with size-capped centroid
+merging). They stay host-side per SURVEY.md §7.6.
+"""
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+import numpy as np
+
+
+class HyperLogLog:
+    """Classic HLL with 2^log2m registers and linear-counting small-range
+    correction."""
+
+    def __init__(self, log2m: int = 12):
+        self.log2m = log2m
+        self.m = 1 << log2m
+        self.registers = np.zeros(self.m, dtype=np.uint8)
+
+    def add_array(self, values: np.ndarray) -> None:
+        if len(values) == 0:
+            return
+        hashes = _hash64(values)
+        idx = (hashes >> np.uint64(64 - self.log2m)).astype(np.int64)
+        rest = hashes << np.uint64(self.log2m)
+        # rank = leading zeros of the remaining bits + 1, capped
+        nbits = 64 - self.log2m
+        rank = np.full(len(hashes), nbits + 1, dtype=np.uint8)
+        found = np.zeros(len(hashes), dtype=bool)
+        for b in range(nbits):
+            bit = (rest >> np.uint64(63 - b)) & np.uint64(1)
+            newly = (~found) & (bit == 1)
+            rank[newly] = b + 1
+            found |= newly
+        np.maximum.at(self.registers, idx, rank)
+
+    def merge(self, other: "HyperLogLog") -> "HyperLogLog":
+        assert self.log2m == other.log2m
+        out = HyperLogLog(self.log2m)
+        out.registers = np.maximum(self.registers, other.registers)
+        return out
+
+    def cardinality(self) -> int:
+        m = float(self.m)
+        alpha = 0.7213 / (1 + 1.079 / m)
+        est = alpha * m * m / float(np.sum(2.0 ** -self.registers.astype(np.float64)))
+        if est <= 2.5 * m:
+            zeros = int(np.count_nonzero(self.registers == 0))
+            if zeros:
+                est = m * np.log(m / zeros)
+        return int(round(est))
+
+
+def _hash64(values: np.ndarray) -> np.ndarray:
+    """64-bit avalanche hash (splitmix64 finalizer) over arbitrary values."""
+    if values.dtype.kind in "iu":
+        x = values.astype(np.uint64)
+    elif values.dtype.kind == "f":
+        x = values.astype(np.float64).view(np.uint64)
+    else:
+        x = np.array([hash(v) & 0xFFFFFFFFFFFFFFFF for v in values.tolist()],
+                     dtype=np.uint64)
+    with np.errstate(over="ignore"):
+        x = x + np.uint64(0x9E3779B97F4A7C15)
+        x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+        x = x ^ (x >> np.uint64(31))
+    return x
+
+
+class TDigest:
+    """Size-capped merging t-digest (Dunning & Ertl).
+
+    Centroids are kept sorted; when the buffer exceeds a threshold the
+    digest re-clusters under the scale-function size bound
+    k1(q) = compression/ (2*pi) * asin(2q-1).
+    """
+
+    def __init__(self, compression: float = 100.0):
+        self.compression = compression
+        self.means = np.empty(0, dtype=np.float64)
+        self.weights = np.empty(0, dtype=np.float64)
+        self._buf_means: list = []
+        self._buf_weights: list = []
+        self.total = 0.0
+
+    def add_array(self, values: np.ndarray) -> None:
+        if len(values) == 0:
+            return
+        self._buf_means.extend(values.astype(np.float64).tolist())
+        self._buf_weights.extend([1.0] * len(values))
+        self.total += float(len(values))
+        if len(self._buf_means) > 10 * self.compression:
+            self._compress()
+
+    def merge(self, other: "TDigest") -> "TDigest":
+        out = TDigest(self.compression)
+        self._compress()
+        other._compress()
+        out.means = np.concatenate([self.means, other.means])
+        out.weights = np.concatenate([self.weights, other.weights])
+        out.total = self.total + other.total
+        out._compress(force=True)
+        return out
+
+    def _k(self, q: np.ndarray) -> np.ndarray:
+        q = np.clip(q, 1e-12, 1 - 1e-12)
+        return self.compression * (np.arcsin(2 * q - 1) / np.pi + 0.5)
+
+    def _compress(self, force: bool = False) -> None:
+        if not self._buf_means and not force:
+            return
+        means = np.concatenate([self.means, np.array(self._buf_means)])
+        weights = np.concatenate([self.weights, np.array(self._buf_weights)])
+        self._buf_means, self._buf_weights = [], []
+        if len(means) == 0:
+            return
+        order = np.argsort(means, kind="stable")
+        means, weights = means[order], weights[order]
+        total = weights.sum()
+        out_means, out_weights = [], []
+        cur_m, cur_w = means[0], weights[0]
+        w_so_far = 0.0
+        for i in range(1, len(means)):
+            q0 = w_so_far / total
+            q1 = (w_so_far + cur_w + weights[i]) / total
+            if self._k(np.array([q1]))[0] - self._k(np.array([q0]))[0] <= 1.0:
+                cur_m = (cur_m * cur_w + means[i] * weights[i]) / (cur_w + weights[i])
+                cur_w += weights[i]
+            else:
+                out_means.append(cur_m)
+                out_weights.append(cur_w)
+                w_so_far += cur_w
+                cur_m, cur_w = means[i], weights[i]
+        out_means.append(cur_m)
+        out_weights.append(cur_w)
+        self.means = np.array(out_means)
+        self.weights = np.array(out_weights)
+
+    def quantile(self, q: float) -> float:
+        self._compress(force=True)
+        if len(self.means) == 0:
+            return float("-inf")
+        if len(self.means) == 1:
+            return float(self.means[0])
+        cum = np.cumsum(self.weights) - self.weights / 2.0
+        target = q * self.total
+        return float(np.interp(target, cum, self.means))

@@ -1,0 +1,82 @@
+"""Server-side query executor: prune, fan out over segments, combine.
+
+Reference parity: pinot-core
+query/executor/ServerQueryExecutorV1Impl.java:94,159 (segment acquisition +
+pruning + plan + execute) and operator/combine/BaseCombineOperator.java:54
+(fan N segment plans over worker threads, merge results). The TPU twist:
+instead of one thread per segment, dict-encoded scan shapes are STACKED
+into [num_segments, padded_docs] device blocks and executed as ONE jit'd
+kernel over the mesh's `segments` axis (ops/engine.py); shapes the device
+engine doesn't cover fall back per-segment to the numpy reference path.
+"""
+from __future__ import annotations
+
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, List, Optional, Sequence
+
+from pinot_tpu.query import executor_cpu
+from pinot_tpu.query.context import QueryContext
+from pinot_tpu.query.pruner import prune_segments
+from pinot_tpu.query.reduce import BrokerResponse, reduce_results
+from pinot_tpu.query.results import ExecutionStats
+from pinot_tpu.segment.loader import ImmutableSegment
+
+
+class QueryExecutor:
+    """Executes queries over a set of loaded segments (one 'server')."""
+
+    def __init__(self, segments: Sequence[ImmutableSegment],
+                 use_tpu: bool = True, max_threads: int = 8):
+        self.segments = list(segments)
+        self.max_threads = max_threads
+        self._tpu_engine = None
+        self._use_tpu = use_tpu
+
+    @property
+    def tpu_engine(self):
+        if self._tpu_engine is None and self._use_tpu:
+            from pinot_tpu.ops.engine import TpuOperatorExecutor
+            self._tpu_engine = TpuOperatorExecutor()
+        return self._tpu_engine
+
+    # ------------------------------------------------------------------
+    def execute_context(self, ctx: QueryContext):
+        """Per-segment results for a query context (server-side half).
+        Returns (results, prune_stats)."""
+        selected = prune_segments(self.segments, ctx)
+        selected_set = set(id(s) for s in selected)
+        prune_stats = ExecutionStats()
+        for seg in self.segments:
+            if id(seg) not in selected_set:
+                prune_stats.num_segments_pruned += 1
+                prune_stats.total_docs += seg.num_docs
+        results: List[Any] = []
+
+        remaining = selected
+        if self._use_tpu and selected:
+            engine = self.tpu_engine
+            if engine is not None and engine.supports(ctx):
+                device_results, remaining = engine.execute(selected, ctx)
+                results.extend(device_results)
+        if remaining:
+            if len(remaining) == 1:
+                results.append(executor_cpu.execute_segment(remaining[0], ctx))
+            else:
+                with ThreadPoolExecutor(
+                        max_workers=min(len(remaining), self.max_threads)) as pool:
+                    results.extend(pool.map(
+                        lambda s: executor_cpu.execute_segment(s, ctx), remaining))
+        return results, prune_stats
+
+    def execute(self, sql: str) -> BrokerResponse:
+        """Full single-process path: parse -> execute -> reduce
+        (the BaseQueriesTest.getBrokerResponse analog)."""
+        start = time.time()
+        ctx = QueryContext.from_sql(sql)
+        results, prune_stats = self.execute_context(ctx)
+        resp = reduce_results(ctx, results)
+        resp.stats.merge(prune_stats)
+        resp.num_servers_queried = resp.num_servers_responded = 1
+        resp.time_used_ms = (time.time() - start) * 1000.0
+        return resp

@@ -1,0 +1,227 @@
+"""CPU reference executor: one segment through plan-shaped execution.
+
+Reference parity: the per-segment operator chains of pinot-core —
+AggregationOperator (operator/query/AggregationOperator.java:64),
+GroupByOperator (:101) with DictionaryBasedGroupKeyGenerator,
+Selection/Distinct operators — collapsed into whole-column numpy execution
+(no 10k-doc block loop: the block iteration exists in the reference to
+bound memory; columns here are already materialized arrays).
+
+This path is the correctness oracle the TPU engine is tested against
+(tests/queries/, the BaseQueriesTest.java:74 analog) and the fallback for
+query shapes the device engine doesn't cover yet.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from pinot_tpu.query import transform
+from pinot_tpu.query.context import QueryContext
+from pinot_tpu.query.expressions import Expression, Function, Identifier, Literal
+from pinot_tpu.query.filter import SegmentColumnProvider, evaluate_filter
+from pinot_tpu.query.results import (
+    AggregationResult, DistinctResult, ExecutionStats, GroupByResult,
+    SelectionResult)
+from pinot_tpu.segment.loader import ImmutableSegment
+
+# ref plan/maker/InstancePlanMakerImplV2.java DEFAULT_NUM_GROUPS_LIMIT
+DEFAULT_NUM_GROUPS_LIMIT = 100_000
+
+
+def execute_segment(seg: ImmutableSegment, ctx: QueryContext):
+    """Run one segment, returning the shape-appropriate SegmentResult."""
+    provider = SegmentColumnProvider(seg)
+    mask = evaluate_filter(seg, ctx.filter, provider)
+    stats = ExecutionStats(
+        num_docs_scanned=int(np.count_nonzero(mask)),
+        num_entries_scanned_in_filter=(
+            seg.num_docs * len(set(ctx.filter_columns())) if ctx.filter is not None else 0),
+        num_segments_processed=1,
+        num_segments_matched=1 if mask.any() else 0,
+        total_docs=seg.num_docs)
+
+    if ctx.is_group_by_query:
+        return _group_by(seg, ctx, provider, mask, stats)
+    if ctx.is_aggregation_query:
+        return _aggregate(seg, ctx, provider, mask, stats)
+    if ctx.is_distinct_query:
+        return _distinct(seg, ctx, provider, mask, stats)
+    return _select(seg, ctx, provider, mask, stats)
+
+
+# ---------------------------------------------------------------------------
+
+def _agg_input(seg: ImmutableSegment, fn_node: Function, provider) -> Optional[np.ndarray]:
+    """Materialize the aggregation argument column (None for COUNT(*))."""
+    if not fn_node.args:
+        return None
+    arg = fn_node.args[0]
+    if isinstance(arg, Identifier) and arg.name == "*":
+        return None
+    if fn_node.name == "countmv":
+        ds = seg.data_source(arg.name)  # type: ignore[union-attr]
+        return np.diff(ds.mv_offsets()).astype(np.int64)
+    out = np.asarray(transform.evaluate(arg, provider))
+    if out.ndim == 0:
+        out = np.broadcast_to(out, (seg.num_docs,))
+    return out
+
+
+def _agg_mask(seg, ctx: QueryContext, provider, mask, i):
+    """Combined doc mask for the i-th aggregation: query filter AND the
+    aggregation's own FILTER (WHERE ...) clause, if any
+    (ref FilteredAggregationOperator)."""
+    cond = ctx.agg_filters[i]
+    if cond is None:
+        return mask
+    return mask & evaluate_filter(seg, cond, provider)
+
+
+def _aggregate(seg, ctx: QueryContext, provider, mask, stats) -> AggregationResult:
+    inters = []
+    for i, (node, fn) in enumerate(zip(ctx.aggregations, ctx.agg_functions)):
+        values = _agg_input(seg, node, provider)
+        inters.append(fn.aggregate(values, _agg_mask(seg, ctx, provider, mask, i)))
+        if values is not None:
+            stats.num_entries_scanned_post_filter += stats.num_docs_scanned
+    return AggregationResult(inters, stats)
+
+
+def _group_key_arrays(seg, ctx: QueryContext, provider, mask):
+    """Factorize each group-by expression into (codes, uniques) over the
+    masked docs (ref DictionaryBasedGroupKeyGenerator — dictIds combine into
+    flat group keys; expression group-bys factorize their value arrays)."""
+    codes_list, uniques_list = [], []
+    for e in ctx.group_by:
+        vals = np.asarray(transform.evaluate(e, provider))
+        if vals.ndim == 0:
+            vals = np.broadcast_to(vals, (seg.num_docs,))
+        masked = vals[mask]
+        uniques, codes = np.unique(masked, return_inverse=True)
+        codes_list.append(codes)
+        uniques_list.append(uniques)
+    return codes_list, uniques_list
+
+
+def _group_by(seg, ctx: QueryContext, provider, mask, stats) -> GroupByResult:
+    num_groups_limit = int(ctx.options.get("numGroupsLimit", DEFAULT_NUM_GROUPS_LIMIT))
+    if not mask.any():
+        return GroupByResult({}, stats)
+    codes_list, uniques_list = _group_key_arrays(seg, ctx, provider, mask)
+    cards = [len(u) for u in uniques_list]
+    # combined key = mixed-radix over per-column codes
+    combined = codes_list[0].astype(np.int64)
+    for c, card in zip(codes_list[1:], cards[1:]):
+        combined = combined * card + c
+    present, combined_codes = np.unique(combined, return_inverse=True)
+    limit_reached = len(present) > num_groups_limit
+    if limit_reached:
+        present = present[:num_groups_limit]
+    num_groups = len(present)
+
+    # decode present combined keys back to value tuples
+    key_cols = []
+    rem = present.copy()
+    for card, uniques in zip(reversed(cards), reversed(uniques_list)):
+        key_cols.append(uniques[(rem % card).astype(np.int64)])
+        rem //= card
+    key_cols.reverse()
+    keys = [tuple(_scalar(col[g]) for col in key_cols) for g in range(num_groups)]
+
+    sub_mask = np.ones(len(combined_codes), dtype=bool) if not limit_reached \
+        else (combined_codes < num_groups)
+    doc_idx = np.nonzero(mask)[0]
+    full_keys = np.full(seg.num_docs, 0, dtype=np.int64)
+    full_keys[doc_idx] = combined_codes
+    gmask = mask.copy()
+    gmask[doc_idx[~sub_mask]] = False
+
+    per_fn: List[list] = []
+    for i, (node, fn) in enumerate(zip(ctx.aggregations, ctx.agg_functions)):
+        values = _agg_input(seg, node, provider)
+        fmask = _agg_mask(seg, ctx, provider, gmask, i)
+        per_fn.append(fn.aggregate_grouped(values, full_keys, num_groups, fmask))
+        if values is not None:
+            stats.num_entries_scanned_post_filter += stats.num_docs_scanned
+    groups = {keys[g]: [per_fn[f][g] for f in range(len(per_fn))]
+              for g in range(num_groups)}
+    return GroupByResult(groups, stats, num_groups_limit_reached=limit_reached)
+
+
+def _project_rows(seg, exprs: List[Expression], provider, doc_idx: np.ndarray):
+    cols = []
+    for e in exprs:
+        if isinstance(e, Identifier) and e.name == "*":
+            for name in seg.column_names:
+                cols.append(np.asarray(provider.column(name))[doc_idx])
+            continue
+        vals = np.asarray(transform.evaluate(e, provider))
+        if vals.ndim == 0:
+            vals = np.broadcast_to(vals, (seg.num_docs,))
+        cols.append(vals[doc_idx])
+    return [tuple(_scalar(c[i]) for c in cols) for i in range(len(doc_idx))]
+
+
+def expand_star(seg: ImmutableSegment, ctx: QueryContext) -> List[str]:
+    names = []
+    result_names = ctx.result_column_names()
+    for i, e in enumerate(ctx.select):
+        if isinstance(e, Identifier) and e.name == "*":
+            names.extend(seg.column_names)
+        else:
+            names.append(ctx.aliases[i] or result_names[i])
+    return names
+
+
+def _select(seg, ctx: QueryContext, provider, mask, stats) -> SelectionResult:
+    doc_idx = np.nonzero(mask)[0]
+    fetch = ctx.limit + ctx.offset
+    if not ctx.order_by:
+        doc_idx = doc_idx[:fetch]  # ref SelectionOnlyOperator early-exit
+        rows = _project_rows(seg, ctx.select, provider, doc_idx)
+        stats.num_entries_scanned_post_filter = len(doc_idx) * max(len(ctx.select), 1)
+        return SelectionResult(rows, columns=expand_star(seg, ctx), stats=stats)
+    # order-by: evaluate sort keys, partial-sort, keep top fetch rows
+    # (ref SelectionOrderByOperator)
+    sort_cols = []
+    for e, asc in ctx.order_by:
+        vals = np.asarray(transform.evaluate(e, provider))
+        if vals.ndim == 0:
+            vals = np.broadcast_to(vals, (seg.num_docs,))
+        sort_cols.append((vals[doc_idx], asc))
+    order = _lexsort(sort_cols)
+    doc_idx = doc_idx[order][:fetch]
+    rows = _project_rows(seg, ctx.select, provider, doc_idx)
+    order_values = _project_rows(seg, [e for e, _ in ctx.order_by], provider, doc_idx)
+    stats.num_entries_scanned_post_filter = len(doc_idx) * max(len(ctx.select), 1)
+    return SelectionResult(rows, order_values=order_values,
+                           columns=expand_star(seg, ctx), stats=stats)
+
+
+def _lexsort(sort_cols) -> np.ndarray:
+    """Stable multi-key argsort honoring per-key asc/desc."""
+    keys = []
+    for vals, asc in reversed(sort_cols):
+        if not asc:
+            if vals.dtype.kind in "iuf":
+                vals = -vals.astype(np.float64)
+            else:
+                # desc on strings: rank-invert via factorize
+                uniques, codes = np.unique(vals, return_inverse=True)
+                vals = -codes
+        keys.append(vals)
+    return np.lexsort(keys)
+
+
+def _distinct(seg, ctx: QueryContext, provider, mask, stats) -> DistinctResult:
+    doc_idx = np.nonzero(mask)[0]
+    rows = _project_rows(seg, ctx.select, provider, doc_idx)
+    return DistinctResult(set(rows), stats=stats)
+
+
+def _scalar(v):
+    if isinstance(v, np.generic):
+        return v.item()
+    return v

@@ -1,0 +1,126 @@
+"""Expression AST for the SQL front-end.
+
+Reference parity: pinot-common's Thrift `Expression`
+(LITERAL/IDENTIFIER/FUNCTION) used by PinotQuery, and
+`ExpressionContext`/`FilterContext` in
+pinot-core/src/main/java/org/apache/pinot/common/request/context/.
+
+Operators are normalized to lower-case function names the way
+CalciteSqlParser does (`=` -> "equals", `+` -> "plus", ...), so the rest of
+the engine only ever sees three node kinds.
+"""
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Iterator, List, Optional, Sequence, Tuple
+
+
+class ExpressionType(enum.Enum):
+    LITERAL = "LITERAL"
+    IDENTIFIER = "IDENTIFIER"
+    FUNCTION = "FUNCTION"
+
+
+# Filter function names (ref FilterKind enum in
+# pinot-common/.../sql/FilterKind.java).
+FILTER_KINDS = {
+    "and", "or", "not",
+    "equals", "not_equals", "greater_than", "greater_than_or_equal",
+    "less_than", "less_than_or_equal", "between", "range",
+    "in", "not_in", "like", "regexp_like", "text_match", "json_match",
+    "is_null", "is_not_null", "vector_similarity",
+}
+
+COMPARISON_KINDS = {
+    "equals", "not_equals", "greater_than", "greater_than_or_equal",
+    "less_than", "less_than_or_equal",
+}
+
+
+@dataclass(frozen=True)
+class Expression:
+    """Base expression node."""
+
+    def walk(self) -> Iterator["Expression"]:
+        yield self
+
+    @property
+    def is_literal(self) -> bool:
+        return isinstance(self, Literal)
+
+    @property
+    def is_identifier(self) -> bool:
+        return isinstance(self, Identifier)
+
+    @property
+    def is_function(self) -> bool:
+        return isinstance(self, Function)
+
+    def columns(self) -> List[str]:
+        """All identifier names referenced under this expression."""
+        out: List[str] = []
+        for node in self.walk():
+            if isinstance(node, Identifier):
+                out.append(node.name)
+        return out
+
+
+@dataclass(frozen=True)
+class Literal(Expression):
+    value: Any  # int | float | str | bool | None | list (for IN value arrays)
+
+    def __str__(self) -> str:
+        if isinstance(self.value, str):
+            return "'" + self.value.replace("'", "''") + "'"
+        if self.value is None:
+            return "NULL"
+        return str(self.value)
+
+
+@dataclass(frozen=True)
+class Identifier(Expression):
+    name: str
+
+    def __str__(self) -> str:
+        return self.name
+
+
+@dataclass(frozen=True)
+class Function(Expression):
+    name: str  # normalized lower-case ("sum", "equals", "plus", ...)
+    args: Tuple[Expression, ...] = ()
+
+    def walk(self) -> Iterator[Expression]:
+        yield self
+        for a in self.args:
+            yield from a.walk()
+
+    def __str__(self) -> str:
+        return f"{self.name}({', '.join(str(a) for a in self.args)})"
+
+
+def func(name: str, *args: Expression) -> Function:
+    return Function(name.lower(), tuple(args))
+
+
+def lit(value: Any) -> Literal:
+    return Literal(value)
+
+
+def ident(name: str) -> Identifier:
+    return Identifier(name)
+
+
+def is_agg_function(name: str) -> bool:
+    from pinot_tpu.query.aggregation import is_aggregation
+    return is_aggregation(name)
+
+
+def extract_aggregations(expr: Expression) -> List[Function]:
+    """All aggregation-function nodes under expr (pre-order)."""
+    out: List[Function] = []
+    for node in expr.walk():
+        if isinstance(node, Function) and is_agg_function(node.name):
+            out.append(node)
+    return out

@@ -1,0 +1,419 @@
+"""SQL parser: Pinot query subset -> PinotQuery AST.
+
+Reference parity: org.apache.pinot.sql.parsers.CalciteSqlParser
+(pinot-common) — the reference leans on Calcite's babel parser; here a
+hand-rolled lexer + recursive-descent/precedence-climbing parser covers the
+single-stage dialect: SELECT [DISTINCT] exprs FROM table [WHERE ...]
+[GROUP BY ...] [HAVING ...] [ORDER BY ...] [LIMIT n [OFFSET m]]
+[OPTION(k=v,...)], plus leading `SET k=v;` statements for query options.
+
+Operators normalize to function names as CalciteSqlParser does
+(`=` -> equals, `BETWEEN` -> between, `+` -> plus ...), producing the
+Expression AST in expressions.py.
+"""
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from pinot_tpu.query.expressions import (
+    Expression, Function, Identifier, Literal, func, ident, lit)
+
+# ---------------------------------------------------------------------------
+# Lexer
+# ---------------------------------------------------------------------------
+
+_TOKEN_RE = re.compile(r"""
+    (?P<ws>\s+)
+  | (?P<comment>--[^\n]*)
+  | (?P<number>\d+\.\d*(?:[eE][+-]?\d+)?|\.\d+(?:[eE][+-]?\d+)?|\d+(?:[eE][+-]?\d+)?)
+  | (?P<string>'(?:[^']|'')*')
+  | (?P<qident>"(?:[^"]|"")*"|`(?:[^`]|``)*`)
+  | (?P<name>[A-Za-z_][A-Za-z0-9_$.]*)
+  | (?P<op><>|!=|<=|>=|=|<|>|\(|\)|,|\+|-|\*|/|%|;)
+""", re.VERBOSE)
+
+
+@dataclass
+class Token:
+    kind: str  # number | string | qident | name | op | end
+    text: str
+    pos: int
+
+    @property
+    def upper(self) -> str:
+        return self.text.upper()
+
+
+def tokenize(sql: str) -> List[Token]:
+    tokens: List[Token] = []
+    pos = 0
+    while pos < len(sql):
+        m = _TOKEN_RE.match(sql, pos)
+        if m is None:
+            raise SqlParseError(f"unexpected character {sql[pos]!r} at {pos}")
+        kind = m.lastgroup
+        if kind not in ("ws", "comment"):
+            tokens.append(Token(kind, m.group(), pos))
+        pos = m.end()
+    tokens.append(Token("end", "", pos))
+    return tokens
+
+
+class SqlParseError(ValueError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# AST container
+# ---------------------------------------------------------------------------
+
+@dataclass
+class PinotQuery:
+    """Parsed query (ref Thrift PinotQuery, pinot-common query.thrift)."""
+    table: str = ""
+    select_list: List[Expression] = field(default_factory=list)
+    distinct: bool = False
+    filter: Optional[Expression] = None
+    group_by: List[Expression] = field(default_factory=list)
+    having: Optional[Expression] = None
+    order_by: List[Tuple[Expression, bool]] = field(default_factory=list)  # (expr, asc)
+    limit: int = 10  # Pinot default limit
+    offset: int = 0
+    options: Dict[str, str] = field(default_factory=dict)
+    explain: bool = False
+
+
+_KEYWORDS = {
+    "SELECT", "FROM", "WHERE", "GROUP", "ORDER", "BY", "HAVING", "LIMIT",
+    "OFFSET", "OPTION", "AS", "AND", "OR", "NOT", "IN", "BETWEEN", "LIKE",
+    "IS", "NULL", "TRUE", "FALSE", "ASC", "DESC", "DISTINCT", "SET",
+    "EXPLAIN", "PLAN", "FOR",
+}
+
+# Binary operator -> canonical function name (ref CalciteSqlParser op mapping)
+_CMP_FUNCS = {
+    "=": "equals", "!=": "not_equals", "<>": "not_equals",
+    "<": "less_than", ">": "greater_than",
+    "<=": "less_than_or_equal", ">=": "greater_than_or_equal",
+}
+_ADD_FUNCS = {"+": "plus", "-": "minus"}
+_MUL_FUNCS = {"*": "times", "/": "divide", "%": "mod"}
+
+
+class _Parser:
+    def __init__(self, tokens: List[Token]):
+        self.toks = tokens
+        self.i = 0
+
+    # -- token helpers ------------------------------------------------------
+    def peek(self, offset: int = 0) -> Token:
+        return self.toks[min(self.i + offset, len(self.toks) - 1)]
+
+    def next(self) -> Token:
+        t = self.toks[self.i]
+        if t.kind != "end":
+            self.i += 1
+        return t
+
+    def accept_kw(self, *kws: str) -> Optional[Token]:
+        t = self.peek()
+        if t.kind == "name" and t.upper in kws:
+            return self.next()
+        return None
+
+    def expect_kw(self, kw: str) -> Token:
+        t = self.accept_kw(kw)
+        if t is None:
+            raise SqlParseError(f"expected {kw} at {self.peek().pos}, got {self.peek().text!r}")
+        return t
+
+    def accept_op(self, *ops: str) -> Optional[Token]:
+        t = self.peek()
+        if t.kind == "op" and t.text in ops:
+            return self.next()
+        return None
+
+    def expect_op(self, op: str) -> Token:
+        t = self.accept_op(op)
+        if t is None:
+            raise SqlParseError(f"expected {op!r} at {self.peek().pos}, got {self.peek().text!r}")
+        return t
+
+    # -- statement ----------------------------------------------------------
+    def parse(self) -> PinotQuery:
+        q = PinotQuery()
+        # leading SET k = v; statements (query options)
+        while self.accept_kw("SET"):
+            key = self._name_text(self.next())
+            self.expect_op("=")
+            q.options[key] = self._literal_text(self.next())
+            self.accept_op(";")
+        if self.accept_kw("EXPLAIN"):
+            self.expect_kw("PLAN")
+            self.expect_kw("FOR")
+            q.explain = True
+        self.expect_kw("SELECT")
+        if self.accept_kw("DISTINCT"):
+            q.distinct = True
+        q.select_list = self._select_list()
+        self.expect_kw("FROM")
+        q.table = self._table_name()
+        if self.accept_kw("WHERE"):
+            q.filter = self.expr()
+        if self.accept_kw("GROUP"):
+            self.expect_kw("BY")
+            q.group_by = self._expr_list()
+        if self.accept_kw("HAVING"):
+            q.having = self.expr()
+        if self.accept_kw("ORDER"):
+            self.expect_kw("BY")
+            q.order_by = self._order_list()
+        if self.accept_kw("LIMIT"):
+            a = int(self._literal_text(self.next()))
+            if self.accept_op(","):
+                q.offset, q.limit = a, int(self._literal_text(self.next()))
+            else:
+                q.limit = a
+                if self.accept_kw("OFFSET"):
+                    q.offset = int(self._literal_text(self.next()))
+        if self.accept_kw("OPTION"):
+            self.expect_op("(")
+            while True:
+                key = self._name_text(self.next())
+                self.expect_op("=")
+                q.options[key] = self._literal_text(self.next())
+                if not self.accept_op(","):
+                    break
+            self.expect_op(")")
+        self.accept_op(";")
+        t = self.peek()
+        if t.kind != "end":
+            raise SqlParseError(f"trailing input at {t.pos}: {t.text!r}")
+        return q
+
+    def _name_text(self, t: Token) -> str:
+        if t.kind == "qident":
+            return t.text[1:-1].replace('""', '"').replace("``", "`")
+        if t.kind in ("name", "string"):
+            return t.text.strip("'")
+        raise SqlParseError(f"expected name at {t.pos}, got {t.text!r}")
+
+    def _literal_text(self, t: Token) -> str:
+        if t.kind == "string":
+            return t.text[1:-1].replace("''", "'")
+        if t.kind in ("number", "name"):
+            return t.text
+        raise SqlParseError(f"expected literal at {t.pos}, got {t.text!r}")
+
+    def _table_name(self) -> str:
+        t = self.next()
+        return self._name_text(t)
+
+    def _select_list(self) -> List[Expression]:
+        out = []
+        while True:
+            if self.accept_op("*"):
+                out.append(ident("*"))
+            else:
+                e = self.expr()
+                if self.accept_kw("AS"):
+                    alias = self._name_text(self.next())
+                    e = func("as", e, lit(alias))
+                out.append(e)
+            if not self.accept_op(","):
+                return out
+
+    def _expr_list(self) -> List[Expression]:
+        out = [self.expr()]
+        while self.accept_op(","):
+            out.append(self.expr())
+        return out
+
+    def _order_list(self) -> List[Tuple[Expression, bool]]:
+        out = []
+        while True:
+            e = self.expr()
+            asc = True
+            if self.accept_kw("DESC"):
+                asc = False
+            else:
+                self.accept_kw("ASC")
+            # NULLS FIRST/LAST accepted and ignored (default ordering)
+            if self.accept_kw("NULLS"):
+                self.next()
+            out.append((e, asc))
+            if not self.accept_op(","):
+                return out
+
+    # -- expression precedence climbing -------------------------------------
+    # OR < AND < NOT < comparison/IN/BETWEEN/LIKE/IS < add < mul < unary < atom
+    def expr(self) -> Expression:
+        return self._or()
+
+    def _or(self) -> Expression:
+        left = self._and()
+        args = [left]
+        while self.accept_kw("OR"):
+            args.append(self._and())
+        return func("or", *args) if len(args) > 1 else left
+
+    def _and(self) -> Expression:
+        left = self._not()
+        args = [left]
+        while self.accept_kw("AND"):
+            args.append(self._not())
+        return func("and", *args) if len(args) > 1 else left
+
+    def _not(self) -> Expression:
+        if self.accept_kw("NOT"):
+            return func("not", self._not())
+        return self._comparison()
+
+    def _comparison(self) -> Expression:
+        left = self._additive()
+        t = self.peek()
+        if t.kind == "op" and t.text in _CMP_FUNCS:
+            self.next()
+            return func(_CMP_FUNCS[t.text], left, self._additive())
+        negate = False
+        if t.kind == "name" and t.upper == "NOT" \
+                and self.peek(1).upper in ("IN", "BETWEEN", "LIKE"):
+            self.next()
+            negate = True
+            t = self.peek()
+        if self.accept_kw("BETWEEN"):
+            lo = self._additive()
+            self.expect_kw("AND")
+            hi = self._additive()
+            e: Expression = func("between", left, lo, hi)
+            return func("not", e) if negate else e
+        if self.accept_kw("IN"):
+            self.expect_op("(")
+            vals = self._expr_list()
+            self.expect_op(")")
+            e = func("not_in" if negate else "in", left, *vals)
+            return e
+        if self.accept_kw("LIKE"):
+            e = func("like", left, self._additive())
+            return func("not", e) if negate else e
+        if self.accept_kw("IS"):
+            if self.accept_kw("NOT"):
+                self.expect_kw("NULL")
+                return func("is_not_null", left)
+            self.expect_kw("NULL")
+            return func("is_null", left)
+        return left
+
+    def _additive(self) -> Expression:
+        left = self._multiplicative()
+        while True:
+            t = self.peek()
+            if t.kind == "op" and t.text in _ADD_FUNCS:
+                self.next()
+                left = func(_ADD_FUNCS[t.text], left, self._multiplicative())
+            else:
+                return left
+
+    def _multiplicative(self) -> Expression:
+        left = self._unary()
+        while True:
+            t = self.peek()
+            if t.kind == "op" and t.text in _MUL_FUNCS:
+                self.next()
+                left = func(_MUL_FUNCS[t.text], left, self._unary())
+            else:
+                return left
+
+    def _unary(self) -> Expression:
+        if self.accept_op("-"):
+            inner = self._unary()
+            if isinstance(inner, Literal) and isinstance(inner.value, (int, float)):
+                return lit(-inner.value)
+            return func("minus", lit(0), inner)
+        self.accept_op("+")
+        return self._atom()
+
+    def _atom(self) -> Expression:
+        t = self.peek()
+        if t.kind == "number":
+            self.next()
+            txt = t.text
+            if re.fullmatch(r"\d+", txt):
+                return lit(int(txt))
+            return lit(float(txt))
+        if t.kind == "string":
+            self.next()
+            return lit(t.text[1:-1].replace("''", "'"))
+        if t.kind == "qident":
+            self.next()
+            return ident(self._name_text(t))
+        if self.accept_op("("):
+            e = self.expr()
+            self.expect_op(")")
+            return e
+        if t.kind == "name":
+            up = t.upper
+            if up == "NULL":
+                self.next()
+                return lit(None)
+            if up == "TRUE":
+                self.next()
+                return lit(True)
+            if up == "FALSE":
+                self.next()
+                return lit(False)
+            if up == "CASE":
+                return self._case()
+            self.next()
+            if self.peek().kind == "op" and self.peek().text == "(":
+                return self._call(t.text)
+            return ident(t.text)
+        raise SqlParseError(f"unexpected token {t.text!r} at {t.pos}")
+
+    def _case(self) -> Expression:
+        """CASE WHEN c1 THEN v1 ... [ELSE e] END -> case(c1,v1,...,e)."""
+        self.expect_kw("CASE")
+        args: List[Expression] = []
+        while self.accept_kw("WHEN"):
+            args.append(self.expr())
+            self.expect_kw("THEN")
+            args.append(self.expr())
+        if self.accept_kw("ELSE"):
+            args.append(self.expr())
+        else:
+            args.append(lit(None))
+        self.expect_kw("END")
+        return func("case", *args)
+
+    def _call(self, name: str) -> Expression:
+        self.expect_op("(")
+        lname = name.lower()
+        if self.accept_op(")"):
+            e: Expression = func(lname)
+        elif lname == "count" and self.accept_op("*"):
+            self.expect_op(")")
+            e = func("count", ident("*"))
+        else:
+            distinct = bool(self.accept_kw("DISTINCT"))
+            args = self._expr_list()
+            self.expect_op(")")
+            if distinct:
+                e = func("distinctcount", *args) if lname == "count" \
+                    else func(lname, func("distinct", *args))
+            else:
+                e = func(lname, *args)
+        # FILTER (WHERE cond) suffix for filtered aggregation
+        if self.accept_kw("FILTER"):
+            self.expect_op("(")
+            self.expect_kw("WHERE")
+            cond = self.expr()
+            self.expect_op(")")
+            e = func("filter_agg", e, cond)
+        return e
+
+
+def parse_sql(sql: str) -> PinotQuery:
+    """Parse a SQL string into a PinotQuery AST."""
+    return _Parser(tokenize(sql)).parse()

@@ -1,0 +1,86 @@
+"""Segment pruning before execution.
+
+Reference parity: pinot-core query/pruner/ — ColumnValueSegmentPruner
+(min/max + bloom-filter checks on EQ/range predicates,
+ColumnValueSegmentPruner.java), SelectionQuerySegmentPruner (limit-0 /
+already-satisfied selections). Partition and time pruning happen
+broker-side in routing (broker/routing.py), as in the reference.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from pinot_tpu.query.context import QueryContext
+from pinot_tpu.query.expressions import Expression, Function, Identifier, Literal
+from pinot_tpu.segment.loader import ImmutableSegment
+
+
+def prune_segments(segments: List[ImmutableSegment],
+                   ctx: QueryContext) -> List[ImmutableSegment]:
+    if ctx.filter is None:
+        return list(segments)
+    return [s for s in segments if not _can_prune(s, ctx.filter)]
+
+
+def _can_prune(seg: ImmutableSegment, expr: Expression) -> bool:
+    """True when the filter provably matches nothing in this segment."""
+    if not isinstance(expr, Function):
+        return False
+    name = expr.name
+    if name == "and":
+        return any(_can_prune(seg, a) for a in expr.args)
+    if name == "or":
+        return all(_can_prune(seg, a) for a in expr.args)
+    if name not in ("equals", "between", "greater_than", "greater_than_or_equal",
+                    "less_than", "less_than_or_equal", "in"):
+        return False
+    if not expr.args or not isinstance(expr.args[0], Identifier):
+        return False
+    col = expr.args[0].name
+    meta = seg.metadata.columns.get(col)
+    if meta is None or meta.min_value is None or meta.max_value is None:
+        return False
+    lo, hi = meta.min_value, meta.max_value
+
+    def lit(i: int):
+        a = expr.args[i]
+        return a.value if isinstance(a, Literal) else None
+
+    try:
+        if name == "equals":
+            v = lit(1)
+            if v is None:
+                return False
+            if _cmp_lt(v, lo) or _cmp_lt(hi, v):
+                return True
+            bloom = seg.data_source(col).bloom_filter
+            if bloom is not None and not bloom.might_contain(v):
+                return True
+            return False
+        if name == "in":
+            vals = [a.value for a in expr.args[1:] if isinstance(a, Literal)]
+            return all(_cmp_lt(v, lo) or _cmp_lt(hi, v) for v in vals) if vals else False
+        if name == "between":
+            a, b = lit(1), lit(2)
+            return a is not None and b is not None and (_cmp_lt(hi, a) or _cmp_lt(b, lo))
+        if name == "greater_than":
+            v = lit(1)
+            return v is not None and not _cmp_lt(v, hi)
+        if name == "greater_than_or_equal":
+            v = lit(1)
+            return v is not None and _cmp_lt(hi, v)
+        if name == "less_than":
+            v = lit(1)
+            return v is not None and not _cmp_lt(lo, v)
+        if name == "less_than_or_equal":
+            v = lit(1)
+            return v is not None and _cmp_lt(v, lo)
+    except TypeError:
+        return False
+    return False
+
+
+def _cmp_lt(a, b) -> bool:
+    if isinstance(a, str) != isinstance(b, str):
+        a, b = float(a), float(b)
+    return a < b

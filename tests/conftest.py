@@ -4,13 +4,15 @@ Multi-chip TPU hardware is not available in CI; sharding correctness is
 validated on host-platform virtual devices (the driver separately dry-runs
 the multi-chip path via __graft_entry__.dryrun_multichip).
 
-Must run before the first `import jax` anywhere in the test session.
+NOTE: this environment pre-imports jax at interpreter startup (an
+.axon_site sitecustomize), so env vars like JAX_PLATFORMS / XLA_FLAGS set
+here are too late — the runtime jax.config.update path is required, and it
+works because the backend isn't initialized until first use.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_ENABLE_X64", "1")
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,131 @@
+"""Single-node query-correctness harness.
+
+Reference parity: pinot-core BaseQueriesTest
+(src/test/java/org/apache/pinot/queries/BaseQueriesTest.java:74) — build
+real segments in-process from synthetic rows, run full server-side planning
++ execution + broker reduce in one process with no networking. The TPU
+twist: every query runs through BOTH the numpy reference executor and the
+device engine, and results must agree (the CPU-parity harness SURVEY.md §7.3
+calls for).
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from pinot_tpu.models import (DataType, FieldSpec, FieldType, Schema,
+                              TableConfig, TableType)
+from pinot_tpu.query.executor import QueryExecutor
+from pinot_tpu.query.reduce import BrokerResponse
+from pinot_tpu.segment.creator import SegmentCreator
+from pinot_tpu.segment.loader import ImmutableSegment, load_segment
+
+
+def build_segments(tmp_path, schema: Schema, table_config: TableConfig,
+                   columns_per_segment: Sequence[Dict[str, list]],
+                   ) -> List[ImmutableSegment]:
+    creator = SegmentCreator(table_config, schema)
+    segs = []
+    for i, cols in enumerate(columns_per_segment):
+        d = str(tmp_path / f"seg_{i}")
+        creator.build(cols, d, f"testTable_{i}")
+        segs.append(load_segment(d))
+    return segs
+
+
+class QueriesTestHarness:
+    """getBrokerResponse twice (CPU ref + TPU) and assert equality."""
+
+    def __init__(self, segments: List[ImmutableSegment]):
+        self.cpu = QueryExecutor(segments, use_tpu=False)
+        self.tpu = QueryExecutor(segments, use_tpu=True)
+
+    def broker_response(self, sql: str, check_parity: bool = True) -> BrokerResponse:
+        cpu_resp = self.cpu.execute(sql)
+        if check_parity:
+            tpu_resp = self.tpu.execute(sql)
+            assert_responses_equal(cpu_resp, tpu_resp, sql)
+        return cpu_resp
+
+    def tpu_response(self, sql: str) -> BrokerResponse:
+        return self.tpu.execute(sql)
+
+
+def assert_responses_equal(a: BrokerResponse, b: BrokerResponse, sql: str,
+                           ordered: Optional[bool] = None) -> None:
+    ra, rb = a.result_table, b.result_table
+    assert (ra is None) == (rb is None), f"one response empty for {sql!r}"
+    if ra is None:
+        return
+    assert ra.columns == rb.columns, f"column mismatch for {sql!r}"
+    rows_a, rows_b = ra.rows, rb.rows
+    if ordered is None:
+        ordered = "order by" in sql.lower()
+    if not ordered:
+        rows_a = sorted(rows_a, key=_row_key)
+        rows_b = sorted(rows_b, key=_row_key)
+    assert len(rows_a) == len(rows_b), \
+        f"row count mismatch for {sql!r}: {len(rows_a)} != {len(rows_b)}"
+    for i, (x, y) in enumerate(zip(rows_a, rows_b)):
+        assert len(x) == len(y), f"row width mismatch at {i} for {sql!r}"
+        for va, vb in zip(x, y):
+            assert values_equal(va, vb), \
+                f"value mismatch for {sql!r} row {i}: {x} != {y}"
+
+
+def values_equal(a, b, rel: float = 1e-9) -> bool:
+    if isinstance(a, float) or isinstance(b, float):
+        try:
+            fa, fb = float(a), float(b)
+        except (TypeError, ValueError):
+            return a == b
+        if math.isnan(fa) and math.isnan(fb):
+            return True
+        if math.isinf(fa) or math.isinf(fb):
+            return fa == fb
+        return math.isclose(fa, fb, rel_tol=rel, abs_tol=1e-9)
+    return a == b
+
+
+def _row_key(row):
+    return tuple(str(v) for v in row)
+
+
+# ---------------------------------------------------------------------------
+# canonical synthetic table (the baseballStats-like fixture)
+# ---------------------------------------------------------------------------
+
+def synthetic_schema() -> Schema:
+    return Schema("testTable", [
+        FieldSpec("intCol", DataType.INT, FieldType.DIMENSION),
+        FieldSpec("longCol", DataType.LONG, FieldType.DIMENSION),
+        FieldSpec("floatCol", DataType.FLOAT, FieldType.METRIC),
+        FieldSpec("doubleCol", DataType.DOUBLE, FieldType.METRIC),
+        FieldSpec("stringCol", DataType.STRING, FieldType.DIMENSION),
+        FieldSpec("groupCol", DataType.STRING, FieldType.DIMENSION),
+        FieldSpec("rawIntCol", DataType.INT, FieldType.METRIC),
+    ])
+
+
+def synthetic_table_config() -> TableConfig:
+    tc = TableConfig("testTable", TableType.OFFLINE)
+    tc.indexing.no_dictionary_columns = ["rawIntCol"]
+    tc.indexing.inverted_index_columns = ["stringCol"]
+    tc.indexing.range_index_columns = ["intCol"]
+    return tc
+
+
+def synthetic_columns(num_docs: int, seed: int) -> Dict[str, list]:
+    rng = np.random.default_rng(seed)
+    ints = rng.integers(0, 1000, num_docs).astype(np.int32)
+    return {
+        "intCol": ints,
+        "longCol": rng.integers(0, 10**12, num_docs).astype(np.int64),
+        "floatCol": rng.random(num_docs).astype(np.float32) * 100,
+        "doubleCol": rng.random(num_docs) * 1000,
+        "stringCol": [f"s{v % 37}" for v in ints.tolist()],
+        "groupCol": [f"g{v % 11}" for v in ints.tolist()],
+        "rawIntCol": rng.integers(-500, 500, num_docs).astype(np.int32),
+    }

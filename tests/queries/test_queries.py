@@ -1,0 +1,340 @@
+"""Query correctness: CPU reference vs TPU device path vs hand-computed
+expectations (the BaseQueriesTest-style suite, SURVEY.md §4.2)."""
+import math
+
+import numpy as np
+import pytest
+
+from tests.queries.harness import (
+    QueriesTestHarness, build_segments, synthetic_columns, synthetic_schema,
+    synthetic_table_config)
+
+NUM_DOCS = 2000
+NUM_SEGMENTS = 3
+
+
+@pytest.fixture(scope="module")
+def data():
+    return [synthetic_columns(NUM_DOCS, seed=42 + i) for i in range(NUM_SEGMENTS)]
+
+
+@pytest.fixture(scope="module")
+def harness(tmp_path_factory, data):
+    tmp = tmp_path_factory.mktemp("queries")
+    segs = build_segments(tmp, synthetic_schema(), synthetic_table_config(), data)
+    return QueriesTestHarness(segs)
+
+
+@pytest.fixture(scope="module")
+def all_rows(data):
+    """Concatenated raw columns across segments for oracle computation."""
+    out = {}
+    for k in data[0]:
+        parts = [np.asarray(d[k]) for d in data]
+        out[k] = np.concatenate(parts)
+    return out
+
+
+class TestAggregation:
+    def test_count_star(self, harness, all_rows):
+        r = harness.broker_response("SELECT COUNT(*) FROM testTable")
+        assert r.rows[0][0] == NUM_DOCS * NUM_SEGMENTS
+
+    def test_sum(self, harness, all_rows):
+        r = harness.broker_response("SELECT SUM(intCol) FROM testTable")
+        assert r.rows[0][0] == pytest.approx(float(all_rows["intCol"].sum()))
+
+    def test_min_max_avg(self, harness, all_rows):
+        r = harness.broker_response(
+            "SELECT MIN(doubleCol), MAX(doubleCol), AVG(doubleCol) FROM testTable")
+        assert r.rows[0][0] == pytest.approx(all_rows["doubleCol"].min())
+        assert r.rows[0][1] == pytest.approx(all_rows["doubleCol"].max())
+        assert r.rows[0][2] == pytest.approx(all_rows["doubleCol"].mean())
+
+    def test_filtered_sum(self, harness, all_rows):
+        r = harness.broker_response(
+            "SELECT SUM(intCol) FROM testTable WHERE intCol BETWEEN 100 AND 500")
+        v = all_rows["intCol"]
+        expected = float(v[(v >= 100) & (v <= 500)].sum())
+        assert r.rows[0][0] == pytest.approx(expected)
+
+    def test_filter_eq_string(self, harness, all_rows):
+        r = harness.broker_response(
+            "SELECT COUNT(*) FROM testTable WHERE stringCol = 's5'")
+        s = np.asarray(all_rows["stringCol"])
+        assert r.rows[0][0] == int((s == "s5").sum())
+
+    def test_filter_in(self, harness, all_rows):
+        r = harness.broker_response(
+            "SELECT COUNT(*) FROM testTable WHERE stringCol IN ('s1', 's2', 's3')")
+        s = np.asarray(all_rows["stringCol"])
+        assert r.rows[0][0] == int(np.isin(s, ["s1", "s2", "s3"]).sum())
+
+    def test_filter_not_in(self, harness, all_rows):
+        r = harness.broker_response(
+            "SELECT COUNT(*) FROM testTable WHERE stringCol NOT IN ('s1', 's2')")
+        s = np.asarray(all_rows["stringCol"])
+        assert r.rows[0][0] == int((~np.isin(s, ["s1", "s2"])).sum())
+
+    def test_filter_ne(self, harness, all_rows):
+        r = harness.broker_response(
+            "SELECT COUNT(*) FROM testTable WHERE groupCol != 'g3'")
+        s = np.asarray(all_rows["groupCol"])
+        assert r.rows[0][0] == int((s != "g3").sum())
+
+    def test_filter_and_or_not(self, harness, all_rows):
+        r = harness.broker_response(
+            "SELECT COUNT(*) FROM testTable WHERE (intCol < 200 OR intCol > 800) "
+            "AND NOT groupCol = 'g1'")
+        v, g = all_rows["intCol"], np.asarray(all_rows["groupCol"])
+        expected = int((((v < 200) | (v > 800)) & (g != "g1")).sum())
+        assert r.rows[0][0] == expected
+
+    def test_filter_on_raw_column(self, harness, all_rows):
+        r = harness.broker_response(
+            "SELECT COUNT(*), SUM(rawIntCol) FROM testTable WHERE rawIntCol >= 0")
+        v = all_rows["rawIntCol"]
+        assert r.rows[0][0] == int((v >= 0).sum())
+        assert r.rows[0][1] == pytest.approx(float(v[v >= 0].sum()))
+
+    def test_sum_product_expression(self, harness, all_rows):
+        r = harness.broker_response(
+            "SELECT SUM(intCol * rawIntCol) FROM testTable WHERE intCol < 500")
+        a, b = all_rows["intCol"].astype(np.float64), all_rows["rawIntCol"]
+        expected = float((a * b)[all_rows["intCol"] < 500].sum())
+        assert r.rows[0][0] == pytest.approx(expected)
+
+    def test_like(self, harness, all_rows):
+        r = harness.broker_response(
+            "SELECT COUNT(*) FROM testTable WHERE stringCol LIKE 's1%'")
+        s = np.asarray(all_rows["stringCol"])
+        expected = int(sum(1 for x in s.tolist() if str(x).startswith("s1")))
+        assert r.rows[0][0] == expected
+
+    def test_empty_result(self, harness):
+        r = harness.broker_response(
+            "SELECT SUM(intCol), COUNT(*) FROM testTable WHERE intCol > 100000")
+        assert r.rows[0][1] == 0
+
+    def test_minmaxrange(self, harness, all_rows):
+        r = harness.broker_response("SELECT MINMAXRANGE(intCol) FROM testTable")
+        v = all_rows["intCol"]
+        assert r.rows[0][0] == pytest.approx(float(v.max() - v.min()))
+
+    def test_post_aggregation_arithmetic(self, harness, all_rows):
+        r = harness.broker_response(
+            "SELECT SUM(intCol) / COUNT(*) FROM testTable")
+        v = all_rows["intCol"]
+        assert r.rows[0][0] == pytest.approx(v.sum() / len(v))
+
+
+class TestGroupBy:
+    def test_group_by_sum(self, harness, all_rows):
+        r = harness.broker_response(
+            "SELECT groupCol, SUM(intCol) FROM testTable GROUP BY groupCol "
+            "ORDER BY groupCol LIMIT 100")
+        g = np.asarray(all_rows["groupCol"])
+        v = all_rows["intCol"]
+        expected = {key: float(v[g == key].sum()) for key in np.unique(g)}
+        assert len(r.rows) == len(expected)
+        for key, total in r.rows:
+            assert total == pytest.approx(expected[key])
+
+    def test_group_by_multi_col(self, harness, all_rows):
+        r = harness.broker_response(
+            "SELECT groupCol, stringCol, COUNT(*) FROM testTable "
+            "GROUP BY groupCol, stringCol ORDER BY COUNT(*) DESC, groupCol, stringCol "
+            "LIMIT 20")
+        g = np.asarray(all_rows["groupCol"])
+        s = np.asarray(all_rows["stringCol"])
+        from collections import Counter
+        counts = Counter(zip(g.tolist(), s.tolist()))
+        top = r.rows[0]
+        assert top[2] == max(counts.values())
+
+    def test_group_by_having(self, harness, all_rows):
+        r = harness.broker_response(
+            "SELECT groupCol, COUNT(*) FROM testTable GROUP BY groupCol "
+            "HAVING COUNT(*) > 100 ORDER BY groupCol LIMIT 100")
+        g = np.asarray(all_rows["groupCol"])
+        from collections import Counter
+        counts = Counter(g.tolist())
+        expected = {k: c for k, c in counts.items() if c > 100}
+        assert {row[0]: row[1] for row in r.rows} == expected
+
+    def test_group_by_with_filter(self, harness, all_rows):
+        r = harness.broker_response(
+            "SELECT groupCol, AVG(doubleCol) FROM testTable WHERE intCol >= 250 "
+            "GROUP BY groupCol ORDER BY groupCol LIMIT 100")
+        g = np.asarray(all_rows["groupCol"])
+        v, d = all_rows["intCol"], all_rows["doubleCol"]
+        for key, avg in r.rows:
+            m = (g == key) & (v >= 250)
+            assert avg == pytest.approx(d[m].mean())
+
+    def test_group_by_order_by_agg_desc_limit(self, harness):
+        r = harness.broker_response(
+            "SELECT groupCol, SUM(intCol) FROM testTable GROUP BY groupCol "
+            "ORDER BY SUM(intCol) DESC LIMIT 3")
+        sums = [row[1] for row in r.rows]
+        assert sums == sorted(sums, reverse=True)
+        assert len(r.rows) == 3
+
+
+class TestHostOnlyAggregations:
+    def test_distinctcount(self, harness, all_rows):
+        r = harness.broker_response("SELECT DISTINCTCOUNT(stringCol) FROM testTable")
+        assert r.rows[0][0] == len(np.unique(np.asarray(all_rows["stringCol"])))
+
+    def test_count_distinct_rewrite(self, harness, all_rows):
+        r = harness.broker_response("SELECT COUNT(DISTINCT stringCol) FROM testTable")
+        assert r.rows[0][0] == len(np.unique(np.asarray(all_rows["stringCol"])))
+
+    def test_distinctcounthll_close(self, harness, all_rows):
+        r = harness.broker_response("SELECT DISTINCTCOUNTHLL(longCol) FROM testTable")
+        exact = len(np.unique(all_rows["longCol"]))
+        assert abs(r.rows[0][0] - exact) / exact < 0.1
+
+    def test_percentile(self, harness, all_rows):
+        r = harness.broker_response("SELECT PERCENTILE(doubleCol, 90) FROM testTable")
+        v = np.sort(all_rows["doubleCol"])
+        expected = v[min(int(len(v) * 0.9), len(v) - 1)]
+        assert r.rows[0][0] == pytest.approx(float(expected))
+
+    def test_percentile_legacy_name(self, harness, all_rows):
+        r = harness.broker_response("SELECT PERCENTILE50(doubleCol) FROM testTable")
+        v = np.sort(all_rows["doubleCol"])
+        assert r.rows[0][0] == pytest.approx(float(v[len(v) // 2]))
+
+    def test_percentile_tdigest_close(self, harness, all_rows):
+        r = harness.broker_response(
+            "SELECT PERCENTILETDIGEST(doubleCol, 95) FROM testTable")
+        exact = np.quantile(all_rows["doubleCol"], 0.95)
+        assert abs(r.rows[0][0] - exact) / exact < 0.02
+
+    def test_mode(self, harness, all_rows):
+        r = harness.broker_response("SELECT MODE(intCol) FROM testTable")
+        v, c = np.unique(all_rows["intCol"], return_counts=True)
+        best = v[c == c.max()].min()
+        assert r.rows[0][0] == pytest.approx(float(best))
+
+
+class TestSelection:
+    def test_select_star_limit(self, harness):
+        r = harness.broker_response("SELECT * FROM testTable LIMIT 5",
+                                    check_parity=False)
+        assert len(r.rows) == 5
+        assert len(r.result_table.columns) == 7
+
+    def test_select_columns_where(self, harness, all_rows):
+        r = harness.broker_response(
+            "SELECT intCol, stringCol FROM testTable WHERE intCol = 77 LIMIT 10000",
+            check_parity=False)
+        v = all_rows["intCol"]
+        assert len(r.rows) == int((v == 77).sum())
+        assert all(row[0] == 77 for row in r.rows)
+
+    def test_select_order_by(self, harness, all_rows):
+        r = harness.broker_response(
+            "SELECT intCol FROM testTable ORDER BY intCol DESC LIMIT 10",
+            check_parity=False)
+        v = np.sort(all_rows["intCol"])[::-1][:10]
+        assert [row[0] for row in r.rows] == v.tolist()
+
+    def test_select_order_by_multi(self, harness):
+        r = harness.broker_response(
+            "SELECT groupCol, intCol FROM testTable "
+            "ORDER BY groupCol ASC, intCol DESC LIMIT 20", check_parity=False)
+        rows = r.rows
+        for i in range(1, len(rows)):
+            assert rows[i - 1][0] <= rows[i][0]
+            if rows[i - 1][0] == rows[i][0]:
+                assert rows[i - 1][1] >= rows[i][1]
+
+    def test_select_transform(self, harness, all_rows):
+        r = harness.broker_response(
+            "SELECT intCol + 1 FROM testTable ORDER BY intCol LIMIT 3",
+            check_parity=False)
+        v = np.sort(all_rows["intCol"])[:3] + 1
+        assert [row[0] for row in r.rows] == v.tolist()
+
+    def test_distinct(self, harness, all_rows):
+        r = harness.broker_response(
+            "SELECT DISTINCT groupCol FROM testTable ORDER BY groupCol LIMIT 100",
+            check_parity=False)
+        expected = sorted(set(np.asarray(all_rows["groupCol"]).tolist()))
+        assert [row[0] for row in r.rows] == expected
+
+    def test_offset(self, harness, all_rows):
+        r = harness.broker_response(
+            "SELECT intCol FROM testTable ORDER BY intCol LIMIT 5 OFFSET 10",
+            check_parity=False)
+        v = np.sort(all_rows["intCol"])[10:15]
+        assert [row[0] for row in r.rows] == v.tolist()
+
+
+class TestResponseMetadata:
+    def test_stats(self, harness):
+        r = harness.broker_response(
+            "SELECT COUNT(*) FROM testTable WHERE intCol > 500")
+        assert r.stats.total_docs == NUM_DOCS * NUM_SEGMENTS
+        assert r.stats.num_segments_processed == NUM_SEGMENTS
+        assert 0 < r.stats.num_docs_scanned < NUM_DOCS * NUM_SEGMENTS
+
+    def test_pruning(self, harness):
+        # intCol max < 1000, so this prunes every segment
+        r = harness.broker_response(
+            "SELECT COUNT(*) FROM testTable WHERE intCol > 5000",
+            check_parity=False)
+        assert r.rows[0][0] == 0
+
+    def test_to_dict_roundtrip(self, harness):
+        r = harness.broker_response("SELECT COUNT(*) FROM testTable")
+        d = r.to_dict()
+        assert d["resultTable"]["rows"][0][0] == NUM_DOCS * NUM_SEGMENTS
+        assert d["totalDocs"] == NUM_DOCS * NUM_SEGMENTS
+
+
+class TestReviewRegressions:
+    """Regressions from code-review findings."""
+
+    def test_expression_filter_first_and_operand(self, harness, all_rows):
+        # value-space masks must be writable for in-place AND combining
+        r = harness.broker_response(
+            "SELECT COUNT(*) FROM testTable WHERE intCol + 0 > 500 AND intCol < 900",
+            check_parity=False)
+        v = all_rows["intCol"]
+        assert r.rows[0][0] == int(((v > 500) & (v < 900)).sum())
+
+    def test_column_to_column_predicate(self, harness, all_rows):
+        # non-literal rhs must fall back to value-space evaluation
+        r = harness.broker_response(
+            "SELECT COUNT(*) FROM testTable WHERE intCol = rawIntCol",
+            check_parity=False)
+        assert r.rows[0][0] == int(
+            (all_rows["intCol"] == all_rows["rawIntCol"]).sum())
+
+    def test_filtered_aggregation(self, harness, all_rows):
+        r = harness.broker_response(
+            "SELECT SUM(intCol) FILTER (WHERE intCol > 500), COUNT(*) "
+            "FROM testTable", check_parity=False)
+        v = all_rows["intCol"]
+        assert r.rows[0][0] == pytest.approx(float(v[v > 500].sum()))
+        assert r.rows[0][1] == len(v)
+
+    def test_filtered_aggregation_group_by(self, harness, all_rows):
+        r = harness.broker_response(
+            "SELECT groupCol, COUNT(*) FILTER (WHERE intCol < 100) FROM testTable "
+            "GROUP BY groupCol ORDER BY groupCol LIMIT 100", check_parity=False)
+        g = np.asarray(all_rows["groupCol"])
+        v = all_rows["intCol"]
+        for key, cnt in r.rows:
+            assert cnt == int(((g == key) & (v < 100)).sum())
+
+    def test_all_segments_pruned_stats(self, harness):
+        r = harness.broker_response(
+            "SELECT COUNT(*) FROM testTable WHERE intCol > 5000",
+            check_parity=False)
+        assert r.stats.num_segments_pruned == NUM_SEGMENTS
+        assert r.stats.total_docs == NUM_DOCS * NUM_SEGMENTS
+        assert r.rows[0][0] == 0
