@@ -1,0 +1,118 @@
+"""Benchmark: SSB Q1.1-shaped scan-aggregation on the TPU query engine.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Config #2 from BASELINE.md: flat-lineorder range-filter + SUM, no index.
+  SELECT SUM(lo_extendedprice * lo_discount) FROM ssb
+  WHERE lo_orderdate BETWEEN 19940101 AND 19940131
+    AND lo_discount BETWEEN 4 AND 6 AND lo_quantity BETWEEN 26 AND 35
+value = device rows-scanned/sec (one chip); vs_baseline = speedup over the
+single-process numpy reference executor on the same segments (the stand-in
+for the JVM single-node reference until a JVM run is recorded).
+
+Segments are built once into ./bench_data (git-ignored) and reloaded on
+later runs; columns stay HBM-resident across queries (the segment cache of
+SURVEY.md §7.5), so steady-state timing reflects the scan path, not I/O.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+NUM_SEGMENTS = 16
+DOCS_PER_SEGMENT = 8_000_000
+DATA_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "bench_data")
+QUERY = ("SELECT SUM(lo_extendedprice * lo_discount), COUNT(*) FROM ssb "
+         "WHERE lo_orderdate BETWEEN 19940101 AND 19940131 "
+         "AND lo_discount BETWEEN 4 AND 6 AND lo_quantity BETWEEN 26 AND 35")
+
+
+def build_data():
+    from pinot_tpu.models import (DataType, FieldSpec, FieldType, Schema,
+                                  TableConfig, TableType)
+    from pinot_tpu.segment.creator import SegmentCreator
+
+    schema = Schema("ssb", [
+        FieldSpec("lo_orderdate", DataType.INT, FieldType.DIMENSION),
+        FieldSpec("lo_discount", DataType.INT, FieldType.DIMENSION),
+        FieldSpec("lo_quantity", DataType.INT, FieldType.DIMENSION),
+        FieldSpec("lo_extendedprice", DataType.INT, FieldType.METRIC),
+    ])
+    tc = TableConfig("ssb", TableType.OFFLINE)
+    # high-cardinality measure stays raw (no dictionary); random ints are
+    # incompressible, so skip chunk compression for build/load speed
+    tc.indexing.no_dictionary_columns = ["lo_extendedprice"]
+    tc.indexing.compression = "PASS_THROUGH"
+    creator = SegmentCreator(tc, schema)
+    dates = np.array([y * 10000 + m * 100 + d
+                      for y in range(1992, 1999)
+                      for m in range(1, 13) for d in range(1, 29)],
+                     dtype=np.int32)
+    for i in range(NUM_SEGMENTS):
+        out = os.path.join(DATA_DIR, f"seg_{i}")
+        if os.path.exists(os.path.join(out, "metadata.json")):
+            continue
+        rng = np.random.default_rng(1000 + i)
+        n = DOCS_PER_SEGMENT
+        cols = {
+            "lo_orderdate": dates[rng.integers(0, len(dates), n)],
+            "lo_discount": rng.integers(0, 11, n).astype(np.int32),
+            "lo_quantity": rng.integers(1, 51, n).astype(np.int32),
+            "lo_extendedprice": rng.integers(90_000, 10_000_000, n).astype(np.int32),
+        }
+        creator.build(cols, out, f"ssb_{i}")
+
+
+def load():
+    from pinot_tpu.segment.loader import load_segment
+    return [load_segment(os.path.join(DATA_DIR, f"seg_{i}"))
+            for i in range(NUM_SEGMENTS)]
+
+
+def time_executor(ex, n_iters: int, warmup: int = 2):
+    for _ in range(warmup):
+        resp = ex.execute(QUERY)
+    t0 = time.perf_counter()
+    for _ in range(n_iters):
+        resp = ex.execute(QUERY)
+    dt = (time.perf_counter() - t0) / n_iters
+    return dt, resp
+
+
+def main():
+    os.makedirs(DATA_DIR, exist_ok=True)
+    build_data()
+    segments = load()
+    total_rows = sum(s.num_docs for s in segments)
+
+    from pinot_tpu.query.executor import QueryExecutor
+
+    tpu_ex = QueryExecutor(segments, use_tpu=True)
+    tpu_dt, tpu_resp = time_executor(tpu_ex, n_iters=10)
+
+    cpu_ex = QueryExecutor(segments, use_tpu=False, max_threads=1)
+    cpu_dt, cpu_resp = time_executor(cpu_ex, n_iters=2, warmup=1)
+
+    # sanity: answers must agree (f32 device accumulate tolerance)
+    t, c = tpu_resp.rows[0], cpu_resp.rows[0]
+    assert c[1] == t[1], f"count mismatch: {t} vs {c}"
+    assert abs(t[0] - c[0]) <= 2e-3 * abs(c[0]), f"sum mismatch: {t} vs {c}"
+
+    rows_per_sec = total_rows / tpu_dt
+    cpu_rows_per_sec = total_rows / cpu_dt
+    print(json.dumps({
+        "metric": "ssb_q1_scan_agg_rows_per_sec_per_chip",
+        "value": round(rows_per_sec),
+        "unit": "rows/s",
+        "vs_baseline": round(rows_per_sec / cpu_rows_per_sec, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,7 @@
+"""Broker role: routing, scatter-gather, reduce, client HTTP API.
+
+Reference parity: pinot-broker (SURVEY.md L8 + §2.7):
+BaseSingleStageBrokerRequestHandler.handleRequest (requesthandler/...:280),
+BrokerRoutingManager (routing/BrokerRoutingManager.java:100),
+TimeBoundaryManager, QueryRouter scatter + BrokerReduceService gather.
+"""

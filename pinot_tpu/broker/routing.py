@@ -1,0 +1,162 @@
+"""Broker routing: which server executes which segments.
+
+Reference parity: pinot-broker routing/ — BrokerRoutingManager.java:100
+(segment preselect -> select -> prune -> instance select), instance
+selectors (BalancedInstanceSelector, ReplicaGroupInstanceSelector),
+segment pruners (partition, time), TimeBoundaryManager.java:56 for hybrid
+tables.
+"""
+from __future__ import annotations
+
+import random
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from pinot_tpu.query.context import QueryContext
+from pinot_tpu.query.expressions import Expression, Function, Identifier, Literal
+
+
+@dataclass
+class SegmentInfo:
+    name: str
+    servers: List[str]                       # replicas holding this segment
+    partition_id: Optional[int] = None       # for partition pruning
+    partition_column: Optional[str] = None
+    num_partitions: int = 0
+    start_time: Optional[int] = None         # time-range pruning
+    end_time: Optional[int] = None
+
+
+@dataclass
+class TableRoute:
+    """Routing state for one physical table (OFFLINE or REALTIME)."""
+    table_name: str
+    segments: Dict[str, SegmentInfo] = field(default_factory=dict)
+    time_column: Optional[str] = None
+
+
+class RoutingTable:
+    """segment->servers map + instance selection for one logical table."""
+
+    def __init__(self, offline: Optional[TableRoute] = None,
+                 realtime: Optional[TableRoute] = None,
+                 time_boundary: Optional[int] = None):
+        self.offline = offline
+        self.realtime = realtime
+        #: hybrid split: offline serves time <= boundary, realtime the rest
+        #: (ref TimeBoundaryManager.java:56)
+        self.time_boundary = time_boundary
+        self._rr = 0
+        self._lock = threading.Lock()
+
+    def route(self, ctx: QueryContext) -> List[Tuple[str, str, List[str], Optional[str]]]:
+        """Returns [(server, physical_table, segment_names, extra_filter)].
+
+        extra_filter is the time-boundary predicate SQL fragment to AND in
+        (the reference rewrites the query per physical table the same way).
+        """
+        out: List[Tuple[str, str, List[str], Optional[str]]] = []
+        if self.offline is not None:
+            extra = None
+            if self.realtime is not None and self.time_boundary is not None \
+                    and self.offline.time_column:
+                extra = f"{self.offline.time_column} <= {self.time_boundary}"
+            out.extend(self._route_physical(self.offline, ctx, extra))
+        if self.realtime is not None:
+            extra = None
+            if self.offline is not None and self.time_boundary is not None \
+                    and self.realtime.time_column:
+                extra = f"{self.realtime.time_column} > {self.time_boundary}"
+            out.extend(self._route_physical(self.realtime, ctx, extra))
+        return out
+
+    # ------------------------------------------------------------------
+    def _route_physical(self, route: TableRoute, ctx: QueryContext,
+                        extra_filter: Optional[str]):
+        selected = [s for s in route.segments.values()
+                    if not _prunable(s, ctx)]
+        per_server: Dict[str, List[str]] = {}
+        with self._lock:
+            for seg in selected:
+                if not seg.servers:
+                    continue
+                # balanced selection: rotate across replicas
+                # (ref BalancedInstanceSelector)
+                server = seg.servers[self._rr % len(seg.servers)]
+                per_server.setdefault(server, []).append(seg.name)
+            self._rr += 1
+        return [(server, route.table_name, names, extra_filter)
+                for server, names in per_server.items()]
+
+
+def _prunable(seg: SegmentInfo, ctx: QueryContext) -> bool:
+    """Partition pruning (ref broker/routing/segmentpruner/): a segment can
+    be skipped when an EQ filter on the partition column hashes to a
+    different partition."""
+    if ctx.filter is None or seg.partition_column is None or not seg.num_partitions:
+        return False
+    value = _eq_value(ctx.filter, seg.partition_column)
+    if value is None:
+        return False
+    return _modulo_partition(value, seg.num_partitions) != seg.partition_id
+
+
+def _eq_value(expr: Expression, column: str):
+    """Value of a top-level (AND-reachable) EQ predicate on `column`."""
+    if not isinstance(expr, Function):
+        return None
+    if expr.name == "and":
+        for a in expr.args:
+            v = _eq_value(a, column)
+            if v is not None:
+                return v
+        return None
+    if expr.name == "equals" and expr.args \
+            and isinstance(expr.args[0], Identifier) \
+            and expr.args[0].name == column \
+            and isinstance(expr.args[1], Literal):
+        return expr.args[1].value
+    return None
+
+
+def _modulo_partition(value, num_partitions: int) -> int:
+    """Ref segment-spi partition/ModuloPartitionFunction."""
+    try:
+        return int(value) % num_partitions
+    except (TypeError, ValueError):
+        return hash(str(value)) % num_partitions
+
+
+class BrokerRoutingManager:
+    """All tables' routing state (ref BrokerRoutingManager.java:100).
+    Rebuilt from cluster state on assignment changes (the ExternalView
+    watch analog is a callback from the controller-lite)."""
+
+    def __init__(self):
+        self._tables: Dict[str, RoutingTable] = {}
+        self._lock = threading.Lock()
+
+    def set_route(self, logical_table: str, routing: RoutingTable) -> None:
+        with self._lock:
+            self._tables[logical_table] = routing
+
+    def get_route(self, table: str) -> Optional[RoutingTable]:
+        base = table
+        for suffix in ("_OFFLINE", "_REALTIME"):
+            if base.endswith(suffix):
+                base = base[: -len(suffix)]
+        with self._lock:
+            rt = self._tables.get(base)
+            if rt is None:
+                return None
+            if table.endswith("_OFFLINE"):
+                return RoutingTable(offline=rt.offline)
+            if table.endswith("_REALTIME"):
+                return RoutingTable(realtime=rt.realtime)
+            return rt
+
+    @property
+    def table_names(self) -> List[str]:
+        with self._lock:
+            return list(self._tables.keys())

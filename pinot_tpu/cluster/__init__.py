@@ -1,0 +1,6 @@
+"""Cluster assembly: control plane (controller-lite) + in-process clusters.
+
+Reference parity: the Helix/ZooKeeper control plane (SURVEY.md L7) is
+replaced by an in-process/JSON-backed ClusterState with callback watches —
+ZK-free first, per the build plan (SURVEY.md §7.4).
+"""

@@ -1,0 +1,20 @@
+"""Ingestion: stream SPI, record transforms, mutable segments, realtime
+consumption lifecycle, batch jobs.
+
+Reference parity: pinot-spi stream/ (36-file consumer SPI),
+pinot-segment-local recordtransformer/ + realtime/impl/ mutable indexes,
+pinot-core data/manager/realtime/RealtimeSegmentDataManager.java:122
+(SURVEY.md §3.3 call stack).
+"""
+from pinot_tpu.ingest.stream import (
+    LongMsgOffset, MessageBatch, PartitionGroupConsumer, StreamConfig,
+    StreamConsumerFactory, StreamMessage)
+from pinot_tpu.ingest.memory_stream import InMemoryStream, InMemoryStreamConsumerFactory
+from pinot_tpu.ingest.mutable_segment import MutableSegment
+from pinot_tpu.ingest.transforms import TransformPipeline
+
+__all__ = [
+    "LongMsgOffset", "MessageBatch", "PartitionGroupConsumer", "StreamConfig",
+    "StreamConsumerFactory", "StreamMessage", "InMemoryStream",
+    "InMemoryStreamConsumerFactory", "MutableSegment", "TransformPipeline",
+]

@@ -1,0 +1,102 @@
+"""In-memory stream: the embedded-Kafka analog for tests and quickstarts.
+
+Reference parity: the test-scope StreamDataServerStartable embedded Kafka
+(pinot-plugins/pinot-stream-ingestion/pinot-kafka-base) used by
+BaseClusterIntegrationTest — here a thread-safe in-process topic with
+numbered partitions and Long offsets.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+from pinot_tpu.ingest.stream import (
+    LongMsgOffset, MessageBatch, PartitionGroupConsumer, StreamConfig,
+    StreamConsumerFactory, StreamMessage, StreamMetadataProvider,
+    register_stream_factory)
+
+
+class InMemoryStream:
+    """A topic: N partitions of append-only message lists."""
+
+    _topics: Dict[str, "InMemoryStream"] = {}
+    _registry_lock = threading.Lock()
+
+    def __init__(self, topic: str, num_partitions: int = 1):
+        self.topic = topic
+        self.num_partitions = num_partitions
+        self._partitions: List[List[StreamMessage]] = [
+            [] for _ in range(num_partitions)]
+        self._lock = threading.Lock()
+        with InMemoryStream._registry_lock:
+            InMemoryStream._topics[topic] = self
+
+    @classmethod
+    def get(cls, topic: str) -> "InMemoryStream":
+        with cls._registry_lock:
+            s = cls._topics.get(topic)
+        if s is None:
+            raise KeyError(f"in-memory topic {topic!r} does not exist")
+        return s
+
+    @classmethod
+    def delete(cls, topic: str) -> None:
+        with cls._registry_lock:
+            cls._topics.pop(topic, None)
+
+    def publish(self, record: Dict[str, Any], partition: Optional[int] = None,
+                key: Optional[str] = None) -> LongMsgOffset:
+        if partition is None:
+            partition = (hash(key) if key is not None else 0) % self.num_partitions
+        with self._lock:
+            part = self._partitions[partition]
+            off = LongMsgOffset(len(part))
+            part.append(StreamMessage(value=record, offset=off, key=key))
+            return off
+
+    def fetch(self, partition: int, start: LongMsgOffset,
+              max_messages: int = 10_000) -> MessageBatch:
+        with self._lock:
+            part = self._partitions[partition]
+            msgs = part[start.offset:start.offset + max_messages]
+            nxt = LongMsgOffset(start.offset + len(msgs))
+            return MessageBatch(messages=list(msgs), next_offset=nxt)
+
+    def latest_offset(self, partition: int) -> LongMsgOffset:
+        with self._lock:
+            return LongMsgOffset(len(self._partitions[partition]))
+
+
+class _InMemoryConsumer(PartitionGroupConsumer):
+    def __init__(self, topic: str, partition_id: int):
+        self.topic = topic
+        self.partition_id = partition_id
+
+    def fetch_messages(self, start_offset: LongMsgOffset,
+                       timeout_ms: int) -> MessageBatch:
+        return InMemoryStream.get(self.topic).fetch(self.partition_id, start_offset)
+
+
+class _InMemoryMetadataProvider(StreamMetadataProvider):
+    def __init__(self, topic: str):
+        self.topic = topic
+
+    def partition_ids(self) -> List[int]:
+        return list(range(InMemoryStream.get(self.topic).num_partitions))
+
+    def start_offset(self, partition_id: int, criteria: str) -> LongMsgOffset:
+        if criteria == "largest":
+            return InMemoryStream.get(self.topic).latest_offset(partition_id)
+        return LongMsgOffset(0)
+
+
+class InMemoryStreamConsumerFactory(StreamConsumerFactory):
+    def create_partition_consumer(self, config: StreamConfig,
+                                  partition_id: int) -> PartitionGroupConsumer:
+        return _InMemoryConsumer(config.topic, partition_id)
+
+    def create_metadata_provider(self, config: StreamConfig) -> StreamMetadataProvider:
+        return _InMemoryMetadataProvider(config.topic)
+
+
+register_stream_factory("inmemory", InMemoryStreamConsumerFactory())

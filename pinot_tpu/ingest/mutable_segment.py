@@ -1,0 +1,210 @@
+"""Mutable (consuming) segment: rows are queryable as they arrive.
+
+Reference parity: pinot-segment-local
+indexsegment/mutable/MutableSegmentImpl.java:515 (index(row)) and the
+realtime/impl/ mutable column structures. Differences, deliberate:
+  * columns append into amortized-doubling numpy buffers (the analog of
+    FixedByteSVMutableForwardIndex's chunked buffers);
+  * mutable dictionaries are insertion-ordered value<->id maps (unsorted,
+    as in the reference) — so the query path treats mutable columns as
+    raw values (value-space predicates) rather than sorted-dictId space,
+    and the device engine leaves consuming segments to the host executor
+    (they are small by construction: flush thresholds cap them).
+
+Queries see a CONSISTENT SNAPSHOT: data_source() binds to num_docs at
+call time (ref: reference queries read up to the indexed row count).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from pinot_tpu.models import DataType, FieldSpec, FieldType, Schema, TableConfig
+from pinot_tpu.segment.bitmap import Bitmap
+from pinot_tpu.segment.meta import ColumnMetadata, SegmentMetadata
+
+
+class _MutableColumn:
+    def __init__(self, spec: FieldSpec):
+        self.spec = spec
+        st = spec.data_type.stored_type
+        self._np_dtype = spec.data_type.np_dtype
+        self._fixed = self._np_dtype.kind in "iuf"
+        if spec.single_value:
+            if self._fixed:
+                self._buf = np.empty(1024, dtype=self._np_dtype)
+            else:
+                self._buf: List[Any] = []
+        else:
+            self._values: List[List[Any]] = []
+        self._null_docs: List[int] = []
+        self.distinct: set = set()
+
+    def append(self, doc_id: int, value: Any) -> None:
+        spec = self.spec
+        if value is None:
+            self._null_docs.append(doc_id)
+            value = (spec.default_null_value if spec.single_value
+                     else [spec.default_null_value])
+        if spec.single_value:
+            if self._fixed:
+                if doc_id >= len(self._buf):
+                    self._buf = np.concatenate(
+                        [self._buf, np.empty(len(self._buf), dtype=self._np_dtype)])
+                self._buf[doc_id] = value
+            else:
+                self._buf.append(value)
+            self.distinct.add(value)
+        else:
+            self._values.append(list(value))
+            self.distinct.update(value)
+
+    def values_snapshot(self, n: int):
+        if self.spec.single_value:
+            if self._fixed:
+                return self._buf[:n].copy()
+            return np.array(self._buf[:n], dtype=object)
+        return self._values[:n]
+
+    def null_bitmap(self, n: int) -> Optional[Bitmap]:
+        nulls = [d for d in self._null_docs if d < n]
+        if not nulls:
+            return None
+        return Bitmap.from_indices(n, nulls)
+
+
+class _MutableDataSource:
+    """Snapshot view implementing the DataSource duck type the executors
+    consume (values + metadata; no sorted dict, no aux indexes)."""
+
+    def __init__(self, col: _MutableColumn, n: int, meta: ColumnMetadata):
+        self._col = col
+        self._n = n
+        self.metadata = meta
+
+    def values(self) -> np.ndarray:
+        return self._col.values_snapshot(self._n)
+
+    def mv_offsets(self) -> np.ndarray:
+        vals = self._col.values_snapshot(self._n)
+        lens = np.array([len(v) for v in vals], dtype=np.int32)
+        out = np.zeros(len(vals) + 1, dtype=np.int32)
+        np.cumsum(lens, out=out[1:])
+        return out
+
+    def dict_ids(self):
+        raise ValueError(f"mutable column {self.metadata.name} has no "
+                         "sorted dictionary")
+
+    @property
+    def dictionary(self):
+        return None
+
+    @property
+    def inverted_index(self):
+        return None
+
+    @property
+    def range_index(self):
+        return None
+
+    @property
+    def sorted_index(self):
+        return None
+
+    @property
+    def bloom_filter(self):
+        return None
+
+    @property
+    def null_value_vector(self) -> Optional[Bitmap]:
+        return self._col.null_bitmap(self._n)
+
+
+class MutableSegment:
+    """Ref MutableSegmentImpl — the CONSUMING segment."""
+
+    def __init__(self, segment_name: str, table_config: TableConfig,
+                 schema: Schema):
+        self.segment_name = segment_name
+        self.table_config = table_config
+        self.schema = schema
+        self._cols: Dict[str, _MutableColumn] = {
+            s.name: _MutableColumn(s) for s in schema.fields if not s.virtual}
+        self._num_docs = 0
+        self._lock = threading.Lock()
+        self.start_consumption_time = time.time()
+
+    # -- ingestion side -----------------------------------------------------
+    def index(self, record: Dict[str, Any]) -> bool:
+        """Append one transformed row (ref MutableSegmentImpl.index:515)."""
+        with self._lock:
+            doc_id = self._num_docs
+            for name, col in self._cols.items():
+                col.append(doc_id, record.get(name))
+            self._num_docs += 1
+        return True
+
+    # -- query side (IndexSegment duck type) --------------------------------
+    @property
+    def name(self) -> str:
+        return self.segment_name
+
+    @property
+    def num_docs(self) -> int:
+        return self._num_docs
+
+    @property
+    def column_names(self) -> List[str]:
+        return list(self._cols.keys())
+
+    def has_column(self, column: str) -> bool:
+        return column in self._cols
+
+    @property
+    def metadata(self) -> SegmentMetadata:
+        n = self._num_docs
+        cols = {}
+        for name, col in self._cols.items():
+            cols[name] = self._col_meta(name, col, n)
+        return SegmentMetadata(
+            segment_name=self.segment_name,
+            table_name=self.table_config.table_name_with_type,
+            num_docs=n, columns=cols,
+            time_column=self.table_config.retention.time_column)
+
+    def _col_meta(self, name: str, col: _MutableColumn, n: int) -> ColumnMetadata:
+        return ColumnMetadata(
+            name=name, data_type=col.spec.data_type,
+            field_type=col.spec.field_type,
+            single_value=col.spec.single_value,
+            has_dictionary=False,  # unsorted mutable dict -> value space
+            cardinality=len(col.distinct), total_entries=n)
+
+    def data_source(self, column: str) -> _MutableDataSource:
+        col = self._cols.get(column)
+        if col is None:
+            raise KeyError(f"column {column!r} not in segment {self.segment_name}")
+        n = self._num_docs  # snapshot
+        return _MutableDataSource(col, n, self._col_meta(column, col, n))
+
+    def destroy(self) -> None:
+        self._cols.clear()
+
+    # -- sealing ------------------------------------------------------------
+    def to_columns(self) -> Dict[str, Any]:
+        """Materialize all columns for immutable segment build."""
+        n = self._num_docs
+        out: Dict[str, Any] = {}
+        for name, col in self._cols.items():
+            vals = col.values_snapshot(n)
+            nulls = col.null_bitmap(n)
+            if nulls is not None and col.spec.single_value:
+                vals = list(vals)
+                for d in nulls.to_indices():
+                    vals[d] = None
+            out[name] = vals
+        return out

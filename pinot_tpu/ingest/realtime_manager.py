@@ -1,0 +1,163 @@
+"""Realtime segment lifecycle: consume -> queryable -> seal -> immutable.
+
+Reference parity: pinot-core
+data/manager/realtime/RealtimeSegmentDataManager.java:122 — one consumer
+thread per stream partition (:716,1450), consumeLoop fetching batches
+(:439,765), end-criteria (rows/time) triggering segment completion: build
+the immutable segment, swap it into the table data manager, persist the
+stream offset as the replay checkpoint, open the next CONSUMING segment
+(SURVEY.md §3.3). The controller-side completion FSM is collapsed into the
+local commit callback until multi-instance coordination lands
+(controller-lite owns it then).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from pinot_tpu.ingest.mutable_segment import MutableSegment
+from pinot_tpu.ingest.stream import (
+    LongMsgOffset, StreamConfig, get_stream_factory)
+from pinot_tpu.ingest.transforms import TransformPipeline
+from pinot_tpu.models import Schema, TableConfig
+from pinot_tpu.segment.creator import SegmentCreator
+from pinot_tpu.segment.loader import load_segment
+from pinot_tpu.server.data_manager import TableDataManager
+
+log = logging.getLogger(__name__)
+
+
+class RealtimeSegmentDataManager:
+    """One stream partition's consumer + segment rotation."""
+
+    def __init__(self, table_config: TableConfig, schema: Schema,
+                 stream_config: StreamConfig, partition_id: int,
+                 table_data_manager: TableDataManager, segment_store_dir: str,
+                 start_offset: Optional[LongMsgOffset] = None,
+                 on_commit: Optional[Callable[[str, LongMsgOffset], None]] = None,
+                 ingestion_delay_tracker=None):
+        self.table_config = table_config
+        self.schema = schema
+        self.stream_config = stream_config
+        self.partition_id = partition_id
+        self.tdm = table_data_manager
+        self.store_dir = segment_store_dir
+        self.on_commit = on_commit
+        self.pipeline = TransformPipeline(table_config, schema)
+        self.delay_tracker = ingestion_delay_tracker
+
+        factory = get_stream_factory(stream_config)
+        self.consumer = factory.create_partition_consumer(stream_config, partition_id)
+        if start_offset is None:
+            meta = factory.create_metadata_provider(stream_config)
+            start_offset = meta.start_offset(partition_id,
+                                             stream_config.offset_criteria)
+        self.current_offset = start_offset
+        self._seq = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.mutable: Optional[MutableSegment] = None
+        self._open_new_consuming()
+
+    # ------------------------------------------------------------------
+    def _segment_name(self) -> str:
+        # ref LLCSegmentName: table__partition__seq__creationTime
+        return (f"{self.table_config.name}__{self.partition_id}__{self._seq}"
+                f"__{int(time.time())}")
+
+    def _open_new_consuming(self) -> None:
+        self.mutable = MutableSegment(self._segment_name(), self.table_config,
+                                      self.schema)
+        self.tdm.add_segment(self.mutable)  # immediately queryable
+
+    # ------------------------------------------------------------------
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._consume_loop, daemon=True,
+            name=f"consumer-{self.table_config.name}-{self.partition_id}")
+        self._thread.start()
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+        self.consumer.close()
+
+    def _consume_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                batch = self.consumer.fetch_messages(self.current_offset, 100)
+            except Exception:  # noqa: BLE001
+                log.exception("fetch failed; backing off")
+                time.sleep(1.0)
+                continue
+            for msg in batch.messages:
+                rec = self.pipeline.transform(msg.value)
+                if rec is not None:
+                    self.mutable.index(rec)
+                # offset advances per message so a mid-batch commit
+                # checkpoints exactly the rows it sealed
+                self.current_offset = msg.offset.next()
+                if self.delay_tracker is not None and msg.timestamp_ms:
+                    self.delay_tracker.record(self.partition_id, msg.timestamp_ms)
+                if self._end_criteria_reached():
+                    self._commit()
+            if batch.next_offset is not None:
+                self.current_offset = batch.next_offset
+            if self._end_criteria_reached():
+                self._commit()
+            if len(batch) == 0:
+                if self._stop.wait(0.05):
+                    break
+
+    def _end_criteria_reached(self) -> bool:
+        if self.mutable.num_docs >= self.stream_config.flush_threshold_rows:
+            return True
+        age_ms = (time.time() - self.mutable.start_consumption_time) * 1000
+        return (self.mutable.num_docs > 0
+                and age_ms >= self.stream_config.flush_threshold_time_ms)
+
+    # ------------------------------------------------------------------
+    def _commit(self) -> None:
+        """Seal: mutable -> immutable on disk -> swap -> checkpoint
+        (ref commitSegment, RealtimeSegmentDataManager.java:856,1164)."""
+        sealed = self.mutable
+        name = sealed.segment_name
+        out_dir = os.path.join(self.store_dir, name)
+        creator = SegmentCreator(self.table_config, self.schema)
+        creator.build(sealed.to_columns(), out_dir, name)
+        immutable = load_segment(out_dir)
+        # swap BEFORE removing: add_segment replaces by name atomically
+        self.tdm.add_segment(immutable)
+        if self.on_commit is not None:
+            self.on_commit(name, self.current_offset)
+        self._seq += 1
+        self._open_new_consuming()
+
+    def force_commit(self) -> None:
+        """Ops hook (ref forceCommit REST): seal now regardless of criteria."""
+        if self.mutable.num_docs > 0:
+            self._commit()
+
+
+class IngestionDelayTracker:
+    """Ref core/data/manager/realtime/IngestionDelayTracker.java — per
+    partition end-to-end ingestion lag."""
+
+    def __init__(self):
+        self._latest: Dict[int, int] = {}
+        self._lock = threading.Lock()
+
+    def record(self, partition_id: int, event_ts_ms: int) -> None:
+        with self._lock:
+            self._latest[partition_id] = event_ts_ms
+
+    def delay_ms(self, partition_id: int) -> Optional[float]:
+        with self._lock:
+            ts = self._latest.get(partition_id)
+        if ts is None:
+            return None
+        return max(0.0, time.time() * 1000 - ts)

@@ -1,0 +1,108 @@
+"""Stream consumer SPI.
+
+Reference parity: pinot-spi stream/ — StreamConfig, StreamConsumerFactory,
+PartitionGroupConsumer.fetchMessages, MessageBatch, StreamPartitionMsgOffset
+(monotonic, comparable, string-serializable so it can live in segment
+metadata as the replay checkpoint — SURVEY.md §5 checkpoint/resume).
+Concrete plugins (in-memory, kafka) implement this contract.
+"""
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+
+@dataclass(frozen=True, order=True)
+class LongMsgOffset:
+    """Ref LongMsgOffset — kafka-style numeric offset."""
+    offset: int
+
+    def __str__(self) -> str:
+        return str(self.offset)
+
+    @classmethod
+    def parse(cls, s: str) -> "LongMsgOffset":
+        return cls(int(s))
+
+    def next(self) -> "LongMsgOffset":
+        return LongMsgOffset(self.offset + 1)
+
+
+@dataclass
+class StreamMessage:
+    value: Dict[str, Any]          # decoded record (RecordExtractor output)
+    offset: LongMsgOffset
+    key: Optional[str] = None
+    timestamp_ms: Optional[int] = None
+
+
+@dataclass
+class MessageBatch:
+    """Ref MessageBatch — one fetch's worth of messages."""
+    messages: List[StreamMessage] = field(default_factory=list)
+    #: offset to resume from after consuming this batch
+    next_offset: Optional[LongMsgOffset] = None
+    end_of_partition: bool = False
+
+    def __len__(self) -> int:
+        return len(self.messages)
+
+
+@dataclass
+class StreamConfig:
+    """Ref StreamConfig — parsed from table streamConfigs map."""
+    stream_type: str = "inmemory"       # kafka | kinesis | pulsar | inmemory
+    topic: str = ""
+    consumer_factory: str = ""
+    decoder: str = "json"
+    #: segment flush thresholds (ref StreamConfig flush settings)
+    flush_threshold_rows: int = 100_000
+    flush_threshold_time_ms: int = 6 * 3600 * 1000
+    offset_criteria: str = "smallest"   # smallest | largest
+    properties: Dict[str, str] = field(default_factory=dict)
+
+
+class PartitionGroupConsumer(abc.ABC):
+    """Ref PartitionGroupConsumer — one stream partition's consumer."""
+
+    @abc.abstractmethod
+    def fetch_messages(self, start_offset: LongMsgOffset,
+                       timeout_ms: int) -> MessageBatch: ...
+
+    def close(self) -> None:
+        pass
+
+
+class StreamMetadataProvider(abc.ABC):
+    @abc.abstractmethod
+    def partition_ids(self) -> List[int]: ...
+
+    @abc.abstractmethod
+    def start_offset(self, partition_id: int, criteria: str) -> LongMsgOffset: ...
+
+
+class StreamConsumerFactory(abc.ABC):
+    """Ref StreamConsumerFactory — resolved from StreamConfig."""
+
+    @abc.abstractmethod
+    def create_partition_consumer(self, config: StreamConfig,
+                                  partition_id: int) -> PartitionGroupConsumer: ...
+
+    @abc.abstractmethod
+    def create_metadata_provider(self, config: StreamConfig) -> StreamMetadataProvider: ...
+
+
+_FACTORIES: Dict[str, StreamConsumerFactory] = {}
+
+
+def register_stream_factory(stream_type: str, factory: StreamConsumerFactory) -> None:
+    _FACTORIES[stream_type] = factory
+
+
+def get_stream_factory(config: StreamConfig) -> StreamConsumerFactory:
+    f = _FACTORIES.get(config.stream_type)
+    if f is None:
+        raise ValueError(f"no stream factory registered for {config.stream_type!r}"
+                         f" (registered: {sorted(_FACTORIES)})")
+    return f

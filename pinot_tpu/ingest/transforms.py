@@ -1,0 +1,99 @@
+"""Record transform pipeline (ingestion side).
+
+Reference parity: pinot-segment-local recordtransformer/ — the
+CompositeTransformer chain: filtering (skip rows), expression transforms
+(derived columns), data-type conversion + null handling against the
+schema, time validation, sanitization. Order mirrors
+CompositeTransformer.getDefaultTransformers.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from pinot_tpu.models import FieldSpec, Schema, TableConfig
+from pinot_tpu.query.expressions import Expression
+from pinot_tpu.query.parser import _Parser, tokenize
+
+
+def parse_expression(text: str) -> Expression:
+    """Parse a standalone scalar expression (for transform/filter configs)."""
+    return _Parser(tokenize(text)).expr()
+
+
+class _ScalarProvider:
+    """ColumnProvider over one record's scalars (arrays of length 1)."""
+
+    def __init__(self, record: Dict[str, Any]):
+        self.record = record
+
+    def column(self, name: str):
+        v = self.record.get(name)
+        return np.array([v]) if not isinstance(v, (list, tuple)) else np.array([0])
+
+    @property
+    def num_docs(self) -> int:
+        return 1
+
+
+class TransformPipeline:
+    """record dict -> transformed record dict (or None when filtered)."""
+
+    def __init__(self, table_config: TableConfig, schema: Schema):
+        self.schema = schema
+        ing = table_config.ingestion
+        self._filter_expr: Optional[Expression] = None
+        if getattr(ing, "filter_function", None):
+            self._filter_expr = parse_expression(ing.filter_function)
+        self._transforms: List[tuple] = []
+        for cfg in getattr(ing, "transform_configs", []) or []:
+            self._transforms.append(
+                (cfg["columnName"], parse_expression(cfg["transformFunction"])))
+        self._enrichers: List[Callable[[Dict[str, Any]], None]] = []
+
+    def add_enricher(self, fn: Callable[[Dict[str, Any]], None]) -> None:
+        """Ref recordtransformer/enricher/ (e.g. CLPEncodingEnricher)."""
+        self._enrichers.append(fn)
+
+    def transform(self, record: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        from pinot_tpu.query import transform as texpr
+
+        # 1. filter (ref FilterTransformer): truthy filter result -> DROP
+        if self._filter_expr is not None:
+            out = texpr.evaluate(self._filter_expr, _ScalarProvider(record))
+            if bool(np.asarray(out).reshape(-1)[0]):
+                return None
+        # 2. expression transforms (ref ExpressionTransformer)
+        if self._transforms:
+            record = dict(record)
+            for col, expr in self._transforms:
+                if record.get(col) is None:
+                    out = texpr.evaluate(expr, _ScalarProvider(record))
+                    record[col] = _scalar(out)
+        # 3. enrichers
+        for fn in self._enrichers:
+            fn(record)
+        # 4. schema conversion + null handling (ref DataTypeTransformer /
+        #    NullValueTransformer): coerce to stored type, defaults for nulls
+        out_rec: Dict[str, Any] = {}
+        for spec in self.schema.fields:
+            if spec.virtual:
+                continue
+            v = record.get(spec.name)
+            if spec.single_value:
+                out_rec[spec.name] = (spec.data_type.convert(v)
+                                      if v is not None else None)
+            else:
+                if v is None:
+                    v = []
+                elif not isinstance(v, (list, tuple)):
+                    v = [v]
+                out_rec[spec.name] = [spec.data_type.convert(x) for x in v]
+        return out_rec
+
+
+def _scalar(v: Any) -> Any:
+    arr = np.asarray(v).reshape(-1)
+    x = arr[0]
+    return x.item() if isinstance(x, np.generic) else x

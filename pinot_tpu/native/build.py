@@ -1,0 +1,43 @@
+"""Build libpinot_tpu_native.so with g++.
+
+Usage: python -m pinot_tpu.native.build
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+SRC = os.path.join(HERE, "src", "pinot_tpu_native.cpp")
+OUT = os.path.join(HERE, "libpinot_tpu_native.so")
+
+
+def build(verbose: bool = True) -> str:
+    cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC",
+           "-std=c++17", SRC, "-o", OUT]
+    if verbose:
+        print(" ".join(cmd))
+    subprocess.run(cmd, check=True)
+    return OUT
+
+
+if __name__ == "__main__":
+    build()
+    # smoke test through the ctypes wrapper
+    sys.path.insert(0, os.path.dirname(os.path.dirname(HERE)))
+    from pinot_tpu.native import _load
+    lib = _load()
+    assert lib is not None, "built but failed to load"
+    import numpy as np
+    from pinot_tpu.segment import bitpack
+    rng = np.random.default_rng(0)
+    vals = rng.integers(0, 1 << 7, 100_001).astype(np.uint32)
+    packed = bitpack.pack(vals, 7)
+    out = lib.bitunpack32(packed, len(vals), 7)
+    assert np.array_equal(out, vals.astype(np.int32)), "bitunpack mismatch"
+    data = rng.integers(0, 50, 1 << 20).astype(np.uint8).tobytes()
+    comp = lib.lz4_compress(data)
+    rt = lib.lz4_decompress(comp, len(data))
+    assert rt == data, "lz4 roundtrip mismatch"
+    print(f"OK {OUT} (lz4 ratio {len(comp)/len(data):.3f})")

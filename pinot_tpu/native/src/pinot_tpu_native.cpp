@@ -1,0 +1,182 @@
+// Native host-side fast paths for pinot-tpu.
+//
+// Reference parity: the role of the JVM's hand-tuned readers —
+// pinot-segment-local io/util/FixedBitIntReaderWriterV2.java:99-124 (bulk
+// fixed-bit unpack) — and of the lz4-java dependency behind
+// ChunkCompressionType.LZ4 (pinot-segment-spi compression/
+// ChunkCompressionType.java:21). The LZ4 block codec is a clean-room
+// implementation of the public LZ4 block format (greedy hash-table
+// matcher; standard token/literal/match sequence decoding).
+//
+// Exposed with C linkage for the ctypes wrapper in
+// pinot_tpu/native/__init__.py. Build: python -m pinot_tpu.native.build
+#include <cstdint>
+#include <cstring>
+#include <algorithm>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Fixed-bit unpack: MSB-first dense bitstream -> int32 (bitpack.py format)
+// ---------------------------------------------------------------------------
+void bitunpack32(const uint8_t* buf, int32_t* out, long n, int bits) {
+    uint64_t acc = 0;      // bit accumulator, top-aligned consumption
+    int have = 0;          // bits in accumulator
+    const uint8_t* p = buf;
+    const uint64_t mask = (bits == 64) ? ~0ULL : ((1ULL << bits) - 1);
+    for (long i = 0; i < n; i++) {
+        while (have < bits) {
+            acc = (acc << 8) | *p++;
+            have += 8;
+        }
+        out[i] = (int32_t)((acc >> (have - bits)) & mask);
+        have -= bits;
+    }
+}
+
+// Gathered dictionary decode: out[i] = dict[ids[i]] for 4-byte values —
+// the DataFetcher.fetchIntValues hot loop.
+void dict_gather_i32(const int32_t* dict, const int32_t* ids, int32_t* out,
+                     long n) {
+    for (long i = 0; i < n; i++) out[i] = dict[ids[i]];
+}
+
+void dict_gather_f64(const double* dict, const int32_t* ids, double* out,
+                     long n) {
+    for (long i = 0; i < n; i++) out[i] = dict[ids[i]];
+}
+
+// ---------------------------------------------------------------------------
+// LZ4 block format (https://github.com/lz4/lz4/blob/dev/doc/lz4_Block_format.md)
+// ---------------------------------------------------------------------------
+
+int lz4_compress_bound(int n) {
+    return n + n / 255 + 16;
+}
+
+static inline uint32_t lz4_hash(uint32_t v) {
+    return (v * 2654435761u) >> 20;  // 12-bit table
+}
+
+// Greedy single-pass compressor with a 4KB hash table.
+int lz4_compress_default(const char* src_c, char* dst_c, int src_len,
+                         int dst_cap) {
+    const uint8_t* src = (const uint8_t*)src_c;
+    uint8_t* dst = (uint8_t*)dst_c;
+    if (src_len < 0 || dst_cap <= 0) return 0;
+    int32_t table[4096];
+    for (int i = 0; i < 4096; i++) table[i] = -1;
+
+    const int MFLIMIT = 12;  // last 12 bytes are always literals
+    long ip = 0, op = 0, anchor = 0;
+    long mflimit = src_len - MFLIMIT;
+
+    auto emit = [&](long literal_len, long match_len, long offset) -> bool {
+        // token
+        long ll = literal_len;
+        long ml = match_len - 4;  // stored minus minmatch
+        long need = 1 + literal_len + (literal_len >= 15 ? literal_len / 255 + 1 : 0)
+                    + (match_len ? 2 + (ml >= 15 ? ml / 255 + 1 : 0) : 0);
+        if (op + need + 8 > dst_cap) return false;
+        uint8_t token = (uint8_t)((std::min(ll, 15L) << 4)
+                                  | (match_len ? std::min(ml, 15L) : 0));
+        dst[op++] = token;
+        if (ll >= 15) {
+            long rem = ll - 15;
+            while (rem >= 255) { dst[op++] = 255; rem -= 255; }
+            dst[op++] = (uint8_t)rem;
+        }
+        std::memcpy(dst + op, src + anchor, ll);
+        op += ll;
+        if (match_len) {
+            dst[op++] = (uint8_t)(offset & 0xFF);
+            dst[op++] = (uint8_t)(offset >> 8);
+            if (ml >= 15) {
+                long rem = ml - 15;
+                while (rem >= 255) { dst[op++] = 255; rem -= 255; }
+                dst[op++] = (uint8_t)rem;
+            }
+        }
+        return true;
+    };
+
+    while (ip <= mflimit) {
+        uint32_t seq;
+        std::memcpy(&seq, src + ip, 4);
+        uint32_t h = lz4_hash(seq);
+        long ref = table[h];
+        table[h] = (int32_t)ip;
+        uint32_t refseq = 0;
+        if (ref >= 0 && ip - ref <= 65535) std::memcpy(&refseq, src + ref, 4);
+        if (ref >= 0 && ip - ref <= 65535 && refseq == seq) {
+            // extend match
+            long match_len = 4;
+            while (ip + match_len <= mflimit + (MFLIMIT - 5) &&
+                   src[ref + match_len] == src[ip + match_len] &&
+                   ip + match_len < src_len - 5)
+                match_len++;
+            if (!emit(ip - anchor, match_len, ip - ref)) return 0;
+            ip += match_len;
+            anchor = ip;
+        } else {
+            ip++;
+        }
+    }
+    // final literals
+    long ll = src_len - anchor;
+    long need = 1 + ll + (ll >= 15 ? ll / 255 + 1 : 0);
+    if (op + need > dst_cap) return 0;
+    uint8_t token = (uint8_t)(std::min(ll, 15L) << 4);
+    dst[op++] = token;
+    if (ll >= 15) {
+        long rem = ll - 15;
+        while (rem >= 255) { dst[op++] = 255; rem -= 255; }
+        dst[op++] = (uint8_t)rem;
+    }
+    std::memcpy(dst + op, src + anchor, ll);
+    op += ll;
+    return (int)op;
+}
+
+int lz4_decompress_safe(const char* src_c, char* dst_c, int src_len,
+                        int dst_cap) {
+    const uint8_t* src = (const uint8_t*)src_c;
+    uint8_t* dst = (uint8_t*)dst_c;
+    long ip = 0, op = 0;
+    while (ip < src_len) {
+        uint8_t token = src[ip++];
+        long ll = token >> 4;
+        if (ll == 15) {
+            uint8_t b;
+            do {
+                if (ip >= src_len) return -1;
+                b = src[ip++];
+                ll += b;
+            } while (b == 255);
+        }
+        if (ip + ll > src_len || op + ll > dst_cap) return -1;
+        std::memcpy(dst + op, src + ip, ll);
+        ip += ll;
+        op += ll;
+        if (ip >= src_len) break;  // last sequence has no match
+        long offset = src[ip] | ((long)src[ip + 1] << 8);
+        ip += 2;
+        if (offset == 0 || offset > op) return -1;
+        long ml = (token & 0xF) + 4;
+        if ((token & 0xF) == 15) {
+            uint8_t b;
+            do {
+                if (ip >= src_len) return -1;
+                b = src[ip++];
+                ml += b;
+            } while (b == 255);
+        }
+        if (op + ml > dst_cap) return -1;
+        // overlapping copy must be byte-wise
+        for (long i = 0; i < ml; i++) dst[op + i] = dst[op + i - offset];
+        op += ml;
+    }
+    return (int)op;
+}
+
+}  // extern "C"

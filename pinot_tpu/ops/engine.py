@@ -51,6 +51,11 @@ def _pow2(n: int, floor: int = 128) -> int:
     return v
 
 
+# doc-padding cap: counts are packed in float32 when x64 is off, which is
+# exact only below 2^24; segments larger than this are rejected to host
+MAX_DOCS_PER_SEGMENT = 1 << 24
+
+
 class TpuOperatorExecutor:
     def __init__(self, devices: Optional[Sequence] = None):
         self.devices = list(devices) if devices is not None else jax.devices()
@@ -58,6 +63,20 @@ class TpuOperatorExecutor:
         if len(self.devices) > 1:
             from jax.sharding import Mesh
             self._mesh = Mesh(np.array(self.devices), ("segments",))
+        #: device-resident column blocks, LRU-evicted under a byte budget
+        #: (HBM segment cache, SURVEY.md §7.5); keys carry the segment
+        #: batch identity (id+name pairs guard against id() reuse)
+        from collections import OrderedDict
+        self._block_cache: "OrderedDict[tuple, Any]" = OrderedDict()
+        self._block_bytes: Dict[tuple, int] = {}
+        self._cache_bytes = 0
+        import os as _os
+        self.cache_budget_bytes = int(_os.environ.get(
+            "PINOT_TPU_HBM_CACHE_BYTES", 8 << 30))
+        #: resolved predicate parameter arrays per (batch, plan, filter) —
+        #: repeat queries then cost zero host->device param uploads;
+        #: bounded by simple size cap (entries are tiny)
+        self._params_cache: Dict[tuple, Any] = {}
 
     # ------------------------------------------------------------------
     # capability check (structural)
@@ -127,9 +146,8 @@ class TpuOperatorExecutor:
         except _NotStageable:
             return [], segments
         kernel = kernels.compiled_kernel(plan)
-        out = kernel(cols, params, num_docs, D=D)
-        out = {k: np.asarray(v) for k, v in out.items()}
-        results = self._assemble(segments, ctx, plan, out, S_real, slots_of_fn)
+        packed = np.asarray(kernel(cols, params, num_docs, D=D))
+        results = self._assemble(segments, ctx, plan, packed, S_real, slots_of_fn)
         return results, []
 
     # ------------------------------------------------------------------
@@ -295,6 +313,8 @@ class TpuOperatorExecutor:
         if self._mesh is not None:
             n = len(self.devices)
             S = ((S_real + n - 1) // n) * n
+        if max(s.num_docs for s in segments) > MAX_DOCS_PER_SEGMENT:
+            raise _NotStageable()
         D = _pow2(max(s.num_docs for s in segments))
 
         cols: Dict[str, jnp.ndarray] = {}
@@ -306,26 +326,41 @@ class TpuOperatorExecutor:
                 segments, S, D, col, "ids",
                 lambda ds: ds.dict_ids().astype(np.int32), np.int32)
         for col in plan.raw_cols:
+            self._check_value_precision(segments, col, vdt)
             cols["val:" + col] = self._stacked(
                 segments, S, D, col, "val",
                 lambda ds: ds.values().astype(vdt), vdt)
 
-        # dictionary value tables for value IR gathers
+        # value columns: stage MATERIALIZED values (dictionary take done
+        # host-side at staging, cached in HBM) rather than in-kernel
+        # take_along_axis gathers — TPU gathers run off the vector units and
+        # dominated the scan kernel when measured; a dense [S, D] value
+        # block turns the hot path into a pure fused multiply-reduce
         value_cols = set()
         for ir in plan.value_irs:
             value_cols |= self._ir_cols(ir)
         for col in value_cols & set(plan.dict_cols):
-            C = _pow2(max(s.metadata.columns[col].cardinality for s in segments),
-                      floor=8)
-            table = np.zeros((S, C), dtype=vdt)
-            for i, seg in enumerate(segments):
-                vals = seg.data_source(col).dictionary.values_as_f64()
-                if vals is None:
+            if "val:" + col in cols:
+                continue
+            self._check_value_precision(segments, col, vdt)
+            def fetch_values(ds):
+                vals = ds.values()
+                if vals.dtype.kind not in "iuf":
                     raise _NotStageable()
-                table[i, :len(vals)] = vals.astype(vdt)
-            params["dict:" + col] = self._put(table)
+                return vals.astype(vdt)
+            cols["val:" + col] = self._stacked(
+                segments, S, D, col, "val", fetch_values, vdt)
 
-        # per-leaf predicate parameters
+        # per-leaf predicate parameters (cached: ctx.filter is a frozen
+        # expression tree, so it keys the resolved literals exactly)
+        pkey = (_batch_id(segments), plan, ctx.filter, S)
+        if len(self._params_cache) > 4096:
+            self._params_cache.clear()
+        cached = self._params_cache.get(pkey)
+        if cached is not None:
+            cparams, cnum_docs = cached
+            params.update(cparams)
+            return cols, params, cnum_docs, S_real, D
         leaf_exprs = self._collect_leaf_exprs(ctx.filter, plan) \
             if ctx.filter is not None else []
         for i, (leaf, expr) in enumerate(zip(plan.leaves, leaf_exprs)):
@@ -392,27 +427,64 @@ class TpuOperatorExecutor:
 
         num_docs = np.zeros(S, dtype=np.int32)
         num_docs[:S_real] = [s.num_docs for s in segments]
-        return cols, params, self._put(num_docs), S_real, D
+        num_docs_dev = self._put(num_docs)
+        leaf_params = {k: v for k, v in params.items() if k.startswith("leaf")}
+        self._params_cache[pkey] = (leaf_params, num_docs_dev)
+        return cols, params, num_docs_dev, S_real, D
 
     def _stacked(self, segments, S, D, col, kind, fetch, dtype):
-        """Stacked per-segment column block, cached on each segment."""
+        """Stacked per-segment column block, DEVICE-resident and cached
+        across queries keyed by the segment batch (the HBM segment cache of
+        SURVEY.md §7.5 — re-uploading ~GB blocks per query would make the
+        device path slower than the host scan it replaces)."""
+        batch_key = (_batch_id(segments), kind, col, S, D, np.dtype(dtype).str)
+        cached = self._block_cache.get(batch_key)
+        if cached is not None:
+            self._block_cache.move_to_end(batch_key)  # LRU touch
+            return cached
         rows = []
         for seg in segments:
-            cache = seg.__dict__.setdefault("_device_stage_cache", {})
-            key = (kind, col, D)
-            arr = cache.get(key)
-            if arr is None:
-                if not seg.has_column(col):
-                    raise _NotStageable()
-                raw = fetch(seg.data_source(col))
-                arr = np.zeros(D, dtype=dtype)
-                arr[:len(raw)] = raw
-                cache[key] = arr
+            if not seg.has_column(col):
+                raise _NotStageable()
+            raw = fetch(seg.data_source(col))
+            arr = np.zeros(D, dtype=dtype)
+            arr[:len(raw)] = raw
             rows.append(arr)
         block = np.stack(rows) if len(rows) == S else \
             np.concatenate([np.stack(rows),
                             np.zeros((S - len(rows), D), dtype=dtype)])
-        return self._put(block)
+        out = self._put(block)
+        self._insert_block(batch_key, out, block.nbytes)
+        return out
+
+    def _insert_block(self, key, arr, nbytes: int) -> None:
+        self._block_cache[key] = arr
+        self._block_bytes[key] = nbytes
+        self._cache_bytes += nbytes
+        while self._cache_bytes > self.cache_budget_bytes and len(self._block_cache) > 1:
+            old_key, old_arr = self._block_cache.popitem(last=False)
+            self._cache_bytes -= self._block_bytes.pop(old_key)
+            try:
+                old_arr.delete()  # free HBM eagerly
+            except Exception:  # noqa: BLE001 — best-effort
+                pass
+
+    def _check_value_precision(self, segments, col: str, vdt) -> None:
+        """float32 staging (x64 off, the TPU default) is exact only for
+        integers with |v| <= 2^24; larger int/long columns (e.g. epoch
+        millis) would silently round, so they fall back to the exact-f64
+        host path. Float columns stay f32: they are approximate either way.
+        """
+        if vdt is np.float64:
+            return
+        for seg in segments:
+            m = seg.metadata.columns.get(col)
+            if m is None or m.data_type.np_dtype.kind not in "iu":
+                continue
+            lo, hi = m.min_value, m.max_value
+            if lo is None or hi is None or \
+                    max(abs(int(lo)), abs(int(hi))) > (1 << 24):
+                raise _NotStageable()
 
     def _put(self, arr: np.ndarray):
         if self._mesh is None:
@@ -452,40 +524,49 @@ class TpuOperatorExecutor:
 
     # ------------------------------------------------------------------
     def _assemble(self, segments, ctx: QueryContext, plan: DevicePlan,
-                  out: Dict[str, np.ndarray], S_real: int,
+                  packed: np.ndarray, S_real: int,
                   mappings: List[Dict[str, int]]) -> List[Any]:
         filter_cols = len(set(ctx.filter_columns()))
+        # parity with executor_cpu: COUNT(*) materializes no column, so it
+        # doesn't contribute to entries-scanned-post-filter
+        n_valued_aggs = sum(
+            1 for node in ctx.aggregations
+            if node.args and not (isinstance(node.args[0], Identifier)
+                                  and node.args[0].name == "*"))
+        count_j = None
+        if plan.num_groups:
+            for j, (op, _vidx) in enumerate(plan.agg_ops):
+                if op == "count":
+                    count_j = j
+                    break
+            assert count_j is not None  # _plan guarantees a count slot
         results = []
         for s, seg in enumerate(segments[:S_real]):
-            matched = int(out["matched"][s])
+            if plan.num_groups:
+                matched = int(round(float(packed[s, :, count_j].sum())))
+            else:
+                matched = int(round(float(packed[s, 0])))
             stats = ExecutionStats(
                 num_docs_scanned=matched,
                 num_entries_scanned_in_filter=(
                     seg.num_docs * filter_cols if ctx.filter is not None else 0),
-                num_entries_scanned_post_filter=matched * len(ctx.aggregations),
+                num_entries_scanned_post_filter=matched * n_valued_aggs,
                 num_segments_processed=1,
                 num_segments_matched=1 if matched else 0,
                 total_docs=seg.num_docs)
             if plan.num_groups:
                 results.append(self._assemble_group(
-                    seg, s, ctx, plan, out, mappings, stats))
+                    seg, s, ctx, plan, packed, count_j, mappings, stats))
             else:
                 inters = []
                 for fn, mapping in zip(ctx.agg_functions, mappings):
-                    slots = {op: out[f"slot{j}"][s] for op, j in mapping.items()}
+                    slots = {op: packed[s, 1 + j] for op, j in mapping.items()}
                     inters.append(fn.from_device_slots(slots))
                 results.append(AggregationResult(inters, stats))
         return results
 
-    def _assemble_group(self, seg, s, ctx, plan, out, mappings, stats):
-        # find any count slot to detect present groups
-        count_j = None
-        for j, (op, vidx) in enumerate(plan.agg_ops):
-            if op == "count":
-                count_j = j
-                break
-        assert count_j is not None  # _plan guarantees a count slot
-        present = np.nonzero(out[f"slot{count_j}"][s] > 0)[0]
+    def _assemble_group(self, seg, s, ctx, plan, packed, count_j, mappings, stats):
+        present = np.nonzero(packed[s, :, count_j] > 0)[0]
 
         # decode combined keys (mixed radix) -> per-column local dictIds
         dicts = [seg.data_source(c).dictionary for c in plan.group_cols]
@@ -507,10 +588,16 @@ class TpuOperatorExecutor:
             key = tuple(_py(col[gi]) for col in key_cols)
             inters = []
             for fn, mapping in zip(ctx.agg_functions, mappings):
-                slots = {op: out[f"slot{j}"][s][g] for op, j in mapping.items()}
+                slots = {op: packed[s, g, j] for op, j in mapping.items()}
                 inters.append(fn.from_device_slots(slots))
             groups[key] = inters
         return GroupByResult(groups, stats)
+
+
+def _batch_id(segments) -> tuple:
+    """Identity of a segment batch: id() alone can be reused after GC, so
+    pair it with the segment name."""
+    return tuple((id(s), s.name) for s in segments)
 
 
 class _NotStageable(Exception):

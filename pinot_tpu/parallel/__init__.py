@@ -1,0 +1,13 @@
+"""Device-mesh parallelism: sharded segment batches + collective combines.
+
+The TPU-native re-expression of the reference's parallelism inventory
+(SURVEY.md §2.6): intra-server per-segment fan-out becomes a `segments`
+mesh axis (DP analog); within-segment doc-block iteration becomes a `docs`
+mesh axis (SP analog) with psum combines over ICI; scatter-gather across
+servers stays host-side (broker), and multi-stage shuffles map to
+collective all-to-alls (phase 2+).
+"""
+from pinot_tpu.parallel.mesh import make_mesh, segment_sharding
+from pinot_tpu.parallel.distributed_query import distributed_query_step
+
+__all__ = ["make_mesh", "segment_sharding", "distributed_query_step"]

@@ -1,0 +1,40 @@
+"""Mesh construction helpers.
+
+A query mesh has two axes:
+  `segments` — data parallelism over stacked segments (the analog of the
+               reference's CombinePlanNode thread fan-out and of broker
+               scatter-gather, SURVEY.md §2.6 rows 1-2)
+  `docs`     — sequence parallelism within a segment's doc dimension (the
+               long-context axis; partial aggregates combine with psum
+               over ICI rather than host merges)
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(devices: Optional[Sequence] = None,
+              doc_axis: int = 1) -> Mesh:
+    """Mesh over (segments, docs). doc_axis devices are dedicated to the
+    intra-segment doc dimension; the rest to the segment axis."""
+    devices = list(devices) if devices is not None else jax.devices()
+    n = len(devices)
+    if doc_axis < 1 or n % doc_axis != 0:
+        raise ValueError(f"doc_axis {doc_axis} must divide device count {n}")
+    arr = np.array(devices).reshape(n // doc_axis, doc_axis)
+    return Mesh(arr, ("segments", "docs"))
+
+
+def segment_sharding(mesh: Mesh, ndim: int) -> NamedSharding:
+    """[S, ...] arrays sharded over the segments axis only."""
+    return NamedSharding(mesh, P("segments", *([None] * (ndim - 1))))
+
+
+def block_sharding(mesh: Mesh) -> NamedSharding:
+    """[S, D] blocks sharded over both axes."""
+    return NamedSharding(mesh, P("segments", "docs"))

@@ -46,7 +46,8 @@ class CountAggregation(AggregationFunction):
         return 0
 
     def from_device_slots(self, slots):
-        return int(slots["count"])
+        # device counts arrive in the value dtype (single packed output)
+        return int(round(float(slots["count"])))
 
     @property
     def result_name(self):
@@ -156,7 +157,7 @@ class AvgAggregation(AggregationFunction):
         return s / c if c else float("-inf")  # ref returns NEGATIVE_INFINITY
 
     def from_device_slots(self, slots):
-        return (float(slots["sum"]), int(slots["count"]))
+        return (float(slots["sum"]), int(round(float(slots["count"]))))
 
 
 @register

@@ -27,10 +27,13 @@ class QueryExecutor:
     """Executes queries over a set of loaded segments (one 'server')."""
 
     def __init__(self, segments: Sequence[ImmutableSegment],
-                 use_tpu: bool = True, max_threads: int = 8):
+                 use_tpu: bool = True, max_threads: int = 8, engine=None):
+        """engine: a shared TpuOperatorExecutor. Long-lived callers (the
+        server) MUST pass one — the engine owns the HBM block cache, and a
+        per-request engine would re-upload every column on every query."""
         self.segments = list(segments)
         self.max_threads = max_threads
-        self._tpu_engine = None
+        self._tpu_engine = engine
         self._use_tpu = use_tpu
 
     @property
@@ -53,12 +56,18 @@ class QueryExecutor:
                 prune_stats.total_docs += seg.num_docs
         results: List[Any] = []
 
-        remaining = selected
-        if self._use_tpu and selected:
+        # consuming (mutable) segments always run host-side: their columns
+        # are unsorted-dict/append buffers, not stageable blocks
+        device_candidates = [s for s in selected
+                             if isinstance(s, ImmutableSegment)]
+        host_only = [s for s in selected if not isinstance(s, ImmutableSegment)]
+        remaining = device_candidates
+        if self._use_tpu and device_candidates:
             engine = self.tpu_engine
             if engine is not None and engine.supports(ctx):
-                device_results, remaining = engine.execute(selected, ctx)
+                device_results, remaining = engine.execute(device_candidates, ctx)
                 results.extend(device_results)
+        remaining = list(remaining) + host_only
         if remaining:
             if len(remaining) == 1:
                 results.append(executor_cpu.execute_segment(remaining[0], ctx))

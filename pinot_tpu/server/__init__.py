@@ -1,0 +1,8 @@
+"""Server role: segment data managers, query scheduler, transport.
+
+Reference parity: pinot-server + the server-side parts of pinot-core L4/L5
+(SURVEY.md): InstanceRequestHandler (core/transport/
+InstanceRequestHandler.java:122), QueryScheduler (query/scheduler/
+QueryScheduler.java:93), InstanceDataManager/TableDataManager
+(core/data/manager/).
+"""

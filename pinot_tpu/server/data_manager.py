@@ -1,0 +1,139 @@
+"""Segment data managers: server-side table/segment lifecycle.
+
+Reference parity: pinot-core data/manager/ — InstanceDataManager ->
+TableDataManager -> SegmentDataManager with acquire/release reference
+counting (BaseTableDataManager.acquireSegments / releaseSegment), so a
+segment directory is never deleted under a running query.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+from typing import Dict, List, Optional, Sequence
+
+from pinot_tpu.segment.loader import ImmutableSegment, load_segment
+
+
+class SegmentDataManager:
+    def __init__(self, segment: ImmutableSegment):
+        self.segment = segment
+        self._refs = 1  # the manager's own reference
+        self._lock = threading.Lock()
+        self._destroyed = False
+
+    @property
+    def name(self) -> str:
+        return self.segment.name
+
+    def acquire(self) -> bool:
+        with self._lock:
+            if self._destroyed:
+                return False
+            self._refs += 1
+            return True
+
+    def release(self) -> None:
+        destroy = False
+        with self._lock:
+            self._refs -= 1
+            destroy = self._refs == 0 and self._destroyed
+        if destroy:
+            self.segment.destroy()
+
+    def offload(self) -> None:
+        """Drop the manager's own reference; destroys once queries drain."""
+        destroy = False
+        with self._lock:
+            if not self._destroyed:
+                self._destroyed = True
+                self._refs -= 1
+                destroy = self._refs == 0
+        if destroy:
+            self.segment.destroy()
+
+
+class TableDataManager:
+    """Ref BaseTableDataManager — one per table on a server."""
+
+    def __init__(self, table_name: str):
+        self.table_name = table_name
+        self._segments: Dict[str, SegmentDataManager] = {}
+        self._lock = threading.Lock()
+
+    def add_segment(self, segment: ImmutableSegment) -> None:
+        sdm = SegmentDataManager(segment)
+        with self._lock:
+            old = self._segments.get(segment.name)
+            self._segments[segment.name] = sdm
+        if old is not None:
+            old.offload()
+
+    def add_segment_from_dir(self, seg_dir: str) -> None:
+        self.add_segment(load_segment(seg_dir))
+
+    def remove_segment(self, name: str) -> None:
+        with self._lock:
+            sdm = self._segments.pop(name, None)
+        if sdm is not None:
+            sdm.offload()
+
+    def acquire_segments(self, names: Optional[Sequence[str]] = None
+                         ) -> List[SegmentDataManager]:
+        """Acquire the named segments (or all); caller must release_all.
+        Missing names are silently skipped (ref returns missing list for
+        the broker to count as partial results)."""
+        out = []
+        with self._lock:
+            targets = (self._segments.values() if names is None else
+                       [self._segments[n] for n in names if n in self._segments])
+            for sdm in list(targets):
+                if sdm.acquire():
+                    out.append(sdm)
+        return out
+
+    @staticmethod
+    def release_all(sdms: List[SegmentDataManager]) -> None:
+        for sdm in sdms:
+            sdm.release()
+
+    @property
+    def segment_names(self) -> List[str]:
+        with self._lock:
+            return list(self._segments.keys())
+
+    def shutdown(self) -> None:
+        with self._lock:
+            sdms = list(self._segments.values())
+            self._segments.clear()
+        for sdm in sdms:
+            sdm.offload()
+
+
+class InstanceDataManager:
+    """Ref InstanceDataManager — all tables on one server instance."""
+
+    def __init__(self, instance_id: str = "server_0"):
+        self.instance_id = instance_id
+        self._tables: Dict[str, TableDataManager] = {}
+        self._lock = threading.Lock()
+
+    def table(self, table_name: str, create: bool = True) -> Optional[TableDataManager]:
+        with self._lock:
+            tdm = self._tables.get(table_name)
+            if tdm is None and create:
+                tdm = TableDataManager(table_name)
+                self._tables[table_name] = tdm
+            return tdm
+
+    @property
+    def table_names(self) -> List[str]:
+        with self._lock:
+            return list(self._tables.keys())
+
+    def shutdown(self) -> None:
+        with self._lock:
+            tables = list(self._tables.values())
+            self._tables.clear()
+        for t in tables:
+            t.shutdown()

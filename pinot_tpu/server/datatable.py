@@ -1,0 +1,277 @@
+"""DataTable: the server->broker wire format.
+
+Reference parity: pinot-common datatable/DataTableImplV4.java:82 — the
+binary container a server returns per query: result payload + metadata
+(stats) + exceptions. The reference serializes aggregation intermediates
+with a typed ObjectSerDe registry; same approach here (tag byte + typed
+payload, numpy-backed), deliberately NOT pickle: the broker must never
+execute payload-controlled code.
+
+Layout: 4-byte magic 'PDT1', then a tagged value tree:
+  N null | i int64 | f float64 | s utf-8 str | b bytes | T/F bool
+  D Decimal(str)  | t tuple | l list | S set | M dict
+  A numpy array (dtype str, ndim, shape, raw bytes)
+  H HyperLogLog (log2m + registers) | G TDigest (compression, means, weights)
+  R result container (shape tag + fields)
+"""
+from __future__ import annotations
+
+import struct
+from decimal import Decimal
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+from pinot_tpu.query.aggregation.sketches import HyperLogLog, TDigest
+from pinot_tpu.query.results import (
+    AggregationResult, DistinctResult, ExecutionStats, GroupByResult,
+    SelectionResult)
+
+MAGIC = b"PDT1"
+
+_I64 = struct.Struct("<q")
+_F64 = struct.Struct("<d")
+_U32 = struct.Struct("<I")
+
+
+class _Writer:
+    def __init__(self):
+        self.parts: List[bytes] = []
+
+    def u32(self, v: int):
+        self.parts.append(_U32.pack(v))
+
+    def raw(self, b: bytes):
+        self.parts.append(b)
+
+    def tag(self, t: str):
+        self.parts.append(t.encode())
+
+    def value(self, v: Any):
+        if v is None:
+            self.tag("N")
+        elif isinstance(v, bool):
+            self.tag("T" if v else "F")
+        elif isinstance(v, (int, np.integer)):
+            self.tag("i")
+            self.raw(_I64.pack(int(v)))
+        elif isinstance(v, (float, np.floating)):
+            self.tag("f")
+            self.raw(_F64.pack(float(v)))
+        elif isinstance(v, str):
+            b = v.encode()
+            self.tag("s")
+            self.u32(len(b))
+            self.raw(b)
+        elif isinstance(v, bytes):
+            self.tag("b")
+            self.u32(len(v))
+            self.raw(v)
+        elif isinstance(v, Decimal):
+            b = str(v).encode()
+            self.tag("D")
+            self.u32(len(b))
+            self.raw(b)
+        elif isinstance(v, tuple):
+            self.tag("t")
+            self.u32(len(v))
+            for x in v:
+                self.value(x)
+        elif isinstance(v, list):
+            self.tag("l")
+            self.u32(len(v))
+            for x in v:
+                self.value(x)
+        elif isinstance(v, (set, frozenset)):
+            self.tag("S")
+            self.u32(len(v))
+            for x in v:
+                self.value(x)
+        elif isinstance(v, dict):
+            self.tag("M")
+            self.u32(len(v))
+            for k, x in v.items():
+                self.value(k)
+                self.value(x)
+        elif isinstance(v, np.ndarray):
+            self.tag("A")
+            if v.dtype.kind in "UO":  # store as list of strings
+                self.value([str(x) for x in v.tolist()])
+            else:
+                dt = v.dtype.str.encode()
+                self.u32(len(dt))
+                self.raw(dt)
+                self.u32(v.ndim)
+                for d in v.shape:
+                    self.u32(d)
+                self.raw(np.ascontiguousarray(v).tobytes())
+        elif isinstance(v, HyperLogLog):
+            self.tag("H")
+            self.u32(v.log2m)
+            self.raw(v.registers.tobytes())
+        elif isinstance(v, TDigest):
+            v._compress(force=True)
+            self.tag("G")
+            self.raw(_F64.pack(v.compression))
+            self.raw(_F64.pack(v.total))
+            self.value(v.means)
+            self.value(v.weights)
+        else:
+            raise TypeError(f"unserializable value type {type(v)}")
+
+    def bytes(self) -> bytes:
+        return b"".join(self.parts)
+
+
+class _Reader:
+    def __init__(self, buf: bytes, pos: int = 0):
+        self.buf = buf
+        self.pos = pos
+
+    def u32(self) -> int:
+        v = _U32.unpack_from(self.buf, self.pos)[0]
+        self.pos += 4
+        return v
+
+    def take(self, n: int) -> bytes:
+        b = self.buf[self.pos:self.pos + n]
+        self.pos += n
+        return b
+
+    def value(self) -> Any:
+        t = chr(self.buf[self.pos])
+        self.pos += 1
+        if t == "N":
+            return None
+        if t == "T":
+            return True
+        if t == "F":
+            return False
+        if t == "i":
+            v = _I64.unpack_from(self.buf, self.pos)[0]
+            self.pos += 8
+            return v
+        if t == "f":
+            v = _F64.unpack_from(self.buf, self.pos)[0]
+            self.pos += 8
+            return v
+        if t == "s":
+            return self.take(self.u32()).decode()
+        if t == "b":
+            return self.take(self.u32())
+        if t == "D":
+            return Decimal(self.take(self.u32()).decode())
+        if t == "t":
+            return tuple(self.value() for _ in range(self.u32()))
+        if t == "l":
+            return [self.value() for _ in range(self.u32())]
+        if t == "S":
+            return {self.value() for _ in range(self.u32())}
+        if t == "M":
+            return {self.value(): self.value() for _ in range(self.u32())}
+        if t == "A":
+            if chr(self.buf[self.pos]) == "l":  # string array stored as list
+                return np.array(self.value(), dtype=object)
+            dt = np.dtype(self.take(self.u32()).decode())
+            ndim = self.u32()
+            shape = tuple(self.u32() for _ in range(ndim))
+            n = int(np.prod(shape)) if shape else 1
+            arr = np.frombuffer(self.take(n * dt.itemsize), dtype=dt)
+            return arr.reshape(shape).copy()
+        if t == "H":
+            h = HyperLogLog(self.u32())
+            h.registers = np.frombuffer(self.take(h.m), dtype=np.uint8).copy()
+            return h
+        if t == "G":
+            comp = _F64.unpack_from(self.buf, self.pos)[0]
+            self.pos += 8
+            total = _F64.unpack_from(self.buf, self.pos)[0]
+            self.pos += 8
+            td = TDigest(comp)
+            td.total = total
+            td.means = self.value()
+            td.weights = self.value()
+            return td
+        raise ValueError(f"bad tag {t!r} at {self.pos - 1}")
+
+
+def _stats_tuple(s: ExecutionStats) -> tuple:
+    return (s.num_docs_scanned, s.num_entries_scanned_in_filter,
+            s.num_entries_scanned_post_filter, s.num_segments_processed,
+            s.num_segments_matched, s.total_docs, s.num_segments_pruned)
+
+
+def _stats_from(t: tuple) -> ExecutionStats:
+    return ExecutionStats(*t)
+
+
+def serialize_results(results: List[Any], exceptions: List[dict] = ()) -> bytes:
+    """Server response: list of shape-tagged SegmentResults + exceptions."""
+    w = _Writer()
+    w.raw(MAGIC)
+    w.value([_exc_tuple(e) for e in exceptions])
+    w.u32(len(results))
+    for r in results:
+        if isinstance(r, AggregationResult):
+            w.tag("1")
+            w.value(r.intermediates)
+            w.value(_stats_tuple(r.stats))
+        elif isinstance(r, GroupByResult):
+            w.tag("2")
+            w.value(r.groups)
+            w.value(_stats_tuple(r.stats))
+            w.value(r.num_groups_limit_reached)
+        elif isinstance(r, SelectionResult):
+            w.tag("3")
+            w.value(r.rows)
+            w.value(r.order_values)
+            w.value(r.columns)
+            w.value(_stats_tuple(r.stats))
+        elif isinstance(r, DistinctResult):
+            w.tag("4")
+            w.value(r.rows)
+            w.value(_stats_tuple(r.stats))
+        else:
+            raise TypeError(f"unserializable result {type(r)}")
+    return w.bytes()
+
+
+def deserialize_results(buf: bytes) -> Tuple[List[Any], List[dict]]:
+    if buf[:4] != MAGIC:
+        raise ValueError("bad DataTable magic")
+    r = _Reader(buf, 4)
+    exceptions = [_exc_from(t) for t in r.value()]
+    n = r.u32()
+    out: List[Any] = []
+    for _ in range(n):
+        tag = chr(r.buf[r.pos])
+        r.pos += 1
+        if tag == "1":
+            inters = r.value()
+            out.append(AggregationResult(inters, _stats_from(r.value())))
+        elif tag == "2":
+            groups = r.value()
+            stats = _stats_from(r.value())
+            out.append(GroupByResult(groups, stats,
+                                     num_groups_limit_reached=r.value()))
+        elif tag == "3":
+            rows = r.value()
+            order_values = r.value()
+            columns = r.value()
+            out.append(SelectionResult(rows, order_values=order_values,
+                                       columns=columns,
+                                       stats=_stats_from(r.value())))
+        elif tag == "4":
+            rows = r.value()
+            out.append(DistinctResult(rows, _stats_from(r.value())))
+        else:
+            raise ValueError(f"bad result tag {tag!r}")
+    return out, exceptions
+
+
+def _exc_tuple(e: dict) -> tuple:
+    return (int(e.get("errorCode", 200)), str(e.get("message", "")))
+
+
+def _exc_from(t: tuple) -> dict:
+    return {"errorCode": t[0], "message": t[1]}

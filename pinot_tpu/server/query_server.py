@@ -1,0 +1,205 @@
+"""Server transport + per-server query execution.
+
+Reference parity: pinot-core transport — QueryServer (Netty) +
+InstanceRequestHandler.channelRead0 (transport/InstanceRequestHandler.java:122)
++ QueryScheduler.submit (query/scheduler/QueryScheduler.java:93). Here:
+an asyncio TCP server speaking length-prefixed frames:
+
+  request : u32 len | JSON {requestId, tableName, sql, segments?: [...]}
+  response: u32 len | DataTable bytes (server/datatable.py)
+
+Execution itself reuses QueryExecutor (pruning + device engine + host
+fallback) over the acquired segments; a thread pool keeps the event loop
+free (FCFS scheduling, the QuerySchedulerFactory default).
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import socket
+import struct
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import List, Optional
+
+from pinot_tpu.query.context import QueryContext
+from pinot_tpu.query.executor import QueryExecutor
+from pinot_tpu.server import datatable
+from pinot_tpu.server.data_manager import InstanceDataManager, TableDataManager
+
+_LEN = struct.Struct("<I")
+
+
+class ServerQueryExecutor:
+    """Ref ServerQueryExecutorV1Impl: executes one query over this server's
+    segments for a table."""
+
+    def __init__(self, data_manager: InstanceDataManager, use_tpu: bool = True):
+        self.data_manager = data_manager
+        self.use_tpu = use_tpu
+        #: ONE engine for the server's lifetime — it owns the HBM block
+        #: cache, which must survive across requests
+        self._engine = None
+        self._engine_lock = threading.Lock()
+
+    def _shared_engine(self):
+        if not self.use_tpu:
+            return None
+        with self._engine_lock:
+            if self._engine is None:
+                from pinot_tpu.ops.engine import TpuOperatorExecutor
+                self._engine = TpuOperatorExecutor()
+            return self._engine
+
+    def execute(self, table_name: str, sql_or_ctx, segments: Optional[List[str]] = None):
+        """Returns serialized DataTable bytes."""
+        try:
+            ctx = (sql_or_ctx if isinstance(sql_or_ctx, QueryContext)
+                   else QueryContext.from_sql(sql_or_ctx))
+            tdm = self.data_manager.table(table_name, create=False)
+            if tdm is None:
+                return datatable.serialize_results(
+                    [], [{"errorCode": 190, "message": f"table {table_name} not found"}])
+            sdms = tdm.acquire_segments(segments)
+            try:
+                ex = QueryExecutor([s.segment for s in sdms],
+                                   use_tpu=self.use_tpu,
+                                   engine=self._shared_engine())
+                results, prune_stats = ex.execute_context(ctx)
+                if results:
+                    results[0].stats.merge(prune_stats)
+                return datatable.serialize_results(results)
+            finally:
+                TableDataManager.release_all(sdms)
+        except Exception as e:  # noqa: BLE001 — server must answer, not die
+            return datatable.serialize_results(
+                [], [{"errorCode": 200, "message": f"{type(e).__name__}: {e}"}])
+
+
+class QueryServer:
+    """Asyncio TCP server (the Netty QueryServer analog)."""
+
+    def __init__(self, executor: ServerQueryExecutor, host: str = "127.0.0.1",
+                 port: int = 0, num_threads: int = 8):
+        self.executor = executor
+        self.host = host
+        self.port = port
+        self._pool = ThreadPoolExecutor(max_workers=num_threads)
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread: Optional[threading.Thread] = None
+        self._started = threading.Event()
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                hdr = await reader.readexactly(4)
+                n = _LEN.unpack(hdr)[0]
+                payload = await reader.readexactly(n)
+                req = json.loads(payload)
+                loop = asyncio.get_running_loop()
+                resp = await loop.run_in_executor(
+                    self._pool, self.executor.execute,
+                    req["tableName"], req["sql"], req.get("segments"))
+                writer.write(_LEN.pack(len(resp)) + resp)
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        finally:
+            writer.close()
+
+    def start(self) -> None:
+        """Start serving on a background thread; sets self.port."""
+        def run():
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            self._loop = loop
+
+            async def main():
+                self._server = await asyncio.start_server(
+                    self._handle, self.host, self.port)
+                self.port = self._server.sockets[0].getsockname()[1]
+                self._started.set()
+                async with self._server:
+                    await self._server.serve_forever()
+
+            try:
+                loop.run_until_complete(main())
+            except asyncio.CancelledError:
+                pass
+            finally:
+                loop.close()
+
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name=f"query-server-{self.port}")
+        self._thread.start()
+        if not self._started.wait(10):
+            raise RuntimeError("query server failed to start")
+
+    def stop(self) -> None:
+        if self._loop is not None:
+            def shutdown():
+                for task in asyncio.all_tasks(self._loop):
+                    task.cancel()
+            self._loop.call_soon_threadsafe(shutdown)
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self._pool.shutdown(wait=False)
+
+
+class ServerConnection:
+    """Broker-side long-lived channel to one server (ref ServerChannels:65)."""
+
+    def __init__(self, host: str, port: int):
+        self.host, self.port = host, port
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+
+    def _connect(self) -> socket.socket:
+        if self._sock is None:
+            self._sock = socket.create_connection((self.host, self.port), timeout=30)
+        return self._sock
+
+    def request(self, table_name: str, sql: str,
+                segments: Optional[List[str]] = None,
+                request_id: int = 0) -> bytes:
+        payload = json.dumps({
+            "requestId": request_id, "tableName": table_name, "sql": sql,
+            "segments": segments}).encode()
+        with self._lock:
+            try:
+                sock = self._connect()
+                sock.sendall(_LEN.pack(len(payload)) + payload)
+                return self._read_frame(sock)
+            except (ConnectionError, socket.timeout):
+                # one reconnect attempt (ref channel re-establish)
+                self.close()
+                sock = self._connect()
+                sock.sendall(_LEN.pack(len(payload)) + payload)
+                return self._read_frame(sock)
+
+    @staticmethod
+    def _read_frame(sock: socket.socket) -> bytes:
+        hdr = b""
+        while len(hdr) < 4:
+            chunk = sock.recv(4 - len(hdr))
+            if not chunk:
+                raise ConnectionError("server closed connection")
+            hdr += chunk
+        n = _LEN.unpack(hdr)[0]
+        buf = bytearray()
+        while len(buf) < n:
+            chunk = sock.recv(min(1 << 20, n - len(buf)))
+            if not chunk:
+                raise ConnectionError("server closed connection mid-frame")
+            buf += chunk
+        return bytes(buf)
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None

@@ -1,0 +1,176 @@
+"""Cluster integration: broker -> TCP -> servers -> reduce (the embedded
+ClusterTest analog, SURVEY.md §4.4) plus DataTable serde round-trips."""
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pinot_tpu.cluster.mini import MiniCluster
+from pinot_tpu.query.results import (
+    AggregationResult, DistinctResult, ExecutionStats, GroupByResult,
+    SelectionResult)
+from pinot_tpu.query.aggregation.sketches import HyperLogLog, TDigest
+from pinot_tpu.server import datatable
+from tests.queries.harness import (
+    build_segments, synthetic_columns, synthetic_schema, synthetic_table_config)
+
+NUM_DOCS = 1000
+
+
+class TestDataTableSerde:
+    def test_aggregation_roundtrip(self):
+        hll = HyperLogLog(8)
+        hll.add_array(np.arange(500))
+        td = TDigest(100.0)
+        td.add_array(np.random.default_rng(0).random(1000))
+        r = AggregationResult(
+            [1.5, 42, (3.0, 7), {"a": 1}, {1, 2, 3}, hll, td, None, "x"],
+            ExecutionStats(num_docs_scanned=10, total_docs=100))
+        buf = datatable.serialize_results([r])
+        [out], exc = datatable.deserialize_results(buf)
+        assert exc == []
+        assert out.intermediates[0] == 1.5
+        assert out.intermediates[1] == 42
+        assert tuple(out.intermediates[2]) == (3.0, 7)
+        assert out.intermediates[3] == {"a": 1}
+        assert out.intermediates[4] == {1, 2, 3}
+        assert out.intermediates[5].cardinality() == hll.cardinality()
+        assert abs(out.intermediates[6].quantile(0.5) - td.quantile(0.5)) < 1e-9
+        assert out.intermediates[7] is None
+        assert out.intermediates[8] == "x"
+        assert out.stats.num_docs_scanned == 10
+        assert out.stats.total_docs == 100
+
+    def test_group_by_roundtrip(self):
+        r = GroupByResult({("a", 1): [1.0, 2], ("b", 2): [3.0, 4]},
+                          ExecutionStats(), num_groups_limit_reached=True)
+        buf = datatable.serialize_results([r])
+        [out], _ = datatable.deserialize_results(buf)
+        assert out.groups == r.groups
+        assert out.num_groups_limit_reached is True
+
+    def test_selection_roundtrip(self):
+        r = SelectionResult([(1, "x"), (2, "y")],
+                            order_values=[(1,), (2,)],
+                            columns=["a", "b"], stats=ExecutionStats())
+        buf = datatable.serialize_results([r])
+        [out], _ = datatable.deserialize_results(buf)
+        assert out.rows == r.rows
+        assert out.order_values == r.order_values
+        assert out.columns == ["a", "b"]
+
+    def test_exceptions(self):
+        buf = datatable.serialize_results(
+            [], [{"errorCode": 190, "message": "no table"}])
+        results, exc = datatable.deserialize_results(buf)
+        assert results == []
+        assert exc == [{"errorCode": 190, "message": "no table"}]
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("cluster")
+    data = [synthetic_columns(NUM_DOCS, seed=7 + i) for i in range(4)]
+    segs = build_segments(tmp, synthetic_schema(), synthetic_table_config(), data)
+    c = MiniCluster(num_servers=2)
+    c.start(with_http=True)
+    c.add_table("testTable")
+    for i, seg in enumerate(segs):
+        c.add_segment("testTable", seg, server_idx=i % 2)
+    yield c, data
+    c.stop()
+
+
+class TestMiniCluster:
+    def test_count_star(self, cluster):
+        c, data = cluster
+        resp = c.query("SELECT COUNT(*) FROM testTable")
+        assert resp.rows[0][0] == NUM_DOCS * 4
+        assert resp.num_servers_queried == 2
+        assert resp.num_servers_responded == 2
+        assert resp.stats.num_segments_processed == 4
+
+    def test_filtered_agg_across_servers(self, cluster):
+        c, data = cluster
+        v = np.concatenate([d["intCol"] for d in data])
+        resp = c.query("SELECT SUM(intCol), MAX(intCol) FROM testTable "
+                       "WHERE intCol >= 500")
+        assert resp.rows[0][0] == pytest.approx(float(v[v >= 500].sum()))
+        assert resp.rows[0][1] == pytest.approx(float(v.max()))
+
+    def test_group_by_across_servers(self, cluster):
+        c, data = cluster
+        g = np.concatenate([np.asarray(d["groupCol"]) for d in data])
+        resp = c.query("SELECT groupCol, COUNT(*) FROM testTable "
+                       "GROUP BY groupCol ORDER BY groupCol LIMIT 100")
+        from collections import Counter
+        counts = Counter(g.tolist())
+        assert {r[0]: r[1] for r in resp.rows} == dict(counts)
+
+    def test_distinctcount_merge(self, cluster):
+        c, data = cluster
+        s = np.concatenate([np.asarray(d["stringCol"]) for d in data])
+        resp = c.query("SELECT DISTINCTCOUNT(stringCol) FROM testTable")
+        assert resp.rows[0][0] == len(np.unique(s))
+
+    def test_selection_order_by(self, cluster):
+        c, data = cluster
+        v = np.concatenate([d["intCol"] for d in data])
+        resp = c.query("SELECT intCol FROM testTable ORDER BY intCol DESC LIMIT 5")
+        assert [r[0] for r in resp.rows] == np.sort(v)[::-1][:5].tolist()
+
+    def test_unknown_table(self, cluster):
+        c, _ = cluster
+        resp = c.query("SELECT COUNT(*) FROM nope")
+        assert resp.exceptions and resp.exceptions[0]["errorCode"] == 190
+
+    def test_parse_error(self, cluster):
+        c, _ = cluster
+        resp = c.query("SELEC broken")
+        assert resp.exceptions and resp.exceptions[0]["errorCode"] == 150
+
+    def test_http_endpoint(self, cluster):
+        c, _ = cluster
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{c.http.port}/query/sql",
+            data=json.dumps({"sql": "SELECT COUNT(*) FROM testTable"}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as f:
+            body = json.loads(f.read())
+        assert body["resultTable"]["rows"][0][0] == NUM_DOCS * 4
+        assert body["numServersResponded"] == 2
+
+
+class TestHybridTable:
+    def test_time_boundary_split(self, tmp_path_factory):
+        tmp = tmp_path_factory.mktemp("hybrid")
+        from pinot_tpu.models import (DataType, FieldSpec, FieldType, Schema,
+                                      TableConfig, TableType)
+        schema = Schema("hybrid", [
+            FieldSpec("ts", DataType.LONG, FieldType.DATE_TIME),
+            FieldSpec("val", DataType.INT, FieldType.METRIC),
+        ])
+        tc = TableConfig("hybrid", TableType.OFFLINE)
+        tc.retention.time_column = "ts"
+        # offline: ts 0..99 (incl. overlap with realtime), realtime: ts 80..199
+        off = build_segments(tmp, schema, tc, [{
+            "ts": np.arange(0, 100, dtype=np.int64),
+            "val": np.ones(100, dtype=np.int32)}])[0]
+        rt = build_segments(tmp_path_factory.mktemp("hybrid_rt"), schema, tc, [{
+            "ts": np.arange(80, 200, dtype=np.int64),
+            "val": np.full(120, 2, dtype=np.int32)}])[0]
+        c = MiniCluster(num_servers=1)
+        c.start()
+        try:
+            c.add_table("hybrid", "OFFLINE", time_column="ts")
+            c.add_table("hybrid", "REALTIME", time_column="ts", time_boundary=99)
+            c.add_segment("hybrid", off, 0, "OFFLINE")
+            c.add_segment("hybrid", rt, 0, "REALTIME")
+            resp = c.query("SELECT COUNT(*), SUM(val) FROM hybrid")
+            # offline serves ts <= 99 (100 docs of val 1);
+            # realtime serves ts > 99 (100 docs of val 2) — overlap dropped
+            assert resp.rows[0][0] == 200
+            assert resp.rows[0][1] == pytest.approx(100 * 1 + 100 * 2)
+        finally:
+            c.stop()

@@ -1,0 +1,152 @@
+"""Realtime ingestion: stream -> mutable segment -> queryable -> sealed
+(the LLCRealtimeClusterIntegrationTest analog, SURVEY.md §3.3)."""
+import time
+
+import numpy as np
+import pytest
+
+from pinot_tpu.ingest import (
+    InMemoryStream, LongMsgOffset, MutableSegment, StreamConfig,
+    TransformPipeline)
+from pinot_tpu.ingest.realtime_manager import (
+    IngestionDelayTracker, RealtimeSegmentDataManager)
+from pinot_tpu.models import (DataType, FieldSpec, FieldType, IngestionConfig,
+                              Schema, TableConfig, TableType)
+from pinot_tpu.query.executor import QueryExecutor
+from pinot_tpu.server.data_manager import TableDataManager
+
+
+def make_schema():
+    return Schema("rt", [
+        FieldSpec("id", DataType.LONG, FieldType.DIMENSION),
+        FieldSpec("name", DataType.STRING, FieldType.DIMENSION),
+        FieldSpec("score", DataType.DOUBLE, FieldType.METRIC),
+    ])
+
+
+def make_config():
+    return TableConfig("rt", TableType.REALTIME)
+
+
+class TestMutableSegment:
+    def test_index_and_query(self):
+        seg = MutableSegment("rt__0__0__1", make_config(), make_schema())
+        for i in range(100):
+            seg.index({"id": i, "name": f"n{i % 5}", "score": float(i)})
+        assert seg.num_docs == 100
+        ex = QueryExecutor([seg], use_tpu=False)
+        r = ex.execute("SELECT COUNT(*), SUM(score) FROM rt WHERE id < 50")
+        assert r.rows[0][0] == 50
+        assert r.rows[0][1] == pytest.approx(sum(range(50)))
+        r = ex.execute("SELECT name, COUNT(*) FROM rt GROUP BY name "
+                       "ORDER BY name LIMIT 10")
+        assert len(r.rows) == 5
+        assert all(c == 20 for _, c in r.rows)
+
+    def test_null_handling(self):
+        seg = MutableSegment("rt__0__0__1", make_config(), make_schema())
+        seg.index({"id": 1, "name": None, "score": None})
+        ds = seg.data_source("score")
+        assert ds.null_value_vector is not None
+        assert ds.values()[0] == 0.0  # metric default
+
+    def test_snapshot_isolation(self):
+        seg = MutableSegment("rt__0__0__1", make_config(), make_schema())
+        for i in range(10):
+            seg.index({"id": i, "name": "x", "score": 1.0})
+        ds = seg.data_source("id")
+        seg.index({"id": 10, "name": "x", "score": 1.0})
+        assert len(ds.values()) == 10  # bound at snapshot time
+
+
+class TestTransformPipeline:
+    def test_filter_and_transform(self):
+        tc = make_config()
+        tc.ingestion = IngestionConfig(
+            transform_configs=[
+                {"columnName": "score", "transformFunction": "id * 2"}],
+            filter_function="id >= 100")
+        p = TransformPipeline(tc, make_schema())
+        assert p.transform({"id": 100, "name": "x"}) is None  # filtered out
+        out = p.transform({"id": 3, "name": "x"})
+        assert out["score"] == 6.0
+        assert isinstance(out["score"], float)
+
+    def test_type_coercion_and_defaults(self):
+        p = TransformPipeline(make_config(), make_schema())
+        out = p.transform({"id": "42", "name": 7})
+        assert out["id"] == 42
+        assert out["name"] == "7"
+        assert out["score"] is None  # nulls survive to creator default fill
+
+
+class TestRealtimeLifecycle:
+    def test_consume_seal_rotate(self, tmp_path):
+        topic = InMemoryStream("rt_topic", num_partitions=1)
+        try:
+            tdm = TableDataManager("rt_REALTIME")
+            commits = []
+            sc = StreamConfig(stream_type="inmemory", topic="rt_topic",
+                              flush_threshold_rows=100)
+            mgr = RealtimeSegmentDataManager(
+                make_config(), make_schema(), sc, 0, tdm, str(tmp_path),
+                on_commit=lambda name, off: commits.append((name, off)))
+            # publish 250 rows -> expect 2 sealed segments + 50 consuming
+            for i in range(250):
+                topic.publish({"id": i, "name": f"n{i % 3}", "score": i * 1.0})
+            mgr.start()
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                segs = [s.segment for s in tdm.acquire_segments()]
+                total = sum(s.num_docs for s in segs)
+                TableDataManager.release_all(
+                    [s for s in tdm.acquire_segments()])  # balance below
+                if total >= 250 and len(commits) >= 2:
+                    break
+                time.sleep(0.1)
+            mgr.stop()
+            assert len(commits) == 2, commits
+            # offsets checkpointed monotonically
+            assert commits[0][1] == LongMsgOffset(100)
+            assert commits[1][1] == LongMsgOffset(200)
+            # all 250 rows queryable across sealed + consuming segments
+            sdms = tdm.acquire_segments()
+            try:
+                ex = QueryExecutor([s.segment for s in sdms], use_tpu=False)
+                r = ex.execute("SELECT COUNT(*), SUM(id) FROM rt LIMIT 10")
+                assert r.rows[0][0] == 250
+                assert r.rows[0][1] == pytest.approx(sum(range(250)))
+            finally:
+                TableDataManager.release_all(sdms)
+        finally:
+            InMemoryStream.delete("rt_topic")
+
+    def test_restart_resumes_from_checkpoint(self, tmp_path):
+        topic = InMemoryStream("rt_topic2", num_partitions=1)
+        try:
+            tdm = TableDataManager("rt_REALTIME")
+            sc = StreamConfig(stream_type="inmemory", topic="rt_topic2",
+                              flush_threshold_rows=1000)
+            for i in range(100):
+                topic.publish({"id": i, "name": "a", "score": 1.0})
+            # simulate a committed checkpoint at offset 40: restart consumer
+            mgr = RealtimeSegmentDataManager(
+                make_config(), make_schema(), sc, 0, tdm, str(tmp_path),
+                start_offset=LongMsgOffset(40))
+            mgr.start()
+            deadline = time.time() + 15
+            while time.time() < deadline and mgr.mutable.num_docs < 60:
+                time.sleep(0.05)
+            mgr.stop()
+            assert mgr.mutable.num_docs == 60  # rows 40..99 only
+        finally:
+            InMemoryStream.delete("rt_topic2")
+
+
+class TestIngestionDelay:
+    def test_delay_tracking(self):
+        t = IngestionDelayTracker()
+        now_ms = int(time.time() * 1000)
+        t.record(0, now_ms - 5000)
+        assert t.delay_ms(0) == pytest.approx(5000, abs=2000)
+        assert t.delay_ms(1) is None
