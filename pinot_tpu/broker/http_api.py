@@ -28,13 +28,18 @@ class BrokerHttpServer:
             def do_GET(self):
                 if self.path == "/health":
                     body = b"OK"
-                    self.send_response(200)
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
+                elif self.path == "/metrics":
+                    from pinot_tpu.utils.metrics import get_registry
+                    body = get_registry("broker").prometheus_text().encode() \
+                        + get_registry("server").prometheus_text().encode()
                 else:
                     self.send_response(404)
                     self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
 
             def do_POST(self):
                 if self.path not in ("/query/sql", "/query"):
@@ -45,7 +50,9 @@ class BrokerHttpServer:
                 try:
                     req = json.loads(self.rfile.read(n))
                     sql = req["sql"]
-                except (json.JSONDecodeError, KeyError):
+                    if not isinstance(sql, str):
+                        raise TypeError("sql must be a string")
+                except (json.JSONDecodeError, KeyError, TypeError):
                     self.send_response(400)
                     self.end_headers()
                     return

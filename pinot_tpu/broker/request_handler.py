@@ -51,59 +51,48 @@ class BrokerRequestHandler:
         plan = route.route(ctx)
         request_id = self._next_id()
         futures = []
+        missing_servers = []
         for server, physical_table, segment_names, extra_filter in plan:
             conn = self.connections.get(server)
             if conn is None:
+                # a silently skipped server would return a clean-looking
+                # partial aggregate; surface it as a server error instead
+                missing_servers.append(server)
                 continue
-            server_sql = _rewrite_sql(sql, extra_filter)
+            # the time-boundary predicate travels as a separate field and is
+            # ANDed into the filter TREE server-side — splicing SQL text is
+            # unsound (keywords inside identifiers/literals)
             futures.append(self._pool.submit(
-                conn.request, physical_table, server_sql, segment_names,
-                request_id))
+                conn.request, physical_table, sql, segment_names,
+                request_id, extra_filter))
 
-        results, exceptions = [], []
+        results, exceptions, server_stats = [], [], []
+        for server in missing_servers:
+            exceptions.append({"errorCode": 427,
+                               "message": f"ServerNotConnected: {server}"})
         responded = 0
         for fut in futures:
             try:
                 payload = fut.result(timeout=60)
-                server_results, server_exc = datatable.deserialize_results(payload)
+                server_results, server_exc, extra = \
+                    datatable.deserialize_results(payload)
                 results.extend(server_results)
                 exceptions.extend(server_exc)
+                if extra is not None:
+                    server_stats.append(extra)
                 responded += 1
             except Exception as e:  # noqa: BLE001 — partial results semantics
                 exceptions.append(
                     {"errorCode": 427, "message": f"ServerError: {e}"})
 
         resp = reduce_results(ctx, results)
+        for extra in server_stats:
+            resp.stats.merge(extra)
         resp.exceptions = exceptions
-        resp.num_servers_queried = len(futures)
+        resp.num_servers_queried = len(futures) + len(missing_servers)
         resp.num_servers_responded = responded
         resp.time_used_ms = (time.time() - start) * 1000.0
         return resp
-
-
-def _rewrite_sql(sql: str, extra_filter: Optional[str]) -> str:
-    """AND the hybrid time-boundary predicate into the query text (the
-    reference rewrites the BrokerRequest filter tree; rewriting SQL keeps
-    the wire format one string)."""
-    if extra_filter is None:
-        return sql
-    q = parse_sql(sql)
-    # splice before GROUP/ORDER/LIMIT...: re-parse guarantees validity, so a
-    # textual rebuild is safe here
-    lowered = sql.lower()
-    idx = len(sql)
-    for kw in (" group by ", " having ", " order by ", " limit ", " option"):
-        j = lowered.find(kw)
-        if j != -1:
-            idx = min(idx, j)
-    head, tail = sql[:idx], sql[idx:]
-    if q.filter is None:
-        return f"{head} WHERE {extra_filter}{tail}"
-    # wrap existing WHERE in parens
-    widx = lowered.find(" where ")
-    head_before = sql[:widx]
-    cond = sql[widx + 7:idx]
-    return f"{head_before} WHERE ({cond}) AND {extra_filter}{tail}"
 
 
 def _error_response(code: int, message: str, start: float) -> BrokerResponse:

@@ -56,7 +56,12 @@ class RealtimeSegmentDataManager:
             start_offset = meta.start_offset(partition_id,
                                              stream_config.offset_criteria)
         self.current_offset = start_offset
+        self.error_count = 0
         self._seq = 0
+        #: index/seal mutual exclusion: a commit snapshots + swaps the
+        #: mutable segment; rows must not land in it concurrently or they
+        #: are lost while the checkpoint advances past them
+        self._seal_lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.mutable: Optional[MutableSegment] = None
@@ -95,23 +100,40 @@ class RealtimeSegmentDataManager:
                 time.sleep(1.0)
                 continue
             for msg in batch.messages:
-                rec = self.pipeline.transform(msg.value)
-                if rec is not None:
-                    self.mutable.index(rec)
-                # offset advances per message so a mid-batch commit
-                # checkpoints exactly the rows it sealed
-                self.current_offset = msg.offset.next()
+                try:
+                    with self._seal_lock:
+                        rec = self.pipeline.transform(msg.value)
+                        if rec is not None:
+                            self.mutable.index(rec)
+                        self.current_offset = msg.offset.next()
+                except Exception:  # noqa: BLE001 — one bad row must not
+                    # kill the partition consumer (ref: reference skips
+                    # untransformable rows and meters them)
+                    self.error_count += 1
+                    self.current_offset = msg.offset.next()  # skip poison row
+                    if self.error_count <= 10 or self.error_count % 1000 == 0:
+                        log.exception("skipping bad record at offset %s",
+                                      msg.offset)
                 if self.delay_tracker is not None and msg.timestamp_ms:
                     self.delay_tracker.record(self.partition_id, msg.timestamp_ms)
                 if self._end_criteria_reached():
-                    self._commit()
+                    self._try_commit()
             if batch.next_offset is not None:
                 self.current_offset = batch.next_offset
             if self._end_criteria_reached():
-                self._commit()
+                self._try_commit()
             if len(batch) == 0:
                 if self._stop.wait(0.05):
                     break
+
+    def _try_commit(self) -> None:
+        try:
+            with self._seal_lock:
+                self._commit()
+        except Exception:  # noqa: BLE001 — seal failure must not kill the
+            # consumer; the segment keeps consuming and the next criteria
+            # check retries the build
+            log.exception("segment commit failed; will retry")
 
     def _end_criteria_reached(self) -> bool:
         if self.mutable.num_docs >= self.stream_config.flush_threshold_rows:
@@ -139,8 +161,9 @@ class RealtimeSegmentDataManager:
 
     def force_commit(self) -> None:
         """Ops hook (ref forceCommit REST): seal now regardless of criteria."""
-        if self.mutable.num_docs > 0:
-            self._commit()
+        with self._seal_lock:
+            if self.mutable.num_docs > 0:
+                self._commit()
 
 
 class IngestionDelayTracker:

@@ -30,7 +30,15 @@ class _ScalarProvider:
 
     def column(self, name: str):
         v = self.record.get(name)
-        return np.array([v]) if not isinstance(v, (list, tuple)) else np.array([0])
+        if isinstance(v, (list, tuple)):
+            # MV field: keep the list as an object element — equality
+            # comparisons evaluate honestly and arithmetic raises (the
+            # per-record error guard skips+logs the row) instead of
+            # silently computing on a bogus 0
+            out = np.empty(1, dtype=object)
+            out[0] = list(v)
+            return out
+        return np.array([v])
 
     @property
     def num_docs(self) -> int:

@@ -16,6 +16,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Any, List, Optional, Sequence
 
 from pinot_tpu.query import executor_cpu
+from pinot_tpu.utils import tracing
 from pinot_tpu.query.context import QueryContext
 from pinot_tpu.query.pruner import prune_segments
 from pinot_tpu.query.reduce import BrokerResponse, reduce_results
@@ -83,9 +84,19 @@ class QueryExecutor:
         (the BaseQueriesTest.getBrokerResponse analog)."""
         start = time.time()
         ctx = QueryContext.from_sql(sql)
-        results, prune_stats = self.execute_context(ctx)
-        resp = reduce_results(ctx, results)
+        trace_on = ctx.options.get("trace", "false").lower() == "true"
+        req_trace = tracing.RequestTrace() if trace_on else None
+        if req_trace is not None:
+            with req_trace:
+                results, prune_stats = self.execute_context(ctx)
+                with tracing.Scope("BrokerReduce"):
+                    resp = reduce_results(ctx, results)
+        else:
+            results, prune_stats = self.execute_context(ctx)
+            resp = reduce_results(ctx, results)
         resp.stats.merge(prune_stats)
+        if req_trace is not None:
+            resp.trace = req_trace.to_dict()
         resp.num_servers_queried = resp.num_servers_responded = 1
         resp.time_used_ms = (time.time() - start) * 1000.0
         return resp

@@ -32,6 +32,24 @@ DEFAULT_NUM_GROUPS_LIMIT = 100_000
 
 def execute_segment(seg: ImmutableSegment, ctx: QueryContext):
     """Run one segment, returning the shape-appropriate SegmentResult."""
+    from pinot_tpu.utils import tracing
+    if tracing.active():
+        with tracing.Scope("SegmentExecutor", segment=seg.name) as scope:
+            result = _execute_segment(seg, ctx)
+            scope.set(numDocsScanned=result.stats.num_docs_scanned)
+            return result
+    return _execute_segment(seg, ctx)
+
+
+def _execute_segment(seg: ImmutableSegment, ctx: QueryContext):
+    # star-tree fast path (ref AggregationOperator._useStarTree): answer
+    # from pre-aggregated records when a tree fits the query shape
+    if ctx.aggregations and getattr(seg, "metadata", None) is not None \
+            and getattr(seg.metadata, "star_tree", None):
+        from pinot_tpu.query.startree_exec import execute_star_tree
+        result = execute_star_tree(seg, ctx)
+        if result is not None:
+            return result
     provider = SegmentColumnProvider(seg)
     mask = evaluate_filter(seg, ctx.filter, provider)
     stats = ExecutionStats(

@@ -290,6 +290,17 @@ class SegmentColumnProvider:
     def column(self, name: str) -> np.ndarray:
         return self._seg.data_source(name).values()
 
+    def mv_lists(self, name: str):
+        """Multi-value column as per-doc lists (for MV-aware transforms)."""
+        ds = self._seg.data_source(name)
+        offsets = ds.mv_offsets()
+        if ds.metadata.has_dictionary:
+            flat = ds.dictionary.get_values(ds.dict_ids())
+        else:
+            flat = ds.values()
+        return [flat[offsets[i]:offsets[i + 1]]
+                for i in range(len(offsets) - 1)]
+
     @property
     def num_docs(self) -> int:
         return self._seg.num_docs

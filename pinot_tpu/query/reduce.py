@@ -44,6 +44,7 @@ class BrokerResponse:
     num_servers_queried: int = 0
     num_servers_responded: int = 0
     num_groups_limit_reached: bool = False
+    trace: Optional[dict] = None  # operator trace tree when trace=true
 
     def to_dict(self) -> dict:
         d = {
@@ -61,6 +62,8 @@ class BrokerResponse:
             "numGroupsLimitReached": self.num_groups_limit_reached,
             "timeUsedMs": self.time_used_ms,
         }
+        if self.trace is not None:
+            d["traceInfo"] = self.trace
         return d
 
     @property

@@ -1,0 +1,205 @@
+"""Star-tree query execution: answer aggregations from pre-agg records.
+
+Reference parity: pinot-core core/startree/ — StarTreeUtils (fit check:
+aggregations must map to the tree's function-column pairs, filter must be
+an AND of predicates on split-order dims), StarTreeFilterOperator.java:90
+(traversal), StarTreeAggregationExecutor / StarTreeGroupByExecutor
+(aggregate the pre-agg metric columns over matched records). Used by
+executor_cpu.execute_segment when a segment has a fitting tree and the
+query doesn't disable it (option useStarTree=false).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from pinot_tpu.query.context import QueryContext
+from pinot_tpu.query.expressions import Expression, Function, Identifier
+from pinot_tpu.query.filter import resolve_predicate
+from pinot_tpu.query.results import AggregationResult, ExecutionStats, GroupByResult
+
+#: range predicates expand to explicit id lists during traversal; wider
+#: ranges fall back to the scan path (ids stay compact in dictId space)
+_MAX_RANGE_IDS = 100_000
+
+
+def _agg_pairs_needed(ctx: QueryContext) -> Optional[List[List[Tuple[str, str]]]]:
+    """Per aggregation: list of (func, col) pre-agg pairs it needs, or None
+    when some aggregation can't be served from a star-tree."""
+    out = []
+    for node, filt in zip(ctx.aggregations, ctx.agg_filters):
+        if filt is not None:
+            return None  # FILTER aggs bypass the tree (ref StarTreeUtils)
+        name = node.name
+        if name == "count":
+            out.append([("count", "*")])
+            continue
+        if not node.args or not isinstance(node.args[0], Identifier):
+            return None
+        col = node.args[0].name
+        if name in ("sum", "min", "max"):
+            out.append([(name, col)])
+        elif name == "avg":
+            out.append([("sum", col), ("count", "*")])
+        else:
+            return None
+    return out
+
+
+def _filter_id_sets(seg, expr: Optional[Expression], dims: List[str]
+                    ) -> Optional[Dict[str, Optional[np.ndarray]]]:
+    """AND-only filter tree -> per-dim matching dictId arrays, or None when
+    the filter doesn't fit (non-AND composition, non-tree dim, unsupported
+    predicate)."""
+    sets: Dict[str, Optional[np.ndarray]] = {d: None for d in dims}
+    if expr is None:
+        return sets
+
+    def add(pred_col: str, ids: np.ndarray) -> bool:
+        cur = sets.get(pred_col)
+        sets[pred_col] = ids if cur is None else \
+            np.intersect1d(cur, ids)
+        return True
+
+    def walk(e: Expression) -> bool:
+        if not isinstance(e, Function):
+            return False
+        if e.name == "and":
+            return all(walk(a) for a in e.args)
+        if not e.args or not isinstance(e.args[0], Identifier):
+            return False
+        col = e.args[0].name
+        if col not in sets:
+            return False  # predicate on a non-tree dim
+        p = resolve_predicate(seg, e)
+        if p is None:
+            return False
+        if p.kind == "all":
+            return True
+        if p.kind == "none":
+            return add(col, np.empty(0, dtype=np.int32))
+        if p.kind == "range":
+            if p.hi - p.lo + 1 > _MAX_RANGE_IDS:
+                return False
+            return add(col, np.arange(p.lo, p.hi + 1, dtype=np.int32))
+        if p.kind == "set":
+            return add(col, p.ids)
+        return False  # notset / null kinds -> scan path
+
+    if not walk(expr):
+        return None
+    return sets
+
+
+def execute_star_tree(seg, ctx: QueryContext):
+    """Returns AggregationResult/GroupByResult, or None when no tree fits."""
+    if ctx.options.get("useStarTree", "true").lower() == "false":
+        return None
+    reader = getattr(seg, "star_tree", None)
+    if reader is None or not reader.trees:
+        return None
+    if not ctx.aggregations or ctx.distinct:
+        return None
+    needed = _agg_pairs_needed(ctx)
+    if needed is None:
+        return None
+    group_cols: List[str] = []
+    for g in ctx.group_by:
+        if not isinstance(g, Identifier):
+            return None
+        group_cols.append(g.name)
+
+    for tree in reader.trees:
+        dims = tree.meta.dims
+        tree_pairs = set()
+        for p in tree.meta.pairs:
+            func, col = p.split("__", 1)
+            tree_pairs.add((func.lower(), col))
+        if not all(pair in tree_pairs for pairs in needed for pair in pairs):
+            continue
+        if not all(c in dims for c in group_cols):
+            continue
+        id_sets = _filter_id_sets(seg, ctx.filter, dims)
+        if id_sets is None:
+            continue
+        return _execute_on_tree(seg, tree, ctx, needed, group_cols, id_sets)
+    return None
+
+
+def _execute_on_tree(seg, tree, ctx: QueryContext, needed, group_cols,
+                     id_sets):
+    recs = tree.traverse(id_sets, set(group_cols))
+    stats = ExecutionStats(
+        num_docs_scanned=len(recs),   # pre-agg records scanned
+        num_segments_processed=1,
+        num_segments_matched=1 if len(recs) else 0,
+        total_docs=seg.num_docs)
+
+    def pair_values(pair):
+        return tree.metrics[pair][recs]
+
+    if not group_cols:
+        inters = [_whole(fn_node.name, needed[i], pair_values)
+                  for i, fn_node in enumerate(ctx.aggregations)]
+        return AggregationResult(inters, stats)
+
+    # group-by: decode group keys from record dim codes via dictionaries
+    dicts = [seg.data_source(c).dictionary for c in group_cols]
+    codes = [tree.dim_codes[c][recs] for c in group_cols]
+    stacked = np.stack(codes, axis=1) if codes else np.empty((len(recs), 0))
+    uniq, inverse = np.unique(stacked, axis=0, return_inverse=True)
+    n_groups = len(uniq)
+    groups: Dict[tuple, list] = {}
+    per_fn = []
+    for i, fn_node in enumerate(ctx.aggregations):
+        per_fn.append(_grouped(fn_node.name, needed[i], pair_values, inverse,
+                               n_groups))
+    for g in range(n_groups):
+        key = tuple(_py(d.get_value(int(uniq[g, j])))
+                    for j, d in enumerate(dicts))
+        groups[key] = [per_fn[i][g] for i in range(len(per_fn))]
+    return GroupByResult(groups, stats)
+
+
+def _whole(name: str, pairs, pair_values):
+    if name == "count":
+        return int(pair_values(("count", "*")).sum())
+    if name == "sum":
+        return float(pair_values(pairs[0]).sum())
+    if name == "min":
+        v = pair_values(pairs[0])
+        return float(v.min()) if len(v) else float("inf")
+    if name == "max":
+        v = pair_values(pairs[0])
+        return float(v.max()) if len(v) else float("-inf")
+    if name == "avg":
+        return (float(pair_values(pairs[0]).sum()),
+                int(pair_values(("count", "*")).sum()))
+    raise AssertionError(name)
+
+
+def _grouped(name: str, pairs, pair_values, inverse, n_groups):
+    def bsum(pair):
+        return np.bincount(inverse, weights=pair_values(pair),
+                           minlength=n_groups)
+    if name == "count":
+        return bsum(("count", "*")).astype(np.int64).tolist()
+    if name == "sum":
+        return bsum(pairs[0]).tolist()
+    if name == "avg":
+        s = bsum(pairs[0])
+        c = bsum(("count", "*")).astype(np.int64)
+        return list(zip(s.tolist(), c.tolist()))
+    v = pair_values(pairs[0])
+    if name == "min":
+        out = np.full(n_groups, np.inf)
+        np.minimum.at(out, inverse, v)
+        return out.tolist()
+    out = np.full(n_groups, -np.inf)
+    np.maximum.at(out, inverse, v)
+    return out.tolist()
+
+
+def _py(v):
+    return v.item() if isinstance(v, np.generic) else v

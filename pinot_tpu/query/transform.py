@@ -168,7 +168,20 @@ def _cast(expr: Function, p: ColumnProvider):
     raise ValueError(f"unsupported cast target {tname}")
 
 
+def _clpdecode(expr: Function, p: ColumnProvider):
+    """clpDecode(logtypeCol, dictVarsCol, encodedVarsCol) -> message strings
+    (ref CLPDecodeTransformFunction, used with clp-log ingestion where the
+    enricher split a field into three columns)."""
+    from pinot_tpu.segment.clp import decode_message
+    lt = np.asarray(evaluate(expr.args[0], p)).astype(str)
+    dv = p.mv_lists(expr.args[1].name)  # type: ignore[union-attr]
+    ev = p.mv_lists(expr.args[2].name)  # type: ignore[union-attr]
+    return np.array([decode_message(lt[i], dv[i], [int(x) for x in ev[i]])
+                     for i in range(len(lt))], dtype=object)
+
+
 _SPECIAL: Dict[str, Callable] = {
+    "clpdecode": _clpdecode,
     "case": _case,
     "concat": _concat,
     "substr": _substr,

@@ -1,0 +1,241 @@
+"""CLP-style log compression: logtype templates + variable columns.
+
+Reference parity: the y-scope extension — CLPForwardIndexCreatorV1/V2 and
+CLPForwardIndexReaderV1/V2 (pinot-segment-local
+segment/index/readers/forward/, SURVEY.md §2.2 row 4), which split each
+log message via com.yscope.clp:clp-ffi (JNI -> C++) into:
+  logtype      — the message template with variables replaced by
+                 placeholder bytes (highly repetitive -> dictionary)
+  dictVars     — variable tokens that only round-trip as strings
+  encodedVars  — integral/float variables packed into int64
+
+This is a clean-room codec with our own placeholders and byte format (the
+reference's exact CLP encoding lives in the external clp-ffi library, not
+in-tree). Round-trip is exact: tokens only become encoded/dict variables
+when re-rendering reproduces the original text.
+
+Placeholders (chosen outside printable ASCII):
+  \\x11 int variable (rendered str(int))
+  \\x12 dictionary variable (string token)
+  \\x13 float variable (IEEE bits in int64, rendered repr-roundtrip)
+"""
+from __future__ import annotations
+
+import re
+import struct
+from typing import Any, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+INT_PH = "\x11"
+DICT_PH = "\x12"
+FLOAT_PH = "\x13"
+
+# token = run of non-delimiter chars; delimiters stay in the logtype
+_TOKEN_RE = re.compile(r"[^\s=:,\[\]\(\)\"']+")
+_HAS_DIGIT = re.compile(r"\d")
+
+
+def encode_message(msg: str) -> Tuple[str, List[str], List[int]]:
+    """message -> (logtype, dict_vars, encoded_vars)."""
+    dict_vars: List[str] = []
+    encoded: List[int] = []
+
+    def repl(m: re.Match) -> str:
+        tok = m.group()
+        if not _HAS_DIGIT.search(tok):
+            return tok  # static text
+        # exact-roundtrip int
+        try:
+            v = int(tok)
+            if str(v) == tok and -(2**63) <= v < 2**63:
+                encoded.append(v)
+                return INT_PH
+        except ValueError:
+            pass
+        # exact-roundtrip float
+        try:
+            f = float(tok)
+            if repr(f) == tok:
+                encoded.append(
+                    struct.unpack("<q", struct.pack("<d", f))[0])
+                return FLOAT_PH
+        except ValueError:
+            pass
+        dict_vars.append(tok)
+        return DICT_PH
+
+    logtype = _TOKEN_RE.sub(repl, msg)
+    return logtype, dict_vars, encoded
+
+
+def decode_message(logtype: str, dict_vars: Sequence[str],
+                   encoded_vars: Sequence[int]) -> str:
+    out: List[str] = []
+    di = ei = 0
+    for ch in logtype:
+        if ch == INT_PH:
+            out.append(str(encoded_vars[ei]))
+            ei += 1
+        elif ch == FLOAT_PH:
+            out.append(repr(struct.unpack(
+                "<d", struct.pack("<q", encoded_vars[ei]))[0]))
+            ei += 1
+        elif ch == DICT_PH:
+            out.append(dict_vars[di])
+            di += 1
+        else:
+            out.append(ch)
+    return "".join(out)
+
+
+# ---------------------------------------------------------------------------
+# Forward index (one packed buffer per CLP column)
+# ---------------------------------------------------------------------------
+# layout: u32 section count-free header:
+#   u32 num_docs, u32 num_logtypes, u32 lt_blob_len
+#   logtype dictionary: i32 offsets[num_logtypes+1] + utf8 blob
+#   i32 logtype_id per doc
+#   dictvars: u32 num_unique, u32 uniq_len, str_section(uniques),
+#             i32 var_offsets[num_docs+1], i32 var_ids[num_vars]
+#             (vars are themselves dictionary-encoded — repeated tokens
+#              like hostnames/task-ids collapse, ref CLP var dictionary)
+#   encodedvars: i32 enc_offsets[num_docs+1], i64 flat[num_enc]
+
+_U32 = struct.Struct("<I")
+
+
+def write_clp_column(messages: Sequence[Any]) -> bytes:
+    n = len(messages)
+    logtypes: List[str] = []
+    lt_index = {}
+    lt_ids = np.empty(n, dtype=np.int32)
+    all_dict_vars: List[str] = []
+    dv_counts = np.empty(n, dtype=np.int32)
+    all_enc: List[int] = []
+    enc_counts = np.empty(n, dtype=np.int32)
+    for i, m in enumerate(messages):
+        lt, dv, ev = encode_message("" if m is None else str(m))
+        idx = lt_index.get(lt)
+        if idx is None:
+            idx = len(logtypes)
+            lt_index[lt] = idx
+            logtypes.append(lt)
+        lt_ids[i] = idx
+        all_dict_vars.extend(dv)
+        dv_counts[i] = len(dv)
+        all_enc.extend(ev)
+        enc_counts[i] = len(ev)
+
+    def str_section(strings: List[str]) -> bytes:
+        offsets = np.zeros(len(strings) + 1, dtype=np.int32)
+        blobs = [s.encode() for s in strings]
+        np.cumsum([len(b) for b in blobs], out=offsets[1:len(strings) + 1])
+        return offsets.tobytes() + b"".join(blobs)
+
+    lt_section = str_section(logtypes)
+    uniq_vars = list(dict.fromkeys(all_dict_vars))
+    var_index = {v: i for i, v in enumerate(uniq_vars)}
+    var_ids = np.array([var_index[v] for v in all_dict_vars], dtype=np.int32)
+    uniq_section = str_section(uniq_vars)
+    parts = [
+        _U32.pack(n), _U32.pack(len(logtypes)), _U32.pack(len(lt_section)),
+        lt_section,
+        lt_ids.tobytes(),
+        _U32.pack(len(uniq_vars)), _U32.pack(len(uniq_section)), uniq_section,
+        _prefix(dv_counts).tobytes(), var_ids.tobytes(),
+        _prefix(enc_counts).tobytes(),
+        np.asarray(all_enc, dtype=np.int64).tobytes(),
+    ]
+    return b"".join(parts)
+
+
+def _prefix(counts: np.ndarray) -> np.ndarray:
+    out = np.zeros(len(counts) + 1, dtype=np.int32)
+    np.cumsum(counts, out=out[1:])
+    return out
+
+
+def pack_compressed(buf: bytes, compression: str = "LZ4") -> bytes:
+    """Envelope: u32 codec_id, u32 raw_len, compressed payload (the chunk
+    compression the reference applies on top of CLP sections)."""
+    from pinot_tpu.segment import codec
+    cid, comp = codec.compress(buf, codec.codec_id(compression))
+    return _U32.pack(cid) + _U32.pack(len(buf)) + comp
+
+
+def unpack_compressed(buf) -> bytes:
+    from pinot_tpu.segment import codec
+    buf = bytes(buf)
+    cid = _U32.unpack_from(buf, 0)[0]
+    raw_len = _U32.unpack_from(buf, 4)[0]
+    return codec.decompress(buf[8:], cid, raw_len)
+
+
+class CLPForwardIndexReader:
+    """Ref CLPForwardIndexReaderV2 — decodes on demand; the logtype ids and
+    dictionary are directly accessible for template-level predicates."""
+
+    def __init__(self, buf: bytes):
+        buf = bytes(buf)
+        self.num_docs = _U32.unpack_from(buf, 0)[0]
+        num_lt = _U32.unpack_from(buf, 4)[0]
+        lt_len = _U32.unpack_from(buf, 8)[0]
+        pos = 12
+        self.logtypes, _ = self._read_strs(buf, pos, num_lt)
+        pos += lt_len
+        self.logtype_ids = np.frombuffer(buf, np.int32, self.num_docs, pos)
+        pos += 4 * self.num_docs
+        num_uniq = _U32.unpack_from(buf, pos)[0]
+        uniq_len = _U32.unpack_from(buf, pos + 4)[0]
+        pos += 8
+        self.var_dictionary, _ = self._read_strs(buf, pos, num_uniq)
+        pos += uniq_len
+        self.dv_offsets = np.frombuffer(buf, np.int32, self.num_docs + 1, pos)
+        pos += 4 * (self.num_docs + 1)
+        num_dv = int(self.dv_offsets[-1])
+        self.var_ids = np.frombuffer(buf, np.int32, num_dv, pos)
+        pos += 4 * num_dv
+        self.enc_offsets = np.frombuffer(buf, np.int32, self.num_docs + 1, pos)
+        pos += 4 * (self.num_docs + 1)
+        num_enc = int(self.enc_offsets[-1])
+        self.encoded_vars = np.frombuffer(buf, np.int64, num_enc, pos)
+
+    @staticmethod
+    def _read_strs(buf: bytes, pos: int, count: int):
+        """Returns (strings, total section length in bytes)."""
+        offsets = np.frombuffer(buf, np.int32, count + 1, pos)
+        blob_start = pos + 4 * (count + 1)
+        out = []
+        for i in range(count):
+            out.append(buf[blob_start + offsets[i]:
+                           blob_start + offsets[i + 1]].decode())
+        return out, 4 * (count + 1) + int(offsets[-1])
+
+    def get(self, doc_id: int) -> str:
+        lt = self.logtypes[self.logtype_ids[doc_id]]
+        dv = [self.var_dictionary[i] for i in
+              self.var_ids[self.dv_offsets[doc_id]:self.dv_offsets[doc_id + 1]]]
+        ev = self.encoded_vars[self.enc_offsets[doc_id]:self.enc_offsets[doc_id + 1]]
+        return decode_message(lt, dv, ev.tolist())
+
+    def decode_all(self) -> np.ndarray:
+        return np.array([self.get(i) for i in range(self.num_docs)],
+                        dtype=object)
+
+
+def clp_enricher(fields: Sequence[str]):
+    """Ingestion enricher (ref recordtransformer/enricher/clp/
+    CLPEncodingEnricher): splits each configured string field into
+    <field>_logtype / <field>_dictionaryVars / <field>_encodedVars columns
+    for tables that store the three CLP parts as separate columns."""
+    def enrich(record: dict) -> None:
+        for f in fields:
+            v = record.get(f)
+            if v is None:
+                continue
+            lt, dv, ev = encode_message(str(v))
+            record[f + "_logtype"] = lt
+            record[f + "_dictionaryVars"] = dv
+            record[f + "_encodedVars"] = ev
+    return enrich

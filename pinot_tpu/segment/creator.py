@@ -96,6 +96,18 @@ class SegmentCreator:
             buffers[index_key(name, it.NULLVECTOR)] = null_bm.to_bytes()
             meta.indexes.append(it.NULLVECTOR)
 
+        # CLP log columns: template/variable split instead of plain fwd
+        # (ref CLPForwardIndexCreatorV2; SURVEY.md §2.2 y-scope addition)
+        if name in idx_cfg.clp_columns:
+            from pinot_tpu.segment import clp
+            if spec.data_type.stored_type is not DataType.STRING:
+                raise ValueError(f"CLP column {name!r} must be STRING-typed")
+            meta.has_dictionary = False
+            meta.cardinality = len(set(values.tolist()))
+            buffers[index_key(name, it.CLP)] = clp.pack_compressed(
+                clp.write_clp_column(values), idx_cfg.compression)
+            meta.indexes.append(it.CLP)
+            return meta
         use_dict = name not in idx_cfg.no_dictionary_columns
         if use_dict:
             dictionary, dict_ids = Dictionary.build(spec.data_type, values)

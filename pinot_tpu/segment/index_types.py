@@ -17,6 +17,7 @@ FST = "fst_index"
 VECTOR = "vector_index"
 STARTREE = "startree_index"
 STARTREE_DATA = "startree_data"
+CLP = "clp_forward"  # y-scope CLP log-compressed forward index
 
 ALL = [DICTIONARY, FORWARD, INVERTED, RANGE, SORTED, BLOOM, NULLVECTOR,
-       JSON, TEXT, FST, VECTOR, STARTREE, STARTREE_DATA]
+       JSON, TEXT, FST, VECTOR, STARTREE, STARTREE_DATA, CLP]

@@ -70,11 +70,23 @@ class DataSource:
             self.dict_ids()
         return self._mv_offsets
 
+    @property
+    def clp_reader(self):
+        """CLP log column sub-reader (ref DataSource CLP getter)."""
+        if getattr(self, "_clp", None) is None and self._has(it.CLP):
+            from pinot_tpu.segment.clp import (CLPForwardIndexReader,
+                                               unpack_compressed)
+            self._clp = CLPForwardIndexReader(unpack_compressed(
+                self._seg.dir.get_buffer(self.metadata.name, it.CLP)))
+        return getattr(self, "_clp", None)
+
     def values(self) -> np.ndarray:
         """Whole-column materialized values (dictionary take or raw decode)."""
         if self._values is None:
             m = self.metadata
-            if m.has_dictionary:
+            if it.CLP in m.indexes:
+                self._values = self.clp_reader.decode_all()
+            elif m.has_dictionary:
                 self._values = self.dictionary.get_values(self.dict_ids())
             else:
                 buf = self._seg.dir.get_buffer(m.name, it.FORWARD)

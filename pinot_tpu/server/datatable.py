@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import struct
 from decimal import Decimal
-from typing import Any, Dict, List, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -205,11 +205,15 @@ def _stats_from(t: tuple) -> ExecutionStats:
     return ExecutionStats(*t)
 
 
-def serialize_results(results: List[Any], exceptions: List[dict] = ()) -> bytes:
-    """Server response: list of shape-tagged SegmentResults + exceptions."""
+def serialize_results(results: List[Any], exceptions: List[dict] = (),
+                      extra_stats: Optional[ExecutionStats] = None) -> bytes:
+    """Server response: list of shape-tagged SegmentResults + exceptions +
+    server-level stats (pruning counts survive even with zero results —
+    the reference carries these in DataTable metadata)."""
     w = _Writer()
     w.raw(MAGIC)
     w.value([_exc_tuple(e) for e in exceptions])
+    w.value(_stats_tuple(extra_stats) if extra_stats is not None else None)
     w.u32(len(results))
     for r in results:
         if isinstance(r, AggregationResult):
@@ -236,11 +240,14 @@ def serialize_results(results: List[Any], exceptions: List[dict] = ()) -> bytes:
     return w.bytes()
 
 
-def deserialize_results(buf: bytes) -> Tuple[List[Any], List[dict]]:
+def deserialize_results(buf: bytes
+                        ) -> Tuple[List[Any], List[dict], Optional[ExecutionStats]]:
     if buf[:4] != MAGIC:
         raise ValueError("bad DataTable magic")
     r = _Reader(buf, 4)
     exceptions = [_exc_from(t) for t in r.value()]
+    st = r.value()
+    extra_stats = _stats_from(st) if st is not None else None
     n = r.u32()
     out: List[Any] = []
     for _ in range(n):
@@ -266,7 +273,7 @@ def deserialize_results(buf: bytes) -> Tuple[List[Any], List[dict]]:
             out.append(DistinctResult(rows, _stats_from(r.value())))
         else:
             raise ValueError(f"bad result tag {tag!r}")
-    return out, exceptions
+    return out, exceptions, extra_stats
 
 
 def _exc_tuple(e: dict) -> tuple:

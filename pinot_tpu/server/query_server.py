@@ -52,11 +52,25 @@ class ServerQueryExecutor:
                 self._engine = TpuOperatorExecutor()
             return self._engine
 
-    def execute(self, table_name: str, sql_or_ctx, segments: Optional[List[str]] = None):
-        """Returns serialized DataTable bytes."""
+    def execute(self, table_name: str, sql_or_ctx,
+                segments: Optional[List[str]] = None,
+                extra_filter: Optional[str] = None):
+        """Returns serialized DataTable bytes. extra_filter (an expression
+        string, e.g. the hybrid time-boundary predicate) is ANDed into the
+        filter tree — the reference rewrites the BrokerRequest the same way."""
+        from pinot_tpu.utils.metrics import get_registry
+        metrics = get_registry("server")
+        metrics.add_meter("queries", labels={"table": table_name})
+        timer = metrics.time("query_execution", labels={"table": table_name})
+        timer.__enter__()
         try:
             ctx = (sql_or_ctx if isinstance(sql_or_ctx, QueryContext)
                    else QueryContext.from_sql(sql_or_ctx))
+            if extra_filter:
+                from pinot_tpu.ingest.transforms import parse_expression
+                from pinot_tpu.query.expressions import func
+                extra = parse_expression(extra_filter)
+                ctx.filter = extra if ctx.filter is None                     else func("and", ctx.filter, extra)
             tdm = self.data_manager.table(table_name, create=False)
             if tdm is None:
                 return datatable.serialize_results(
@@ -67,14 +81,16 @@ class ServerQueryExecutor:
                                    use_tpu=self.use_tpu,
                                    engine=self._shared_engine())
                 results, prune_stats = ex.execute_context(ctx)
-                if results:
-                    results[0].stats.merge(prune_stats)
-                return datatable.serialize_results(results)
+                return datatable.serialize_results(results,
+                                                   extra_stats=prune_stats)
             finally:
                 TableDataManager.release_all(sdms)
         except Exception as e:  # noqa: BLE001 — server must answer, not die
+            metrics.add_meter("query_exceptions", labels={"table": table_name})
             return datatable.serialize_results(
                 [], [{"errorCode": 200, "message": f"{type(e).__name__}: {e}"}])
+        finally:
+            timer.__exit__(None, None, None)
 
 
 class QueryServer:
@@ -102,7 +118,8 @@ class QueryServer:
                 loop = asyncio.get_running_loop()
                 resp = await loop.run_in_executor(
                     self._pool, self.executor.execute,
-                    req["tableName"], req["sql"], req.get("segments"))
+                    req["tableName"], req["sql"], req.get("segments"),
+                    req.get("extraFilter"))
                 writer.write(_LEN.pack(len(resp)) + resp)
                 await writer.drain()
         except (asyncio.IncompleteReadError, ConnectionResetError):
@@ -164,16 +181,24 @@ class ServerConnection:
 
     def request(self, table_name: str, sql: str,
                 segments: Optional[List[str]] = None,
-                request_id: int = 0) -> bytes:
+                request_id: int = 0,
+                extra_filter: Optional[str] = None) -> bytes:
         payload = json.dumps({
             "requestId": request_id, "tableName": table_name, "sql": sql,
-            "segments": segments}).encode()
+            "segments": segments, "extraFilter": extra_filter}).encode()
         with self._lock:
             try:
                 sock = self._connect()
                 sock.sendall(_LEN.pack(len(payload)) + payload)
                 return self._read_frame(sock)
-            except (ConnectionError, socket.timeout):
+            except socket.timeout:
+                # a slow query, NOT a dead channel: retransmitting would run
+                # it twice server-side; drop the channel and surface the
+                # timeout (ref: the reference fails the query, the failure
+                # detector handles the server)
+                self.close()
+                raise
+            except ConnectionError:
                 # one reconnect attempt (ref channel re-establish)
                 self.close()
                 sock = self._connect()

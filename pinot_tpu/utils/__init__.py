@@ -1,0 +1,6 @@
+"""Cross-cutting utilities: config, metrics, tracing, resource accounting.
+
+Reference parity: pinot-spi's cross-cutting SPIs (SURVEY.md §2.1 row 1):
+env/PinotConfiguration, metrics/PinotMetricsRegistry, trace/Tracing,
+accounting/ThreadResourceUsageAccountant.
+"""

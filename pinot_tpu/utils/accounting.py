@@ -1,0 +1,144 @@
+"""Per-query resource accounting + memory-pressure query killing.
+
+Reference parity: pinot-spi accounting/ThreadResourceUsageAccountant +
+the production PerQueryCPUMemResourceUsageAccountant
+(pinot-core accounting/PerQueryCPUMemAccountantFactory.java:63) — threads
+register the query they work for, per-thread CPU/allocations aggregate per
+query, and a WatcherTask (:560) interrupts the most expensive queries
+under heap pressure. Python twist: cooperative cancellation — executors
+poll `check_cancelled()` in their loops (the reference's hot loops call
+Tracing.ThreadAccountantOps.sample() the same way, DocIdSetOperator.java:70).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+class QueryCancelledError(RuntimeError):
+    pass
+
+
+@dataclass
+class QueryUsage:
+    query_id: str
+    start_time: float = field(default_factory=time.time)
+    cpu_ns: int = 0
+    bytes_allocated: int = 0
+    cancelled: bool = False
+    threads: int = 0
+
+
+class ResourceAccountant:
+    """Tracks per-query usage; kills the most expensive under pressure."""
+
+    def __init__(self, memory_limit_bytes: Optional[int] = None,
+                 query_timeout_s: Optional[float] = None):
+        self._queries: Dict[str, QueryUsage] = {}
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+        self.memory_limit_bytes = memory_limit_bytes
+        self.query_timeout_s = query_timeout_s
+
+    # -- per-thread registration (ref setupRunner / clear) -------------------
+    def setup_worker(self, query_id: str) -> None:
+        self._tls.query_id = query_id
+        self._tls.cpu_start = time.thread_time_ns()
+        with self._lock:
+            u = self._queries.get(query_id)
+            if u is None:
+                u = QueryUsage(query_id)
+                self._queries[query_id] = u
+            u.threads += 1
+
+    def clear_worker(self) -> None:
+        qid = getattr(self._tls, "query_id", None)
+        if qid is None:
+            return
+        spent = time.thread_time_ns() - self._tls.cpu_start
+        with self._lock:
+            u = self._queries.get(qid)
+            if u is not None:
+                u.cpu_ns += spent
+                u.threads -= 1
+        self._tls.query_id = None
+
+    def record_allocation(self, nbytes: int) -> None:
+        qid = getattr(self._tls, "query_id", None)
+        if qid is None:
+            return
+        with self._lock:
+            u = self._queries.get(qid)
+            if u is not None:
+                u.bytes_allocated += nbytes
+
+    # -- cooperative cancellation (ref sample() in hot loops) ----------------
+    def check_cancelled(self) -> None:
+        qid = getattr(self._tls, "query_id", None)
+        if qid is None:
+            return
+        with self._lock:
+            u = self._queries.get(qid)
+        if u is not None and u.cancelled:
+            raise QueryCancelledError(f"query {qid} cancelled by accountant")
+
+    def cancel(self, query_id: str) -> bool:
+        with self._lock:
+            u = self._queries.get(query_id)
+            if u is None:
+                return False
+            u.cancelled = True
+            return True
+
+    def finish_query(self, query_id: str) -> Optional[QueryUsage]:
+        with self._lock:
+            return self._queries.pop(query_id, None)
+
+    def usage(self, query_id: str) -> Optional[QueryUsage]:
+        with self._lock:
+            return self._queries.get(query_id)
+
+    # -- watcher (ref WatcherTask) ------------------------------------------
+    def watch_once(self, rss_bytes: Optional[int] = None) -> List[str]:
+        """One watcher sweep: kill the most expensive query when over the
+        memory limit, and any query over the timeout. Returns killed ids."""
+        killed: List[str] = []
+        now = time.time()
+        with self._lock:
+            live = [u for u in self._queries.values() if not u.cancelled]
+            if self.query_timeout_s is not None:
+                for u in live:
+                    if now - u.start_time > self.query_timeout_s:
+                        u.cancelled = True
+                        killed.append(u.query_id)
+            if self.memory_limit_bytes is not None:
+                rss = rss_bytes if rss_bytes is not None else _rss_bytes()
+                if rss is not None and rss > self.memory_limit_bytes:
+                    live = [u for u in live if not u.cancelled]
+                    if live:
+                        worst = max(live, key=lambda u: u.bytes_allocated)
+                        worst.cancelled = True
+                        killed.append(worst.query_id)
+        return killed
+
+    def start_watcher(self, interval_s: float = 1.0) -> threading.Event:
+        stop = threading.Event()
+
+        def loop():
+            while not stop.wait(interval_s):
+                self.watch_once()
+
+        threading.Thread(target=loop, daemon=True,
+                         name="accountant-watcher").start()
+        return stop
+
+
+def _rss_bytes() -> Optional[int]:
+    try:
+        with open("/proc/self/statm") as f:
+            return int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        return None

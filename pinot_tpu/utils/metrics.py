@@ -1,0 +1,131 @@
+"""Metrics registry: meters, gauges, timers + Prometheus text exposition.
+
+Reference parity: pinot-spi metrics/PinotMetricsRegistry.java + the typed
+role registries over AbstractMetrics (pinot-common metrics/ —
+ServerMetrics/BrokerMetrics/ControllerMetrics/MinionMetrics with per-role
+meter/gauge/timer enums, exported via JMX). Here one thread-safe registry
+with the same meter/gauge/timer trio, exported as Prometheus text
+(the modern equivalent of the JMX reporter).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from typing import Dict, Iterator, List, Optional, Tuple
+
+_Key = Tuple[str, Tuple[Tuple[str, str], ...]]
+
+
+def _key(name: str, labels: Optional[Dict[str, str]]) -> _Key:
+    return (name, tuple(sorted((labels or {}).items())))
+
+
+class Timer:
+    __slots__ = ("count", "total_ms", "max_ms")
+
+    def __init__(self):
+        self.count = 0
+        self.total_ms = 0.0
+        self.max_ms = 0.0
+
+    def update(self, ms: float) -> None:
+        self.count += 1
+        self.total_ms += ms
+        self.max_ms = max(self.max_ms, ms)
+
+
+class MetricsRegistry:
+    """Ref PinotMetricsRegistry — meters (counters), gauges, timers."""
+
+    def __init__(self, role: str = "server"):
+        self.role = role
+        self._meters: Dict[_Key, float] = defaultdict(float)
+        self._gauges: Dict[_Key, float] = {}
+        self._timers: Dict[_Key, Timer] = defaultdict(Timer)
+        self._lock = threading.Lock()
+
+    # -- write side ---------------------------------------------------------
+    def add_meter(self, name: str, value: float = 1,
+                  labels: Optional[Dict[str, str]] = None) -> None:
+        with self._lock:
+            self._meters[_key(name, labels)] += value
+
+    def set_gauge(self, name: str, value: float,
+                  labels: Optional[Dict[str, str]] = None) -> None:
+        with self._lock:
+            self._gauges[_key(name, labels)] = value
+
+    def add_timing(self, name: str, ms: float,
+                   labels: Optional[Dict[str, str]] = None) -> None:
+        with self._lock:
+            self._timers[_key(name, labels)].update(ms)
+
+    class _TimeCtx:
+        def __init__(self, reg, name, labels):
+            self.reg, self.name, self.labels = reg, name, labels
+
+        def __enter__(self):
+            self.t0 = time.perf_counter()
+            return self
+
+        def __exit__(self, *exc):
+            self.reg.add_timing(self.name,
+                                (time.perf_counter() - self.t0) * 1000.0,
+                                self.labels)
+
+    def time(self, name: str, labels: Optional[Dict[str, str]] = None):
+        return MetricsRegistry._TimeCtx(self, name, labels)
+
+    # -- read side ----------------------------------------------------------
+    def meter(self, name: str, labels: Optional[Dict[str, str]] = None) -> float:
+        with self._lock:
+            return self._meters.get(_key(name, labels), 0.0)
+
+    def gauge(self, name: str, labels: Optional[Dict[str, str]] = None):
+        with self._lock:
+            return self._gauges.get(_key(name, labels))
+
+    def timer(self, name: str, labels: Optional[Dict[str, str]] = None) -> Timer:
+        with self._lock:
+            return self._timers.get(_key(name, labels), Timer())
+
+    def prometheus_text(self) -> str:
+        """Prometheus exposition format (the JMX-reporter analog)."""
+        out: List[str] = []
+        prefix = f"pinot_tpu_{self.role}_"
+        with self._lock:
+            for (name, labels), v in sorted(self._meters.items()):
+                out.append(f"# TYPE {prefix}{name} counter")
+                out.append(f"{prefix}{name}{_fmt(labels)} {v:g}")
+            for (name, labels), v in sorted(self._gauges.items()):
+                out.append(f"# TYPE {prefix}{name} gauge")
+                out.append(f"{prefix}{name}{_fmt(labels)} {v:g}")
+            for (name, labels), t in sorted(self._timers.items()):
+                base = f"{prefix}{name}"
+                out.append(f"# TYPE {base} summary")
+                out.append(f"{base}_count{_fmt(labels)} {t.count}")
+                out.append(f"{base}_sum_ms{_fmt(labels)} {t.total_ms:g}")
+                out.append(f"{base}_max_ms{_fmt(labels)} {t.max_ms:g}")
+        return "\n".join(out) + "\n"
+
+
+def _fmt(labels: Tuple[Tuple[str, str], ...]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in labels)
+    return "{" + inner + "}"
+
+
+# role-level singletons (ref ServerMetrics.get() style accessors)
+_registries: Dict[str, MetricsRegistry] = {}
+_reg_lock = threading.Lock()
+
+
+def get_registry(role: str = "server") -> MetricsRegistry:
+    with _reg_lock:
+        reg = _registries.get(role)
+        if reg is None:
+            reg = MetricsRegistry(role)
+            _registries[role] = reg
+        return reg

@@ -1,0 +1,86 @@
+"""Per-query operator tracing.
+
+Reference parity: pinot-spi trace/Tracing.java:45 — a registry holding one
+Tracer; every operator wraps nextBlock() in an InvocationScope
+(core/operator/BaseOperator.java:47) recording operator class + rows/docs;
+enabled per query via the trace=true query option and returned in the
+broker response. Here a contextvar-scoped trace tree with the same shape.
+"""
+from __future__ import annotations
+
+import contextvars
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+_current: contextvars.ContextVar[Optional["TraceNode"]] = \
+    contextvars.ContextVar("pinot_tpu_trace", default=None)
+
+
+@dataclass
+class TraceNode:
+    operator: str
+    start_ms: float = 0.0
+    duration_ms: float = 0.0
+    attrs: Dict[str, Any] = field(default_factory=dict)
+    children: List["TraceNode"] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return {"operator": self.operator,
+                "durationMs": round(self.duration_ms, 3),
+                **self.attrs,
+                **({"children": [c.to_dict() for c in self.children]}
+                   if self.children else {})}
+
+
+class Scope:
+    """Ref InvocationScope (try-with-resources around nextBlock)."""
+
+    def __init__(self, operator: str, **attrs):
+        self.node = TraceNode(operator, attrs=dict(attrs))
+        self._token = None
+        self._active = False
+
+    def __enter__(self) -> "Scope":
+        parent = _current.get()
+        if parent is not None:
+            parent.children.append(self.node)
+            self._token = _current.set(self.node)
+            self._active = True
+            self.node.start_ms = time.perf_counter() * 1000.0
+        return self
+
+    def set(self, **attrs) -> None:
+        if self._active:
+            self.node.attrs.update(attrs)
+
+    def __exit__(self, *exc):
+        if self._active:
+            self.node.duration_ms = \
+                time.perf_counter() * 1000.0 - self.node.start_ms
+            _current.reset(self._token)
+
+
+class RequestTrace:
+    """Root scope for one query; activates tracing for the request."""
+
+    def __init__(self, request_id: int = 0):
+        self.root = TraceNode("BrokerRequest", attrs={"requestId": request_id})
+        self._token = None
+
+    def __enter__(self) -> "RequestTrace":
+        self.root.start_ms = time.perf_counter() * 1000.0
+        self._token = _current.set(self.root)
+        return self
+
+    def __exit__(self, *exc):
+        self.root.duration_ms = \
+            time.perf_counter() * 1000.0 - self.root.start_ms
+        _current.reset(self._token)
+
+    def to_dict(self) -> dict:
+        return self.root.to_dict()
+
+
+def active() -> bool:
+    return _current.get() is not None

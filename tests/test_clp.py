@@ -1,0 +1,154 @@
+"""CLP log-compression: codec round-trip, forward index, query integration
+(the y-scope extension; ref CLPForwardIndexReaderV2 + ClpRewriterTest)."""
+import numpy as np
+import pytest
+
+from pinot_tpu.models import (DataType, FieldSpec, FieldType, Schema,
+                              TableConfig, TableType)
+from pinot_tpu.query.executor import QueryExecutor
+from pinot_tpu.segment import clp
+from pinot_tpu.segment.creator import SegmentCreator
+from pinot_tpu.segment.loader import load_segment
+
+MESSAGES = [
+    "INFO  Task task_1234 assigned to container: [container_e3243], operation took 0.335 seconds",
+    "ERROR Connection to 10.0.23.1:8080 refused after 3 retries",
+    "WARN  GC pause of 1.21 seconds detected at offset 987654321",
+    "INFO  Task task_1234 assigned to container: [container_e3243], operation took 0.335 seconds",
+    "DEBUG user=alice id=42 logged in from 192.168.0.7",
+    "plain message with no variables at all",
+    "edge cases: 007 0x1F 1.2.3 -17 +5 3.14000",
+]
+
+
+class TestCodec:
+    @pytest.mark.parametrize("msg", MESSAGES)
+    def test_roundtrip(self, msg):
+        lt, dv, ev = clp.encode_message(msg)
+        assert clp.decode_message(lt, dv, ev) == msg
+
+    def test_template_extraction(self):
+        lt1, _, ev1 = clp.encode_message("took 5 seconds")
+        lt2, _, ev2 = clp.encode_message("took 93 seconds")
+        assert lt1 == lt2  # same template
+        assert ev1 == [5] and ev2 == [93]
+
+    def test_float_encoding(self):
+        lt, dv, ev = clp.encode_message("pause of 1.21 seconds")
+        assert clp.FLOAT_PH in lt
+        assert dv == []
+        assert len(ev) == 1
+
+    def test_nonroundtrip_stays_dict_var(self):
+        # leading zeros would not survive int round-trip
+        lt, dv, ev = clp.encode_message("code 007")
+        assert dv == ["007"] and ev == []
+
+    def test_forward_index_roundtrip(self):
+        buf = clp.write_clp_column(MESSAGES * 10)
+        r = clp.CLPForwardIndexReader(buf)
+        assert r.num_docs == len(MESSAGES) * 10
+        out = r.decode_all()
+        assert out.tolist() == MESSAGES * 10
+        # logtype dictionary is shared: duplicates collapse
+        assert len(r.logtypes) < len(MESSAGES) * 10
+
+    def test_compression_wins_on_repetitive_logs(self):
+        msgs = [f"INFO request {i} served in {i % 100} ms from host h{i % 4}"
+                for i in range(10_000)]
+        raw = sum(len(m) for m in msgs)
+        buf = clp.pack_compressed(clp.write_clp_column(msgs))
+        assert len(buf) < raw * 0.4  # templates + chunk codec beat raw text
+
+
+class TestClpColumn:
+    @pytest.fixture(scope="class")
+    def seg(self, tmp_path_factory):
+        tmp = tmp_path_factory.mktemp("clp")
+        schema = Schema("logs", [
+            FieldSpec("ts", DataType.LONG, FieldType.DATE_TIME),
+            FieldSpec("message", DataType.STRING),
+        ])
+        tc = TableConfig("logs", TableType.OFFLINE)
+        tc.indexing.clp_columns = ["message"]
+        msgs = [MESSAGES[i % len(MESSAGES)] for i in range(500)]
+        SegmentCreator(tc, schema).build(
+            {"ts": np.arange(500, dtype=np.int64), "message": msgs},
+            str(tmp / "seg"), "logs_0")
+        return load_segment(str(tmp / "seg")), msgs
+
+    def test_values_decode(self, seg):
+        s, msgs = seg
+        vals = s.data_source("message").values()
+        assert vals.tolist() == msgs
+
+    def test_like_query_on_clp_column(self, seg):
+        s, msgs = seg
+        ex = QueryExecutor([s], use_tpu=False)
+        r = ex.execute("SELECT COUNT(*) FROM logs WHERE message LIKE '%refused%'")
+        want = sum(1 for m in msgs if "refused" in m)
+        assert r.rows[0][0] == want
+
+    def test_select_clp_column(self, seg):
+        s, msgs = seg
+        ex = QueryExecutor([s], use_tpu=False)
+        r = ex.execute("SELECT message FROM logs WHERE ts = 1 LIMIT 1")
+        assert r.rows[0][0] == msgs[1]
+
+    def test_storage_smaller_than_plain(self, seg, tmp_path):
+        s, msgs = seg
+        schema = Schema("logs", [
+            FieldSpec("ts", DataType.LONG, FieldType.DATE_TIME),
+            FieldSpec("message", DataType.STRING),
+        ])
+        tc = TableConfig("logs", TableType.OFFLINE)
+        tc.indexing.no_dictionary_columns = ["message"]
+        tc.indexing.compression = "PASS_THROUGH"
+        SegmentCreator(tc, schema).build(
+            {"ts": np.arange(500, dtype=np.int64), "message": msgs},
+            str(tmp_path / "plain"), "logs_plain")
+        import os
+        clp_size = sum(os.path.getsize(os.path.join(r, f))
+                       for r, _, fs in os.walk(str(s.dir.path)) for f in fs) \
+            if hasattr(s.dir, "path") else None
+        # direct buffer comparison instead: clp buffer vs raw var buffer
+        plain = load_segment(str(tmp_path / "plain"))
+        from pinot_tpu.segment import index_types as it
+        clp_buf = s.dir.get_buffer("message", it.CLP)
+        raw_buf = plain.dir.get_buffer("message", it.FORWARD)
+        assert len(bytes(clp_buf)) < len(bytes(raw_buf))
+
+
+class TestClpIngestion:
+    def test_enricher_and_clpdecode_transform(self, tmp_path):
+        schema = Schema("logs", [
+            FieldSpec("message_logtype", DataType.STRING),
+            FieldSpec("message_dictionaryVars", DataType.STRING,
+                      single_value=False),
+            FieldSpec("message_encodedVars", DataType.LONG,
+                      single_value=False),
+        ])
+        tc = TableConfig("logs", TableType.OFFLINE)
+        enrich = clp.clp_enricher(["message"])
+        rows = {"message_logtype": [], "message_dictionaryVars": [],
+                "message_encodedVars": []}
+        for m in MESSAGES:
+            rec = {"message": m}
+            enrich(rec)
+            rows["message_logtype"].append(rec["message_logtype"])
+            rows["message_dictionaryVars"].append(
+                rec["message_dictionaryVars"] or ["\x00"])
+            rows["message_encodedVars"].append(
+                rec["message_encodedVars"] or [0])
+        SegmentCreator(tc, schema).build(rows, str(tmp_path / "seg"), "l0")
+        seg = load_segment(str(tmp_path / "seg"))
+        ex = QueryExecutor([seg], use_tpu=False)
+        r = ex.execute(
+            "SELECT clpDecode(message_logtype, message_dictionaryVars, "
+            "message_encodedVars) FROM logs LIMIT 10")
+        decoded = [row[0] for row in r.rows]
+        # messages whose var lists were non-empty round-trip exactly
+        for got, want in zip(decoded, MESSAGES):
+            lt, dv, ev = clp.encode_message(want)
+            if dv and ev:
+                assert got == want

@@ -28,7 +28,7 @@ class TestDataTableSerde:
             [1.5, 42, (3.0, 7), {"a": 1}, {1, 2, 3}, hll, td, None, "x"],
             ExecutionStats(num_docs_scanned=10, total_docs=100))
         buf = datatable.serialize_results([r])
-        [out], exc = datatable.deserialize_results(buf)
+        [out], exc, _ = datatable.deserialize_results(buf)
         assert exc == []
         assert out.intermediates[0] == 1.5
         assert out.intermediates[1] == 42
@@ -46,7 +46,7 @@ class TestDataTableSerde:
         r = GroupByResult({("a", 1): [1.0, 2], ("b", 2): [3.0, 4]},
                           ExecutionStats(), num_groups_limit_reached=True)
         buf = datatable.serialize_results([r])
-        [out], _ = datatable.deserialize_results(buf)
+        [out], _, _ = datatable.deserialize_results(buf)
         assert out.groups == r.groups
         assert out.num_groups_limit_reached is True
 
@@ -55,7 +55,7 @@ class TestDataTableSerde:
                             order_values=[(1,), (2,)],
                             columns=["a", "b"], stats=ExecutionStats())
         buf = datatable.serialize_results([r])
-        [out], _ = datatable.deserialize_results(buf)
+        [out], _, _ = datatable.deserialize_results(buf)
         assert out.rows == r.rows
         assert out.order_values == r.order_values
         assert out.columns == ["a", "b"]
@@ -63,7 +63,7 @@ class TestDataTableSerde:
     def test_exceptions(self):
         buf = datatable.serialize_results(
             [], [{"errorCode": 190, "message": "no table"}])
-        results, exc = datatable.deserialize_results(buf)
+        results, exc, _ = datatable.deserialize_results(buf)
         assert results == []
         assert exc == [{"errorCode": 190, "message": "no table"}]
 
@@ -174,3 +174,110 @@ class TestHybridTable:
             assert resp.rows[0][1] == pytest.approx(100 * 1 + 100 * 2)
         finally:
             c.stop()
+
+
+class TestReviewRegressions:
+    def test_hybrid_query_with_keywordish_text(self, tmp_path_factory):
+        """Time-boundary must not corrupt queries containing keyword-like
+        identifiers or literals (travels as structured extraFilter now)."""
+        from pinot_tpu.models import (DataType, FieldSpec, FieldType, Schema,
+                                      TableConfig, TableType)
+        schema = Schema("hybrid2", [
+            FieldSpec("ts", DataType.LONG, FieldType.DATE_TIME),
+            FieldSpec("options", DataType.STRING),
+            FieldSpec("msg", DataType.STRING),
+        ])
+        tc = TableConfig("hybrid2", TableType.OFFLINE)
+        tc.retention.time_column = "ts"
+        tmp = tmp_path_factory.mktemp("hybrid2")
+        off = build_segments(tmp, schema, tc, [{
+            "ts": np.arange(0, 100, dtype=np.int64),
+            "options": ["yes" if i % 2 else "no" for i in range(100)],
+            "msg": ["rate limit hit" if i % 4 == 0 else "ok" for i in range(100)],
+        }])[0]
+        rt = build_segments(tmp_path_factory.mktemp("hybrid2rt"), schema, tc, [{
+            "ts": np.arange(100, 200, dtype=np.int64),
+            "options": ["yes"] * 100,
+            "msg": ["ok"] * 100,
+        }])[0]
+        c = MiniCluster(num_servers=1)
+        c.start()
+        try:
+            c.add_table("hybrid2", "OFFLINE", time_column="ts")
+            c.add_table("hybrid2", "REALTIME", time_column="ts", time_boundary=99)
+            c.add_segment("hybrid2", off, 0, "OFFLINE")
+            c.add_segment("hybrid2", rt, 0, "REALTIME")
+            r = c.query("SELECT options FROM hybrid2 LIMIT 500")
+            assert not r.exceptions, r.exceptions
+            assert len(r.rows) == 200
+            r = c.query("SELECT COUNT(*) FROM hybrid2 WHERE msg = 'rate limit hit'")
+            assert not r.exceptions, r.exceptions
+            assert r.rows[0][0] == 25
+        finally:
+            c.stop()
+
+    def test_all_pruned_stats_survive_wire(self, cluster):
+        c, _ = cluster
+        resp = c.query("SELECT COUNT(*) FROM testTable WHERE intCol > 99999")
+        assert resp.stats.num_segments_pruned == 4
+        assert resp.stats.total_docs == NUM_DOCS * 4
+
+    def test_segment_refresh_invalidates_device_cache(self, tmp_path_factory):
+        """A refreshed segment (same name, new data) must not serve stale
+        HBM blocks."""
+        from pinot_tpu.ops.engine import TpuOperatorExecutor
+        from pinot_tpu.query.executor import QueryExecutor
+        tmp = tmp_path_factory.mktemp("refresh")
+        data1 = {"intCol": np.full(512, 1, dtype=np.int32),
+                 "longCol": np.arange(512, dtype=np.int64),
+                 "floatCol": np.ones(512, dtype=np.float32),
+                 "doubleCol": np.ones(512),
+                 "stringCol": ["a"] * 512, "groupCol": ["g"] * 512,
+                 "rawIntCol": np.full(512, 1, dtype=np.int32)}
+        data2 = dict(data1)
+        data2["intCol"] = np.full(512, 2, dtype=np.int32)
+        seg1 = build_segments(tmp, synthetic_schema(), synthetic_table_config(),
+                              [data1])[0]
+        engine = TpuOperatorExecutor()
+        ex1 = QueryExecutor([seg1], use_tpu=True, engine=engine)
+        r1 = ex1.execute("SELECT SUM(intCol) FROM testTable")
+        assert r1.rows[0][0] == 512
+        # refresh: same segment name, new contents, new object
+        seg2 = build_segments(tmp_path_factory.mktemp("refresh2"),
+                              synthetic_schema(), synthetic_table_config(),
+                              [data2])[0]
+        ex2 = QueryExecutor([seg2], use_tpu=True, engine=engine)
+        r2 = ex2.execute("SELECT SUM(intCol) FROM testTable")
+        assert r2.rows[0][0] == 1024
+
+
+class TestConsumerResilience:
+    def test_bad_record_does_not_kill_consumer(self, tmp_path):
+        import time as _time
+        from pinot_tpu.ingest import InMemoryStream, StreamConfig
+        from pinot_tpu.ingest.realtime_manager import RealtimeSegmentDataManager
+        from pinot_tpu.models import (DataType, FieldSpec, FieldType, Schema,
+                                      TableConfig, TableType)
+        from pinot_tpu.server.data_manager import TableDataManager
+        schema = Schema("r", [FieldSpec("id", DataType.LONG),
+                              FieldSpec("v", DataType.DOUBLE, FieldType.METRIC)])
+        topic = InMemoryStream("bad_topic", 1)
+        try:
+            tdm = TableDataManager("r_REALTIME")
+            sc = StreamConfig(stream_type="inmemory", topic="bad_topic",
+                              flush_threshold_rows=10_000)
+            mgr = RealtimeSegmentDataManager(
+                TableConfig("r", TableType.REALTIME), schema, sc, 0, tdm,
+                str(tmp_path))
+            topic.publish({"id": 1, "v": 1.0})
+            topic.publish({"id": "not-a-number", "v": 2.0})  # poison
+            topic.publish({"id": 3, "v": 3.0})
+            mgr.start()
+            deadline = _time.time() + 10
+            while _time.time() < deadline and mgr.mutable.num_docs < 2:
+                _time.sleep(0.05)
+            mgr.stop()
+            assert mgr.mutable.num_docs == 2  # poison skipped, rest ingested
+            assert mgr.error_count == 1
+        finally:
+            InMemoryStream.delete("bad_topic")

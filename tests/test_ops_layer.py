@@ -1,0 +1,130 @@
+"""Metrics, tracing, resource accounting (SURVEY.md §5 aux subsystems)."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pinot_tpu.utils.accounting import (
+    QueryCancelledError, ResourceAccountant)
+from pinot_tpu.utils.metrics import MetricsRegistry, get_registry
+from pinot_tpu.utils import tracing
+
+
+class TestMetrics:
+    def test_meters_gauges_timers(self):
+        m = MetricsRegistry("test")
+        m.add_meter("queries", labels={"table": "t"})
+        m.add_meter("queries", 2, labels={"table": "t"})
+        m.set_gauge("segments", 5)
+        with m.time("exec"):
+            pass
+        assert m.meter("queries", {"table": "t"}) == 3
+        assert m.gauge("segments") == 5
+        assert m.timer("exec").count == 1
+
+    def test_prometheus_text(self):
+        m = MetricsRegistry("test")
+        m.add_meter("q", labels={"table": "a"})
+        m.set_gauge("g", 1.5)
+        m.add_timing("t", 12.0)
+        text = m.prometheus_text()
+        assert 'pinot_tpu_test_q{table="a"} 1' in text
+        assert "pinot_tpu_test_g 1.5" in text
+        assert "pinot_tpu_test_t_count 1" in text
+
+    def test_registry_singletons(self):
+        assert get_registry("broker") is get_registry("broker")
+
+    def test_thread_safety(self):
+        m = MetricsRegistry("test")
+
+        def work():
+            for _ in range(1000):
+                m.add_meter("n")
+
+        ts = [threading.Thread(target=work) for _ in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert m.meter("n") == 8000
+
+
+class TestTracing:
+    def test_scope_tree(self):
+        with tracing.RequestTrace(7) as rt:
+            with tracing.Scope("A", x=1):
+                with tracing.Scope("B") as b:
+                    b.set(rows=10)
+            with tracing.Scope("C"):
+                pass
+        d = rt.to_dict()
+        assert d["operator"] == "BrokerRequest"
+        assert [c["operator"] for c in d["children"]] == ["A", "C"]
+        assert d["children"][0]["children"][0]["rows"] == 10
+        assert d["children"][0]["durationMs"] >= 0
+
+    def test_inactive_scopes_are_noops(self):
+        assert not tracing.active()
+        with tracing.Scope("orphan"):
+            pass  # no crash, records nothing
+
+    def test_trace_option_end_to_end(self, tmp_path):
+        from pinot_tpu.query.executor import QueryExecutor
+        from tests.queries.harness import (
+            build_segments, synthetic_columns, synthetic_schema,
+            synthetic_table_config)
+        segs = build_segments(tmp_path, synthetic_schema(),
+                              synthetic_table_config(),
+                              [synthetic_columns(500, 1)])
+        ex = QueryExecutor(segs, use_tpu=False)
+        r = ex.execute("SELECT COUNT(*) FROM testTable OPTION(trace=true)")
+        assert r.trace is not None
+        ops = [c["operator"] for c in r.trace["children"]]
+        assert "SegmentExecutor" in ops and "BrokerReduce" in ops
+        assert r.to_dict()["traceInfo"]["operator"] == "BrokerRequest"
+        r2 = ex.execute("SELECT COUNT(*) FROM testTable")
+        assert r2.trace is None
+
+
+class TestAccounting:
+    def test_usage_tracking(self):
+        acc = ResourceAccountant()
+        acc.setup_worker("q1")
+        _ = sum(i * i for i in range(100_000))
+        acc.record_allocation(1024)
+        acc.clear_worker()
+        u = acc.usage("q1")
+        assert u.cpu_ns > 0
+        assert u.bytes_allocated == 1024
+        assert acc.finish_query("q1") is not None
+        assert acc.usage("q1") is None
+
+    def test_cooperative_cancellation(self):
+        acc = ResourceAccountant()
+        acc.setup_worker("q2")
+        acc.check_cancelled()  # fine
+        assert acc.cancel("q2")
+        with pytest.raises(QueryCancelledError):
+            acc.check_cancelled()
+        acc.clear_worker()
+
+    def test_timeout_kill(self):
+        acc = ResourceAccountant(query_timeout_s=0.01)
+        acc.setup_worker("q3")
+        acc.clear_worker()
+        time.sleep(0.05)
+        killed = acc.watch_once()
+        assert killed == ["q3"]
+
+    def test_memory_pressure_kills_most_expensive(self):
+        acc = ResourceAccountant(memory_limit_bytes=100)
+        for qid, alloc in (("small", 10), ("big", 10_000)):
+            acc.setup_worker(qid)
+            acc.record_allocation(alloc)
+            acc.clear_worker()
+        killed = acc.watch_once(rss_bytes=200)
+        assert killed == ["big"]
+        # small survives
+        assert not acc.usage("small").cancelled
