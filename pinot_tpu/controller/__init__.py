@@ -1,0 +1,15 @@
+"""Controller role: cluster state, segment assignment, retention,
+rebalance, background (minion) tasks.
+
+Reference parity: pinot-controller (SURVEY.md L7) — PinotHelixResourceManager
+(table/schema/instance CRUD + IdealState updates), segment assignment
+strategies (helix/core/assignment/segment/), TableRebalancer,
+RetentionManager, PinotTaskManager/minion task framework — rebuilt without
+ZooKeeper/Helix: an in-process (optionally JSON-persisted) ClusterState
+with listener callbacks standing in for ExternalView watches (the ZK-free
+control plane of SURVEY.md §7.4).
+"""
+from pinot_tpu.controller.cluster_state import ClusterState, SegmentState
+from pinot_tpu.controller.controller import Controller
+
+__all__ = ["ClusterState", "SegmentState", "Controller"]

@@ -1,0 +1,80 @@
+"""Segment assignment strategies.
+
+Reference parity: pinot-controller helix/core/assignment/segment/ —
+BalancedNumSegmentAssignment (least-loaded instances),
+ReplicaGroupSegmentAssignment (replica groups get full copies;
+partition-aware placement inside a group). Returns instance lists per
+segment; the controller commits them to ClusterState (IdealState update).
+"""
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict, List, Optional, Sequence
+
+from pinot_tpu.controller.cluster_state import ClusterState, SegmentState
+
+
+def assign_balanced(state: ClusterState, table: str, segment: str,
+                    replication: int = 1) -> List[str]:
+    """Least-loaded placement (ref BalancedNumSegmentAssignment)."""
+    instances = [i.instance_id for i in state.live_instances()]
+    if not instances:
+        raise RuntimeError("no live server instances to assign to")
+    load: Dict[str, int] = defaultdict(int)
+    for seg in state.table_segments(table):
+        for inst in seg.instances:
+            load[inst] += 1
+    ordered = sorted(instances, key=lambda i: (load[i], i))
+    return ordered[:min(replication, len(ordered))]
+
+
+def assign_replica_groups(state: ClusterState, table: str, segment: str,
+                          num_replica_groups: int,
+                          partition_id: Optional[int] = None) -> List[str]:
+    """Replica-group placement (ref ReplicaGroupSegmentAssignment): servers
+    are split into N groups; each group holds a full copy; inside a group
+    the segment goes to partition_id % group_size (partition-aware) or the
+    least-loaded member."""
+    instances = sorted(i.instance_id for i in state.live_instances())
+    if len(instances) < num_replica_groups:
+        raise RuntimeError(
+            f"{len(instances)} instances < {num_replica_groups} replica groups")
+    group_size = len(instances) // num_replica_groups
+    groups = [instances[g * group_size:(g + 1) * group_size]
+              for g in range(num_replica_groups)]
+    load: Dict[str, int] = defaultdict(int)
+    for seg in state.table_segments(table):
+        for inst in seg.instances:
+            load[inst] += 1
+    out = []
+    for group in groups:
+        if partition_id is not None:
+            out.append(group[partition_id % len(group)])
+        else:
+            out.append(min(group, key=lambda i: (load[i], i)))
+    return out
+
+
+def target_assignment(state: ClusterState, table: str,
+                      replication: int = 1,
+                      num_replica_groups: Optional[int] = None
+                      ) -> Dict[str, List[str]]:
+    """Full-table target map used by the rebalancer: round-robin spread in
+    segment-name order (deterministic), honoring the strategy."""
+    segments = sorted(state.table_segments(table), key=lambda s: s.name)
+    instances = sorted(i.instance_id for i in state.live_instances())
+    if not instances:
+        return {}
+    out: Dict[str, List[str]] = {}
+    if num_replica_groups:
+        group_size = len(instances) // num_replica_groups
+        groups = [instances[g * group_size:(g + 1) * group_size]
+                  for g in range(num_replica_groups)]
+        for idx, seg in enumerate(segments):
+            pick = seg.partition_id if seg.partition_id is not None else idx
+            out[seg.name] = [g[pick % len(g)] for g in groups]
+        return out
+    for idx, seg in enumerate(segments):
+        out[seg.name] = [instances[(idx + r) % len(instances)]
+                        for r in range(min(replication, len(instances)))]
+    return out

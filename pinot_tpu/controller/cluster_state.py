@@ -1,0 +1,157 @@
+"""Cluster state: the IdealState/ExternalView + property-store analog.
+
+Reference parity: Helix ZNodes managed by PinotHelixResourceManager —
+table configs + schemas (property store), instance list, per-table
+segment->instances maps (IdealState), and change listeners (the
+ExternalView watch mechanism BrokerRoutingManager relies on,
+SURVEY.md L7). Persistence is a JSON directory instead of ZK; listeners
+are in-process callbacks.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from pinot_tpu.models import Schema, TableConfig
+
+
+@dataclass
+class SegmentState:
+    """One segment's ZK-metadata analog."""
+    name: str
+    table: str                      # physical table name (with type)
+    instances: List[str] = field(default_factory=list)
+    dir_path: Optional[str] = None  # deep-store location (local FS for now)
+    num_docs: int = 0
+    start_time: Optional[int] = None
+    end_time: Optional[int] = None
+    partition_id: Optional[int] = None
+    #: realtime replay checkpoint (ref StreamPartitionMsgOffset in ZK meta)
+    end_offset: Optional[str] = None
+    status: str = "ONLINE"          # ONLINE | CONSUMING | OFFLINE
+
+    def to_dict(self) -> dict:
+        return self.__dict__.copy()
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "SegmentState":
+        return cls(**d)
+
+
+@dataclass
+class InstanceState:
+    instance_id: str
+    host: str = "127.0.0.1"
+    port: int = 0
+    enabled: bool = True
+    tags: List[str] = field(default_factory=list)
+
+
+class ClusterState:
+    def __init__(self, persist_dir: Optional[str] = None):
+        self._lock = threading.RLock()
+        self.tables: Dict[str, TableConfig] = {}        # logical name -> cfg
+        self.schemas: Dict[str, Schema] = {}
+        self.instances: Dict[str, InstanceState] = {}
+        #: physical table -> {segment name -> SegmentState}
+        self.segments: Dict[str, Dict[str, SegmentState]] = {}
+        self._listeners: List[Callable[[str], None]] = []
+        self.persist_dir = persist_dir
+        if persist_dir:
+            os.makedirs(persist_dir, exist_ok=True)
+            self._load()
+
+    # -- listeners (ExternalView watch analog) ------------------------------
+    def add_listener(self, fn: Callable[[str], None]) -> None:
+        """fn(physical_table) fires after any assignment change."""
+        self._listeners.append(fn)
+
+    def _notify(self, physical_table: str) -> None:
+        for fn in list(self._listeners):
+            fn(physical_table)
+
+    # -- CRUD ----------------------------------------------------------------
+    def add_table(self, config: TableConfig, schema: Schema) -> None:
+        with self._lock:
+            self.tables[config.name] = config
+            self.schemas[schema.name] = schema
+            self.segments.setdefault(config.table_name_with_type, {})
+        self._persist()
+
+    def drop_table(self, name: str) -> None:
+        with self._lock:
+            cfg = self.tables.pop(name, None)
+            if cfg is not None:
+                self.segments.pop(cfg.table_name_with_type, None)
+        self._persist()
+
+    def register_instance(self, inst: InstanceState) -> None:
+        with self._lock:
+            self.instances[inst.instance_id] = inst
+        self._persist()
+
+    def live_instances(self) -> List[InstanceState]:
+        with self._lock:
+            return [i for i in self.instances.values() if i.enabled]
+
+    # -- segments ------------------------------------------------------------
+    def upsert_segment(self, state: SegmentState) -> None:
+        with self._lock:
+            self.segments.setdefault(state.table, {})[state.name] = state
+        self._persist()
+        self._notify(state.table)
+
+    def remove_segment(self, table: str, name: str) -> Optional[SegmentState]:
+        with self._lock:
+            st = self.segments.get(table, {}).pop(name, None)
+        if st is not None:
+            self._persist()
+            self._notify(table)
+        return st
+
+    def table_segments(self, table: str) -> List[SegmentState]:
+        with self._lock:
+            return list(self.segments.get(table, {}).values())
+
+    def set_assignment(self, table: str, assignment: Dict[str, List[str]]) -> None:
+        """Bulk update segment->instances (rebalance commit)."""
+        with self._lock:
+            seg_map = self.segments.get(table, {})
+            for name, instances in assignment.items():
+                if name in seg_map:
+                    seg_map[name].instances = list(instances)
+        self._persist()
+        self._notify(table)
+
+    # -- persistence ---------------------------------------------------------
+    def _persist(self) -> None:
+        if not self.persist_dir:
+            return
+        with self._lock:
+            blob = {
+                "tables": {k: v.to_dict() for k, v in self.tables.items()},
+                "schemas": {k: v.to_dict() for k, v in self.schemas.items()},
+                "segments": {t: {n: s.to_dict() for n, s in m.items()}
+                             for t, m in self.segments.items()},
+            }
+        tmp = os.path.join(self.persist_dir, "state.json.tmp")
+        with open(tmp, "w") as f:
+            json.dump(blob, f)
+        os.replace(tmp, os.path.join(self.persist_dir, "state.json"))
+
+    def _load(self) -> None:
+        path = os.path.join(self.persist_dir, "state.json")
+        if not os.path.exists(path):
+            return
+        with open(path) as f:
+            blob = json.load(f)
+        for k, v in blob.get("tables", {}).items():
+            self.tables[k] = TableConfig.from_dict(v)
+        for k, v in blob.get("schemas", {}).items():
+            self.schemas[k] = Schema.from_dict(v)
+        for t, m in blob.get("segments", {}).items():
+            self.segments[t] = {n: SegmentState.from_dict(s)
+                                for n, s in m.items()}

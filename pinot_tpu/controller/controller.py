@@ -1,0 +1,139 @@
+"""Controller facade: table/segment lifecycle + cluster integration.
+
+Reference parity: the PinotHelixResourceManager surface the REST resources
+call into (addTable, addNewSegment, deleteSegment...) plus the periodic
+task loop (RetentionManager, RebalanceChecker, SegmentStatusChecker).
+Integrates with MiniCluster-style deployments by translating ClusterState
+changes into server loads + broker routing rebuilds through the listener
+(the OFFLINE->ONLINE Helix transition analog, SURVEY.md §3.5).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from pinot_tpu.controller import maintenance
+from pinot_tpu.controller.assignment import assign_balanced
+from pinot_tpu.controller.cluster_state import (
+    ClusterState, InstanceState, SegmentState)
+from pinot_tpu.models import Schema, TableConfig
+from pinot_tpu.segment.loader import load_segment
+
+
+class Controller:
+    def __init__(self, state: Optional[ClusterState] = None,
+                 task_output_dir: Optional[str] = None):
+        self.state = state or ClusterState()
+        self.task_output_dir = task_output_dir or os.path.join(
+            os.getcwd(), "controller_tasks")
+        #: instance_id -> (load_fn(table, seg_dir), unload_fn(table, name));
+        #: the state-transition channel to servers (Helix message analog)
+        self._server_hooks: Dict[str, tuple] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- instance / server wiring -------------------------------------------
+    def register_server(self, instance_id: str, load_fn: Callable,
+                        unload_fn: Callable, host: str = "127.0.0.1",
+                        port: int = 0) -> None:
+        self.state.register_instance(InstanceState(instance_id, host, port))
+        self._server_hooks[instance_id] = (load_fn, unload_fn)
+
+    # -- table / segment API (ref REST resources) ---------------------------
+    def add_table(self, config: TableConfig, schema: Schema) -> None:
+        self.state.add_table(config, schema)
+
+    def upload_segment(self, logical_table: str, seg_dir: str,
+                       table_type: str = "OFFLINE",
+                       partition_id: Optional[int] = None) -> SegmentState:
+        """Ref controller upload REST -> assign -> notify servers."""
+        cfg = self.state.tables[logical_table]
+        physical = f"{logical_table}_{table_type}"
+        seg = load_segment(seg_dir)
+        meta = seg.metadata
+        instances = assign_balanced(self.state, physical, meta.segment_name,
+                                    replication=cfg.retention.replication)
+        st = SegmentState(
+            name=meta.segment_name, table=physical, instances=instances,
+            dir_path=seg_dir, num_docs=meta.num_docs,
+            start_time=meta.start_time, end_time=meta.end_time,
+            partition_id=partition_id)
+        self.state.upsert_segment(st)
+        for inst in instances:
+            hooks = self._server_hooks.get(inst)
+            if hooks is not None:
+                hooks[0](physical, seg_dir)  # OFFLINE -> ONLINE
+        return st
+
+    def delete_segment(self, physical_table: str, name: str) -> None:
+        st = self.state.remove_segment(physical_table, name)
+        if st is None:
+            return
+        for inst in st.instances:
+            hooks = self._server_hooks.get(inst)
+            if hooks is not None:
+                hooks[1](physical_table, name)
+
+    # -- periodic loop (ref ControllerPeriodicTask scheduling) --------------
+    def run_maintenance_once(self) -> Dict[str, object]:
+        removed = maintenance.run_retention(self.state)
+        for st in removed:
+            for inst in st.instances:
+                hooks = self._server_hooks.get(inst)
+                if hooks is not None:
+                    hooks[1](st.table, st.name)
+        status = {}
+        for cfg in list(self.state.tables.values()):
+            t = cfg.table_name_with_type
+            status[t] = maintenance.segment_status(
+                self.state, t, cfg.retention.replication)
+        return {"retentionRemoved": [s.name for s in removed],
+                "status": status}
+
+    def start_periodic(self, interval_s: float = 60.0) -> None:
+        def loop():
+            while not self._stop.wait(interval_s):
+                try:
+                    self.run_maintenance_once()
+                except Exception:  # noqa: BLE001 — periodic must survive
+                    import logging
+                    logging.getLogger(__name__).exception("maintenance failed")
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="controller-periodic")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    # -- rebalance (ref TableRebalancer REST) --------------------------------
+    def rebalance(self, logical_table: str, table_type: str = "OFFLINE",
+                  dry_run: bool = False) -> Dict[str, dict]:
+        cfg = self.state.tables[logical_table]
+        physical = f"{logical_table}_{table_type}"
+        before = {s.name: list(s.instances)
+                  for s in self.state.table_segments(physical)}
+        moves = maintenance.rebalance_table(
+            self.state, physical, replication=cfg.retention.replication,
+            dry_run=dry_run)
+        if dry_run:
+            return moves
+        # apply to servers: load on new instances, then unload from old
+        # (minimal-disruption ordering, ref TableRebalancer)
+        for name, mv in moves.items():
+            st = self.state.segments[physical][name]
+            for inst in mv["to"]:
+                if inst not in mv["from"]:
+                    hooks = self._server_hooks.get(inst)
+                    if hooks is not None and st.dir_path:
+                        hooks[0](physical, st.dir_path)
+            for inst in mv["from"]:
+                if inst not in mv["to"]:
+                    hooks = self._server_hooks.get(inst)
+                    if hooks is not None:
+                        hooks[1](physical, name)
+        return moves

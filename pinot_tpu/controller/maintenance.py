@@ -1,0 +1,71 @@
+"""Periodic maintenance: retention, rebalance, status checks.
+
+Reference parity: pinot-controller periodic task framework —
+RetentionManager (retention/RetentionManager.java: drop segments past the
+table's retention window by end-time), TableRebalancer
+(helix/core/rebalance/TableRebalancer.java: move to a target assignment
+with minimal disruption), SegmentStatusChecker (gauges for missing
+replicas).
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional
+
+from pinot_tpu.controller.assignment import target_assignment
+from pinot_tpu.controller.cluster_state import ClusterState, SegmentState
+
+_UNIT_MS = {
+    "MILLISECONDS": 1, "SECONDS": 1000, "MINUTES": 60_000, "HOURS": 3_600_000,
+    "DAYS": 86_400_000,
+}
+
+
+def run_retention(state: ClusterState,
+                  now_ms: Optional[int] = None) -> List[SegmentState]:
+    """Drop segments whose end-time is past retention (ref RetentionManager).
+    Returns the removed segment states (caller unloads them from servers)."""
+    now_ms = now_ms if now_ms is not None else int(time.time() * 1000)
+    removed: List[SegmentState] = []
+    for cfg in list(state.tables.values()):
+        ret = cfg.retention
+        if not ret.retention_time_value or not ret.time_column:
+            continue
+        window_ms = int(ret.retention_time_value) * _UNIT_MS.get(
+            (ret.retention_time_unit or "DAYS").upper(), 86_400_000)
+        cutoff = now_ms - window_ms
+        table = cfg.table_name_with_type
+        for seg in state.table_segments(table):
+            if seg.status == "CONSUMING":
+                continue
+            if seg.end_time is not None and seg.end_time < cutoff:
+                state.remove_segment(table, seg.name)
+                removed.append(seg)
+    return removed
+
+
+def rebalance_table(state: ClusterState, table: str, replication: int = 1,
+                    num_replica_groups: Optional[int] = None,
+                    dry_run: bool = False) -> Dict[str, dict]:
+    """Move the table to its target assignment (ref TableRebalancer).
+    Returns {segment: {'from': [...], 'to': [...]}} for segments that move."""
+    target = target_assignment(state, table, replication, num_replica_groups)
+    moves: Dict[str, dict] = {}
+    current = {s.name: s.instances for s in state.table_segments(table)}
+    for name, to in target.items():
+        frm = current.get(name, [])
+        if sorted(frm) != sorted(to):
+            moves[name] = {"from": frm, "to": to}
+    if not dry_run and moves:
+        state.set_assignment(table, {n: m["to"] for n, m in moves.items()})
+    return moves
+
+
+def segment_status(state: ClusterState, table: str,
+                   expected_replication: int = 1) -> Dict[str, int]:
+    """Ref SegmentStatusChecker gauges."""
+    segs = state.table_segments(table)
+    missing = sum(1 for s in segs if len(s.instances) < expected_replication)
+    offline = sum(1 for s in segs if s.status == "OFFLINE")
+    return {"numSegments": len(segs), "segmentsMissingReplicas": missing,
+            "segmentsOffline": offline}

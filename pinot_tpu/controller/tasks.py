@@ -1,0 +1,243 @@
+"""Minion-style background tasks: merge-rollup, realtime-to-offline, purge.
+
+Reference parity: pinot-minion + pinot-controller minion/PinotTaskManager:84
+— generators scan cluster state and emit task configs; executors run them
+(ref TaskFactoryRegistry bridging the Helix Task Framework to
+PinotTaskExecutor). Without Helix, tasks run on a local thread pool with
+the same generate/execute split, so distributed workers can be added
+behind the same interfaces.
+
+MergeRollupTask: merge N small segments of a time bucket into one
+(ref pinot-plugins minion-tasks merge-rollup).
+RealtimeToOfflineTask: move completed realtime segments' rows into the
+OFFLINE table (ref realtime-to-offline-segments task).
+PurgeTask: rewrite segments dropping rows matching a predicate.
+"""
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from pinot_tpu.controller.cluster_state import ClusterState, SegmentState
+from pinot_tpu.models import Schema, TableConfig
+from pinot_tpu.segment.creator import SegmentCreator
+from pinot_tpu.segment.loader import ImmutableSegment, load_segment
+
+
+@dataclass
+class TaskConfig:
+    task_type: str
+    table: str                      # physical table name
+    segments: List[str]
+    params: Dict[str, Any] = field(default_factory=dict)
+
+
+class TaskExecutor:
+    """Ref PinotTaskExecutor."""
+    task_type = ""
+
+    def execute(self, task: TaskConfig, ctx: "TaskContext") -> Dict[str, Any]:
+        raise NotImplementedError
+
+
+@dataclass
+class TaskContext:
+    state: ClusterState
+    output_dir: str
+
+    def table_config(self, physical_table: str) -> TableConfig:
+        base = physical_table.rsplit("_", 1)[0]
+        return self.state.tables[base]
+
+    def schema_for(self, physical_table: str) -> Schema:
+        base = physical_table.rsplit("_", 1)[0]
+        return self.state.schemas[base]
+
+    def load(self, table: str, name: str) -> ImmutableSegment:
+        seg_map = self.state.segments.get(table, {})
+        st = seg_map[name]
+        return load_segment(st.dir_path)
+
+
+def _segments_to_columns(segs: Sequence[ImmutableSegment],
+                         schema: Schema) -> Dict[str, list]:
+    cols: Dict[str, list] = {}
+    for spec in schema.fields:
+        if spec.virtual:
+            continue
+        parts = []
+        for s in segs:
+            if s.has_column(spec.name):
+                vals = s.data_source(spec.name).values()
+                parts.append(list(vals) if not isinstance(vals, list) else vals)
+            else:
+                parts.append([None] * s.num_docs)
+        cols[spec.name] = [v for p in parts for v in p]
+    return cols
+
+
+class MergeRollupTaskExecutor(TaskExecutor):
+    """Merge small segments; optional rollup aggregates duplicate dim rows
+    (ref MergeRollupTask: CONCAT and ROLLUP merge types)."""
+    task_type = "MergeRollupTask"
+
+    def execute(self, task: TaskConfig, ctx: TaskContext) -> Dict[str, Any]:
+        table = task.table
+        cfg = ctx.table_config(table)
+        schema = ctx.schema_for(table)
+        segs = [ctx.load(table, n) for n in task.segments]
+        columns = _segments_to_columns(segs, schema)
+        if task.params.get("mergeType", "CONCAT").upper() == "ROLLUP":
+            columns = _rollup(columns, schema)
+        name = task.params.get(
+            "segmentName",
+            f"{cfg.name}_merged_{int(time.time())}_{task.segments[0][-8:]}")
+        out_dir = os.path.join(ctx.output_dir, name)
+        SegmentCreator(cfg, schema).build(columns, out_dir, name)
+        merged = load_segment(out_dir)
+        meta = merged.metadata
+        ctx.state.upsert_segment(SegmentState(
+            name=name, table=table, instances=[], dir_path=out_dir,
+            num_docs=meta.num_docs, start_time=meta.start_time,
+            end_time=meta.end_time))
+        for old in task.segments:
+            ctx.state.remove_segment(table, old)
+        return {"mergedSegment": name, "numDocs": meta.num_docs,
+                "replaced": task.segments}
+
+
+def _rollup(columns: Dict[str, list], schema: Schema) -> Dict[str, list]:
+    """Aggregate metric columns over identical dimension tuples."""
+    from pinot_tpu.models import FieldType
+    dim_names = [f.name for f in schema.fields
+                 if f.field_type is not FieldType.METRIC and not f.virtual]
+    met_names = [f.name for f in schema.fields
+                 if f.field_type is FieldType.METRIC and not f.virtual]
+    keys: Dict[tuple, int] = {}
+    out: Dict[str, list] = {c: [] for c in columns}
+    for i in range(len(next(iter(columns.values())))):
+        key = tuple(columns[d][i] for d in dim_names)
+        at = keys.get(key)
+        if at is None:
+            keys[key] = len(out[dim_names[0]]) if dim_names else i
+            for c in columns:
+                out[c].append(columns[c][i])
+        else:
+            for m in met_names:
+                out[m][at] = out[m][at] + columns[m][i]
+    return out
+
+
+class RealtimeToOfflineTaskExecutor(TaskExecutor):
+    """Move sealed realtime segments' rows into the OFFLINE table
+    (ref RealtimeToOfflineSegmentsTask)."""
+    task_type = "RealtimeToOfflineSegmentsTask"
+
+    def execute(self, task: TaskConfig, ctx: TaskContext) -> Dict[str, Any]:
+        rt_table = task.table
+        base = rt_table.rsplit("_", 1)[0]
+        off_table = f"{base}_OFFLINE"
+        cfg = ctx.table_config(rt_table)
+        schema = ctx.schema_for(rt_table)
+        segs = [ctx.load(rt_table, n) for n in task.segments]
+        columns = _segments_to_columns(segs, schema)
+        name = f"{base}_r2o_{int(time.time())}_{len(task.segments)}"
+        out_dir = os.path.join(ctx.output_dir, name)
+        SegmentCreator(cfg, schema).build(columns, out_dir, name)
+        merged = load_segment(out_dir)
+        ctx.state.upsert_segment(SegmentState(
+            name=name, table=off_table, instances=[], dir_path=out_dir,
+            num_docs=merged.num_docs,
+            start_time=merged.metadata.start_time,
+            end_time=merged.metadata.end_time))
+        for old in task.segments:
+            ctx.state.remove_segment(rt_table, old)
+        return {"offlineSegment": name, "numDocs": merged.num_docs}
+
+
+class PurgeTaskExecutor(TaskExecutor):
+    """Rewrite segments dropping rows the purge predicate matches
+    (ref PurgeTask with a RecordPurger)."""
+    task_type = "PurgeTask"
+
+    def execute(self, task: TaskConfig, ctx: TaskContext) -> Dict[str, Any]:
+        from pinot_tpu.ingest.transforms import parse_expression
+        from pinot_tpu.query.filter import evaluate_filter
+        table = task.table
+        cfg = ctx.table_config(table)
+        schema = ctx.schema_for(table)
+        predicate = parse_expression(task.params["purgePredicate"])
+        purged = []
+        for seg_name in task.segments:
+            seg = ctx.load(table, seg_name)
+            drop = evaluate_filter(seg, predicate)
+            if not drop.any():
+                continue
+            keep = ~drop
+            columns = {}
+            for spec in schema.fields:
+                if spec.virtual:
+                    continue
+                vals = np.asarray(seg.data_source(spec.name).values())
+                columns[spec.name] = vals[keep]
+            name = f"{seg_name}_purged"
+            out_dir = os.path.join(ctx.output_dir, name)
+            SegmentCreator(cfg, schema).build(columns, out_dir, name)
+            m = load_segment(out_dir).metadata
+            old_state = ctx.state.segments[table][seg_name]
+            ctx.state.upsert_segment(SegmentState(
+                name=name, table=table, instances=list(old_state.instances),
+                dir_path=out_dir, num_docs=m.num_docs,
+                start_time=m.start_time, end_time=m.end_time))
+            ctx.state.remove_segment(table, seg_name)
+            purged.append(name)
+        return {"purgedSegments": purged}
+
+
+# -- generators (ref PinotTaskGenerator) ------------------------------------
+
+def generate_merge_rollup_tasks(state: ClusterState, table: str,
+                                max_docs_per_merged: int = 5_000_000,
+                                min_segments: int = 2) -> List[TaskConfig]:
+    """Group small ONLINE segments into merge buckets."""
+    segs = sorted((s for s in state.table_segments(table)
+                   if s.status == "ONLINE"),
+                  key=lambda s: (s.start_time or 0, s.name))
+    tasks: List[TaskConfig] = []
+    bucket: List[SegmentState] = []
+    docs = 0
+    for s in segs:
+        if docs + s.num_docs > max_docs_per_merged and len(bucket) >= min_segments:
+            tasks.append(TaskConfig("MergeRollupTask", table,
+                                    [b.name for b in bucket]))
+            bucket, docs = [], 0
+        bucket.append(s)
+        docs += s.num_docs
+    if len(bucket) >= min_segments:
+        tasks.append(TaskConfig("MergeRollupTask", table,
+                                [b.name for b in bucket]))
+    return tasks
+
+
+_EXECUTORS: Dict[str, TaskExecutor] = {}
+
+
+def register_executor(ex: TaskExecutor) -> None:
+    _EXECUTORS[ex.task_type] = ex
+
+
+def run_task(task: TaskConfig, ctx: TaskContext) -> Dict[str, Any]:
+    """Ref TaskFactoryRegistry.executeTask."""
+    ex = _EXECUTORS.get(task.task_type)
+    if ex is None:
+        raise ValueError(f"no executor for task type {task.task_type!r}")
+    return ex.execute(task, ctx)
+
+
+register_executor(MergeRollupTaskExecutor())
+register_executor(RealtimeToOfflineTaskExecutor())
+register_executor(PurgeTaskExecutor())

@@ -48,6 +48,19 @@ class RealtimeSegmentDataManager:
         self.on_commit = on_commit
         self.pipeline = TransformPipeline(table_config, schema)
         self.delay_tracker = ingestion_delay_tracker
+        # upsert/dedup metadata (ref RealtimeTableDataManager wiring)
+        self.upsert_manager = None
+        self.dedup_manager = None
+        if table_config.upsert is not None:
+            from pinot_tpu.segment.upsert import PartitionUpsertMetadataManager
+            cmp_col = (table_config.upsert.comparison_column
+                       or table_config.retention.time_column)
+            self.upsert_manager = PartitionUpsertMetadataManager(
+                schema.primary_key_columns, cmp_col)
+        elif table_config.dedup is not None:
+            from pinot_tpu.segment.upsert import PartitionDedupMetadataManager
+            self.dedup_manager = PartitionDedupMetadataManager(
+                schema.primary_key_columns)
 
         factory = get_stream_factory(stream_config)
         self.consumer = factory.create_partition_consumer(stream_config, partition_id)
@@ -103,8 +116,14 @@ class RealtimeSegmentDataManager:
                 try:
                     with self._seal_lock:
                         rec = self.pipeline.transform(msg.value)
-                        if rec is not None:
+                        if rec is not None and (
+                                self.dedup_manager is None
+                                or self.dedup_manager.check_and_add(rec)):
+                            doc_id = self.mutable.num_docs
                             self.mutable.index(rec)
+                            if self.upsert_manager is not None:
+                                self.upsert_manager.add_row(
+                                    self.mutable, doc_id, rec)
                         self.current_offset = msg.offset.next()
                 except Exception:  # noqa: BLE001 — one bad row must not
                     # kill the partition consumer (ref: reference skips
@@ -152,6 +171,13 @@ class RealtimeSegmentDataManager:
         creator = SegmentCreator(self.table_config, self.schema)
         creator.build(sealed.to_columns(), out_dir, name)
         immutable = load_segment(out_dir)
+        if self.upsert_manager is not None:
+            # transfer validity: the immutable copy inherits the mutable's
+            # valid bitmap and takes over its map entries (ref
+            # replaceSegment in the upsert manager)
+            immutable.valid_doc_ids = sealed.valid_doc_ids                 if getattr(sealed, "valid_doc_ids", None) is not None else None
+            self.upsert_manager.add_segment(immutable)
+            self.upsert_manager.remove_segment(sealed)
         # swap BEFORE removing: add_segment replaces by name atomically
         self.tdm.add_segment(immutable)
         if self.on_commit is not None:

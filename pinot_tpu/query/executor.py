@@ -59,9 +59,12 @@ class QueryExecutor:
 
         # consuming (mutable) segments always run host-side: their columns
         # are unsorted-dict/append buffers, not stageable blocks
-        device_candidates = [s for s in selected
-                             if isinstance(s, ImmutableSegment)]
-        host_only = [s for s in selected if not isinstance(s, ImmutableSegment)]
+        device_candidates = [
+            s for s in selected
+            if isinstance(s, ImmutableSegment)
+            and getattr(s, "valid_doc_ids", None) is None]
+        dc = set(id(s) for s in device_candidates)
+        host_only = [s for s in selected if id(s) not in dc]
         remaining = device_candidates
         if self._use_tpu and device_candidates:
             engine = self.tpu_engine

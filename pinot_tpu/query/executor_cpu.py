@@ -52,6 +52,15 @@ def _execute_segment(seg: ImmutableSegment, ctx: QueryContext):
             return result
     provider = SegmentColumnProvider(seg)
     mask = evaluate_filter(seg, ctx.filter, provider)
+    # upsert: only the latest row per primary key is visible
+    # (ref: queries AND validDocIds into their filter, SURVEY.md §2.3)
+    valid = getattr(seg, "valid_doc_ids", None)
+    if valid is not None:
+        vmask = valid.to_mask()
+        if len(vmask) < seg.num_docs:  # growing mutable segment
+            vmask = np.concatenate(
+                [vmask, np.zeros(seg.num_docs - len(vmask), bool)])
+        mask &= vmask[:seg.num_docs]
     stats = ExecutionStats(
         num_docs_scanned=int(np.count_nonzero(mask)),
         num_entries_scanned_in_filter=(

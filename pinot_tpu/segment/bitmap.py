@@ -112,6 +112,17 @@ class Bitmap:
     def contains(self, doc_id: int) -> bool:
         return bool((self._bytes[doc_id >> 3] >> (7 - (doc_id & 7))) & 1)
 
+    def clear(self, doc_id: int) -> None:
+        self._bytes[doc_id >> 3] &= np.uint8(0xFF ^ (0x80 >> (doc_id & 7)))
+
+    def resize(self, num_docs: int) -> None:
+        """Grow in place (mutable/realtime usage; bits init to 0)."""
+        nbytes = (num_docs + 7) // 8
+        if nbytes > len(self._bytes):
+            self._bytes = np.concatenate(
+                [self._bytes, np.zeros(nbytes - len(self._bytes), np.uint8)])
+        self.num_docs = num_docs
+
     def set(self, doc_id: int) -> None:
         self._bytes[doc_id >> 3] |= np.uint8(1 << (7 - (doc_id & 7)))
 

@@ -1,0 +1,173 @@
+"""Upsert & dedup metadata managers.
+
+Reference parity: pinot-segment-local upsert/
+ConcurrentMapPartitionUpsertMetadataManager.java:48 — a per-partition
+primary-key map to (segment, docId, comparisonValue); per-segment
+validDocIds bitmaps that queries AND into their filter mask; later
+(or equal, last-wins) comparison values replace earlier rows. Partial
+upsert merge strategies live in merger functions (ref upsert/merger/).
+Dedup analog: ConcurrentMapPartitionDedupMetadataManager (dedup/).
+
+Query integration: segments gain a `valid_doc_ids` attribute; the host
+executor ANDs it into the filter mask, and the device engine excludes
+upsert segments (they are realtime-sized; SURVEY.md §2.3).
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from pinot_tpu.segment.bitmap import Bitmap
+
+
+@dataclass
+class _RecordLocation:
+    segment: Any          # object with .name and .valid_doc_ids
+    doc_id: int
+    comparison_value: Any
+
+
+def _pk_of(record_or_row, pk_columns: Sequence[str]) -> tuple:
+    return tuple(record_or_row[c] for c in pk_columns)
+
+
+class PartitionUpsertMetadataManager:
+    """One stream partition's upsert state (ref :48)."""
+
+    def __init__(self, pk_columns: Sequence[str], comparison_column: str,
+                 partial_merger: Optional[Callable[[dict, dict], dict]] = None):
+        self.pk_columns = list(pk_columns)
+        self.comparison_column = comparison_column
+        self.partial_merger = partial_merger
+        self._map: Dict[tuple, _RecordLocation] = {}
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------------
+    def add_segment(self, segment) -> None:
+        """Register an (im)mutable segment's rows; later comparison values
+        win, losers are invalidated in their owning segment's bitmap."""
+        n = segment.num_docs
+        valid = Bitmap.all_set(n)
+        segment.valid_doc_ids = valid
+        pk_cols = [np.asarray(segment.data_source(c).values())
+                   for c in self.pk_columns]
+        cmp_col = np.asarray(segment.data_source(self.comparison_column).values())
+        with self._lock:
+            for doc_id in range(n):
+                pk = tuple(_py(col[doc_id]) for col in pk_cols)
+                self._upsert_locked(segment, doc_id, _py(cmp_col[doc_id]), pk,
+                                    valid)
+
+    def add_row(self, segment, doc_id: int, record: Dict[str, Any]) -> None:
+        """Realtime path: account one newly indexed row (ref addRecord)."""
+        if getattr(segment, "valid_doc_ids", None) is None:
+            segment.valid_doc_ids = Bitmap(0)
+        valid = segment.valid_doc_ids
+        if valid.num_docs <= doc_id:
+            valid.resize(doc_id + 1)
+        valid.set(doc_id)
+        pk = _pk_of(record, self.pk_columns)
+        cmp_value = record[self.comparison_column]
+        with self._lock:
+            self._upsert_locked(segment, doc_id, cmp_value, pk, valid)
+
+    def _upsert_locked(self, segment, doc_id, cmp_value, pk, valid) -> None:
+        cur = self._map.get(pk)
+        if cur is not None:
+            if _cmp_ge(cmp_value, cur.comparison_value):
+                cur.segment.valid_doc_ids.clear(cur.doc_id)
+                self._map[pk] = _RecordLocation(segment, doc_id, cmp_value)
+            else:
+                valid.clear(doc_id)
+        else:
+            self._map[pk] = _RecordLocation(segment, doc_id, cmp_value)
+
+    def merge_record(self, previous: Optional[dict], record: dict) -> dict:
+        """Partial-upsert merge (ref upsert/merger/): with no merger
+        configured the new record fully replaces the old."""
+        if self.partial_merger is None or previous is None:
+            return record
+        return self.partial_merger(previous, record)
+
+    def remove_segment(self, segment) -> None:
+        """Ref removeSegment: drop map entries still pointing at it."""
+        with self._lock:
+            dead = [pk for pk, loc in self._map.items()
+                    if loc.segment is segment]
+            for pk in dead:
+                del self._map[pk]
+
+    def lookup(self, pk: tuple) -> Optional[Tuple[Any, int]]:
+        with self._lock:
+            loc = self._map.get(pk)
+            return (loc.segment, loc.doc_id) if loc else None
+
+    @property
+    def num_primary_keys(self) -> int:
+        with self._lock:
+            return len(self._map)
+
+
+class PartitionDedupMetadataManager:
+    """Drop exact-duplicate primary keys at ingestion time
+    (ref dedup/ConcurrentMapPartitionDedupMetadataManager)."""
+
+    def __init__(self, pk_columns: Sequence[str]):
+        self.pk_columns = list(pk_columns)
+        self._seen: set = set()
+        self._lock = threading.Lock()
+
+    def check_and_add(self, record: Dict[str, Any]) -> bool:
+        """True when the record is new (should be ingested)."""
+        pk = _pk_of(record, self.pk_columns)
+        with self._lock:
+            if pk in self._seen:
+                return False
+            self._seen.add(pk)
+            return True
+
+    @property
+    def num_primary_keys(self) -> int:
+        with self._lock:
+            return len(self._seen)
+
+
+# partial-upsert merge strategies (ref upsert/merger/)
+def overwrite_merger(previous: dict, record: dict) -> dict:
+    return record
+
+
+def ignore_nulls_merger(previous: dict, record: dict) -> dict:
+    """OVERWRITE per column but keep previous value where new is null."""
+    out = dict(previous)
+    for k, v in record.items():
+        if v is not None:
+            out[k] = v
+    return out
+
+
+def increment_merger(columns: Sequence[str]):
+    """INCREMENT for listed columns, overwrite otherwise."""
+    cols = set(columns)
+
+    def merge(previous: dict, record: dict) -> dict:
+        out = dict(record)
+        for c in cols:
+            if previous.get(c) is not None and record.get(c) is not None:
+                out[c] = previous[c] + record[c]
+        return out
+    return merge
+
+
+def _cmp_ge(a, b) -> bool:
+    try:
+        return a >= b
+    except TypeError:
+        return str(a) >= str(b)
+
+
+def _py(v):
+    return v.item() if isinstance(v, np.generic) else v

@@ -1,0 +1,1 @@
+"""CLI tools (ref pinot-tools: PinotAdministrator + quickstarts)."""

@@ -1,0 +1,119 @@
+"""Batch ingestion job + CLI admin (ref LaunchDataIngestionJob flow)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from pinot_tpu.ingest.batch import (
+    IngestionJobSpec, read_records, run_ingestion_job)
+from pinot_tpu.models import (DataType, FieldSpec, FieldType, IngestionConfig,
+                              Schema, TableConfig, TableType)
+from pinot_tpu.query.executor import QueryExecutor
+from pinot_tpu.segment.loader import load_segment
+
+
+def make_schema():
+    return Schema("bt", [
+        FieldSpec("name", DataType.STRING),
+        FieldSpec("score", DataType.INT, FieldType.METRIC),
+        FieldSpec("bonus", DataType.DOUBLE, FieldType.METRIC),
+    ])
+
+
+class TestReaders:
+    def test_csv(self, tmp_path):
+        p = tmp_path / "a.csv"
+        p.write_text("name,score,bonus\nalice,10,1.5\nbob,20,\n")
+        rows = list(read_records(str(p)))
+        assert rows == [{"name": "alice", "score": "10", "bonus": "1.5"},
+                        {"name": "bob", "score": "20", "bonus": None}]
+
+    def test_jsonl(self, tmp_path):
+        p = tmp_path / "a.jsonl"
+        p.write_text('{"name": "x", "score": 1}\n{"name": "y", "score": 2}\n')
+        assert len(list(read_records(str(p)))) == 2
+
+    def test_json_array(self, tmp_path):
+        p = tmp_path / "a.json"
+        p.write_text('[{"name": "x"}, {"name": "y"}]')
+        assert len(list(read_records(str(p)))) == 2
+
+
+class TestIngestionJob:
+    def test_csv_to_segments_to_query(self, tmp_path):
+        for i in range(2):
+            (tmp_path / f"in_{i}.csv").write_text(
+                "name,score,bonus\n" +
+                "\n".join(f"n{j},{j},{j}.5" for j in range(100)) + "\n")
+        tc = TableConfig("bt", TableType.OFFLINE)
+        spec = IngestionJobSpec(
+            input_pattern=str(tmp_path / "in_*.csv"),
+            output_dir=str(tmp_path / "out"),
+            table_config=tc, schema=make_schema())
+        dirs = run_ingestion_job(spec)
+        assert len(dirs) == 2  # one per file
+        segs = [load_segment(d) for d in dirs]
+        ex = QueryExecutor(segs, use_tpu=False)
+        r = ex.execute("SELECT COUNT(*), SUM(score) FROM bt")
+        assert r.rows[0][0] == 200
+        assert r.rows[0][1] == pytest.approx(2 * sum(range(100)))
+
+    def test_rows_per_segment_split(self, tmp_path):
+        (tmp_path / "in.csv").write_text(
+            "name,score,bonus\n" +
+            "\n".join(f"n{j},{j},0.0" for j in range(250)) + "\n")
+        tc = TableConfig("bt", TableType.OFFLINE)
+        spec = IngestionJobSpec(
+            input_pattern=str(tmp_path / "in.csv"),
+            output_dir=str(tmp_path / "out"),
+            table_config=tc, schema=make_schema(), rows_per_segment=100)
+        dirs = run_ingestion_job(spec)
+        assert len(dirs) == 3  # 100 + 100 + 50
+        assert sum(load_segment(d).num_docs for d in dirs) == 250
+
+    def test_transforms_and_filter_applied(self, tmp_path):
+        (tmp_path / "in.jsonl").write_text(
+            "\n".join(json.dumps({"name": f"n{j}", "score": j})
+                      for j in range(50)))
+        tc = TableConfig("bt", TableType.OFFLINE)
+        tc.ingestion = IngestionConfig(
+            transform_configs=[
+                {"columnName": "bonus", "transformFunction": "score * 2"}],
+            filter_function="score >= 25")
+        spec = IngestionJobSpec(
+            input_pattern=str(tmp_path / "in.jsonl"),
+            output_dir=str(tmp_path / "out"),
+            table_config=tc, schema=make_schema())
+        dirs = run_ingestion_job(spec)
+        seg = load_segment(dirs[0])
+        assert seg.num_docs == 25  # score >= 25 dropped
+        ex = QueryExecutor([seg], use_tpu=False)
+        r = ex.execute("SELECT SUM(bonus) FROM bt")
+        assert r.rows[0][0] == pytest.approx(2.0 * sum(range(25)))
+
+
+class TestAdminCli:
+    def test_ingest_and_post_query_flow(self, tmp_path):
+        from pinot_tpu.tools import admin
+        (tmp_path / "data.csv").write_text(
+            "name,score,bonus\n" +
+            "\n".join(f"n{j},{j},1.0" for j in range(30)) + "\n")
+        (tmp_path / "table.json").write_text(json.dumps(
+            TableConfig("bt", TableType.OFFLINE).to_dict()))
+        (tmp_path / "schema.json").write_text(json.dumps(
+            make_schema().to_dict()))
+        rc = admin.main([
+            "LaunchDataIngestionJob",
+            "--table", str(tmp_path / "table.json"),
+            "--schema", str(tmp_path / "schema.json"),
+            "--input", str(tmp_path / "data.csv"),
+            "--output", str(tmp_path / "segments")])
+        assert rc == 0
+        assert os.path.isdir(tmp_path / "segments" / "bt_0")
+
+    def test_quickstart_exits_cleanly(self):
+        from pinot_tpu.tools import admin
+        rc = admin.main(["Quickstart", "--rows", "5000", "--no-tpu",
+                         "--exit-after-queries", "--port", "0"])
+        assert rc == 0

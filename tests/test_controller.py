@@ -1,0 +1,235 @@
+"""Controller-lite: state, assignment, retention, rebalance, minion tasks."""
+import os
+import time
+
+import numpy as np
+import pytest
+
+from pinot_tpu.controller import ClusterState, Controller, SegmentState
+from pinot_tpu.controller.assignment import (
+    assign_balanced, assign_replica_groups, target_assignment)
+from pinot_tpu.controller.cluster_state import InstanceState
+from pinot_tpu.controller.maintenance import (
+    rebalance_table, run_retention, segment_status)
+from pinot_tpu.controller.tasks import (
+    TaskConfig, TaskContext, generate_merge_rollup_tasks, run_task)
+from pinot_tpu.models import (DataType, FieldSpec, FieldType, Schema,
+                              TableConfig, TableType)
+from pinot_tpu.segment.creator import SegmentCreator
+from pinot_tpu.segment.loader import load_segment
+
+
+def make_schema():
+    return Schema("ct", [
+        FieldSpec("d", DataType.STRING),
+        FieldSpec("ts", DataType.LONG, FieldType.DATE_TIME),
+        FieldSpec("m", DataType.LONG, FieldType.METRIC),
+    ])
+
+
+def make_config(**kw):
+    tc = TableConfig("ct", TableType.OFFLINE)
+    tc.retention.time_column = "ts"
+    for k, v in kw.items():
+        setattr(tc.retention, k, v)
+    return tc
+
+
+def build_seg(tmp, name, n=100, ts_base=0, seed=0):
+    rng = np.random.default_rng(seed)
+    cols = {"d": [f"k{v}" for v in rng.integers(0, 5, n)],
+            "ts": (ts_base + np.arange(n)).astype(np.int64),
+            "m": rng.integers(0, 100, n).astype(np.int64)}
+    out = str(tmp / name)
+    SegmentCreator(make_config(), make_schema()).build(cols, out, name)
+    return out
+
+
+class TestAssignment:
+    def _state(self, n_servers=4):
+        st = ClusterState()
+        for i in range(n_servers):
+            st.register_instance(InstanceState(f"server_{i}"))
+        st.add_table(make_config(), make_schema())
+        return st
+
+    def test_balanced_least_loaded(self):
+        st = self._state(3)
+        st.upsert_segment(SegmentState("s0", "ct_OFFLINE", ["server_0"]))
+        st.upsert_segment(SegmentState("s1", "ct_OFFLINE", ["server_1"]))
+        out = assign_balanced(st, "ct_OFFLINE", "s2")
+        assert out == ["server_2"]
+
+    def test_replication(self):
+        st = self._state(3)
+        out = assign_balanced(st, "ct_OFFLINE", "s0", replication=2)
+        assert len(out) == 2 and len(set(out)) == 2
+
+    def test_replica_groups(self):
+        st = self._state(4)
+        out = assign_replica_groups(st, "ct_OFFLINE", "s0",
+                                    num_replica_groups=2)
+        assert len(out) == 2
+        # one from each half
+        assert out[0] in ("server_0", "server_1")
+        assert out[1] in ("server_2", "server_3")
+
+    def test_partition_aware_groups(self):
+        st = self._state(4)
+        a = assign_replica_groups(st, "ct_OFFLINE", "s0", 2, partition_id=0)
+        b = assign_replica_groups(st, "ct_OFFLINE", "s1", 2, partition_id=1)
+        assert a != b
+
+
+class TestRetention:
+    def test_expired_segments_removed(self, tmp_path):
+        st = ClusterState()
+        cfg = make_config(retention_time_value=1, retention_time_unit="DAYS")
+        st.add_table(cfg, make_schema())
+        now = int(time.time() * 1000)
+        old = SegmentState("old", "ct_OFFLINE", [], end_time=now - 2 * 86_400_000)
+        new = SegmentState("new", "ct_OFFLINE", [], end_time=now)
+        consuming = SegmentState("c", "ct_OFFLINE", [], status="CONSUMING",
+                                 end_time=now - 9 * 86_400_000)
+        for s in (old, new, consuming):
+            st.upsert_segment(s)
+        removed = run_retention(st, now_ms=now)
+        assert [s.name for s in removed] == ["old"]
+        names = {s.name for s in st.table_segments("ct_OFFLINE")}
+        assert names == {"new", "c"}
+
+
+class TestRebalance:
+    def test_rebalance_moves_to_target(self):
+        st = ClusterState()
+        for i in range(2):
+            st.register_instance(InstanceState(f"server_{i}"))
+        st.add_table(make_config(), make_schema())
+        # all segments piled on server_0
+        for i in range(4):
+            st.upsert_segment(SegmentState(f"s{i}", "ct_OFFLINE", ["server_0"]))
+        moves = rebalance_table(st, "ct_OFFLINE", dry_run=True)
+        assert moves  # imbalance detected
+        rebalance_table(st, "ct_OFFLINE")
+        loads = {}
+        for s in st.table_segments("ct_OFFLINE"):
+            for inst in s.instances:
+                loads[inst] = loads.get(inst, 0) + 1
+        assert loads == {"server_0": 2, "server_1": 2}
+
+    def test_status_checker(self):
+        st = ClusterState()
+        st.add_table(make_config(), make_schema())
+        st.upsert_segment(SegmentState("a", "ct_OFFLINE", ["server_0"]))
+        st.upsert_segment(SegmentState("b", "ct_OFFLINE", []))
+        out = segment_status(st, "ct_OFFLINE", expected_replication=1)
+        assert out == {"numSegments": 2, "segmentsMissingReplicas": 1,
+                       "segmentsOffline": 0}
+
+
+class TestMinionTasks:
+    def _ctx(self, tmp_path):
+        st = ClusterState()
+        st.add_table(make_config(), make_schema())
+        return st, TaskContext(st, str(tmp_path / "task_out"))
+
+    def test_merge_rollup_concat(self, tmp_path):
+        st, ctx = self._ctx(tmp_path)
+        for i in range(3):
+            d = build_seg(tmp_path, f"seg_{i}", n=100, ts_base=i * 1000, seed=i)
+            m = load_segment(d).metadata
+            st.upsert_segment(SegmentState(
+                f"seg_{i}", "ct_OFFLINE", [], dir_path=d, num_docs=100,
+                start_time=m.start_time, end_time=m.end_time))
+        tasks = generate_merge_rollup_tasks(st, "ct_OFFLINE")
+        assert len(tasks) == 1 and len(tasks[0].segments) == 3
+        out = run_task(tasks[0], ctx)
+        assert out["numDocs"] == 300
+        segs = st.table_segments("ct_OFFLINE")
+        assert len(segs) == 1 and segs[0].num_docs == 300
+        merged = load_segment(segs[0].dir_path)
+        assert merged.num_docs == 300
+
+    def test_merge_rollup_rollup(self, tmp_path):
+        st, ctx = self._ctx(tmp_path)
+        cols = {"d": ["a", "a", "b"], "ts": np.array([1, 1, 2], dtype=np.int64),
+                "m": np.array([10, 5, 7], dtype=np.int64)}
+        d = str(tmp_path / "r0")
+        SegmentCreator(make_config(), make_schema()).build(cols, d, "r0")
+        st.upsert_segment(SegmentState("r0", "ct_OFFLINE", [], dir_path=d,
+                                       num_docs=3))
+        out = run_task(TaskConfig("MergeRollupTask", "ct_OFFLINE", ["r0"],
+                                  {"mergeType": "ROLLUP"}), ctx)
+        merged = load_segment(st.table_segments("ct_OFFLINE")[0].dir_path)
+        assert merged.num_docs == 2  # (a,1) rolled up
+        from pinot_tpu.query.executor import QueryExecutor
+        r = QueryExecutor([merged], use_tpu=False).execute(
+            "SELECT d, SUM(m) FROM ct GROUP BY d ORDER BY d LIMIT 10")
+        assert r.rows == [("a", 15.0), ("b", 7.0)]
+
+    def test_realtime_to_offline(self, tmp_path):
+        st = ClusterState()
+        cfg = TableConfig("ct", TableType.REALTIME)
+        cfg.retention.time_column = "ts"
+        st.add_table(cfg, make_schema())
+        ctx = TaskContext(st, str(tmp_path / "task_out"))
+        d = build_seg(tmp_path, "rt0", n=50)
+        st.upsert_segment(SegmentState("rt0", "ct_REALTIME", [], dir_path=d,
+                                       num_docs=50))
+        out = run_task(TaskConfig("RealtimeToOfflineSegmentsTask",
+                                  "ct_REALTIME", ["rt0"]), ctx)
+        assert out["numDocs"] == 50
+        assert not st.table_segments("ct_REALTIME")
+        assert len(st.table_segments("ct_OFFLINE")) == 1
+
+    def test_purge(self, tmp_path):
+        st, ctx = self._ctx(tmp_path)
+        d = build_seg(tmp_path, "p0", n=100)
+        st.upsert_segment(SegmentState("p0", "ct_OFFLINE", [], dir_path=d,
+                                       num_docs=100))
+        out = run_task(TaskConfig("PurgeTask", "ct_OFFLINE", ["p0"],
+                                  {"purgePredicate": "ts < 50"}), ctx)
+        assert out["purgedSegments"] == ["p0_purged"]
+        seg = load_segment(st.table_segments("ct_OFFLINE")[0].dir_path)
+        assert seg.num_docs == 50
+
+
+class TestControllerFacade:
+    def test_upload_assign_load_delete(self, tmp_path):
+        ctrl = Controller(task_output_dir=str(tmp_path / "tasks"))
+        loads, unloads = [], []
+        for i in range(2):
+            ctrl.register_server(
+                f"server_{i}",
+                lambda t, d, i=i: loads.append((i, t, d)),
+                lambda t, n, i=i: unloads.append((i, t, n)))
+        ctrl.add_table(make_config(), make_schema())
+        d = build_seg(tmp_path, "u0", n=40)
+        st = ctrl.upload_segment("ct", d)
+        assert st.instances and loads
+        ctrl.delete_segment("ct_OFFLINE", st.name)
+        assert unloads and unloads[0][2] == st.name
+
+    def test_retention_unloads_servers(self, tmp_path):
+        ctrl = Controller()
+        unloads = []
+        ctrl.register_server("server_0", lambda t, d: None,
+                             lambda t, n: unloads.append(n))
+        cfg = make_config(retention_time_value=1, retention_time_unit="DAYS")
+        ctrl.add_table(cfg, make_schema())
+        ctrl.state.upsert_segment(SegmentState(
+            "ancient", "ct_OFFLINE", ["server_0"],
+            end_time=int(time.time() * 1000) - 10 * 86_400_000))
+        out = ctrl.run_maintenance_once()
+        assert out["retentionRemoved"] == ["ancient"]
+        assert unloads == ["ancient"]
+
+    def test_state_persistence_roundtrip(self, tmp_path):
+        st = ClusterState(persist_dir=str(tmp_path / "zk"))
+        st.add_table(make_config(), make_schema())
+        st.upsert_segment(SegmentState("s0", "ct_OFFLINE", ["server_0"],
+                                       num_docs=7))
+        st2 = ClusterState(persist_dir=str(tmp_path / "zk"))
+        assert "ct" in st2.tables
+        segs = st2.table_segments("ct_OFFLINE")
+        assert len(segs) == 1 and segs[0].num_docs == 7
