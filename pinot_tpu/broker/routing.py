@@ -99,7 +99,10 @@ def _prunable(seg: SegmentInfo, ctx: QueryContext) -> bool:
     value = _eq_value(ctx.filter, seg.partition_column)
     if value is None:
         return False
-    return _modulo_partition(value, seg.num_partitions) != seg.partition_id
+    p = _modulo_partition(value, seg.num_partitions)
+    if p is None:  # non-numeric value: cannot prove mismatch, keep segment
+        return False
+    return p != seg.partition_id
 
 
 def _eq_value(expr: Expression, column: str):
@@ -120,12 +123,15 @@ def _eq_value(expr: Expression, column: str):
     return None
 
 
-def _modulo_partition(value, num_partitions: int) -> int:
-    """Ref segment-spi partition/ModuloPartitionFunction."""
+def _modulo_partition(value, num_partitions: int) -> Optional[int]:
+    """Ref segment-spi partition/ModuloPartitionFunction — numeric-only.
+    Returns None for non-numeric values: Python's salted str hash is not
+    stable across processes, so using it would silently mis-prune
+    (ADVICE r1 medium)."""
     try:
         return int(value) % num_partitions
     except (TypeError, ValueError):
-        return hash(str(value)) % num_partitions
+        return None
 
 
 class BrokerRoutingManager:

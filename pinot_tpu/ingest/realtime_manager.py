@@ -172,12 +172,11 @@ class RealtimeSegmentDataManager:
         creator.build(sealed.to_columns(), out_dir, name)
         immutable = load_segment(out_dir)
         if self.upsert_manager is not None:
-            # transfer validity: the immutable copy inherits the mutable's
-            # valid bitmap and takes over its map entries (ref
-            # replaceSegment in the upsert manager)
-            immutable.valid_doc_ids = sealed.valid_doc_ids                 if getattr(sealed, "valid_doc_ids", None) is not None else None
-            self.upsert_manager.add_segment(immutable)
-            self.upsert_manager.remove_segment(sealed)
+            # transfer validity: the immutable is a row-for-row rebuild of
+            # the mutable, so it SHARES the valid bitmap and takes over the
+            # map entries in place — no recompute, so concurrent queries
+            # never observe cleared bits on either copy
+            self.upsert_manager.replace_segment(sealed, immutable)
         # swap BEFORE removing: add_segment replaces by name atomically
         self.tdm.add_segment(immutable)
         if self.on_commit is not None:

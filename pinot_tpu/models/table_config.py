@@ -265,6 +265,16 @@ class TableConfig:
     def __post_init__(self):
         if isinstance(self.table_type, str):
             self.table_type = TableType(self.table_type)
+        self.validate()
+
+    def validate(self) -> None:
+        """Reject config combinations with no correct execution (ref
+        TableConfigUtils.validateUpsertAndDedupConfig: upsert tables
+        forbid star-tree — pre-agg records cannot honor validDocIds)."""
+        if self.upsert and self.indexing.star_tree_configs:
+            raise ValueError(
+                "star-tree index is not supported on upsert tables: "
+                "pre-aggregated records cannot apply validDocIds")
 
     @property
     def table_name_with_type(self) -> str:

@@ -384,7 +384,7 @@ class TpuOperatorExecutor:
             if ctx.filter is not None else []
         for i, (leaf, expr) in enumerate(zip(plan.leaves, leaf_exprs)):
             if leaf.kind == "vrange":
-                lo, hi = _vrange_bounds(expr)
+                lo, hi = _vrange_bounds(expr, vdt)
                 params[f"leaf{i}:lo"] = self._put(np.full(S, lo, dtype=vdt))
                 params[f"leaf{i}:hi"] = self._put(np.full(S, hi, dtype=vdt))
                 continue
@@ -646,21 +646,25 @@ class _NotStageable(Exception):
     pass
 
 
-def _vrange_bounds(e: Function) -> Tuple[float, float]:
+def _vrange_bounds(e: Function, vdt=np.float64) -> Tuple[float, float]:
+    """Closed [lo, hi] bounds for a raw-value comparison, computed in the
+    STAGING dtype vdt: nextafter in float64 would collapse back to the
+    original value when later cast to float32, silently turning strict
+    comparisons into non-strict ones (x > 5 executing as x >= 5)."""
     def lv(i):
-        return float(e.args[i].value)  # type: ignore[union-attr]
+        return vdt(e.args[i].value)  # type: ignore[union-attr]
     if e.name == "equals":
         return lv(1), lv(1)
     if e.name == "between":
         return lv(1), lv(2)
     if e.name == "greater_than":
-        return np.nextafter(lv(1), np.inf), np.inf
+        return np.nextafter(lv(1), vdt(np.inf)), vdt(np.inf)
     if e.name == "greater_than_or_equal":
-        return lv(1), np.inf
+        return lv(1), vdt(np.inf)
     if e.name == "less_than":
-        return -np.inf, np.nextafter(lv(1), -np.inf)
+        return vdt(-np.inf), np.nextafter(lv(1), vdt(-np.inf))
     if e.name == "less_than_or_equal":
-        return -np.inf, lv(1)
+        return vdt(-np.inf), lv(1)
     raise _NotStageable()
 
 

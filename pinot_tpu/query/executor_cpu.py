@@ -43,9 +43,12 @@ def execute_segment(seg: ImmutableSegment, ctx: QueryContext):
 
 def _execute_segment(seg: ImmutableSegment, ctx: QueryContext):
     # star-tree fast path (ref AggregationOperator._useStarTree): answer
-    # from pre-aggregated records when a tree fits the query shape
+    # from pre-aggregated records when a tree fits the query shape.
+    # Skipped when upsert validDocIds exist: pre-agg records bake in
+    # superseded rows and cannot honor the validity mask (ADVICE r1).
     if ctx.aggregations and getattr(seg, "metadata", None) is not None \
-            and getattr(seg.metadata, "star_tree", None):
+            and getattr(seg.metadata, "star_tree", None) \
+            and getattr(seg, "valid_doc_ids", None) is None:
         from pinot_tpu.query.startree_exec import execute_star_tree
         result = execute_star_tree(seg, ctx)
         if result is not None:

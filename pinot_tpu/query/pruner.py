@@ -8,8 +8,11 @@ broker-side in routing (broker/routing.py), as in the reference.
 """
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
+import numpy as np
+
+from pinot_tpu.models import DataType
 from pinot_tpu.query.context import QueryContext
 from pinot_tpu.query.expressions import Expression, Function, Identifier, Literal
 from pinot_tpu.segment.loader import ImmutableSegment
@@ -54,8 +57,14 @@ def _can_prune(seg: ImmutableSegment, expr: Expression) -> bool:
             if _cmp_lt(v, lo) or _cmp_lt(hi, v):
                 return True
             bloom = seg.data_source(col).bloom_filter
-            if bloom is not None and not bloom.might_contain(v):
-                return True
+            if bloom is not None:
+                # probe with the value coerced into the column's STORED
+                # domain (what BloomFilter.build hashed); a raw literal of
+                # a different type hashes differently and would wrongly
+                # prune (ADVICE r1: `WHERE intcol = 5.0` pruned everything)
+                ok, pv = _bloom_probe_value(meta, v)
+                if ok and not bloom.might_contain(pv):
+                    return True
             return False
         if name == "in":
             vals = [a.value for a in expr.args[1:] if isinstance(a, Literal)]
@@ -78,6 +87,36 @@ def _can_prune(seg: ImmutableSegment, expr: Expression) -> bool:
     except TypeError:
         return False
     return False
+
+
+def _bloom_probe_value(meta, v) -> Tuple[bool, Optional[object]]:
+    """Coerce a literal into the stored value domain the bloom filter was
+    built over. Returns (ok, value); ok=False means 'cannot probe' and the
+    caller must skip the bloom check rather than prune."""
+    st = meta.data_type.stored_type
+    try:
+        if st in (DataType.INT, DataType.LONG):
+            if isinstance(v, str):
+                v = float(v)
+            if isinstance(v, float):
+                if not v.is_integer():
+                    return False, None
+                v = int(v)
+            return True, int(v)
+        if st in (DataType.FLOAT, DataType.DOUBLE):
+            f = float(v)
+            if st is DataType.FLOAT:
+                # stored values are f32; the filter hashed the f64-widened
+                # f32 value, so round-trip through f32 before probing
+                f = float(np.float32(f))
+            return True, f
+        if st is DataType.STRING:
+            return True, v if isinstance(v, str) else str(v)
+        if st is DataType.BYTES and isinstance(v, bytes):
+            return True, v
+    except (TypeError, ValueError):
+        pass
+    return False, None
 
 
 def _cmp_lt(a, b) -> bool:

@@ -100,6 +100,19 @@ class PartitionUpsertMetadataManager:
             for pk in dead:
                 del self._map[pk]
 
+    def replace_segment(self, old, new) -> None:
+        """Ref replaceSegment (seal handoff): `new` is a row-for-row
+        rebuild of `old`, so its validity IS old's bitmap — share the
+        object and redirect map entries in place. No recompute, so there
+        is no window where either copy's valid bits are cleared
+        (ADVICE r1: add+remove cleared the sealed mutable's bits while
+        queries could still see it)."""
+        new.valid_doc_ids = getattr(old, "valid_doc_ids", None)
+        with self._lock:
+            for loc in self._map.values():
+                if loc.segment is old:
+                    loc.segment = new
+
     def lookup(self, pk: tuple) -> Optional[Tuple[Any, int]]:
         with self._lock:
             loc = self._map.get(pk)
