@@ -652,7 +652,23 @@ def _vrange_bounds(e: Function, vdt=np.float64) -> Tuple[float, float]:
     original value when later cast to float32, silently turning strict
     comparisons into non-strict ones (x > 5 executing as x >= 5)."""
     def lv(i):
-        return vdt(e.args[i].value)  # type: ignore[union-attr]
+        raw = e.args[i].value  # type: ignore[union-attr]
+        try:
+            if isinstance(raw, str):
+                raw = int(raw) if raw.lstrip("+-").isdigit() else float(raw)
+            v = vdt(raw)
+            # a literal not exactly representable in the staging dtype (e.g.
+            # 16777217 in f32, 2^53+1 in f64) would alias to a neighbour and
+            # match rows the exact host path would not — fall back instead.
+            # Compare in exact Python arithmetic: int(v)/float(v) vs raw
+            # avoids rounding the reference side through the staging dtype.
+            exact = (int(v) if isinstance(raw, int) and float(v).is_integer()
+                     else float(v))
+            if exact != raw:
+                raise _NotStageable()
+        except (OverflowError, ValueError, TypeError):
+            raise _NotStageable() from None
+        return v
     if e.name == "equals":
         return lv(1), lv(1)
     if e.name == "between":

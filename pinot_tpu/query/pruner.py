@@ -97,7 +97,12 @@ def _bloom_probe_value(meta, v) -> Tuple[bool, Optional[object]]:
     try:
         if st in (DataType.INT, DataType.LONG):
             if isinstance(v, str):
-                v = float(v)
+                # int() first: float() loses precision above 2^53 and would
+                # probe the wrong long value for e.g. '9007199254740993'
+                try:
+                    v = int(v)
+                except ValueError:
+                    v = float(v)
             if isinstance(v, float):
                 if not v.is_integer():
                     return False, None
