@@ -24,9 +24,15 @@ from pinot_tpu.broker.routing import BrokerRoutingManager
 class BrokerRequestHandler:
     def __init__(self, routing: BrokerRoutingManager,
                  connections: Dict[str, ServerConnection],
-                 max_fanout_threads: int = 16):
+                 max_fanout_threads: int = 16,
+                 mse_dispatcher=None):
         self.routing = routing
         self.connections = connections
+        #: multi-stage dispatcher (mse/dispatcher.py); when set, queries the
+        #: single-stage grammar rejects (joins, subqueries) — or that opt in
+        #: via useMultistageEngine — go through it (ref
+        #: BrokerRequestHandlerDelegate engine selection)
+        self.mse_dispatcher = mse_dispatcher
         self._pool = ThreadPoolExecutor(max_workers=max_fanout_threads)
         self._request_id = 0
         self._lock = threading.Lock()
@@ -42,7 +48,20 @@ class BrokerRequestHandler:
             query = parse_sql(sql)
             ctx = QueryContext.from_query(query)
         except (SqlParseError, ValueError) as e:
+            if self.mse_dispatcher is not None:
+                # delegate only if the multi-stage grammar accepts the query
+                # (joins/subqueries); a genuine syntax error stays a 150
+                try:
+                    from pinot_tpu.mse.sql import parse_mse_sql
+                    parsed = parse_mse_sql(sql)
+                except (SqlParseError, ValueError):
+                    return _error_response(
+                        150, f"SQLParsingError: {e}", start)
+                return self.mse_dispatcher.submit(sql, parsed)
             return _error_response(150, f"SQLParsingError: {e}", start)
+        if self.mse_dispatcher is not None and \
+                query.options.get("useMultistageEngine", "").lower() == "true":
+            return self.mse_dispatcher.submit(sql)
         route = self.routing.get_route(ctx.table)
         if route is None:
             return _error_response(

@@ -26,11 +26,17 @@ class MiniClusterServer:
         self.data_manager = InstanceDataManager(instance_id)
         self.executor = ServerQueryExecutor(self.data_manager, use_tpu=use_tpu)
         self.transport = QueryServer(self.executor)
+        # multi-stage worker endpoint (mailbox data plane + stage executor)
+        from pinot_tpu.mse.dispatcher import make_scan_fn
+        from pinot_tpu.mse.runtime import MseWorker
+        self.mse_worker = MseWorker(instance_id, make_scan_fn(self.data_manager))
 
     def start(self) -> None:
         self.transport.start()
+        self.mse_worker.start()
 
     def stop(self) -> None:
+        self.mse_worker.stop()
         self.transport.stop()
         self.data_manager.shutdown()
 
@@ -56,7 +62,13 @@ class MiniCluster:
             s.start()
             self._connections[s.instance_id] = ServerConnection(
                 s.transport.host, s.transport.port)
-        self.broker = BrokerRequestHandler(self.routing, self._connections)
+        from pinot_tpu.mse.dispatcher import QueryDispatcher
+        self.mse = QueryDispatcher(
+            workers={s.instance_id: s.mse_worker for s in self.servers},
+            catalog_fn=self._catalog,
+            table_workers_fn=self._table_workers)
+        self.broker = BrokerRequestHandler(self.routing, self._connections,
+                                           mse_dispatcher=self.mse)
         if with_http:
             self.http = BrokerHttpServer(self.broker)
             self.http.start()
@@ -64,10 +76,46 @@ class MiniCluster:
     def stop(self) -> None:
         if self.http is not None:
             self.http.stop()
+        if getattr(self, "mse", None) is not None:
+            self.mse.stop()
         for c in self._connections.values():
             c.close()
         for s in self.servers:
             s.stop()
+
+    # -- multi-stage catalog / placement ------------------------------------
+    def _catalog(self):
+        """Logical table -> column names, unioned over all servers."""
+        cat = {}
+        for s in self.servers:
+            dm = s.data_manager
+            for phys in dm.table_names:
+                logical = phys
+                for suffix in ("_OFFLINE", "_REALTIME"):
+                    if phys.endswith(suffix):
+                        logical = phys[: -len(suffix)]
+                tdm = dm.table(phys, create=False)
+                sdms = tdm.acquire_segments(None)
+                try:
+                    if sdms:
+                        cat.setdefault(logical,
+                                       list(sdms[0].segment.column_names))
+                finally:
+                    type(tdm).release_all(sdms)
+        return cat
+
+    def _table_workers(self, table: str):
+        """Servers hosting at least one segment of the (logical) table."""
+        out = []
+        wanted = (table, table + "_OFFLINE", table + "_REALTIME")
+        for s in self.servers:
+            for phys in s.data_manager.table_names:
+                if phys in wanted:
+                    out.append(s.instance_id)
+                    break
+        if not out:
+            raise ValueError(f"no servers host table {table!r}")
+        return out
 
     # ------------------------------------------------------------------
     def add_table(self, table_name: str, table_type: str = "OFFLINE",

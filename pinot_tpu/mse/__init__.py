@@ -1,0 +1,16 @@
+"""Multi-stage query engine (v2): planner + distributed runtime.
+
+Reference parity: pinot-query-planner (QueryEnvironment.java:100 — SQL ->
+distributed stage DAG) and pinot-query-runtime (QueryRunner.java:94 —
+per-stage operator chains shuffling blocks through mailboxes). The TPU-first
+re-design: all intermediate data is COLUMNAR numpy blocks (not row
+iterators), operators are vectorized (factorize/searchsorted hash joins,
+bincount aggregates), and leaf stages reuse the single-stage device engine
+(the reference blesses exactly this layering, QueryRunner.java:258).
+"""
+from pinot_tpu.mse.blocks import Block
+from pinot_tpu.mse.sql import parse_mse_sql
+from pinot_tpu.mse.planner import plan_query
+from pinot_tpu.mse.dispatcher import QueryDispatcher
+
+__all__ = ["Block", "parse_mse_sql", "plan_query", "QueryDispatcher"]

@@ -1,0 +1,214 @@
+"""Columnar record batches shuffled between stages.
+
+Reference parity: pinot-common datablock (RowDataBlock/ColumnarDataBlock +
+ZeroCopyDataBlockSerde) and pinot-query-runtime TransferableBlock. Here a
+block IS a columnar batch (dict-of-numpy-arrays), so every downstream
+operator works vectorized; the wire format is a typed binary layout with
+raw little-endian numeric buffers (zero-copy on read for numerics).
+"""
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+_U32 = struct.Struct("<I")
+
+# dtype tag on the wire -> numpy dtype for raw-buffer columns
+_NUMERIC_TAGS = {
+    b"i4": np.int32, b"i8": np.int64, b"f4": np.float32, b"f8": np.float64,
+    b"b1": np.bool_,
+}
+_DTYPE_TO_TAG = {np.dtype(v): k for k, v in _NUMERIC_TAGS.items()}
+
+
+class Block:
+    """One columnar batch: parallel (name, array) columns of equal length.
+
+    Object-dtype arrays hold strings/None/bytes (variable-width values stay
+    host-side per SURVEY §7 hard-parts). Also implements the ColumnProvider
+    protocol (query/transform.py) so expressions evaluate directly over it.
+    """
+
+    __slots__ = ("names", "arrays", "_index")
+
+    def __init__(self, names: Sequence[str], arrays: Sequence[np.ndarray]):
+        assert len(names) == len(arrays)
+        if arrays:
+            n = len(arrays[0])
+            assert all(len(a) == n for a in arrays), \
+                [len(a) for a in arrays]
+        self.names: List[str] = list(names)
+        self.arrays: List[np.ndarray] = [np.asarray(a) for a in arrays]
+        self._index: Dict[str, int] = {c: i for i, c in enumerate(self.names)}
+
+    # -- ColumnProvider protocol -------------------------------------------
+    def column(self, name: str) -> np.ndarray:
+        i = self._index.get(name)
+        if i is None:
+            # unqualified lookup: match a unique "alias.name" suffix
+            hits = [j for j, c in enumerate(self.names)
+                    if c.endswith("." + name)]
+            if len(hits) == 1:
+                i = hits[0]
+            elif len(hits) > 1:
+                raise KeyError(f"ambiguous column {name!r} in {self.names}")
+            else:
+                raise KeyError(f"no column {name!r} in {self.names}")
+        return self.arrays[i]
+
+    @property
+    def num_docs(self) -> int:
+        return self.num_rows
+
+    # ----------------------------------------------------------------------
+    @property
+    def num_rows(self) -> int:
+        return len(self.arrays[0]) if self.arrays else 0
+
+    def has_column(self, name: str) -> bool:
+        try:
+            self.column(name)
+            return True
+        except KeyError:
+            return False
+
+    def take(self, idx: np.ndarray) -> "Block":
+        return Block(self.names, [a[idx] for a in self.arrays])
+
+    def mask(self, m: np.ndarray) -> "Block":
+        return Block(self.names, [a[m] for a in self.arrays])
+
+    def select(self, names: Sequence[str]) -> "Block":
+        return Block(list(names), [self.column(c) for c in names])
+
+    def rename(self, names: Sequence[str]) -> "Block":
+        return Block(list(names), self.arrays)
+
+    def rows(self) -> List[tuple]:
+        return [tuple(_py(a[i]) for a in self.arrays)
+                for i in range(self.num_rows)]
+
+    @staticmethod
+    def empty(names: Sequence[str]) -> "Block":
+        return Block(list(names), [np.empty(0, object) for _ in names])
+
+    @staticmethod
+    def concat(blocks: Sequence["Block"]) -> "Block":
+        blocks = [b for b in blocks if b is not None]
+        if not blocks:
+            return Block([], [])
+        if len(blocks) == 1:
+            return blocks[0]
+        names = blocks[0].names
+        arrays = []
+        for i in range(len(names)):
+            cols = [b.arrays[i] for b in blocks]
+            dt = np.result_type(*[c.dtype for c in cols]) \
+                if all(c.dtype.kind != "O" for c in cols) else np.dtype(object)
+            arrays.append(np.concatenate(
+                [c.astype(dt, copy=False) for c in cols]))
+        return Block(names, arrays)
+
+    def __repr__(self) -> str:
+        return f"Block({self.names}, rows={self.num_rows})"
+
+    # -- wire format --------------------------------------------------------
+    def to_bytes(self) -> bytes:
+        out = [_U32.pack(len(self.names)), _U32.pack(self.num_rows)]
+        for name, arr in zip(self.names, self.arrays):
+            nb = name.encode()
+            out.append(_U32.pack(len(nb)))
+            out.append(nb)
+            tag = _DTYPE_TO_TAG.get(arr.dtype.base)
+            if tag is not None:
+                out.append(tag)
+                out.append(np.ascontiguousarray(arr).tobytes())
+            elif arr.dtype.kind in "iu":
+                out.append(b"i8")
+                out.append(np.ascontiguousarray(arr, np.int64).tobytes())
+            elif arr.dtype.kind == "f":
+                out.append(b"f8")
+                out.append(np.ascontiguousarray(arr, np.float64).tobytes())
+            elif arr.dtype.kind in ("U", "S", "O"):
+                out.append(b"vo")
+                out.append(_encode_objects(arr))
+            else:
+                raise TypeError(f"unsupported column dtype {arr.dtype}")
+        return b"".join(out)
+
+    @staticmethod
+    def from_bytes(buf: bytes) -> "Block":
+        pos = 0
+        ncols = _U32.unpack_from(buf, pos)[0]; pos += 4
+        nrows = _U32.unpack_from(buf, pos)[0]; pos += 4
+        names, arrays = [], []
+        for _ in range(ncols):
+            ln = _U32.unpack_from(buf, pos)[0]; pos += 4
+            names.append(buf[pos:pos + ln].decode()); pos += ln
+            tag = buf[pos:pos + 2]; pos += 2
+            if tag in _NUMERIC_TAGS:
+                dt = np.dtype(_NUMERIC_TAGS[tag])
+                nb = dt.itemsize * nrows
+                arrays.append(np.frombuffer(buf, dt, nrows, pos).copy())
+                pos += nb
+            elif tag == b"vo":
+                arr, pos = _decode_objects(buf, pos, nrows)
+                arrays.append(arr)
+            else:
+                raise ValueError(f"bad column tag {tag!r}")
+        return Block(names, arrays)
+
+
+# -- object-column value serde (str | bytes | int | float | bool | None) ----
+
+def _encode_objects(arr: np.ndarray) -> bytes:
+    out = []
+    for v in arr:
+        v = _py(v)
+        if v is None:
+            out.append(b"n")
+        elif isinstance(v, bool):
+            out.append(b"t" if v else b"F")
+        elif isinstance(v, int):
+            out.append(b"i" + struct.pack("<q", v))
+        elif isinstance(v, float):
+            out.append(b"d" + struct.pack("<d", v))
+        elif isinstance(v, str):
+            b = v.encode()
+            out.append(b"s" + _U32.pack(len(b)) + b)
+        elif isinstance(v, bytes):
+            out.append(b"b" + _U32.pack(len(v)) + v)
+        else:
+            raise TypeError(f"unsupported object value {type(v)}")
+    return b"".join(out)
+
+
+def _decode_objects(buf: bytes, pos: int, n: int):
+    vals = np.empty(n, object)
+    for i in range(n):
+        t = buf[pos:pos + 1]; pos += 1
+        if t == b"n":
+            vals[i] = None
+        elif t == b"t":
+            vals[i] = True
+        elif t == b"F":
+            vals[i] = False
+        elif t == b"i":
+            vals[i] = struct.unpack_from("<q", buf, pos)[0]; pos += 8
+        elif t == b"d":
+            vals[i] = struct.unpack_from("<d", buf, pos)[0]; pos += 8
+        elif t == b"s":
+            ln = _U32.unpack_from(buf, pos)[0]; pos += 4
+            vals[i] = buf[pos:pos + ln].decode(); pos += ln
+        elif t == b"b":
+            ln = _U32.unpack_from(buf, pos)[0]; pos += 4
+            vals[i] = buf[pos:pos + ln]; pos += ln
+        else:
+            raise ValueError(f"bad object tag {t!r}")
+    return vals, pos
+
+
+def _py(v):
+    return v.item() if isinstance(v, np.generic) else v

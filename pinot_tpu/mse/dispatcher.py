@@ -1,0 +1,181 @@
+"""Broker-side multi-stage dispatch: plan -> workers -> root stage -> rows.
+
+Reference parity: pinot-query-runtime
+service/dispatch/QueryDispatcher.java:92 (submitAndReduce: dispatch each
+stage to its workers over gRPC, then runReducer pulls the final-stage
+mailbox). Here dispatch hands stage JSON to MseWorker endpoints (direct
+call in-process; the data plane between workers is real TCP mailboxes)
+and the broker runs stage 0 inline.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from pinot_tpu.mse.blocks import Block
+from pinot_tpu.mse.logical import Catalog, build_logical
+from pinot_tpu.mse.mailbox import MailboxService
+from pinot_tpu.mse.planner import QueryPlan, plan_query
+from pinot_tpu.mse.runtime import MseWorker, ScanFn, StageContext, run_stage
+from pinot_tpu.mse.sql import parse_mse_sql
+from pinot_tpu.query.reduce import BrokerResponse, ResultTable
+from pinot_tpu.query.results import ExecutionStats
+
+_QUERY_SEQ = itertools.count(1)
+_SEQ_LOCK = threading.Lock()
+
+
+def make_scan_fn(data_manager) -> ScanFn:
+    """Leaf scan over an instance's local segments: filter mask + column
+    materialization per segment, concatenated columnar (the
+    LeafStageTransferableBlockOperator analog over the single-stage
+    segment layer)."""
+    from pinot_tpu.query.filter import SegmentColumnProvider, evaluate_filter
+
+    def scan(table: str, columns: List[str], filt) -> Block:
+        tdm = data_manager.table(table, create=False)
+        # logical name -> physical table (OFFLINE preferred, ref hybrid
+        # routing; MSE hybrid time-split lands with the time-boundary work)
+        if tdm is None:
+            for suffix in ("_OFFLINE", "_REALTIME"):
+                tdm = data_manager.table(table + suffix, create=False)
+                if tdm is not None:
+                    break
+        if tdm is None:
+            return Block(columns, [np.empty(0, object) for _ in columns])
+        sdms = tdm.acquire_segments(None)
+        try:
+            blocks = []
+            for sdm in sdms:
+                seg = sdm.segment
+                provider = SegmentColumnProvider(seg)
+                mask = evaluate_filter(seg, filt, provider)
+                valid = getattr(seg, "valid_doc_ids", None)
+                if valid is not None:
+                    vmask = valid.to_mask()
+                    if len(vmask) < seg.num_docs:
+                        vmask = np.concatenate(
+                            [vmask, np.zeros(seg.num_docs - len(vmask), bool)])
+                    mask = mask & vmask[:seg.num_docs]
+                arrays = []
+                for c in columns:
+                    vals = np.asarray(provider.column(c))
+                    if vals.ndim == 0:
+                        vals = np.broadcast_to(vals, (seg.num_docs,))
+                    arrays.append(vals[mask])
+                blocks.append(Block(columns, arrays))
+            return Block.concat(blocks) if blocks else \
+                Block(columns, [np.empty(0, object) for _ in columns])
+        finally:
+            type(tdm).release_all(sdms)
+
+    return scan
+
+
+class QueryDispatcher:
+    """Multi-stage query entry point on the broker."""
+
+    def __init__(self,
+                 workers: Dict[str, MseWorker],
+                 catalog_fn: Callable[[], Catalog],
+                 table_workers_fn: Callable[[str], List[str]],
+                 broker_mailbox: Optional[MailboxService] = None):
+        self.workers = workers
+        self.catalog_fn = catalog_fn
+        self.table_workers_fn = table_workers_fn
+        if broker_mailbox is None:
+            broker_mailbox = MailboxService("broker")
+            broker_mailbox.start()
+        self.mailbox = broker_mailbox
+
+    def stop(self) -> None:
+        self.mailbox.stop()
+
+    # ------------------------------------------------------------------
+    def plan_sql(self, sql: str, parsed=None) -> QueryPlan:
+        q = parsed if parsed is not None else parse_mse_sql(sql)
+        if q.limit is None:
+            q.limit = 10  # Pinot default applies to the outermost query
+        logical = build_logical(q, self.catalog_fn())
+        return plan_query(logical, q.options, self.table_workers_fn,
+                          intermediate_workers=sorted(self.workers))
+
+    def submit(self, sql: str, parsed=None) -> BrokerResponse:
+        start = time.time()
+        try:
+            plan = self.plan_sql(sql, parsed)
+            block = self._execute(plan)
+        except Exception as e:  # noqa: BLE001 — broker answers, never dies
+            resp = BrokerResponse(
+                result_table=None,
+                exceptions=[{"errorCode": 200,
+                             "message": f"{type(e).__name__}: {e}"}],
+                stats=ExecutionStats())
+            resp.time_used_ms = (time.time() - start) * 1000.0
+            return resp
+        table = ResultTable(
+            columns=list(plan.root.schema),
+            column_types=[_infer_type(a) for a in block.arrays],
+            rows=block.rows())
+        resp = BrokerResponse(result_table=table, exceptions=[],
+                              stats=ExecutionStats())
+        resp.num_servers_queried = resp.num_servers_responded = \
+            len(self.workers)
+        resp.time_used_ms = (time.time() - start) * 1000.0
+        return resp
+
+    # ------------------------------------------------------------------
+    def _execute(self, plan: QueryPlan) -> Block:
+        with _SEQ_LOCK:
+            qid = f"mse_{next(_QUERY_SEQ)}_{int(time.time() * 1000)}"
+        timeout = float(plan.options.get("timeoutMs", 60000)) / 1000.0
+
+        addresses: Dict[str, str] = {}
+        for s in plan.stages:
+            for w, inst in enumerate(s.workers):
+                addr = self.mailbox.address if inst == "broker" \
+                    else self.workers[inst].mailbox_address
+                addresses[f"{s.stage_id}:{w}"] = addr
+
+        plan_json = {"stages": [s.to_json() for s in plan.stages],
+                     "options": plan.options}
+        for s in plan.stages[1:]:
+            sj = s.to_json()
+            for w, inst in enumerate(s.workers):
+                self.workers[inst].submit_stage(
+                    qid, plan_json, sj, w, addresses, timeout=timeout)
+
+        ctx = StageContext(
+            query_id=qid, plan=plan, worker_id="broker", worker_idx=0,
+            mailbox=self.mailbox, addresses=addresses, scan_fn=None,
+            timeout=timeout)
+        block = run_stage(ctx, plan.root)
+        assert block is not None
+        return block
+
+
+def _infer_type(arr: np.ndarray) -> str:
+    k = arr.dtype.kind
+    if k in "iu":
+        return "LONG"
+    if k == "f":
+        return "DOUBLE"
+    if k == "b":
+        return "BOOLEAN"
+    for v in arr:
+        if v is None:
+            continue
+        if isinstance(v, bool):
+            return "BOOLEAN"
+        if isinstance(v, int):
+            return "LONG"
+        if isinstance(v, float):
+            return "DOUBLE"
+        if isinstance(v, bytes):
+            return "BYTES"
+        return "STRING"
+    return "STRING"

@@ -1,0 +1,489 @@
+"""Logical plan: relational tree built from the parsed MseQuery.
+
+Reference parity: pinot-query-planner's Calcite logical planning
+(QueryEnvironment.java:100 -> RelNode tree via logical rules). Here the
+tree is built directly (no cost-based optimizer): left-deep joins in FROM
+order, filter pushdown of single-scope conjuncts into scans, equi-key
+extraction from ON conditions, aggregate/having/project/sort layering.
+All identifiers are resolved to qualified "alias.column" names during the
+build, so later stages never re-resolve.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from pinot_tpu.mse.sql import FromItem, MseQuery
+from pinot_tpu.query.expressions import (
+    Expression, Function, Identifier, Literal, func, ident)
+from pinot_tpu.query.aggregation import is_aggregation
+
+
+class PlanError(ValueError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Nodes. Every node exposes .schema — ordered qualified output column names.
+# ---------------------------------------------------------------------------
+
+@dataclass
+class LogicalNode:
+    schema: List[str] = field(default_factory=list, init=False)
+
+    @property
+    def inputs(self) -> List["LogicalNode"]:
+        return []
+
+
+@dataclass
+class Scan(LogicalNode):
+    table: str
+    alias: str
+    columns: List[str]                    # physical column names to read
+    filter: Optional[Expression] = None   # pushed-down, UNQUALIFIED names
+
+    def __post_init__(self):
+        self.schema = [f"{self.alias}.{c}" for c in self.columns]
+
+
+@dataclass
+class SubqueryScan(LogicalNode):
+    """Derived table: re-exposes a child plan under an alias."""
+    child: LogicalNode
+    alias: str
+    names: List[str]                      # child output -> alias.name
+
+    def __post_init__(self):
+        self.schema = [f"{self.alias}.{n}" for n in self.names]
+
+    @property
+    def inputs(self):
+        return [self.child]
+
+
+@dataclass
+class Join(LogicalNode):
+    left: LogicalNode
+    right: LogicalNode
+    join_type: str                        # inner | left | right | full | cross
+    left_keys: List[Expression]
+    right_keys: List[Expression]
+    residual: Optional[Expression] = None  # non-equi remainder of ON
+
+    def __post_init__(self):
+        self.schema = list(self.left.schema) + list(self.right.schema)
+
+    @property
+    def inputs(self):
+        return [self.left, self.right]
+
+
+@dataclass
+class Filter(LogicalNode):
+    child: LogicalNode
+    condition: Expression
+
+    def __post_init__(self):
+        self.schema = list(self.child.schema)
+
+    @property
+    def inputs(self):
+        return [self.child]
+
+
+@dataclass
+class Aggregate(LogicalNode):
+    child: LogicalNode
+    group_exprs: List[Expression]
+    agg_nodes: List[Function]             # resolved aggregation calls
+
+    def __post_init__(self):
+        self.schema = [str(e) for e in self.group_exprs] + \
+                      [str(a) for a in self.agg_nodes]
+
+    @property
+    def inputs(self):
+        return [self.child]
+
+
+@dataclass
+class Project(LogicalNode):
+    child: LogicalNode
+    exprs: List[Expression]
+    names: List[str]
+
+    def __post_init__(self):
+        self.schema = list(self.names)
+
+    @property
+    def inputs(self):
+        return [self.child]
+
+
+@dataclass
+class Sort(LogicalNode):
+    child: LogicalNode
+    keys: List[Expression]
+    ascs: List[bool]
+    limit: int = -1                       # -1 = no limit
+    offset: int = 0
+
+    def __post_init__(self):
+        self.schema = list(self.child.schema)
+
+    @property
+    def inputs(self):
+        return [self.child]
+
+
+# ---------------------------------------------------------------------------
+# Catalog: table -> ordered physical column names
+# ---------------------------------------------------------------------------
+
+Catalog = Dict[str, List[str]]
+
+
+# ---------------------------------------------------------------------------
+# Identifier resolution
+# ---------------------------------------------------------------------------
+
+class _Scope:
+    """Visible relations: alias -> (column names)."""
+
+    def __init__(self):
+        self.relations: Dict[str, List[str]] = {}
+
+    def add(self, alias: str, columns: Sequence[str]) -> None:
+        if alias in self.relations:
+            raise PlanError(f"duplicate alias {alias!r}")
+        self.relations[alias] = list(columns)
+
+    def resolve(self, name: str) -> str:
+        """name or alias.name -> qualified 'alias.column'."""
+        if "." in name:
+            alias, col = name.split(".", 1)
+            cols = self.relations.get(alias)
+            if cols is not None:
+                if col not in cols:
+                    raise PlanError(f"column {col!r} not in {alias!r}")
+                return f"{alias}.{col}"
+            # fall through: the dot may be part of an unusual column name
+        hits = [a for a, cols in self.relations.items() if name in cols]
+        if len(hits) == 1:
+            return f"{hits[0]}.{name}"
+        if len(hits) > 1:
+            raise PlanError(f"ambiguous column {name!r} (in {hits})")
+        raise PlanError(f"unknown column {name!r}")
+
+    def side_of(self, qualified: str, left_aliases: set) -> str:
+        alias = qualified.split(".", 1)[0]
+        return "left" if alias in left_aliases else "right"
+
+
+def _qualify(e: Expression, scope: _Scope) -> Expression:
+    if isinstance(e, Identifier):
+        if e.name == "*":
+            return e
+        return ident(scope.resolve(e.name))
+    if isinstance(e, Function):
+        return Function(e.name, tuple(_qualify(a, scope) for a in e.args))
+    return e
+
+
+def _conjuncts(e: Optional[Expression]) -> List[Expression]:
+    if e is None:
+        return []
+    if isinstance(e, Function) and e.name == "and":
+        out: List[Expression] = []
+        for a in e.args:
+            out.extend(_conjuncts(a))
+        return out
+    return [e]
+
+
+def _and_all(cs: List[Expression]) -> Optional[Expression]:
+    if not cs:
+        return None
+    if len(cs) == 1:
+        return cs[0]
+    return func("and", *cs)
+
+
+def _aliases_in(e: Expression) -> set:
+    return {c.split(".", 1)[0] for c in e.columns()}
+
+
+def _strip_alias(e: Expression, alias: str) -> Expression:
+    """alias.col -> col (for pushdown into a single scan)."""
+    if isinstance(e, Identifier) and e.name.startswith(alias + "."):
+        return ident(e.name[len(alias) + 1:])
+    if isinstance(e, Function):
+        return Function(e.name, tuple(_strip_alias(a, alias) for a in e.args))
+    return e
+
+
+def _contains_agg(e: Expression) -> bool:
+    if isinstance(e, Function):
+        if is_aggregation(e.name) or e.name == "filter_agg":
+            return True
+        return any(_contains_agg(a) for a in e.args)
+    return False
+
+
+def _collect_aggs(e: Expression, out: List[Function]) -> None:
+    if isinstance(e, Function):
+        if is_aggregation(e.name) or e.name == "filter_agg":
+            if e not in out:
+                out.append(e)
+            return
+        for a in e.args:
+            _collect_aggs(a, out)
+
+
+# ---------------------------------------------------------------------------
+# Builder
+# ---------------------------------------------------------------------------
+
+def build_logical(q: MseQuery, catalog: Catalog) -> LogicalNode:
+    """MseQuery -> logical plan tree with resolved identifiers."""
+    scope = _Scope()
+
+    # 1. FROM items -> scans (filters pushed in later)
+    items: List[Tuple[FromItem, LogicalNode]] = []
+    for fi in [q.from_item] + [j.item for j in q.joins]:
+        node = _build_from_item(fi, catalog)
+        items.append((fi, node))
+        scope.add(fi.alias, _local_names(node))
+
+    where = [_qualify(c, scope) for c in _conjuncts(q.filter)]
+
+    # 2. single-scope WHERE conjuncts push into their scan — EXCEPT when the
+    # alias sits on the null-supplying side of an outer join: filtering
+    # before the join would turn should-be-eliminated rows into NULL-padded
+    # matches (must filter after the join instead)
+    null_supplying: set = set()
+    seen_aliases = [items[0][0].alias]
+    for jc, (fi, _n) in zip(q.joins, items[1:]):
+        if jc.join_type in ("left", "full"):
+            null_supplying.add(fi.alias)
+        if jc.join_type in ("right", "full"):
+            null_supplying.update(seen_aliases)
+        seen_aliases.append(fi.alias)
+
+    remaining: List[Expression] = []
+    pushed: Dict[str, List[Expression]] = {}
+    for c in where:
+        aliases = _aliases_in(c)
+        if len(aliases) == 1 and not (aliases & null_supplying):
+            pushed.setdefault(next(iter(aliases)), []).append(c)
+        else:
+            remaining.append(c)
+    for (fi, node) in items:
+        fs = pushed.get(fi.alias)
+        if fs and isinstance(node, Scan):
+            node.filter = _and_all(
+                [_strip_alias(f, fi.alias) for f in fs])
+        elif fs:
+            remaining.extend(fs)
+
+    # 3. left-deep joins in FROM order
+    plan: LogicalNode = items[0][1]
+    left_aliases = {items[0][0].alias}
+    for jc, (fi, right) in zip(q.joins, items[1:]):
+        on = [_qualify(c, scope) for c in _conjuncts(jc.condition)]
+        lk, rk, residual = _split_equi_keys(on, left_aliases, fi.alias)
+        if jc.join_type != "cross" and not lk:
+            # no equi keys: keep as residual-only join (nested-loop semantics
+            # via single-key constant partition)
+            residual = _and_all(on)
+        plan = Join(plan, right, jc.join_type, lk, rk, residual)
+        left_aliases.add(fi.alias)
+
+    # 4. remaining WHERE above the joins
+    rem = _and_all(remaining)
+    if rem is not None:
+        plan = Filter(plan, rem)
+
+    # 5. select/having/order expressions, aggregate detection
+    select, aliases = [], []
+    for e in q.select_list:
+        if isinstance(e, Function) and e.name == "as":
+            select.append(_qualify(e.args[0], scope))
+            aliases.append(e.args[1].value)  # type: ignore[union-attr]
+        else:
+            qe = _qualify(e, scope)
+            select.append(qe)
+            aliases.append(None)
+    group_by = [_qualify(e, scope) for e in q.group_by]
+    having = _qualify(q.having, scope) if q.having is not None else None
+    # an ORDER BY identifier may be a select alias rather than a column
+    order_by = []
+    for e, asc in q.order_by:
+        if isinstance(e, Identifier) and e.name in aliases:
+            order_by.append((e, asc))
+        else:
+            order_by.append((_qualify(e, scope), asc))
+
+    agg_nodes: List[Function] = []
+    for e in select + [e for e, _ in order_by] + \
+            ([having] if having is not None else []):
+        _collect_aggs(e, agg_nodes)
+
+    if agg_nodes or group_by:
+        plan = Aggregate(plan, group_by, agg_nodes)
+        # above the aggregate, agg calls and group exprs are plain columns
+        select = [_post_agg(e, plan.schema) for e in select]
+        having = _post_agg(having, plan.schema) if having is not None else None
+        order_by = [(_post_agg(e, plan.schema), asc) for e, asc in order_by]
+    elif q.distinct:
+        plan = Aggregate(plan, list(select), [])
+        select = [_post_agg(e, plan.schema) for e in select]
+        order_by = [(_post_agg(e, plan.schema), asc) for e, asc in order_by]
+
+    if having is not None:
+        plan = Filter(plan, having)
+
+    # 6. final projection
+    names = []
+    for e, alias, raw in zip(select, aliases, q.select_list):
+        if alias is not None:
+            names.append(alias)
+        else:
+            base = raw.args[0] if (isinstance(raw, Function)
+                                   and raw.name == "as") else raw
+            names.append(_display_name(base))
+    if len(select) == 1 and isinstance(select[0], Identifier) \
+            and select[0].name == "*":
+        select = [ident(c) for c in plan.schema]
+        names = [c.split(".", 1)[-1] for c in plan.schema]
+
+    # 7. sort keys resolve against the projection: a key matching a select
+    # expression (or its alias) reuses that output column; any other key is
+    # carried as a hidden __sortN column dropped after the sort
+    keys: List[Expression] = []
+    ascs: List[bool] = []
+    visible = len(select)
+    proj_exprs, proj_names = list(select), list(names)
+    for i, (e, asc) in enumerate(order_by):
+        name = None
+        for se, sn in zip(select, names):
+            if e == se or (isinstance(e, Identifier) and e.name == sn):
+                name = sn
+                break
+        if name is None:
+            name = f"__sort{i}"
+            proj_exprs.append(e)
+            proj_names.append(name)
+        keys.append(ident(name))
+        ascs.append(asc)
+    plan = Project(plan, proj_exprs, proj_names)
+    limit = -1 if q.limit is None else q.limit
+    if keys or limit >= 0 or q.offset:
+        plan = Sort(plan, keys, ascs, limit, q.offset)
+    if len(proj_exprs) > visible:
+        vis = proj_names[:visible]
+        plan = Project(plan, [ident(n) for n in vis], vis)
+    _prune_scan_columns(plan)
+    return plan
+
+
+def _node_exprs(n: LogicalNode) -> List[Optional[Expression]]:
+    """Expressions a node evaluates over its INPUT schema (scan filters are
+    excluded: they run inside the scan against physical columns)."""
+    if isinstance(n, Join):
+        return list(n.left_keys) + list(n.right_keys) + [n.residual]
+    if isinstance(n, Filter):
+        return [n.condition]
+    if isinstance(n, Aggregate):
+        return list(n.group_exprs) + list(n.agg_nodes)
+    if isinstance(n, Project):
+        return list(n.exprs)
+    if isinstance(n, Sort):
+        return list(n.keys)
+    return []
+
+
+def _prune_scan_columns(root: LogicalNode) -> None:
+    """Narrow every Scan's output to columns referenced above it, then
+    recompute derived schemas bottom-up (less scan materialization and
+    mailbox wire traffic)."""
+    used: set = set()
+
+    def collect(n: LogicalNode) -> None:
+        for e in _node_exprs(n):
+            if e is not None:
+                used.update(e.columns())
+        for c in n.inputs:
+            collect(c)
+
+    collect(root)
+
+    def prune(n: LogicalNode) -> None:
+        for c in n.inputs:
+            prune(c)
+        if isinstance(n, Scan):
+            kept = [c for c in n.columns if f"{n.alias}.{c}" in used]
+            n.columns = kept or n.columns[:1]  # COUNT(*)-only: keep one
+        n.__post_init__()  # refresh schema from (possibly pruned) children
+
+    prune(root)
+
+
+def _build_from_item(fi: FromItem, catalog: Catalog) -> LogicalNode:
+    if fi.subquery is not None:
+        child = build_logical(fi.subquery, catalog)
+        return SubqueryScan(child, fi.alias, list(child.schema))
+    cols = catalog.get(fi.table)
+    if cols is None:
+        raise PlanError(f"unknown table {fi.table!r}")
+    return Scan(fi.table, fi.alias, list(cols))
+
+
+def _local_names(node: LogicalNode) -> List[str]:
+    """Names visible under the relation's alias (unqualified)."""
+    if isinstance(node, Scan):
+        return list(node.columns)
+    if isinstance(node, SubqueryScan):
+        return list(node.names)
+    raise PlanError(f"bad from item {node}")
+
+
+def _split_equi_keys(on: List[Expression], left_aliases: set,
+                     right_alias: str):
+    """Partition ON conjuncts into equi-join key pairs + residual."""
+    lk: List[Expression] = []
+    rk: List[Expression] = []
+    residual: List[Expression] = []
+    for c in on:
+        if isinstance(c, Function) and c.name == "equals" \
+                and len(c.args) == 2:
+            a, b = c.args
+            aa, ba = _aliases_in(a), _aliases_in(b)
+            if aa and aa <= left_aliases and ba == {right_alias}:
+                lk.append(a)
+                rk.append(b)
+                continue
+            if ba and ba <= left_aliases and aa == {right_alias}:
+                lk.append(b)
+                rk.append(a)
+                continue
+        residual.append(c)
+    return lk, rk, _and_all(residual)
+
+
+def _post_agg(e: Expression, agg_schema: List[str]) -> Expression:
+    """Rewrite agg calls / group exprs into references to aggregate output
+    columns (matched by canonical string form)."""
+    s = str(e)
+    if s in agg_schema:
+        return ident(s)
+    if isinstance(e, Function):
+        return Function(e.name, tuple(_post_agg(a, agg_schema) for a in e.args))
+    return e
+
+
+def _display_name(e: Expression) -> str:
+    if isinstance(e, Identifier):
+        return e.name.split(".", 1)[-1] if "." in e.name else e.name
+    return str(e)

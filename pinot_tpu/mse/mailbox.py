@@ -1,0 +1,198 @@
+"""Mailbox data plane: block shuffle between stage workers.
+
+Reference parity: pinot-query-runtime query/mailbox/ —
+MailboxService.java:40 (id'd mailboxes), GrpcSendingMailbox /
+InMemorySendingMailbox / ReceivingMailbox. Here: one asyncio TCP listener
+per instance; frames are
+
+  u32 len | u16 keyLen | key utf8 | u8 flags | payload
+
+flags: 1 = EOS (sender-worker done), 2 = ERROR (payload = utf8 message).
+Same-instance sends short-circuit the socket (the InMemory mailbox path).
+Mailbox key: "<queryId>|<senderStage>|<receiverStage>|<receiverWorker>".
+Each sender worker sends its partition blocks then one EOS; the receiver
+drains until it counts EOS from every sender worker.
+"""
+from __future__ import annotations
+
+import asyncio
+import queue
+import socket
+import struct
+import threading
+from typing import Dict, Optional, Tuple
+
+_LEN = struct.Struct("<I")
+_KEYLEN = struct.Struct("<H")
+
+FLAG_EOS = 1
+FLAG_ERROR = 2
+
+
+class MailboxError(RuntimeError):
+    pass
+
+
+class MailboxTimeout(MailboxError):
+    pass
+
+
+def mailbox_key(query_id: str, sender_stage: int, receiver_stage: int,
+                receiver_worker: int) -> str:
+    return f"{query_id}|{sender_stage}|{receiver_stage}|{receiver_worker}"
+
+
+class MailboxService:
+    """Per-instance mailbox endpoint: TCP listener + local queues."""
+
+    def __init__(self, instance_id: str, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.instance_id = instance_id
+        self.host = host
+        self.port = port
+        self._queues: Dict[str, "queue.Queue[Tuple[int, bytes]]"] = {}
+        self._qlock = threading.Lock()
+        self._conns: Dict[str, socket.socket] = {}
+        self._conn_lock = threading.Lock()
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread: Optional[threading.Thread] = None
+        self._started = threading.Event()
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> None:
+        def run():
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            self._loop = loop
+
+            async def main():
+                self._server = await asyncio.start_server(
+                    self._handle, self.host, self.port)
+                self.port = self._server.sockets[0].getsockname()[1]
+                self._started.set()
+                async with self._server:
+                    await self._server.serve_forever()
+
+            try:
+                loop.run_until_complete(main())
+            except asyncio.CancelledError:
+                pass
+            finally:
+                loop.close()
+
+        self._thread = threading.Thread(
+            target=run, daemon=True, name=f"mailbox-{self.instance_id}")
+        self._thread.start()
+        if not self._started.wait(10):
+            raise RuntimeError("mailbox service failed to start")
+
+    def stop(self) -> None:
+        if self._loop is not None:
+            def shutdown():
+                for task in asyncio.all_tasks(self._loop):
+                    task.cancel()
+            self._loop.call_soon_threadsafe(shutdown)
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        with self._conn_lock:
+            for s in self._conns.values():
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            self._conns.clear()
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    # -- receiving ----------------------------------------------------------
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                hdr = await reader.readexactly(4)
+                n = _LEN.unpack(hdr)[0]
+                frame = await reader.readexactly(n)
+                klen = _KEYLEN.unpack_from(frame, 0)[0]
+                key = frame[2:2 + klen].decode()
+                flags = frame[2 + klen]
+                payload = frame[3 + klen:]
+                self._queue(key).put((flags, payload))
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        finally:
+            writer.close()
+
+    def _queue(self, key: str) -> "queue.Queue[Tuple[int, bytes]]":
+        with self._qlock:
+            q = self._queues.get(key)
+            if q is None:
+                q = self._queues[key] = queue.Queue()
+            return q
+
+    def receive_all(self, key: str, num_senders: int,
+                    timeout: float = 60.0):
+        """Yield payload bytes until EOS from every sender; raises on an
+        ERROR frame. Removes the queue when drained."""
+        q = self._queue(key)
+        eos_seen = 0
+        try:
+            while eos_seen < num_senders:
+                try:
+                    flags, payload = q.get(timeout=timeout)
+                except queue.Empty:
+                    raise MailboxTimeout(
+                        f"mailbox {key}: timed out after {timeout}s "
+                        f"({eos_seen}/{num_senders} senders done)") from None
+                if flags & FLAG_ERROR:
+                    raise MailboxError(payload.decode(errors="replace"))
+                if payload:
+                    yield payload
+                if flags & FLAG_EOS:
+                    eos_seen += 1
+        finally:
+            with self._qlock:
+                self._queues.pop(key, None)
+
+    def discard(self, key: str) -> None:
+        """Drop a queue (undrained partition after an error elsewhere)."""
+        with self._qlock:
+            self._queues.pop(key, None)
+
+    # -- sending ------------------------------------------------------------
+    def send(self, dest_address: str, key: str, payload: bytes,
+             flags: int = 0) -> None:
+        if dest_address == self.address:
+            self._queue(key).put((flags, payload))
+            return
+        kb = key.encode()
+        frame = _KEYLEN.pack(len(kb)) + kb + bytes([flags]) + payload
+        msg = _LEN.pack(len(frame)) + frame
+        with self._conn_lock:
+            sock = self._conns.get(dest_address)
+            try:
+                if sock is None:
+                    sock = self._connect(dest_address)
+                sock.sendall(msg)
+            except (ConnectionError, OSError):
+                # one reconnect attempt (peer restarted)
+                self._drop(dest_address)
+                sock = self._connect(dest_address)
+                sock.sendall(msg)
+
+    def _connect(self, dest_address: str) -> socket.socket:
+        host, port = dest_address.rsplit(":", 1)
+        sock = socket.create_connection((host, int(port)), timeout=30)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._conns[dest_address] = sock
+        return sock
+
+    def _drop(self, dest_address: str) -> None:
+        sock = self._conns.pop(dest_address, None)
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass

@@ -1,0 +1,233 @@
+"""Physical planner: logical tree -> distributed stage DAG.
+
+Reference parity: pinot-query-planner planner/physical/ — fragmenting the
+logical plan into stages at exchange boundaries and assigning workers
+(DispatchablePlanFragment). Rules here (v1):
+
+  * every Scan / SubqueryScan is its own leaf stage
+  * every Join is a stage; both inputs hash-exchange on the join keys
+    (cross / residual-only joins use singleton exchange)
+  * every Aggregate is a stage; input hash-exchanges on the group keys
+    (no keys -> singleton), so each worker owns whole key groups and
+    one-phase FINAL aggregation is exact for every function incl. sketches
+  * Filter / Project fuse into the stage that PRODUCES their input
+    (pushdown: less data on the wire)
+  * the topmost Sort (global order/limit) and anything above it run in the
+    root stage (stage 0) on the broker; senders pre-apply a local
+    sort+limit when a limit exists (root re-sorts, so this is safe)
+
+Stages serialize to JSON for the dispatch wire.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from pinot_tpu.mse import logical as L
+from pinot_tpu.mse.serde import expr_to_json, exprs_to_json
+
+
+@dataclass
+class StagePlan:
+    stage_id: int
+    root: Dict[str, Any] = field(default_factory=dict)  # physical op tree
+    workers: List[str] = field(default_factory=list)
+    out_kind: Optional[str] = None       # hash | singleton | broadcast
+    out_keys: List[Any] = field(default_factory=list)   # expr JSON
+    receiver_stage: int = -1
+    schema: List[str] = field(default_factory=list)
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "stageId": self.stage_id, "root": self.root,
+            "workers": self.workers, "outKind": self.out_kind,
+            "outKeys": self.out_keys, "receiverStage": self.receiver_stage,
+            "schema": self.schema,
+        }
+
+    @staticmethod
+    def from_json(j: Dict[str, Any]) -> "StagePlan":
+        return StagePlan(
+            stage_id=j["stageId"], root=j["root"], workers=j["workers"],
+            out_kind=j.get("outKind"), out_keys=j.get("outKeys", []),
+            receiver_stage=j.get("receiverStage", -1),
+            schema=j.get("schema", []))
+
+
+@dataclass
+class QueryPlan:
+    stages: List[StagePlan]              # stages[0] is the root
+    options: Dict[str, str] = field(default_factory=dict)
+
+    @property
+    def root(self) -> StagePlan:
+        return self.stages[0]
+
+    def stage(self, sid: int) -> StagePlan:
+        for s in self.stages:
+            if s.stage_id == sid:
+                return s
+        raise KeyError(sid)
+
+    def senders_to(self, sid: int) -> List[StagePlan]:
+        return [s for s in self.stages if s.receiver_stage == sid]
+
+
+class _Fragmenter:
+    def __init__(self, table_workers: Callable[[str], List[str]],
+                 intermediate_workers: List[str]):
+        self.table_workers = table_workers
+        self.intermediate = intermediate_workers
+        self.stages: List[StagePlan] = []
+        self._next_id = 0
+
+    def new_stage(self, workers: List[str]) -> StagePlan:
+        s = StagePlan(stage_id=self._next_id, workers=workers)
+        self._next_id += 1
+        self.stages.append(s)
+        return s
+
+    # ------------------------------------------------------------------
+    def fragment_to_stage(self, node: L.LogicalNode) -> StagePlan:
+        """Produce a stage whose root op computes `node` in full (fusing
+        Filter/Project chains into the producing stage)."""
+        if isinstance(node, L.Scan):
+            s = self.new_stage(self.table_workers(node.table))
+            s.root = {"op": "scan", "table": node.table,
+                      "alias": node.alias, "columns": node.columns,
+                      "filter": expr_to_json(node.filter),
+                      "schema": node.schema}
+            s.schema = node.schema
+            return s
+
+        if isinstance(node, L.SubqueryScan):
+            s = self.fragment_to_stage(node.child)
+            s.root = {"op": "rename", "child": s.root,
+                      "schema": node.schema}
+            s.schema = node.schema
+            return s
+
+        if isinstance(node, L.Join):
+            s = self.new_stage(list(self.intermediate))
+            left = self.fragment_to_stage(node.left)
+            right = self.fragment_to_stage(node.right)
+            lk = exprs_to_json(node.left_keys)
+            rk = exprs_to_json(node.right_keys)
+            self._connect(left, s, lk)
+            self._connect(right, s, rk)
+            out_schema = node.left.schema if node.join_type in ("semi", "anti") \
+                else node.schema
+            s.root = {"op": "join", "type": node.join_type,
+                      "left": _receive(left), "right": _receive(right),
+                      "leftKeys": lk, "rightKeys": rk,
+                      "residual": expr_to_json(node.residual),
+                      "schema": out_schema}
+            s.schema = out_schema
+            return s
+
+        if isinstance(node, L.Aggregate):
+            # no group keys -> singleton exchange: exactly ONE worker must
+            # aggregate (a second would emit a spurious identity row)
+            workers = list(self.intermediate) if node.group_exprs \
+                else list(self.intermediate)[:1]
+            s = self.new_stage(workers)
+            child = self.fragment_to_stage(node.child)
+            gk = exprs_to_json(node.group_exprs)
+            self._connect(child, s, gk)
+            s.root = {"op": "aggregate", "child": _receive(child),
+                      "groupExprs": gk,
+                      "aggNodes": exprs_to_json(node.agg_nodes),
+                      "schema": node.schema}
+            s.schema = node.schema
+            return s
+
+        if isinstance(node, L.Filter):
+            s = self.fragment_to_stage(node.child)
+            s.root = {"op": "filter", "child": s.root,
+                      "condition": expr_to_json(node.condition),
+                      "schema": node.schema}
+            s.schema = node.schema
+            return s
+
+        if isinstance(node, L.Project):
+            s = self.fragment_to_stage(node.child)
+            s.root = {"op": "project", "child": s.root,
+                      "exprs": exprs_to_json(node.exprs),
+                      "names": node.names, "schema": node.schema}
+            s.schema = node.schema
+            return s
+
+        if isinstance(node, L.Sort):
+            # a non-topmost sort (subquery ORDER BY LIMIT) needs a global
+            # view, so it gets its OWN single-worker stage fed by a
+            # singleton exchange — narrowing the producing stage itself
+            # would silently drop other servers' scan shards
+            child = self.fragment_to_stage(node.child)
+            s = self.new_stage(list(self.intermediate)[:1])
+            self._connect(child, s, [])
+            s.root = {"op": "sort", "child": _receive(child),
+                      "keys": exprs_to_json(node.keys), "ascs": node.ascs,
+                      "limit": node.limit, "offset": node.offset,
+                      "schema": node.schema}
+            s.schema = node.schema
+            return s
+
+        raise L.PlanError(f"cannot fragment {type(node).__name__}")
+
+    @staticmethod
+    def _connect(child: StagePlan, parent: StagePlan,
+                 hash_keys: List[Any]) -> None:
+        child.receiver_stage = parent.stage_id
+        if hash_keys:
+            child.out_kind = "hash"
+            child.out_keys = hash_keys
+        else:
+            child.out_kind = "singleton"
+
+
+def _receive(child: StagePlan) -> Dict[str, Any]:
+    return {"op": "receive", "stage": child.stage_id, "schema": child.schema}
+
+
+def plan_query(root_logical: L.LogicalNode, options: Dict[str, str],
+               table_workers: Callable[[str], List[str]],
+               intermediate_workers: List[str]) -> QueryPlan:
+    """Fragment a logical plan into a stage DAG; stages[0] runs on the
+    broker and owns the global Sort (and anything above it)."""
+    f = _Fragmenter(table_workers, intermediate_workers)
+    root_stage = f.new_stage(["broker"])
+
+    # peel the chain above (and including) the topmost Sort into the root
+    root_chain: List[L.LogicalNode] = []
+    node = root_logical
+    while isinstance(node, (L.Project, L.Sort)):
+        root_chain.append(node)
+        is_sort = isinstance(node, L.Sort)
+        node = node.child
+        if is_sort:
+            break
+
+    child = f.fragment_to_stage(node)
+    f._connect(child, root_stage, [])
+
+    # local sort+limit at the sender bounds shuffled rows; the root re-sorts
+    sort = next((n for n in root_chain if isinstance(n, L.Sort)), None)
+    if sort is not None and sort.limit >= 0 and child.root["op"] != "aggregate":
+        child.root = {"op": "sort", "child": child.root,
+                      "keys": exprs_to_json(sort.keys), "ascs": sort.ascs,
+                      "limit": sort.limit + sort.offset, "offset": 0,
+                      "schema": child.schema}
+
+    op: Dict[str, Any] = _receive(child)
+    for n in reversed(root_chain):
+        if isinstance(n, L.Sort):
+            op = {"op": "sort", "child": op,
+                  "keys": exprs_to_json(n.keys), "ascs": n.ascs,
+                  "limit": n.limit, "offset": n.offset, "schema": n.schema}
+        else:
+            op = {"op": "project", "child": op,
+                  "exprs": exprs_to_json(n.exprs),
+                  "names": n.names, "schema": n.schema}
+    root_stage.root = op
+    root_stage.schema = root_logical.schema
+    return QueryPlan(stages=f.stages, options=dict(options))

@@ -1,0 +1,230 @@
+"""Stage runtime: executes one stage instance on a worker.
+
+Reference parity: pinot-query-runtime QueryRunner.java:94 (processQuery ->
+build op chain per stage, schedule) and
+LeafStageTransferableBlockOperator (leaf stage runs on the single-stage
+executor — QueryRunner.java:258). Here a stage instance materializes its
+op tree bottom-up (receive -> vectorized block ops), partitions the output
+per the stage's exchange, and pushes to the receiver workers' mailboxes.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from pinot_tpu.mse import operators as ops
+from pinot_tpu.mse.blocks import Block
+from pinot_tpu.mse.mailbox import (
+    FLAG_EOS, FLAG_ERROR, MailboxService, mailbox_key)
+from pinot_tpu.mse.planner import QueryPlan, StagePlan
+from pinot_tpu.mse.serde import expr_from_json, exprs_from_json
+
+#: a scan callback: (table, columns, filter_expr_or_None) -> Block with the
+#: instance's local rows for the table (qualified names applied by caller)
+ScanFn = Callable[[str, List[str], Optional[object]], Block]
+
+
+class StageContext:
+    """Everything a stage instance needs to run."""
+
+    def __init__(self, query_id: str, plan: QueryPlan, worker_id: str,
+                 worker_idx: int, mailbox: MailboxService,
+                 addresses: Dict[str, str], scan_fn: Optional[ScanFn],
+                 timeout: float = 60.0):
+        self.query_id = query_id
+        self.plan = plan
+        self.worker_id = worker_id
+        self.worker_idx = worker_idx
+        self.mailbox = mailbox
+        #: "stage:workerIdx" -> mailbox address
+        self.addresses = addresses
+        self.scan_fn = scan_fn
+        self.timeout = timeout
+
+
+def run_stage(ctx: StageContext, stage: StagePlan) -> Optional[Block]:
+    """Execute one stage instance. Root stage (receiver_stage < 0) returns
+    its block; other stages push to their receivers and return None."""
+    try:
+        try:
+            block = _run_op(ctx, stage.root)
+        except Exception as e:  # noqa: BLE001 — report receivers, don't hang
+            _propagate_error(ctx, stage, f"{type(e).__name__}: {e}")
+            if stage.receiver_stage < 0:
+                raise
+            return None
+        if stage.receiver_stage < 0:
+            return block
+        _send_output(ctx, stage, block)
+        return None
+    finally:
+        # drop any mailbox queues this instance didn't fully drain (e.g. a
+        # join whose OTHER input errored first) — they'd leak otherwise
+        for key in _receive_keys(ctx, stage.root):
+            ctx.mailbox.discard(key)
+
+
+def _propagate_error(ctx: StageContext, stage: StagePlan, msg: str) -> None:
+    """Error frames flow to the receiving stage so the root fails fast."""
+    if stage.receiver_stage < 0:
+        return
+    receivers = ctx.plan.stage(stage.receiver_stage)
+    payload = msg.encode()
+    for w in range(len(receivers.workers)):
+        key = mailbox_key(ctx.query_id, stage.stage_id,
+                          stage.receiver_stage, w)
+        addr = ctx.addresses[f"{stage.receiver_stage}:{w}"]
+        try:
+            ctx.mailbox.send(addr, key, payload, FLAG_ERROR)
+        except Exception:  # noqa: BLE001 — best effort
+            pass
+
+
+def _send_output(ctx: StageContext, stage: StagePlan, block: Block) -> None:
+    receivers = ctx.plan.stage(stage.receiver_stage)
+    nw = len(receivers.workers)
+    if stage.out_kind == "hash" and nw > 1:
+        keys = exprs_from_json(stage.out_keys)
+        parts = ops.hash_partition(block, keys, nw)
+    elif stage.out_kind == "broadcast":
+        parts = [block] * nw
+    else:  # singleton
+        parts = [block] + [None] * (nw - 1)
+    for w in range(nw):
+        key = mailbox_key(ctx.query_id, stage.stage_id,
+                          stage.receiver_stage, w)
+        addr = ctx.addresses[f"{stage.receiver_stage}:{w}"]
+        part = parts[w]
+        payload = part.to_bytes() if part is not None and part.num_rows \
+            else b""
+        ctx.mailbox.send(addr, key, payload, FLAG_EOS)
+
+
+# ---------------------------------------------------------------------------
+# op interpreters
+# ---------------------------------------------------------------------------
+
+def _run_op(ctx: StageContext, op: Dict[str, Any]) -> Block:
+    kind = op["op"]
+    if kind == "receive":
+        return _op_receive(ctx, op)
+    if kind == "scan":
+        return _op_scan(ctx, op)
+    if kind == "rename":
+        child = _run_op(ctx, op["child"])
+        return child.rename(op["schema"])
+    if kind == "filter":
+        child = _run_op(ctx, op["child"])
+        return ops.filter_block(child, expr_from_json(op["condition"]))
+    if kind == "project":
+        child = _run_op(ctx, op["child"])
+        return ops.project_block(child, exprs_from_json(op["exprs"]),
+                                 op["names"])
+    if kind == "join":
+        left = _run_op(ctx, op["left"])
+        right = _run_op(ctx, op["right"])
+        return ops.hash_join(
+            left, right, op["type"],
+            exprs_from_json(op["leftKeys"]), exprs_from_json(op["rightKeys"]),
+            expr_from_json(op["residual"]), op["schema"])
+    if kind == "aggregate":
+        child = _run_op(ctx, op["child"])
+        from pinot_tpu.query.expressions import Function
+        aggs = [a for a in exprs_from_json(op["aggNodes"])]
+        return ops.aggregate_block(
+            child, exprs_from_json(op["groupExprs"]),
+            [a for a in aggs if isinstance(a, Function)], op["schema"])
+    if kind == "sort":
+        child = _run_op(ctx, op["child"])
+        return ops.sort_block(child, exprs_from_json(op["keys"]),
+                              op["ascs"], op["limit"], op["offset"])
+    raise ValueError(f"unknown op {kind!r}")
+
+
+def _receive_keys(ctx: StageContext, op: Dict[str, Any]) -> List[str]:
+    out = []
+    if op["op"] == "receive":
+        sender = ctx.plan.stage(op["stage"])
+        out.append(mailbox_key(ctx.query_id, sender.stage_id,
+                               sender.receiver_stage, ctx.worker_idx))
+    for k in ("child", "left", "right"):
+        child = op.get(k)
+        if isinstance(child, dict):
+            out.extend(_receive_keys(ctx, child))
+    return out
+
+
+def _op_receive(ctx: StageContext, op: Dict[str, Any]) -> Block:
+    sender = ctx.plan.stage(op["stage"])
+    key = mailbox_key(ctx.query_id, sender.stage_id,
+                      sender.receiver_stage, ctx.worker_idx)
+    blocks = [Block.from_bytes(p) for p in ctx.mailbox.receive_all(
+        key, num_senders=len(sender.workers), timeout=ctx.timeout)]
+    blocks = [b for b in blocks if b.num_rows]
+    if not blocks:
+        return _typed_empty(op["schema"])
+    return Block.concat(blocks)
+
+
+def _typed_empty(schema: List[str]) -> Block:
+    return Block(schema, [np.empty(0, object) for _ in schema])
+
+
+def _op_scan(ctx: StageContext, op: Dict[str, Any]) -> Block:
+    if ctx.scan_fn is None:
+        raise RuntimeError("no scan_fn bound (leaf stage on broker?)")
+    filt = expr_from_json(op["filter"])
+    block = ctx.scan_fn(op["table"], op["columns"], filt)
+    return block.rename(op["schema"])
+
+
+# ---------------------------------------------------------------------------
+# worker endpoint
+# ---------------------------------------------------------------------------
+
+class MseWorker:
+    """Per-instance multi-stage worker: mailbox endpoint + stage executor.
+
+    Ref: pinot-query-runtime service/server/QueryServer (gRPC Submit) —
+    here stages arrive as JSON (via the server transport or direct call)
+    and run on a thread pool.
+    """
+
+    def __init__(self, instance_id: str, scan_fn: Optional[ScanFn]):
+        self.instance_id = instance_id
+        self.scan_fn = scan_fn
+        self.mailbox = MailboxService(instance_id)
+        self._lock = threading.Lock()
+
+    def start(self) -> None:
+        self.mailbox.start()
+
+    def stop(self) -> None:
+        self.mailbox.stop()
+
+    @property
+    def mailbox_address(self) -> str:
+        return self.mailbox.address
+
+    def submit_stage(self, query_id: str, plan_json: Dict[str, Any],
+                     stage_json: Dict[str, Any], worker_idx: int,
+                     addresses: Dict[str, str],
+                     timeout: float = 60.0) -> None:
+        """Async: schedule one stage instance on the pool."""
+        plan = QueryPlan(
+            stages=[StagePlan.from_json(s) for s in plan_json["stages"]],
+            options=plan_json.get("options", {}))
+        stage = StagePlan.from_json(stage_json)
+        ctx = StageContext(
+            query_id=query_id, plan=plan, worker_id=self.instance_id,
+            worker_idx=worker_idx, mailbox=self.mailbox,
+            addresses=addresses, scan_fn=self.scan_fn, timeout=timeout)
+        # one thread per stage instance: receive ops BLOCK on producer
+        # stages, so a bounded pool would deadlock once every thread holds
+        # a receive-blocked instance (e.g. deep join trees / concurrency)
+        threading.Thread(
+            target=run_stage, args=(ctx, stage), daemon=True,
+            name=f"mse-{self.instance_id}-{query_id}-s{stage.stage_id}",
+        ).start()

@@ -1,0 +1,43 @@
+"""JSON serde for expressions and stage plans (dispatch wire format).
+
+Reference parity: pinot-query-planner serializes plan fragments to proto
+(planner/serde/, plan.proto); here plans cross the dispatch boundary as
+JSON — expressions as tagged s-expression lists.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+from pinot_tpu.query.expressions import (
+    Expression, Function, Identifier, Literal)
+
+
+def expr_to_json(e: Optional[Expression]) -> Any:
+    if e is None:
+        return None
+    if isinstance(e, Literal):
+        return ["lit", e.value]
+    if isinstance(e, Identifier):
+        return ["id", e.name]
+    assert isinstance(e, Function)
+    return ["fn", e.name] + [expr_to_json(a) for a in e.args]
+
+
+def expr_from_json(j: Any) -> Optional[Expression]:
+    if j is None:
+        return None
+    tag = j[0]
+    if tag == "lit":
+        return Literal(j[1])
+    if tag == "id":
+        return Identifier(j[1])
+    assert tag == "fn"
+    return Function(j[1], tuple(expr_from_json(a) for a in j[2:]))
+
+
+def exprs_to_json(es) -> List[Any]:
+    return [expr_to_json(e) for e in es]
+
+
+def exprs_from_json(js) -> List[Expression]:
+    return [expr_from_json(j) for j in js]

@@ -1,0 +1,182 @@
+"""Multi-stage SQL dialect: JOINs + derived tables on top of the
+single-stage grammar.
+
+Reference parity: the reference hands multi-stage SQL to Calcite
+(pinot-query-planner QueryEnvironment.java:100); here the hand-rolled
+single-stage parser (query/parser.py) is extended with a FROM clause
+grammar: table [AS alias] | (subquery) AS alias, followed by
+[INNER|LEFT [OUTER]|RIGHT [OUTER]|FULL [OUTER]] JOIN ... ON <cond>.
+Qualified identifiers (t.col) arrive as single tokens (the lexer's name
+production includes dots) and are resolved during logical planning.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from pinot_tpu.query.expressions import Expression
+from pinot_tpu.query.parser import (
+    PinotQuery, SqlParseError, Token, _Parser, tokenize)
+
+
+@dataclass
+class FromItem:
+    alias: str
+    table: Optional[str] = None            # base table scan ...
+    subquery: Optional["MseQuery"] = None  # ... or derived table
+
+
+@dataclass
+class JoinClause:
+    item: FromItem
+    join_type: str                  # inner | left | right | full
+    condition: Optional[Expression]
+
+
+@dataclass
+class MseQuery:
+    """Multi-table query tree (ref: Calcite SqlSelect + joins)."""
+    from_item: FromItem = None  # type: ignore[assignment]
+    joins: List[JoinClause] = field(default_factory=list)
+    select_list: List[Expression] = field(default_factory=list)
+    distinct: bool = False
+    filter: Optional[Expression] = None
+    group_by: List[Expression] = field(default_factory=list)
+    having: Optional[Expression] = None
+    order_by: List[Tuple[Expression, bool]] = field(default_factory=list)
+    #: None = no explicit LIMIT. The dispatcher applies the Pinot default
+    #: (10) to the OUTERMOST query only; subqueries stay unlimited.
+    limit: Optional[int] = None
+    offset: int = 0
+    options: Dict[str, str] = field(default_factory=dict)
+    explain: bool = False
+
+    @property
+    def is_single_table(self) -> bool:
+        return not self.joins and self.from_item.table is not None
+
+    def to_single_stage(self) -> PinotQuery:
+        """Lower a join-free query to the single-stage AST."""
+        assert self.is_single_table
+        return PinotQuery(
+            table=self.from_item.table, select_list=self.select_list,
+            distinct=self.distinct, filter=self.filter,
+            group_by=self.group_by, having=self.having,
+            order_by=self.order_by,
+            limit=10 if self.limit is None else self.limit,
+            offset=self.offset, options=self.options, explain=self.explain)
+
+
+_JOIN_KWS = ("JOIN", "INNER", "LEFT", "RIGHT", "FULL", "CROSS")
+
+
+class _MseParser(_Parser):
+    def parse_mse(self) -> MseQuery:
+        q = self._select_stmt()
+        self.accept_op(";")
+        t = self.peek()
+        if t.kind != "end":
+            raise SqlParseError(f"trailing input at {t.pos}: {t.text!r}")
+        return q
+
+    def _select_stmt(self) -> MseQuery:
+        q = MseQuery()
+        while self.accept_kw("SET"):
+            key = self._name_text(self.next())
+            self.expect_op("=")
+            q.options[key] = self._literal_text(self.next())
+            self.accept_op(";")
+        if self.accept_kw("EXPLAIN"):
+            self.expect_kw("PLAN")
+            self.expect_kw("FOR")
+            q.explain = True
+        self.expect_kw("SELECT")
+        if self.accept_kw("DISTINCT"):
+            q.distinct = True
+        q.select_list = self._select_list()
+        self.expect_kw("FROM")
+        q.from_item = self._from_item()
+        while True:
+            jt = self._join_type()
+            if jt is None:
+                break
+            item = self._from_item()
+            cond = None
+            if jt != "cross":
+                self.expect_kw("ON")
+                cond = self.expr()
+            q.joins.append(JoinClause(item, jt, cond))
+        if self.accept_kw("WHERE"):
+            q.filter = self.expr()
+        if self.accept_kw("GROUP"):
+            self.expect_kw("BY")
+            q.group_by = self._expr_list()
+        if self.accept_kw("HAVING"):
+            q.having = self.expr()
+        if self.accept_kw("ORDER"):
+            self.expect_kw("BY")
+            q.order_by = self._order_list()
+        if self.accept_kw("LIMIT"):
+            a = int(self._literal_text(self.next()))
+            if self.accept_op(","):
+                q.offset, q.limit = a, int(self._literal_text(self.next()))
+            else:
+                q.limit = a
+                if self.accept_kw("OFFSET"):
+                    q.offset = int(self._literal_text(self.next()))
+        if self.accept_kw("OPTION"):
+            self.expect_op("(")
+            while True:
+                key = self._name_text(self.next())
+                self.expect_op("=")
+                q.options[key] = self._literal_text(self.next())
+                if not self.accept_op(","):
+                    break
+            self.expect_op(")")
+        return q
+
+    def _join_type(self) -> Optional[str]:
+        t = self.peek()
+        if t.kind != "name" or t.upper not in _JOIN_KWS:
+            return None
+        if self.accept_kw("JOIN"):
+            return "inner"
+        if self.accept_kw("CROSS"):
+            self.expect_kw("JOIN")
+            return "cross"
+        for kw in ("INNER", "LEFT", "RIGHT", "FULL"):
+            if self.accept_kw(kw):
+                self.accept_kw("OUTER")
+                self.expect_kw("JOIN")
+                return kw.lower() if kw != "INNER" else "inner"
+        return None
+
+    def _from_item(self) -> FromItem:
+        if self.accept_op("("):
+            sub = self._select_stmt()
+            self.expect_op(")")
+            self.accept_kw("AS")
+            alias = self._name_text(self.next())
+            return FromItem(alias=alias, subquery=sub)
+        table = self._table_name()
+        alias = table
+        t = self.peek()
+        if self.accept_kw("AS"):
+            alias = self._name_text(self.next())
+        elif t.kind in ("name", "qident") and t.upper not in _RESERVED_AFTER_TABLE:
+            self.next()
+            alias = self._name_text(t)
+        return FromItem(alias=alias, table=table)
+
+
+# keywords that may legally follow a table name (so a bare name after the
+# table is otherwise an alias)
+_RESERVED_AFTER_TABLE = {
+    "JOIN", "INNER", "LEFT", "RIGHT", "FULL", "CROSS", "ON", "WHERE",
+    "GROUP", "HAVING", "ORDER", "LIMIT", "OFFSET", "OPTION", "AS", "UNION",
+}
+
+
+def parse_mse_sql(sql: str) -> MseQuery:
+    """Parse multi-stage SQL (joins, derived tables) into an MseQuery."""
+    return _MseParser(tokenize(sql)).parse_mse()

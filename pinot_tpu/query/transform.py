@@ -86,10 +86,88 @@ def evaluate(expr: Expression, provider: ColumnProvider) -> Any:
     if name in _COMPARISONS:
         return _COMPARISONS[name](evaluate(expr.args[0], provider),
                                   evaluate(expr.args[1], provider))
+    if name in _PREDICATES:
+        return _PREDICATES[name](expr, provider)
     handler = _SPECIAL.get(name)
     if handler is not None:
         return handler(expr, provider)
     raise ValueError(f"unsupported transform function: {name}")
+
+
+# -- predicate evaluation over plain providers (MSE intermediate blocks;
+#    segment scans use the index-aware path in query/filter.py instead) ----
+
+def _bool(x, p: ColumnProvider) -> np.ndarray:
+    arr = np.asarray(x)
+    if arr.ndim == 0:
+        arr = np.full(p.num_docs, bool(arr))
+    return arr.astype(bool, copy=False)
+
+
+def _pred_and(e: Function, p: ColumnProvider):
+    out = _bool(evaluate(e.args[0], p), p)
+    for a in e.args[1:]:
+        out = out & _bool(evaluate(a, p), p)
+    return out
+
+
+def _pred_or(e: Function, p: ColumnProvider):
+    out = _bool(evaluate(e.args[0], p), p)
+    for a in e.args[1:]:
+        out = out | _bool(evaluate(a, p), p)
+    return out
+
+
+def _pred_between(e: Function, p: ColumnProvider):
+    v = evaluate(e.args[0], p)
+    lo = evaluate(e.args[1], p)
+    hi = evaluate(e.args[2], p)
+    return np.greater_equal(v, lo) & np.less_equal(v, hi)
+
+
+def _pred_in(e: Function, p: ColumnProvider):
+    v = np.asarray(evaluate(e.args[0], p))
+    vals = [a.value for a in e.args[1:]]  # type: ignore[union-attr]
+    if v.dtype.kind in "UOS":
+        vals = [str(x) for x in vals]
+        v = v.astype(str)
+    else:
+        # numeric column: coerce string literals into the value domain
+        # (parity with the leaf path, query/filter.py _value_space_mask)
+        vals = [float(x) if isinstance(x, str) else x for x in vals]
+    return np.isin(v, np.asarray(vals))
+
+
+def _pred_like(e: Function, p: ColumnProvider):
+    import re as _re
+    from pinot_tpu.query.filter import like_to_regex
+    v = np.asarray(evaluate(e.args[0], p))
+    pattern = e.args[1].value  # type: ignore[union-attr]
+    rx = _re.compile(like_to_regex(pattern) if e.name == "like" else pattern)
+    return np.array([rx.search(str(x)) is not None for x in v], bool)
+
+
+def _pred_is_null(e: Function, p: ColumnProvider):
+    v = np.asarray(evaluate(e.args[0], p))
+    if v.dtype.kind == "f":
+        return np.isnan(v)
+    if v.dtype.kind == "O":
+        return np.array([x is None for x in v], bool)
+    return np.zeros(len(v), bool)
+
+
+_PREDICATES: Dict[str, Callable] = {
+    "and": _pred_and,
+    "or": _pred_or,
+    "not": lambda e, p: ~_bool(evaluate(e.args[0], p), p),
+    "between": _pred_between,
+    "in": _pred_in,
+    "not_in": lambda e, p: ~_pred_in(e, p),
+    "like": _pred_like,
+    "regexp_like": _pred_like,
+    "is_null": _pred_is_null,
+    "is_not_null": lambda e, p: ~_pred_is_null(e, p),
+}
 
 
 def _as_numeric(x):
