@@ -58,12 +58,27 @@ MAX_DOCS_PER_SEGMENT = 1 << 24
 
 
 class TpuOperatorExecutor:
-    def __init__(self, devices: Optional[Sequence] = None):
-        self.devices = list(devices) if devices is not None else jax.devices()
-        self._mesh = None
-        if len(self.devices) > 1:
-            from jax.sharding import Mesh
-            self._mesh = Mesh(np.array(self.devices), ("segments",))
+    def __init__(self, devices: Optional[Sequence] = None, mesh=None):
+        """mesh: an explicit (segments, docs) jax Mesh — blocks shard over
+        BOTH axes and the kernel runs under shard_map with psum/pmin/pmax
+        collectives over `docs` (SURVEY §2.6 rows 6-7). Without one, >1
+        device gets a segments-only mesh (GSPMD partitions the reductions);
+        one device runs the plain jit kernel."""
+        self._doc_axis = 1
+        if mesh is not None:
+            self._mesh = mesh
+            self.devices = list(mesh.devices.flat)
+            shape = dict(zip(mesh.axis_names, mesh.devices.shape))
+            self._seg_axis = shape.get("segments", 1)
+            self._doc_axis = shape.get("docs", 1)
+        else:
+            self.devices = list(devices) if devices is not None \
+                else jax.devices()
+            self._mesh = None
+            self._seg_axis = max(len(self.devices), 1)
+            if len(self.devices) > 1:
+                from jax.sharding import Mesh
+                self._mesh = Mesh(np.array(self.devices), ("segments",))
         #: device-resident column blocks, LRU-evicted under a byte budget
         #: (HBM segment cache, SURVEY.md §7.5); keys carry the segment
         #: batch identity (id+name pairs guard against id() reuse)
@@ -163,8 +178,12 @@ class TpuOperatorExecutor:
             cols, params, num_docs, S_real, D = self._stage(segments, ctx, plan)
         except _NotStageable:
             return [], segments
-        kernel = kernels.compiled_kernel(plan)
-        packed = np.asarray(kernel(cols, params, num_docs, D=D))
+        if self._doc_axis > 1:
+            kernel = kernels.compiled_sharded_kernel(plan, self._mesh)
+            packed = np.asarray(kernel(cols, params, num_docs, D=D))
+        else:
+            kernel = kernels.compiled_kernel(plan)
+            packed = np.asarray(kernel(cols, params, num_docs, D=D))
         results = self._assemble(segments, ctx, plan, packed, S_real, slots_of_fn)
         return results, []
 
@@ -329,11 +348,14 @@ class TpuOperatorExecutor:
         S_real = len(segments)
         S = S_real
         if self._mesh is not None:
-            n = len(self.devices)
+            n = self._seg_axis
             S = ((S_real + n - 1) // n) * n
         if max(s.num_docs for s in segments) > MAX_DOCS_PER_SEGMENT:
             raise _NotStageable()
         D = _pow2(max(s.num_docs for s in segments))
+        if D % self._doc_axis:  # doc shards must tile evenly (pow2 D can
+            a = self._doc_axis  # never reach divisibility by doubling)
+            D = ((D + a - 1) // a) * a
 
         cols: Dict[str, jnp.ndarray] = {}
         params: Dict[str, jnp.ndarray] = {}
@@ -495,7 +517,7 @@ class TpuOperatorExecutor:
         block = np.stack(rows) if len(rows) == S else \
             np.concatenate([np.stack(rows),
                             np.zeros((S - len(rows), D), dtype=dtype)])
-        dev = self._put(block)
+        dev = self._put(block, block=True)
         self._insert_block(bkey, (tuple(segments), dev), block.nbytes)
         return dev
 
@@ -528,11 +550,16 @@ class TpuOperatorExecutor:
                     max(abs(int(lo)), abs(int(hi))) > (1 << 24):
                 raise _NotStageable()
 
-    def _put(self, arr: np.ndarray):
+    def _put(self, arr: np.ndarray, block: bool = False):
+        """block=True marks [S, D] column blocks, which also shard over the
+        docs axis on a 2-axis mesh; params/bounds shard over segments only."""
         if self._mesh is None:
             return jnp.asarray(arr)
         from jax.sharding import NamedSharding, PartitionSpec as P
-        spec = P("segments", *([None] * (arr.ndim - 1)))
+        if block and self._doc_axis > 1 and arr.ndim == 2:
+            spec = P("segments", "docs")
+        else:
+            spec = P("segments", *([None] * (arr.ndim - 1)))
         return jax.device_put(arr, NamedSharding(self._mesh, spec))
 
     @staticmethod

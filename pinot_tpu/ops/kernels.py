@@ -192,6 +192,37 @@ def _vmap_scatter(init: jnp.ndarray, keys: jnp.ndarray, vals: jnp.ndarray,
 # Kernel assembly
 # ---------------------------------------------------------------------------
 
+def _compute_slots(plan: DevicePlan, cols, params, valid):
+    """Shared kernel body: filter + values + per-slot reductions over a
+    (possibly shard-local) [S, D] block. Returns
+    ([(op, [S]- or [S, G]-array)], matched_count [S] or None)."""
+    dt = _value_dtype()
+    if plan.filter_ir is not None:
+        mask = _eval_filter(plan.filter_ir, plan, cols, params)
+    else:
+        mask = jnp.ones(valid.shape, dtype=bool)
+
+    values = []
+    for ir in plan.value_irs:
+        values.append(None if ir is None else _eval_value(ir, cols, params))
+
+    slots = []
+    if plan.num_groups:
+        keys = jnp.zeros(valid.shape, dtype=jnp.int32)
+        for col, stride in zip(plan.group_cols, plan.group_strides):
+            keys = keys + cols["ids:" + col] * jnp.int32(stride)
+        for op, vidx in plan.agg_ops:
+            vals = None if vidx is None else values[vidx]
+            slots.append((op, _grouped_reduce(op, vals, keys, mask, valid,
+                                              plan.num_groups)))
+        return slots, None
+    matched = jnp.sum(mask & valid, axis=1).astype(dt)
+    for op, vidx in plan.agg_ops:
+        vals = None if vidx is None else values[vidx]
+        slots.append((op, _masked_reduce(op, vals, mask, valid)))
+    return slots, matched
+
+
 def make_kernel(plan: DevicePlan):
     """Build the traced kernel fn(cols, params, num_docs, D) -> packed array.
 
@@ -199,45 +230,21 @@ def make_kernel(plan: DevicePlan):
     params:  dict of per-leaf predicate arrays ('leaf<i>:lo/hi/idx/lut')
     num_docs: int32 [S] actual docs per segment (for the padding mask).
 
-    Returns one packed array (see kernel docstring below).
+    Returns ONE packed array — a single device->host fetch matters
+    because the host<->TPU link can cost O(100ms) per round trip:
+      no group-by: [S, 1 + n_slots]  (col 0 = matched doc count)
+      group-by:    [S, G, n_slots]   (matched derived from the count
+                                      slot host-side)
+    Counts ride in the value dtype; exact while D < 2^24 (engine caps
+    doc padding below that).
     """
 
     def kernel(cols, params, num_docs, D):
-        """Returns ONE packed array — a single device->host fetch matters
-        because the host<->TPU link can cost O(100ms) per round trip:
-          no group-by: [S, 1 + n_slots]  (col 0 = matched doc count)
-          group-by:    [S, G, n_slots]   (matched derived from the count
-                                          slot host-side)
-        Counts ride in the value dtype; exact while D < 2^24 (engine caps
-        doc padding below that).
-        """
-        S = num_docs.shape[0]
         valid = jnp.arange(D, dtype=jnp.int32)[None, :] < num_docs[:, None]
-        if plan.filter_ir is not None:
-            mask = _eval_filter(plan.filter_ir, plan, cols, params)
-        else:
-            mask = jnp.ones((S, D), dtype=bool)
-
-        values = []
-        for ir in plan.value_irs:
-            values.append(None if ir is None else _eval_value(ir, cols, params))
-
+        slots, matched = _compute_slots(plan, cols, params, valid)
         if plan.num_groups:
-            keys = jnp.zeros((S, D), dtype=jnp.int32)
-            for col, stride in zip(plan.group_cols, plan.group_strides):
-                keys = keys + cols["ids:" + col] * jnp.int32(stride)
-            slots = []
-            for op, vidx in plan.agg_ops:
-                vals = None if vidx is None else values[vidx]
-                slots.append(_grouped_reduce(op, vals, keys, mask, valid,
-                                             plan.num_groups))
-            return jnp.stack(slots, axis=-1)
-        dt = _value_dtype()
-        slots = [jnp.sum(mask & valid, axis=1).astype(dt)]
-        for op, vidx in plan.agg_ops:
-            vals = None if vidx is None else values[vidx]
-            slots.append(_masked_reduce(op, vals, mask, valid))
-        return jnp.stack(slots, axis=-1)
+            return jnp.stack([s for _, s in slots], axis=-1)
+        return jnp.stack([matched] + [s for _, s in slots], axis=-1)
 
     return kernel
 
@@ -248,3 +255,81 @@ def compiled_kernel(plan: DevicePlan):
     handled inside jit's own cache; D is static because a filterless
     COUNT(*) stages no columns to infer it from)."""
     return jax.jit(make_kernel(plan), static_argnames=("D",))
+
+
+# ---------------------------------------------------------------------------
+# multi-chip: the same kernel under shard_map over a (segments, docs) mesh
+# ---------------------------------------------------------------------------
+
+_DOC_COMBINE = {"sum": "psum", "count": "psum", "sumsq": "psum",
+                "min": "pmin", "max": "pmax"}
+
+
+def make_sharded_kernel(plan: DevicePlan, mesh):
+    """ANY DevicePlan over a (segments x docs) mesh with explicit ICI
+    collectives (SURVEY §2.6 rows 6-7): column blocks shard over both axes,
+    each device reduces its local [S_loc, D_loc] shard, then partials
+    combine with psum/pmin/pmax over the `docs` axis. Per-segment results
+    stay sharded over `segments` (the engine assembles them host-side, the
+    same contract as the single-chip kernel).
+
+    fn(cols, params, num_docs, D) -> packed array (D static: the padded
+    GLOBAL doc count; each shard derives its global doc indices from
+    axis_index('docs') — a shard-local arange would restart at 0 and
+    mis-mask padding).
+    """
+    from jax.sharding import PartitionSpec as P
+    try:
+        from jax import shard_map  # jax >= 0.8
+    except ImportError:  # pragma: no cover — older jax
+        from jax.experimental.shard_map import shard_map  # type: ignore
+
+    doc_shards = dict(zip(mesh.axis_names, mesh.devices.shape)).get("docs", 1)
+
+    def local(cols, params, num_docs, D):
+        d_local = D // doc_shards
+        doc_pos = (jax.lax.axis_index("docs") * d_local
+                   + jnp.arange(d_local, dtype=jnp.int32))[None, :]
+        valid = doc_pos < num_docs[:, None]
+        slots, matched = _compute_slots(plan, cols, params, valid)
+        combined = []
+        for op, s in slots:
+            kind = _DOC_COMBINE[op]
+            if kind == "psum":
+                combined.append(jax.lax.psum(s, "docs"))
+            elif kind == "pmin":
+                combined.append(jax.lax.pmin(s, "docs"))
+            else:
+                combined.append(jax.lax.pmax(s, "docs"))
+        if plan.num_groups:
+            return jnp.stack(combined, axis=-1)
+        matched = jax.lax.psum(matched, "docs")
+        return jnp.stack([matched] + combined, axis=-1)
+
+    def col_spec(name):
+        return P("segments", "docs")  # every staged block is [S, D]
+
+    def param_spec(arr):
+        # leaf params: [S] bounds or [S, C] LUTs — segment axis only
+        return P("segments", *([None] * (arr.ndim - 1)))
+
+    def fn(cols, params, num_docs, D):
+        in_specs = (
+            {k: col_spec(k) for k in cols},
+            {k: param_spec(v) for k, v in params.items()},
+            P("segments"),
+        )
+        ndim_out = 3 if plan.num_groups else 2
+        sm = shard_map(
+            functools.partial(local, D=D), mesh=mesh,
+            in_specs=in_specs,
+            out_specs=P("segments", *([None] * (ndim_out - 1))),
+        )
+        return sm(cols, params, num_docs)
+
+    return jax.jit(fn, static_argnames=("D",))
+
+
+@functools.lru_cache(maxsize=256)
+def compiled_sharded_kernel(plan: DevicePlan, mesh):
+    return make_sharded_kernel(plan, mesh)

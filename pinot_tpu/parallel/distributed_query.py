@@ -22,9 +22,9 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 try:  # moved out of experimental in newer jax
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 except ImportError:  # pragma: no cover
-    from jax.shard_map import shard_map  # type: ignore
+    from jax.experimental.shard_map import shard_map  # type: ignore
 
 
 def distributed_query_step(mesh: Mesh):
