@@ -37,7 +37,9 @@ from pinot_tpu.query.results import (
     AggregationResult, ExecutionStats, GroupByResult)
 from pinot_tpu.segment.loader import DataSource, ImmutableSegment
 
-MAX_DEVICE_GROUPS = 65536
+MAX_DEVICE_GROUPS = 1 << 20
+#: cap on the [S, G, slots] group-by result buffer (f32/f64 accumulators)
+MAX_GROUP_RESULT_BYTES = 1 << 31
 _LEAF_RANGE_FUNCS = {
     "equals", "between", "greater_than", "greater_than_or_equal",
     "less_than", "less_than_or_equal",
@@ -113,8 +115,11 @@ class TpuOperatorExecutor:
     def supports(self, ctx: QueryContext) -> bool:
         if not ctx.aggregations or ctx.distinct:
             return False
-        if any(f is not None for f in ctx.agg_filters):
-            return False  # FILTER aggs run host-side for now
+        for f in ctx.agg_filters:
+            # FILTER (WHERE ...) aggs offload as per-slot masks when the
+            # condition has a device filter shape
+            if f is not None and not self._filter_shape_ok(f):
+                return False
         if any(fn.device_spec is None for fn in ctx.agg_functions):
             return False
         for node in ctx.aggregations:
@@ -225,19 +230,55 @@ class TpuOperatorExecutor:
         def check_value_cols(ir) -> bool:
             if ir[0] == "col":
                 col = ir[1]
+                if col in raw64:
+                    return False  # split-plane columns have no value block
                 if not classify(col):
                     return False
                 m = seg0.metadata.columns[col]
-                return m.data_type.np_dtype.kind in "iuf" 
+                return m.data_type.np_dtype.kind in "iuf"
             if ir[0] == "lit":
                 return True
             return all(check_value_cols(c) for c in ir[1:] if isinstance(c, tuple))
 
+        # filter IR FIRST: leaves fill in build order, so the main filter's
+        # leaves precede agg-filter leaves (staging resolves in this order)
+        leaves: List[DeviceLeaf] = []
+        filter_ir = None
+        if ctx.filter is not None:
+            filter_ir = self._build_filter_ir(ctx.filter, segments, leaves,
+                                              classify)
+            if filter_ir is None:
+                return None
+
+        #: columns that stage as split planes carry NO 'val:' block — they
+        #: cannot feed value IRs (the whole query falls back instead)
+        raw64 = {lf.column for lf in leaves if lf.kind == "vrange64"}
+
+        # per-aggregation FILTER (WHERE ...) trees, deduplicated
+        agg_filter_irs: List[tuple] = []
+        fidx_of_filter: Dict[Expression, int] = {}
+        agg_fidx: List[Optional[int]] = []
+        for f in ctx.agg_filters:
+            if f is None:
+                agg_fidx.append(None)
+                continue
+            if f in fidx_of_filter:
+                agg_fidx.append(fidx_of_filter[f])
+                continue
+            ir = self._build_filter_ir(f, segments, leaves, classify)
+            if ir is None:
+                return None
+            fidx_of_filter[f] = len(agg_filter_irs)
+            agg_fidx.append(len(agg_filter_irs))
+            agg_filter_irs.append(ir)
+        raw64 |= {lf.column for lf in leaves if lf.kind == "vrange64"}
+
         # aggregation slots
-        agg_ops: List[Tuple[str, Optional[int]]] = []
-        slot_index: Dict[Tuple[str, Optional[int]], int] = {}
+        agg_ops: List[Tuple[str, Optional[int], Optional[int]]] = []
+        slot_index: Dict[Tuple[str, Optional[int], Optional[int]], int] = {}
         slots_of_fn: List[Dict[str, int]] = []
-        for node, fn in zip(ctx.aggregations, ctx.agg_functions):
+        for i, (node, fn) in enumerate(zip(ctx.aggregations,
+                                           ctx.agg_functions)):
             arg_ir = None
             if node.args and not (isinstance(node.args[0], Identifier)
                                   and node.args[0].name == "*"):
@@ -245,9 +286,10 @@ class TpuOperatorExecutor:
                 if arg_ir is None or not check_value_cols(arg_ir):
                     return None
             vidx = intern_ir(arg_ir)
+            fidx = agg_fidx[i]
             mapping = {}
             for op in fn.device_spec.ops:
-                key = (op, None if op == "count" else vidx)
+                key = (op, None if op == "count" else vidx, fidx)
                 if op != "count" and vidx is None:
                     return None
                 if key not in slot_index:
@@ -278,48 +320,51 @@ class TpuOperatorExecutor:
                 num_groups *= c
             if num_groups > MAX_DEVICE_GROUPS:
                 return None
+            # memory guard: the [S, G, slots] result buffer must stay sane
+            # (S as padded by _stage to a segments-axis multiple)
+            n_slots = len(agg_ops) + 1  # +1 for the guaranteed count slot
+            n = self._seg_axis if self._mesh is not None else 1
+            s_pad = ((len(segments) + n - 1) // n) * n
+            if s_pad * num_groups * n_slots * 8 > MAX_GROUP_RESULT_BYTES:
+                return None
             stride = num_groups
             for c in card_pads:
                 stride //= c
                 group_strides.append(stride)
-            # group-by always needs a count slot to detect present groups
-            if ("count", None) not in slot_index:
-                slot_index[("count", None)] = len(agg_ops)
-                agg_ops.append(("count", None))
+            # group-by always needs an unfiltered count slot to detect
+            # present groups
+            if ("count", None, None) not in slot_index:
+                slot_index[("count", None, None)] = len(agg_ops)
+                agg_ops.append(("count", None, None))
 
-        # filter IR
-        leaves: List[DeviceLeaf] = []
-        filter_ir = None
-        if ctx.filter is not None:
-            filter_ir = self._build_filter_ir(ctx.filter, seg0, leaves,
-                                              classify)
-            if filter_ir is None:
-                return None
-
+        raw64 = {lf.column for lf in leaves if lf.kind == "vrange64"}
         plan = DevicePlan(
             filter_ir=filter_ir,
             leaves=tuple(leaves),
             value_irs=tuple(value_irs),
             agg_ops=tuple(agg_ops),
+            agg_filter_irs=tuple(agg_filter_irs),
             group_cols=tuple(group_cols),
             group_strides=tuple(group_strides),
             num_groups=num_groups,
             dict_cols=tuple(sorted(dict_cols)),
-            raw_cols=tuple(sorted(raw_cols)),
+            raw_cols=tuple(sorted(raw_cols - raw64)),
+            raw64_cols=tuple(sorted(raw64)),
         )
         return plan, slots_of_fn
 
-    def _build_filter_ir(self, e: Function, seg0, leaves, classify):
+    def _build_filter_ir(self, e: Function, segments, leaves, classify):
+        seg0 = segments[0]
         if e.name in ("and", "or"):
             children = []
             for a in e.args:
-                c = self._build_filter_ir(a, seg0, leaves, classify)
+                c = self._build_filter_ir(a, segments, leaves, classify)
                 if c is None:
                     return None
                 children.append(c)
             return (e.name, *children)
         if e.name == "not":
-            c = self._build_filter_ir(e.args[0], seg0, leaves, classify)
+            c = self._build_filter_ir(e.args[0], segments, leaves, classify)
             return None if c is None else ("not", c)
         if not e.args or not isinstance(e.args[0], Identifier):
             return None
@@ -339,9 +384,34 @@ class TpuOperatorExecutor:
         else:
             if e.name not in _LEAF_RANGE_FUNCS:
                 return None
-            kind = "vrange"
+            if m.data_type.np_dtype.kind in "iu" and \
+                    not jax.config.read("jax_enable_x64"):
+                kind = self._int_filter_kind(segments, col)
+                if kind is None:
+                    return None
+            else:
+                kind = "vrange"
         leaves.append(DeviceLeaf(kind, col))
         return ("leaf", len(leaves) - 1)
+
+    @staticmethod
+    def _int_filter_kind(segments, col: str) -> Optional[str]:
+        """Staging for a raw int filter column under x64-off:
+        'vrange'   — |v| <= 2^24, exact in f32
+        'vrange64' — |v| < 2^55, exact via (hi, lo) i32 split planes
+        None       — range unknown or too wide: host fallback (an i32 hi
+                     plane would silently wrap for |v| >= 2^55)"""
+        big = False
+        for seg in segments:
+            m = seg.metadata.columns.get(col)
+            if m is None or m.min_value is None or m.max_value is None:
+                return None
+            peak = max(abs(int(m.min_value)), abs(int(m.max_value)))
+            if peak >= (1 << 55):
+                return None
+            if peak > (1 << 24):
+                big = True
+        return "vrange64" if big else "vrange"
 
     # ------------------------------------------------------------------
     def _stage(self, segments, ctx: QueryContext, plan: DevicePlan):
@@ -370,6 +440,17 @@ class TpuOperatorExecutor:
             cols["val:" + col] = self._stacked(
                 segments, S, D, col, "val",
                 lambda ds: ds.values().astype(vdt), vdt)
+        for col in plan.raw64_cols:
+            # big-int filter columns: (hi, lo) i32 split planes, exact
+            # under x64-off where f32 staging would alias (plan_ir vrange64)
+            cols["valhi:" + col] = self._stacked(
+                segments, S, D, col, "valhi",
+                lambda ds: (ds.values().astype(np.int64) >> 24
+                            ).astype(np.int32), np.int32)
+            cols["vallo:" + col] = self._stacked(
+                segments, S, D, col, "vallo",
+                lambda ds: (ds.values().astype(np.int64) & 0xFFFFFF
+                            ).astype(np.int32), np.int32)
 
         # value columns: stage MATERIALIZED values (dictionary take done
         # host-side at staging, cached in HBM) rather than in-kernel
@@ -391,9 +472,10 @@ class TpuOperatorExecutor:
             cols["val:" + col] = self._stacked(
                 segments, S, D, col, "val", fetch_values, vdt)
 
-        # per-leaf predicate parameters (cached: ctx.filter is a frozen
-        # expression tree, so it keys the resolved literals exactly)
-        pkey = (_batch_id(segments), plan, ctx.filter, S)
+        # per-leaf predicate parameters (cached: filters are frozen
+        # expression trees, so they key the resolved literals exactly)
+        pkey = (_batch_id(segments), plan, ctx.filter,
+                tuple(ctx.agg_filters), S)
         if len(self._params_cache) > 4096:
             self._params_cache.clear()
         cached = self._params_cache.get(pkey)
@@ -402,13 +484,32 @@ class TpuOperatorExecutor:
             if all(a is b for a, b in zip(csegs, segments)):
                 params.update(cparams)
                 return cols, params, cnum_docs, S_real, D
-        leaf_exprs = self._collect_leaf_exprs(ctx.filter, plan) \
-            if ctx.filter is not None else []
+        # leaf expressions in the exact order _plan appended leaves:
+        # main filter first, then each distinct agg FILTER tree
+        leaf_exprs: List[Function] = []
+        if ctx.filter is not None:
+            leaf_exprs += self._collect_leaf_exprs(ctx.filter, plan)
+        seen_filters = set()
+        for f in ctx.agg_filters:
+            if f is not None and f not in seen_filters:
+                seen_filters.add(f)
+                leaf_exprs += self._collect_leaf_exprs(f, plan)
         for i, (leaf, expr) in enumerate(zip(plan.leaves, leaf_exprs)):
             if leaf.kind == "vrange":
                 lo, hi = _vrange_bounds(expr, vdt)
                 params[f"leaf{i}:lo"] = self._put(np.full(S, lo, dtype=vdt))
                 params[f"leaf{i}:hi"] = self._put(np.full(S, hi, dtype=vdt))
+                continue
+            if leaf.kind == "vrange64":
+                a, b = _vrange_int_bounds(expr)
+                params[f"leaf{i}:lohi"] = self._put(
+                    np.full(S, a >> 24, dtype=np.int32))
+                params[f"leaf{i}:lolo"] = self._put(
+                    np.full(S, a & 0xFFFFFF, dtype=np.int32))
+                params[f"leaf{i}:hihi"] = self._put(
+                    np.full(S, b >> 24, dtype=np.int32))
+                params[f"leaf{i}:hilo"] = self._put(
+                    np.full(S, b & 0xFFFFFF, dtype=np.int32))
                 continue
             if leaf.kind == "range":
                 lo = np.zeros(S, dtype=np.int32)
@@ -604,8 +705,8 @@ class TpuOperatorExecutor:
                                   and node.args[0].name == "*"))
         count_j = None
         if plan.num_groups:
-            for j, (op, _vidx) in enumerate(plan.agg_ops):
-                if op == "count":
+            for j, (op, _vidx, fidx) in enumerate(plan.agg_ops):
+                if op == "count" and fidx is None:
                     count_j = j
                     break
             assert count_j is not None  # _plan guarantees a count slot
@@ -708,6 +809,51 @@ def _vrange_bounds(e: Function, vdt=np.float64) -> Tuple[float, float]:
         return vdt(-np.inf), np.nextafter(lv(1), vdt(-np.inf))
     if e.name == "less_than_or_equal":
         return vdt(-np.inf), lv(1)
+    raise _NotStageable()
+
+
+_INT_BOUND_CLAMP = 1 << 54  # split planes stay exact below 2^55
+
+
+def _vrange_int_bounds(e: Function) -> Tuple[int, int]:
+    """Closed [lo, hi] INTEGER bounds for a comparison on an int column
+    (vrange64 leaves). Exact Python integer arithmetic throughout."""
+    import math
+
+    def lv(i):
+        raw = e.args[i].value  # type: ignore[union-attr]
+        try:
+            if isinstance(raw, str):
+                raw = int(raw) if raw.lstrip("+-").isdigit() else float(raw)
+            if isinstance(raw, bool) or raw is None:
+                raise _NotStageable()
+            if isinstance(raw, float) and not math.isfinite(raw):
+                raise _NotStageable()  # ceil/floor of inf/nan would raise
+            return raw
+        except (ValueError, TypeError, OverflowError):
+            raise _NotStageable() from None
+
+    def clamp(v: int) -> int:
+        return max(-_INT_BOUND_CLAMP, min(_INT_BOUND_CLAMP, v))
+
+    if e.name == "equals":
+        v = lv(1)
+        if isinstance(v, float):
+            if not v.is_integer():
+                return 1, 0  # empty interval
+            v = int(v)
+        return clamp(v), clamp(v)
+    if e.name == "between":
+        a, b = lv(1), lv(2)
+        return clamp(math.ceil(a)), clamp(math.floor(b))
+    if e.name == "greater_than":
+        return clamp(math.floor(lv(1)) + 1), _INT_BOUND_CLAMP
+    if e.name == "greater_than_or_equal":
+        return clamp(math.ceil(lv(1))), _INT_BOUND_CLAMP
+    if e.name == "less_than":
+        return -_INT_BOUND_CLAMP, clamp(math.ceil(lv(1)) - 1)
+    if e.name == "less_than_or_equal":
+        return -_INT_BOUND_CLAMP, clamp(math.floor(lv(1)))
     raise _NotStageable()
 
 

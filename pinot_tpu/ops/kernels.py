@@ -70,6 +70,18 @@ def _eval_filter(node, plan: DevicePlan, cols: Dict[str, jnp.ndarray],
         lo = params[f"leaf{i}:lo"][:, None]
         hi = params[f"leaf{i}:hi"][:, None]
         return (vals >= lo) & (vals <= hi)
+    if leaf.kind == "vrange64":
+        # exact closed-interval compare on (hi, lo) i32 split planes:
+        # lexicographic (hi strictly dominates; lo always in [0, 2^24))
+        vhi = cols["valhi:" + leaf.column]
+        vlo = cols["vallo:" + leaf.column]
+        a_hi = params[f"leaf{i}:lohi"][:, None]
+        a_lo = params[f"leaf{i}:lolo"][:, None]
+        b_hi = params[f"leaf{i}:hihi"][:, None]
+        b_lo = params[f"leaf{i}:hilo"][:, None]
+        ge = (vhi > a_hi) | ((vhi == a_hi) & (vlo >= a_lo))
+        le = (vhi < b_hi) | ((vhi == b_hi) & (vlo <= b_lo))
+        return ge & le
     raise ValueError(f"unknown leaf kind {leaf.kind}")
 
 
@@ -201,6 +213,10 @@ def _compute_slots(plan: DevicePlan, cols, params, valid):
         mask = _eval_filter(plan.filter_ir, plan, cols, params)
     else:
         mask = jnp.ones(valid.shape, dtype=bool)
+    # per-aggregation FILTER (WHERE ...) masks AND into the main mask
+    # per slot (ref FilteredAggregationOperator)
+    agg_masks = [_eval_filter(ir, plan, cols, params)
+                 for ir in plan.agg_filter_irs]
 
     values = []
     for ir in plan.value_irs:
@@ -211,15 +227,17 @@ def _compute_slots(plan: DevicePlan, cols, params, valid):
         keys = jnp.zeros(valid.shape, dtype=jnp.int32)
         for col, stride in zip(plan.group_cols, plan.group_strides):
             keys = keys + cols["ids:" + col] * jnp.int32(stride)
-        for op, vidx in plan.agg_ops:
+        for op, vidx, fidx in plan.agg_ops:
             vals = None if vidx is None else values[vidx]
-            slots.append((op, _grouped_reduce(op, vals, keys, mask, valid,
+            m = mask if fidx is None else mask & agg_masks[fidx]
+            slots.append((op, _grouped_reduce(op, vals, keys, m, valid,
                                               plan.num_groups)))
         return slots, None
     matched = jnp.sum(mask & valid, axis=1).astype(dt)
-    for op, vidx in plan.agg_ops:
+    for op, vidx, fidx in plan.agg_ops:
         vals = None if vidx is None else values[vidx]
-        slots.append((op, _masked_reduce(op, vals, mask, valid)))
+        m = mask if fidx is None else mask & agg_masks[fidx]
+        slots.append((op, _masked_reduce(op, vals, m, valid)))
     return slots, matched
 
 

@@ -15,6 +15,10 @@ Leaf kinds (resolved per-segment into parameter arrays, see ops/engine.py):
     'neq'   : idx[S] int32           -- dictId != idx (idx=-1 matches all)
     'lut'   : table[S, C] bool       -- table[s, dictId] (in/not-in/like/regex)
     'vrange': lo[S], hi[S] float     -- lo <= value <= hi (raw numeric columns)
+    'vrange64': lohi/lolo/hihi/hilo[S] int32 -- exact closed-interval compare
+              on big-int columns staged as (hi, lo) i32 split planes
+              (hi = v >> 24, lo = v & 0xFFFFFF); works with x64 OFF where
+              f32 staging would alias values above 2^24 (epoch millis)
 
 Value IR (aggregation inputs / in-kernel transforms):
     ('col', name)       -- column values (dict gather or raw staged block)
@@ -31,7 +35,7 @@ from typing import Optional, Tuple
 
 @dataclass(frozen=True)
 class DeviceLeaf:
-    kind: str         # 'range' | 'neq' | 'lut' | 'vrange'
+    kind: str         # 'range' | 'neq' | 'lut' | 'vrange' | 'vrange64'
     column: str
 
 
@@ -41,7 +45,12 @@ class DevicePlan:
     filter_ir: Optional[tuple]            # nested tuple tree or None
     leaves: Tuple[DeviceLeaf, ...]
     value_irs: Tuple[Optional[tuple], ...]  # one per agg slot input (None = count(*))
-    agg_ops: Tuple[Tuple[str, Optional[int]], ...]  # (op, value_ir index or None)
+    #: (op, value_ir index or None, agg-filter index or None) — the third
+    #: element selects an entry of agg_filter_irs to AND into the main mask
+    #: for this slot (ref FilteredAggregationOperator)
+    agg_ops: Tuple[Tuple[str, Optional[int], Optional[int]], ...]
+    #: per-aggregation FILTER (WHERE ...) trees (same leaf space as filter_ir)
+    agg_filter_irs: Tuple[tuple, ...] = ()
     group_cols: Tuple[str, ...] = ()
     group_strides: Tuple[int, ...] = ()   # mixed-radix strides over padded cards
     num_groups: int = 0                   # padded combined-key space (0 = no group-by)
@@ -49,3 +58,5 @@ class DevicePlan:
     dict_cols: Tuple[str, ...] = ()
     #: columns staged as raw numeric value blocks
     raw_cols: Tuple[str, ...] = ()
+    #: big-int columns staged as (hi, lo) i32 split planes, filter-only
+    raw64_cols: Tuple[str, ...] = ()
