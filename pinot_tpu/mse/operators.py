@@ -217,8 +217,26 @@ def aggregate_block(block: Block, group_exprs: Sequence[Expression],
         fn = get_aggregation(inner.name, inner.args)
         fns.append(fn)
         arg = None
-        if inner.args and not (isinstance(inner.args[0], Identifier)
-                               and inner.args[0].name == "*"):
+        if fn.multi_arg:
+            from pinot_tpu.query.expressions import Literal
+            # list (not np.stack): stacking unifies dtypes and would alias
+            # i64 timestamps above 2^53 through f64
+            arg = [eval_expr(a, block) if n else np.empty(0)
+                   for a in inner.args if not isinstance(a, Literal)]
+        elif fn.mv_input or inner.name == "countmv":
+            # MV columns arrive as object arrays of per-doc value lists;
+            # flatten and remember per-doc entry counts for mask expansion
+            # (countmv consumes the per-doc counts directly, executor-style)
+            col = eval_expr(inner.args[0], block) if n else np.empty(0, object)
+            lists = [np.asarray(v) for v in col]
+            counts = np.array([len(v) for v in lists], np.int64)
+            if inner.name == "countmv":
+                arg = counts
+            else:
+                flat = np.concatenate(lists) if lists else np.empty(0)
+                arg = (flat, counts)
+        elif inner.args and not (isinstance(inner.args[0], Identifier)
+                                 and inner.args[0].name == "*"):
             arg = eval_expr(inner.args[0], block) if n else np.empty(0)
         arg_vals.append(arg)
         filt_masks.append(fmask)
@@ -228,6 +246,10 @@ def aggregate_block(block: Block, group_exprs: Sequence[Expression],
         base = np.ones(n, bool)
         for fn, arg, fmask in zip(fns, arg_vals, filt_masks):
             mask = base if fmask is None else fmask
+            if fn.mv_input and arg is not None:
+                flat, counts = arg
+                mask = np.repeat(mask, counts)
+                arg = flat
             inter = fn.aggregate(arg, mask) if n else fn.identity()
             vals.append(fn.extract_final(inter))
         return Block(schema, [np.array([v], object) for v in vals])
@@ -240,7 +262,13 @@ def aggregate_block(block: Block, group_exprs: Sequence[Expression],
     out: List[np.ndarray] = [kc[first] for kc in key_cols]
     for fn, arg, fmask in zip(fns, arg_vals, filt_masks):
         mask = base if fmask is None else fmask
-        inters = fn.aggregate_grouped(arg, codes, num_groups, mask)
+        keys = codes
+        if fn.mv_input and arg is not None:
+            flat, counts = arg
+            mask = np.repeat(mask, counts)
+            keys = np.repeat(codes, counts)
+            arg = flat
+        inters = fn.aggregate_grouped(arg, keys, num_groups, mask)
         finals = np.empty(num_groups, object)
         for g in range(num_groups):
             finals[g] = fn.extract_final(inters[g])

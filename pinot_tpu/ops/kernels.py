@@ -128,6 +128,11 @@ def _masked_reduce(op: str, vals: Optional[jnp.ndarray], mask: jnp.ndarray,
         return jnp.sum(jnp.where(m, vals, 0), axis=1, dtype=dt)
     if op == "sumsq":
         return jnp.sum(jnp.where(m, vals * vals, 0), axis=1, dtype=dt)
+    if op == "sum3":
+        return jnp.sum(jnp.where(m, vals * vals * vals, 0), axis=1, dtype=dt)
+    if op == "sum4":
+        v2 = vals * vals
+        return jnp.sum(jnp.where(m, v2 * v2, 0), axis=1, dtype=dt)
     if op == "min":
         return jnp.min(jnp.where(m, vals, jnp.inf), axis=1)
     if op == "max":
@@ -148,6 +153,16 @@ def _grouped_reduce(op: str, vals: Optional[jnp.ndarray], keys: jnp.ndarray,
     assert vals is not None
     if op == "sum":
         contrib = jnp.where(m, vals, 0).astype(dt)
+        return _scatter_sum(contrib, safe_keys, num_groups)
+    if op == "sumsq":
+        contrib = jnp.where(m, vals * vals, 0).astype(dt)
+        return _scatter_sum(contrib, safe_keys, num_groups)
+    if op == "sum3":
+        contrib = jnp.where(m, vals * vals * vals, 0).astype(dt)
+        return _scatter_sum(contrib, safe_keys, num_groups)
+    if op == "sum4":
+        v2 = vals * vals
+        contrib = jnp.where(m, v2 * v2, 0).astype(dt)
         return _scatter_sum(contrib, safe_keys, num_groups)
     if op == "min":
         init = jnp.full((vals.shape[0], num_groups), jnp.inf, dtype=vals.dtype)
@@ -280,6 +295,7 @@ def compiled_kernel(plan: DevicePlan):
 # ---------------------------------------------------------------------------
 
 _DOC_COMBINE = {"sum": "psum", "count": "psum", "sumsq": "psum",
+                "sum3": "psum", "sum4": "psum",
                 "min": "pmin", "max": "pmax"}
 
 

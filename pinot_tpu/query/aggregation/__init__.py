@@ -15,6 +15,7 @@ from pinot_tpu.query.aggregation.base import (
     AggregationFunction, DeviceAggSpec, get_aggregation, is_aggregation,
     REGISTRY)
 from pinot_tpu.query.aggregation import functions as _functions  # registers
+from pinot_tpu.query.aggregation import functions_stats as _stats  # registers
 
 __all__ = [
     "AggregationFunction", "DeviceAggSpec", "get_aggregation",

@@ -11,9 +11,10 @@ import numpy as np
 class DeviceAggSpec:
     """How the TPU kernel computes this aggregation's intermediate.
 
-    op: one of 'sum' | 'min' | 'max' | 'count' | 'sumsq' — the masked
-    reduction the fused device kernel emits. Functions whose intermediate is
-    a tuple of these (AVG = sum+count) list several slots. Functions with no
+    op: one of 'sum' | 'min' | 'max' | 'count' | 'sumsq' | 'sum3' | 'sum4'
+    — the masked reduction the fused device kernel emits. Functions whose
+    intermediate is a tuple of these (AVG = sum+count; moments =
+    sum+sumsq[+sum3+sum4]+count) list several slots. Functions with no
     spec run host-side.
     """
     ops: tuple  # e.g. ('sum',), ('sum', 'count')
@@ -26,6 +27,11 @@ class AggregationFunction:
     names: Sequence[str] = ()
     #: device kernel composition, or None for host-only
     device_spec: Optional[DeviceAggSpec] = None
+    #: True: `values` arrives stacked [n_args, n] (covariance, with-time)
+    multi_arg: bool = False
+    #: True: `values` arrives FLAT (all MV entries) with the mask/keys
+    #: pre-expanded per entry by the executor (the *MV family)
+    mv_input: bool = False
 
     def __init__(self, args: tuple):
         self.args = args  # tuple[Expression]
@@ -81,6 +87,11 @@ class AggregationFunction:
     @property
     def final_dtype(self) -> str:
         return "DOUBLE"
+
+
+def scalar(v):
+    """Unwrap a numpy scalar to its Python value."""
+    return v.item() if isinstance(v, np.generic) else v
 
 
 REGISTRY: Dict[str, Type[AggregationFunction]] = {}

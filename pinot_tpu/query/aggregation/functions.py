@@ -253,7 +253,7 @@ class DistinctCountHLLAggregation(AggregationFunction):
     """Approximate distinct count via HyperLogLog
     (ref DistinctCountHLLAggregationFunction, log2m default 12)."""
     names = ("distinctcounthll", "distinctcounthllplus", "distinctcountull",
-             "distinctcountthetasketch", "distinctcountcpcsketch")
+             "distinctcountcpcsketch")
 
     def _log2m(self) -> int:
         from pinot_tpu.query.expressions import Literal
@@ -306,7 +306,7 @@ class PercentileAggregation(_ValueCollectingAggregation):
 
     percentile(col, p) or legacy percentileNN(col) via name suffix.
     """
-    names = ("percentile", "percentileest", "percentilekll", "percentilerawest")
+    names = ("percentile", "percentileest", "percentilerawest")
 
     def __init__(self, args, percent: Optional[float] = None):
         super().__init__(args)
@@ -423,4 +423,8 @@ def resolve_percentile_suffix(name: str, args: tuple):
     base, pct = m.group(1), float(m.group(2))
     if "tdigest" in base:
         return PercentileTDigestAggregation(args, percent=pct)
+    if "kll" in base:
+        from pinot_tpu.query.aggregation.functions_stats import (
+            PercentileKLLAggregation)
+        return PercentileKLLAggregation(args, percent=pct)
     return PercentileAggregation(args, percent=pct)

@@ -1,0 +1,581 @@
+"""Statistical / sketch / multi-value aggregation functions.
+
+Reference parity: pinot-core query/aggregation/function/ —
+VarianceAggregationFunction + StdDev variants (via Welford-style merge;
+here raw-moment tuples), SkewnessAggregationFunction /
+KurtosisAggregationFunction (FourthMoment.java), CovarianceAggregationFunction,
+FirstWithTimeAggregationFunction / LastWithTime,
+HistogramAggregationFunction, DistinctSum/DistinctAvg, BoolAnd/BoolOr,
+DistinctCountThetaSketchAggregationFunction, PercentileKLL, and the MV
+family (SumMV/MinMV/MaxMV/AvgMV/MinMaxRangeMV/DistinctCountMV —
+ref *MVAggregationFunction classes).
+
+Device offload: variance/stddev ride (sum, sumsq, count) slots;
+skew/kurtosis add (sum3, sum4); the rest are host-side (sketches and
+multi-arg functions per SURVEY §7.6).
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+from pinot_tpu.query.aggregation.base import (
+    AggregationFunction, DeviceAggSpec, register, scalar as _scalar)
+from pinot_tpu.query.aggregation.functions import _masked
+from pinot_tpu.query.aggregation.sketches import KLLSketch, ThetaSketch
+
+
+# ---------------------------------------------------------------------------
+# moments: variance / stddev / skew / kurtosis
+# ---------------------------------------------------------------------------
+
+class _MomentsAggregation(AggregationFunction):
+    """Intermediate = (count, sum, sumsq[, sum3, sum4]) raw moments —
+    trivially mergeable and exactly what the device kernel emits."""
+    order = 2
+
+    def aggregate(self, values, mask):
+        v = _masked(values, mask).astype(np.float64)
+        out = [float(len(v)), float(v.sum()), float((v * v).sum())]
+        if self.order >= 4:
+            out.append(float((v ** 3).sum()))
+            out.append(float((v ** 4).sum()))
+        return tuple(out)
+
+    def aggregate_grouped(self, values, keys, num_groups, mask):
+        k = keys[mask]
+        v = values[mask].astype(np.float64)
+        cnt = np.bincount(k, minlength=num_groups)
+        s1 = np.bincount(k, weights=v, minlength=num_groups)
+        s2 = np.bincount(k, weights=v * v, minlength=num_groups)
+        cols = [cnt.astype(np.float64), s1, s2]
+        if self.order >= 4:
+            cols.append(np.bincount(k, weights=v ** 3, minlength=num_groups))
+            cols.append(np.bincount(k, weights=v ** 4, minlength=num_groups))
+        return [tuple(float(c[g]) for c in cols) for g in range(num_groups)]
+
+    def merge(self, a, b):
+        return tuple(x + y for x, y in zip(a, b))
+
+    def identity(self):
+        return (0.0,) * (3 if self.order < 4 else 5)
+
+    def from_device_slots(self, slots):
+        out = [slots["count"], slots["sum"], slots["sumsq"]]
+        if self.order >= 4:
+            out.append(slots["sum3"])
+            out.append(slots["sum4"])
+        return tuple(float(x) for x in out)
+
+
+def _central_moments(inter):
+    n, s1, s2 = inter[0], inter[1], inter[2]
+    if n == 0:
+        return 0.0, 0.0, 0.0, None, None
+    mean = s1 / n
+    m2 = s2 / n - mean * mean
+    if len(inter) < 5:
+        return n, mean, m2, None, None
+    s3, s4 = inter[3], inter[4]
+    m3 = s3 / n - 3 * mean * s2 / n + 2 * mean ** 3
+    m4 = s4 / n - 4 * mean * s3 / n + 6 * mean * mean * s2 / n - 3 * mean ** 4
+    return n, mean, m2, m3, m4
+
+
+@register
+class VariancePopAggregation(_MomentsAggregation):
+    names = ("variance", "var_pop", "varpop")
+    device_spec = DeviceAggSpec(("sum", "sumsq", "count"))
+
+    def extract_final(self, inter):
+        n, _mean, m2, _, _ = _central_moments(inter)
+        return max(m2, 0.0) if n else 0.0
+
+
+@register
+class VarianceSampAggregation(_MomentsAggregation):
+    names = ("var_samp", "varsamp", "variancesamp")
+    device_spec = DeviceAggSpec(("sum", "sumsq", "count"))
+
+    def extract_final(self, inter):
+        n, _mean, m2, _, _ = _central_moments(inter)
+        if n < 2:
+            return 0.0
+        return max(m2 * n / (n - 1), 0.0)
+
+
+@register
+class StdDevPopAggregation(VariancePopAggregation):
+    names = ("stddev", "stddev_pop", "stddevpop")
+
+    def extract_final(self, inter):
+        return float(np.sqrt(super().extract_final(inter)))
+
+
+@register
+class StdDevSampAggregation(VarianceSampAggregation):
+    names = ("stddev_samp", "stddevsamp")
+
+    def extract_final(self, inter):
+        return float(np.sqrt(super().extract_final(inter)))
+
+
+@register
+class SkewnessAggregation(_MomentsAggregation):
+    """ref SkewnessAggregationFunction (FourthMoment based)."""
+    names = ("skewness",)
+    order = 4
+    device_spec = DeviceAggSpec(("sum", "sumsq", "sum3", "sum4", "count"))
+
+    def extract_final(self, inter):
+        n, _mean, m2, m3, _ = _central_moments(inter)
+        if not n or m2 <= 0:
+            return 0.0
+        return float(m3 / m2 ** 1.5)
+
+
+@register
+class KurtosisAggregation(_MomentsAggregation):
+    """Excess kurtosis (ref KurtosisAggregationFunction)."""
+    names = ("kurtosis",)
+    order = 4
+    device_spec = DeviceAggSpec(("sum", "sumsq", "sum3", "sum4", "count"))
+
+    def extract_final(self, inter):
+        n, _mean, m2, _m3, m4 = _central_moments(inter)
+        if not n or m2 <= 0:
+            return 0.0
+        return float(m4 / (m2 * m2) - 3.0)
+
+
+# ---------------------------------------------------------------------------
+# covariance (two-argument)
+# ---------------------------------------------------------------------------
+
+class _CovarianceBase(AggregationFunction):
+    """values arrives stacked [2, n] (multi_arg contract).
+    Intermediate = (count, sum_x, sum_y, sum_xy)."""
+    multi_arg = True
+
+    def aggregate(self, values, mask):
+        x = values[0][mask].astype(np.float64)
+        y = values[1][mask].astype(np.float64)
+        return (float(len(x)), float(x.sum()), float(y.sum()),
+                float((x * y).sum()))
+
+    def aggregate_grouped(self, values, keys, num_groups, mask):
+        k = keys[mask]
+        x = values[0][mask].astype(np.float64)
+        y = values[1][mask].astype(np.float64)
+        cnt = np.bincount(k, minlength=num_groups).astype(np.float64)
+        sx = np.bincount(k, weights=x, minlength=num_groups)
+        sy = np.bincount(k, weights=y, minlength=num_groups)
+        sxy = np.bincount(k, weights=x * y, minlength=num_groups)
+        return [(float(cnt[g]), float(sx[g]), float(sy[g]), float(sxy[g]))
+                for g in range(num_groups)]
+
+    def merge(self, a, b):
+        return tuple(p + q for p, q in zip(a, b))
+
+    def identity(self):
+        return (0.0, 0.0, 0.0, 0.0)
+
+
+@register
+class CovarPopAggregation(_CovarianceBase):
+    names = ("covar_pop", "covarpop")
+
+    def extract_final(self, inter):
+        n, sx, sy, sxy = inter
+        if n == 0:
+            return 0.0
+        return float(sxy / n - (sx / n) * (sy / n))
+
+
+@register
+class CovarSampAggregation(_CovarianceBase):
+    names = ("covar_samp", "covarsamp")
+
+    def extract_final(self, inter):
+        n, sx, sy, sxy = inter
+        if n < 2:
+            return 0.0
+        return float((sxy - sx * sy / n) / (n - 1))
+
+
+# ---------------------------------------------------------------------------
+# FIRST/LAST with time (two-argument)
+# ---------------------------------------------------------------------------
+
+class _WithTimeBase(AggregationFunction):
+    """firstwithtime(col, timeCol[, 'dataType']) — intermediate is
+    (time, value) of the extreme-time row (ref FirstWithTimeAggregationFunction)."""
+    multi_arg = True
+    #: number of leading args that are data columns (3rd is a type literal)
+    n_data_args = 2
+    pick_first = True
+
+    def aggregate(self, values, mask):
+        v = values[0][mask]
+        t = values[1][mask].astype(np.float64)
+        if len(t) == 0:
+            return None
+        idx = int(np.argmin(t) if self.pick_first else np.argmax(t))
+        return (float(t[idx]), _scalar(v[idx]))
+
+    def aggregate_grouped(self, values, keys, num_groups, mask):
+        k = keys[mask]
+        v = values[0][mask]
+        t = values[1][mask].astype(np.float64)
+        out = [None] * num_groups
+        order = np.argsort(k, kind="stable")
+        k, v, t = k[order], v[order], t[order]
+        bounds = np.searchsorted(k, np.arange(num_groups + 1))
+        for g in range(num_groups):
+            ts = t[bounds[g]:bounds[g + 1]]
+            if len(ts):
+                i = int(np.argmin(ts) if self.pick_first else np.argmax(ts))
+                out[g] = (float(ts[i]), _scalar(v[bounds[g] + i]))
+        return out
+
+    def merge(self, a, b):
+        if a is None:
+            return b
+        if b is None:
+            return a
+        if self.pick_first:
+            return a if a[0] <= b[0] else b
+        return a if a[0] >= b[0] else b
+
+    def identity(self):
+        return None
+
+    def extract_final(self, inter):
+        return inter[1] if inter is not None else None
+
+
+@register
+class FirstWithTimeAggregation(_WithTimeBase):
+    names = ("firstwithtime",)
+    pick_first = True
+
+
+@register
+class LastWithTimeAggregation(_WithTimeBase):
+    names = ("lastwithtime",)
+    pick_first = False
+
+
+# ---------------------------------------------------------------------------
+# histogram
+# ---------------------------------------------------------------------------
+
+@register
+class HistogramAggregation(AggregationFunction):
+    """histogram(col, lower, upper, numBins) — final result is the
+    per-bucket count list (ref HistogramAggregationFunction equal-length
+    mode)."""
+    names = ("histogram",)
+
+    def __init__(self, args):
+        super().__init__(args)
+        from pinot_tpu.query.expressions import Literal
+        lits = [a.value for a in args[1:] if isinstance(a, Literal)]
+        if len(lits) != 3:
+            raise ValueError(
+                "histogram(col, lower, upper, numBins) expected")
+        self.lower, self.upper = float(lits[0]), float(lits[1])
+        self.bins = int(lits[2])
+        self.edges = np.linspace(self.lower, self.upper, self.bins + 1)
+
+    def aggregate(self, values, mask):
+        v = _masked(values, mask).astype(np.float64)
+        counts, _ = np.histogram(v, bins=self.edges)
+        return counts.astype(np.float64)
+
+    def aggregate_grouped(self, values, keys, num_groups, mask):
+        k = keys[mask]
+        v = values[mask].astype(np.float64)
+        out = []
+        order = np.argsort(k, kind="stable")
+        k, v = k[order], v[order]
+        bounds = np.searchsorted(k, np.arange(num_groups + 1))
+        for g in range(num_groups):
+            counts, _ = np.histogram(v[bounds[g]:bounds[g + 1]],
+                                     bins=self.edges)
+            out.append(counts.astype(np.float64))
+        return out
+
+    def merge(self, a, b):
+        return a + b
+
+    def identity(self):
+        return np.zeros(self.bins, dtype=np.float64)
+
+    def extract_final(self, inter):
+        return [float(x) for x in inter]
+
+    @property
+    def final_dtype(self):
+        return "DOUBLE_ARRAY"
+
+
+# ---------------------------------------------------------------------------
+# boolean / distinct-value folds
+# ---------------------------------------------------------------------------
+
+@register
+class BoolAndAggregation(AggregationFunction):
+    names = ("bool_and", "booland")
+    device_spec = DeviceAggSpec(("min", "count"))
+
+    def aggregate(self, values, mask):
+        v = _masked(values, mask)
+        return bool(np.all(v.astype(bool))) if len(v) else True
+
+    def merge(self, a, b):
+        return a and b
+
+    def identity(self):
+        return True
+
+    def from_device_slots(self, slots):
+        return bool(slots["count"] == 0 or slots["min"] >= 0.5)
+
+    @property
+    def final_dtype(self):
+        return "BOOLEAN"
+
+
+@register
+class BoolOrAggregation(AggregationFunction):
+    names = ("bool_or", "boolor")
+    device_spec = DeviceAggSpec(("max", "count"))
+
+    def aggregate(self, values, mask):
+        v = _masked(values, mask)
+        return bool(np.any(v.astype(bool))) if len(v) else False
+
+    def merge(self, a, b):
+        return a or b
+
+    def identity(self):
+        return False
+
+    def from_device_slots(self, slots):
+        return bool(slots["count"] > 0 and slots["max"] >= 0.5)
+
+    @property
+    def final_dtype(self):
+        return "BOOLEAN"
+
+
+class _DistinctFoldBase(AggregationFunction):
+    """Set intermediate with a numeric fold at extraction."""
+
+    def aggregate(self, values, mask):
+        return set(np.unique(_masked(values, mask)).tolist())
+
+    def merge(self, a, b):
+        return a | b
+
+    def identity(self):
+        return set()
+
+
+@register
+class DistinctSumAggregation(_DistinctFoldBase):
+    names = ("distinctsum",)
+
+    def extract_final(self, inter):
+        return float(sum(inter)) if inter else 0.0
+
+
+@register
+class DistinctAvgAggregation(_DistinctFoldBase):
+    names = ("distinctavg",)
+
+    def extract_final(self, inter):
+        return float(sum(inter) / len(inter)) if inter else 0.0
+
+
+# ---------------------------------------------------------------------------
+# sketches
+# ---------------------------------------------------------------------------
+
+@register
+class DistinctCountThetaAggregation(AggregationFunction):
+    """ref DistinctCountThetaSketchAggregationFunction (nominal entries
+    default 4096)."""
+    names = ("distinctcountthetasketch", "distinctcountrawthetasketch")
+
+    def _k(self) -> int:
+        from pinot_tpu.query.expressions import Literal
+        if len(self.args) > 1 and isinstance(self.args[1], Literal):
+            try:
+                return int(self.args[1].value)
+            except (TypeError, ValueError):
+                return 4096
+        return 4096
+
+    def aggregate(self, values, mask):
+        sk = ThetaSketch(self._k())
+        sk.add_array(_masked(values, mask))
+        return sk
+
+    def merge(self, a, b):
+        return a.merge(b)
+
+    def identity(self):
+        return ThetaSketch(self._k())
+
+    def extract_final(self, inter):
+        return inter.estimate()
+
+    @property
+    def final_dtype(self):
+        return "LONG"
+
+
+@register
+class PercentileKLLAggregation(AggregationFunction):
+    """ref PercentileKLLAggregationFunction (K default 200)."""
+    names = ("percentilekll", "percentilerawkll")
+
+    def __init__(self, args, percent: Optional[float] = None):
+        super().__init__(args)
+        from pinot_tpu.query.expressions import Literal
+        self._pct = percent if percent is not None else (
+            float(args[1].value) if len(args) > 1
+            and isinstance(args[1], Literal) else 50.0)
+        self._k = (int(args[2].value) if len(args) > 2
+                   and isinstance(args[2], Literal) else 200)
+
+    def aggregate(self, values, mask):
+        sk = KLLSketch(self._k)
+        sk.add_array(_masked(values, mask))
+        return sk
+
+    def merge(self, a, b):
+        return a.merge(b)
+
+    def identity(self):
+        return KLLSketch(self._k)
+
+    def extract_final(self, inter):
+        return inter.quantile(self._pct / 100.0)
+
+
+# ---------------------------------------------------------------------------
+# multi-value (MV) family — values arrive FLAT with pre-expanded mask/keys
+# ---------------------------------------------------------------------------
+
+class _MVMixin:
+    mv_input = True
+
+
+@register
+class SumMVAggregation(_MVMixin, AggregationFunction):
+    names = ("summv",)
+
+    def aggregate(self, values, mask):
+        return float(_masked(values, mask).astype(np.float64).sum())
+
+    def merge(self, a, b):
+        return a + b
+
+    def identity(self):
+        return 0.0
+
+
+@register
+class MinMVAggregation(_MVMixin, AggregationFunction):
+    names = ("minmv",)
+
+    def aggregate(self, values, mask):
+        v = _masked(values, mask)
+        return float(v.min()) if len(v) else float("inf")
+
+    def merge(self, a, b):
+        return min(a, b)
+
+    def identity(self):
+        return float("inf")
+
+
+@register
+class MaxMVAggregation(_MVMixin, AggregationFunction):
+    names = ("maxmv",)
+
+    def aggregate(self, values, mask):
+        v = _masked(values, mask)
+        return float(v.max()) if len(v) else float("-inf")
+
+    def merge(self, a, b):
+        return max(a, b)
+
+    def identity(self):
+        return float("-inf")
+
+
+@register
+class AvgMVAggregation(_MVMixin, AggregationFunction):
+    names = ("avgmv",)
+
+    def aggregate(self, values, mask):
+        v = _masked(values, mask).astype(np.float64)
+        return (float(v.sum()), int(len(v)))
+
+    def merge(self, a, b):
+        return (a[0] + b[0], a[1] + b[1])
+
+    def identity(self):
+        return (0.0, 0)
+
+    def extract_final(self, inter):
+        s, n = inter
+        return s / n if n else 0.0
+
+
+@register
+class MinMaxRangeMVAggregation(_MVMixin, AggregationFunction):
+    names = ("minmaxrangemv",)
+
+    def aggregate(self, values, mask):
+        v = _masked(values, mask)
+        if not len(v):
+            return (float("inf"), float("-inf"))
+        return (float(v.min()), float(v.max()))
+
+    def merge(self, a, b):
+        return (min(a[0], b[0]), max(a[1], b[1]))
+
+    def identity(self):
+        return (float("inf"), float("-inf"))
+
+    def extract_final(self, inter):
+        lo, hi = inter
+        return hi - lo if hi >= lo else 0.0
+
+
+@register
+class DistinctCountMVAggregation(_MVMixin, AggregationFunction):
+    names = ("distinctcountmv",)
+
+    def aggregate(self, values, mask):
+        return set(np.unique(_masked(values, mask)).tolist())
+
+    def merge(self, a, b):
+        return a | b
+
+    def identity(self):
+        return set()
+
+    def extract_final(self, inter):
+        return len(inter)
+
+    @property
+    def final_dtype(self):
+        return "INT"
+

@@ -153,3 +153,124 @@ class TDigest:
         cum = np.cumsum(self.weights) - self.weights / 2.0
         target = q * self.total
         return float(np.interp(target, cum, self.means))
+
+
+class ThetaSketch:
+    """KMV-style theta sketch for distinct counting with set operations
+    (ref DistinctCountThetaSketchAggregationFunction over Apache
+    DataSketches; clean-room K-minimum-values design: keep the k smallest
+    64-bit hashes; theta = k-th smallest / 2^64, estimate = (k-1)/theta)."""
+
+    def __init__(self, k: int = 4096):
+        self.k = k
+        self.hashes = np.empty(0, dtype=np.uint64)  # sorted, unique
+        self.theta = np.uint64(0xFFFFFFFFFFFFFFFF)
+
+    def add_array(self, values: np.ndarray) -> None:
+        if len(values) == 0:
+            return
+        h = np.unique(_hash64(values))
+        self._absorb(h)
+
+    def _absorb(self, h: np.ndarray) -> None:
+        h = h[h < self.theta]
+        merged = np.unique(np.concatenate([self.hashes, h]))
+        if len(merged) > self.k:
+            merged = merged[: self.k]
+            self.theta = merged[-1]
+            merged = merged[:-1]
+        self.hashes = merged
+
+    def merge(self, other: "ThetaSketch") -> "ThetaSketch":
+        out = ThetaSketch(min(self.k, other.k))
+        out.theta = min(self.theta, other.theta)
+        both = np.unique(np.concatenate([self.hashes, other.hashes]))
+        both = both[both < out.theta]
+        if len(both) > out.k:
+            both = both[: out.k]
+            out.theta = both[-1]
+            both = both[:-1]
+        out.hashes = both
+        return out
+
+    def estimate(self) -> int:
+        full = np.uint64(0xFFFFFFFFFFFFFFFF)
+        if self.theta == full:
+            return int(len(self.hashes))
+        frac = float(self.theta) / float(full)
+        return int(round(len(self.hashes) / frac))
+
+
+class KLLSketch:
+    """KLL quantile sketch (Karnin-Lang-Liberty) — clean-room: compactor
+    levels with capacity decaying by ~(2/3)^h; a full level sorts, keeps a
+    random parity's every-other item, and promotes it with doubled weight
+    (ref PercentileKLLAggregationFunction over DataSketches KllDoublesSketch).
+    """
+
+    def __init__(self, k: int = 200, _seed: int = 0):
+        self.k = k
+        self.levels: list = [np.empty(0, dtype=np.float64)]
+        self.n = 0
+        # seeded: query results must be reproducible (and host/device parity
+        # harnesses run the same query twice)
+        self._rng = np.random.default_rng(_seed)
+
+    def _capacity(self, height: int, num_levels: int) -> int:
+        depth = num_levels - height - 1
+        return max(int(np.ceil(self.k * (2.0 / 3.0) ** depth)), 8)
+
+    def add_array(self, values: np.ndarray) -> None:
+        if len(values) == 0:
+            return
+        self.n += int(len(values))
+        self.levels[0] = np.concatenate(
+            [self.levels[0], values.astype(np.float64)])
+        self._compress()
+
+    def _compress(self) -> None:
+        h = 0
+        while h < len(self.levels):
+            if len(self.levels[h]) > self._capacity(h, len(self.levels)):
+                buf = np.sort(self.levels[h])
+                offset = int(self._rng.integers(0, 2))
+                promoted = buf[offset::2]
+                self.levels[h] = np.empty(0, dtype=np.float64)
+                if h + 1 == len(self.levels):
+                    self.levels.append(np.empty(0, dtype=np.float64))
+                self.levels[h + 1] = np.concatenate(
+                    [self.levels[h + 1], promoted])
+            h += 1
+
+    def merge(self, other: "KLLSketch") -> "KLLSketch":
+        out = KLLSketch(min(self.k, other.k))
+        out.n = self.n + other.n
+        nl = max(len(self.levels), len(other.levels))
+        out.levels = []
+        for h in range(nl):
+            parts = []
+            if h < len(self.levels):
+                parts.append(self.levels[h])
+            if h < len(other.levels):
+                parts.append(other.levels[h])
+            out.levels.append(np.concatenate(parts) if parts
+                              else np.empty(0, dtype=np.float64))
+        out._compress()
+        return out
+
+    def quantile(self, q: float) -> float:
+        if self.n == 0:
+            return float("-inf")
+        vals, weights = [], []
+        for h, lvl in enumerate(self.levels):
+            if len(lvl):
+                vals.append(lvl)
+                weights.append(np.full(len(lvl), 2 ** h, dtype=np.float64))
+        v = np.concatenate(vals)
+        w = np.concatenate(weights)
+        order = np.argsort(v, kind="stable")
+        v, w = v[order], w[order]
+        cum = np.cumsum(w)
+        target = q * cum[-1]
+        idx = int(np.searchsorted(cum, target, side="left"))
+        return float(v[min(idx, len(v) - 1)])

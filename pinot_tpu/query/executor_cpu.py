@@ -83,8 +83,10 @@ def _execute_segment(seg: ImmutableSegment, ctx: QueryContext):
 
 # ---------------------------------------------------------------------------
 
-def _agg_input(seg: ImmutableSegment, fn_node: Function, provider) -> Optional[np.ndarray]:
-    """Materialize the aggregation argument column (None for COUNT(*))."""
+def _agg_input(seg: ImmutableSegment, fn_node: Function, provider,
+               fn=None) -> Optional[np.ndarray]:
+    """Materialize the aggregation argument column (None for COUNT(*)).
+    multi_arg functions get all non-literal args stacked [k, n]."""
     if not fn_node.args:
         return None
     arg = fn_node.args[0]
@@ -93,10 +95,30 @@ def _agg_input(seg: ImmutableSegment, fn_node: Function, provider) -> Optional[n
     if fn_node.name == "countmv":
         ds = seg.data_source(arg.name)  # type: ignore[union-attr]
         return np.diff(ds.mv_offsets()).astype(np.int64)
+    if fn is not None and fn.multi_arg:
+        # LIST of per-arg columns, not np.stack: stacking would unify
+        # dtypes (an i64 time column next to a f64 value column silently
+        # casts to f64, aliasing timestamps above 2^53)
+        cols = []
+        for a in fn_node.args:
+            if isinstance(a, Literal):
+                continue  # config literals (type name, percent, ...)
+            col = np.asarray(transform.evaluate(a, provider))
+            if col.ndim == 0:
+                col = np.broadcast_to(col, (seg.num_docs,))
+            cols.append(col)
+        return cols
     out = np.asarray(transform.evaluate(arg, provider))
     if out.ndim == 0:
         out = np.broadcast_to(out, (seg.num_docs,))
     return out
+
+
+def _mv_flat_input(seg: ImmutableSegment, fn_node: Function):
+    """(flat values, per-doc entry counts) for the *MV aggregations."""
+    arg = fn_node.args[0]
+    ds = seg.data_source(arg.name)  # type: ignore[union-attr]
+    return ds.values(), np.diff(ds.mv_offsets())
 
 
 def _agg_mask(seg, ctx: QueryContext, provider, mask, i):
@@ -112,8 +134,14 @@ def _agg_mask(seg, ctx: QueryContext, provider, mask, i):
 def _aggregate(seg, ctx: QueryContext, provider, mask, stats) -> AggregationResult:
     inters = []
     for i, (node, fn) in enumerate(zip(ctx.aggregations, ctx.agg_functions)):
-        values = _agg_input(seg, node, provider)
-        inters.append(fn.aggregate(values, _agg_mask(seg, ctx, provider, mask, i)))
+        fmask = _agg_mask(seg, ctx, provider, mask, i)
+        if fn.mv_input:
+            flat, counts = _mv_flat_input(seg, node)
+            inters.append(fn.aggregate(flat, np.repeat(fmask, counts)))
+            stats.num_entries_scanned_post_filter += int(counts[fmask].sum())
+            continue
+        values = _agg_input(seg, node, provider, fn)
+        inters.append(fn.aggregate(values, fmask))
         if values is not None:
             stats.num_entries_scanned_post_filter += stats.num_docs_scanned
     return AggregationResult(inters, stats)
@@ -170,8 +198,15 @@ def _group_by(seg, ctx: QueryContext, provider, mask, stats) -> GroupByResult:
 
     per_fn: List[list] = []
     for i, (node, fn) in enumerate(zip(ctx.aggregations, ctx.agg_functions)):
-        values = _agg_input(seg, node, provider)
         fmask = _agg_mask(seg, ctx, provider, gmask, i)
+        if fn.mv_input:
+            flat, counts = _mv_flat_input(seg, node)
+            per_fn.append(fn.aggregate_grouped(
+                flat, np.repeat(full_keys, counts), num_groups,
+                np.repeat(fmask, counts)))
+            stats.num_entries_scanned_post_filter += int(counts[fmask].sum())
+            continue
+        values = _agg_input(seg, node, provider, fn)
         per_fn.append(fn.aggregate_grouped(values, full_keys, num_groups, fmask))
         if values is not None:
             stats.num_entries_scanned_post_filter += stats.num_docs_scanned

@@ -22,7 +22,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from pinot_tpu.query.aggregation.sketches import HyperLogLog, TDigest
+from pinot_tpu.query.aggregation.sketches import (
+    HyperLogLog, KLLSketch, TDigest, ThetaSketch)
 from pinot_tpu.query.results import (
     AggregationResult, DistinctResult, ExecutionStats, GroupByResult,
     SelectionResult)
@@ -116,6 +117,16 @@ class _Writer:
             self.raw(_F64.pack(v.total))
             self.value(v.means)
             self.value(v.weights)
+        elif isinstance(v, ThetaSketch):
+            self.tag("E")
+            self.u32(v.k)
+            self.raw(struct.pack("<Q", int(v.theta)))
+            self.value(v.hashes)
+        elif isinstance(v, KLLSketch):
+            self.tag("K")
+            self.u32(v.k)
+            self.raw(_I64.pack(v.n))
+            self.value([lvl for lvl in v.levels])
         else:
             raise TypeError(f"unserializable value type {type(v)}")
 
@@ -192,6 +203,21 @@ class _Reader:
             td.means = self.value()
             td.weights = self.value()
             return td
+        if t == "E":
+            sk = ThetaSketch(self.u32())
+            sk.theta = np.uint64(
+                struct.unpack_from("<Q", self.buf, self.pos)[0])
+            self.pos += 8
+            sk.hashes = self.value().astype(np.uint64)
+            return sk
+        if t == "K":
+            k = self.u32()
+            sk = KLLSketch(k)
+            sk.n = _I64.unpack_from(self.buf, self.pos)[0]
+            self.pos += 8
+            sk.levels = [np.asarray(lvl, dtype=np.float64)
+                         for lvl in self.value()]
+            return sk
         raise ValueError(f"bad tag {t!r} at {self.pos - 1}")
 
 

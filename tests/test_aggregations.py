@@ -1,0 +1,251 @@
+"""New aggregation-function coverage: moments, covariance, with-time,
+histogram, bool folds, distinct folds, theta/KLL sketches, MV family —
+each parity-checked host-vs-device (where a device spec exists) and
+against numpy oracles; wire serde round-trips for the new sketch types.
+"""
+import numpy as np
+import pytest
+
+from pinot_tpu.models import (DataType, FieldSpec, FieldType, Schema,
+                              TableConfig, TableType)
+from pinot_tpu.query.executor import QueryExecutor
+from pinot_tpu.query.aggregation.sketches import KLLSketch, ThetaSketch
+from pinot_tpu.server import datatable
+from pinot_tpu.query.results import AggregationResult, ExecutionStats
+from tests.queries.harness import build_segments
+
+N = 3000
+
+
+@pytest.fixture(scope="module")
+def segs(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("aggseg")
+    schema = Schema("testTable", [
+        FieldSpec("x", DataType.DOUBLE, FieldType.METRIC),
+        FieldSpec("y", DataType.DOUBLE, FieldType.METRIC),
+        FieldSpec("ts", DataType.INT, FieldType.DIMENSION),
+        FieldSpec("grp", DataType.INT, FieldType.DIMENSION),
+        FieldSpec("flag", DataType.INT, FieldType.DIMENSION),
+        FieldSpec("tags", DataType.INT, FieldType.DIMENSION,
+                  single_value=False),
+    ])
+    tc = TableConfig("testTable", TableType.OFFLINE)
+    rng0 = np.random.default_rng(100)
+    cols = []
+    for i in range(2):
+        rng = np.random.default_rng(100 + i)
+        cols.append({
+            "x": rng.normal(50, 10, N),
+            "y": rng.normal(5, 2, N),
+            "ts": rng.permutation(N).astype(np.int32) + i * N,
+            "grp": rng.integers(0, 7, N).astype(np.int32),
+            "flag": rng.integers(0, 2, N).astype(np.int32),
+            "tags": [rng.integers(0, 50, rng.integers(1, 5)).tolist()
+                     for _ in range(N)],
+        })
+    segs = build_segments(tmp, schema, tc, cols)
+    all_cols = {k: (np.concatenate([np.asarray(c[k]) for c in cols])
+                    if k != "tags" else
+                    [t for c in cols for t in c["tags"]])
+                for k in cols[0]}
+    return segs, all_cols
+
+
+def _one_row(segs, sql):
+    cpu = QueryExecutor(segs, use_tpu=False)
+    tpu = QueryExecutor(segs, use_tpu=True)
+    a, b = cpu.execute(sql), tpu.execute(sql)
+    assert not a.exceptions and not b.exceptions, (a.exceptions, b.exceptions)
+    for x, y in zip(a.rows[0], b.rows[0]):
+        if isinstance(x, float) and isinstance(y, float):
+            assert abs(x - y) <= 1e-4 * max(1.0, abs(x)), (sql, a.rows, b.rows)
+        else:
+            assert x == y, (sql, a.rows, b.rows)
+    return a.rows[0]
+
+
+class TestMoments:
+    def test_variance_stddev(self, segs):
+        segs, cols = segs
+        r = _one_row(segs,
+                     "SELECT VAR_POP(x), VAR_SAMP(x), STDDEV_POP(x), "
+                     "STDDEV_SAMP(x) FROM testTable")
+        x = cols["x"]
+        assert abs(r[0] - np.var(x)) < 1e-6 * np.var(x)
+        assert abs(r[1] - np.var(x, ddof=1)) < 1e-6 * np.var(x)
+        assert abs(r[2] - np.std(x)) < 1e-6 * np.std(x)
+        assert abs(r[3] - np.std(x, ddof=1)) < 1e-6 * np.std(x)
+
+    def test_skew_kurtosis(self, segs):
+        segs, cols = segs
+        r = _one_row(segs, "SELECT SKEWNESS(x), KURTOSIS(x) FROM testTable")
+        x = cols["x"]
+        m = x.mean()
+        m2 = ((x - m) ** 2).mean()
+        skew = ((x - m) ** 3).mean() / m2 ** 1.5
+        kurt = ((x - m) ** 4).mean() / m2 ** 2 - 3
+        assert abs(r[0] - skew) < 1e-3
+        assert abs(r[1] - kurt) < 1e-3
+
+    def test_variance_group_by(self, segs):
+        segs, cols = segs
+        cpu = QueryExecutor(segs, use_tpu=False)
+        tpu = QueryExecutor(segs, use_tpu=True)
+        sql = ("SELECT grp, VAR_POP(x), STDDEV_SAMP(x) FROM testTable "
+               "GROUP BY grp ORDER BY grp LIMIT 10")
+        a, b = cpu.execute(sql), tpu.execute(sql)
+        assert len(a.rows) == len(b.rows) == 7
+        for ra, rb in zip(a.rows, b.rows):
+            assert ra[0] == rb[0]
+            assert abs(ra[1] - rb[1]) < 1e-4 * max(1.0, abs(ra[1]))
+        x, g = cols["x"], cols["grp"]
+        for row in a.rows:
+            want = np.var(x[g == row[0]])
+            assert abs(row[1] - want) < 1e-6 * max(1.0, want)
+
+    def test_variance_filtered(self, segs):
+        segs, cols = segs
+        r = _one_row(segs, "SELECT VAR_POP(x) FILTER (WHERE flag = 1), "
+                           "COUNT(*) FROM testTable")
+        x, f = cols["x"], cols["flag"]
+        want = np.var(x[f == 1])
+        assert abs(r[0] - want) < 1e-6 * want
+
+
+class TestCovariance:
+    def test_covar(self, segs):
+        segs, cols = segs
+        r = _one_row(segs,
+                     "SELECT COVAR_POP(x, y), COVAR_SAMP(x, y) FROM testTable")
+        x, y = cols["x"], cols["y"]
+        pop = np.cov(x, y, ddof=0)[0, 1]
+        samp = np.cov(x, y, ddof=1)[0, 1]
+        assert abs(r[0] - pop) < 1e-6 * max(1.0, abs(pop))
+        assert abs(r[1] - samp) < 1e-6 * max(1.0, abs(samp))
+
+    def test_covar_group_by(self, segs):
+        segs, cols = segs
+        cpu = QueryExecutor(segs, use_tpu=False)
+        resp = cpu.execute("SELECT grp, COVAR_POP(x, y) FROM testTable "
+                           "GROUP BY grp ORDER BY grp LIMIT 10")
+        x, y, g = cols["x"], cols["y"], cols["grp"]
+        for row in resp.rows:
+            sel = g == row[0]
+            want = np.cov(x[sel], y[sel], ddof=0)[0, 1]
+            assert abs(row[1] - want) < 1e-6 * max(1.0, abs(want))
+
+
+class TestWithTime:
+    def test_first_last(self, segs):
+        segs, cols = segs
+        r = _one_row(segs, "SELECT FIRSTWITHTIME(x, ts, 'DOUBLE'), "
+                           "LASTWITHTIME(x, ts, 'DOUBLE') FROM testTable")
+        x, ts = cols["x"], cols["ts"]
+        assert abs(r[0] - x[np.argmin(ts)]) < 1e-9
+        assert abs(r[1] - x[np.argmax(ts)]) < 1e-9
+
+    def test_last_group_by(self, segs):
+        segs, cols = segs
+        cpu = QueryExecutor(segs, use_tpu=False)
+        resp = cpu.execute("SELECT grp, LASTWITHTIME(x, ts, 'DOUBLE') "
+                           "FROM testTable GROUP BY grp ORDER BY grp LIMIT 10")
+        x, ts, g = cols["x"], cols["ts"], cols["grp"]
+        for row in resp.rows:
+            sel = np.nonzero(g == row[0])[0]
+            want = x[sel[np.argmax(ts[sel])]]
+            assert abs(row[1] - want) < 1e-9
+
+
+class TestHistogramBoolDistinct:
+    def test_histogram(self, segs):
+        segs, cols = segs
+        r = _one_row(segs,
+                     "SELECT HISTOGRAM(x, 0, 100, 10) FROM testTable")
+        want, _ = np.histogram(cols["x"], bins=np.linspace(0, 100, 11))
+        assert [int(v) for v in r[0]] == want.tolist()
+
+    def test_bool_folds(self, segs):
+        segs, cols = segs
+        r = _one_row(segs,
+                     "SELECT BOOL_AND(flag), BOOL_OR(flag) FROM testTable")
+        assert r[0] == bool(np.all(cols["flag"])) \
+            and r[1] == bool(np.any(cols["flag"]))
+        r2 = _one_row(segs, "SELECT BOOL_AND(flag), BOOL_OR(flag) "
+                            "FROM testTable WHERE flag = 1")
+        assert r2[0] is True and r2[1] is True
+
+    def test_distinct_folds(self, segs):
+        segs, cols = segs
+        r = _one_row(segs,
+                     "SELECT DISTINCTSUM(grp), DISTINCTAVG(grp) FROM testTable")
+        u = np.unique(cols["grp"])
+        assert abs(r[0] - u.sum()) < 1e-9
+        assert abs(r[1] - u.mean()) < 1e-9
+
+
+class TestSketches:
+    def test_theta(self, segs):
+        segs, cols = segs
+        r = _one_row(segs,
+                     "SELECT DISTINCTCOUNTTHETASKETCH(ts) FROM testTable")
+        true = len(np.unique(cols["ts"]))
+        assert abs(r[0] - true) <= 0.05 * true
+
+    def test_kll(self, segs):
+        segs, cols = segs
+        r = _one_row(segs, "SELECT PERCENTILEKLL(x, 90) FROM testTable")
+        want = np.quantile(cols["x"], 0.9)
+        assert abs(r[0] - want) < 0.05 * abs(want)
+        r2 = _one_row(segs, "SELECT PERCENTILEKLL50(x) FROM testTable")
+        assert abs(r2[0] - np.quantile(cols["x"], 0.5)) < 0.05 * 50
+
+    def test_sketch_serde_roundtrip(self):
+        rng = np.random.default_rng(0)
+        t = ThetaSketch(1024)
+        t.add_array(rng.integers(0, 10**6, 50000))
+        k = KLLSketch(200)
+        k.add_array(rng.random(50000))
+        r = AggregationResult([t, k], ExecutionStats())
+        buf = datatable.serialize_results([r])
+        [out], exc, _ = datatable.deserialize_results(buf)
+        assert not exc
+        t2, k2 = out.intermediates
+        assert t2.estimate() == t.estimate()
+        assert abs(k2.quantile(0.5) - k.quantile(0.5)) < 1e-9
+        # merged across the wire stays usable
+        assert t2.merge(t).estimate() == t.estimate()
+
+
+class TestMVFamily:
+    def test_mv_aggs(self, segs):
+        segs, cols = segs
+        r = _one_row(segs,
+                     "SELECT SUMMV(tags), MINMV(tags), MAXMV(tags), "
+                     "AVGMV(tags), MINMAXRANGEMV(tags), "
+                     "DISTINCTCOUNTMV(tags), COUNTMV(tags) FROM testTable")
+        flat = np.concatenate([np.asarray(t) for t in cols["tags"]])
+        assert abs(r[0] - flat.sum()) < 1e-6 * abs(flat.sum())
+        assert r[1] == flat.min() and r[2] == flat.max()
+        assert abs(r[3] - flat.mean()) < 1e-9
+        assert r[4] == flat.max() - flat.min()
+        assert r[5] == len(np.unique(flat))
+        assert r[6] == len(flat)
+
+    def test_mv_group_by(self, segs):
+        segs, cols = segs
+        cpu = QueryExecutor(segs, use_tpu=False)
+        resp = cpu.execute("SELECT grp, SUMMV(tags) FROM testTable "
+                           "GROUP BY grp ORDER BY grp LIMIT 10")
+        g = np.asarray(cols["grp"])
+        for row in resp.rows:
+            want = sum(sum(t) for t, gi in zip(cols["tags"], g)
+                       if gi == row[0])
+            assert abs(row[1] - want) < 1e-6 * max(1.0, abs(want))
+
+    def test_mv_with_filter(self, segs):
+        segs, cols = segs
+        r = _one_row(segs,
+                     "SELECT SUMMV(tags) FROM testTable WHERE flag = 1")
+        g = np.asarray(cols["flag"])
+        want = sum(sum(t) for t, f in zip(cols["tags"], g) if f == 1)
+        assert abs(r[0] - want) < 1e-6 * max(1.0, abs(want))
