@@ -99,10 +99,12 @@ class TpuOperatorExecutor:
             "PINOT_TPU_HOST_ROW_CACHE_BYTES", 16 << 30))
         self.cache_budget_bytes = int(_os.environ.get(
             "PINOT_TPU_HBM_CACHE_BYTES", 8 << 30))
-        #: one coarse lock: the engine is shared across server worker
-        #: threads; staging/dispatch serialize (kernel EXECUTION is async,
-        #: so device compute still overlaps), and eviction can never free a
-        #: block while another thread is mid-staging with it
+        #: staging lock only: cache mutation (plan/stage/evict) serializes,
+        #: but kernel dispatch + result fetch run OUTSIDE it so concurrent
+        #: queries overlap their device round trips (the host<->TPU link
+        #: costs ~100ms per sync; overlapped, N queries share that latency).
+        #: Eviction drops cache references without .delete() — in-flight
+        #: dispatches keep their input buffers alive via refcounts
         self._engine_lock = threading.RLock()
         #: resolved predicate parameter arrays per (batch, plan, filter) —
         #: repeat queries then cost zero host->device param uploads;
@@ -170,25 +172,28 @@ class TpuOperatorExecutor:
     # ------------------------------------------------------------------
     def execute(self, segments: List[ImmutableSegment], ctx: QueryContext
                 ) -> Tuple[List[Any], List[ImmutableSegment]]:
-        """Returns (device results, segments to fall back to host)."""
-        with self._engine_lock:
-            return self._execute_locked(segments, ctx)
+        """Returns (device results, segments to fall back to host).
 
-    def _execute_locked(self, segments, ctx):
-        plan_info = self._plan(segments, ctx)
-        if plan_info is None:
-            return [], segments
-        plan, slots_of_fn = plan_info
-        try:
-            cols, params, num_docs, S_real, D = self._stage(segments, ctx, plan)
-        except _NotStageable:
-            return [], segments
-        if self._doc_axis > 1:
-            kernel = kernels.compiled_sharded_kernel(plan, self._mesh)
-            packed = np.asarray(kernel(cols, params, num_docs, D=D))
-        else:
-            kernel = kernels.compiled_kernel(plan)
-            packed = np.asarray(kernel(cols, params, num_docs, D=D))
+        Plan + staging run under the engine lock (they mutate the block
+        caches); dispatch and the device->host result fetch run outside it,
+        so N server threads overlap their round trips on the async device
+        queue instead of serializing behind one ~100ms sync each.
+        """
+        with self._engine_lock:
+            plan_info = self._plan(segments, ctx)
+            if plan_info is None:
+                return [], segments
+            plan, slots_of_fn = plan_info
+            try:
+                cols, params, num_docs, S_real, D = self._stage(
+                    segments, ctx, plan)
+            except _NotStageable:
+                return [], segments
+            if self._doc_axis > 1:
+                kernel = kernels.compiled_sharded_kernel(plan, self._mesh)
+            else:
+                kernel = kernels.compiled_kernel(plan)
+        packed = np.asarray(kernel(cols, params, num_docs, D=D))
         results = self._assemble(segments, ctx, plan, packed, S_real, slots_of_fn)
         return results, []
 
@@ -627,12 +632,11 @@ class TpuOperatorExecutor:
         self._block_bytes[key] = nbytes
         self._cache_bytes += nbytes
         while self._cache_bytes > self.cache_budget_bytes and len(self._block_cache) > 1:
-            old_key, (old_segs, old_arr) = self._block_cache.popitem(last=False)
+            # drop the reference only (no eager .delete()): a concurrent
+            # query dispatched outside the lock may still hold this block
+            # as a kernel input; refcounting frees HBM once it finishes
+            old_key, _ = self._block_cache.popitem(last=False)
             self._cache_bytes -= self._block_bytes.pop(old_key)
-            try:
-                old_arr.delete()  # free HBM eagerly
-            except Exception:  # noqa: BLE001 — best-effort
-                pass
 
     def _check_value_precision(self, segments, col: str, vdt) -> None:
         """float32 staging (x64 off, the TPU default) is exact only for

@@ -1,0 +1,51 @@
+import os, sys, time
+import numpy as np
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import bench
+
+bench.build_data()
+segments = bench.load()
+import jax
+from pinot_tpu.ops.engine import TpuOperatorExecutor
+from pinot_tpu.ops import kernels
+from pinot_tpu.query.context import QueryContext
+
+engine = TpuOperatorExecutor()
+ctx = QueryContext.from_sql(bench.QUERY)
+plan, slots = engine._plan(segments, ctx)
+cols, params, num_docs, S_real, D = engine._stage(segments, ctx, plan)
+kernel = kernels.compiled_kernel(plan)
+o = kernel(cols, params, num_docs, D=D); np.asarray(o)  # warm
+
+# 1) fresh dispatch -> np.asarray (what engine.execute does)
+for i in range(3):
+    t0 = time.perf_counter()
+    o = kernel(cols, params, num_docs, D=D)
+    t1 = time.perf_counter()
+    a = np.asarray(o)
+    t2 = time.perf_counter()
+    print(f"dispatch {1000*(t1-t0):8.3f} ms   asarray {1000*(t2-t1):8.3f} ms")
+
+# 2) fresh dispatch -> block_until_ready -> asarray
+for i in range(3):
+    t0 = time.perf_counter()
+    o = kernel(cols, params, num_docs, D=D)
+    o.block_until_ready()
+    t1 = time.perf_counter()
+    a = np.asarray(o)
+    t2 = time.perf_counter()
+    print(f"dispatch+block {1000*(t1-t0):8.3f} ms   asarray {1000*(t2-t1):8.3f} ms")
+
+# 3) deep pipeline: 20 dispatches, then asarray each
+t0 = time.perf_counter()
+outs = [kernel(cols, params, num_docs, D=D) for _ in range(20)]
+t1 = time.perf_counter()
+arrs = [np.asarray(o) for o in outs]
+t2 = time.perf_counter()
+print(f"20 dispatches {1000*(t1-t0):8.3f} ms   20 asarrays {1000*(t2-t1):8.3f} ms"
+      f"  -> amortized {1000*(t2-t0)/20:8.3f} ms/query")
+
+# 4) jax.device_get vs np.asarray
+o = kernel(cols, params, num_docs, D=D)
+t0 = time.perf_counter(); a = jax.device_get(o); t1 = time.perf_counter()
+print(f"device_get fresh {1000*(t1-t0):8.3f} ms")

@@ -1,0 +1,41 @@
+import os, sys, time
+import numpy as np
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import jax, jax.numpy as jnp
+
+# pure link round trip: trivial kernel on tiny data
+@jax.jit
+def triv(x):
+    return x + 1.0
+
+x = jnp.zeros((8,), jnp.float32)
+np.asarray(triv(x))  # warm
+for i in range(5):
+    t0 = time.perf_counter()
+    a = np.asarray(triv(x))
+    t1 = time.perf_counter()
+    print(f"trivial sync {1000*(t1-t0):8.3f} ms")
+
+# medium kernel: reduce 128M f32 (0.5 GB)
+big = jax.device_put(np.zeros((16, 8_388_608), np.float32))
+@jax.jit
+def red(v):
+    return jnp.sum(v, axis=1)
+np.asarray(red(big))
+for i in range(5):
+    t0 = time.perf_counter()
+    a = np.asarray(red(big))
+    t1 = time.perf_counter()
+    print(f"0.5GB reduce sync {1000*(t1-t0):8.3f} ms")
+
+# 2.5 GB reduce (5 col equivalents)
+bigs = [jax.device_put(np.zeros((16, 8_388_608), np.float32)) for _ in range(5)]
+@jax.jit
+def red5(vs):
+    return sum(jnp.sum(v, axis=1) for v in vs)
+np.asarray(red5(bigs))
+for i in range(5):
+    t0 = time.perf_counter()
+    a = np.asarray(red5(bigs))
+    t1 = time.perf_counter()
+    print(f"2.5GB reduce sync {1000*(t1-t0):8.3f} ms")

@@ -1,0 +1,40 @@
+import os, sys, time
+from concurrent.futures import ThreadPoolExecutor
+import numpy as np
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import jax, jax.numpy as jnp
+
+bigs = [jax.device_put(np.zeros((16, 8_388_608), np.float32)) for _ in range(5)]
+
+@jax.jit
+def red5(vs):
+    return sum(jnp.sum(v, axis=1) for v in vs)
+
+np.asarray(red5(bigs))
+
+def one(_):
+    return np.asarray(red5(bigs))
+
+for nthreads in (1, 2, 4, 8, 16):
+    n = nthreads * 4
+    with ThreadPoolExecutor(nthreads) as pool:
+        list(pool.map(one, range(nthreads)))  # warm
+        t0 = time.perf_counter()
+        list(pool.map(one, range(n)))
+        dt = time.perf_counter() - t0
+    print(f"threads={nthreads:3d}  {n:3d} queries in {dt*1000:8.1f} ms  "
+          f"-> {dt/n*1000:7.2f} ms/query")
+
+# async fetch: dispatch all, copy_to_host_async all, then gather
+n = 16
+t0 = time.perf_counter()
+outs = [red5(bigs) for _ in range(n)]
+for o in outs:
+    try:
+        o.copy_to_host_async()
+    except Exception as e:
+        print("copy_to_host_async failed:", e)
+        break
+arrs = [np.asarray(o) for o in outs]
+dt = time.perf_counter() - t0
+print(f"async-fetch {n} queries in {dt*1000:8.1f} ms -> {dt/n*1000:7.2f} ms/query")

@@ -1,0 +1,93 @@
+"""One-off profiler: break the bench query's wall time into phases.
+
+Phases: parse, plan, stage (steady-state), kernel dispatch->ready, fetch,
+assemble, reduce. Also measures amortized pure-kernel time by issuing K
+dispatches back-to-back and blocking once (hides link latency).
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import bench  # reuse data builder
+
+
+def main():
+    os.makedirs(bench.DATA_DIR, exist_ok=True)
+    bench.build_data()
+    segments = bench.load()
+    total_rows = sum(s.num_docs for s in segments)
+    print(f"total rows: {total_rows:,}", file=sys.stderr)
+
+    import jax
+    print("devices:", jax.devices(), file=sys.stderr)
+
+    from pinot_tpu.ops.engine import TpuOperatorExecutor
+    from pinot_tpu.ops import kernels
+    from pinot_tpu.query.context import QueryContext
+    from pinot_tpu.query.reduce import reduce_results
+
+    engine = TpuOperatorExecutor()
+
+    def t(label, fn, n=20):
+        # warmup
+        fn()
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = fn()
+        dt = (time.perf_counter() - t0) / n * 1000
+        print(f"{label:35s} {dt:10.3f} ms")
+        return out
+
+    ctx = t("parse", lambda: QueryContext.from_sql(bench.QUERY))
+    plan_info = t("plan", lambda: engine._plan(segments, ctx))
+    plan, slots_of_fn = plan_info
+    staged = t("stage(steady)", lambda: engine._stage(segments, ctx, plan))
+    cols, params, num_docs, S_real, D = staged
+
+    kernel = kernels.compiled_kernel(plan)
+    # one full dispatch+block
+    out = kernel(cols, params, num_docs, D=D)
+    out.block_until_ready()
+
+    def dispatch_block():
+        o = kernel(cols, params, num_docs, D=D)
+        o.block_until_ready()
+        return o
+
+    out = t("kernel dispatch+block (1x)", dispatch_block, n=20)
+
+    # amortized: K dispatches, block once
+    K = 20
+    o = None
+    t0 = time.perf_counter()
+    for _ in range(K):
+        o = kernel(cols, params, num_docs, D=D)
+    o.block_until_ready()
+    dt = (time.perf_counter() - t0) / K * 1000
+    print(f"{'kernel amortized (20 deep)':35s} {dt:10.3f} ms")
+
+    packed = t("fetch np.asarray", lambda: np.asarray(out))
+    results = t("assemble", lambda: engine._assemble(
+        segments, ctx, plan, packed, S_real, slots_of_fn))
+    t("reduce", lambda: reduce_results(ctx, results))
+
+    # full engine.execute for comparison
+    def full():
+        r, rem = engine.execute(segments, ctx)
+        return r
+    t("engine.execute full", full, n=10)
+
+    from pinot_tpu.query.executor import QueryExecutor
+    ex = QueryExecutor(segments, use_tpu=True, engine=engine)
+    t("QueryExecutor.execute full", lambda: ex.execute(bench.QUERY), n=10)
+
+    bw = 5 * total_rows * 4 / 1e9
+    print(f"\nbytes touched/query: {bw:.2f} GB")
+
+
+if __name__ == "__main__":
+    main()
