@@ -184,7 +184,8 @@ def main():
         "host_cpu_cores": os.cpu_count(),
         "pipeline_depth": PIPELINE_DEPTH,
         "p50_query_ms": round(statistics.median(seq_lat) * 1e3, 1),
-        "p90_query_ms": round(sorted(seq_lat)[int(len(seq_lat) * 0.9)] * 1e3, 1),
+        "p90_query_ms": round(
+            sorted(seq_lat)[max(0, -(-len(seq_lat) * 9 // 10) - 1)] * 1e3, 1),
         "pipelined_query_ms": round(pipe_dt * 1e3, 2),
         "sequential_rows_per_sec": round(seq_rows_per_sec),
         "link_rt_ms": round(measure_link_rt_ms(), 1),

@@ -26,10 +26,16 @@ class MiniClusterServer:
         self.data_manager = InstanceDataManager(instance_id)
         self.executor = ServerQueryExecutor(self.data_manager, use_tpu=use_tpu)
         self.transport = QueryServer(self.executor)
-        # multi-stage worker endpoint (mailbox data plane + stage executor)
-        from pinot_tpu.mse.dispatcher import make_scan_fn
+        # multi-stage worker endpoint (mailbox data plane + stage executor);
+        # leaf aggregates route through the single-stage executor and its
+        # shared device engine (ref QueryRunner.java:258)
+        from pinot_tpu.mse.dispatcher import make_leaf_query_fn, make_scan_fn
         from pinot_tpu.mse.runtime import MseWorker
-        self.mse_worker = MseWorker(instance_id, make_scan_fn(self.data_manager))
+        self.mse_worker = MseWorker(
+            instance_id, make_scan_fn(self.data_manager),
+            leaf_query_fn=make_leaf_query_fn(
+                self.data_manager,
+                self.executor._shared_engine if use_tpu else None))
 
     def start(self) -> None:
         self.transport.start()

@@ -29,6 +29,18 @@ _QUERY_SEQ = itertools.count(1)
 _SEQ_LOCK = threading.Lock()
 
 
+def _resolve_table(data_manager, table: str):
+    """Logical name -> TableDataManager (OFFLINE preferred, ref hybrid
+    routing; MSE hybrid time-split lands with the time-boundary work)."""
+    tdm = data_manager.table(table, create=False)
+    if tdm is None:
+        for suffix in ("_OFFLINE", "_REALTIME"):
+            tdm = data_manager.table(table + suffix, create=False)
+            if tdm is not None:
+                break
+    return tdm
+
+
 def make_scan_fn(data_manager) -> ScanFn:
     """Leaf scan over an instance's local segments: filter mask + column
     materialization per segment, concatenated columnar (the
@@ -37,14 +49,7 @@ def make_scan_fn(data_manager) -> ScanFn:
     from pinot_tpu.query.filter import SegmentColumnProvider, evaluate_filter
 
     def scan(table: str, columns: List[str], filt) -> Block:
-        tdm = data_manager.table(table, create=False)
-        # logical name -> physical table (OFFLINE preferred, ref hybrid
-        # routing; MSE hybrid time-split lands with the time-boundary work)
-        if tdm is None:
-            for suffix in ("_OFFLINE", "_REALTIME"):
-                tdm = data_manager.table(table + suffix, create=False)
-                if tdm is not None:
-                    break
+        tdm = _resolve_table(data_manager, table)
         if tdm is None:
             return Block(columns, [np.empty(0, object) for _ in columns])
         sdms = tdm.acquire_segments(None)
@@ -74,6 +79,31 @@ def make_scan_fn(data_manager) -> ScanFn:
             type(tdm).release_all(sdms)
 
     return scan
+
+
+def make_leaf_query_fn(data_manager, engine_fn=None):
+    """Leaf-stage bridge to the single-stage executor (ref
+    LeafStageTransferableBlockOperator / QueryRunner.java:258): the leaf
+    aggregate runs over the instance's segments through QueryExecutor —
+    stacked-device-block TPU path included when engine_fn yields one."""
+    from pinot_tpu.query.executor import QueryExecutor
+    from pinot_tpu.server.data_manager import TableDataManager
+
+    def leaf_query(table: str, qctx):
+        tdm = _resolve_table(data_manager, table)
+        if tdm is None:
+            return []
+        sdms = tdm.acquire_segments(None)
+        try:
+            engine = engine_fn() if engine_fn is not None else None
+            ex = QueryExecutor([s.segment for s in sdms],
+                               use_tpu=engine is not None, engine=engine)
+            results, _ = ex.execute_context(qctx)
+            return results
+        finally:
+            TableDataManager.release_all(sdms)
+
+    return leaf_query
 
 
 class QueryDispatcher:

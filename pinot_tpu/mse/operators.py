@@ -200,12 +200,8 @@ def _nulls(like: np.ndarray, n: int) -> np.ndarray:
 # aggregate (ref AggregateOperator.java) — one-phase final after key shuffle
 # ---------------------------------------------------------------------------
 
-def aggregate_block(block: Block, group_exprs: Sequence[Expression],
-                    agg_nodes: Sequence[Function],
-                    schema: List[str]) -> Block:
-    """Full (final) aggregation: every distinct key is wholly local (the
-    planner hash-exchanges rows on the group key), so extract_final here is
-    exact for every function incl. sketches."""
+def _prepare_aggs(block: Block, agg_nodes: Sequence[Function]):
+    """Resolve agg nodes against a block: (fns, arg values, FILTER masks)."""
     n = block.num_rows
     fns, arg_vals, filt_masks = [], [], []
     for node in agg_nodes:
@@ -240,6 +236,17 @@ def aggregate_block(block: Block, group_exprs: Sequence[Expression],
             arg = eval_expr(inner.args[0], block) if n else np.empty(0)
         arg_vals.append(arg)
         filt_masks.append(fmask)
+    return fns, arg_vals, filt_masks
+
+
+def aggregate_block(block: Block, group_exprs: Sequence[Expression],
+                    agg_nodes: Sequence[Function],
+                    schema: List[str]) -> Block:
+    """Full (final) aggregation: every distinct key is wholly local (the
+    planner hash-exchanges rows on the group key), so extract_final here is
+    exact for every function incl. sketches."""
+    n = block.num_rows
+    fns, arg_vals, filt_masks = _prepare_aggs(block, agg_nodes)
 
     if not group_exprs:
         vals = []
@@ -272,6 +279,99 @@ def aggregate_block(block: Block, group_exprs: Sequence[Expression],
         finals = np.empty(num_groups, object)
         for g in range(num_groups):
             finals[g] = fn.extract_final(inters[g])
+        out.append(finals)
+    return Block(schema, out)
+
+
+# ---------------------------------------------------------------------------
+# two-phase aggregation (ref AggregateOperator intermediate/final modes +
+# LeafStageTransferableBlockOperator) — the leaf stage partially aggregates
+# and ships per-group INTERMEDIATES (serialized, sketch-capable) instead of
+# raw rows; the receiving stage merges and finalizes
+# ---------------------------------------------------------------------------
+
+def partial_aggregate_block(block: Block, group_exprs: Sequence[Expression],
+                            agg_nodes: Sequence[Function],
+                            schema: List[str]) -> Block:
+    """Host fallback for the leaf_agg op (when no leaf executor is bound):
+    group values + one serialized intermediate cell per (group, agg)."""
+    from pinot_tpu.server.datatable import serialize_value
+    n = block.num_rows
+    fns, arg_vals, filt_masks = _prepare_aggs(block, agg_nodes)
+
+    if not group_exprs:
+        base = np.ones(n, bool)
+        cells = []
+        for fn, arg, fmask in zip(fns, arg_vals, filt_masks):
+            mask = base if fmask is None else fmask
+            if fn.mv_input and arg is not None:
+                flat, counts = arg
+                mask = np.repeat(mask, counts)
+                arg = flat
+            inter = fn.aggregate(arg, mask) if n else fn.identity()
+            cells.append(serialize_value(inter))
+        return Block(schema, [np.array([c], object) for c in cells])
+
+    if n == 0:
+        return Block.empty(schema)
+    key_cols = [eval_expr(e, block) for e in group_exprs]
+    codes, num_groups, first = factorize(key_cols)
+    base = np.ones(n, bool)
+    out: List[np.ndarray] = [kc[first] for kc in key_cols]
+    for fn, arg, fmask in zip(fns, arg_vals, filt_masks):
+        mask = base if fmask is None else fmask
+        keys = codes
+        if fn.mv_input and arg is not None:
+            flat, counts = arg
+            mask = np.repeat(mask, counts)
+            keys = np.repeat(codes, counts)
+            arg = flat
+        inters = fn.aggregate_grouped(arg, keys, num_groups, mask)
+        cells = np.empty(num_groups, object)
+        for g in range(num_groups):
+            cells[g] = serialize_value(inters[g])
+        out.append(cells)
+    return Block(schema, out)
+
+
+def final_merge_block(block: Block, num_group_cols: int,
+                      agg_nodes: Sequence[Function],
+                      schema: List[str]) -> Block:
+    """Merge serialized partial intermediates (leaf_agg output, possibly
+    from many workers) and extract final values."""
+    from pinot_tpu.server.datatable import deserialize_value
+    fns = []
+    for node in agg_nodes:
+        inner = node.args[0] if node.name == "filter_agg" else node
+        fns.append(get_aggregation(inner.name, inner.args))
+    n = block.num_rows
+
+    if num_group_cols == 0:
+        merged = [fn.identity() for fn in fns]
+        for i, fn in enumerate(fns):
+            col = block.arrays[i]
+            for r in range(n):
+                merged[i] = fn.merge(merged[i], deserialize_value(col[r]))
+        return Block(schema, [np.array([fn.extract_final(m)], object)
+                              for fn, m in zip(fns, merged)])
+
+    if n == 0:
+        return Block.empty(schema)
+    key_cols = list(block.arrays[:num_group_cols])
+    codes, num_groups, first = factorize(key_cols)
+    out: List[np.ndarray] = [kc[first] for kc in key_cols]
+    for i, fn in enumerate(fns):
+        col = block.arrays[num_group_cols + i]
+        merged = [None] * num_groups
+        for r in range(n):
+            g = codes[r]
+            inter = deserialize_value(col[r])
+            merged[g] = inter if merged[g] is None \
+                else fn.merge(merged[g], inter)
+        finals = np.empty(num_groups, object)
+        for g in range(num_groups):
+            finals[g] = fn.extract_final(
+                merged[g] if merged[g] is not None else fn.identity())
         out.append(finals)
     return Block(schema, out)
 

@@ -133,10 +133,29 @@ class _Fragmenter:
             s = self.new_stage(workers)
             child = self.fragment_to_stage(node.child)
             gk = exprs_to_json(node.group_exprs)
+            aggs = exprs_to_json(node.agg_nodes)
+            if _is_leaf_chain(child.root):
+                # two-phase aggregation (ref LeafStageTransferableBlockOperator
+                # + AggregateOperator intermediate/final split): the leaf
+                # stage partially aggregates ON the scanning servers — the
+                # single-stage engine (TPU path included) runs the scan-agg
+                # hot loop, and only per-group INTERMEDIATES cross the wire.
+                child.root = {"op": "leaf_agg", "child": child.root,
+                              "groupExprs": gk, "aggNodes": aggs,
+                              "schema": node.schema}
+                child.schema = node.schema
+                group_ids = [["id", n]
+                             for n in node.schema[:len(node.group_exprs)]]
+                self._connect(child, s, group_ids)
+                s.root = {"op": "final_agg", "child": _receive(child),
+                          "numGroups": len(node.group_exprs),
+                          "aggNodes": aggs, "schema": node.schema}
+                s.schema = node.schema
+                return s
             self._connect(child, s, gk)
             s.root = {"op": "aggregate", "child": _receive(child),
                       "groupExprs": gk,
-                      "aggNodes": exprs_to_json(node.agg_nodes),
+                      "aggNodes": aggs,
                       "schema": node.schema}
             s.schema = node.schema
             return s
@@ -183,6 +202,17 @@ class _Fragmenter:
             child.out_keys = hash_keys
         else:
             child.out_kind = "singleton"
+
+
+def _is_leaf_chain(op: Dict[str, Any]) -> bool:
+    """True when the op tree is a pure table-local chain (scan with only
+    stateless row ops above) — the shape the leaf executor can take over."""
+    kind = op["op"]
+    if kind == "scan":
+        return True
+    if kind in ("filter", "project", "rename"):
+        return _is_leaf_chain(op["child"])
+    return False
 
 
 def _receive(child: StagePlan) -> Dict[str, Any]:

@@ -32,7 +32,7 @@ class StageContext:
     def __init__(self, query_id: str, plan: QueryPlan, worker_id: str,
                  worker_idx: int, mailbox: MailboxService,
                  addresses: Dict[str, str], scan_fn: Optional[ScanFn],
-                 timeout: float = 60.0):
+                 timeout: float = 60.0, leaf_query_fn=None):
         self.query_id = query_id
         self.plan = plan
         self.worker_id = worker_id
@@ -42,6 +42,10 @@ class StageContext:
         self.addresses = addresses
         self.scan_fn = scan_fn
         self.timeout = timeout
+        #: (table, QueryContext) -> per-segment SegmentResults via the
+        #: single-stage executor (TPU engine included) — the
+        #: LeafStageTransferableBlockOperator bridge; None on the broker
+        self.leaf_query_fn = leaf_query_fn
 
 
 def run_stage(ctx: StageContext, stage: StagePlan) -> Optional[Block]:
@@ -136,6 +140,13 @@ def _run_op(ctx: StageContext, op: Dict[str, Any]) -> Block:
         return ops.aggregate_block(
             child, exprs_from_json(op["groupExprs"]),
             [a for a in aggs if isinstance(a, Function)], op["schema"])
+    if kind == "leaf_agg":
+        return _op_leaf_agg(ctx, op)
+    if kind == "final_agg":
+        child = _run_op(ctx, op["child"])
+        return ops.final_merge_block(
+            child, op["numGroups"], exprs_from_json(op["aggNodes"]),
+            op["schema"])
     if kind == "sort":
         child = _run_op(ctx, op["child"])
         return ops.sort_block(child, exprs_from_json(op["keys"]),
@@ -172,6 +183,155 @@ def _typed_empty(schema: List[str]) -> Block:
     return Block(schema, [np.empty(0, object) for _ in schema])
 
 
+def _op_leaf_agg(ctx: StageContext, op: Dict[str, Any]) -> Block:
+    """Leaf-stage partial aggregation. Preferred path: rewrite the chain
+    onto the single-stage executor (which stacks segments into device
+    blocks — ref QueryRunner.java:258, leaf runs on the v1 engine) and ship
+    merged per-group intermediates. Fallback: scan + host partial agg."""
+    groups = exprs_from_json(op["groupExprs"])
+    aggs = exprs_from_json(op["aggNodes"])
+    if ctx.leaf_query_fn is not None:
+        block = _leaf_agg_pushdown(ctx, op, groups, aggs)
+        if block is not None:
+            return block
+    child = _run_op(ctx, op["child"])
+    return ops.partial_aggregate_block(child, groups, aggs, op["schema"])
+
+
+def _leaf_chain_map(op: Dict[str, Any]):
+    """Resolve a leaf-local op chain to (table, physical filter expr,
+    output-name -> physical expr map), or None when it doesn't map."""
+    from pinot_tpu.query.expressions import Function, Identifier
+    kind = op["op"]
+    if kind == "scan":
+        m = {out: Identifier(col)
+             for out, col in zip(op["schema"], op["columns"])}
+        return op["table"], expr_from_json(op["filter"]), m
+    got = _leaf_chain_map(op["child"]) if "child" in op else None
+    if got is None:
+        return None
+    table, filt, m = got
+    if kind == "rename":
+        child_schema = op["child"]["schema"]
+        try:
+            m2 = {new: m[old]
+                  for new, old in zip(op["schema"], child_schema)}
+        except KeyError:
+            return None
+        return table, filt, m2
+    if kind == "project":
+        try:
+            m2 = {name: _substitute(e, m) for name, e in
+                  zip(op["names"], exprs_from_json(op["exprs"]))}
+        except KeyError:
+            return None
+        return table, filt, m2
+    if kind == "filter":
+        try:
+            cond = _substitute(expr_from_json(op["condition"]), m)
+        except KeyError:
+            return None
+        filt = cond if filt is None else Function("and", (filt, cond))
+        return table, filt, m
+    return None
+
+
+def _key_columns(keys: List[tuple], nk: int) -> List[np.ndarray]:
+    """Transpose group-key tuples into per-column object arrays."""
+    cols = []
+    for i in range(nk):
+        col = np.empty(len(keys), object)
+        for r_i, k in enumerate(keys):
+            col[r_i] = k[i]
+        cols.append(col)
+    return cols
+
+
+def _substitute(e, m):
+    from pinot_tpu.query.expressions import Function, Identifier
+    if isinstance(e, Identifier):
+        if e.name == "*":  # COUNT(*) — not a real column
+            return e
+        return m[e.name]
+    if isinstance(e, Function):
+        return Function(e.name, tuple(_substitute(a, m) for a in e.args))
+    return e
+
+
+def _leaf_agg_pushdown(ctx: StageContext, op: Dict[str, Any],
+                       groups, aggs) -> Optional[Block]:
+    from pinot_tpu.query.context import QueryContext
+    from pinot_tpu.query.results import AggregationResult, GroupByResult
+    from pinot_tpu.server.datatable import serialize_value
+
+    mapped = _leaf_chain_map(op["child"])
+    if mapped is None:
+        return None
+    table, filt, m = mapped
+    try:
+        groups_p = [_substitute(e, m) for e in groups]
+        aggs_p = [_substitute(e, m) for e in aggs]
+    except KeyError:
+        return None
+    schema = op["schema"]
+    if not aggs:
+        # agg-less group-by (DISTINCT lowering): leaf-side dedup through
+        # the single-stage DISTINCT path, group values only on the wire
+        from pinot_tpu.query.results import DistinctResult
+        qctx = QueryContext(
+            table=table, select=groups_p, aliases=[None] * len(groups_p),
+            distinct=True, filter=filt, group_by=[], having=None,
+            order_by=[], limit=1 << 31, offset=0, options={})
+        qctx._extract_aggregations()
+        seen = set()
+        for r in ctx.leaf_query_fn(table, qctx):
+            assert isinstance(r, DistinctResult), r
+            seen.update(r.rows)
+        return Block(schema, _key_columns(list(seen), len(groups)))
+
+    select = groups_p + aggs_p
+    qctx = QueryContext(
+        table=table, select=select, aliases=[None] * len(select),
+        distinct=False, filter=filt, group_by=groups_p, having=None,
+        order_by=[], limit=1 << 31, offset=0,
+        options={"numGroupsLimit": str(1 << 31)})
+    try:
+        qctx._extract_aggregations()
+        agg_idx = [qctx.agg_index(a) for a in aggs_p]
+    except Exception:  # noqa: BLE001 — unsupported agg name etc.
+        return None
+    results = ctx.leaf_query_fn(table, qctx)
+
+    if not groups:
+        merged = [fn.identity() for fn in qctx.agg_functions]
+        for r in results:
+            assert isinstance(r, AggregationResult), r
+            for i, fn in enumerate(qctx.agg_functions):
+                merged[i] = fn.merge(merged[i], r.intermediates[i])
+        cells = [serialize_value(merged[j]) for j in agg_idx]
+        return Block(schema, [np.array([c], object) for c in cells])
+
+    combined: Dict[tuple, list] = {}
+    for r in results:
+        assert isinstance(r, GroupByResult), r
+        for key, inters in r.groups.items():
+            cur = combined.get(key)
+            if cur is None:
+                combined[key] = list(inters)
+            else:
+                for i, fn in enumerate(qctx.agg_functions):
+                    cur[i] = fn.merge(cur[i], inters[i])
+    keys = list(combined.keys())
+    cols: List[np.ndarray] = _key_columns(keys, len(groups))
+    for j in agg_idx:
+        fn = qctx.agg_functions[j]
+        col = np.empty(len(keys), object)
+        for r_i, k in enumerate(keys):
+            col[r_i] = serialize_value(combined[k][j])
+        cols.append(col)
+    return Block(schema, cols)
+
+
 def _op_scan(ctx: StageContext, op: Dict[str, Any]) -> Block:
     if ctx.scan_fn is None:
         raise RuntimeError("no scan_fn bound (leaf stage on broker?)")
@@ -192,9 +352,11 @@ class MseWorker:
     and run on a thread pool.
     """
 
-    def __init__(self, instance_id: str, scan_fn: Optional[ScanFn]):
+    def __init__(self, instance_id: str, scan_fn: Optional[ScanFn],
+                 leaf_query_fn=None):
         self.instance_id = instance_id
         self.scan_fn = scan_fn
+        self.leaf_query_fn = leaf_query_fn
         self.mailbox = MailboxService(instance_id)
         self._lock = threading.Lock()
 
@@ -220,7 +382,8 @@ class MseWorker:
         ctx = StageContext(
             query_id=query_id, plan=plan, worker_id=self.instance_id,
             worker_idx=worker_idx, mailbox=self.mailbox,
-            addresses=addresses, scan_fn=self.scan_fn, timeout=timeout)
+            addresses=addresses, scan_fn=self.scan_fn, timeout=timeout,
+            leaf_query_fn=self.leaf_query_fn)
         # one thread per stage instance: receive ops BLOCK on producer
         # stages, so a bounded pool would deadlock once every thread holds
         # a receive-blocked instance (e.g. deep join trees / concurrency)

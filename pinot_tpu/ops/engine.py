@@ -103,9 +103,14 @@ class TpuOperatorExecutor:
         #: but kernel dispatch + result fetch run OUTSIDE it so concurrent
         #: queries overlap their device round trips (the host<->TPU link
         #: costs ~100ms per sync; overlapped, N queries share that latency).
-        #: Eviction drops cache references without .delete() — in-flight
-        #: dispatches keep their input buffers alive via refcounts
+        #: Eviction drops cache references without an immediate .delete()
+        #: — in-flight dispatches keep their input buffers alive via
+        #: refcounts; once the in-flight count drains to zero, pending
+        #: evictions free HBM eagerly (so the budget is a real bound again
+        #: whenever the engine quiesces)
         self._engine_lock = threading.RLock()
+        self._inflight = 0
+        self._evicted_pending: List[Any] = []
         #: resolved predicate parameter arrays per (batch, plan, filter) —
         #: repeat queries then cost zero host->device param uploads;
         #: bounded by simple size cap (entries are tiny)
@@ -193,7 +198,21 @@ class TpuOperatorExecutor:
                 kernel = kernels.compiled_sharded_kernel(plan, self._mesh)
             else:
                 kernel = kernels.compiled_kernel(plan)
-        packed = np.asarray(kernel(cols, params, num_docs, D=D))
+            self._inflight += 1
+        try:
+            packed = np.asarray(kernel(cols, params, num_docs, D=D))
+        finally:
+            with self._engine_lock:
+                self._inflight -= 1
+                if self._inflight == 0 and self._evicted_pending:
+                    # no dispatch holds the evicted blocks anymore:
+                    # free their HBM eagerly instead of waiting on GC
+                    for arr in self._evicted_pending:
+                        try:
+                            arr.delete()
+                        except Exception:  # noqa: BLE001 — best-effort
+                            pass
+                    self._evicted_pending.clear()
         results = self._assemble(segments, ctx, plan, packed, S_real, slots_of_fn)
         return results, []
 
@@ -632,11 +651,18 @@ class TpuOperatorExecutor:
         self._block_bytes[key] = nbytes
         self._cache_bytes += nbytes
         while self._cache_bytes > self.cache_budget_bytes and len(self._block_cache) > 1:
-            # drop the reference only (no eager .delete()): a concurrent
-            # query dispatched outside the lock may still hold this block
-            # as a kernel input; refcounting frees HBM once it finishes
-            old_key, _ = self._block_cache.popitem(last=False)
+            old_key, (_segs, old_arr) = self._block_cache.popitem(last=False)
             self._cache_bytes -= self._block_bytes.pop(old_key)
+            if self._inflight == 0:
+                # nothing dispatched outside the lock: free HBM eagerly
+                try:
+                    old_arr.delete()
+                except Exception:  # noqa: BLE001 — best-effort
+                    pass
+            else:
+                # a concurrent query may hold this block as a kernel
+                # input; defer the delete until in-flight drains to zero
+                self._evicted_pending.append(old_arr)
 
     def _check_value_precision(self, segments, col: str, vdt) -> None:
         """float32 staging (x64 off, the TPU default) is exact only for

@@ -231,6 +231,19 @@ def _stats_from(t: tuple) -> ExecutionStats:
     return ExecutionStats(*t)
 
 
+def serialize_value(v: Any) -> bytes:
+    """One typed value (incl. sketches) -> bytes. Used for aggregation
+    intermediates crossing the MSE mailbox plane as opaque block cells
+    (ref DataBlock variable-size payloads)."""
+    w = _Writer()
+    w.value(v)
+    return w.bytes()
+
+
+def deserialize_value(buf: bytes) -> Any:
+    return _Reader(buf).value()
+
+
 def serialize_results(results: List[Any], exceptions: List[dict] = (),
                       extra_stats: Optional[ExecutionStats] = None) -> bytes:
     """Server response: list of shape-tagged SegmentResults + exceptions +
