@@ -122,6 +122,47 @@ class Project(LogicalNode):
 
 
 @dataclass
+class SetOp(LogicalNode):
+    """UNION / INTERSECT / EXCEPT (ref runtime/operator/SetOperator.java).
+    Output schema takes the left input's names (SQL rule)."""
+    left: LogicalNode
+    right: LogicalNode
+    op: str                               # union | intersect | except
+    all: bool
+
+    def __post_init__(self):
+        if len(self.left.schema) != len(self.right.schema):
+            raise PlanError(
+                f"{self.op.upper()} arity mismatch: "
+                f"{len(self.left.schema)} vs {len(self.right.schema)} columns")
+        self.schema = list(self.left.schema)
+
+    @property
+    def inputs(self):
+        return [self.left, self.right]
+
+
+@dataclass
+class Window(LogicalNode):
+    """One window spec + its functions (ref WindowAggregateOperator.java;
+    Calcite groups OVER calls by identical window). Appends one output
+    column per function to the child's schema."""
+    child: LogicalNode
+    partition: List[Expression]
+    order_keys: List[Expression]
+    ascs: List[bool]
+    over_nodes: List[Function]            # full over(...) expressions
+
+    def __post_init__(self):
+        self.schema = list(self.child.schema) + \
+            [str(o) for o in self.over_nodes]
+
+    @property
+    def inputs(self):
+        return [self.child]
+
+
+@dataclass
 class Sort(LogicalNode):
     child: LogicalNode
     keys: List[Expression]
@@ -225,6 +266,8 @@ def _strip_alias(e: Expression, alias: str) -> Expression:
 
 def _contains_agg(e: Expression) -> bool:
     if isinstance(e, Function):
+        if e.name == "over":
+            return False  # window-owned aggs are not grouping aggs
         if is_aggregation(e.name) or e.name == "filter_agg":
             return True
         return any(_contains_agg(a) for a in e.args)
@@ -233,6 +276,8 @@ def _contains_agg(e: Expression) -> bool:
 
 def _collect_aggs(e: Expression, out: List[Function]) -> None:
     if isinstance(e, Function):
+        if e.name == "over":
+            return  # the inner agg belongs to the window operator
         if is_aggregation(e.name) or e.name == "filter_agg":
             if e not in out:
                 out.append(e)
@@ -241,12 +286,36 @@ def _collect_aggs(e: Expression, out: List[Function]) -> None:
             _collect_aggs(a, out)
 
 
+#: window-only functions (aggregations are additionally valid OVER fns)
+WINDOW_FNS = {"row_number", "rank", "dense_rank", "ntile", "lag", "lead",
+              "first_value", "last_value"}
+
+
+def _collect_overs(e: Expression, out: List[Function]) -> None:
+    if isinstance(e, Function):
+        if e.name == "over":
+            if e not in out:
+                inner = e.args[0]
+                if not (isinstance(inner, Function)
+                        and (inner.name in WINDOW_FNS
+                             or is_aggregation(inner.name))):
+                    raise PlanError(
+                        f"{inner} is not a window function")
+                out.append(e)
+            return
+        for a in e.args:
+            _collect_overs(a, out)
+
+
 # ---------------------------------------------------------------------------
 # Builder
 # ---------------------------------------------------------------------------
 
-def build_logical(q: MseQuery, catalog: Catalog) -> LogicalNode:
-    """MseQuery -> logical plan tree with resolved identifiers."""
+def build_logical(q, catalog: Catalog) -> LogicalNode:
+    """MseQuery | MseSetQuery -> logical plan tree with resolved names."""
+    from pinot_tpu.mse.sql import MseSetQuery
+    if isinstance(q, MseSetQuery):
+        return _build_set_query(q, catalog)
     scope = _Scope()
 
     # 1. FROM items -> scans (filters pushed in later)
@@ -336,13 +405,34 @@ def build_logical(q: MseQuery, catalog: Catalog) -> LogicalNode:
         select = [_post_agg(e, plan.schema) for e in select]
         having = _post_agg(having, plan.schema) if having is not None else None
         order_by = [(_post_agg(e, plan.schema), asc) for e, asc in order_by]
-    elif q.distinct:
-        plan = Aggregate(plan, list(select), [])
-        select = [_post_agg(e, plan.schema) for e in select]
-        order_by = [(_post_agg(e, plan.schema), asc) for e, asc in order_by]
 
     if having is not None:
         plan = Filter(plan, having)
+        having = None
+
+    # window functions evaluate after GROUP BY/HAVING but before DISTINCT;
+    # one Window node per distinct OVER spec (the way Calcite groups
+    # windows — ref WindowAggregateOperator)
+    over_nodes: List[Function] = []
+    for e in select + [e for e, _ in order_by]:
+        _collect_overs(e, over_nodes)
+    if over_nodes:
+        specs: Dict[Tuple, List[Function]] = {}
+        for o in over_nodes:
+            specs.setdefault((o.args[1], o.args[2]), []).append(o)
+        for (part_f, order_f), nodes in specs.items():
+            partition = list(part_f.args)
+            okeys = [k.args[0] for k in order_f.args]
+            ascs = [k.name == "asc" for k in order_f.args]
+            plan = Window(plan, partition, okeys, ascs, nodes)
+            select = [_post_agg(e, plan.schema) for e in select]
+            order_by = [(_post_agg(e, plan.schema), asc)
+                        for e, asc in order_by]
+
+    if q.distinct and not (agg_nodes or group_by):
+        plan = Aggregate(plan, list(select), [])
+        select = [_post_agg(e, plan.schema) for e in select]
+        order_by = [(_post_agg(e, plan.schema), asc) for e, asc in order_by]
 
     # 6. final projection
     names = []
@@ -388,6 +478,27 @@ def build_logical(q: MseQuery, catalog: Catalog) -> LogicalNode:
     return plan
 
 
+def _build_set_query(q, catalog: Catalog) -> LogicalNode:
+    """MseSetQuery -> SetOp (+ Sort for compound ORDER BY/LIMIT)."""
+    left = build_logical(q.left, catalog)
+    right = build_logical(q.right, catalog)
+    plan: LogicalNode = SetOp(left, right, q.op, q.all)
+    keys: List[Expression] = []
+    ascs: List[bool] = []
+    for e, asc in q.order_by:
+        if isinstance(e, Identifier) and e.name in plan.schema:
+            keys.append(e)
+        else:
+            raise PlanError(
+                f"compound ORDER BY key {e} must be an output column "
+                f"of the first operand ({plan.schema})")
+        ascs.append(asc)
+    limit = -1 if q.limit is None else q.limit
+    if keys or limit >= 0 or q.offset:
+        plan = Sort(plan, keys, ascs, limit, q.offset)
+    return plan
+
+
 def _node_exprs(n: LogicalNode) -> List[Optional[Expression]]:
     """Expressions a node evaluates over its INPUT schema (scan filters are
     excluded: they run inside the scan against physical columns)."""
@@ -397,6 +508,9 @@ def _node_exprs(n: LogicalNode) -> List[Optional[Expression]]:
         return [n.condition]
     if isinstance(n, Aggregate):
         return list(n.group_exprs) + list(n.agg_nodes)
+    if isinstance(n, Window):
+        return list(n.partition) + list(n.order_keys) + \
+            [o.args[0] for o in n.over_nodes]
     if isinstance(n, Project):
         return list(n.exprs)
     if isinstance(n, Sort):

@@ -409,8 +409,201 @@ def sort_block(block: Block, keys: Sequence[Expression], ascs: Sequence[bool],
 
 
 # ---------------------------------------------------------------------------
-# exchange partitioning
+# window functions (ref runtime/operator/WindowAggregateOperator.java +
+# operator/window/ rank/value/aggregate families) — whole-block vectorized:
+# sort rows by (partition, order keys), compute per-row results with
+# prefix-scan doubling, scatter back to input order
 # ---------------------------------------------------------------------------
+
+def _segmented_scan(vals: np.ndarray, start: np.ndarray, op) -> np.ndarray:
+    """Inclusive running `op` (np.minimum/np.maximum) within segments whose
+    per-row segment start position is `start` — Hillis-Steele doubling, so
+    O(n log n) without a Python loop over partitions."""
+    out = vals.copy()
+    n = len(out)
+    pos = np.arange(n)
+    d = 1
+    while d < n:
+        take = pos >= start + d
+        shifted = np.empty_like(out)
+        shifted[d:] = out[:-d]
+        out = np.where(take, op(out, shifted), out)
+        d *= 2
+    return out
+
+
+def window_block(block: Block, partition: Sequence[Expression],
+                 order_keys: Sequence[Expression], ascs: Sequence[bool],
+                 over_nodes: Sequence[Function],
+                 schema: List[str]) -> Block:
+    """Evaluate one window spec; appends one column per over node.
+
+    Default SQL frame semantics: with ORDER BY, aggregates use RANGE
+    UNBOUNDED PRECEDING..CURRENT ROW (peers included); without, the whole
+    partition. first_value/last_value follow the same frame (the standard
+    last_value-gotcha included); lag/lead are row-based.
+    """
+    n = block.num_rows
+    if n == 0:
+        return Block(schema, list(block.arrays)
+                     + [np.empty(0, object) for _ in over_nodes])
+
+    okey_vals = [eval_expr(e, block) for e in order_keys]
+    if partition:
+        pcodes, _np_, _ = factorize([eval_expr(e, block) for e in partition])
+    else:
+        pcodes = np.zeros(n, np.int64)
+    if order_keys:
+        ocodes, _no_, _ = factorize(list(okey_vals))
+    else:
+        ocodes = np.zeros(n, np.int64)
+
+    # sort: partition primary, then order keys with direction
+    sort_cols = []
+    for c, asc in zip(reversed(okey_vals), reversed(list(ascs))):
+        if c.dtype.kind == "O":
+            c = _as_str(c)
+        if not asc:
+            if c.dtype.kind in "US":
+                _, inv = np.unique(c, return_inverse=True)
+                c = -inv
+            elif c.dtype.kind in "iu":
+                c = -c.astype(np.int64, copy=False)
+            else:
+                c = -c.astype(np.float64, copy=False)
+        sort_cols.append(c)
+    sort_cols.append(pcodes)
+    idx = np.lexsort(sort_cols) if len(sort_cols) > 1 \
+        else np.argsort(pcodes, kind="stable")
+
+    pcs = pcodes[idx]
+    ocs = ocodes[idx]
+    pos = np.arange(n)
+    pstart_mark = np.r_[True, pcs[1:] != pcs[:-1]]
+    part_start = np.maximum.accumulate(np.where(pstart_mark, pos, 0))
+    peer_mark = pstart_mark | np.r_[True, ocs[1:] != ocs[:-1]]
+    peer_gid = np.cumsum(peer_mark) - 1
+    peer_last = np.zeros(peer_gid[-1] + 1, np.int64)
+    np.maximum.at(peer_last, peer_gid, pos)
+    peer_end = peer_last[peer_gid]          # last row of the peer group
+    pgid = np.cumsum(pstart_mark) - 1
+    plast = np.zeros(pgid[-1] + 1, np.int64)
+    np.maximum.at(plast, pgid, pos)
+    part_end = plast[pgid]
+
+    framed_end = peer_end if order_keys else part_end
+
+    out_cols: List[np.ndarray] = []
+    for over in over_nodes:
+        inner = over.args[0]
+        assert isinstance(inner, Function)
+        name = inner.name
+        if name == "row_number":
+            res = (pos - part_start + 1).astype(np.int64)
+        elif name == "rank":
+            peer_first = np.maximum.accumulate(np.where(peer_mark, pos, 0))
+            res = (peer_first - part_start + 1).astype(np.int64)
+        elif name == "dense_rank":
+            csum = np.cumsum(peer_mark)
+            res = (csum - csum[part_start] + 1).astype(np.int64)
+        elif name == "ntile":
+            buckets = int(_literal_arg(inner, 0, required=True))
+            size = part_end - part_start + 1
+            rel = pos - part_start
+            res = (rel * buckets // size + 1).astype(np.int64)
+        elif name in ("lag", "lead"):
+            vals = eval_expr(inner.args[0], block)[idx]
+            off = int(_literal_arg(inner, 1, default=1))
+            default = _literal_arg(inner, 2, default=None)
+            if name == "lag":
+                src = pos - off
+                ok = src >= part_start
+            else:
+                src = pos + off
+                ok = src <= part_end
+            src = np.clip(src, 0, n - 1)
+            res = np.empty(n, object)
+            res[ok] = vals[src[ok]]
+            res[~ok] = default
+        elif name == "first_value":
+            vals = eval_expr(inner.args[0], block)[idx]
+            res = vals[part_start]
+        elif name == "last_value":
+            vals = eval_expr(inner.args[0], block)[idx]
+            res = vals[framed_end]
+        elif name in ("sum", "count", "avg", "min", "max"):
+            star = (inner.args and isinstance(inner.args[0], Identifier)
+                    and inner.args[0].name == "*") or not inner.args
+            vals = None if star else eval_expr(inner.args[0], block)[idx]
+            cnt_run = (pos - part_start + 1).astype(np.float64)
+            if name == "count":
+                res = cnt_run[framed_end].astype(np.int64)
+            else:
+                v = vals.astype(np.float64, copy=False)
+                if name in ("sum", "avg"):
+                    cum = np.cumsum(v)
+                    base = cum[part_start] - v[part_start]
+                    run = cum - base
+                    res = run[framed_end]
+                    if name == "avg":
+                        res = res / cnt_run[framed_end]
+                elif name == "min":
+                    res = _segmented_scan(v, part_start, np.minimum)[framed_end]
+                else:
+                    res = _segmented_scan(v, part_start, np.maximum)[framed_end]
+        else:
+            raise ValueError(f"unsupported window function {name!r}")
+        # scatter back to input row order
+        unsorted = np.empty(n, dtype=object if res.dtype.kind == "O"
+                            else res.dtype)
+        unsorted[idx] = res
+        out_cols.append(unsorted)
+    return Block(schema, list(block.arrays) + out_cols)
+
+
+def _literal_arg(fn: Function, i: int, default=None, required: bool = False):
+    from pinot_tpu.query.expressions import Literal
+    if len(fn.args) > i and isinstance(fn.args[i], Literal):
+        return fn.args[i].value
+    if required:
+        raise ValueError(f"{fn.name} needs a literal argument {i}")
+    return default
+
+
+# ---------------------------------------------------------------------------
+# set operators (ref runtime/operator/SetOperator.java +
+# Union/Intersect/MinusOperator) — rows hashed to workers on all columns,
+# so per-worker multiset logic is globally exact
+# ---------------------------------------------------------------------------
+
+def set_op_block(left: Block, right: Block, kind: str, all_: bool,
+                 schema: List[str]) -> Block:
+    if kind == "union":
+        both = Block.concat([left, right.rename(left.names)])
+        if all_ or both.num_rows == 0:
+            return both.rename(schema)
+        _codes, _k, first = factorize(list(both.arrays))
+        return both.take(np.sort(first)).rename(schema)
+
+    cl, cr = _factorize_pair(list(left.arrays), list(right.arrays))
+    k = int(max(cl.max() if len(cl) else -1,
+                cr.max() if len(cr) else -1)) + 1
+    lcount = np.bincount(cl, minlength=k)
+    rcount = np.bincount(cr, minlength=k)
+    if kind == "intersect":
+        keep_per_code = np.minimum(lcount, rcount) if all_ \
+            else np.minimum(np.minimum(lcount, rcount), 1)
+    else:  # except
+        keep_per_code = np.maximum(lcount - rcount, 0) if all_ \
+            else (np.minimum(lcount, 1) * (rcount == 0))
+    # emit the first keep_per_code[c] left rows of each code, stable order
+    order = np.argsort(cl, kind="stable")
+    sorted_codes = cl[order]
+    rank_in_code = np.arange(len(cl)) - np.searchsorted(
+        sorted_codes, sorted_codes, side="left")
+    keep_sorted = rank_in_code < keep_per_code[sorted_codes]
+    keep_idx = np.sort(order[keep_sorted])
+    return left.take(keep_idx).rename(schema)
 
 def hash_partition(block: Block, key_exprs: Sequence[Expression],
                    num_partitions: int) -> List[Block]:

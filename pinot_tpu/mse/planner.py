@@ -160,6 +160,40 @@ class _Fragmenter:
             s.schema = node.schema
             return s
 
+        if isinstance(node, L.Window):
+            # all rows of a partition must land on one worker: hash
+            # exchange on the partition keys (singleton when unpartitioned)
+            pk = exprs_to_json(node.partition)
+            workers = list(self.intermediate) if node.partition \
+                else list(self.intermediate)[:1]
+            s = self.new_stage(workers)
+            child = self.fragment_to_stage(node.child)
+            self._connect(child, s, pk)
+            s.root = {"op": "window", "child": _receive(child),
+                      "partition": pk,
+                      "orderKeys": exprs_to_json(node.order_keys),
+                      "ascs": list(node.ascs),
+                      "overs": exprs_to_json(node.over_nodes),
+                      "schema": node.schema}
+            s.schema = node.schema
+            return s
+
+        if isinstance(node, L.SetOp):
+            # hash both inputs on ALL columns: equal rows meet on one
+            # worker, so per-worker set semantics compose globally
+            s = self.new_stage(list(self.intermediate))
+            left = self.fragment_to_stage(node.left)
+            right = self.fragment_to_stage(node.right)
+            self._connect(left, s,
+                          [["id", n] for n in node.left.schema])
+            self._connect(right, s,
+                          [["id", n] for n in node.right.schema])
+            s.root = {"op": "setop", "kind": node.op, "all": node.all,
+                      "left": _receive(left), "right": _receive(right),
+                      "schema": node.schema}
+            s.schema = node.schema
+            return s
+
         if isinstance(node, L.Filter):
             s = self.fragment_to_stage(node.child)
             s.root = {"op": "filter", "child": s.root,

@@ -151,6 +151,17 @@ def _run_op(ctx: StageContext, op: Dict[str, Any]) -> Block:
         child = _run_op(ctx, op["child"])
         return ops.sort_block(child, exprs_from_json(op["keys"]),
                               op["ascs"], op["limit"], op["offset"])
+    if kind == "window":
+        child = _run_op(ctx, op["child"])
+        return ops.window_block(
+            child, exprs_from_json(op["partition"]),
+            exprs_from_json(op["orderKeys"]), op["ascs"],
+            exprs_from_json(op["overs"]), op["schema"])
+    if kind == "setop":
+        left = _run_op(ctx, op["left"])
+        right = _run_op(ctx, op["right"])
+        return ops.set_op_block(left, right, op["kind"], op["all"],
+                                op["schema"])
     raise ValueError(f"unknown op {kind!r}")
 
 

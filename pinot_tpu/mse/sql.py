@@ -53,7 +53,19 @@ class MseQuery:
 
     @property
     def is_single_table(self) -> bool:
-        return not self.joins and self.from_item.table is not None
+        return (not self.joins and self.from_item.table is not None
+                and not self._has_window())
+
+    def _has_window(self) -> bool:
+        def walk(e) -> bool:
+            from pinot_tpu.query.expressions import Function
+            if isinstance(e, Function):
+                if e.name == "over":
+                    return True
+                return any(walk(a) for a in e.args)
+            return False
+        return any(walk(e) for e in self.select_list) or \
+            any(walk(e) for e, _ in self.order_by)
 
     def to_single_stage(self) -> PinotQuery:
         """Lower a join-free query to the single-stage AST."""
@@ -67,17 +79,92 @@ class MseQuery:
             offset=self.offset, options=self.options, explain=self.explain)
 
 
+@dataclass
+class MseSetQuery:
+    """Compound query: UNION / INTERSECT / EXCEPT of two query trees.
+
+    Ref: Calcite SqlSetOperator -> LogicalUnion/Intersect/Minus (the
+    reference executes them in pinot-query-runtime
+    runtime/operator/SetOperator.java + Union/Intersect/MinusOperator).
+    ORDER BY / LIMIT parsed after the last operand bind to the compound.
+    """
+    op: str                     # union | intersect | except
+    all: bool
+    left: object                # MseQuery | MseSetQuery
+    right: object
+    order_by: List[Tuple[Expression, bool]] = field(default_factory=list)
+    limit: Optional[int] = None
+    offset: int = 0
+    options: Dict[str, str] = field(default_factory=dict)
+    explain: bool = False
+
+    @property
+    def is_single_table(self) -> bool:
+        return False
+
+
+def _combine(left, op: str, all_: bool, right,
+             hoist: bool) -> MseSetQuery:
+    """An UNPARENTHESIZED right operand's trailing ORDER BY/LIMIT/OPTION
+    syntactically belong to the compound — hoist them. A parenthesized
+    operand keeps its own (they bind inside the parens)."""
+    if not hoist:
+        return MseSetQuery(op=op, all=all_, left=left, right=right)
+    q = MseSetQuery(op=op, all=all_, left=left, right=right,
+                    order_by=list(right.order_by), limit=right.limit,
+                    offset=right.offset, options=dict(right.options))
+    right.order_by, right.limit, right.offset = [], None, 0
+    right.options = {}
+    return q
+
+
 _JOIN_KWS = ("JOIN", "INNER", "LEFT", "RIGHT", "FULL", "CROSS")
 
 
 class _MseParser(_Parser):
-    def parse_mse(self) -> MseQuery:
-        q = self._select_stmt()
+    def parse_mse(self):
+        q = self._set_expr()
         self.accept_op(";")
         t = self.peek()
         if t.kind != "end":
             raise SqlParseError(f"trailing input at {t.pos}: {t.text!r}")
         return q
+
+    # -- compound queries ---------------------------------------------------
+    def _set_expr(self):
+        left, _p = self._intersect_expr()
+        while True:
+            if self.accept_kw("UNION"):
+                op = "union"
+            elif self.accept_kw("EXCEPT"):
+                op = "except"
+            else:
+                return left
+            all_ = bool(self.accept_kw("ALL"))
+            self.accept_kw("DISTINCT")
+            right, parens = self._intersect_expr()
+            left = _combine(left, op, all_, right, hoist=not parens)
+
+    def _intersect_expr(self):
+        """Returns (query, last_operand_was_parenthesized)."""
+        left, parens = self._select_operand()
+        while self.accept_kw("INTERSECT"):
+            all_ = bool(self.accept_kw("ALL"))
+            self.accept_kw("DISTINCT")
+            right, parens = self._select_operand()
+            left = _combine(left, "intersect", all_, right,
+                            hoist=not parens)
+        return left, parens
+
+    def _select_operand(self):
+        """Returns (query, was_parenthesized)."""
+        if self.peek().kind == "op" and self.peek().text == "(" \
+                and self.peek(1).upper in ("SELECT", "SET", "EXPLAIN"):
+            self.next()
+            q = self._set_expr()
+            self.expect_op(")")
+            return q, True
+        return self._select_stmt(), False
 
     def _select_stmt(self) -> MseQuery:
         q = MseQuery()
@@ -151,9 +238,32 @@ class _MseParser(_Parser):
                 return kw.lower() if kw != "INNER" else "inner"
         return None
 
+    def _call(self, name: str) -> Expression:
+        """Extend the base call grammar with the window suffix:
+        fn(args) OVER (PARTITION BY e,... ORDER BY e [ASC|DESC],...).
+        Encoded as over(fn, __partition(p...), __orderby(asc(k)|desc(k)...))
+        so the node stays a plain hashable expression tree."""
+        from pinot_tpu.query.expressions import func
+        e = super()._call(name)
+        if self.accept_kw("OVER"):
+            self.expect_op("(")
+            parts: List[Expression] = []
+            okeys: List[Expression] = []
+            if self.accept_kw("PARTITION"):
+                self.expect_kw("BY")
+                parts = self._expr_list()
+            if self.accept_kw("ORDER"):
+                self.expect_kw("BY")
+                for k, asc in self._order_list():
+                    okeys.append(func("asc" if asc else "desc", k))
+            self.expect_op(")")
+            e = func("over", e, func("__partition", *parts),
+                     func("__orderby", *okeys))
+        return e
+
     def _from_item(self) -> FromItem:
         if self.accept_op("("):
-            sub = self._select_stmt()
+            sub = self._set_expr()
             self.expect_op(")")
             self.accept_kw("AS")
             alias = self._name_text(self.next())
@@ -174,9 +284,11 @@ class _MseParser(_Parser):
 _RESERVED_AFTER_TABLE = {
     "JOIN", "INNER", "LEFT", "RIGHT", "FULL", "CROSS", "ON", "WHERE",
     "GROUP", "HAVING", "ORDER", "LIMIT", "OFFSET", "OPTION", "AS", "UNION",
+    "INTERSECT", "EXCEPT",
 }
 
 
-def parse_mse_sql(sql: str) -> MseQuery:
-    """Parse multi-stage SQL (joins, derived tables) into an MseQuery."""
+def parse_mse_sql(sql: str):
+    """Parse multi-stage SQL (joins, derived tables, set ops, window
+    functions) into an MseQuery or MseSetQuery."""
     return _MseParser(tokenize(sql)).parse_mse()

@@ -653,16 +653,12 @@ class TpuOperatorExecutor:
         while self._cache_bytes > self.cache_budget_bytes and len(self._block_cache) > 1:
             old_key, (_segs, old_arr) = self._block_cache.popitem(last=False)
             self._cache_bytes -= self._block_bytes.pop(old_key)
-            if self._inflight == 0:
-                # nothing dispatched outside the lock: free HBM eagerly
-                try:
-                    old_arr.delete()
-                except Exception:  # noqa: BLE001 — best-effort
-                    pass
-            else:
-                # a concurrent query may hold this block as a kernel
-                # input; defer the delete until in-flight drains to zero
-                self._evicted_pending.append(old_arr)
+            # never .delete() here: the CURRENT query may have staged this
+            # block for its own kernel inputs (staging runs before its
+            # in-flight increment), and concurrent dispatches may hold it
+            # too — the post-dispatch drain frees pending evictions once
+            # in-flight reaches zero
+            self._evicted_pending.append(old_arr)
 
     def _check_value_precision(self, segments, col: str, vdt) -> None:
         """float32 staging (x64 off, the TPU default) is exact only for

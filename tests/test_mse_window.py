@@ -1,0 +1,264 @@
+"""MSE window functions + set operators.
+
+Ref semantics: pinot-query-runtime runtime/operator/WindowAggregateOperator
+(rank/value/aggregate window families, default RANGE frame with peers) and
+SetOperator.java (UNION/INTERSECT/EXCEPT incl. ALL multiset semantics).
+"""
+import numpy as np
+import pytest
+
+from pinot_tpu.mse.blocks import Block
+from pinot_tpu.mse.operators import set_op_block, window_block
+from pinot_tpu.query.expressions import func, ident, lit
+from test_mse import mse  # noqa: F401 — shared distributed-harness fixture
+
+
+def _over(inner, partition=(), order=()):
+    return func("over", inner, func("__partition", *partition),
+                func("__orderby", *order))
+
+
+class TestWindowBlock:
+    def _block(self):
+        return Block(["g", "v"], [
+            np.array([1, 1, 1, 2, 2, 3], np.int64),
+            np.array([10, 20, 20, 5, 7, 9], np.int64)])
+
+    def test_row_number(self):
+        b = self._block()
+        out = window_block(
+            b, [ident("g")], [ident("v")], [True],
+            [_over(func("row_number"))], ["g", "v", "rn"])
+        assert out.arrays[2].tolist() == [1, 2, 3, 1, 2, 1]
+
+    def test_rank_vs_dense_rank_with_ties(self):
+        b = self._block()
+        over_r = _over(func("rank"))
+        over_d = _over(func("dense_rank"))
+        out = window_block(
+            b, [ident("g")], [ident("v")], [True], [over_r, over_d],
+            ["g", "v", "r", "d"])
+        assert out.arrays[2].tolist() == [1, 2, 2, 1, 2, 1]
+        assert out.arrays[3].tolist() == [1, 2, 2, 1, 2, 1]
+
+    def test_running_sum_includes_peers(self):
+        """RANGE frame: tied order keys aggregate together."""
+        b = self._block()
+        out = window_block(
+            b, [ident("g")], [ident("v")], [True],
+            [_over(func("sum", ident("v")))], ["g", "v", "s"])
+        # g=1 sorted v=[10,20,20]: run=[10,50,50] (peers share the frame)
+        assert out.arrays[2].tolist() == [10.0, 50.0, 50.0, 5.0, 12.0, 9.0]
+
+    def test_partition_total_without_order(self):
+        b = self._block()
+        out = window_block(
+            b, [ident("g")], [], [],
+            [_over(func("sum", ident("v"))), _over(func("count", ident("*"))),
+             _over(func("min", ident("v"))), _over(func("max", ident("v"))),
+             _over(func("avg", ident("v")))],
+            ["g", "v", "s", "c", "mn", "mx", "a"])
+        assert out.arrays[2].tolist() == [50.0, 50, 50, 12, 12, 9]
+        assert out.arrays[3].tolist() == [3, 3, 3, 2, 2, 1]
+        assert out.arrays[4].tolist() == [10, 10, 10, 5, 5, 9]
+        assert out.arrays[5].tolist() == [20, 20, 20, 7, 7, 9]
+        assert out.arrays[6].tolist() == pytest.approx(
+            [50 / 3, 50 / 3, 50 / 3, 6, 6, 9])
+
+    def test_global_window_no_partition(self):
+        b = self._block()
+        out = window_block(
+            b, [], [ident("v")], [True],
+            [_over(func("rank"))], ["g", "v", "r"])
+        # global ranks of v=[10,20,20,5,7,9] -> [4,5,5,1,2,3]
+        assert out.arrays[2].tolist() == [4, 5, 5, 1, 2, 3]
+
+    def test_lag_lead(self):
+        b = self._block()
+        out = window_block(
+            b, [ident("g")], [ident("v")], [True],
+            [_over(func("lag", ident("v"))),
+             _over(func("lead", ident("v"), lit(1), lit(-1)))],
+            ["g", "v", "lg", "ld"])
+        assert out.arrays[2].tolist() == [None, 10, 20, None, 5, None]
+        assert out.arrays[3].tolist() == [20, 20, -1, 7, -1, -1]
+
+    def test_first_last_value_frame(self):
+        b = self._block()
+        out = window_block(
+            b, [ident("g")], [ident("v")], [True],
+            [_over(func("first_value", ident("v"))),
+             _over(func("last_value", ident("v")))],
+            ["g", "v", "f", "l"])
+        assert out.arrays[2].tolist() == [10, 10, 10, 5, 5, 9]
+        # last_value default frame ends at the CURRENT peer group
+        assert out.arrays[3].tolist() == [10, 20, 20, 5, 7, 9]
+
+    def test_ntile(self):
+        b = Block(["v"], [np.arange(6, dtype=np.int64)])
+        out = window_block(
+            b, [], [ident("v")], [True],
+            [_over(func("ntile", lit(3)))], ["v", "t"])
+        assert out.arrays[1].tolist() == [1, 1, 2, 2, 3, 3]
+
+    def test_desc_order(self):
+        b = self._block()
+        out = window_block(
+            b, [ident("g")], [ident("v")], [False],
+            [_over(func("row_number"))], ["g", "v", "rn"])
+        assert out.arrays[2].tolist() == [3, 1, 2, 2, 1, 1]
+
+    def test_empty_block(self):
+        b = Block(["g", "v"], [np.empty(0, np.int64), np.empty(0, np.int64)])
+        out = window_block(b, [ident("g")], [], [],
+                           [_over(func("sum", ident("v")))], ["g", "v", "s"])
+        assert out.num_rows == 0 and len(out.arrays) == 3
+
+
+class TestCompoundParsing:
+    def test_trailing_clauses_hoist_to_compound(self):
+        from pinot_tpu.mse.sql import parse_mse_sql
+        q = parse_mse_sql("SELECT a FROM t UNION SELECT a FROM u "
+                          "ORDER BY a LIMIT 5")
+        assert q.op == "union" and not q.all
+        assert q.limit == 5 and len(q.order_by) == 1
+        assert q.right.limit is None and not q.right.order_by
+
+    def test_parenthesized_operand_keeps_its_clauses(self):
+        from pinot_tpu.mse.sql import parse_mse_sql
+        q = parse_mse_sql("SELECT a FROM t UNION ALL "
+                          "(SELECT a FROM u ORDER BY a LIMIT 1)")
+        assert q.op == "union" and q.all
+        assert q.limit is None and not q.order_by
+        assert q.right.limit == 1 and len(q.right.order_by) == 1
+
+    def test_intersect_binds_tighter(self):
+        from pinot_tpu.mse.sql import parse_mse_sql
+        q = parse_mse_sql("SELECT a FROM t UNION SELECT a FROM u "
+                          "INTERSECT SELECT a FROM v")
+        assert q.op == "union"
+        assert q.right.op == "intersect"
+
+    def test_order_by_window_not_single_table(self):
+        from pinot_tpu.mse.sql import parse_mse_sql
+        q = parse_mse_sql("SELECT x.a FROM t x "
+                          "ORDER BY ROW_NUMBER() OVER (ORDER BY x.a)")
+        assert not q.is_single_table
+
+
+class TestSetOpBlock:
+    def _sides(self):
+        left = Block(["a", "b"], [
+            np.array([1, 1, 2, 3, 3, 3], np.int64),
+            np.array([1, 1, 2, 3, 3, 3], np.int64)])
+        right = Block(["x", "y"], [
+            np.array([1, 3, 3, 4], np.int64),
+            np.array([1, 3, 3, 4], np.int64)])
+        return left, right
+
+    def _rows(self, b):
+        return sorted(tuple(int(v) for v in r) for r in zip(
+            *[a.tolist() for a in b.arrays]))
+
+    def test_union_distinct_and_all(self):
+        left, right = self._sides()
+        u = set_op_block(left, right, "union", False, ["a", "b"])
+        assert self._rows(u) == [(1, 1), (2, 2), (3, 3), (4, 4)]
+        ua = set_op_block(left, right, "union", True, ["a", "b"])
+        assert len(self._rows(ua)) == 10
+
+    def test_intersect(self):
+        left, right = self._sides()
+        i = set_op_block(left, right, "intersect", False, ["a", "b"])
+        assert self._rows(i) == [(1, 1), (3, 3)]
+        ia = set_op_block(left, right, "intersect", True, ["a", "b"])
+        # multiset min counts: 1x1 appears min(2,1)=1, 3x3 min(3,2)=2
+        assert self._rows(ia) == [(1, 1), (3, 3), (3, 3)]
+
+    def test_except(self):
+        left, right = self._sides()
+        e = set_op_block(left, right, "except", False, ["a", "b"])
+        assert self._rows(e) == [(2, 2)]
+        ea = set_op_block(left, right, "except", True, ["a", "b"])
+        # multiset difference: 1 appears 2-1=1, 3 appears 3-2=1
+        assert self._rows(ea) == [(1, 1), (2, 2), (3, 3)]
+
+    def test_empty_sides(self):
+        left, right = self._sides()
+        empty = Block(["x", "y"], [np.empty(0, np.int64),
+                                   np.empty(0, np.int64)])
+        assert self._rows(set_op_block(
+            left, empty, "except", False, ["a", "b"])) == \
+            [(1, 1), (2, 2), (3, 3)]
+        assert self._rows(set_op_block(
+            empty.rename(["a", "b"]), right, "intersect", False,
+            ["a", "b"])) == []
+
+
+# ---------------------------------------------------------------------------
+# end-to-end through the distributed MSE harness
+# ---------------------------------------------------------------------------
+
+class TestDistributedWindowSetOps:
+    def test_window_sql(self, mse):
+        disp, t = mse
+        resp = disp.submit(
+            "SELECT lo.lo_suppkey, lo.lo_revenue, "
+            "RANK() OVER (PARTITION BY lo.lo_suppkey "
+            "ORDER BY lo.lo_revenue DESC) AS r "
+            "FROM lineorder lo WHERE lo.lo_orderkey < 50 "
+            "ORDER BY lo.lo_suppkey, r, lo.lo_revenue LIMIT 2000")
+        assert not resp.exceptions, resp.exceptions
+        lo = t["lineorder"]
+        sel = lo["lo_orderkey"] < 50
+        rows = list(zip(lo["lo_suppkey"][sel], lo["lo_revenue"][sel]))
+        want = []
+        for sk, rev in rows:
+            rank = 1 + sum(1 for s2, r2 in rows if s2 == sk and r2 > rev)
+            want.append((int(sk), int(rev), rank))
+        want.sort()
+        got = sorted((int(a), int(b), int(c))
+                     for a, b, c in resp.result_table.rows)
+        assert got == want
+
+    def test_window_sum_over_group_output(self, mse):
+        disp, t = mse
+        resp = disp.submit(
+            "SELECT lo.lo_suppkey, SUM(lo.lo_revenue) AS rev, "
+            "SUM(SUM(lo.lo_revenue)) OVER () AS total "
+            "FROM lineorder lo GROUP BY lo.lo_suppkey "
+            "ORDER BY lo.lo_suppkey LIMIT 100")
+        assert not resp.exceptions, resp.exceptions
+        lo = t["lineorder"]
+        total = int(lo["lo_revenue"].sum())
+        for _sk, _rev, tot in resp.result_table.rows:
+            assert int(tot) == total
+
+    def test_union_sql(self, mse):
+        disp, t = mse
+        resp = disp.submit(
+            "SELECT lo.lo_suppkey FROM lineorder lo WHERE lo.lo_suppkey < 4 "
+            "UNION "
+            "SELECT lo.lo_suppkey FROM lineorder lo "
+            "WHERE lo.lo_suppkey BETWEEN 2 AND 6 "
+            "ORDER BY lo_suppkey LIMIT 100")
+        assert not resp.exceptions, resp.exceptions
+        got = [int(r[0]) for r in resp.result_table.rows]
+        assert got == [0, 1, 2, 3, 4, 5, 6]
+
+    def test_intersect_except_sql(self, mse):
+        disp, t = mse
+        resp = disp.submit(
+            "SELECT lo.lo_suppkey FROM lineorder lo WHERE lo.lo_suppkey < 4 "
+            "INTERSECT "
+            "SELECT lo.lo_suppkey FROM lineorder lo "
+            "WHERE lo.lo_suppkey BETWEEN 2 AND 6 LIMIT 100")
+        assert not resp.exceptions, resp.exceptions
+        assert sorted(int(r[0]) for r in resp.result_table.rows) == [2, 3]
+        resp = disp.submit(
+            "SELECT lo.lo_suppkey FROM lineorder lo WHERE lo.lo_suppkey < 4 "
+            "EXCEPT "
+            "SELECT lo.lo_suppkey FROM lineorder lo "
+            "WHERE lo.lo_suppkey BETWEEN 2 AND 6 LIMIT 100")
+        assert not resp.exceptions, resp.exceptions
+        assert sorted(int(r[0]) for r in resp.result_table.rows) == [0, 1]
