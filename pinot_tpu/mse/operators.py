@@ -10,7 +10,7 @@ kernels use, so hot intermediate ops can later migrate onto the chip.
 from __future__ import annotations
 
 import zlib
-from typing import List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -380,25 +380,29 @@ def final_merge_block(block: Block, num_group_cols: int,
 # sort / limit (ref SortOperator.java)
 # ---------------------------------------------------------------------------
 
+def _sort_key_encode(c: np.ndarray, asc: bool) -> np.ndarray:
+    """Encode one sort-key column for np.lexsort honoring direction."""
+    if c.dtype.kind == "O":
+        c = _as_str(c)
+    if not asc:
+        if c.dtype.kind in "US":
+            # lexsort has no descending option for strings: rank them
+            _, inv = np.unique(c, return_inverse=True)
+            c = -inv
+        elif c.dtype.kind in "iu":
+            # negate as int64: the float64 detour aliases above 2^53
+            c = -c.astype(np.int64, copy=False)
+        else:
+            c = -c.astype(np.float64, copy=False)
+    return c
+
+
 def sort_block(block: Block, keys: Sequence[Expression], ascs: Sequence[bool],
                limit: int, offset: int) -> Block:
     if keys and block.num_rows > 1:
-        cols = []
-        for e, asc in zip(reversed(list(keys)), reversed(list(ascs))):
-            c = eval_expr(e, block)
-            if c.dtype.kind == "O":
-                c = _as_str(c)
-            if not asc:
-                if c.dtype.kind in "US":
-                    # lexsort has no descending option for strings: rank them
-                    _, inv = np.unique(c, return_inverse=True)
-                    c = -inv
-                elif c.dtype.kind in "iu":
-                    # negate as int64: the float64 detour aliases above 2^53
-                    c = -c.astype(np.int64, copy=False)
-                else:
-                    c = -c.astype(np.float64, copy=False)
-            cols.append(c)
+        cols = [_sort_key_encode(eval_expr(e, block), asc)
+                for e, asc in zip(reversed(list(keys)),
+                                  reversed(list(ascs)))]
         idx = np.lexsort(cols)
         block = block.take(idx)
     if offset:
@@ -459,19 +463,8 @@ def window_block(block: Block, partition: Sequence[Expression],
         ocodes = np.zeros(n, np.int64)
 
     # sort: partition primary, then order keys with direction
-    sort_cols = []
-    for c, asc in zip(reversed(okey_vals), reversed(list(ascs))):
-        if c.dtype.kind == "O":
-            c = _as_str(c)
-        if not asc:
-            if c.dtype.kind in "US":
-                _, inv = np.unique(c, return_inverse=True)
-                c = -inv
-            elif c.dtype.kind in "iu":
-                c = -c.astype(np.int64, copy=False)
-            else:
-                c = -c.astype(np.float64, copy=False)
-        sort_cols.append(c)
+    sort_cols = [_sort_key_encode(c, asc)
+                 for c, asc in zip(reversed(okey_vals), reversed(list(ascs)))]
     sort_cols.append(pcodes)
     idx = np.lexsort(sort_cols) if len(sort_cols) > 1 \
         else np.argsort(pcodes, kind="stable")
@@ -493,6 +486,15 @@ def window_block(block: Block, partition: Sequence[Expression],
 
     framed_end = peer_end if order_keys else part_end
 
+    arg_cache: Dict[Expression, np.ndarray] = {}
+
+    def sorted_arg(e: Expression) -> np.ndarray:
+        got = arg_cache.get(e)
+        if got is None:
+            got = eval_expr(e, block)[idx]
+            arg_cache[e] = got
+        return got
+
     out_cols: List[np.ndarray] = []
     for over in over_nodes:
         inner = over.args[0]
@@ -512,7 +514,7 @@ def window_block(block: Block, partition: Sequence[Expression],
             rel = pos - part_start
             res = (rel * buckets // size + 1).astype(np.int64)
         elif name in ("lag", "lead"):
-            vals = eval_expr(inner.args[0], block)[idx]
+            vals = sorted_arg(inner.args[0])
             off = int(_literal_arg(inner, 1, default=1))
             default = _literal_arg(inner, 2, default=None)
             if name == "lag":
@@ -526,20 +528,15 @@ def window_block(block: Block, partition: Sequence[Expression],
             res[ok] = vals[src[ok]]
             res[~ok] = default
         elif name == "first_value":
-            vals = eval_expr(inner.args[0], block)[idx]
-            res = vals[part_start]
+            res = sorted_arg(inner.args[0])[part_start]
         elif name == "last_value":
-            vals = eval_expr(inner.args[0], block)[idx]
-            res = vals[framed_end]
+            res = sorted_arg(inner.args[0])[framed_end]
         elif name in ("sum", "count", "avg", "min", "max"):
-            star = (inner.args and isinstance(inner.args[0], Identifier)
-                    and inner.args[0].name == "*") or not inner.args
-            vals = None if star else eval_expr(inner.args[0], block)[idx]
             cnt_run = (pos - part_start + 1).astype(np.float64)
             if name == "count":
                 res = cnt_run[framed_end].astype(np.int64)
             else:
-                v = vals.astype(np.float64, copy=False)
+                v = sorted_arg(inner.args[0]).astype(np.float64, copy=False)
                 if name in ("sum", "avg"):
                     cum = np.cumsum(v)
                     base = cum[part_start] - v[part_start]

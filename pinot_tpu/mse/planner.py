@@ -179,15 +179,24 @@ class _Fragmenter:
             return s
 
         if isinstance(node, L.SetOp):
-            # hash both inputs on ALL columns: equal rows meet on one
-            # worker, so per-worker set semantics compose globally
+            # hash both inputs on ALL columns so equal rows meet on one
+            # worker and per-worker set semantics compose globally. Hash
+            # keys must resolve POSITIONALLY (duplicate output names would
+            # alias to one column), so each side renames to __setN first.
+            # UNION ALL needs no co-location at all — one-column hash
+            # keeps the distribution without hashing every column.
             s = self.new_stage(list(self.intermediate))
             left = self.fragment_to_stage(node.left)
             right = self.fragment_to_stage(node.right)
-            self._connect(left, s,
-                          [["id", n] for n in node.left.schema])
-            self._connect(right, s,
-                          [["id", n] for n in node.right.schema])
+            pos = [f"__set{i}" for i in range(len(node.left.schema))]
+            for side in (left, right):
+                side.root = {"op": "rename", "child": side.root,
+                             "schema": pos}
+                side.schema = pos
+            keys = [["id", pos[0]]] if node.op == "union" and node.all \
+                else [["id", n] for n in pos]
+            self._connect(left, s, keys)
+            self._connect(right, s, keys)
             s.root = {"op": "setop", "kind": node.op, "all": node.all,
                       "left": _receive(left), "right": _receive(right),
                       "schema": node.schema}

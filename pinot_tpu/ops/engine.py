@@ -119,9 +119,14 @@ class TpuOperatorExecutor:
     # ------------------------------------------------------------------
     # capability check (structural)
     # ------------------------------------------------------------------
+    #: cap on selection/order-by top-K offload (limit + offset)
+    TOPN_MAX_K = 8192
+
     def supports(self, ctx: QueryContext) -> bool:
-        if not ctx.aggregations or ctx.distinct:
-            return False
+        if ctx.distinct:
+            return self._supports_distinct(ctx)
+        if not ctx.aggregations:
+            return self._supports_selection(ctx)
         for f in ctx.agg_filters:
             # FILTER (WHERE ...) aggs offload as per-slot masks when the
             # condition has a device filter shape
@@ -138,6 +143,42 @@ class TpuOperatorExecutor:
                 return False
         for g in ctx.group_by:
             if not isinstance(g, Identifier):
+                return False
+        if ctx.filter is not None and not self._filter_shape_ok(ctx.filter):
+            return False
+        return True
+
+    def _supports_distinct(self, ctx: QueryContext) -> bool:
+        """DISTINCT over dict columns rides the group-by kernel (a
+        presence-only group-by); detailed stagability checks happen in
+        _plan with segment metadata in hand."""
+        if not ctx.select or ctx.aggregations:
+            return False
+        for e in ctx.select:
+            if not isinstance(e, Identifier) or e.name == "*":
+                return False
+        if ctx.filter is not None and not self._filter_shape_ok(ctx.filter):
+            return False
+        return True
+
+    def _supports_selection(self, ctx: QueryContext) -> bool:
+        """Selection (+ at most one ORDER BY key) offloads as a device
+        top-K over the order value: only winning docs are materialized
+        (ref SelectionOrderByOperator / MinMaxValueBasedSelection
+        OrderByCombineOperator)."""
+        if ctx.distinct or ctx.aggregations:
+            return False
+        if len(ctx.order_by) > 1:
+            return False
+        if ctx.filter is None and not ctx.order_by:
+            return False  # LIMIT-only: host early-exit is already O(K)
+        k = ctx.limit + ctx.offset
+        if k <= 0 or k > self.TOPN_MAX_K:
+            return False
+        if ctx.order_by:
+            e, _asc = ctx.order_by[0]
+            if not (isinstance(e, Identifier)
+                    or self._value_ir_shape(e) is not None):
                 return False
         if ctx.filter is not None and not self._filter_shape_ok(ctx.filter):
             return False
@@ -184,6 +225,10 @@ class TpuOperatorExecutor:
         so N server threads overlap their round trips on the async device
         queue instead of serializing behind one ~100ms sync each.
         """
+        if ctx.distinct:
+            return self._execute_distinct(segments, ctx)
+        if not ctx.aggregations:
+            return self._execute_topn(segments, ctx)
         with self._engine_lock:
             plan_info = self._plan(segments, ctx)
             if plan_info is None:
@@ -202,24 +247,69 @@ class TpuOperatorExecutor:
         try:
             packed = np.asarray(kernel(cols, params, num_docs, D=D))
         finally:
-            with self._engine_lock:
-                self._inflight -= 1
-                if self._inflight == 0 and self._evicted_pending:
-                    # no dispatch holds the evicted blocks anymore:
-                    # free their HBM eagerly instead of waiting on GC
-                    for arr in self._evicted_pending:
-                        try:
-                            arr.delete()
-                        except Exception:  # noqa: BLE001 — best-effort
-                            pass
-                    self._evicted_pending.clear()
+            self._drain_one()
         results = self._assemble(segments, ctx, plan, packed, S_real, slots_of_fn)
         return results, []
 
+    def _drain_one(self) -> None:
+        """Retire one in-flight dispatch; at zero, free pending evictions
+        (no kernel holds the evicted blocks anymore)."""
+        with self._engine_lock:
+            self._inflight -= 1
+            if self._inflight == 0 and self._evicted_pending:
+                for arr in self._evicted_pending:
+                    try:
+                        arr.delete()
+                    except Exception:  # noqa: BLE001 — best-effort
+                        pass
+                self._evicted_pending.clear()
+
     # ------------------------------------------------------------------
-    def _plan(self, segments, ctx: QueryContext):
-        """Build the DevicePlan from the query + first segment's schema."""
-        seg0 = segments[0]
+    def _execute_distinct(self, segments, ctx: QueryContext):
+        """DISTINCT d1..dk = a presence-only GROUP BY d1..dk: reuse the
+        whole group-by kernel path and convert keys to DistinctResult rows
+        (ref DistinctOperator; dictionary-based distinct)."""
+        sel = list(ctx.select)
+        gctx = QueryContext(
+            table=ctx.table, select=sel + [Function("count",
+                                                    (Identifier("*"),))],
+            aliases=[None] * (len(sel) + 1), distinct=False,
+            filter=ctx.filter, group_by=sel, having=None, order_by=[],
+            limit=ctx.limit, offset=0, options=dict(ctx.options))
+        gctx._extract_aggregations()
+        results, remaining = self.execute(segments, gctx)
+        from pinot_tpu.query.results import DistinctResult
+        out = [DistinctResult(set(r.groups.keys()), r.stats)
+               for r in results]
+        return out, remaining
+
+    # ------------------------------------------------------------------
+    def _execute_topn(self, segments, ctx: QueryContext):
+        if self._doc_axis > 1:
+            return [], segments  # top-K across doc shards: host path
+        with self._engine_lock:
+            plan = self._plan_topn(segments, ctx)
+            if plan is None:
+                return [], segments
+            try:
+                cols, params, num_docs, S_real, D = self._stage(
+                    segments, ctx, plan)
+            except _NotStageable:
+                return [], segments
+            kernel = kernels.compiled_topn_kernel(plan)
+            self._inflight += 1
+        try:
+            packed = np.asarray(kernel(cols, params, num_docs, D=D))
+        finally:
+            self._drain_one()
+        return self._assemble_topn(segments, ctx, packed, S_real), []
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _make_classifier(seg0):
+        """Column stagability test; records dict/raw membership as a side
+        effect (ids usable for filters/group-by regardless of value type;
+        value math additionally needs a numeric dictionary)."""
         dict_cols: set = set()
         raw_cols: set = set()
 
@@ -230,14 +320,19 @@ class TpuOperatorExecutor:
             if not m.single_value:
                 return False
             if m.has_dictionary:
-                # ids usable for filters/group-by regardless of value type;
-                # value math additionally needs a numeric dictionary
                 dict_cols.add(col)
                 return True
             if m.data_type.np_dtype.kind in "iuf":
                 raw_cols.add(col)
                 return True
             return False
+
+        return classify, dict_cols, raw_cols
+
+    def _plan(self, segments, ctx: QueryContext):
+        """Build the DevicePlan from the query + first segment's schema."""
+        seg0 = segments[0]
+        classify, dict_cols, raw_cols = self._make_classifier(seg0)
 
         # value IRs for aggregation inputs
         value_irs: List[Optional[tuple]] = []
@@ -376,6 +471,96 @@ class TpuOperatorExecutor:
             raw64_cols=tuple(sorted(raw64)),
         )
         return plan, slots_of_fn
+
+    def _plan_topn(self, segments, ctx: QueryContext) -> Optional[DevicePlan]:
+        """DevicePlan for selection / single-key order-by top-K."""
+        seg0 = segments[0]
+        classify, dict_cols, raw_cols = self._make_classifier(seg0)
+        k = ctx.limit + ctx.offset
+        if k <= 0 or k > self.TOPN_MAX_K:
+            return None
+
+        leaves: List[DeviceLeaf] = []
+        filter_ir = None
+        if ctx.filter is not None:
+            filter_ir = self._build_filter_ir(ctx.filter, segments, leaves,
+                                              classify)
+            if filter_ir is None:
+                return None
+        raw64 = {lf.column for lf in leaves if lf.kind == "vrange64"}
+
+        value_irs: Tuple[Optional[tuple], ...] = ()
+        topn_asc = True
+        if ctx.order_by:
+            e, topn_asc = ctx.order_by[0]
+            ir = None
+            if isinstance(e, Identifier) and classify(e.name):
+                m = seg0.metadata.columns[e.name]
+                if m.has_dictionary:
+                    # dictionaries are value-sorted: dictId order IS value
+                    # order, and ids stay exact in f32 below 2^24
+                    if max(s.metadata.columns[e.name].cardinality
+                           for s in segments) >= (1 << 24):
+                        return None
+                    ir = ("ids", e.name)
+                elif e.name not in raw64:
+                    ir = ("col", e.name)
+            elif isinstance(e, Function):
+                ir = self._value_ir_shape(e)
+                if ir is not None:
+                    for col in self._ir_cols(ir):
+                        if col in raw64 or not classify(col):
+                            return None
+                        mc = seg0.metadata.columns[col]
+                        if mc.data_type.np_dtype.kind not in "iuf":
+                            return None
+            if ir is None:
+                return None
+            value_irs = (ir,)
+
+        return DevicePlan(
+            filter_ir=filter_ir,
+            leaves=tuple(leaves),
+            value_irs=value_irs,
+            agg_ops=(),
+            dict_cols=tuple(sorted(dict_cols)),
+            raw_cols=tuple(sorted(raw_cols - raw64)),
+            raw64_cols=tuple(sorted(raw64)),
+            mode="topn", topn_k=k, topn_asc=bool(topn_asc))
+
+    def _assemble_topn(self, segments, ctx: QueryContext,
+                       packed: np.ndarray, S_real: int) -> List[Any]:
+        """packed [S, 1+K] int32 -> SelectionResults: project ONLY the
+        winning docs host-side (incl. '*' and string columns)."""
+        from pinot_tpu.query.executor_cpu import _project_rows, expand_star
+        from pinot_tpu.query.filter import SegmentColumnProvider
+        from pinot_tpu.query.results import SelectionResult
+        filter_cols = len(set(ctx.filter_columns()))
+        results = []
+        for s, seg in enumerate(segments[:S_real]):
+            matched = int(packed[s, 0])
+            idx = packed[s, 1:]
+            idx = idx[(idx >= 0) & (idx < seg.num_docs)].astype(np.int64)
+            provider = SegmentColumnProvider(seg)
+            rows = _project_rows(seg, ctx.select, provider, idx)
+            order_values = None
+            if ctx.order_by:
+                order_values = _project_rows(
+                    seg, [e for e, _ in ctx.order_by], provider, idx)
+            stats = ExecutionStats(
+                num_docs_scanned=matched,
+                num_entries_scanned_in_filter=(
+                    seg.num_docs * filter_cols
+                    if ctx.filter is not None else 0),
+                num_entries_scanned_post_filter=len(idx) * max(
+                    len(ctx.select), 1),
+                num_segments_processed=1,
+                num_segments_matched=1 if matched else 0,
+                total_docs=seg.num_docs)
+            results.append(SelectionResult(
+                rows, order_values=order_values,
+                columns=expand_star(seg, ctx), stats=stats))
+        return results
 
     def _build_filter_ir(self, e: Function, segments, leaves, classify):
         seg0 = segments[0]

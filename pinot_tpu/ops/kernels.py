@@ -282,6 +282,52 @@ def make_kernel(plan: DevicePlan):
     return kernel
 
 
+def make_topn_kernel(plan: DevicePlan):
+    """Selection / selection-order-by kernel (ref
+    operator/query/SelectionOrderByOperator + the min/max-based combine):
+    per segment, the top-K doc indices by the order value (value_irs[0];
+    ascending negates), or the first K matching docs when unordered.
+
+    Output [S, 1 + K] int32: col 0 = matched doc count, cols 1.. = doc
+    indices (-1 = no more matches). The host projects ONLY the winning
+    docs — a large filtered SELECT never materializes losing rows.
+    """
+
+    def kernel(cols, params, num_docs, D):
+        valid = jnp.arange(D, dtype=jnp.int32)[None, :] < num_docs[:, None]
+        if plan.filter_ir is not None:
+            mask = _eval_filter(plan.filter_ir, plan, cols, params) & valid
+        else:
+            mask = valid
+        dt = _value_dtype()
+        if plan.value_irs:
+            v = _eval_value(plan.value_irs[0], cols, params).astype(dt)
+            score = -v if plan.topn_asc else v
+            # tie-break toward lower doc ids so results are stable
+        else:
+            score = jnp.broadcast_to(
+                -jnp.arange(D, dtype=dt)[None, :], mask.shape)
+        # clamp matched scores to the finite range so a legitimate -inf
+        # score (f32 overflow of huge values, or a real +/-inf column
+        # value under ASC negation) still outranks every unmatched doc's
+        # -inf sentinel; validity then reads the MASK at the winning docs
+        fin = jnp.finfo(dt)
+        score = jnp.where(mask, jnp.clip(score, fin.min, fin.max), -jnp.inf)
+        k = min(plan.topn_k, D)
+        _top_vals, top_idx = jax.lax.top_k(score, k)
+        found = jnp.take_along_axis(mask, top_idx, axis=1)
+        idx_out = jnp.where(found, top_idx, -1).astype(jnp.int32)
+        matched = jnp.sum(mask, axis=1).astype(jnp.int32)
+        return jnp.concatenate([matched[:, None], idx_out], axis=1)
+
+    return kernel
+
+
+@functools.lru_cache(maxsize=256)
+def compiled_topn_kernel(plan: DevicePlan):
+    return jax.jit(make_topn_kernel(plan), static_argnames=("D",))
+
+
 @functools.lru_cache(maxsize=256)
 def compiled_kernel(plan: DevicePlan):
     """jit-compiled kernel for a plan structure (shape specialization is

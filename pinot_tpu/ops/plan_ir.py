@@ -60,3 +60,9 @@ class DevicePlan:
     raw_cols: Tuple[str, ...] = ()
     #: big-int columns staged as (hi, lo) i32 split planes, filter-only
     raw64_cols: Tuple[str, ...] = ()
+    #: 'agg' (default) | 'topn' — topn plans compute per-segment top-K doc
+    #: indices by value_irs[0] (or first-K matching when it is None) for
+    #: selection / selection-order-by offload
+    mode: str = "agg"
+    topn_k: int = 0
+    topn_asc: bool = True

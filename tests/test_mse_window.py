@@ -139,6 +139,28 @@ class TestCompoundParsing:
         assert q.op == "union"
         assert q.right.op == "intersect"
 
+    def test_compound_order_after_parenthesized_operand(self):
+        from pinot_tpu.mse.sql import parse_mse_sql
+        q = parse_mse_sql("SELECT a FROM t UNION (SELECT a FROM u) "
+                          "ORDER BY a LIMIT 5")
+        assert q.op == "union"
+        assert q.limit == 5 and len(q.order_by) == 1
+        assert q.right.limit is None and not q.right.order_by
+
+    def test_duplicate_output_names_setop(self, mse):
+        """Hash exchange must key on column POSITION: duplicate output
+        names would alias to one column and split equal rows."""
+        disp, t = mse
+        resp = disp.submit(
+            "SELECT lo.lo_suppkey, lo.lo_suppkey FROM lineorder lo "
+            "WHERE lo.lo_suppkey < 3 "
+            "INTERSECT "
+            "SELECT lo.lo_suppkey, lo.lo_suppkey FROM lineorder lo "
+            "WHERE lo.lo_suppkey < 5 LIMIT 100")
+        assert not resp.exceptions, resp.exceptions
+        got = sorted((int(a), int(b)) for a, b in resp.result_table.rows)
+        assert got == [(0, 0), (1, 1), (2, 2)]
+
     def test_order_by_window_not_single_table(self):
         from pinot_tpu.mse.sql import parse_mse_sql
         q = parse_mse_sql("SELECT x.a FROM t x "
