@@ -133,7 +133,6 @@ class _MseParser(_Parser):
     # -- compound queries ---------------------------------------------------
     def _set_expr(self):
         left, _p = self._intersect_expr()
-        combined = False
         while True:
             if self.accept_kw("UNION"):
                 op = "union"
@@ -141,38 +140,17 @@ class _MseParser(_Parser):
                 op = "except"
             else:
                 break
-            combined = True
             all_ = bool(self.accept_kw("ALL"))
             self.accept_kw("DISTINCT")
             right, parens = self._intersect_expr()
             left = _combine(left, op, all_, right, hoist=not parens)
-        if combined:
-            # a parenthesized last operand keeps its own clauses, so the
-            # compound's trailing ORDER BY/LIMIT/OPTION parse here
-            self._trailing_clauses(left)
+        if isinstance(left, MseSetQuery):
+            # any compound (UNION/EXCEPT here or INTERSECT below) whose
+            # last operand was parenthesized kept its clauses inside the
+            # parens — the compound's trailing ORDER BY/LIMIT/OPTION
+            # parse here (shared grammar with the single-stage tail)
+            self._tail_clauses(left)
         return left
-
-    def _trailing_clauses(self, q) -> None:
-        if self.accept_kw("ORDER"):
-            self.expect_kw("BY")
-            q.order_by = self._order_list()
-        if self.accept_kw("LIMIT"):
-            a = int(self._literal_text(self.next()))
-            if self.accept_op(","):
-                q.offset, q.limit = a, int(self._literal_text(self.next()))
-            else:
-                q.limit = a
-                if self.accept_kw("OFFSET"):
-                    q.offset = int(self._literal_text(self.next()))
-        if self.accept_kw("OPTION"):
-            self.expect_op("(")
-            while True:
-                key = self._name_text(self.next())
-                self.expect_op("=")
-                q.options[key] = self._literal_text(self.next())
-                if not self.accept_op(","):
-                    break
-            self.expect_op(")")
 
     def _intersect_expr(self):
         """Returns (query, last_operand_was_parenthesized)."""

@@ -103,14 +103,13 @@ class TpuOperatorExecutor:
         #: but kernel dispatch + result fetch run OUTSIDE it so concurrent
         #: queries overlap their device round trips (the host<->TPU link
         #: costs ~100ms per sync; overlapped, N queries share that latency).
-        #: Eviction drops cache references without an immediate .delete()
-        #: — in-flight dispatches keep their input buffers alive via
-        #: refcounts; once the in-flight count drains to zero, pending
-        #: evictions free HBM eagerly (so the budget is a real bound again
-        #: whenever the engine quiesces)
+        #: Eviction drops cache references WITHOUT .delete(): the staging
+        #: query itself and any concurrently dispatched kernels hold the
+        #: block as an input, and JAX refcounting frees the HBM as soon as
+        #: the last consumer finishes — an eager delete could invalidate a
+        #: buffer mid-flight, and a deferred-until-quiescent delete list
+        #: would pin evicted blocks forever under sustained pipelined load
         self._engine_lock = threading.RLock()
-        self._inflight = 0
-        self._evicted_pending: List[Any] = []
         #: resolved predicate parameter arrays per (batch, plan, filter) —
         #: repeat queries then cost zero host->device param uploads;
         #: bounded by simple size cap (entries are tiny)
@@ -235,7 +234,7 @@ class TpuOperatorExecutor:
                 return [], segments
             plan, slots_of_fn = plan_info
             try:
-                cols, params, num_docs, S_real, D = self._stage(
+                cols, params, num_docs, S_real, D, G = self._stage(
                     segments, ctx, plan)
             except _NotStageable:
                 return [], segments
@@ -243,26 +242,9 @@ class TpuOperatorExecutor:
                 kernel = kernels.compiled_sharded_kernel(plan, self._mesh)
             else:
                 kernel = kernels.compiled_kernel(plan)
-            self._inflight += 1
-        try:
-            packed = np.asarray(kernel(cols, params, num_docs, D=D))
-        finally:
-            self._drain_one()
+        packed = np.asarray(kernel(cols, params, num_docs, D=D, G=G))
         results = self._assemble(segments, ctx, plan, packed, S_real, slots_of_fn)
         return results, []
-
-    def _drain_one(self) -> None:
-        """Retire one in-flight dispatch; at zero, free pending evictions
-        (no kernel holds the evicted blocks anymore)."""
-        with self._engine_lock:
-            self._inflight -= 1
-            if self._inflight == 0 and self._evicted_pending:
-                for arr in self._evicted_pending:
-                    try:
-                        arr.delete()
-                    except Exception:  # noqa: BLE001 — best-effort
-                        pass
-                self._evicted_pending.clear()
 
     # ------------------------------------------------------------------
     def _execute_distinct(self, segments, ctx: QueryContext):
@@ -292,16 +274,12 @@ class TpuOperatorExecutor:
             if plan is None:
                 return [], segments
             try:
-                cols, params, num_docs, S_real, D = self._stage(
+                cols, params, num_docs, S_real, D, _G = self._stage(
                     segments, ctx, plan)
             except _NotStageable:
                 return [], segments
             kernel = kernels.compiled_topn_kernel(plan)
-            self._inflight += 1
-        try:
-            packed = np.asarray(kernel(cols, params, num_docs, D=D))
-        finally:
-            self._drain_one()
+        packed = np.asarray(kernel(cols, params, num_docs, D=D))
         return self._assemble_topn(segments, ctx, packed, S_real), []
 
     # ------------------------------------------------------------------
@@ -421,6 +399,7 @@ class TpuOperatorExecutor:
         group_cols: List[str] = []
         group_strides: List[int] = []
         num_groups = 0
+        group_compact = False
         if ctx.group_by:
             card_pads = []
             for g in ctx.group_by:
@@ -438,18 +417,24 @@ class TpuOperatorExecutor:
             for c in card_pads:
                 num_groups *= c
             if num_groups > MAX_DEVICE_GROUPS:
-                return None
-            # memory guard: the [S, G, slots] result buffer must stay sane
-            # (S as padded by _stage to a segments-axis multiple)
-            n_slots = len(agg_ops) + 1  # +1 for the guaranteed count slot
-            n = self._seg_axis if self._mesh is not None else 1
-            s_pad = ((len(segments) + n - 1) // n) * n
-            if s_pad * num_groups * n_slots * 8 > MAX_GROUP_RESULT_BYTES:
-                return None
-            stride = num_groups
-            for c in card_pads:
-                stride //= c
-                group_strides.append(stride)
+                # sparse key space: per-segment compacted keys replace the
+                # dense mixed-radix product (ref DictionaryBasedGroupKey
+                # Generator's map-based modes) — the OBSERVED distinct
+                # count is what matters, resolved at staging
+                group_compact = True
+                num_groups = 0
+            else:
+                # memory guard: the [S, G, slots] result buffer must stay
+                # sane (S as padded by _stage to a segments-axis multiple)
+                n_slots = len(agg_ops) + 1  # +1 guaranteed count slot
+                n = self._seg_axis if self._mesh is not None else 1
+                s_pad = ((len(segments) + n - 1) // n) * n
+                if s_pad * num_groups * n_slots * 8 > MAX_GROUP_RESULT_BYTES:
+                    return None
+                stride = num_groups
+                for c in card_pads:
+                    stride //= c
+                    group_strides.append(stride)
             # group-by always needs an unfiltered count slot to detect
             # present groups
             if ("count", None, None) not in slot_index:
@@ -457,6 +442,13 @@ class TpuOperatorExecutor:
                 agg_ops.append(("count", None, None))
 
         raw64 = {lf.column for lf in leaves if lf.kind == "vrange64"}
+        if group_compact:
+            # the gkey block replaces per-column id planes for group-only
+            # columns; keep ids only where filters/values still need them
+            needed = {lf.column for lf in leaves}
+            for ir in value_irs:
+                needed |= self._ir_cols(ir)
+            dict_cols -= set(group_cols) - needed
         plan = DevicePlan(
             filter_ir=filter_ir,
             leaves=tuple(leaves),
@@ -466,6 +458,7 @@ class TpuOperatorExecutor:
             group_cols=tuple(group_cols),
             group_strides=tuple(group_strides),
             num_groups=num_groups,
+            group_compact=group_compact,
             dict_cols=tuple(sorted(dict_cols)),
             raw_cols=tuple(sorted(raw_cols - raw64)),
             raw64_cols=tuple(sorted(raw64)),
@@ -681,6 +674,10 @@ class TpuOperatorExecutor:
             cols["val:" + col] = self._stacked(
                 segments, S, D, col, "val", fetch_values, vdt)
 
+        G = 0
+        if plan.group_compact:
+            cols["gkey"], G = self._stage_gkey(segments, S, D, plan)
+
         # per-leaf predicate parameters (cached: filters are frozen
         # expression trees, so they key the resolved literals exactly)
         pkey = (_batch_id(segments), plan, ctx.filter,
@@ -692,7 +689,7 @@ class TpuOperatorExecutor:
             csegs, cparams, cnum_docs = cached
             if all(a is b for a, b in zip(csegs, segments)):
                 params.update(cparams)
-                return cols, params, cnum_docs, S_real, D
+                return cols, params, cnum_docs, S_real, D, G
         # leaf expressions in the exact order _plan appended leaves:
         # main filter first, then each distinct agg FILTER tree
         leaf_exprs: List[Function] = []
@@ -781,7 +778,83 @@ class TpuOperatorExecutor:
         num_docs_dev = self._put(num_docs)
         leaf_params = {k: v for k, v in params.items() if k.startswith("leaf")}
         self._params_cache[pkey] = (tuple(segments), leaf_params, num_docs_dev)
-        return cols, params, num_docs_dev, S_real, D
+        return cols, params, num_docs_dev, S_real, D, G
+
+    def _stage_gkey(self, segments, S, D, plan: DevicePlan):
+        """Compacted combined group keys: one int32 [S, D] code block,
+        codes dense per segment over OBSERVED key tuples only (ref
+        DictionaryBasedGroupKeyGenerator's map modes for sparse spaces).
+        Returns (device block, G = pow2 pad of the max distinct count).
+        Host rows cache (codes, decode table) per (segment, group cols)."""
+        sig = ",".join(plan.group_cols)
+        bkey = (_batch_id(segments), "gkey", sig, S, D, "i4")
+        rows, tables = [], []
+        for seg in segments:
+            codes, table = self._segment_gkey(seg, plan)
+            rows.append(codes)
+            tables.append(table)
+        G = _pow2(max(t.shape[0] for t in tables), floor=8)
+        # guard BEFORE any upload: an over-cap key space must not pay a
+        # useless HBM transfer (and LRU churn) on every repeat query
+        if G > MAX_DEVICE_GROUPS \
+                or S * G * len(plan.agg_ops) * 8 > MAX_GROUP_RESULT_BYTES:
+            raise _NotStageable()
+
+        entry = self._block_cache.get(bkey)
+        if entry is not None and all(a is b
+                                     for a, b in zip(entry[0], segments)):
+            self._block_cache.move_to_end(bkey)
+            return entry[1], G
+        block = np.zeros((S, D), dtype=np.int32)
+        for s, codes in enumerate(rows):
+            block[s, :len(codes)] = codes
+        dev = self._put(block, block=True)
+        self._insert_block(bkey, (tuple(segments), dev), block.nbytes)
+        return dev, G
+
+    def _segment_gkey(self, seg, plan: DevicePlan):
+        """(codes [num_docs] int32, decode table [G_s, k] int32 dictIds)
+        for one segment, via the host row cache. Takes the engine lock:
+        assembly calls this outside it (the RLock makes the staging-path
+        call reentrant)."""
+        with self._engine_lock:
+            return self._segment_gkey_locked(seg, plan)
+
+    def _segment_gkey_locked(self, seg, plan: DevicePlan):
+        sig = ",".join(plan.group_cols)
+        rkey = (id(seg), "gkey", sig)
+        rentry = self._host_rows.get(rkey)
+        if rentry is not None and rentry[0] is seg:
+            self._host_rows.move_to_end(rkey)
+            return rentry[1]
+        cards = []
+        prod = 1
+        for col in plan.group_cols:
+            if not seg.has_column(col):
+                raise _NotStageable()
+            card = max(int(seg.metadata.columns[col].cardinality), 1)
+            cards.append(card)
+            prod *= card
+            if prod > (1 << 62):
+                raise _NotStageable()  # mixed-radix overflows int64
+        combined = np.zeros(seg.num_docs, np.int64)
+        for col, card in zip(plan.group_cols, cards):
+            combined = combined * card + \
+                seg.data_source(col).dict_ids().astype(np.int64)
+        uniq, inv = np.unique(combined, return_inverse=True)
+        table = np.empty((len(uniq), len(plan.group_cols)), np.int32)
+        rem = uniq.copy()
+        for j in range(len(plan.group_cols) - 1, -1, -1):
+            table[:, j] = rem % cards[j]
+            rem //= cards[j]
+        codes = inv.astype(np.int32)
+        self._host_rows[rkey] = (seg, (codes, table))
+        self._host_bytes += codes.nbytes + table.nbytes
+        while self._host_bytes > self.host_budget_bytes \
+                and len(self._host_rows) > 1:
+            _k, (_s, _a) = self._host_rows.popitem(last=False)
+            self._host_bytes -= _entry_nbytes(_a)
+        return codes, table
 
     def _stacked(self, segments, S, D, col, kind, fetch, dtype):
         """Stacked per-segment column block, two-level cached:
@@ -822,7 +895,7 @@ class TpuOperatorExecutor:
             while self._host_bytes > self.host_budget_bytes \
                     and len(self._host_rows) > 1:
                 _k, (_s, _a) = self._host_rows.popitem(last=False)
-                self._host_bytes -= _a.nbytes
+                self._host_bytes -= _entry_nbytes(_a)
             rows.append(arr)
         block = np.stack(rows) if len(rows) == S else \
             np.concatenate([np.stack(rows),
@@ -836,14 +909,11 @@ class TpuOperatorExecutor:
         self._block_bytes[key] = nbytes
         self._cache_bytes += nbytes
         while self._cache_bytes > self.cache_budget_bytes and len(self._block_cache) > 1:
-            old_key, (_segs, old_arr) = self._block_cache.popitem(last=False)
+            # drop the reference only — the current query and concurrent
+            # dispatches hold evicted blocks as kernel inputs; refcounting
+            # frees the HBM when the last consumer finishes
+            old_key, _entry = self._block_cache.popitem(last=False)
             self._cache_bytes -= self._block_bytes.pop(old_key)
-            # never .delete() here: the CURRENT query may have staged this
-            # block for its own kernel inputs (staging runs before its
-            # in-flight increment), and concurrent dispatches may hold it
-            # too — the post-dispatch drain frees pending evictions once
-            # in-flight reaches zero
-            self._evicted_pending.append(old_arr)
 
     def _check_value_precision(self, segments, col: str, vdt) -> None:
         """float32 staging (x64 off, the TPU default) is exact only for
@@ -915,7 +985,8 @@ class TpuOperatorExecutor:
             if node.args and not (isinstance(node.args[0], Identifier)
                                   and node.args[0].name == "*"))
         count_j = None
-        if plan.num_groups:
+        is_group = bool(plan.num_groups or plan.group_compact)
+        if is_group:
             for j, (op, _vidx, fidx) in enumerate(plan.agg_ops):
                 if op == "count" and fidx is None:
                     count_j = j
@@ -923,7 +994,7 @@ class TpuOperatorExecutor:
             assert count_j is not None  # _plan guarantees a count slot
         results = []
         for s, seg in enumerate(segments[:S_real]):
-            if plan.num_groups:
+            if is_group:
                 matched = int(round(float(packed[s, :, count_j].sum())))
             else:
                 matched = int(round(float(packed[s, 0])))
@@ -935,7 +1006,7 @@ class TpuOperatorExecutor:
                 num_segments_processed=1,
                 num_segments_matched=1 if matched else 0,
                 total_docs=seg.num_docs)
-            if plan.num_groups:
+            if is_group:
                 results.append(self._assemble_group(
                     seg, s, ctx, plan, packed, count_j, mappings, stats))
             else:
@@ -949,19 +1020,27 @@ class TpuOperatorExecutor:
     def _assemble_group(self, seg, s, ctx, plan, packed, count_j, mappings, stats):
         present = np.nonzero(packed[s, :, count_j] > 0)[0]
 
-        # decode combined keys (mixed radix) -> per-column local dictIds
         dicts = [seg.data_source(c).dictionary for c in plan.group_cols]
-        cards = [seg.metadata.columns[c].cardinality for c in plan.group_cols]
-        rem = present.copy()
-        ids_per_col = []
-        for stride in plan.group_strides:
-            ids_per_col.append(rem // stride)
-            rem = rem % stride
-        valid = np.ones(len(present), dtype=bool)
-        for ids, card in zip(ids_per_col, cards):
-            valid &= ids < card
-        present = present[valid]
-        ids_per_col = [ids[valid] for ids in ids_per_col]
+        if plan.group_compact:
+            # compacted codes -> per-column dictIds via the decode table
+            _codes, table = self._segment_gkey(seg, plan)
+            present = present[present < table.shape[0]]
+            ids_per_col = [table[present, j]
+                           for j in range(len(plan.group_cols))]
+        else:
+            # decode combined keys (mixed radix) -> per-column dictIds
+            cards = [seg.metadata.columns[c].cardinality
+                     for c in plan.group_cols]
+            rem = present.copy()
+            ids_per_col = []
+            for stride in plan.group_strides:
+                ids_per_col.append(rem // stride)
+                rem = rem % stride
+            valid = np.ones(len(present), dtype=bool)
+            for ids, card in zip(ids_per_col, cards):
+                valid &= ids < card
+            present = present[valid]
+            ids_per_col = [ids[valid] for ids in ids_per_col]
 
         key_cols = [d.get_values(ids) for d, ids in zip(dicts, ids_per_col)]
         groups: Dict[tuple, list] = {}
@@ -973,6 +1052,13 @@ class TpuOperatorExecutor:
                 inters.append(fn.from_device_slots(slots))
             groups[key] = inters
         return GroupByResult(groups, stats)
+
+
+def _entry_nbytes(a) -> int:
+    """Bytes of a host-row cache payload (array, or (codes, table))."""
+    if isinstance(a, tuple):
+        return sum(x.nbytes for x in a)
+    return a.nbytes
 
 
 def _batch_id(segments) -> tuple:

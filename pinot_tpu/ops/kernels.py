@@ -219,10 +219,11 @@ def _vmap_scatter(init: jnp.ndarray, keys: jnp.ndarray, vals: jnp.ndarray,
 # Kernel assembly
 # ---------------------------------------------------------------------------
 
-def _compute_slots(plan: DevicePlan, cols, params, valid):
+def _compute_slots(plan: DevicePlan, cols, params, valid, G: int = 0):
     """Shared kernel body: filter + values + per-slot reductions over a
     (possibly shard-local) [S, D] block. Returns
-    ([(op, [S]- or [S, G]-array)], matched_count [S] or None)."""
+    ([(op, [S]- or [S, G]-array)], matched_count [S] or None).
+    G: group count for compact-key plans (plan.num_groups is 0 there)."""
     dt = _value_dtype()
     if plan.filter_ir is not None:
         mask = _eval_filter(plan.filter_ir, plan, cols, params)
@@ -238,15 +239,19 @@ def _compute_slots(plan: DevicePlan, cols, params, valid):
         values.append(None if ir is None else _eval_value(ir, cols, params))
 
     slots = []
-    if plan.num_groups:
-        keys = jnp.zeros(valid.shape, dtype=jnp.int32)
-        for col, stride in zip(plan.group_cols, plan.group_strides):
-            keys = keys + cols["ids:" + col] * jnp.int32(stride)
+    num_groups = plan.num_groups or G
+    if num_groups:
+        if plan.group_compact:
+            keys = cols["gkey"]
+        else:
+            keys = jnp.zeros(valid.shape, dtype=jnp.int32)
+            for col, stride in zip(plan.group_cols, plan.group_strides):
+                keys = keys + cols["ids:" + col] * jnp.int32(stride)
         for op, vidx, fidx in plan.agg_ops:
             vals = None if vidx is None else values[vidx]
             m = mask if fidx is None else mask & agg_masks[fidx]
             slots.append((op, _grouped_reduce(op, vals, keys, m, valid,
-                                              plan.num_groups)))
+                                              num_groups)))
         return slots, None
     matched = jnp.sum(mask & valid, axis=1).astype(dt)
     for op, vidx, fidx in plan.agg_ops:
@@ -272,10 +277,10 @@ def make_kernel(plan: DevicePlan):
     doc padding below that).
     """
 
-    def kernel(cols, params, num_docs, D):
+    def kernel(cols, params, num_docs, D, G=0):
         valid = jnp.arange(D, dtype=jnp.int32)[None, :] < num_docs[:, None]
-        slots, matched = _compute_slots(plan, cols, params, valid)
-        if plan.num_groups:
+        slots, matched = _compute_slots(plan, cols, params, valid, G)
+        if plan.num_groups or G:
             return jnp.stack([s for _, s in slots], axis=-1)
         return jnp.stack([matched] + [s for _, s in slots], axis=-1)
 
@@ -332,8 +337,10 @@ def compiled_topn_kernel(plan: DevicePlan):
 def compiled_kernel(plan: DevicePlan):
     """jit-compiled kernel for a plan structure (shape specialization is
     handled inside jit's own cache; D is static because a filterless
-    COUNT(*) stages no columns to infer it from)."""
-    return jax.jit(make_kernel(plan), static_argnames=("D",))
+    COUNT(*) stages no columns to infer it from; G is the compact-key
+    group count — data-dependent, hence a static arg rather than plan
+    state)."""
+    return jax.jit(make_kernel(plan), static_argnames=("D", "G"))
 
 
 # ---------------------------------------------------------------------------
@@ -366,12 +373,12 @@ def make_sharded_kernel(plan: DevicePlan, mesh):
 
     doc_shards = dict(zip(mesh.axis_names, mesh.devices.shape)).get("docs", 1)
 
-    def local(cols, params, num_docs, D):
+    def local(cols, params, num_docs, D, G=0):
         d_local = D // doc_shards
         doc_pos = (jax.lax.axis_index("docs") * d_local
                    + jnp.arange(d_local, dtype=jnp.int32))[None, :]
         valid = doc_pos < num_docs[:, None]
-        slots, matched = _compute_slots(plan, cols, params, valid)
+        slots, matched = _compute_slots(plan, cols, params, valid, G)
         combined = []
         for op, s in slots:
             kind = _DOC_COMBINE[op]
@@ -381,7 +388,7 @@ def make_sharded_kernel(plan: DevicePlan, mesh):
                 combined.append(jax.lax.pmin(s, "docs"))
             else:
                 combined.append(jax.lax.pmax(s, "docs"))
-        if plan.num_groups:
+        if plan.num_groups or G:
             return jnp.stack(combined, axis=-1)
         matched = jax.lax.psum(matched, "docs")
         return jnp.stack([matched] + combined, axis=-1)
@@ -393,21 +400,21 @@ def make_sharded_kernel(plan: DevicePlan, mesh):
         # leaf params: [S] bounds or [S, C] LUTs — segment axis only
         return P("segments", *([None] * (arr.ndim - 1)))
 
-    def fn(cols, params, num_docs, D):
+    def fn(cols, params, num_docs, D, G=0):
         in_specs = (
             {k: col_spec(k) for k in cols},
             {k: param_spec(v) for k, v in params.items()},
             P("segments"),
         )
-        ndim_out = 3 if plan.num_groups else 2
+        ndim_out = 3 if (plan.num_groups or G) else 2
         sm = shard_map(
-            functools.partial(local, D=D), mesh=mesh,
+            functools.partial(local, D=D, G=G), mesh=mesh,
             in_specs=in_specs,
             out_specs=P("segments", *([None] * (ndim_out - 1))),
         )
         return sm(cols, params, num_docs)
 
-    return jax.jit(fn, static_argnames=("D",))
+    return jax.jit(fn, static_argnames=("D", "G"))
 
 
 @functools.lru_cache(maxsize=256)

@@ -54,6 +54,13 @@ class DevicePlan:
     group_cols: Tuple[str, ...] = ()
     group_strides: Tuple[int, ...] = ()   # mixed-radix strides over padded cards
     num_groups: int = 0                   # padded combined-key space (0 = no group-by)
+    #: True: the dense mixed-radix key space exceeded MAX_DEVICE_GROUPS, so
+    #: keys are staged as a per-segment COMPACTED key block ('gkey') — host
+    #: factorizes the observed combined keys once per (segment, group cols)
+    #: and caches the codes + decode table (ref
+    #: DictionaryBasedGroupKeyGenerator's sparse map modes). The group
+    #: count is then data-dependent and rides the kernel's static G arg.
+    group_compact: bool = False
     #: columns staged as dictIds with a dictionary value table
     dict_cols: Tuple[str, ...] = ()
     #: columns staged as raw numeric value blocks

@@ -167,6 +167,16 @@ class _Parser:
             q.group_by = self._expr_list()
         if self.accept_kw("HAVING"):
             q.having = self.expr()
+        self._tail_clauses(q)
+        self.accept_op(";")
+        t = self.peek()
+        if t.kind != "end":
+            raise SqlParseError(f"trailing input at {t.pos}: {t.text!r}")
+        return q
+
+    def _tail_clauses(self, q) -> None:
+        """ORDER BY / LIMIT[,off|OFFSET] / OPTION(...) — shared between
+        the single-stage statement tail and MSE compound-query tails."""
         if self.accept_kw("ORDER"):
             self.expect_kw("BY")
             q.order_by = self._order_list()
@@ -187,11 +197,6 @@ class _Parser:
                 if not self.accept_op(","):
                     break
             self.expect_op(")")
-        self.accept_op(";")
-        t = self.peek()
-        if t.kind != "end":
-            raise SqlParseError(f"trailing input at {t.pos}: {t.text!r}")
-        return q
 
     def _name_text(self, t: Token) -> str:
         if t.kind == "qident":

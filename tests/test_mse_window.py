@@ -147,6 +147,13 @@ class TestCompoundParsing:
         assert q.limit == 5 and len(q.order_by) == 1
         assert q.right.limit is None and not q.right.order_by
 
+    def test_intersect_only_compound_trailing_clauses(self):
+        from pinot_tpu.mse.sql import parse_mse_sql
+        q = parse_mse_sql("SELECT a FROM t INTERSECT (SELECT a FROM u) "
+                          "ORDER BY a LIMIT 5")
+        assert q.op == "intersect"
+        assert q.limit == 5 and len(q.order_by) == 1
+
     def test_duplicate_output_names_setop(self, mse):
         """Hash exchange must key on column POSITION: duplicate output
         names would alias to one column and split equal rows."""
