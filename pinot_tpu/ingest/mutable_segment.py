@@ -108,6 +108,14 @@ class _MutableDataSource:
         return None
 
     @property
+    def json_index(self):
+        return None  # json_match falls back to a transient per-query index
+
+    @property
+    def text_index(self):
+        return None  # text_match likewise
+
+    @property
     def range_index(self):
         return None
 

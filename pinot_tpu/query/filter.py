@@ -237,6 +237,10 @@ def evaluate_filter(seg: ImmutableSegment, expr: Optional[Expression],
             return mask
         if expr.name == "not":
             return ~evaluate_filter(seg, expr.args[0], provider)
+        if expr.name == "json_match":
+            return _json_match_mask(seg, expr)
+        if expr.name == "text_match":
+            return _text_match_mask(seg, expr)
         pred = resolve_predicate(seg, expr)
         if pred is not None:
             return predicate_mask(seg, pred)
@@ -279,6 +283,60 @@ def _value_space_mask(seg: ImmutableSegment, fn: Function, provider) -> np.ndarr
     if name == "is_not_null":
         return ~np.isnan(lhs) if lhs.dtype.kind == "f" else np.ones(seg.num_docs, bool)
     raise ValueError(f"unsupported filter function: {name}")
+
+
+def parse_filter_string(s: str) -> Expression:
+    """Parse a standalone predicate string (json_match's filter argument
+    — SQL predicate syntax over double-quoted json paths)."""
+    from pinot_tpu.query.parser import SqlParseError, _Parser, tokenize
+    p = _Parser(tokenize(s))
+    e = p.expr()
+    t = p.peek()
+    if t.kind != "end":
+        raise SqlParseError(f"trailing input in filter at {t.pos}: {t.text!r}")
+    return e
+
+
+def _json_match_mask(seg: ImmutableSegment, fn: Function) -> np.ndarray:
+    """json_match(col, 'predicate over "$.paths"') — index-backed when the
+    column carries a JSON index (ref JsonMatchFilterOperator +
+    ImmutableJsonIndexReader.getMatchingDocIds); otherwise a transient
+    index over the column's values answers exactly (ExpressionFilter-style
+    fallback)."""
+    col = fn.args[0]
+    assert isinstance(col, Identifier), "json_match needs a column"
+    pred = parse_filter_string(str(fn.args[1].value))  # type: ignore
+    ds = seg.data_source(col.name)
+    idx = getattr(ds, "json_index", None)  # mutable sources lack the attr
+    if idx is None:
+        from pinot_tpu.segment.json_index import JsonIndex
+        idx = JsonIndex.build(ds.values(), seg.num_docs)
+        if hasattr(ds, "_json"):
+            ds._json = idx  # memoize the transient index on the source
+    mask = np.zeros(seg.num_docs, dtype=bool)
+    docs = idx.matching_docs(pred)
+    mask[docs[docs < seg.num_docs]] = True
+    return mask
+
+
+def _text_match_mask(seg: ImmutableSegment, fn: Function) -> np.ndarray:
+    """text_match(col, 'lucene-style query') — ref TextMatchFilterOperator
+    over the text index; transient index fallback without one."""
+    col = fn.args[0]
+    assert isinstance(col, Identifier), "text_match needs a column"
+    query = str(fn.args[1].value)  # type: ignore[union-attr]
+    ds = seg.data_source(col.name)
+    idx = getattr(ds, "text_index", None)  # mutable sources lack the attr
+    if idx is None:
+        from pinot_tpu.segment.text_index import TextIndex
+        idx = TextIndex.build(ds.values(), seg.num_docs)
+        if hasattr(ds, "_text"):
+            ds._text = idx  # memoize the transient index on the source
+    raw = ds.values() if '"' in query else None  # phrases verify adjacency
+    mask = np.zeros(seg.num_docs, dtype=bool)
+    docs = idx.matching_docs(query, raw_values=raw)
+    mask[docs[docs < seg.num_docs]] = True
+    return mask
 
 
 class SegmentColumnProvider:

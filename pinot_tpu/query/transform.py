@@ -9,6 +9,7 @@ mirrors the same arithmetic in jnp for the shapes it offloads.
 """
 from __future__ import annotations
 
+import json
 from typing import Any, Callable, Dict, Protocol
 
 import numpy as np
@@ -273,7 +274,79 @@ _SPECIAL: Dict[str, Callable] = {
     "reverse": lambda e, p: np.array(
         [x[::-1] for x in np.asarray(evaluate(e.args[0], p)).astype(str)]),
     "coalesce": lambda e, p: _coalesce(e, p),
+    "json_extract_scalar": lambda e, p: _json_extract_scalar(e, p),
+    "json_extract_key": lambda e, p: _json_extract_key(e, p),
+    "json_format": lambda e, p: np.array(
+        [_json_format_one(v) for v in np.asarray(evaluate(e.args[0], p))],
+        dtype=object),
 }
+
+
+def _json_format_one(v) -> str:
+    if v is None:
+        return ""
+    try:
+        return json.dumps(json.loads(str(v)))
+    except ValueError:
+        return str(v)
+
+
+def _json_extract_scalar(expr: Function, p: ColumnProvider):
+    """json_extract_scalar(col, '$.path', resultType[, default]) — ref
+    pinot-common function/scalar JsonFunctions + the
+    JsonExtractScalarTransformFunction block evaluator."""
+    from pinot_tpu.segment.json_index import extract_path
+    col = np.asarray(evaluate(expr.args[0], p))
+    path = str(expr.args[1].value)  # type: ignore[union-attr]
+    rtype = str(expr.args[2].value).upper() if len(expr.args) > 2 else "STRING"
+    default = expr.args[3].value if len(expr.args) > 3 else None  # type: ignore
+
+    def conv(v):
+        if v is None:
+            return default
+        if rtype in ("INT", "LONG"):
+            try:
+                return int(v)
+            except (TypeError, ValueError):
+                return default
+        if rtype in ("FLOAT", "DOUBLE"):
+            try:
+                return float(v)
+            except (TypeError, ValueError):
+                return default
+        if isinstance(v, (dict, list)):
+            return json.dumps(v)
+        return str(v)
+
+    out = np.empty(len(col), dtype=object)
+    for i, raw in enumerate(col):
+        try:
+            doc = json.loads(raw) if isinstance(raw, (str, bytes)) else raw
+        except (ValueError, TypeError):
+            doc = None
+        out[i] = conv(extract_path(doc, path))
+    if rtype in ("INT", "LONG") and all(v is not None for v in out):
+        return out.astype(np.int64)
+    if rtype in ("FLOAT", "DOUBLE"):
+        return np.array([np.nan if v is None else v for v in out],
+                        dtype=np.float64)
+    return out
+
+
+def _json_extract_key(expr: Function, p: ColumnProvider):
+    """json_extract_key(col, '$.path') -> sorted keys of the object."""
+    from pinot_tpu.segment.json_index import extract_path
+    col = np.asarray(evaluate(expr.args[0], p))
+    path = str(expr.args[1].value)  # type: ignore[union-attr]
+    out = np.empty(len(col), dtype=object)
+    for i, raw in enumerate(col):
+        try:
+            doc = json.loads(raw) if isinstance(raw, (str, bytes)) else raw
+        except (ValueError, TypeError):
+            doc = None
+        v = extract_path(doc, path)
+        out[i] = sorted(v.keys()) if isinstance(v, dict) else []
+    return out
 
 
 def _coalesce(expr: Function, p: ColumnProvider):

@@ -139,6 +139,8 @@ class SegmentCreator:
                 buffers[index_key(name, it.BLOOM)] = \
                     BloomFilter.build(list(dictionary.values)).to_bytes()
                 meta.indexes.append(it.BLOOM)
+            self._build_json_text(name, values, num_docs, idx_cfg,
+                                  buffers, meta)
         else:
             meta.has_dictionary = False
             st = spec.data_type.stored_type
@@ -160,7 +162,24 @@ class SegmentCreator:
                 buffers[index_key(name, it.BLOOM)] = \
                     BloomFilter.build(list(dict.fromkeys(values))).to_bytes()
                 meta.indexes.append(it.BLOOM)
+            self._build_json_text(name, values, num_docs, idx_cfg,
+                                  buffers, meta)
         return meta
+
+    def _build_json_text(self, name, values, num_docs, idx_cfg,
+                         buffers, meta) -> None:
+        """JSON / text indexes on STRING columns (ref
+        creator/impl/json/, creator/impl/text/)."""
+        if name in idx_cfg.json_index_columns:
+            from pinot_tpu.segment.json_index import JsonIndex
+            buffers[index_key(name, it.JSON)] = \
+                JsonIndex.build(values, num_docs).to_bytes()
+            meta.indexes.append(it.JSON)
+        if name in idx_cfg.text_index_columns:
+            from pinot_tpu.segment.text_index import TextIndex
+            buffers[index_key(name, it.TEXT)] = \
+                TextIndex.build(values, num_docs).to_bytes()
+            meta.indexes.append(it.TEXT)
 
     # ------------------------------------------------------------------
     def _build_mv(self, spec: FieldSpec, data: Optional[ColumnData], num_docs: int,

@@ -1,0 +1,305 @@
+"""JSON index: flattened-record posting lists for json_match filters.
+
+Reference parity: pinot-segment-local
+segment/index/readers/json/ImmutableJsonIndexReader.java +
+creator/impl/json/ — each JSON document flattens into one or more flat
+records (nested arrays multiply records, Pinot-style), every (path, value)
+pair maps to the flat-record ids containing it, and a flat->doc table maps
+matches back to documents. json_match's filter string is SQL-predicate
+syntax over double-quoted json paths, evaluated per FLAT RECORD (so
+`"$.a.x"='1' AND "$.a.y"='2'` must hold inside one array element, the
+reference's exclusive-or-inclusive array semantics in their default form).
+
+Clean-room design: postings are numpy int32 arrays keyed by (path, value)
+in plain dicts; serialization is a length-prefixed binary, not a Lucene
+artifact.
+"""
+from __future__ import annotations
+
+import json
+import struct
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+_U32 = struct.Struct("<I")
+
+#: value stored for JSON null (distinct from the string "null")
+_NULL = "\x00null"
+
+
+def _canon(v: Any) -> str:
+    """Canonical posting value for a JSON scalar."""
+    import math
+    if v is None:
+        return _NULL
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, float) and math.isfinite(v) and v == int(v) \
+            and abs(v) < 2 ** 53:
+        return str(int(v))
+    return str(v)
+
+
+def flatten(doc: Any) -> List[Dict[str, str]]:
+    """One parsed JSON value -> flat records of path -> canonical value.
+
+    Objects nest with '.', array elements spawn one flat record each (the
+    cartesian product across sibling arrays, ref JsonUtils.flatten), and
+    each array path also posts under '[*]' so queries may ignore indexes.
+    """
+    records: List[Dict[str, str]] = [{}]
+
+    def add(recs: List[Dict[str, str]], path: str, value: Any
+            ) -> List[Dict[str, str]]:
+        if isinstance(value, dict):
+            for k, v in value.items():
+                recs = add(recs, f"{path}.{k}" if path else str(k), v)
+            return recs
+        if isinstance(value, list):
+            if not value:
+                return recs
+            out: List[Dict[str, str]] = []
+            for rec in recs:
+                for i, v in enumerate(value):
+                    branch = [dict(rec)]
+                    branch = add(branch, f"{path}[{i}]", v)
+                    branch = add(branch, f"{path}[*]", v)
+                    out.extend(branch)
+            return out
+        for rec in recs:
+            rec[path] = _canon(value)
+        return recs
+
+    return add(records, "", doc)
+
+
+class JsonIndex:
+    """Posting lists over flattened JSON records."""
+
+    def __init__(self, paths: Dict[str, Dict[str, np.ndarray]],
+                 flat2doc: np.ndarray, num_docs: int):
+        #: path -> value -> sorted flat-record ids
+        self.paths = paths
+        self.flat2doc = flat2doc
+        self.num_docs = num_docs
+        self.num_flats = len(flat2doc)
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def build(cls, values, num_docs: int) -> "JsonIndex":
+        paths: Dict[str, Dict[str, List[int]]] = {}
+        flat2doc: List[int] = []
+        for doc_id, raw in enumerate(values):
+            try:
+                parsed = json.loads(raw) if isinstance(raw, (str, bytes)) \
+                    else raw
+            except (ValueError, TypeError):
+                parsed = None
+            if parsed is None:
+                parsed = {}
+            for rec in flatten(parsed):
+                fid = len(flat2doc)
+                flat2doc.append(doc_id)
+                for path, val in rec.items():
+                    paths.setdefault(path, {}).setdefault(val, []).append(fid)
+        frozen = {p: {v: np.asarray(ids, np.int32)
+                      for v, ids in vals.items()}
+                  for p, vals in paths.items()}
+        return cls(frozen, np.asarray(flat2doc, np.int32), num_docs)
+
+    # ------------------------------------------------------------------
+    # flat-record set algebra
+    # ------------------------------------------------------------------
+    def _eq(self, path: str, value: str) -> np.ndarray:
+        return self.paths.get(path, {}).get(value, np.empty(0, np.int32))
+
+    def _exists(self, path: str) -> np.ndarray:
+        vals = self.paths.get(path)
+        if not vals:
+            return np.empty(0, np.int32)
+        return np.unique(np.concatenate(list(vals.values())))
+
+    def _range(self, path: str, lo, hi, lo_inc: bool, hi_inc: bool
+               ) -> np.ndarray:
+        """Numeric range over the path's observed values."""
+        vals = self.paths.get(path)
+        if not vals:
+            return np.empty(0, np.int32)
+        hit = []
+        for v, ids in vals.items():
+            try:
+                f = float(v)
+            except ValueError:
+                continue
+            if lo is not None and (f < lo or (f == lo and not lo_inc)):
+                continue
+            if hi is not None and (f > hi or (f == hi and not hi_inc)):
+                continue
+            hit.append(ids)
+        if not hit:
+            return np.empty(0, np.int32)
+        return np.unique(np.concatenate(hit))
+
+    # ------------------------------------------------------------------
+    def matching_flats(self, expr) -> np.ndarray:
+        """Evaluate a parsed predicate tree (query.expressions nodes over
+        quoted-path Identifiers) to a sorted flat-record id array."""
+        from pinot_tpu.query.expressions import Function, Identifier, Literal
+
+        def path_of(e) -> str:
+            assert isinstance(e, Identifier), f"json path expected: {e}"
+            p = e.name
+            if p.startswith("$."):
+                p = p[2:]
+            elif p.startswith("$"):
+                p = p[1:]
+            return p
+
+        def lit(e) -> str:
+            assert isinstance(e, Literal), f"literal expected: {e}"
+            return _canon(e.value)
+
+        def num(e) -> float:
+            assert isinstance(e, Literal)
+            return float(e.value)
+
+        def ev(e) -> np.ndarray:
+            assert isinstance(e, Function), f"predicate expected: {e}"
+            n = e.name
+            if n == "and":
+                out = ev(e.args[0])
+                for a in e.args[1:]:
+                    out = np.intersect1d(out, ev(a), assume_unique=False)
+                return out
+            if n == "or":
+                return np.unique(np.concatenate(
+                    [ev(a) for a in e.args]))
+            if n == "not":
+                inner = ev(e.args[0])
+                return np.setdiff1d(np.arange(self.num_flats, dtype=np.int32),
+                                    inner)
+            p = path_of(e.args[0])
+            if n == "equals":
+                return self._eq(p, lit(e.args[1]))
+            if n == "not_equals":
+                return np.setdiff1d(self._exists(p),
+                                    self._eq(p, lit(e.args[1])))
+            if n == "in":
+                return np.unique(np.concatenate(
+                    [self._eq(p, lit(a)) for a in e.args[1:]] or
+                    [np.empty(0, np.int32)]))
+            if n == "not_in":
+                bad = [self._eq(p, lit(a)) for a in e.args[1:]]
+                return np.setdiff1d(
+                    self._exists(p),
+                    np.concatenate(bad) if bad else np.empty(0, np.int32))
+            if n == "between":
+                return self._range(p, num(e.args[1]), num(e.args[2]),
+                                   True, True)
+            if n == "greater_than":
+                return self._range(p, num(e.args[1]), None, False, True)
+            if n == "greater_than_or_equal":
+                return self._range(p, num(e.args[1]), None, True, True)
+            if n == "less_than":
+                return self._range(p, None, num(e.args[1]), True, False)
+            if n == "less_than_or_equal":
+                return self._range(p, None, num(e.args[1]), True, True)
+            if n == "is_null":
+                all_flats = np.arange(self.num_flats, dtype=np.int32)
+                return np.setdiff1d(all_flats, self._exists(p))
+            if n == "is_not_null":
+                return self._exists(p)
+            raise ValueError(f"unsupported json_match predicate {n!r}")
+
+        return ev(expr)
+
+    def matching_docs(self, expr) -> np.ndarray:
+        flats = self.matching_flats(expr)
+        if not len(flats):
+            return np.empty(0, np.int32)
+        return np.unique(self.flat2doc[flats])
+
+    # ------------------------------------------------------------------
+    # serde
+    # ------------------------------------------------------------------
+    def to_bytes(self) -> bytes:
+        out = [_U32.pack(self.num_docs), _U32.pack(self.num_flats)]
+        out.append(self.flat2doc.astype("<i4").tobytes())
+        out.append(_U32.pack(len(self.paths)))
+        for path, vals in self.paths.items():
+            pb = path.encode()
+            out += [_U32.pack(len(pb)), pb, _U32.pack(len(vals))]
+            for v, ids in vals.items():
+                vb = v.encode()
+                out += [_U32.pack(len(vb)), vb, _U32.pack(len(ids)),
+                        ids.astype("<i4").tobytes()]
+        return b"".join(out)
+
+    @classmethod
+    def from_bytes(cls, buf) -> "JsonIndex":
+        buf = bytes(buf)
+        pos = 0
+
+        def u32():
+            nonlocal pos
+            v = _U32.unpack_from(buf, pos)[0]
+            pos += 4
+            return v
+
+        num_docs = u32()
+        num_flats = u32()
+        flat2doc = np.frombuffer(buf, "<i4", num_flats, pos).copy()
+        pos += 4 * num_flats
+        paths: Dict[str, Dict[str, np.ndarray]] = {}
+        for _ in range(u32()):
+            ln = u32()
+            path = buf[pos:pos + ln].decode()
+            pos += ln
+            vals: Dict[str, np.ndarray] = {}
+            for _ in range(u32()):
+                vn = u32()
+                v = buf[pos:pos + vn].decode()
+                pos += vn
+                n = u32()
+                vals[v] = np.frombuffer(buf, "<i4", n, pos).copy()
+                pos += 4 * n
+            paths[path] = vals
+        return cls(paths, flat2doc, num_docs)
+
+
+# ---------------------------------------------------------------------------
+# json path extraction (json_extract_scalar — no index required)
+# ---------------------------------------------------------------------------
+
+def extract_path(doc: Any, path: str) -> Any:
+    """Navigate '$.a.b[0].c'-style paths through a parsed JSON value."""
+    if path.startswith("$"):
+        path = path[1:]
+    cur = doc
+    for part in _path_parts(path):
+        if cur is None:
+            return None
+        if isinstance(part, int):
+            if not isinstance(cur, list) or part >= len(cur):
+                return None
+            cur = cur[part]
+        else:
+            if not isinstance(cur, dict):
+                return None
+            cur = cur.get(part)
+    return cur
+
+
+def _path_parts(path: str) -> Iterator:
+    for seg in path.split("."):
+        if not seg:
+            continue
+        while "[" in seg:
+            head, _, rest = seg.partition("[")
+            if head:
+                yield head
+            idx, _, seg = rest.partition("]")
+            yield int(idx)
+        if seg:
+            yield seg

@@ -39,6 +39,8 @@ class DataSource:
         self._sorted: Optional[SortedIndex] = None
         self._bloom: Optional[BloomFilter] = None
         self._nullvec: Optional[Bitmap] = None
+        self._json = None
+        self._text = None
 
     # -- dictionary ---------------------------------------------------------
     @property
@@ -127,6 +129,24 @@ class DataSource:
             self._bloom = BloomFilter.from_bytes(
                 self._seg.dir.get_buffer(self.metadata.name, it.BLOOM))
         return self._bloom
+
+    @property
+    def json_index(self):
+        """Ref DataSource.getJsonIndex (datasource/DataSource.java:77-132)."""
+        if self._json is None and self._has(it.JSON):
+            from pinot_tpu.segment.json_index import JsonIndex
+            self._json = JsonIndex.from_bytes(
+                self._seg.dir.get_buffer(self.metadata.name, it.JSON))
+        return self._json
+
+    @property
+    def text_index(self):
+        """Ref DataSource.getTextIndex."""
+        if self._text is None and self._has(it.TEXT):
+            from pinot_tpu.segment.text_index import TextIndex
+            self._text = TextIndex.from_bytes(
+                self._seg.dir.get_buffer(self.metadata.name, it.TEXT))
+        return self._text
 
     @property
     def null_value_vector(self) -> Optional[Bitmap]:
