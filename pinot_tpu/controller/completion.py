@@ -1,0 +1,174 @@
+"""Segment completion FSM: exactly-one-committer for multi-replica realtime.
+
+Reference parity: pinot-controller
+helix/core/realtime/SegmentCompletionManager.java +
+BlockingSegmentCompletionFSM.java — every replica consuming a partition
+reports segmentConsumed(offset) at its end-criteria; the controller HOLDs
+until the replica set reports (or a deadline), elects the replica with the
+highest offset as the committer, tells laggards to CATCHUP, and after the
+winner's commitEnd tells everyone else to KEEP (offset matches) or
+DISCARD-and-download (behind; here the download is the winner's segment
+directory — the shared-FS stand-in for deep store / peer download).
+
+States per segment: HOLDING -> COMMITTER_DECIDED -> COMMITTED.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+#: actions returned to servers (ref SegmentCompletionProtocol)
+HOLD = "HOLD"
+CATCHUP = "CATCHUP"
+COMMIT = "COMMIT"
+KEEP = "KEEP"
+DISCARD = "DISCARD"
+
+
+@dataclass
+class CompletionResponse:
+    action: str
+    #: CATCHUP/DISCARD: the offset to reach / the committed offset
+    offset: Optional[int] = None
+    #: DISCARD: where the committed segment can be fetched (peer/deep store)
+    download_path: Optional[str] = None
+
+
+class _SegmentFsm:
+    def __init__(self, num_replicas: int, hold_deadline_s: float):
+        self.state = "HOLDING"
+        self.num_replicas = num_replicas
+        self.deadline = time.time() + hold_deadline_s
+        self.offsets: Dict[str, int] = {}      # instance -> reported offset
+        self.committer: Optional[str] = None
+        self.committed_offset: Optional[int] = None
+        self.download_path: Optional[str] = None
+        #: replicas that observed the COMMITTED state (for pruning)
+        self.acked: set = set()
+
+
+class SegmentCompletionManager:
+    """Controller-side coordinator, one FSM per committing segment."""
+
+    #: a decided committer that hasn't committed within this multiple of
+    #: the hold deadline is presumed dead and the segment re-elects
+    COMMIT_TIMEOUT_FACTOR = 4.0
+
+    def __init__(self, num_replicas: int = 1, hold_deadline_s: float = 5.0):
+        self.num_replicas = num_replicas
+        self.hold_deadline_s = hold_deadline_s
+        self._fsms: Dict[str, _SegmentFsm] = {}
+        self._names: Dict[tuple, str] = {}
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------------
+    def segment_name(self, table: str, partition_id: int, seq: int) -> str:
+        """Controller-assigned LLC-style name — IDENTICAL across replicas
+        (ref PinotLLCRealtimeSegmentManager creating the CONSUMING segment
+        metadata; replicas must agree on the name to correlate reports)."""
+        with self._lock:
+            key = (table, partition_id, seq)
+            name = self._names.get(key)
+            if name is None:
+                name = f"{table}__{partition_id}__{seq}__{int(time.time())}"
+                self._names[key] = name
+            return name
+
+    # ------------------------------------------------------------------
+    def segment_consumed(self, instance: str, segment: str,
+                         offset: int) -> CompletionResponse:
+        """A replica reached its end-criteria at `offset`."""
+        with self._lock:
+            fsm = self._fsms.get(segment)
+            if fsm is None:
+                fsm = self._fsms[segment] = _SegmentFsm(
+                    self.num_replicas, self.hold_deadline_s)
+            if fsm.state == "COMMITTED":
+                assert fsm.committed_offset is not None
+                fsm.acked.add(instance)
+                if offset == fsm.committed_offset:
+                    return CompletionResponse(KEEP,
+                                              offset=fsm.committed_offset)
+                # behind OR ahead: discard and adopt the committed copy
+                return CompletionResponse(
+                    DISCARD, offset=fsm.committed_offset,
+                    download_path=fsm.download_path)
+            fsm.offsets[instance] = offset
+
+            if fsm.state == "COMMITTER_DECIDED":
+                if instance == fsm.committer:
+                    return CompletionResponse(COMMIT)
+                if time.time() > fsm.deadline:
+                    # the committer went silent: presume it dead, drop its
+                    # claim (and stale offset) and re-elect below
+                    fsm.offsets.pop(fsm.committer, None)
+                    fsm.state = "HOLDING"
+                    fsm.committer = None
+                else:
+                    target = fsm.offsets[fsm.committer]  # type: ignore[index]
+                    if offset < target:
+                        return CompletionResponse(CATCHUP, offset=target)
+                    return CompletionResponse(HOLD)
+
+            # HOLDING: wait for the full replica set or the deadline
+            if len(fsm.offsets) < fsm.num_replicas \
+                    and time.time() < fsm.deadline:
+                return CompletionResponse(HOLD)
+            # elect: max offset, ties broken by instance id for determinism
+            fsm.committer = max(sorted(fsm.offsets),
+                                key=lambda i: fsm.offsets[i])
+            fsm.state = "COMMITTER_DECIDED"
+            fsm.deadline = time.time() \
+                + self.hold_deadline_s * self.COMMIT_TIMEOUT_FACTOR
+            if instance == fsm.committer:
+                return CompletionResponse(COMMIT)
+            target = fsm.offsets[fsm.committer]
+            if offset < target:
+                return CompletionResponse(CATCHUP, offset=target)
+            return CompletionResponse(HOLD)
+
+    def segment_commit_end(self, instance: str, segment: str, offset: int,
+                           download_path: Optional[str] = None,
+                           success: bool = True) -> None:
+        """The elected committer finished (or failed) its build+commit."""
+        with self._lock:
+            fsm = self._fsms.get(segment)
+            if fsm is None:
+                return
+            if not success:
+                # failed committer: drop its claim so the next reporter
+                # re-elects (ref FSM returning to HOLDING on commit failure)
+                fsm.state = "HOLDING"
+                fsm.committer = None
+                fsm.deadline = time.time() + self.hold_deadline_s
+                return
+            assert instance == fsm.committer, \
+                f"{instance} committed but {fsm.committer} was elected"
+            fsm.state = "COMMITTED"
+            fsm.committed_offset = offset
+            fsm.download_path = download_path
+            fsm.acked.add(instance)  # the committer has its copy
+            self._prune_locked()
+
+    #: retained COMMITTED FSMs (a fresh FSM for an already-committed
+    #: segment would re-elect and double-commit, so entries linger for
+    #: late reporters and only the oldest settled ones fall off)
+    MAX_COMMITTED_RETAINED = 1024
+
+    def _prune_locked(self) -> None:
+        committed = [s for s, f in self._fsms.items()
+                     if f.state == "COMMITTED"
+                     and len(f.acked) >= f.num_replicas]
+        excess = len(committed) - self.MAX_COMMITTED_RETAINED
+        for s in committed[:max(excess, 0)]:
+            del self._fsms[s]
+        while len(self._names) > 4 * self.MAX_COMMITTED_RETAINED:
+            self._names.pop(next(iter(self._names)))
+
+    # ------------------------------------------------------------------
+    def state_of(self, segment: str) -> Optional[str]:
+        with self._lock:
+            fsm = self._fsms.get(segment)
+            return fsm.state if fsm else None

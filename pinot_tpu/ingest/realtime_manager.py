@@ -38,7 +38,12 @@ class RealtimeSegmentDataManager:
                  table_data_manager: TableDataManager, segment_store_dir: str,
                  start_offset: Optional[LongMsgOffset] = None,
                  on_commit: Optional[Callable[[str, LongMsgOffset], None]] = None,
-                 ingestion_delay_tracker=None):
+                 ingestion_delay_tracker=None,
+                 completion_manager=None, instance_id: str = "server_0"):
+        """completion_manager: a controller SegmentCompletionManager for
+        multi-replica coordination (exactly one replica commits per
+        segment, ref BlockingSegmentCompletionFSM); None = single-replica
+        local commits, the prior behavior."""
         self.table_config = table_config
         self.schema = schema
         self.stream_config = stream_config
@@ -46,6 +51,13 @@ class RealtimeSegmentDataManager:
         self.tdm = table_data_manager
         self.store_dir = segment_store_dir
         self.on_commit = on_commit
+        self.completion = completion_manager
+        self.instance_id = instance_id
+        self._catchup_target: Optional[int] = None
+        #: a DISCARD rewound current_offset: the in-flight fetched batch
+        #: is stale and must be abandoned (or rows between the committed
+        #: offset and the batch cursor would be skipped)
+        self._restart_fetch = False
         self.pipeline = TransformPipeline(table_config, schema)
         self.delay_tracker = ingestion_delay_tracker
         # upsert/dedup metadata (ref RealtimeTableDataManager wiring)
@@ -82,7 +94,11 @@ class RealtimeSegmentDataManager:
 
     # ------------------------------------------------------------------
     def _segment_name(self) -> str:
-        # ref LLCSegmentName: table__partition__seq__creationTime
+        # ref LLCSegmentName: table__partition__seq__creationTime; with a
+        # completion manager the CONTROLLER assigns it so replicas agree
+        if self.completion is not None:
+            return self.completion.segment_name(
+                self.table_config.name, self.partition_id, self._seq)
         return (f"{self.table_config.name}__{self.partition_id}__{self._seq}"
                 f"__{int(time.time())}")
 
@@ -137,22 +153,96 @@ class RealtimeSegmentDataManager:
                     self.delay_tracker.record(self.partition_id, msg.timestamp_ms)
                 if self._end_criteria_reached():
                     self._try_commit()
+                    if self._restart_fetch:
+                        break
+            if self._restart_fetch:
+                self._restart_fetch = False
+                continue  # refetch from the rewound offset
             if batch.next_offset is not None:
                 self.current_offset = batch.next_offset
             if self._end_criteria_reached():
                 self._try_commit()
+                self._restart_fetch = False
             if len(batch) == 0:
                 if self._stop.wait(0.05):
                     break
 
     def _try_commit(self) -> None:
         try:
+            if self.completion is not None:
+                self._try_commit_protocol()
+                return
             with self._seal_lock:
                 self._commit()
         except Exception:  # noqa: BLE001 — seal failure must not kill the
             # consumer; the segment keeps consuming and the next criteria
             # check retries the build
             log.exception("segment commit failed; will retry")
+
+    def _try_commit_protocol(self) -> None:
+        """One FSM interaction per end-criteria check (the consume loop
+        re-polls, so HOLD/CATCHUP never block the consumer thread)."""
+        name = self.mutable.segment_name
+        offset = int(str(self.current_offset))
+        if self._catchup_target is not None and offset < self._catchup_target:
+            return  # keep consuming toward the committer's offset
+        resp = self.completion.segment_consumed(self.instance_id, name,
+                                                offset)
+        if resp.action == "HOLD":
+            time.sleep(0.02)
+            return
+        if resp.action == "CATCHUP":
+            self._catchup_target = resp.offset
+            return
+        self._catchup_target = None
+        if resp.action == "COMMIT":
+            try:
+                with self._seal_lock:
+                    out_dir = self._commit()
+            except Exception:
+                # report the failure so the FSM re-elects instead of the
+                # other replicas HOLDing behind a dead claim
+                self.completion.segment_commit_end(
+                    self.instance_id, name, 0, success=False)
+                raise
+            self.completion.segment_commit_end(
+                self.instance_id, name, int(str(self.current_offset)),
+                download_path=out_dir)
+            return
+        if resp.action == "KEEP":
+            # offsets match the committed segment: seal the LOCAL copy
+            # (row-identical) without re-reporting (ref SlowCommitter KEEP)
+            with self._seal_lock:
+                self._commit()
+            return
+        if resp.action == "DISCARD":
+            if self.dedup_manager is not None or self.upsert_manager is not None:
+                # dedup/upsert metadata registered rows during the
+                # now-discarded consumption and cannot unwind; adopting
+                # the committed copy would silently drop them on refetch.
+                # Keep the local (superset) build instead — replicas
+                # diverge by a few rows rather than losing data (the
+                # reference rebuilds metadata from segments on restart, a
+                # deep-store capability this path does not have yet)
+                log.warning("DISCARD on a dedup/upsert table: sealing the "
+                            "local copy of %s instead", name)
+                with self._seal_lock:
+                    self._commit()
+                return
+            # behind/ahead of the commit: adopt the committed copy from
+            # the winner's store (shared-FS peer download) and resume from
+            # the committed offset
+            with self._seal_lock:
+                immutable = load_segment(resp.download_path)
+                self.tdm.add_segment(immutable)
+                self.current_offset = LongMsgOffset(resp.offset)
+                self._restart_fetch = True
+                if self.on_commit is not None:
+                    self.on_commit(immutable.name, self.current_offset)
+                self._seq += 1
+                self._open_new_consuming()
+            return
+        raise ValueError(f"unknown completion action {resp.action!r}")
 
     def _end_criteria_reached(self) -> bool:
         if self.mutable.num_docs >= self.stream_config.flush_threshold_rows:
@@ -162,9 +252,11 @@ class RealtimeSegmentDataManager:
                 and age_ms >= self.stream_config.flush_threshold_time_ms)
 
     # ------------------------------------------------------------------
-    def _commit(self) -> None:
+    def _commit(self) -> str:
         """Seal: mutable -> immutable on disk -> swap -> checkpoint
-        (ref commitSegment, RealtimeSegmentDataManager.java:856,1164)."""
+        (ref commitSegment, RealtimeSegmentDataManager.java:856,1164).
+        Returns the built segment directory (the completion protocol
+        advertises it as the peer-download location)."""
         sealed = self.mutable
         name = sealed.segment_name
         out_dir = os.path.join(self.store_dir, name)
@@ -183,6 +275,7 @@ class RealtimeSegmentDataManager:
             self.on_commit(name, self.current_offset)
         self._seq += 1
         self._open_new_consuming()
+        return out_dir
 
     def force_commit(self) -> None:
         """Ops hook (ref forceCommit REST): seal now regardless of criteria."""

@@ -181,14 +181,14 @@ def _reduce_group_by(ctx: QueryContext, results: List[GroupByResult],
         bindings.update(zip(ctx.agg_keys, finals))
         if ctx.having is not None and not eval_scalar(ctx.having, bindings):
             continue
-        for e_sel, alias in zip(ctx.select, ctx.aliases):
-            if alias is not None:
-                # a select alias is referenceable from ORDER BY; bound
-                # AFTER the HAVING gate so guarded expressions (e.g.
-                # SQRT over a HAVING-filtered sum) never evaluate for
-                # excluded groups
-                bindings[Identifier(alias)] = eval_scalar(e_sel, bindings)
+        # the output row evaluates against CLEAN bindings first (an alias
+        # may shadow a column its own expression reads); aliases then bind
+        # to the COMPUTED values for ORDER BY — after the HAVING gate, so
+        # guarded expressions never evaluate for excluded groups
         out_row = tuple(eval_scalar(e, bindings) for e in ctx.select)
+        for val, alias in zip(out_row, ctx.aliases):
+            if alias is not None:
+                bindings[Identifier(alias)] = val
         sort_key = tuple(eval_scalar(e, bindings) for e, _ in ctx.order_by)
         rows.append((sort_key, out_row))
 

@@ -325,8 +325,13 @@ def _json_extract_scalar(expr: Function, p: ColumnProvider):
         except (ValueError, TypeError):
             doc = None
         out[i] = conv(extract_path(doc, path))
-    if rtype in ("INT", "LONG") and all(v is not None for v in out):
-        return out.astype(np.int64)
+    if rtype in ("INT", "LONG"):
+        if all(v is not None for v in out):
+            return out.astype(np.int64)
+        # missing paths with no default: NaN-typed like the DOUBLE branch
+        # so aggregations see floats, not a mixed int/None object array
+        return np.array([np.nan if v is None else float(v) for v in out],
+                        dtype=np.float64)
     if rtype in ("FLOAT", "DOUBLE"):
         return np.array([np.nan if v is None else v for v in out],
                         dtype=np.float64)

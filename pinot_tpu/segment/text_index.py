@@ -98,6 +98,8 @@ class TextIndex:
 
         def parse_unary() -> np.ndarray:
             t = peek()
+            if t is None:  # trailing operator ('a AND'): nothing matches
+                return np.empty(0, np.int32)
             if t == ("op", "NOT"):
                 take()
                 inner = parse_unary()

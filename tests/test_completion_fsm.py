@@ -1,0 +1,157 @@
+"""Segment completion FSM: exactly one committer across replicas.
+
+Ref: pinot-controller realtime/BlockingSegmentCompletionFSM.java +
+SegmentCompletionManager.java — VERDICT r3 item 8. The integration test is
+the LLC multi-replica scenario: two servers consume the SAME partition and
+exactly one commits each segment; the other keeps its row-identical copy.
+"""
+import time
+
+import pytest
+
+from pinot_tpu.controller.completion import (
+    CATCHUP, COMMIT, DISCARD, HOLD, KEEP, SegmentCompletionManager)
+from pinot_tpu.ingest import InMemoryStream, LongMsgOffset, StreamConfig
+from pinot_tpu.ingest.realtime_manager import RealtimeSegmentDataManager
+from pinot_tpu.models import (DataType, FieldSpec, FieldType, Schema,
+                              TableConfig, TableType)
+from pinot_tpu.query.executor import QueryExecutor
+from pinot_tpu.server.data_manager import TableDataManager
+
+
+class TestFsmUnit:
+    def test_single_replica_commits_immediately(self):
+        m = SegmentCompletionManager(num_replicas=1)
+        r = m.segment_consumed("s0", "seg__0__0__1", 100)
+        assert r.action == COMMIT
+        m.segment_commit_end("s0", "seg__0__0__1", 100, "/tmp/x")
+        assert m.state_of("seg__0__0__1") == "COMMITTED"
+
+    def test_two_replicas_one_committer(self):
+        m = SegmentCompletionManager(num_replicas=2)
+        assert m.segment_consumed("s0", "seg", 100).action == HOLD
+        r1 = m.segment_consumed("s1", "seg", 100)
+        # replica set complete: s1 sees the election result directly
+        assert r1.action in (COMMIT, HOLD)
+        r0 = m.segment_consumed("s0", "seg", 100)
+        actions = {r0.action, r1.action}
+        assert COMMIT in actions and HOLD in actions
+        committer = "s0" if r0.action == COMMIT else "s1"
+        loser = "s1" if committer == "s0" else "s0"
+        m.segment_commit_end(committer, "seg", 100, "/d")
+        r = m.segment_consumed(loser, "seg", 100)
+        assert r.action == KEEP
+
+    def test_laggard_catches_up_then_winner_elected_by_offset(self):
+        m = SegmentCompletionManager(num_replicas=2)
+        m.segment_consumed("s0", "seg", 80)
+        r1 = m.segment_consumed("s1", "seg", 100)
+        assert r1.action == COMMIT  # max offset wins
+        r0 = m.segment_consumed("s0", "seg", 80)
+        assert r0.action == CATCHUP and r0.offset == 100
+        m.segment_commit_end("s1", "seg", 100, "/d")
+        # the laggard could not reach 100 (e.g. stream truncated): DISCARD
+        r0 = m.segment_consumed("s0", "seg", 80)
+        assert r0.action == DISCARD
+        assert r0.offset == 100 and r0.download_path == "/d"
+        # once caught up exactly: KEEP
+        assert m.segment_consumed("s0", "seg", 100).action == KEEP
+
+    def test_deadline_elects_with_partial_replica_set(self):
+        m = SegmentCompletionManager(num_replicas=2, hold_deadline_s=0.05)
+        assert m.segment_consumed("s0", "seg", 50).action == HOLD
+        time.sleep(0.07)
+        assert m.segment_consumed("s0", "seg", 50).action == COMMIT
+
+    def test_failed_commit_reelects(self):
+        m = SegmentCompletionManager(num_replicas=2)
+        m.segment_consumed("s0", "seg", 100)
+        m.segment_consumed("s1", "seg", 100)
+        r0 = m.segment_consumed("s0", "seg", 100)
+        committer = "s0" if r0.action == COMMIT else "s1"
+        m.segment_commit_end(committer, "seg", 100, success=False)
+        # next reporter triggers re-election and someone commits again
+        acts = {m.segment_consumed("s0", "seg", 100).action,
+                m.segment_consumed("s1", "seg", 100).action}
+        assert COMMIT in acts
+
+    def test_controller_assigned_names_are_stable(self):
+        m = SegmentCompletionManager(num_replicas=2)
+        a = m.segment_name("rt", 0, 3)
+        b = m.segment_name("rt", 0, 3)
+        assert a == b and a.startswith("rt__0__3__")
+
+
+# ---------------------------------------------------------------------------
+# multi-replica integration: 2 servers, same partition, one committer
+# ---------------------------------------------------------------------------
+
+def _schema():
+    return Schema("rt", [
+        FieldSpec("id", DataType.LONG, FieldType.DIMENSION),
+        FieldSpec("score", DataType.DOUBLE, FieldType.METRIC)])
+
+
+class TestTwoReplicaIntegration:
+    def test_exactly_one_committer_per_segment(self, tmp_path):
+        topic = InMemoryStream("fsm_topic", num_partitions=1)
+        try:
+            completion = SegmentCompletionManager(num_replicas=2,
+                                                  hold_deadline_s=10.0)
+            sc = StreamConfig(stream_type="inmemory", topic="fsm_topic",
+                              flush_threshold_rows=100)
+            tdms, mgrs, commits = [], [], {"server_0": [], "server_1": []}
+            for i in range(2):
+                inst = f"server_{i}"
+                tdm = TableDataManager("rt_REALTIME")
+                mgr = RealtimeSegmentDataManager(
+                    TableConfig("rt", TableType.REALTIME), _schema(), sc, 0,
+                    tdm, str(tmp_path / inst),
+                    on_commit=(lambda name, off, _i=inst:
+                               commits[_i].append((name, int(str(off))))),
+                    completion_manager=completion, instance_id=inst)
+                tdms.append(tdm)
+                mgrs.append(mgr)
+            for i in range(250):
+                topic.publish({"id": i, "score": float(i)})
+            for mgr in mgrs:
+                mgr.start()
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                if all(len(commits[f"server_{i}"]) >= 2 for i in range(2)):
+                    break
+                time.sleep(0.1)
+            for mgr in mgrs:
+                mgr.stop()
+
+            # both replicas checkpointed both segments at the same offsets
+            # (a HOLDing winner may consume a few extra rows before its
+            # commit, so offsets are >= the flush threshold, not exact)
+            assert len(commits["server_0"]) >= 2, commits
+            assert len(commits["server_1"]) >= 2, commits
+            assert commits["server_0"][:2] == commits["server_1"][:2]
+            assert commits["server_0"][0][1] >= 100
+            assert commits["server_0"][1][1] >= 200
+
+            # the FSM committed each segment EXACTLY once, with one winner
+            for seg_name, _off in commits["server_0"][:2]:
+                assert completion.state_of(seg_name) == "COMMITTED"
+                fsm = completion._fsms[seg_name]
+                assert fsm.committer in ("server_0", "server_1")
+
+            # both replicas answer identically over sealed + consuming rows
+            counts = []
+            for tdm in tdms:
+                sdms = tdm.acquire_segments()
+                try:
+                    ex = QueryExecutor([s.segment for s in sdms],
+                                       use_tpu=False)
+                    r = ex.execute("SELECT COUNT(*), SUM(id) FROM rt")
+                    counts.append(tuple(r.rows[0]))
+                finally:
+                    TableDataManager.release_all(sdms)
+            assert counts[0] == counts[1]
+            assert counts[0][0] == 250
+            assert counts[0][1] == pytest.approx(sum(range(250)))
+        finally:
+            InMemoryStream.delete("fsm_topic")
