@@ -1,0 +1,64 @@
+"""Broker-side server failure detection with exponential-backoff retry.
+
+Reference parity: pinot-broker
+failuredetector/ConnectionFailureDetector.java (+ BaseExponentialBackoff
+RetryFailureDetector) — servers that fail a query connection are marked
+unhealthy and routing skips them; after an exponentially growing backoff
+the server re-enters routing as a probe, and one success clears it.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional, Set
+
+
+class _Entry:
+    __slots__ = ("failures", "retry_at")
+
+    def __init__(self):
+        self.failures = 0
+        self.retry_at = 0.0
+
+
+class ConnectionFailureDetector:
+    def __init__(self, base_backoff_s: float = 1.0,
+                 max_backoff_s: float = 60.0):
+        self.base_backoff_s = base_backoff_s
+        self.max_backoff_s = max_backoff_s
+        self._entries: Dict[str, _Entry] = {}
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------------
+    def mark_failure(self, server: str) -> None:
+        with self._lock:
+            e = self._entries.get(server)
+            if e is None:
+                e = self._entries[server] = _Entry()
+            e.failures += 1
+            backoff = min(self.base_backoff_s * (2 ** (e.failures - 1)),
+                          self.max_backoff_s)
+            e.retry_at = time.time() + backoff
+
+    def mark_success(self, server: str) -> None:
+        with self._lock:
+            self._entries.pop(server, None)
+
+    # ------------------------------------------------------------------
+    def is_healthy(self, server: str, now: Optional[float] = None) -> bool:
+        """True when routable: never failed, or its backoff expired (the
+        next request is the re-probe; a failure re-doubles the backoff)."""
+        now = time.time() if now is None else now
+        with self._lock:
+            e = self._entries.get(server)
+            return e is None or now >= e.retry_at
+
+    def unhealthy_servers(self, now: Optional[float] = None) -> Set[str]:
+        now = time.time() if now is None else now
+        with self._lock:
+            return {s for s, e in self._entries.items() if now < e.retry_at}
+
+    def failure_count(self, server: str) -> int:
+        with self._lock:
+            e = self._entries.get(server)
+            return 0 if e is None else e.failures

@@ -25,7 +25,7 @@ class BrokerRequestHandler:
     def __init__(self, routing: BrokerRoutingManager,
                  connections: Dict[str, ServerConnection],
                  max_fanout_threads: int = 16,
-                 mse_dispatcher=None):
+                 mse_dispatcher=None, failure_detector=None):
         self.routing = routing
         self.connections = connections
         #: multi-stage dispatcher (mse/dispatcher.py); when set, queries the
@@ -33,6 +33,11 @@ class BrokerRequestHandler:
         #: via useMultistageEngine — go through it (ref
         #: BrokerRequestHandlerDelegate engine selection)
         self.mse_dispatcher = mse_dispatcher
+        if failure_detector is None:
+            from pinot_tpu.broker.failure_detector import \
+                ConnectionFailureDetector
+            failure_detector = ConnectionFailureDetector()
+        self.failure_detector = failure_detector
         self._pool = ThreadPoolExecutor(max_workers=max_fanout_threads)
         self._request_id = 0
         self._lock = threading.Lock()
@@ -67,48 +72,87 @@ class BrokerRequestHandler:
             return _error_response(
                 190, f"TableDoesNotExistError: {ctx.table}", start)
 
-        plan = route.route(ctx)
+        plan = route.route(ctx, unhealthy=self.failure_detector
+                           .unhealthy_servers())
         request_id = self._next_id()
-        futures = []
-        missing_servers = []
-        for server, physical_table, segment_names, extra_filter in plan:
-            conn = self.connections.get(server)
-            if conn is None:
-                # a silently skipped server would return a clean-looking
-                # partial aggregate; surface it as a server error instead
-                missing_servers.append(server)
-                continue
-            # the time-boundary predicate travels as a separate field and is
-            # ANDed into the filter TREE server-side — splicing SQL text is
-            # unsound (keywords inside identifiers/literals)
-            futures.append(self._pool.submit(
-                conn.request, physical_table, sql, segment_names,
-                request_id, extra_filter))
-
         results, exceptions, server_stats = [], [], []
-        for server in missing_servers:
-            exceptions.append({"errorCode": 427,
-                               "message": f"ServerNotConnected: {server}"})
         responded = 0
-        for fut in futures:
-            try:
-                payload = fut.result(timeout=60)
-                server_results, server_exc, extra = \
-                    datatable.deserialize_results(payload)
-                results.extend(server_results)
-                exceptions.extend(server_exc)
-                if extra is not None:
-                    server_stats.append(extra)
-                responded += 1
-            except Exception as e:  # noqa: BLE001 — partial results semantics
-                exceptions.append(
-                    {"errorCode": 427, "message": f"ServerError: {e}"})
+        attempted: set = set()
+        failed_servers: set = set()
+
+        def submit(entries):
+            out = []
+            for server, physical_table, segment_names, extra_filter in entries:
+                attempted.add(server)
+                conn = self.connections.get(server)
+                if conn is None:
+                    # a silently skipped server would return a clean-looking
+                    # partial aggregate; surface it as a server error
+                    exceptions.append(
+                        {"errorCode": 427,
+                         "message": f"ServerNotConnected: {server}"})
+                    continue
+                # the time-boundary predicate travels as a separate field,
+                # ANDed into the filter TREE server-side — splicing SQL
+                # text is unsound (keywords inside identifiers/literals)
+                out.append((self._pool.submit(
+                    conn.request, physical_table, sql, segment_names,
+                    request_id, extra_filter),
+                    server, physical_table, segment_names, extra_filter))
+            return out
+
+        def gather(entries, retried: bool):
+            nonlocal responded
+            failed = []
+            for fut, server, table, names, extra in entries:
+                try:
+                    payload = fut.result(timeout=60)
+                    server_results, server_exc, stats_extra = \
+                        datatable.deserialize_results(payload)
+                    results.extend(server_results)
+                    exceptions.extend(server_exc)
+                    if stats_extra is not None:
+                        server_stats.append(stats_extra)
+                    responded += 1
+                    self.failure_detector.mark_success(server)
+                except Exception as e:  # noqa: BLE001 — partial results
+                    # connection-level failure: mark unhealthy (routing
+                    # skips it until the backoff expires, ref
+                    # ConnectionFailureDetector) and retry the segments on
+                    # surviving replicas ONCE
+                    self.failure_detector.mark_failure(server)
+                    failed_servers.add(server)
+                    if retried:
+                        exceptions.append({"errorCode": 427,
+                                           "message": f"ServerError: {e}"})
+                        continue
+                    # exclude everything known-bad: this round's failures
+                    # AND the detector's unhealthy set, or the single
+                    # retry can land on another dead server while a
+                    # healthy replica exists
+                    exclude = failed_servers | \
+                        self.failure_detector.unhealthy_servers()
+                    rerouted, unplaced = route.reroute_segments(
+                        table, names, exclude=exclude, extra_filter=extra)
+                    if unplaced:
+                        # segments with no surviving replica: surface the
+                        # loss instead of a clean-looking partial answer
+                        exceptions.append({
+                            "errorCode": 427,
+                            "message": (f"ServerError: {e} "
+                                        f"(segments lost: {unplaced})")})
+                    failed.extend(rerouted)
+            return failed
+
+        retry_plan = gather(submit(plan), retried=False)
+        if retry_plan:
+            gather(submit(retry_plan), retried=True)
 
         resp = reduce_results(ctx, results)
         for extra in server_stats:
             resp.stats.merge(extra)
         resp.exceptions = exceptions
-        resp.num_servers_queried = len(futures) + len(missing_servers)
+        resp.num_servers_queried = len(attempted)
         resp.num_servers_responded = responded
         resp.time_used_ms = (time.time() - start) * 1000.0
         return resp

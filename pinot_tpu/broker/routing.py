@@ -50,11 +50,16 @@ class RoutingTable:
         self._rr = 0
         self._lock = threading.Lock()
 
-    def route(self, ctx: QueryContext) -> List[Tuple[str, str, List[str], Optional[str]]]:
+    def route(self, ctx: QueryContext, unhealthy: Optional[Set[str]] = None
+              ) -> List[Tuple[str, str, List[str], Optional[str]]]:
         """Returns [(server, physical_table, segment_names, extra_filter)].
 
         extra_filter is the time-boundary predicate SQL fragment to AND in
         (the reference rewrites the query per physical table the same way).
+        unhealthy: servers the failure detector wants skipped — a segment
+        whose replicas are ALL unhealthy still routes (partial answers
+        beat silently dropped segments, matching the reference's fallback
+        when the selector exhausts candidates).
         """
         out: List[Tuple[str, str, List[str], Optional[str]]] = []
         if self.offline is not None:
@@ -62,32 +67,80 @@ class RoutingTable:
             if self.realtime is not None and self.time_boundary is not None \
                     and self.offline.time_column:
                 extra = f"{self.offline.time_column} <= {self.time_boundary}"
-            out.extend(self._route_physical(self.offline, ctx, extra))
+            out.extend(self._route_physical(self.offline, ctx, extra,
+                                            unhealthy or set()))
         if self.realtime is not None:
             extra = None
             if self.offline is not None and self.time_boundary is not None \
                     and self.realtime.time_column:
                 extra = f"{self.realtime.time_column} > {self.time_boundary}"
-            out.extend(self._route_physical(self.realtime, ctx, extra))
+            out.extend(self._route_physical(self.realtime, ctx, extra,
+                                            unhealthy or set()))
         return out
 
     # ------------------------------------------------------------------
     def _route_physical(self, route: TableRoute, ctx: QueryContext,
-                        extra_filter: Optional[str]):
+                        extra_filter: Optional[str], unhealthy: Set[str]):
         selected = [s for s in route.segments.values()
                     if not _prunable(s, ctx)]
         per_server: Dict[str, List[str]] = {}
         with self._lock:
             for seg in selected:
-                if not seg.servers:
+                server = _pick_replica(seg.servers, self._rr, unhealthy)
+                if server is None:
                     continue
-                # balanced selection: rotate across replicas
-                # (ref BalancedInstanceSelector)
-                server = seg.servers[self._rr % len(seg.servers)]
                 per_server.setdefault(server, []).append(seg.name)
             self._rr += 1
         return [(server, route.table_name, names, extra_filter)
                 for server, names in per_server.items()]
+
+    def reroute_segments(self, physical_table: str, segment_names: List[str],
+                         exclude: Set[str], extra_filter: Optional[str]):
+        """Re-place segments on surviving replicas after a server failed
+        mid-query (ref QueryRouter retry on unhealthy server). Returns
+        (entries, unplaced_segment_names) — unplaced segments have NO
+        surviving replica and must surface as an error, never silently
+        vanish from the answer."""
+        route = None
+        for r in (self.offline, self.realtime):
+            if r is not None and r.table_name == physical_table:
+                route = r
+                break
+        if route is None:
+            return [], list(segment_names)
+        per_server: Dict[str, List[str]] = {}
+        unplaced: List[str] = []
+        with self._lock:
+            for name in segment_names:
+                seg = route.segments.get(name)
+                if seg is None:
+                    unplaced.append(name)
+                    continue
+                server = _pick_replica(seg.servers, self._rr, exclude,
+                                       strict=True)
+                if server is None:
+                    unplaced.append(name)
+                    continue
+                per_server.setdefault(server, []).append(seg.name)
+            self._rr += 1
+        return ([(server, physical_table, names, extra_filter)
+                 for server, names in per_server.items()], unplaced)
+
+
+def _pick_replica(servers: List[str], rr: int, skip: Set[str],
+                  strict: bool = False) -> Optional[str]:
+    """Balanced selection over healthy replicas (ref
+    BalancedInstanceSelector); falls back to ANY replica when all are
+    marked unhealthy — unless strict (mid-query retry must not resend to
+    the server that just failed)."""
+    if not servers:
+        return None
+    healthy = [s for s in servers if s not in skip]
+    if healthy:
+        return healthy[rr % len(healthy)]
+    if strict:
+        return None
+    return servers[rr % len(servers)]
 
 
 def _prunable(seg: SegmentInfo, ctx: QueryContext) -> bool:

@@ -39,6 +39,7 @@ class CompletionResponse:
 class _SegmentFsm:
     def __init__(self, num_replicas: int, hold_deadline_s: float):
         self.state = "HOLDING"
+        self.committed_at = 0.0
         self.num_replicas = num_replicas
         self.deadline = time.time() + hold_deadline_s
         self.offsets: Dict[str, int] = {}      # instance -> reported offset
@@ -137,6 +138,11 @@ class SegmentCompletionManager:
             fsm = self._fsms.get(segment)
             if fsm is None:
                 return
+            if fsm.state == "COMMITTED" or instance != fsm.committer:
+                # a stale (de-elected or late) committer must not reset or
+                # overwrite the FSM — its local seal simply diverges and
+                # reconciles via KEEP/DISCARD on its next report
+                return
             if not success:
                 # failed committer: drop its claim so the next reporter
                 # re-elects (ref FSM returning to HOLDING on commit failure)
@@ -144,9 +150,8 @@ class SegmentCompletionManager:
                 fsm.committer = None
                 fsm.deadline = time.time() + self.hold_deadline_s
                 return
-            assert instance == fsm.committer, \
-                f"{instance} committed but {fsm.committer} was elected"
             fsm.state = "COMMITTED"
+            fsm.committed_at = time.time()
             fsm.committed_offset = offset
             fsm.download_path = download_path
             fsm.acked.add(instance)  # the committer has its copy
@@ -157,10 +162,16 @@ class SegmentCompletionManager:
     #: late reporters and only the oldest settled ones fall off)
     MAX_COMMITTED_RETAINED = 1024
 
+    #: COMMITTED entries older than this are prunable even when a dead
+    #: replica never acked (unbounded-growth guard)
+    COMMITTED_TTL_S = 3600.0
+
     def _prune_locked(self) -> None:
+        now = time.time()
         committed = [s for s, f in self._fsms.items()
                      if f.state == "COMMITTED"
-                     and len(f.acked) >= f.num_replicas]
+                     and (len(f.acked) >= f.num_replicas
+                          or now - f.committed_at > self.COMMITTED_TTL_S)]
         excess = len(committed) - self.MAX_COMMITTED_RETAINED
         for s in committed[:max(excess, 0)]:
             del self._fsms[s]

@@ -54,6 +54,7 @@ class RealtimeSegmentDataManager:
         self.completion = completion_manager
         self.instance_id = instance_id
         self._catchup_target: Optional[int] = None
+        self._catchup_deadline = 0.0
         #: a DISCARD rewound current_offset: the in-flight fetched batch
         #: is stale and must be abandoned (or rows between the committed
         #: offset and the batch cursor would be skipped)
@@ -185,7 +186,13 @@ class RealtimeSegmentDataManager:
         name = self.mutable.segment_name
         offset = int(str(self.current_offset))
         if self._catchup_target is not None and offset < self._catchup_target:
-            return  # keep consuming toward the committer's offset
+            # keep consuming toward the committer's offset — but re-report
+            # after a deadline anyway: the target may be unreachable (stream
+            # truncation, committer re-elected at a lower offset) and a
+            # silent replica would deadlock the segment
+            if time.time() < self._catchup_deadline:
+                return
+            self._catchup_target = None
         resp = self.completion.segment_consumed(self.instance_id, name,
                                                 offset)
         if resp.action == "HOLD":
@@ -193,6 +200,7 @@ class RealtimeSegmentDataManager:
             return
         if resp.action == "CATCHUP":
             self._catchup_target = resp.offset
+            self._catchup_deadline = time.time() + 10.0
             return
         self._catchup_target = None
         if resp.action == "COMMIT":

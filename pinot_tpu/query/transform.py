@@ -328,8 +328,14 @@ def _json_extract_scalar(expr: Function, p: ColumnProvider):
     if rtype in ("INT", "LONG"):
         if all(v is not None for v in out):
             return out.astype(np.int64)
-        # missing paths with no default: NaN-typed like the DOUBLE branch
-        # so aggregations see floats, not a mixed int/None object array
+        # missing paths with no default fall back to NaN floats (like the
+        # DOUBLE branch) — but only while every present value survives the
+        # f64 round trip; big int64s (snowflake ids) would silently alias
+        if any(v is not None and abs(int(v)) > (1 << 53) for v in out):
+            raise ValueError(
+                f"json_extract_scalar {rtype} over {path!r}: some documents "
+                "lack the path and values exceed float precision — pass an "
+                "explicit default argument")
         return np.array([np.nan if v is None else float(v) for v in out],
                         dtype=np.float64)
     if rtype in ("FLOAT", "DOUBLE"):

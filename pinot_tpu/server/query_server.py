@@ -156,11 +156,16 @@ class QueryServer:
             raise RuntimeError("query server failed to start")
 
     def stop(self) -> None:
-        if self._loop is not None:
+        """Idempotent: a failover test (or ops) may stop a server that was
+        already killed."""
+        if self._loop is not None and not self._loop.is_closed():
             def shutdown():
                 for task in asyncio.all_tasks(self._loop):
                     task.cancel()
-            self._loop.call_soon_threadsafe(shutdown)
+            try:
+                self._loop.call_soon_threadsafe(shutdown)
+            except RuntimeError:
+                pass  # loop closed between the check and the call
         if self._thread is not None:
             self._thread.join(timeout=5)
         self._pool.shutdown(wait=False)
