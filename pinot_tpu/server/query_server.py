@@ -97,10 +97,16 @@ class QueryServer:
     """Asyncio TCP server (the Netty QueryServer analog)."""
 
     def __init__(self, executor: ServerQueryExecutor, host: str = "127.0.0.1",
-                 port: int = 0, num_threads: int = 8):
+                 port: int = 0, num_threads: int = 8,
+                 scheduler: str = "fcfs"):
+        from pinot_tpu.server.scheduler import make_scheduler
         self.executor = executor
         self.host = host
         self.port = port
+        #: pluggable query scheduler (ref QuerySchedulerFactory.java:45 —
+        #: fcfs | priority | binary); owns the query worker threads
+        self.scheduler = make_scheduler(scheduler, num_threads)
+        self.scheduler.start()
         self._pool = ThreadPoolExecutor(max_workers=num_threads)
         self._server: Optional[asyncio.AbstractServer] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
@@ -115,11 +121,13 @@ class QueryServer:
                 n = _LEN.unpack(hdr)[0]
                 payload = await reader.readexactly(n)
                 req = json.loads(payload)
-                loop = asyncio.get_running_loop()
-                resp = await loop.run_in_executor(
-                    self._pool, self.executor.execute,
-                    req["tableName"], req["sql"], req.get("segments"),
-                    req.get("extraFilter"))
+                fut = self.scheduler.submit(
+                    lambda r=req: self.executor.execute(
+                        r["tableName"], r["sql"], r.get("segments"),
+                        r.get("extraFilter")),
+                    table=req.get("tableName", ""),
+                    workload=req.get("workload", "primary"))
+                resp = await asyncio.wrap_future(fut)
                 writer.write(_LEN.pack(len(resp)) + resp)
                 await writer.drain()
         except (asyncio.IncompleteReadError, ConnectionResetError):
@@ -168,6 +176,7 @@ class QueryServer:
                 pass  # loop closed between the check and the call
         if self._thread is not None:
             self._thread.join(timeout=5)
+        self.scheduler.stop()
         self._pool.shutdown(wait=False)
 
 

@@ -1,0 +1,109 @@
+"""Query schedulers: FCFS / token-priority fairness / binary workload.
+
+Ref: pinot-core query/scheduler/ (FCFSQueryScheduler, PriorityScheduler +
+token buckets, BinaryWorkloadScheduler) — SURVEY §2.5 schedulers row.
+"""
+import threading
+import time
+
+import pytest
+
+from pinot_tpu.server.scheduler import (
+    BinaryWorkloadScheduler, FCFSQueryScheduler, TokenPriorityScheduler,
+    make_scheduler)
+
+
+class TestFactory:
+    def test_names(self):
+        assert isinstance(make_scheduler("fcfs"), FCFSQueryScheduler)
+        assert isinstance(make_scheduler("priority"), TokenPriorityScheduler)
+        assert isinstance(make_scheduler("binary"), BinaryWorkloadScheduler)
+        with pytest.raises(ValueError):
+            make_scheduler("nope")
+
+
+class TestFcfs:
+    def test_runs_and_propagates(self):
+        s = make_scheduler("fcfs", num_threads=2)
+        try:
+            assert s.submit(lambda: b"ok").result(5) == b"ok"
+            fut = s.submit(lambda: (_ for _ in ()).throw(ValueError("x")))
+            with pytest.raises(ValueError):
+                fut.result(5)
+        finally:
+            s.stop()
+
+
+class TestTokenPriority:
+    def test_flooding_table_cannot_starve_light_one(self):
+        """One worker; table A floods 20 slow queries, then table B sends
+        2. B's queries must not wait behind A's whole backlog — A's spent
+        tokens push its priority below B's."""
+        s = TokenPriorityScheduler(num_threads=1, tokens_per_interval=10.0,
+                                   interval_s=0.1)
+        s.start()
+        try:
+            done = []
+
+            def slow(tag):
+                def run():
+                    time.sleep(0.02)
+                    done.append(tag)
+                    return b""
+                return run
+
+            futs = [s.submit(slow(("A", i)), table="A") for i in range(20)]
+            time.sleep(0.06)  # A starts burning tokens
+            futs += [s.submit(slow(("B", i)), table="B") for i in range(2)]
+            for f in futs:
+                f.result(20)
+            b_last = max(i for i, t in enumerate(done) if t[0] == "B")
+            a_last = max(i for i, t in enumerate(done) if t[0] == "A")
+            # B finished well before A's backlog drained
+            assert b_last < a_last, done
+            assert b_last < len(done) - 5, done
+        finally:
+            s.stop()
+
+    def test_exception_propagates_and_tokens_charged(self):
+        s = TokenPriorityScheduler(num_threads=2)
+        s.start()
+        try:
+            fut = s.submit(lambda: (_ for _ in ()).throw(RuntimeError("r")),
+                           table="t")
+            with pytest.raises(RuntimeError):
+                fut.result(5)
+            assert s.submit(lambda: b"fine", table="t").result(5) == b"fine"
+        finally:
+            s.stop()
+
+
+class TestBinaryWorkload:
+    def test_secondary_confined(self):
+        s = BinaryWorkloadScheduler(num_threads=4, secondary_threads=1)
+        try:
+            running = []
+            peak = []
+            lock = threading.Lock()
+
+            def slow():
+                with lock:
+                    running.append(1)
+                    peak.append(len(running))
+                time.sleep(0.05)
+                with lock:
+                    running.pop()
+                return b""
+
+            futs = [s.submit(slow, workload="secondary") for _ in range(4)]
+            for f in futs:
+                f.result(5)
+            assert max(peak) == 1  # secondary never exceeds its 1 thread
+
+            peak.clear()
+            futs = [s.submit(slow, workload="primary") for _ in range(4)]
+            for f in futs:
+                f.result(5)
+            assert max(peak) > 1  # primary parallelism intact
+        finally:
+            s.stop()
