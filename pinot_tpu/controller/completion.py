@@ -26,6 +26,10 @@ COMMIT = "COMMIT"
 KEEP = "KEEP"
 DISCARD = "DISCARD"
 
+#: segment_commit_end statuses (ref SegmentCompletionProtocol COMMIT_SUCCESS)
+COMMIT_SUCCESS = "COMMIT_SUCCESS"
+COMMIT_FAILED = "COMMIT_FAILED"
+
 
 @dataclass
 class CompletionResponse:
@@ -132,30 +136,35 @@ class SegmentCompletionManager:
 
     def segment_commit_end(self, instance: str, segment: str, offset: int,
                            download_path: Optional[str] = None,
-                           success: bool = True) -> None:
-        """The elected committer finished (or failed) its build+commit."""
+                           success: bool = True) -> str:
+        """The elected committer finished (or failed) its build+commit.
+
+        Returns COMMIT_SUCCESS only when this instance's commit was
+        accepted; a stale (de-elected or late) committer gets
+        COMMIT_FAILED and must discard its build and re-enter
+        segment_consumed to reconcile via KEEP/DISCARD against the real
+        committer's copy (ref SegmentCompletionProtocol response status)."""
         with self._lock:
             fsm = self._fsms.get(segment)
             if fsm is None:
-                return
+                return COMMIT_FAILED
             if fsm.state == "COMMITTED" or instance != fsm.committer:
-                # a stale (de-elected or late) committer must not reset or
-                # overwrite the FSM — its local seal simply diverges and
-                # reconciles via KEEP/DISCARD on its next report
-                return
+                # a stale committer must not reset or overwrite the FSM
+                return COMMIT_FAILED
             if not success:
                 # failed committer: drop its claim so the next reporter
                 # re-elects (ref FSM returning to HOLDING on commit failure)
                 fsm.state = "HOLDING"
                 fsm.committer = None
                 fsm.deadline = time.time() + self.hold_deadline_s
-                return
+                return COMMIT_FAILED
             fsm.state = "COMMITTED"
             fsm.committed_at = time.time()
             fsm.committed_offset = offset
             fsm.download_path = download_path
             fsm.acked.add(instance)  # the committer has its copy
             self._prune_locked()
+            return COMMIT_SUCCESS
 
     #: retained COMMITTED FSMs (a fresh FSM for an already-committed
     #: segment would re-elect and double-commit, so entries linger for

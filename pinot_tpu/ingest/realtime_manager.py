@@ -18,6 +18,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from pinot_tpu.controller.completion import COMMIT_SUCCESS
 from pinot_tpu.ingest.mutable_segment import MutableSegment
 from pinot_tpu.ingest.stream import (
     LongMsgOffset, StreamConfig, get_stream_factory)
@@ -206,16 +207,31 @@ class RealtimeSegmentDataManager:
         if resp.action == "COMMIT":
             try:
                 with self._seal_lock:
-                    out_dir = self._commit()
+                    sealed = self.mutable
+                    out_dir = self._build_immutable()
             except Exception:
                 # report the failure so the FSM re-elects instead of the
                 # other replicas HOLDing behind a dead claim
                 self.completion.segment_commit_end(
                     self.instance_id, name, 0, success=False)
                 raise
-            self.completion.segment_commit_end(
+            status = self.completion.segment_commit_end(
                 self.instance_id, name, int(str(self.current_offset)),
                 download_path=out_dir)
+            if status == COMMIT_SUCCESS:
+                with self._seal_lock:
+                    # a force_commit may have rotated self.mutable during
+                    # the unlocked controller round-trip — finalize only
+                    # the segment this build actually sealed
+                    if self.mutable is sealed:
+                        self._finalize_commit(out_dir)
+            else:
+                # de-elected while building (slow committer past the
+                # deadline): discard the build; the next end-criteria
+                # check re-enters segment_consumed and reconciles via
+                # KEEP/DISCARD against the actual committer's copy
+                import shutil
+                shutil.rmtree(out_dir, ignore_errors=True)
             return
         if resp.action == "KEEP":
             # offsets match the committed segment: seal the LOCAL copy
@@ -265,11 +281,22 @@ class RealtimeSegmentDataManager:
         (ref commitSegment, RealtimeSegmentDataManager.java:856,1164).
         Returns the built segment directory (the completion protocol
         advertises it as the peer-download location)."""
+        out_dir = self._build_immutable()
+        self._finalize_commit(out_dir)
+        return out_dir
+
+    def _build_immutable(self) -> str:
+        """Build the immutable copy on disk WITHOUT sealing/advancing —
+        under the completion protocol the seal only happens after the
+        controller accepts the commit (COMMIT_SUCCESS)."""
         sealed = self.mutable
-        name = sealed.segment_name
-        out_dir = os.path.join(self.store_dir, name)
+        out_dir = os.path.join(self.store_dir, sealed.segment_name)
         creator = SegmentCreator(self.table_config, self.schema)
-        creator.build(sealed.to_columns(), out_dir, name)
+        creator.build(sealed.to_columns(), out_dir, sealed.segment_name)
+        return out_dir
+
+    def _finalize_commit(self, out_dir: str) -> None:
+        sealed = self.mutable
         immutable = load_segment(out_dir)
         if self.upsert_manager is not None:
             # transfer validity: the immutable is a row-for-row rebuild of
@@ -280,10 +307,9 @@ class RealtimeSegmentDataManager:
         # swap BEFORE removing: add_segment replaces by name atomically
         self.tdm.add_segment(immutable)
         if self.on_commit is not None:
-            self.on_commit(name, self.current_offset)
+            self.on_commit(sealed.segment_name, self.current_offset)
         self._seq += 1
         self._open_new_consuming()
-        return out_dir
 
     def force_commit(self) -> None:
         """Ops hook (ref forceCommit REST): seal now regardless of criteria."""

@@ -165,12 +165,18 @@ class _MseParser(_Parser):
 
     def _select_operand(self):
         """Returns (query, was_parenthesized)."""
-        if self.peek().kind == "op" and self.peek().text == "(" \
-                and self.peek(1).upper in ("SELECT", "SET", "EXPLAIN"):
-            self.next()
-            q = self._set_expr()
-            self.expect_op(")")
-            return q, True
+        if self.peek().kind == "op" and self.peek().text == "(":
+            # peek through consecutive '('s: '((SELECT 1))' is a
+            # parenthesized operand just like '(SELECT 1)'
+            depth = 1
+            while self.peek(depth).kind == "op" \
+                    and self.peek(depth).text == "(":
+                depth += 1
+            if self.peek(depth).upper in ("SELECT", "SET", "EXPLAIN"):
+                self.next()
+                q = self._set_expr()
+                self.expect_op(")")
+                return q, True
         return self._select_stmt(), False
 
     def _select_stmt(self) -> MseQuery:

@@ -317,6 +317,10 @@ def make_topn_kernel(plan: DevicePlan):
         # value under ASC negation) still outranks every unmatched doc's
         # -inf sentinel; validity then reads the MASK at the winning docs
         fin = jnp.finfo(dt)
+        # NaN order values sort LAST (host sort parity: numpy puts NaN at
+        # the end) — clip passes NaN through and top_k would rank it first,
+        # so map it to the finite minimum among matched docs
+        score = jnp.where(jnp.isnan(score), fin.min, score)
         score = jnp.where(mask, jnp.clip(score, fin.min, fin.max), -jnp.inf)
         k = min(plan.topn_k, D)
         _top_vals, top_idx = jax.lax.top_k(score, k)

@@ -50,28 +50,33 @@ def flatten(doc: Any) -> List[Dict[str, str]]:
     """
     records: List[Dict[str, str]] = [{}]
 
-    def add(recs: List[Dict[str, str]], path: str, value: Any
+    # Each value is addressed by a set of alias paths (the indexed path plus
+    # its '[*]' forms); a single traversal writes every alias into the same
+    # flat record, so array nesting multiplies records only once per element.
+    def add(recs: List[Dict[str, str]], paths: List[str], value: Any
             ) -> List[Dict[str, str]]:
         if isinstance(value, dict):
             for k, v in value.items():
-                recs = add(recs, f"{path}.{k}" if path else str(k), v)
+                recs = add(
+                    recs, [f"{p}.{k}" if p else str(k) for p in paths], v)
             return recs
         if isinstance(value, list):
             if not value:
                 return recs
+            alias_per_elem = [
+                [q for p in paths for q in (f"{p}[{i}]", f"{p}[*]")]
+                for i in range(len(value))]
             out: List[Dict[str, str]] = []
             for rec in recs:
                 for i, v in enumerate(value):
-                    branch = [dict(rec)]
-                    branch = add(branch, f"{path}[{i}]", v)
-                    branch = add(branch, f"{path}[*]", v)
-                    out.extend(branch)
+                    out.extend(add([dict(rec)], alias_per_elem[i], v))
             return out
         for rec in recs:
-            rec[path] = _canon(value)
+            for p in paths:
+                rec[p] = _canon(value)
         return recs
 
-    return add(records, "", doc)
+    return add(records, [""], doc)
 
 
 class JsonIndex:

@@ -78,33 +78,65 @@ class TextIndex:
             return t
 
         def parse_or() -> np.ndarray:
-            out = parse_and()
+            # Lucene boolean semantics: clauses at one level combine as
+            # SHOULD (implicit/explicit OR) except NOT-clauses, which are
+            # MUST_NOT — subtracted from the union of the positive clauses
+            # ('apple NOT pie' = apple minus pie, never apple OR not-pie).
+            chains = []
             while True:
                 t = peek()
                 if t is None or t == ("op", ")"):
-                    return out
+                    break
                 if t == ("op", "OR"):
                     take()
-                # anything else: implicit OR between adjacent clauses
-                # (Lucene's default operator)
-                out = np.union1d(out, parse_and())
-
-        def parse_and() -> np.ndarray:
-            out = parse_unary()
-            while peek() == ("op", "AND"):
-                take()
-                out = np.intersect1d(out, parse_unary())
+                    continue
+                chains.append(parse_and())
+            positives = [p for p, _ in chains if p is not None]
+            prohibited = [n for p, n in chains if p is None and n is not None]
+            if positives:
+                out = positives[0]
+                for s in positives[1:]:
+                    out = np.union1d(out, s)
+            elif prohibited:  # pure-negative query: complement
+                out = np.arange(self.num_docs, dtype=np.int32)
+            else:
+                out = np.empty(0, np.int32)
+            for s in prohibited:
+                out = np.setdiff1d(out, s)
             return out
 
-        def parse_unary() -> np.ndarray:
+        def parse_and():
+            """One AND-chain -> (positive_result|None, prohibited|None)."""
+            positive = None
+            has_positive = False
+            prohibited = None
+            while True:
+                neg = False
+                while peek() == ("op", "NOT"):
+                    take()
+                    neg = not neg
+                opnd = parse_atom()
+                if neg:
+                    prohibited = opnd if prohibited is None \
+                        else np.union1d(prohibited, opnd)
+                else:
+                    positive = opnd if not has_positive \
+                        else np.intersect1d(positive, opnd)
+                    has_positive = True
+                if peek() == ("op", "AND"):
+                    take()
+                    continue
+                break
+            if has_positive:
+                if prohibited is not None:
+                    positive = np.setdiff1d(positive, prohibited)
+                return positive, None
+            return None, prohibited
+
+        def parse_atom() -> np.ndarray:
             t = peek()
             if t is None:  # trailing operator ('a AND'): nothing matches
                 return np.empty(0, np.int32)
-            if t == ("op", "NOT"):
-                take()
-                inner = parse_unary()
-                return np.setdiff1d(
-                    np.arange(self.num_docs, dtype=np.int32), inner)
             if t == ("op", "("):
                 take()
                 inner = parse_or()

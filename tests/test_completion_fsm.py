@@ -155,3 +155,42 @@ class TestTwoReplicaIntegration:
             assert counts[0][1] == pytest.approx(sum(range(250)))
         finally:
             InMemoryStream.delete("fsm_topic")
+
+
+class TestStaleCommitter:
+    """ADVICE r4: a de-elected slow committer must not seal+advance —
+    segment_commit_end returns a status and the manager discards stale
+    builds, reconciling via KEEP/DISCARD on its next report."""
+
+    def test_commit_end_returns_status(self):
+        m = SegmentCompletionManager(num_replicas=1)
+        seg = "t__0__0__1"
+        assert m.segment_consumed("s0", seg, 100).action == COMMIT
+        assert m.segment_commit_end("s0", seg, 100, "/tmp/x") \
+            == "COMMIT_SUCCESS"
+        # a second (stale) commit attempt is rejected
+        assert m.segment_commit_end("s1", seg, 90, "/tmp/y") \
+            == "COMMIT_FAILED"
+
+    def test_deelected_committer_gets_failed_then_discard(self):
+        m = SegmentCompletionManager(num_replicas=2, hold_deadline_s=0.05)
+        seg = "t__0__0__2"
+        assert m.segment_consumed("s0", seg, 100).action == HOLD
+        assert m.segment_consumed("s1", seg, 100).action == HOLD
+        # the tie-broken winner (s0) re-polls and is told to COMMIT
+        assert m.segment_consumed("s0", seg, 100).action == COMMIT
+        winner, loser = "s0", "s1"
+        # winner goes silent past the commit deadline -> re-election
+        time.sleep(0.05 * SegmentCompletionManager.COMMIT_TIMEOUT_FACTOR
+                   + 0.05)
+        r = m.segment_consumed(loser, seg, 100)
+        assert r.action == COMMIT
+        assert m.segment_commit_end(loser, seg, 100, "/d") \
+            == "COMMIT_SUCCESS"
+        # the original winner's late commit_end is REJECTED
+        assert m.segment_commit_end(winner, seg, 105, "/stale") \
+            == "COMMIT_FAILED"
+        # and its next report reconciles (offset ahead -> DISCARD)
+        r = m.segment_consumed(winner, seg, 105)
+        assert r.action == DISCARD
+        assert r.download_path == "/d"

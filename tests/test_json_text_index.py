@@ -255,3 +255,38 @@ class TestSqlIntegration:
         want = sum(1 for i, d in enumerate(docs)
                    if i < 100 and json.loads(d)["city"] == "ny")
         assert resp.result_table.rows[0][0] == want
+
+
+class TestAdviceR4Fixes:
+    """Regression tests for advisor round-4 findings."""
+
+    def test_nested_array_flatten_is_linear_not_cartesian(self):
+        # ADVICE r4: two chained traversals per array element squared the
+        # record count and mixed values from different elements
+        recs = flatten({"a": [{"b": [1, 2]}]})
+        assert len(recs) == 2
+        # every record is internally consistent: [*] value == indexed value
+        for r in recs:
+            star = r["a[*].b[*]"]
+            indexed = [v for k, v in r.items()
+                       if "[0]" in k or "[1]" in k]
+            assert all(v == star for v in indexed), r
+
+    def test_nested_array_conjunction_no_false_positive(self):
+        docs = [json.dumps({"a": [{"b": [1]}, {"b": [2]}]})]
+        idx = JsonIndex.build(docs, 1)
+        from pinot_tpu.query.filter import parse_filter_string
+        # 1 and 2 live in different elements of a: a conjunction over
+        # [*].b[*] must NOT match within one flat record
+        expr = parse_filter_string('"a[*].b[*]" = 1 AND "a[*].b[*]" = 2')
+        assert idx.matching_docs(expr).tolist() == []
+
+    def test_text_not_is_prohibited_clause(self):
+        vals = ["apple pie", "apple tart", "cherry pie", "banana split"]
+        ix = TextIndex.build(vals, 4)
+        assert ix.matching_docs("apple NOT pie", vals).tolist() == [1]
+        assert ix.matching_docs("apple AND NOT pie", vals).tolist() == [1]
+        assert ix.matching_docs("NOT pie", vals).tolist() == [1, 3]
+        assert ix.matching_docs("NOT apple AND pie", vals).tolist() == [2]
+        # positive-only behavior is unchanged (implicit OR)
+        assert ix.matching_docs("apple pie", vals).tolist() == [0, 1, 2]
