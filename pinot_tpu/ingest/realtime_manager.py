@@ -230,8 +230,13 @@ class RealtimeSegmentDataManager:
                 # deadline): discard the build; the next end-criteria
                 # check re-enters segment_consumed and reconciles via
                 # KEEP/DISCARD against the actual committer's copy
-                import shutil
-                shutil.rmtree(out_dir, ignore_errors=True)
+                with self._seal_lock:
+                    if self.mutable is sealed:
+                        # (if a force_commit rotated the mutable meanwhile,
+                        # out_dir now backs a live registered segment —
+                        # leave it alone)
+                        import shutil
+                        shutil.rmtree(out_dir, ignore_errors=True)
             return
         if resp.action == "KEEP":
             # offsets match the committed segment: seal the LOCAL copy

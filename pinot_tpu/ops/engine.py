@@ -133,6 +133,12 @@ class TpuOperatorExecutor:
                 return False
         if any(fn.device_spec is None for fn in ctx.agg_functions):
             return False
+        if ctx.group_by and any(
+                ":" in op for fn in ctx.agg_functions
+                for op in fn.device_spec.ops):
+            # sketch slots (hll/hist) are vector-valued; the grouped packed
+            # layout is scalar-per-slot — grouped sketches stay host-side
+            return False
         for node in ctx.aggregations:
             if node.args and not (isinstance(node.args[0], Identifier)
                                   and node.args[0].name == "*"):
@@ -337,6 +343,21 @@ class TpuOperatorExecutor:
                 return True
             return all(check_value_cols(c) for c in ir[1:] if isinstance(c, tuple))
 
+        # device-HLL inputs hash i32 split planes of plain int columns —
+        # they join the raw64 staging set and are excluded from value IRs
+        hll_cols: set = set()
+        for node, fn in zip(ctx.aggregations, ctx.agg_functions):
+            spec = fn.device_spec
+            if spec is None:
+                return None
+            if any(op.startswith("hll:") for op in spec.ops):
+                col = node.args[0].name
+                m0 = seg0.metadata.columns.get(col)
+                if m0 is None or not m0.single_value \
+                        or m0.data_type.np_dtype.kind not in "iu":
+                    return None
+                hll_cols.add(col)
+
         # filter IR FIRST: leaves fill in build order, so the main filter's
         # leaves precede agg-filter leaves (staging resolves in this order)
         leaves: List[DeviceLeaf] = []
@@ -349,7 +370,8 @@ class TpuOperatorExecutor:
 
         #: columns that stage as split planes carry NO 'val:' block — they
         #: cannot feed value IRs (the whole query falls back instead)
-        raw64 = {lf.column for lf in leaves if lf.kind == "vrange64"}
+        raw64 = {lf.column for lf in leaves
+                 if lf.kind == "vrange64"} | hll_cols
 
         # per-aggregation FILTER (WHERE ...) trees, deduplicated
         agg_filter_irs: List[tuple] = []
@@ -370,25 +392,59 @@ class TpuOperatorExecutor:
             agg_filter_irs.append(ir)
         raw64 |= {lf.column for lf in leaves if lf.kind == "vrange64"}
 
+        if ctx.group_by and any(
+                ":" in op for fn in ctx.agg_functions
+                for op in fn.device_spec.ops):
+            return None  # grouped sketches: host path (see supports)
+
         # aggregation slots
         agg_ops: List[Tuple[str, Optional[int], Optional[int]]] = []
         slot_index: Dict[Tuple[str, Optional[int], Optional[int]], int] = {}
         slots_of_fn: List[Dict[str, int]] = []
         for i, (node, fn) in enumerate(zip(ctx.aggregations,
                                            ctx.agg_functions)):
+            spec_ops = fn.device_spec.ops
+            is_hll = any(op.startswith("hll:") for op in spec_ops)
             arg_ir = None
-            if node.args and not (isinstance(node.args[0], Identifier)
-                                  and node.args[0].name == "*"):
+            if not is_hll and node.args \
+                    and not (isinstance(node.args[0], Identifier)
+                             and node.args[0].name == "*"):
                 arg_ir = self._value_ir_shape(node.args[0])
                 if arg_ir is None or not check_value_cols(arg_ir):
                     return None
             vidx = intern_ir(arg_ir)
             fidx = agg_fidx[i]
+            # bit-exact SUM for plain int columns under f32 staging: swap
+            # the slot to 'isum' (6-bit-plane i32 accumulation, ref
+            # SumAggregationFunction's exact doubles); _assemble rebuilds
+            # the scalar so the function still sees its 'sum' slot.
+            # Grouped sums stay f32 (scalar-slot packing) — documented
+            # approximation.
+            exact_int_sum = (
+                not ctx.group_by
+                and arg_ir is not None
+                and not jax.config.read("jax_enable_x64")
+                and self._int_ir_bounds(segments, arg_ir) is not None)
             mapping = {}
-            for op in fn.device_spec.ops:
-                key = (op, None if op == "count" else vidx, fidx)
-                if op != "count" and vidx is None:
-                    return None
+            for op in spec_ops:
+                if op == "sum" and exact_int_sum:
+                    op_key = "isum"
+                    key = (op_key, vidx, fidx)
+                    if key not in slot_index:
+                        slot_index[key] = len(agg_ops)
+                        agg_ops.append(key)
+                    mapping[op] = slot_index[key]
+                    continue
+                if op.startswith("hll:"):
+                    # column rides in the op key (the kernel reads its
+                    # split planes directly, no value IR)
+                    key = (f"{op}:{node.args[0].name}", None, fidx)
+                elif op == "count":
+                    key = ("count", None, fidx)
+                else:
+                    if vidx is None:
+                        return None
+                    key = (op, vidx, fidx)
                 if key not in slot_index:
                     slot_index[key] = len(agg_ops)
                     agg_ops.append(key)
@@ -441,7 +497,8 @@ class TpuOperatorExecutor:
                 slot_index[("count", None, None)] = len(agg_ops)
                 agg_ops.append(("count", None, None))
 
-        raw64 = {lf.column for lf in leaves if lf.kind == "vrange64"}
+        raw64 = {lf.column for lf in leaves
+                 if lf.kind == "vrange64"} | hll_cols
         if group_compact:
             # the gkey block replaces per-column id planes for group-only
             # columns; keep ids only where filters/values still need them
@@ -677,6 +734,19 @@ class TpuOperatorExecutor:
         G = 0
         if plan.group_compact:
             cols["gkey"], G = self._stage_gkey(segments, S, D, plan)
+
+        # histogram sketch slots: bucket bounds from segment metadata
+        # (missing min/max -> host fallback); computed before the params
+        # cache so cache hits still carry them
+        for j, (op, vidx, _fidx) in enumerate(plan.agg_ops):
+            if not op.startswith("hist:"):
+                continue
+            col = plan.value_irs[vidx][1]
+            lo, span = self._hist_bounds(segments, col)
+            B = int(op.split(":")[1])
+            params[f"slot{j}:hlo"] = self._put(np.full(S, lo, dtype=vdt))
+            params[f"slot{j}:hscale"] = self._put(
+                np.full(S, B / span, dtype=vdt))
 
         # per-leaf predicate parameters (cached: filters are frozen
         # expression trees, so they key the resolved literals exactly)
@@ -915,6 +985,68 @@ class TpuOperatorExecutor:
             old_key, _entry = self._block_cache.popitem(last=False)
             self._cache_bytes -= self._block_bytes.pop(old_key)
 
+    @staticmethod
+    def _int_ir_bounds(segments, ir) -> Optional[Tuple[int, int]]:
+        """Interval bounds of an int-valued value IR over the batch's
+        metadata, or None when any column is non-int / unbounded or any
+        node (incl. intermediates) can overflow i32 — the admission test
+        for the exact 'isum' device path (kernels._eval_value_int)."""
+        LIM = (1 << 31) - 1
+
+        def rec(node) -> Optional[Tuple[int, int]]:
+            op = node[0]
+            if op == "col":
+                lo, hi = None, None
+                for seg in segments:
+                    m = seg.metadata.columns.get(node[1])
+                    if m is None or m.data_type.np_dtype.kind not in "iu" \
+                            or m.min_value is None or m.max_value is None:
+                        return None
+                    lo = int(m.min_value) if lo is None \
+                        else min(lo, int(m.min_value))
+                    hi = int(m.max_value) if hi is None \
+                        else max(hi, int(m.max_value))
+                return (lo, hi) if lo is not None else None
+            if op == "lit":
+                v = float(node[1])
+                if not v.is_integer():
+                    return None
+                return _clamp((int(v), int(v)))
+            if op == "neg":
+                a = rec(node[1])
+                return None if a is None else _clamp((-a[1], -a[0]))
+            if op not in ("add", "sub", "mul"):
+                return None
+            a, b = rec(node[1]), rec(node[2])
+            if a is None or b is None:
+                return None
+            if op == "add":
+                out = (a[0] + b[0], a[1] + b[1])
+            elif op == "sub":
+                out = (a[0] - b[1], a[1] - b[0])
+            else:
+                corners = [x * y for x in a for y in b]
+                out = (min(corners), max(corners))
+            return _clamp(out)
+
+        def _clamp(bounds):
+            return bounds if -LIM <= bounds[0] and bounds[1] <= LIM else None
+
+        return rec(ir)
+
+    @staticmethod
+    def _hist_bounds(segments, col: str) -> Tuple[float, float]:
+        """Global (lo, span) histogram bounds over the batch's segment
+        metadata min/max; span clamped positive so scale stays finite."""
+        lo, hi = np.inf, -np.inf
+        for seg in segments:
+            m = seg.metadata.columns.get(col)
+            if m is None or m.min_value is None or m.max_value is None:
+                raise _NotStageable()
+            lo = min(lo, float(m.min_value))
+            hi = max(hi, float(m.max_value))
+        return lo, max(hi - lo, 1e-30)
+
     def _check_value_precision(self, segments, col: str, vdt) -> None:
         """float32 staging (x64 off, the TPU default) is exact only for
         integers with |v| <= 2^24; larger int/long columns (e.g. epoch
@@ -985,6 +1117,15 @@ class TpuOperatorExecutor:
             if node.args and not (isinstance(node.args[0], Identifier)
                                   and node.args[0].name == "*"))
         count_j = None
+        widths = [kernels.slot_width(op) for op, _v, _f in plan.agg_ops]
+        slot_offsets = np.concatenate(
+            [[0], np.cumsum(widths)]).astype(int)
+        # hist bucket bounds are batch-global: compute once per slot, not
+        # per segment x function
+        hist_bounds = {
+            j: self._hist_bounds(segments, plan.value_irs[vidx][1])
+            for j, (op, vidx, _f) in enumerate(plan.agg_ops)
+            if op.startswith("hist:")}
         is_group = bool(plan.num_groups or plan.group_compact)
         if is_group:
             for j, (op, _vidx, fidx) in enumerate(plan.agg_ops):
@@ -1012,7 +1153,19 @@ class TpuOperatorExecutor:
             else:
                 inters = []
                 for fn, mapping in zip(ctx.agg_functions, mappings):
-                    slots = {op: packed[s, 1 + j] for op, j in mapping.items()}
+                    slots = {}
+                    for op, j in mapping.items():
+                        off = 1 + slot_offsets[j]
+                        w = widths[j]
+                        if plan.agg_ops[j][0] == "isum":
+                            slots[op] = _isum_value(packed[s, off:off + w])
+                            continue
+                        slots[op] = packed[s, off] if w == 1 \
+                            else packed[s, off:off + w]
+                        if op.startswith("hist:"):
+                            lo, span = hist_bounds[j]
+                            slots["hist_lo"] = lo
+                            slots["hist_width"] = span / w
                     inters.append(fn.from_device_slots(slots))
                 results.append(AggregationResult(inters, stats))
         return results
@@ -1052,6 +1205,17 @@ class TpuOperatorExecutor:
                 inters.append(fn.from_device_slots(slots))
             groups[key] = inters
         return GroupByResult(groups, stats)
+
+
+def _isum_value(planes: np.ndarray) -> float:
+    """Rebuild the exact int sum from the isum slot's packed planes
+    (kernels._isum_slot): pairs of f32-exact signed (hi, lo) halves per
+    6-bit value digit, top digit sign-carrying."""
+    total = 0
+    for k in range(kernels.ISUM_PLANES):
+        s = int(planes[2 * k]) * 4096 + int(planes[2 * k + 1])
+        total += s << (6 * k)
+    return float(total)
 
 
 def _entry_nbytes(a) -> int:

@@ -216,6 +216,120 @@ def _vmap_scatter(init: jnp.ndarray, keys: jnp.ndarray, vals: jnp.ndarray,
 
 
 # ---------------------------------------------------------------------------
+# Sketch slots (device HLL registers / histogram partials)
+# ---------------------------------------------------------------------------
+
+def slot_width(op: str) -> int:
+    """Per-segment output width of a slot op (1 for scalar reductions;
+    sketch ops return register/bucket vectors; isum returns exact-sum
+    planes)."""
+    if op.startswith("hll:"):
+        return 1 << int(op.split(":")[1])
+    if op.startswith("hist:"):
+        return int(op.split(":")[1])
+    if op == "isum":
+        return ISUM_WIDTH
+    return 1
+
+
+#: exact integer SUM slot: 6 signed six-bit planes of the i32-evaluated
+#: value (v = sum_k plane_k << 6k, top plane arithmetic-shifted so sign
+#: rides it), each plane i32-summed exactly (63 * 2^24 docs < 2^31) and
+#: returned as f32-exact (hi, lo) 12-bit halves — see _isum_slot
+ISUM_PLANES = 6
+ISUM_WIDTH = 2 * ISUM_PLANES
+
+
+def _eval_value_int(ir, cols) -> jnp.ndarray:
+    """Evaluate a value IR in EXACT int32 arithmetic (staged f32 blocks
+    hold int-exact values <= 2^24; the engine admits only IRs whose
+    interval bounds — including every intermediate node — fit i32, so no
+    multiply/add here can overflow)."""
+    op = ir[0]
+    if op == "col":
+        return cols["val:" + ir[1]].astype(jnp.int32)
+    if op == "lit":
+        return jnp.int32(int(ir[1]))
+    a = _eval_value_int(ir[1], cols)
+    if op == "neg":
+        return -a
+    b = _eval_value_int(ir[2], cols)
+    if op == "add":
+        return a + b
+    if op == "sub":
+        return a - b
+    if op == "mul":
+        return a * b
+    raise ValueError(f"non-exact int ir op {op}")
+
+
+def _isum_slot(vi, mv) -> jnp.ndarray:
+    """Bit-exact SUM of an i32-evaluated value with x64 off: split into
+    signed 6-bit planes (digits 0-4 masked, top digit arithmetic-shifted),
+    reduce each plane in int32 (never overflows), then split each plane
+    sum into two f32-exact 12-bit halves. Host reconstructs
+    sum = sum_k (hi_k * 4096 + lo_k) << 6k  (engine _isum_value).
+    Ref SumAggregationFunction's exact double accumulation."""
+    vi = jnp.where(mv, vi, 0)
+    dt = _value_dtype()
+    parts = []
+    for k in range(ISUM_PLANES):
+        if k < ISUM_PLANES - 1:
+            p = (vi >> jnp.int32(6 * k)) & jnp.int32(63)
+        else:
+            p = vi >> jnp.int32(30)  # signed top digit
+        s = jnp.sum(p, axis=1, dtype=jnp.int32)
+        parts.append((s >> jnp.int32(12)).astype(dt))  # signed hi half
+        parts.append((s & jnp.int32(4095)).astype(dt))
+    return jnp.stack(parts, axis=1)
+
+
+def _fmix32(h):
+    """murmur3 finalizer — keep in lockstep with sketches._fmix32."""
+    h = h ^ (h >> jnp.uint32(16))
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> jnp.uint32(13))
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> jnp.uint32(16))
+    return h
+
+
+def _hll_slot(op: str, cols, mask) -> jnp.ndarray:
+    """HLL register partials [S, m]: hash the (hi, lo) i32 split planes,
+    bucket by h1's low log2m bits, rank = clz(h2)+1, max-scatter into
+    registers (ref DistinctCountHLLAggregationFunction; the scatter is the
+    same machinery as the group-by max path). Bit-identical to the host
+    sketch (sketches.HyperLogLog.add_array)."""
+    _, log2m_s, col = op.split(":", 2)
+    m = 1 << int(log2m_s)
+    hi = cols["valhi:" + col].astype(jnp.uint32)
+    lo = cols["vallo:" + col].astype(jnp.uint32)
+    h1 = _fmix32(_fmix32(lo ^ jnp.uint32(0x9E3779B9)) ^ hi)
+    h2 = _fmix32(_fmix32(hi ^ jnp.uint32(0x85EBCA77)) ^ lo)
+    bucket = (h1 & jnp.uint32(m - 1)).astype(jnp.int32)
+    rank = jnp.where(h2 == 0, 33,
+                     jax.lax.clz(h2.astype(jnp.int32)) + 1)
+    dt = _value_dtype()
+    rank = jnp.where(mask, rank, 0).astype(dt)  # 0 = empty register
+    bucket = jnp.where(mask, bucket, 0)
+    init = jnp.zeros((mask.shape[0], m), dtype=dt)
+    return _vmap_scatter(init, bucket, rank, "max")
+
+
+def _hist_slot(op: str, j: int, vals, params, mask) -> jnp.ndarray:
+    """Fixed-bucket histogram partials [S, B] over the value block:
+    bucket = clip((v - lo) * scale) then masked scatter-add (feeds
+    TDigest centroids host-side, ref PercentileTDigestAggregationFunction)."""
+    B = int(op.split(":")[1])
+    lo = params[f"slot{j}:hlo"][:, None]
+    scale = params[f"slot{j}:hscale"][:, None]
+    bucket = jnp.clip((vals - lo) * scale, 0, B - 1).astype(jnp.int32)
+    bucket = jnp.where(mask, bucket, 0)
+    contrib = mask.astype(_value_dtype())
+    return _scatter_sum(contrib, bucket, B)
+
+
+# ---------------------------------------------------------------------------
 # Kernel assembly
 # ---------------------------------------------------------------------------
 
@@ -254,9 +368,20 @@ def _compute_slots(plan: DevicePlan, cols, params, valid, G: int = 0):
                                               num_groups)))
         return slots, None
     matched = jnp.sum(mask & valid, axis=1).astype(dt)
-    for op, vidx, fidx in plan.agg_ops:
-        vals = None if vidx is None else values[vidx]
+    for j, (op, vidx, fidx) in enumerate(plan.agg_ops):
         m = mask if fidx is None else mask & agg_masks[fidx]
+        if op.startswith("hll:"):
+            slots.append((op, _hll_slot(op, cols, m & valid)))
+            continue
+        if op.startswith("hist:"):
+            slots.append((op, _hist_slot(op, j, values[vidx], params,
+                                         m & valid)))
+            continue
+        if op == "isum":
+            vi = _eval_value_int(plan.value_irs[vidx], cols)
+            slots.append((op, _isum_slot(vi, m & valid)))
+            continue
+        vals = None if vidx is None else values[vidx]
         slots.append((op, _masked_reduce(op, vals, m, valid)))
     return slots, matched
 
@@ -282,9 +407,18 @@ def make_kernel(plan: DevicePlan):
         slots, matched = _compute_slots(plan, cols, params, valid, G)
         if plan.num_groups or G:
             return jnp.stack([s for _, s in slots], axis=-1)
-        return jnp.stack([matched] + [s for _, s in slots], axis=-1)
+        return _pack_flat(matched, slots)
 
     return kernel
+
+
+def _pack_flat(matched, slots):
+    """[S]-scalar and [S, w]-vector (sketch) slots -> one [S, 1 + sum(w)]
+    array (single device->host fetch; _assemble indexes by slot offsets)."""
+    parts = [matched[:, None]]
+    for _op, s in slots:
+        parts.append(s[:, None] if s.ndim == 1 else s)
+    return jnp.concatenate(parts, axis=1)
 
 
 def make_topn_kernel(plan: DevicePlan):
@@ -353,7 +487,14 @@ def compiled_kernel(plan: DevicePlan):
 
 _DOC_COMBINE = {"sum": "psum", "count": "psum", "sumsq": "psum",
                 "sum3": "psum", "sum4": "psum",
-                "min": "pmin", "max": "pmax"}
+                "min": "pmin", "max": "pmax",
+                "hll": "pmax",   # register maxima merge across doc shards
+                "hist": "psum",  # bucket counts add across doc shards
+                "isum": "psum"}  # exact-sum planes add (halves stay small)
+
+
+def _doc_combine(op: str) -> str:
+    return _DOC_COMBINE[op.split(":")[0]]
 
 
 def make_sharded_kernel(plan: DevicePlan, mesh):
@@ -385,17 +526,18 @@ def make_sharded_kernel(plan: DevicePlan, mesh):
         slots, matched = _compute_slots(plan, cols, params, valid, G)
         combined = []
         for op, s in slots:
-            kind = _DOC_COMBINE[op]
+            kind = _doc_combine(op)
             if kind == "psum":
-                combined.append(jax.lax.psum(s, "docs"))
+                s = jax.lax.psum(s, "docs")
             elif kind == "pmin":
-                combined.append(jax.lax.pmin(s, "docs"))
+                s = jax.lax.pmin(s, "docs")
             else:
-                combined.append(jax.lax.pmax(s, "docs"))
+                s = jax.lax.pmax(s, "docs")
+            combined.append((op, s))
         if plan.num_groups or G:
-            return jnp.stack(combined, axis=-1)
+            return jnp.stack([s for _, s in combined], axis=-1)
         matched = jax.lax.psum(matched, "docs")
-        return jnp.stack([matched] + combined, axis=-1)
+        return _pack_flat(matched, combined)
 
     def col_spec(name):
         return P("segments", "docs")  # every staged block is [S, D]

@@ -276,6 +276,22 @@ class DistinctCountHLLAggregation(AggregationFunction):
         return intermediate.cardinality()
 
     @property
+    def device_spec(self):
+        """Device path: registers computed by a hash->bucket->max-scatter
+        kernel over the column's i32 split planes (ops/kernels.py 'hll'
+        op); bit-identical to the host sketch, so partials merge exactly.
+        Plain-column args only (the kernel hashes staged planes)."""
+        from pinot_tpu.query.expressions import Identifier
+        if self.args and isinstance(self.args[0], Identifier) \
+                and self.args[0].name != "*":
+            return DeviceAggSpec(ops=(f"hll:{self._log2m()}",))
+        return None
+
+    def from_device_slots(self, slots):
+        return HyperLogLog.from_registers(
+            slots[f"hll:{self._log2m()}"], self._log2m())
+
+    @property
     def final_dtype(self):
         return "LONG"
 
@@ -344,6 +360,10 @@ class PercentileTDigestAggregation(AggregationFunction):
             float(args[2].value) if len(args) > 2 and isinstance(args[2], Literal)
             else 100.0)
 
+    #: device histogram resolution (quantile error <= one bucket width of
+    #: the column's [min, max] range on top of the digest's own error)
+    DEVICE_BUCKETS = 8192
+
     def aggregate(self, values, mask):
         td = TDigest(self._compression)
         td.add_array(_masked(values, mask))
@@ -357,6 +377,23 @@ class PercentileTDigestAggregation(AggregationFunction):
 
     def extract_final(self, intermediate):
         return intermediate.quantile(self._pct / 100.0)
+
+    @property
+    def device_spec(self):
+        """Device path: fixed-bucket histogram partials (scatter-add over
+        value buckets, bounds from segment metadata min/max) converted to
+        centroid weights host-side. Plain-column args only (bucket bounds
+        come from that column's metadata)."""
+        from pinot_tpu.query.expressions import Identifier
+        if self.args and isinstance(self.args[0], Identifier) \
+                and self.args[0].name != "*":
+            return DeviceAggSpec(ops=(f"hist:{self.DEVICE_BUCKETS}",))
+        return None
+
+    def from_device_slots(self, slots):
+        return TDigest.from_histogram(
+            slots["hist_lo"], slots["hist_width"],
+            slots[f"hist:{self.DEVICE_BUCKETS}"], self._compression)
 
 
 @register

@@ -17,28 +17,38 @@ import numpy as np
 
 class HyperLogLog:
     """Classic HLL with 2^log2m registers and linear-counting small-range
-    correction."""
+    correction.
+
+    The hash is a 32-bit pair (bucket from h1, rank from clz(h2)+1) over
+    the value's (hi = v >> 24, lo = v & 0xFFFFFF) i32 split planes — the
+    SAME planes the device engine stages for big-int columns, so the TPU
+    kernel (ops/kernels.py hll op) produces bit-identical registers and
+    device/host sketches merge exactly.
+    """
 
     def __init__(self, log2m: int = 12):
         self.log2m = log2m
         self.m = 1 << log2m
         self.registers = np.zeros(self.m, dtype=np.uint8)
 
+    @classmethod
+    def from_registers(cls, registers: np.ndarray,
+                       log2m: int = 12) -> "HyperLogLog":
+        out = cls(log2m)
+        np.maximum(out.registers, registers.astype(np.uint8),
+                   out=out.registers)
+        return out
+
     def add_array(self, values: np.ndarray) -> None:
         if len(values) == 0:
             return
-        hashes = _hash64(values)
-        idx = (hashes >> np.uint64(64 - self.log2m)).astype(np.int64)
-        rest = hashes << np.uint64(self.log2m)
-        # rank = leading zeros of the remaining bits + 1, capped
-        nbits = 64 - self.log2m
-        rank = np.full(len(hashes), nbits + 1, dtype=np.uint8)
-        found = np.zeros(len(hashes), dtype=bool)
-        for b in range(nbits):
-            bit = (rest >> np.uint64(63 - b)) & np.uint64(1)
-            newly = (~found) & (bit == 1)
-            rank[newly] = b + 1
-            found |= newly
+        hi, lo = _split_planes(values)
+        h1, h2 = hash32_pair(hi, lo)
+        idx = (h1 & np.uint32(self.m - 1)).astype(np.int64)
+        # rank = leading zeros of h2 + 1 (h2 == 0 -> 33); frexp is exact:
+        # h2 = frac * 2^e with frac in [0.5, 1) -> clz = 32 - e
+        _frac, e = np.frexp(h2.astype(np.float64))
+        rank = np.where(h2 != 0, 33 - e, 33).astype(np.uint8)
         np.maximum.at(self.registers, idx, rank)
 
     def merge(self, other: "HyperLogLog") -> "HyperLogLog":
@@ -56,6 +66,48 @@ class HyperLogLog:
             if zeros:
                 est = m * np.log(m / zeros)
         return int(round(est))
+
+
+def _split_planes(values: np.ndarray):
+    """Value array -> (hi, lo) uint32 planes matching the device engine's
+    big-int staging (ops/engine.py _stage raw64: hi = v >> 24 as i32,
+    lo = v & 0xFFFFFF)."""
+    if values.dtype.kind in "iu":
+        v = values.astype(np.int64)
+        hi = (v >> 24).astype(np.int32).astype(np.uint32)
+        lo = (v & 0xFFFFFF).astype(np.int32).astype(np.uint32)
+        return hi, lo
+    if values.dtype.kind == "f":
+        x = values.astype(np.float64).view(np.uint64)
+    else:
+        x = np.array([hash(v) & 0xFFFFFFFFFFFFFFFF for v in values.tolist()],
+                     dtype=np.uint64)
+    # fold the top bits (sign + high exponent) into hi so +x/-x and
+    # exponent-distant values don't collide
+    hi = (((x >> np.uint64(24)) ^ (x >> np.uint64(56)))
+          & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    lo = (x & np.uint64(0xFFFFFF)).astype(np.uint32)
+    return hi, lo
+
+
+def _fmix32(h: np.ndarray) -> np.ndarray:
+    """murmur3 32-bit finalizer (wrapping uint32 arithmetic)."""
+    h = h ^ (h >> np.uint32(16))
+    h = h * np.uint32(0x85EBCA6B)
+    h = h ^ (h >> np.uint32(13))
+    h = h * np.uint32(0xC2B2AE35)
+    h = h ^ (h >> np.uint32(16))
+    return h
+
+
+def hash32_pair(hi: np.ndarray, lo: np.ndarray):
+    """Two decorrelated 32-bit avalanche hashes over (hi, lo) planes.
+    Mirrored exactly by the device kernel (ops/kernels.py) in uint32 —
+    keep both implementations in lockstep."""
+    with np.errstate(over="ignore"):
+        h1 = _fmix32(_fmix32(lo ^ np.uint32(0x9E3779B9)) ^ hi)
+        h2 = _fmix32(_fmix32(hi ^ np.uint32(0x85EBCA77)) ^ lo)
+    return h1, h2
 
 
 def _hash64(values: np.ndarray) -> np.ndarray:
@@ -91,14 +143,33 @@ class TDigest:
         self._buf_weights: list = []
         self.total = 0.0
 
+    #: buffered points before a re-cluster (compress is vectorized, so a
+    #: large buffer amortizes the sort)
+    BUFFER = 1 << 16
+
     def add_array(self, values: np.ndarray) -> None:
         if len(values) == 0:
             return
-        self._buf_means.extend(values.astype(np.float64).tolist())
-        self._buf_weights.extend([1.0] * len(values))
+        self._buf_means.append(np.asarray(values, dtype=np.float64).ravel())
         self.total += float(len(values))
-        if len(self._buf_means) > 10 * self.compression:
+        if sum(len(b) for b in self._buf_means) > self.BUFFER:
             self._compress()
+
+    @classmethod
+    def from_histogram(cls, lo: float, width: float, counts: np.ndarray,
+                       compression: float = 100.0) -> "TDigest":
+        """Digest from fixed-bucket histogram partials (the device sketch
+        path): each non-empty bucket becomes a centroid at its center with
+        weight = count. Quantile error is bounded by one bucket width on
+        top of the digest's own error."""
+        out = cls(compression)
+        counts = np.asarray(counts, dtype=np.float64)
+        nz = np.nonzero(counts > 0)[0]
+        out.means = lo + (nz.astype(np.float64) + 0.5) * width
+        out.weights = counts[nz]
+        out.total = float(counts.sum())
+        out._compress(force=True)
+        return out
 
     def merge(self, other: "TDigest") -> "TDigest":
         out = TDigest(self.compression)
@@ -115,34 +186,29 @@ class TDigest:
         return self.compression * (np.arcsin(2 * q - 1) / np.pi + 0.5)
 
     def _compress(self, force: bool = False) -> None:
+        """Vectorized merging pass: sort all points, assign each to the
+        integer cluster floor(k(q_mid)) of its cumulative-weight midpoint
+        quantile, and merge clusters with reduceat — the standard
+        scale-function construction, O(n log n) with no Python loop."""
         if not self._buf_means and not force:
             return
-        means = np.concatenate([self.means, np.array(self._buf_means)])
-        weights = np.concatenate([self.weights, np.array(self._buf_weights)])
+        parts = [self.means] + self._buf_means
+        wparts = [self.weights] + [np.ones(len(b)) for b in self._buf_means]
+        means = np.concatenate(parts)
+        weights = np.concatenate(wparts)
         self._buf_means, self._buf_weights = [], []
         if len(means) == 0:
             return
         order = np.argsort(means, kind="stable")
         means, weights = means[order], weights[order]
         total = weights.sum()
-        out_means, out_weights = [], []
-        cur_m, cur_w = means[0], weights[0]
-        w_so_far = 0.0
-        for i in range(1, len(means)):
-            q0 = w_so_far / total
-            q1 = (w_so_far + cur_w + weights[i]) / total
-            if self._k(np.array([q1]))[0] - self._k(np.array([q0]))[0] <= 1.0:
-                cur_m = (cur_m * cur_w + means[i] * weights[i]) / (cur_w + weights[i])
-                cur_w += weights[i]
-            else:
-                out_means.append(cur_m)
-                out_weights.append(cur_w)
-                w_so_far += cur_w
-                cur_m, cur_w = means[i], weights[i]
-        out_means.append(cur_m)
-        out_weights.append(cur_w)
-        self.means = np.array(out_means)
-        self.weights = np.array(out_weights)
+        q_mid = (np.cumsum(weights) - weights / 2.0) / total
+        cluster = np.floor(self._k(q_mid)).astype(np.int64)
+        _uniq, idx = np.unique(cluster, return_index=True)
+        wsum = np.add.reduceat(weights, idx)
+        msum = np.add.reduceat(means * weights, idx)
+        self.means = msum / wsum
+        self.weights = wsum
 
     def quantile(self, q: float) -> float:
         self._compress(force=True)

@@ -207,10 +207,15 @@ class TestHostOnlyAggregations:
         assert r.rows[0][0] == pytest.approx(float(v[len(v) // 2]))
 
     def test_percentile_tdigest_close(self, harness, all_rows):
-        r = harness.broker_response(
-            "SELECT PERCENTILETDIGEST(doubleCol, 95) FROM testTable")
+        # host and device digests differ within sketch error (the device
+        # path feeds histogram partials), so compare each to exact truth
+        # rather than to each other
+        sql = "SELECT PERCENTILETDIGEST(doubleCol, 95) FROM testTable"
+        r = harness.broker_response(sql, check_parity=False)
+        rt = harness.tpu_response(sql)
         exact = np.quantile(all_rows["doubleCol"], 0.95)
         assert abs(r.rows[0][0] - exact) / exact < 0.02
+        assert abs(rt.rows[0][0] - exact) / abs(exact) < 0.02
 
     def test_mode(self, harness, all_rows):
         r = harness.broker_response("SELECT MODE(intCol) FROM testTable")
