@@ -1,0 +1,229 @@
+"""Separate-process cluster roles: controller, server, broker.
+
+Reference parity: the role starters — BaseControllerStarter.java:150,
+BaseServerStarter.java:135 (start():578 joins Helix as PARTICIPANT,
+registers the state-model factory reacting to OFFLINE->ONLINE
+transitions), BaseBrokerStarter.java:104 (BrokerRoutingManager watching
+ExternalView). Each run_* function below is one OS process's main loop;
+tools/admin.py exposes them as start-controller / start-server /
+start-broker subcommands, and tests/test_multiprocess_cluster.py starts
+real processes through them (ref ClusterTest.java:92's embedded cluster,
+promoted to actual process isolation).
+
+State flows through the coordination service (controller/coordination.py):
+servers watch for segments assigned to them and load/unload to converge
+(the Helix state-transition analog); brokers watch and rebuild routing
+tables + server connections (the ExternalView routing rebuild).
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, Optional, Set
+
+from pinot_tpu.controller.coordination import CoordinationClient
+
+log = logging.getLogger(__name__)
+
+
+def run_controller(state_dir: str, port: int = 0, host: str = "127.0.0.1",
+                   ready_event: Optional[threading.Event] = None,
+                   stop_event: Optional[threading.Event] = None) -> None:
+    from pinot_tpu.controller.cluster_state import ClusterState
+    from pinot_tpu.controller.coordination import CoordinationServer
+    from pinot_tpu.controller.maintenance import run_retention
+
+    state = ClusterState(persist_dir=state_dir)
+    server = CoordinationServer(state, host=host, port=port)
+    server.start()
+    print(f"controller listening on {server.address}", flush=True)
+    if ready_event is not None:
+        ready_event.set()
+    stop = stop_event or threading.Event()
+    last_maintenance = time.time()
+    try:
+        while not stop.wait(1.0):
+            if time.time() - last_maintenance > 60:
+                last_maintenance = time.time()
+                try:
+                    run_retention(state)
+                except Exception:  # noqa: BLE001 — periodic must survive
+                    log.exception("retention pass failed")
+    finally:
+        server.stop()
+
+
+class ServerRole:
+    """One server process: query transport + data manager + state watch."""
+
+    def __init__(self, instance_id: str, coordinator: str,
+                 query_port: int = 0, host: str = "127.0.0.1",
+                 use_tpu: bool = False):
+        from pinot_tpu.server.data_manager import InstanceDataManager
+        from pinot_tpu.server.query_server import (
+            QueryServer, ServerQueryExecutor)
+
+        self.instance_id = instance_id
+        self.client = CoordinationClient(coordinator)
+        self.data_manager = InstanceDataManager(instance_id)
+        self.executor = ServerQueryExecutor(self.data_manager,
+                                            use_tpu=use_tpu)
+        self.transport = QueryServer(self.executor, host=host,
+                                     port=query_port)
+        self._loaded: Set[tuple] = set()  # (physical_table, segment_name)
+        self._reconcile_lock = threading.Lock()
+
+    def start(self) -> None:
+        self.transport.start()
+        self.client.register_instance(
+            self.instance_id, self.transport.host, self.transport.port)
+        self.reconcile()
+        self.client.watch(lambda _v: self.reconcile())
+
+    def stop(self) -> None:
+        self.client.close()
+        self.transport.stop()
+        self.data_manager.shutdown()
+
+    # ------------------------------------------------------------------
+    def reconcile(self) -> None:
+        """Converge local segments to the coordinator's assignment (the
+        OFFLINE->ONLINE / ONLINE->OFFLINE transition handler,
+        ref SegmentOnlineOfflineStateModelFactory.java:44)."""
+        from pinot_tpu.segment.loader import load_segment
+        with self._reconcile_lock:
+            try:
+                blob = self.client.get_state()
+            except (ConnectionError, OSError, RuntimeError):
+                log.warning("coordinator unreachable; keeping local state")
+                return
+            wanted: Set[tuple] = set()
+            for table, segs in blob.get("segments", {}).items():
+                for name, st in segs.items():
+                    if self.instance_id in st.get("instances", ()) \
+                            and st.get("status") == "ONLINE" \
+                            and st.get("dir_path"):
+                        wanted.add((table, name))
+                        if (table, name) not in self._loaded:
+                            try:
+                                seg = load_segment(st["dir_path"])
+                                self.data_manager.table(table) \
+                                    .add_segment(seg)
+                                self._loaded.add((table, name))
+                                log.info("loaded %s/%s", table, name)
+                            except Exception:  # noqa: BLE001
+                                log.exception("failed to load %s/%s",
+                                              table, name)
+            for table, name in list(self._loaded - wanted):
+                tdm = self.data_manager.table(table, create=False)
+                if tdm is not None:
+                    tdm.remove_segment(name)
+                self._loaded.discard((table, name))
+                log.info("unloaded %s/%s", table, name)
+
+
+def run_server(instance_id: str, coordinator: str, query_port: int = 0,
+               use_tpu: bool = False,
+               ready_event: Optional[threading.Event] = None,
+               stop_event: Optional[threading.Event] = None) -> None:
+    role = ServerRole(instance_id, coordinator, query_port=query_port,
+                      use_tpu=use_tpu)
+    role.start()
+    print(f"server {instance_id} listening on "
+          f"{role.transport.host}:{role.transport.port}", flush=True)
+    if ready_event is not None:
+        ready_event.set()
+    stop = stop_event or threading.Event()
+    try:
+        while not stop.wait(2.0):
+            try:
+                role.client.request("heartbeat", instance_id=instance_id)
+            except (ConnectionError, OSError, RuntimeError):
+                pass
+    finally:
+        role.stop()
+
+
+class BrokerRole:
+    """One broker process: HTTP edge + routing rebuilt from watches."""
+
+    def __init__(self, coordinator: str, http_port: int = 0,
+                 host: str = "127.0.0.1"):
+        from pinot_tpu.broker.http_api import BrokerHttpServer
+        from pinot_tpu.broker.request_handler import BrokerRequestHandler
+        from pinot_tpu.broker.routing import BrokerRoutingManager
+        from pinot_tpu.server.query_server import ServerConnection
+
+        self.client = CoordinationClient(coordinator)
+        self.routing = BrokerRoutingManager()
+        self.connections: Dict[str, ServerConnection] = {}
+        self.handler = BrokerRequestHandler(self.routing, self.connections)
+        self.http = BrokerHttpServer(self.handler, host=host, port=http_port)
+        self._rebuild_lock = threading.Lock()
+
+    def start(self) -> None:
+        self.rebuild()
+        self.client.watch(lambda _v: self.rebuild())
+        self.http.start()
+
+    def stop(self) -> None:
+        self.client.close()
+        self.http.stop()
+        for c in self.connections.values():
+            c.close()
+
+    # ------------------------------------------------------------------
+    def rebuild(self) -> None:
+        """Routing rebuild from coordinator state (the ExternalView-change
+        handler, ref BrokerRoutingManager.java:100)."""
+        from pinot_tpu.broker.routing import (
+            RoutingTable, SegmentInfo, TableRoute)
+        from pinot_tpu.models import TableConfig
+        from pinot_tpu.server.query_server import ServerConnection
+        with self._rebuild_lock:
+            try:
+                blob = self.client.get_state()
+            except (ConnectionError, OSError, RuntimeError):
+                log.warning("coordinator unreachable; keeping routes")
+                return
+            for iid, inst in blob.get("instances", {}).items():
+                if iid not in self.connections and inst.get("port"):
+                    self.connections[iid] = ServerConnection(
+                        inst["host"], inst["port"])
+            for logical, cfg_d in blob.get("tables", {}).items():
+                cfg = TableConfig.from_dict(cfg_d)
+                physical = cfg.table_name_with_type
+                route = TableRoute(physical,
+                                   time_column=cfg.retention.time_column)
+                for name, st in blob.get("segments", {}) \
+                                     .get(physical, {}).items():
+                    if st.get("status") == "OFFLINE":
+                        continue
+                    route.segments[name] = SegmentInfo(
+                        name=name, servers=list(st.get("instances", ())),
+                        partition_id=st.get("partition_id"),
+                        start_time=st.get("start_time"),
+                        end_time=st.get("end_time"))
+                rt = RoutingTable()
+                if cfg.table_type.value == "REALTIME":
+                    rt.realtime = route
+                else:
+                    rt.offline = route
+                self.routing.set_route(logical, rt)
+
+
+def run_broker(coordinator: str, http_port: int = 0,
+               ready_event: Optional[threading.Event] = None,
+               stop_event: Optional[threading.Event] = None) -> None:
+    role = BrokerRole(coordinator, http_port=http_port)
+    role.start()
+    print(f"broker http on 127.0.0.1:{role.http.port}", flush=True)
+    if ready_event is not None:
+        ready_event.set()
+    stop = stop_event or threading.Event()
+    try:
+        while not stop.wait(2.0):
+            pass
+    finally:
+        role.stop()

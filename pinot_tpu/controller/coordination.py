@@ -1,0 +1,430 @@
+"""Coordination service: the ZooKeeper/Helix analog as a TCP watch API.
+
+Reference parity: the reference's entire L7 is EXTERNAL coordination —
+Helix IdealState/ExternalView ZNodes watched across processes
+(pinot-controller helix/core/PinotHelixResourceManager.java,
+pinot-broker routing/BrokerRoutingManager.java:100 re-routing on
+ExternalView change) plus the segment-completion REST protocol
+(controller/.../realtime/SegmentCompletionManager.java). Here ONE
+controller process owns the ClusterState JSON store and the completion
+FSM; brokers and servers connect over TCP, mirror the state, and receive
+pushed change notifications (the watch).
+
+Wire format: u32 little-endian length | JSON object, both directions.
+A connection that sends {"op": "watch"} becomes a long-lived push channel:
+the server writes {"event": "change", "version": N} frames on every state
+mutation (coalesced by version number — watchers re-pull the full state,
+the same read-after-notify pattern as ZK watches).
+"""
+from __future__ import annotations
+
+import json
+import logging
+import socket
+import socketserver
+import struct
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+log = logging.getLogger(__name__)
+
+from pinot_tpu.controller.assignment import assign_balanced
+from pinot_tpu.controller.cluster_state import (
+    ClusterState, InstanceState, SegmentState)
+from pinot_tpu.controller.completion import SegmentCompletionManager
+from pinot_tpu.models import Schema, TableConfig
+
+_LEN = struct.Struct("<I")
+MAX_FRAME = 64 << 20
+
+
+def _send_frame(sock: socket.socket, obj: Any) -> None:
+    payload = json.dumps(obj).encode()
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_frame(sock: socket.socket) -> Optional[dict]:
+    hdr = _recv_exact(sock, 4)
+    if hdr is None:
+        return None
+    n = _LEN.unpack(hdr)[0]
+    if n > MAX_FRAME:
+        raise ValueError(f"frame too large: {n}")
+    body = _recv_exact(sock, n)
+    if body is None:
+        return None
+    return json.loads(body)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+class CoordinationServer:
+    """Controller-side: serves state reads/writes + watches + the
+    completion protocol over TCP."""
+
+    def __init__(self, state: ClusterState,
+                 completion: Optional[SegmentCompletionManager] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.state = state
+        self.completion = completion or SegmentCompletionManager()
+        self.version = 0
+        self._watchers: List[socket.socket] = []
+        self._lock = threading.Lock()
+        #: serializes watcher pushes — concurrent dispatch threads writing
+        #: the same socket would interleave frames and desync the stream
+        self._send_lock = threading.Lock()
+        self._last_seen: Dict[str, float] = {}
+        coord = self
+
+        class _Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                sock = self.request
+                try:
+                    while True:
+                        req = _recv_frame(sock)
+                        if req is None:
+                            return
+                        if req.get("op") == "watch":
+                            coord._add_watcher(sock)
+                            # connection is now push-only; park until close
+                            while _recv_exact(sock, 1) is not None:
+                                pass
+                            return
+                        try:
+                            resp = coord._dispatch(req)
+                        except Exception as e:  # noqa: BLE001
+                            resp = {"error": f"{type(e).__name__}: {e}"}
+                        _send_frame(sock, resp)
+                except (ConnectionError, OSError):
+                    pass
+                finally:
+                    coord._drop_watcher(sock)
+
+        class _Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = _Server((host, port), _Handler)
+        self.host, self.port = self._server.server_address
+        self._thread: Optional[threading.Thread] = None
+        # state changes from ANY path (completion loops, maintenance)
+        # notify watchers
+        self.state.add_listener(lambda _table: self._notify())
+
+    # ------------------------------------------------------------------
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="coordination-server")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    # ------------------------------------------------------------------
+    def _add_watcher(self, sock: socket.socket) -> None:
+        with self._lock:
+            self._watchers.append(sock)
+        # initial nudge so a late watcher pulls current state
+        try:
+            with self._send_lock:
+                _send_frame(sock, {"event": "change",
+                                   "version": self.version})
+        except OSError:
+            self._drop_watcher(sock)
+
+    def _drop_watcher(self, sock: socket.socket) -> None:
+        with self._lock:
+            if sock in self._watchers:
+                self._watchers.remove(sock)
+
+    def _notify(self) -> None:
+        with self._lock:
+            self.version += 1
+            watchers = list(self._watchers)
+            version = self.version
+        for w in watchers:
+            try:
+                with self._send_lock:
+                    _send_frame(w, {"event": "change", "version": version})
+            except OSError:
+                self._drop_watcher(w)
+
+    # ------------------------------------------------------------------
+    def _dispatch(self, req: dict) -> dict:
+        op = req["op"]
+        if op == "get_state":
+            return self._state_blob()
+        if op == "add_table":
+            cfg = TableConfig.from_dict(req["config"])
+            schema = Schema.from_dict(req["schema"])
+            self.state.add_table(cfg, schema)
+            self._notify()
+            return {"ok": True}
+        if op == "register_instance":
+            inst = InstanceState(**req["instance"])
+            self.state.register_instance(inst)
+            self._last_seen[inst.instance_id] = time.time()
+            self._notify()
+            return {"ok": True}
+        if op == "heartbeat":
+            iid = req["instance_id"]
+            self._last_seen[iid] = time.time()
+            inst = self.state.instances.get(iid)
+            if inst is not None and not inst.enabled:
+                inst.enabled = True  # recovered: rejoin assignment pool
+                self._notify()
+            return {"ok": True}
+        if op == "upload_segment":
+            self._sweep_liveness()
+            return self._upload_segment(req)
+        if op == "upsert_segment":
+            self.state.upsert_segment(SegmentState.from_dict(req["segment"]))
+            return {"ok": True}
+        if op == "remove_segment":
+            st = self.state.remove_segment(req["table"], req["name"])
+            return {"ok": st is not None}
+        if op == "segment_name":
+            name = self.completion.segment_name(
+                req["table"], req["partition_id"], req["seq"])
+            return {"name": name}
+        if op == "segment_consumed":
+            r = self.completion.segment_consumed(
+                req["instance"], req["segment"], req["offset"])
+            return {"action": r.action, "offset": r.offset,
+                    "download_path": r.download_path}
+        if op == "segment_commit_end":
+            status = self.completion.segment_commit_end(
+                req["instance"], req["segment"], req["offset"],
+                download_path=req.get("download_path"),
+                success=req.get("success", True))
+            # a successful commit updates segment metadata in state so
+            # brokers route to the sealed copy
+            if status == "COMMIT_SUCCESS" and req.get("segment_state"):
+                self.state.upsert_segment(
+                    SegmentState.from_dict(req["segment_state"]))
+            return {"status": status}
+        raise ValueError(f"unknown op {op!r}")
+
+    #: instances silent for this long are disabled (heartbeats come every
+    #: ~2s from run_server) so new segments stop landing on corpses
+    LIVENESS_TTL_S = 15.0
+
+    def _sweep_liveness(self) -> None:
+        now = time.time()
+        changed = False
+        for iid, seen in list(self._last_seen.items()):
+            inst = self.state.instances.get(iid)
+            if inst is not None and inst.enabled \
+                    and now - seen > self.LIVENESS_TTL_S:
+                inst.enabled = False
+                changed = True
+                log.warning("instance %s missed heartbeats; disabled", iid)
+        if changed:
+            self._notify()
+
+    def _upload_segment(self, req: dict) -> dict:
+        """Assign + commit a built segment (ref controller upload REST ->
+        SegmentAssignment -> IdealState update)."""
+        import os
+
+        from pinot_tpu.segment.meta import SegmentMetadata
+        logical = req["table"]
+        table_type = req.get("table_type", "OFFLINE")
+        cfg = self.state.tables[logical]
+        physical = f"{logical}_{table_type}"
+        with open(os.path.join(req["seg_dir"], "metadata.json")) as f:
+            meta = SegmentMetadata.from_dict(json.load(f))
+        instances = assign_balanced(
+            self.state, physical, meta.segment_name,
+            replication=cfg.retention.replication)
+        st = SegmentState(
+            name=meta.segment_name, table=physical, instances=instances,
+            dir_path=req["seg_dir"], num_docs=meta.num_docs,
+            start_time=meta.start_time, end_time=meta.end_time,
+            partition_id=req.get("partition_id"))
+        self.state.upsert_segment(st)
+        return {"segment": st.to_dict()}
+
+    def _state_blob(self) -> dict:
+        with self.state._lock:
+            return {
+                "version": self.version,
+                "tables": {k: v.to_dict()
+                           for k, v in self.state.tables.items()},
+                "schemas": {k: v.to_dict()
+                            for k, v in self.state.schemas.items()},
+                "instances": {k: vars(v).copy()
+                              for k, v in self.state.instances.items()},
+                "segments": {t: {n: s.to_dict() for n, s in m.items()}
+                             for t, m in self.state.segments.items()},
+            }
+
+
+class CoordinationClient:
+    """Broker/server-side: request channel + optional watch thread.
+
+    Thread-safe: one socket for requests under a lock; a second socket for
+    the watch push channel (the ZK client session analog)."""
+
+    def __init__(self, address: str, timeout: float = 30.0):
+        host, port = address.rsplit(":", 1)
+        self.host, self.port = host, int(port)
+        self.timeout = timeout
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+        self._watch_stop = threading.Event()
+        self._watch_thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------
+    def _connect(self) -> socket.socket:
+        if self._sock is None:
+            self._sock = socket.create_connection(
+                (self.host, self.port), timeout=self.timeout)
+        return self._sock
+
+    def request(self, op: str, **kwargs) -> dict:
+        req = {"op": op, **kwargs}
+        with self._lock:
+            for attempt in (0, 1):  # one reconnect on a dropped channel
+                try:
+                    sock = self._connect()
+                    _send_frame(sock, req)
+                    resp = _recv_frame(sock)
+                    if resp is None:
+                        raise ConnectionError("coordination channel closed")
+                    break
+                except (ConnectionError, OSError):
+                    self._close_locked()
+                    if attempt:
+                        raise
+        if "error" in resp:
+            raise RuntimeError(f"coordination error: {resp['error']}")
+        return resp
+
+    def _close_locked(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def close(self) -> None:
+        self.stop_watch()
+        with self._lock:
+            self._close_locked()
+
+    # -- typed helpers --------------------------------------------------
+    def get_state(self) -> dict:
+        return self.request("get_state")
+
+    def add_table(self, config: TableConfig, schema: Schema) -> None:
+        self.request("add_table", config=config.to_dict(),
+                     schema=schema.to_dict())
+
+    def register_instance(self, instance_id: str, host: str, port: int,
+                          tags: Optional[List[str]] = None) -> None:
+        self.request("register_instance", instance={
+            "instance_id": instance_id, "host": host, "port": port,
+            "enabled": True, "tags": tags or []})
+
+    def upload_segment(self, table: str, seg_dir: str,
+                       table_type: str = "OFFLINE",
+                       partition_id: Optional[int] = None) -> dict:
+        return self.request("upload_segment", table=table, seg_dir=seg_dir,
+                            table_type=table_type, partition_id=partition_id)
+
+    # ------------------------------------------------------------------
+    def watch(self, callback: Callable[[int], None],
+              poll_fallback_s: float = 5.0) -> None:
+        """Start the push channel; callback(version) fires on every change
+        notification (and periodically as a missed-notification guard)."""
+
+        def loop():
+            while not self._watch_stop.is_set():
+                sock = None
+                try:
+                    sock = socket.create_connection(
+                        (self.host, self.port), timeout=None)
+                    _send_frame(sock, {"op": "watch"})
+                    sock.settimeout(poll_fallback_s)
+                    while not self._watch_stop.is_set():
+                        try:
+                            msg = _recv_frame(sock)
+                        except socket.timeout:
+                            # a timeout can land mid-frame and desync the
+                            # stream — reconnect; the server's initial
+                            # nudge doubles as the periodic guard pull
+                            callback(-1)
+                            break
+                        if msg is None:
+                            break
+                        callback(int(msg.get("version", -1)))
+                except Exception:  # noqa: BLE001 — the watch must never
+                    # die silently (a dead watch means a server that stops
+                    # loading assignments); reconnect after a beat
+                    log.exception("watch channel error; reconnecting")
+                    if self._watch_stop.wait(1.0):
+                        return
+                finally:
+                    if sock is not None:
+                        try:
+                            sock.close()
+                        except OSError:
+                            pass
+
+        self._watch_thread = threading.Thread(
+            target=loop, daemon=True, name="coordination-watch")
+        self._watch_thread.start()
+
+    def stop_watch(self) -> None:
+        self._watch_stop.set()
+        if self._watch_thread is not None:
+            self._watch_thread.join(timeout=2)
+            self._watch_thread = None
+
+
+class RemoteCompletionManager:
+    """SegmentCompletionManager facade over the coordination client — the
+    drop-in `completion_manager` for RealtimeSegmentDataManager in a
+    multi-process deployment (ref: servers speak the completion protocol
+    to the controller over HTTP; here it rides the coordination channel)."""
+
+    def __init__(self, client: CoordinationClient):
+        self.client = client
+
+    def segment_name(self, table: str, partition_id: int, seq: int) -> str:
+        return self.client.request("segment_name", table=table,
+                                   partition_id=partition_id, seq=seq)["name"]
+
+    def segment_consumed(self, instance: str, segment: str, offset: int):
+        from pinot_tpu.controller.completion import CompletionResponse
+        r = self.client.request("segment_consumed", instance=instance,
+                                segment=segment, offset=offset)
+        return CompletionResponse(r["action"], offset=r.get("offset"),
+                                  download_path=r.get("download_path"))
+
+    def segment_commit_end(self, instance: str, segment: str, offset: int,
+                           download_path: Optional[str] = None,
+                           success: bool = True,
+                           segment_state: Optional[dict] = None) -> str:
+        r = self.client.request(
+            "segment_commit_end", instance=instance, segment=segment,
+            offset=offset, download_path=download_path, success=success,
+            segment_state=segment_state)
+        return r["status"]

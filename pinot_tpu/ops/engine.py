@@ -356,15 +356,26 @@ class TpuOperatorExecutor:
                 if m0 is None or not m0.single_value \
                         or m0.data_type.np_dtype.kind not in "iu":
                     return None
+                # the i32 hi plane wraps for |v| >= 2^55 (the vrange64
+                # bound): the host fold would then diverge from the
+                # device hash, so such columns stay host-side
+                for seg in segments:
+                    m = seg.metadata.columns.get(col)
+                    if m is None or m.min_value is None \
+                            or m.max_value is None or max(
+                                abs(int(m.min_value)),
+                                abs(int(m.max_value))) >= (1 << 55):
+                        return None
                 hll_cols.add(col)
 
         # filter IR FIRST: leaves fill in build order, so the main filter's
         # leaves precede agg-filter leaves (staging resolves in this order)
         leaves: List[DeviceLeaf] = []
         filter_ir = None
+        hll64 = frozenset(hll_cols)
         if ctx.filter is not None:
             filter_ir = self._build_filter_ir(ctx.filter, segments, leaves,
-                                              classify)
+                                              classify, force64=hll64)
             if filter_ir is None:
                 return None
 
@@ -384,7 +395,8 @@ class TpuOperatorExecutor:
             if f in fidx_of_filter:
                 agg_fidx.append(fidx_of_filter[f])
                 continue
-            ir = self._build_filter_ir(f, segments, leaves, classify)
+            ir = self._build_filter_ir(f, segments, leaves, classify,
+                                       force64=hll64)
             if ir is None:
                 return None
             fidx_of_filter[f] = len(agg_filter_irs)
@@ -612,18 +624,24 @@ class TpuOperatorExecutor:
                 columns=expand_star(seg, ctx), stats=stats))
         return results
 
-    def _build_filter_ir(self, e: Function, segments, leaves, classify):
+    def _build_filter_ir(self, e: Function, segments, leaves, classify,
+                         force64: frozenset = frozenset()):
+        """force64: no-dictionary int columns that stage ONLY as split
+        planes (device-HLL inputs) — filter leaves on them must use
+        vrange64, never the 'val:' block that won't exist."""
         seg0 = segments[0]
         if e.name in ("and", "or"):
             children = []
             for a in e.args:
-                c = self._build_filter_ir(a, segments, leaves, classify)
+                c = self._build_filter_ir(a, segments, leaves, classify,
+                                          force64)
                 if c is None:
                     return None
                 children.append(c)
             return (e.name, *children)
         if e.name == "not":
-            c = self._build_filter_ir(e.args[0], segments, leaves, classify)
+            c = self._build_filter_ir(e.args[0], segments, leaves, classify,
+                                      force64)
             return None if c is None else ("not", c)
         if not e.args or not isinstance(e.args[0], Identifier):
             return None
@@ -643,7 +661,11 @@ class TpuOperatorExecutor:
         else:
             if e.name not in _LEAF_RANGE_FUNCS:
                 return None
-            if m.data_type.np_dtype.kind in "iu" and \
+            if col in force64:
+                # split planes are the ONLY staged form of this column
+                # (regardless of x64 — the HLL op reads them either way)
+                kind = "vrange64"
+            elif m.data_type.np_dtype.kind in "iu" and \
                     not jax.config.read("jax_enable_x64"):
                 kind = self._int_filter_kind(segments, col)
                 if kind is None:

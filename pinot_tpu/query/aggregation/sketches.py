@@ -65,6 +65,10 @@ class HyperLogLog:
             zeros = int(np.count_nonzero(self.registers == 0))
             if zeros:
                 est = m * np.log(m / zeros)
+        elif est > (1 << 32) / 30.0:
+            # large-range correction for the 32-bit rank hash (hash-value
+            # saturation near 2^32 distinct values)
+            est = -(2.0 ** 32) * np.log(1.0 - est / 2.0 ** 32)
         return int(round(est))
 
 
@@ -74,7 +78,13 @@ def _split_planes(values: np.ndarray):
     lo = v & 0xFFFFFF)."""
     if values.dtype.kind in "iu":
         v = values.astype(np.int64)
-        hi = (v >> 24).astype(np.int32).astype(np.uint32)
+        hi64 = v >> 24
+        wrapped = hi64.astype(np.int32)
+        # fold bits the i32 wrap loses (nonzero only for |v| >= 2^55) so
+        # huge longs differing in the top byte don't collide; the fold is
+        # identity for device-admissible ranges, keeping hash parity
+        excess = ((hi64 - wrapped.astype(np.int64)) >> 32).astype(np.int32)
+        hi = (wrapped ^ excess).astype(np.uint32)
         lo = (v & 0xFFFFFF).astype(np.int32).astype(np.uint32)
         return hi, lo
     if values.dtype.kind == "f":

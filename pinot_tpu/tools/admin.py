@@ -199,8 +199,89 @@ def main(argv=None) -> int:
     pq.add_argument("--query", required=True)
     pq.set_defaults(fn=cmd_post_query)
 
+    # separate-process roles (ref StartController/StartServer/StartBroker
+    # admin subcommands; the coordination service replaces ZK/Helix)
+    sc = sub.add_parser("StartController",
+                        help="coordination service + maintenance loops")
+    sc.add_argument("--state-dir", required=True)
+    sc.add_argument("--port", type=int, default=9000)
+    sc.set_defaults(fn=cmd_start_controller)
+
+    ss = sub.add_parser("StartServer", help="query server joined to a "
+                                            "controller")
+    ss.add_argument("--instance-id", required=True)
+    ss.add_argument("--coordinator", required=True, help="host:port")
+    ss.add_argument("--query-port", type=int, default=0)
+    ss.add_argument("--tpu", action="store_true")
+    ss.set_defaults(fn=cmd_start_server)
+
+    sb = sub.add_parser("StartBroker", help="HTTP broker joined to a "
+                                            "controller")
+    sb.add_argument("--coordinator", required=True, help="host:port")
+    sb.add_argument("--http-port", type=int, default=0)
+    sb.set_defaults(fn=cmd_start_broker)
+
+    at = sub.add_parser("AddTable", help="register table config + schema "
+                                         "with the controller")
+    at.add_argument("--coordinator", required=True)
+    at.add_argument("--table", required=True, help="table config json file")
+    at.add_argument("--schema", required=True, help="schema json file")
+    at.set_defaults(fn=cmd_add_table)
+
+    us = sub.add_parser("UploadSegment", help="assign a built segment dir")
+    us.add_argument("--coordinator", required=True)
+    us.add_argument("--table", required=True)
+    us.add_argument("--segment-dir", required=True)
+    us.add_argument("--table-type", default="OFFLINE")
+    us.set_defaults(fn=cmd_upload_segment)
+
     args = p.parse_args(argv)
     return args.fn(args)
+
+
+def cmd_start_controller(args) -> int:
+    from pinot_tpu.cluster.roles import run_controller
+    run_controller(args.state_dir, port=args.port)
+    return 0
+
+
+def cmd_start_server(args) -> int:
+    from pinot_tpu.cluster.roles import run_server
+    run_server(args.instance_id, args.coordinator,
+               query_port=args.query_port, use_tpu=args.tpu)
+    return 0
+
+
+def cmd_start_broker(args) -> int:
+    from pinot_tpu.cluster.roles import run_broker
+    run_broker(args.coordinator, http_port=args.http_port)
+    return 0
+
+
+def cmd_add_table(args) -> int:
+    import json as _json
+
+    from pinot_tpu.controller.coordination import CoordinationClient
+    from pinot_tpu.models import Schema, TableConfig
+    with open(args.table) as f:
+        cfg = TableConfig.from_dict(_json.load(f))
+    with open(args.schema) as f:
+        schema = Schema.from_dict(_json.load(f))
+    client = CoordinationClient(args.coordinator)
+    client.add_table(cfg, schema)
+    client.close()
+    print(f"added table {cfg.name}")
+    return 0
+
+
+def cmd_upload_segment(args) -> int:
+    from pinot_tpu.controller.coordination import CoordinationClient
+    client = CoordinationClient(args.coordinator)
+    r = client.upload_segment(args.table, args.segment_dir,
+                              table_type=args.table_type)
+    client.close()
+    print(f"assigned to {r['segment']['instances']}")
+    return 0
 
 
 if __name__ == "__main__":

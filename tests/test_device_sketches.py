@@ -182,3 +182,43 @@ class TestExactIntSums:
         rd = dev.execute(sql).rows[0]
         assert float(rd[0]) == float(rh[0])
         assert float(rd[1]) == pytest.approx(float(rh[1]), rel=1e-12)
+
+
+class TestHllFilterOnSameColumn:
+    """Review finding: HLL forces its no-dict int column into split-plane
+    staging, so a filter on the SAME column must use vrange64 (not the
+    'val:' block that won't exist)."""
+
+    def test_hll_with_filter_on_hll_column(self, tmp_path):
+        rng = np.random.default_rng(13)
+        schema = Schema("h", [
+            FieldSpec("x", DataType.LONG, FieldType.DIMENSION),
+        ])
+        tc = TableConfig(name="h")
+        tc.indexing.no_dictionary_columns = ["x"]
+        n = 50_000
+        xs = rng.integers(0, 1 << 20, size=n, dtype=np.int64)
+        out = str(tmp_path / "s0")
+        SegmentCreator(tc, schema).build({"x": xs}, out, "s0")
+        seg = load_segment(out)
+        host = QueryExecutor([seg], use_tpu=False)
+        dev = QueryExecutor([seg], use_tpu=True)
+        sql = "SELECT DISTINCTCOUNTHLL(x) FROM h WHERE x > 5000"
+        assert host.execute(sql).rows == dev.execute(sql).rows
+        assert len(dev.tpu_engine._block_cache) > 0
+
+    def test_huge_longs_fall_back_and_stay_distinct(self, tmp_path):
+        # |v| >= 2^55: device path must decline, and the HOST fold must
+        # keep values differing only in the top byte distinct
+        schema = Schema("h2", [
+            FieldSpec("x", DataType.LONG, FieldType.DIMENSION),
+        ])
+        tc = TableConfig(name="h2")
+        tc.indexing.no_dictionary_columns = ["x"]
+        xs = np.array([k << 55 for k in range(1, 100)], dtype=np.int64)
+        out = str(tmp_path / "s0")
+        SegmentCreator(tc, schema).build({"x": xs}, out, "s0")
+        seg = load_segment(out)
+        dev = QueryExecutor([seg], use_tpu=True)
+        est = dev.execute("SELECT DISTINCTCOUNTHLL(x) FROM h2").rows[0][0]
+        assert abs(est - 99) / 99 < 0.1
