@@ -18,6 +18,7 @@ tables + server connections (the ExternalView routing rebuild).
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from typing import Dict, Optional, Set
@@ -59,7 +60,10 @@ class ServerRole:
 
     def __init__(self, instance_id: str, coordinator: str,
                  query_port: int = 0, host: str = "127.0.0.1",
-                 use_tpu: bool = False):
+                 use_tpu: bool = False,
+                 download_dir: Optional[str] = None):
+        import tempfile
+
         from pinot_tpu.server.data_manager import InstanceDataManager
         from pinot_tpu.server.query_server import (
             QueryServer, ServerQueryExecutor)
@@ -71,6 +75,11 @@ class ServerRole:
                                             use_tpu=use_tpu)
         self.transport = QueryServer(self.executor, host=host,
                                      port=query_port)
+        #: local cache for deep-store segment downloads — deterministic
+        #: per instance so restarts REUSE extracted copies instead of
+        #: leaking a fresh tempdir per process lifetime
+        self.download_dir = download_dir or os.path.join(
+            tempfile.gettempdir(), f"pinot-tpu-dl-{instance_id}")
         self._loaded: Set[tuple] = set()  # (physical_table, segment_name)
         self._reconcile_lock = threading.Lock()
 
@@ -107,7 +116,8 @@ class ServerRole:
                         wanted.add((table, name))
                         if (table, name) not in self._loaded:
                             try:
-                                seg = load_segment(st["dir_path"])
+                                seg = load_segment(
+                                    self._localize(table, st["dir_path"]))
                                 self.data_manager.table(table) \
                                     .add_segment(seg)
                                 self._loaded.add((table, name))
@@ -121,6 +131,14 @@ class ServerRole:
                     tdm.remove_segment(name)
                 self._loaded.discard((table, name))
                 log.info("unloaded %s/%s", table, name)
+
+    def _localize(self, table: str, dir_path: str) -> str:
+        """A deep-store URI downloads through PinotFS into the local cache
+        (ref BaseTableDataManager.downloadSegmentFromDeepStore); a plain
+        path loads in place."""
+        from pinot_tpu.segment.fs import localize_segment
+        return localize_segment(
+            dir_path, os.path.join(self.download_dir, table))
 
 
 def run_server(instance_id: str, coordinator: str, query_port: int = 0,
@@ -188,9 +206,18 @@ class BrokerRole:
                 log.warning("coordinator unreachable; keeping routes")
                 return
             for iid, inst in blob.get("instances", {}).items():
-                if iid not in self.connections and inst.get("port"):
-                    self.connections[iid] = ServerConnection(
-                        inst["host"], inst["port"])
+                if not inst.get("port"):
+                    continue
+                cur = self.connections.get(iid)
+                if cur is not None and (cur.host, cur.port) == \
+                        (inst["host"], inst["port"]):
+                    continue
+                # new instance OR a restarted one on a fresh port: swap in
+                # the new channel; the old object is NOT closed here — a
+                # query thread may be mid-request on it, and its own
+                # ConnectionError path retires it safely
+                self.connections[iid] = ServerConnection(
+                    inst["host"], inst["port"])
             for logical, cfg_d in blob.get("tables", {}).items():
                 cfg = TableConfig.from_dict(cfg_d)
                 physical = cfg.table_name_with_type

@@ -247,14 +247,21 @@ class CoordinationServer:
         table_type = req.get("table_type", "OFFLINE")
         cfg = self.state.tables[logical]
         physical = f"{logical}_{table_type}"
-        with open(os.path.join(req["seg_dir"], "metadata.json")) as f:
-            meta = SegmentMetadata.from_dict(json.load(f))
+        if req.get("metadata") is not None:
+            # deep-store upload: the client pushed the tar itself and
+            # sends metadata + the store URI (ref tar upload REST body)
+            meta = SegmentMetadata.from_dict(req["metadata"])
+            dir_path = req["dir_path"]
+        else:
+            with open(os.path.join(req["seg_dir"], "metadata.json")) as f:
+                meta = SegmentMetadata.from_dict(json.load(f))
+            dir_path = req["seg_dir"]
         instances = assign_balanced(
             self.state, physical, meta.segment_name,
             replication=cfg.retention.replication)
         st = SegmentState(
             name=meta.segment_name, table=physical, instances=instances,
-            dir_path=req["seg_dir"], num_docs=meta.num_docs,
+            dir_path=dir_path, num_docs=meta.num_docs,
             start_time=meta.start_time, end_time=meta.end_time,
             partition_id=req.get("partition_id"))
         self.state.upsert_segment(st)
@@ -348,6 +355,26 @@ class CoordinationClient:
                        partition_id: Optional[int] = None) -> dict:
         return self.request("upload_segment", table=table, seg_dir=seg_dir,
                             table_type=table_type, partition_id=partition_id)
+
+    def upload_segment_to_store(self, table: str, seg_dir: str, deep_store,
+                                table_type: str = "OFFLINE",
+                                partition_id: Optional[int] = None) -> dict:
+        """Push the built segment tar to the deep store, then register its
+        STORE URI with the controller — servers download through PinotFS,
+        so no shared build directory is needed (ref segment upload REST +
+        deep-store-backed serving)."""
+        import json as _json
+        import os as _os
+
+        from pinot_tpu.segment.meta import SegmentMetadata
+        with open(_os.path.join(seg_dir, "metadata.json")) as f:
+            meta = SegmentMetadata.from_dict(_json.load(f))
+        physical = f"{table}_{table_type}"
+        uri = deep_store.upload(seg_dir, physical, meta.segment_name)
+        return self.request(
+            "upload_segment", table=table, table_type=table_type,
+            partition_id=partition_id, metadata=meta.to_dict(),
+            dir_path=uri)
 
     # ------------------------------------------------------------------
     def watch(self, callback: Callable[[int], None],

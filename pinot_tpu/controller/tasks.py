@@ -58,9 +58,15 @@ class TaskContext:
         return self.state.schemas[base]
 
     def load(self, table: str, name: str) -> ImmutableSegment:
+        import os
+
+        from pinot_tpu.segment.fs import localize_segment
         seg_map = self.state.segments.get(table, {})
         st = seg_map[name]
-        return load_segment(st.dir_path)
+        # deep-store URIs download into the task work area first
+        local = localize_segment(
+            st.dir_path, os.path.join(self.output_dir, "_downloads"))
+        return load_segment(local)
 
 
 def _segments_to_columns(segs: Sequence[ImmutableSegment],

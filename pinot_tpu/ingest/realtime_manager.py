@@ -40,11 +40,17 @@ class RealtimeSegmentDataManager:
                  start_offset: Optional[LongMsgOffset] = None,
                  on_commit: Optional[Callable[[str, LongMsgOffset], None]] = None,
                  ingestion_delay_tracker=None,
-                 completion_manager=None, instance_id: str = "server_0"):
+                 completion_manager=None, instance_id: str = "server_0",
+                 deep_store=None):
         """completion_manager: a controller SegmentCompletionManager for
         multi-replica coordination (exactly one replica commits per
         segment, ref BlockingSegmentCompletionFSM); None = single-replica
-        local commits, the prior behavior."""
+        local commits, the prior behavior.
+        deep_store: a segment.fs.SegmentDeepStore — committed segments
+        upload there and the completion protocol advertises the STORE URI
+        as the download path, so a replica (or restarted server) recovers
+        the committed copy without a shared build directory (ref
+        SplitSegmentCommitter uploading via PinotFS)."""
         self.table_config = table_config
         self.schema = schema
         self.stream_config = stream_config
@@ -54,6 +60,7 @@ class RealtimeSegmentDataManager:
         self.on_commit = on_commit
         self.completion = completion_manager
         self.instance_id = instance_id
+        self.deep_store = deep_store
         self._catchup_target: Optional[int] = None
         self._catchup_deadline = 0.0
         #: a DISCARD rewound current_offset: the in-flight fetched batch
@@ -209,6 +216,16 @@ class RealtimeSegmentDataManager:
                 with self._seal_lock:
                     sealed = self.mutable
                     out_dir = self._build_immutable()
+                # deep-store upload BEFORE declaring success: the
+                # advertised download path must be durable (ref
+                # SplitSegmentCommitter's upload-then-commitEnd ordering)
+                advertised = out_dir
+                if self.deep_store is not None:
+                    # unique=True: a stale de-elected committer finishing
+                    # late must not overwrite the winner's tar
+                    advertised = self.deep_store.upload(
+                        out_dir, self.table_config.table_name_with_type,
+                        sealed.segment_name, unique=True)
             except Exception:
                 # report the failure so the FSM re-elects instead of the
                 # other replicas HOLDing behind a dead claim
@@ -217,7 +234,7 @@ class RealtimeSegmentDataManager:
                 raise
             status = self.completion.segment_commit_end(
                 self.instance_id, name, int(str(self.current_offset)),
-                download_path=out_dir)
+                download_path=advertised)
             if status == COMMIT_SUCCESS:
                 with self._seal_lock:
                     # a force_commit may have rotated self.mutable during
@@ -258,11 +275,17 @@ class RealtimeSegmentDataManager:
                 with self._seal_lock:
                     self._commit()
                 return
-            # behind/ahead of the commit: adopt the committed copy from
-            # the winner's store (shared-FS peer download) and resume from
-            # the committed offset
+            # behind/ahead of the commit: adopt the committed copy and
+            # resume from the committed offset — a deep-store URI fetches
+            # through PinotFS (ref peer download), a plain path loads
+            # directly (shared-FS stand-in)
+            from pinot_tpu.segment.fs import download_segment, is_store_uri
+            path = resp.download_path
+            if is_store_uri(path):
+                path = download_segment(
+                    path, os.path.join(self.store_dir, "_downloads"))
             with self._seal_lock:
-                immutable = load_segment(resp.download_path)
+                immutable = load_segment(path)
                 self.tdm.add_segment(immutable)
                 self.current_offset = LongMsgOffset(resp.offset)
                 self._restart_fetch = True
@@ -287,6 +310,12 @@ class RealtimeSegmentDataManager:
         Returns the built segment directory (the completion protocol
         advertises it as the peer-download location)."""
         out_dir = self._build_immutable()
+        if self.deep_store is not None and self.completion is None:
+            # single-replica durability (the protocol path uploads before
+            # commit-end instead; KEEP re-uploads would be redundant)
+            self.deep_store.upload(
+                out_dir, self.table_config.table_name_with_type,
+                self.mutable.segment_name)
         self._finalize_commit(out_dir)
         return out_dir
 
@@ -298,6 +327,17 @@ class RealtimeSegmentDataManager:
         out_dir = os.path.join(self.store_dir, sealed.segment_name)
         creator = SegmentCreator(self.table_config, self.schema)
         creator.build(sealed.to_columns(), out_dir, sealed.segment_name)
+        if self.upsert_manager is not None:
+            # snapshot BEFORE any deep-store upload so the stored tar
+            # carries validDocIds (a recovering server must not replay)
+            valid = getattr(sealed, "valid_doc_ids", None)
+            if valid is not None:
+                from pinot_tpu.segment.meta import SegmentMetadata
+                from pinot_tpu.segment.upsert import write_valid_doc_ids
+                import json as _json
+                with open(os.path.join(out_dir, "metadata.json")) as f:
+                    crc = SegmentMetadata.from_dict(_json.load(f)).crc
+                write_valid_doc_ids(out_dir, valid, crc)
         return out_dir
 
     def _finalize_commit(self, out_dir: str) -> None:
@@ -309,6 +349,10 @@ class RealtimeSegmentDataManager:
             # map entries in place — no recompute, so concurrent queries
             # never observe cleared bits on either copy
             self.upsert_manager.replace_segment(sealed, immutable)
+            # persist the validDocIds snapshot so a restarted server
+            # resumes upsert state without replaying (ref upsert/ snapshot)
+            from pinot_tpu.segment.upsert import persist_valid_doc_ids
+            persist_valid_doc_ids(immutable)
         # swap BEFORE removing: add_segment replaces by name atomically
         self.tdm.add_segment(immutable)
         if self.on_commit is not None:

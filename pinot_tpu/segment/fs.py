@@ -1,0 +1,203 @@
+"""PinotFS analog: pluggable filesystem abstraction + segment deep store.
+
+Reference parity: pinot-spi filesystem/PinotFS.java — the deep-store
+abstraction behind segment upload/download (s3/gcs/adls/hdfs plugins in
+pinot-plugins/pinot-file-system). Committed segments are tarred and
+uploaded at commit; a replica told to DISCARD (or a restarted server)
+fetches the committed copy back through the same interface, so losing a
+server loses no committed data (ref SplitSegmentCommitter + the
+peer-download path, SURVEY.md §5 checkpoint/resume).
+
+Filesystems register by URI scheme (the plugin seam — additional schemes
+plug in via register_fs, ref PinotFSFactory).
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import tarfile
+import tempfile
+from typing import Callable, Dict, List, Type
+from urllib.parse import urlparse
+
+
+class PinotFS:
+    """Scheme-addressed file operations (ref PinotFS.java contract)."""
+
+    def mkdir(self, uri: str) -> None:
+        raise NotImplementedError
+
+    def delete(self, uri: str) -> bool:
+        raise NotImplementedError
+
+    def exists(self, uri: str) -> bool:
+        raise NotImplementedError
+
+    def length(self, uri: str) -> int:
+        raise NotImplementedError
+
+    def listdir(self, uri: str) -> List[str]:
+        raise NotImplementedError
+
+    def copy_from_local(self, src_path: str, dst_uri: str) -> None:
+        raise NotImplementedError
+
+    def copy_to_local(self, src_uri: str, dst_path: str) -> None:
+        raise NotImplementedError
+
+
+class LocalPinotFS(PinotFS):
+    """file:// scheme over the local filesystem (ref LocalPinotFS.java) —
+    the first deep-store backing; network-FS schemes register the same
+    way."""
+
+    @staticmethod
+    def _path(uri: str) -> str:
+        p = urlparse(uri)
+        if p.scheme not in ("", "file"):
+            raise ValueError(f"not a local uri: {uri}")
+        return p.path if p.scheme else uri
+
+    def mkdir(self, uri: str) -> None:
+        os.makedirs(self._path(uri), exist_ok=True)
+
+    def delete(self, uri: str) -> bool:
+        path = self._path(uri)
+        if os.path.isdir(path):
+            shutil.rmtree(path, ignore_errors=True)
+            return True
+        if os.path.exists(path):
+            os.remove(path)
+            return True
+        return False
+
+    def exists(self, uri: str) -> bool:
+        return os.path.exists(self._path(uri))
+
+    def length(self, uri: str) -> int:
+        return os.path.getsize(self._path(uri))
+
+    def listdir(self, uri: str) -> List[str]:
+        return sorted(os.listdir(self._path(uri)))
+
+    def copy_from_local(self, src_path: str, dst_uri: str) -> None:
+        dst = self._path(dst_uri)
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        tmp = dst + ".tmp"
+        shutil.copyfile(src_path, tmp)
+        os.replace(tmp, dst)  # atomic publish
+
+    def copy_to_local(self, src_uri: str, dst_path: str) -> None:
+        os.makedirs(os.path.dirname(dst_path) or ".", exist_ok=True)
+        shutil.copyfile(self._path(src_uri), dst_path)
+
+
+_SCHEMES: Dict[str, Callable[[], PinotFS]] = {
+    "file": LocalPinotFS,
+    "": LocalPinotFS,
+}
+
+
+def register_fs(scheme: str, factory: Callable[[], PinotFS]) -> None:
+    """Plugin seam (ref PinotFSFactory.register)."""
+    _SCHEMES[scheme] = factory
+
+
+def get_fs(uri: str) -> PinotFS:
+    scheme = urlparse(uri).scheme
+    factory = _SCHEMES.get(scheme)
+    if factory is None:
+        raise ValueError(f"no PinotFS registered for scheme {scheme!r}")
+    return factory()
+
+
+# ---------------------------------------------------------------------------
+# deep store
+# ---------------------------------------------------------------------------
+
+class SegmentDeepStore:
+    """Tar-per-segment store under a base URI (ref the controller's
+    segment store + SegmentCompletionUtils naming)."""
+
+    def __init__(self, base_uri: str):
+        if "://" not in base_uri:
+            base_uri = "file://" + os.path.abspath(base_uri)
+        self.base_uri = base_uri.rstrip("/")
+        self.fs = get_fs(base_uri)
+
+    def segment_uri(self, table: str, segment_name: str) -> str:
+        return f"{self.base_uri}/{table}/{segment_name}.tar.gz"
+
+    def upload(self, seg_dir: str, table: str, segment_name: str,
+               unique: bool = False) -> str:
+        """Tar + push a built segment directory; returns its store URI.
+
+        unique: append a per-attempt token to the stored name (ref
+        SegmentCompletionUtils' UUID suffix) — a stale de-elected
+        committer finishing late must NOT overwrite the winner's tar at a
+        deterministic path."""
+        stored = segment_name
+        if unique:
+            import uuid
+            stored = f"{segment_name}.{uuid.uuid4().hex[:8]}"
+        uri = self.segment_uri(table, stored)
+        with tempfile.NamedTemporaryFile(suffix=".tar.gz",
+                                         delete=False) as tmp:
+            tmp_path = tmp.name
+        try:
+            with tarfile.open(tmp_path, "w:gz") as tar:
+                # arcname == the stored (possibly attempt-unique) name so
+                # the extracted dir matches the tar file and the localize
+                # cache can find it again; the TRUE segment name lives in
+                # metadata.json, which is what the loader uses
+                tar.add(seg_dir, arcname=stored)
+            self.fs.copy_from_local(tmp_path, uri)
+        finally:
+            os.remove(tmp_path)
+        return uri
+
+    def download(self, uri: str, dest_dir: str) -> str:
+        """Fetch + untar a segment; returns the local segment directory."""
+        return download_segment(uri, dest_dir)
+
+    def delete(self, table: str, segment_name: str) -> bool:
+        return self.fs.delete(self.segment_uri(table, segment_name))
+
+
+def download_segment(uri: str, dest_dir: str) -> str:
+    """Fetch + untar a stored segment by URI (peer/deep-store download,
+    ref BaseTableDataManager.downloadSegment); returns the local dir."""
+    fs = get_fs(uri)
+    os.makedirs(dest_dir, exist_ok=True)
+    with tempfile.NamedTemporaryFile(suffix=".tar.gz", delete=False) as tmp:
+        tmp_path = tmp.name
+    try:
+        fs.copy_to_local(uri, tmp_path)
+        with tarfile.open(tmp_path, "r:gz") as tar:
+            top = tar.getnames()[0].split("/")[0]
+            tar.extractall(dest_dir, filter="data")
+    finally:
+        os.remove(tmp_path)
+    return os.path.join(dest_dir, top)
+
+
+def is_store_uri(path: str) -> bool:
+    """True when a segment 'dir_path' is a deep-store URI (tarball),
+    not a directly loadable local directory."""
+    return "://" in path and path.endswith(".tar.gz")
+
+
+def localize_segment(dir_path: str, cache_dir: str) -> str:
+    """Resolve a SegmentState dir_path to a loadable local directory:
+    plain paths pass through; deep-store URIs download into cache_dir
+    (reusing an already-extracted copy). Shared by server reconcile and
+    minion task inputs."""
+    if not is_store_uri(dir_path):
+        return dir_path
+    name = os.path.basename(urlparse(dir_path).path)
+    if name.endswith(".tar.gz"):
+        name = name[: -len(".tar.gz")]
+    existing = os.path.join(cache_dir, name)
+    if os.path.exists(os.path.join(existing, "metadata.json")):
+        return existing
+    return download_segment(dir_path, cache_dir)

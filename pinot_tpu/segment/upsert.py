@@ -46,17 +46,26 @@ class PartitionUpsertMetadataManager:
         self._lock = threading.Lock()
 
     # ------------------------------------------------------------------
-    def add_segment(self, segment) -> None:
+    def add_segment(self, segment, use_snapshot: bool = True) -> None:
         """Register an (im)mutable segment's rows; later comparison values
-        win, losers are invalidated in their owning segment's bitmap."""
+        win, losers are invalidated in their owning segment's bitmap.
+
+        use_snapshot: when the segment directory carries a persisted
+        validDocIds snapshot (ref upsert/ snapshot logic), start from it —
+        docs already invalidated before the snapshot lost their upsert
+        battle and are skipped, making restart O(valid) not O(total)."""
         n = segment.num_docs
-        valid = Bitmap.all_set(n)
+        snap = load_valid_doc_ids(segment) if use_snapshot else None
+        valid = snap if snap is not None else Bitmap.all_set(n)
         segment.valid_doc_ids = valid
         pk_cols = [np.asarray(segment.data_source(c).values())
                    for c in self.pk_columns]
         cmp_col = np.asarray(segment.data_source(self.comparison_column).values())
+        mask = valid.to_mask() if snap is not None else None
         with self._lock:
             for doc_id in range(n):
+                if mask is not None and not mask[doc_id]:
+                    continue
                 pk = tuple(_py(col[doc_id]) for col in pk_cols)
                 self._upsert_locked(segment, doc_id, _py(cmp_col[doc_id]), pk,
                                     valid)
@@ -184,3 +193,60 @@ def _cmp_ge(a, b) -> bool:
 
 def _py(v):
     return v.item() if isinstance(v, np.generic) else v
+
+
+# ---------------------------------------------------------------------------
+# validDocIds snapshots (ref pinot-segment-local upsert/ snapshot logic:
+# persisted per segment so a restarted server resumes upsert state without
+# replaying every row)
+# ---------------------------------------------------------------------------
+
+VALID_DOC_IDS_SNAPSHOT = "validdocids.snapshot"
+
+
+def write_valid_doc_ids(seg_dir: str, valid: Bitmap, crc: int = 0) -> None:
+    """Write a validDocIds snapshot into a segment directory. The header
+    carries (num_docs, crc) so a rebuilt segment of the SAME size does not
+    silently adopt a stale bitmap (ref Pinot's snapshot crc check)."""
+    import os
+    import struct
+    path = os.path.join(seg_dir, VALID_DOC_IDS_SNAPSHOT)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(struct.pack("<IQ", valid.num_docs, crc & (2**64 - 1)))
+        f.write(valid.to_bytes())
+    os.replace(tmp, path)
+
+
+def persist_valid_doc_ids(segment) -> bool:
+    """Write the segment's current validDocIds bitmap next to its data
+    files. Returns False when the segment has no bitmap or no directory."""
+    valid = getattr(segment, "valid_doc_ids", None)
+    seg_dir = getattr(getattr(segment, "dir", None), "path", None)
+    if valid is None or seg_dir is None:
+        return False
+    crc = getattr(getattr(segment, "metadata", None), "crc", 0) or 0
+    write_valid_doc_ids(seg_dir, valid, crc)
+    return True
+
+
+def load_valid_doc_ids(segment) -> Optional[Bitmap]:
+    """Read a persisted snapshot if present and matching this segment
+    build (num_docs AND crc when both sides carry one)."""
+    import os
+    import struct
+    seg_dir = getattr(getattr(segment, "dir", None), "path", None)
+    if seg_dir is None:
+        return None
+    path = os.path.join(seg_dir, VALID_DOC_IDS_SNAPSHOT)
+    if not os.path.exists(path):
+        return None
+    with open(path, "rb") as f:
+        num_docs, snap_crc = struct.unpack("<IQ", f.read(12))
+        data = f.read()
+    if num_docs != segment.num_docs:
+        return None  # stale snapshot from a different build
+    seg_crc = getattr(getattr(segment, "metadata", None), "crc", 0) or 0
+    if snap_crc and seg_crc and snap_crc != (seg_crc & (2**64 - 1)):
+        return None  # same size, different build
+    return Bitmap.from_bytes(num_docs, data)

@@ -1,0 +1,154 @@
+"""Deep store (PinotFS), upsert snapshots, and restart recovery.
+
+Ref: pinot-spi filesystem/PinotFS.java, SplitSegmentCommitter's
+upload-then-commit, pinot-segment-local upsert/ snapshot logic,
+PeerDownloadLLCRealtimeClusterIntegrationTest (deep-store recovery) —
+VERDICT r4 missing #2 / next-round task 4.
+"""
+import os
+
+import numpy as np
+import pytest
+
+from pinot_tpu.models import (DataType, FieldSpec, FieldType, Schema,
+                              TableConfig, TableType)
+from pinot_tpu.query.executor import QueryExecutor
+from pinot_tpu.segment.creator import SegmentCreator
+from pinot_tpu.segment.fs import (LocalPinotFS, SegmentDeepStore,
+                                  download_segment, get_fs, is_store_uri)
+from pinot_tpu.segment.loader import load_segment
+from pinot_tpu.segment.upsert import (PartitionUpsertMetadataManager,
+                                      load_valid_doc_ids,
+                                      persist_valid_doc_ids)
+
+
+def _build_segment(tmp_path, name="s0", n=1000):
+    schema = Schema("t", [
+        FieldSpec("id", DataType.INT, FieldType.DIMENSION),
+        FieldSpec("v", DataType.INT, FieldType.METRIC),
+    ])
+    tc = TableConfig(name="t")
+    out = str(tmp_path / name)
+    SegmentCreator(tc, schema).build(
+        {"id": np.arange(n), "v": np.arange(n) * 2}, out, name)
+    return out
+
+
+class TestPinotFS:
+    def test_local_fs_roundtrip(self, tmp_path):
+        fs = get_fs("file:///tmp")
+        assert isinstance(fs, LocalPinotFS)
+        src = tmp_path / "a.txt"
+        src.write_bytes(b"hello")
+        uri = f"file://{tmp_path}/sub/b.txt"
+        fs.copy_from_local(str(src), uri)
+        assert fs.exists(uri)
+        assert fs.length(uri) == 5
+        dst = tmp_path / "c.txt"
+        fs.copy_to_local(uri, str(dst))
+        assert dst.read_bytes() == b"hello"
+        assert fs.delete(uri)
+        assert not fs.exists(uri)
+
+    def test_unknown_scheme_rejected(self):
+        with pytest.raises(ValueError):
+            get_fs("s3://bucket/x")
+
+
+class TestDeepStore:
+    def test_upload_download_roundtrip(self, tmp_path):
+        seg_dir = _build_segment(tmp_path)
+        store = SegmentDeepStore(str(tmp_path / "store"))
+        uri = store.upload(seg_dir, "t_OFFLINE", "s0")
+        assert is_store_uri(uri)
+        assert store.fs.exists(uri)
+        local = download_segment(uri, str(tmp_path / "dl"))
+        seg = load_segment(local)
+        assert seg.num_docs == 1000
+        r = QueryExecutor([seg], use_tpu=False).execute(
+            "SELECT SUM(v) FROM t")
+        assert r.rows[0][0] == float(sum(range(1000)) * 2)
+
+    def test_snapshot_travels_with_segment(self, tmp_path):
+        """validDocIds snapshots ride inside the tar: a downloaded copy
+        resumes upsert state."""
+        seg_dir = _build_segment(tmp_path)
+        seg = load_segment(seg_dir)
+        mgr = PartitionUpsertMetadataManager(["id"], "v")
+        mgr.add_segment(seg)
+        seg.valid_doc_ids.clear(5)
+        seg.valid_doc_ids.clear(7)
+        assert persist_valid_doc_ids(seg)
+        store = SegmentDeepStore(str(tmp_path / "store"))
+        uri = store.upload(seg_dir, "t_REALTIME", "s0")
+        local = download_segment(uri, str(tmp_path / "dl"))
+        seg2 = load_segment(local)
+        snap = load_valid_doc_ids(seg2)
+        assert snap is not None
+        assert not snap.contains(5) and not snap.contains(7) and snap.contains(6)
+
+    def test_add_segment_uses_snapshot(self, tmp_path):
+        seg_dir = _build_segment(tmp_path, n=100)
+        seg = load_segment(seg_dir)
+        mgr = PartitionUpsertMetadataManager(["id"], "v")
+        mgr.add_segment(seg)
+        seg.valid_doc_ids.clear(3)
+        persist_valid_doc_ids(seg)
+        # fresh manager + fresh load (the restart): snapshot keeps doc 3
+        # invalid and registers only valid docs
+        seg2 = load_segment(seg_dir)
+        mgr2 = PartitionUpsertMetadataManager(["id"], "v")
+        mgr2.add_segment(seg2)
+        assert not seg2.valid_doc_ids.contains(3)
+        assert mgr2.num_primary_keys == 99
+
+
+class TestRealtimeDeepStore:
+    def test_commit_uploads_and_fsm_advertises_store_uri(self, tmp_path):
+        from pinot_tpu.controller.completion import SegmentCompletionManager
+        from pinot_tpu.ingest import InMemoryStream, StreamConfig
+        from pinot_tpu.ingest.realtime_manager import \
+            RealtimeSegmentDataManager
+        from pinot_tpu.server.data_manager import TableDataManager
+
+        topic = "ds_topic"
+        stream = InMemoryStream(topic, num_partitions=1)
+        try:
+            for i in range(120):
+                stream.publish({"id": i, "v": i}, partition=0)
+            schema = Schema("rt", [
+                FieldSpec("id", DataType.INT, FieldType.DIMENSION),
+                FieldSpec("v", DataType.INT, FieldType.METRIC),
+            ])
+            tc = TableConfig(name="rt", table_type=TableType.REALTIME)
+            sc = StreamConfig(topic=topic, flush_threshold_rows=100,
+                              flush_threshold_time_ms=3_600_000)
+            store = SegmentDeepStore(str(tmp_path / "store"))
+            completion = SegmentCompletionManager(num_replicas=1)
+            tdm = TableDataManager("rt_REALTIME")
+            mgr = RealtimeSegmentDataManager(
+                tc, schema, sc, 0, tdm, str(tmp_path / "segs"),
+                completion_manager=completion, instance_id="server_0",
+                deep_store=store)
+            mgr.start()
+            import time
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                segs = completion._fsms
+                if any(f.state == "COMMITTED" for f in segs.values()):
+                    break
+                time.sleep(0.05)
+            mgr.stop()
+            committed = [(n, f) for n, f in completion._fsms.items()
+                         if f.state == "COMMITTED"]
+            assert committed, "no segment committed"
+            name, fsm = committed[0]
+            assert is_store_uri(fsm.download_path), fsm.download_path
+            assert store.fs.exists(fsm.download_path)
+            # the stored copy is a loadable, queryable segment
+            local = download_segment(fsm.download_path,
+                                     str(tmp_path / "recover"))
+            seg = load_segment(local)
+            assert seg.num_docs >= 100
+        finally:
+            InMemoryStream.delete(topic)

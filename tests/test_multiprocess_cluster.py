@@ -166,3 +166,79 @@ def _coord_up(address: str) -> bool:
         return True
     finally:
         c.close()
+
+
+def test_server_restart_recovers_from_deep_store(tmp_path):
+    """Segments live in the deep store (PinotFS URI), not a shared build
+    dir: a restarted server re-downloads and serves them — killing a
+    server loses nothing (ref PeerDownloadLLCRealtimeClusterIntegrationTest
+    / deep-store-backed serving)."""
+    from pinot_tpu.segment.fs import SegmentDeepStore
+
+    coord_port = _free_port()
+    http_port = _free_port()
+    coordinator = f"127.0.0.1:{coord_port}"
+    procs = {}
+    try:
+        procs["controller"] = _spawn(
+            ["StartController", "--state-dir", str(tmp_path / "state"),
+             "--port", str(coord_port)])
+        _wait(lambda: _coord_up(coordinator), desc="controller up")
+        procs["server"] = _spawn(
+            ["StartServer", "--instance-id", "s0",
+             "--coordinator", coordinator])
+        procs["broker"] = _spawn(
+            ["StartBroker", "--coordinator", coordinator,
+             "--http-port", str(http_port)])
+        client = CoordinationClient(coordinator)
+        _wait(lambda: len(client.get_state()["instances"]) == 1,
+              desc="server registered")
+
+        schema = Schema("ds", [
+            FieldSpec("id", DataType.INT, FieldType.DIMENSION),
+            FieldSpec("v", DataType.INT, FieldType.METRIC)])
+        cfg = TableConfig(name="ds")
+        client.add_table(cfg, schema)
+
+        store = SegmentDeepStore(str(tmp_path / "store"))
+        build_dir = str(tmp_path / "build" / "seg0")
+        vals = np.arange(5000)
+        SegmentCreator(cfg, schema).build(
+            {"id": vals, "v": vals * 3}, build_dir, "ds_0")
+        r = client.upload_segment_to_store("ds", build_dir, store)
+        assert r["segment"]["dir_path"].startswith("file://")
+        # the original build dir is GONE — only the store copy exists
+        import shutil
+        shutil.rmtree(build_dir)
+
+        sql = "SELECT COUNT(*), SUM(v) FROM ds"
+        expect = [5000, float(vals.sum() * 3)]
+
+        def answered():
+            resp = _post_query(http_port, sql)
+            rows = (resp.get("resultTable") or {}).get("rows")
+            return bool(rows) and rows[0] == expect and \
+                not resp.get("exceptions")
+        _wait(answered, desc="served from deep-store download")
+
+        # kill the server hard; restart a fresh process with the same id
+        victim = procs.pop("server")
+        os.kill(victim.pid, signal.SIGKILL)
+        victim.wait(timeout=10)
+        procs["server2"] = _spawn(
+            ["StartServer", "--instance-id", "s0",
+             "--coordinator", coordinator])
+        _wait(answered, timeout=60,
+              desc="restarted server recovered from deep store")
+    finally:
+        for name, proc in procs.items():
+            if proc.poll() is None:
+                proc.terminate()
+        for name, proc in procs.items():
+            try:
+                out, _ = proc.communicate(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                out, _ = proc.communicate()
+            if out:
+                print(f"--- {name} ---\n{out[-2000:]}")
