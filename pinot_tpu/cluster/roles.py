@@ -29,6 +29,7 @@ log = logging.getLogger(__name__)
 
 
 def run_controller(state_dir: str, port: int = 0, host: str = "127.0.0.1",
+                   deep_store_uri: Optional[str] = None,
                    ready_event: Optional[threading.Event] = None,
                    stop_event: Optional[threading.Event] = None) -> None:
     from pinot_tpu.controller.cluster_state import ClusterState
@@ -36,7 +37,8 @@ def run_controller(state_dir: str, port: int = 0, host: str = "127.0.0.1",
     from pinot_tpu.controller.maintenance import run_retention
 
     state = ClusterState(persist_dir=state_dir)
-    server = CoordinationServer(state, host=host, port=port)
+    server = CoordinationServer(state, host=host, port=port,
+                                deep_store_uri=deep_store_uri)
     server.start()
     print(f"controller listening on {server.address}", flush=True)
     if ready_event is not None:
@@ -81,6 +83,11 @@ class ServerRole:
         self.download_dir = download_dir or os.path.join(
             tempfile.gettempdir(), f"pinot-tpu-dl-{instance_id}")
         self._loaded: Set[tuple] = set()  # (physical_table, segment_name)
+        #: (physical_table, partition_id) -> RealtimeSegmentDataManager
+        self._rt_managers: Dict[tuple, object] = {}
+        #: physical_table -> discovered stream partition ids (cached so a
+        #: watch storm doesn't re-dial the stream broker per notification)
+        self._rt_partitions: Dict[str, list] = {}
         self._reconcile_lock = threading.Lock()
 
     def start(self) -> None:
@@ -91,6 +98,8 @@ class ServerRole:
         self.client.watch(lambda _v: self.reconcile())
 
     def stop(self) -> None:
+        for mgr in self._rt_managers.values():
+            mgr.stop()
         self.client.close()
         self.transport.stop()
         self.data_manager.shutdown()
@@ -115,6 +124,14 @@ class ServerRole:
                             and st.get("dir_path"):
                         wanted.add((table, name))
                         if (table, name) not in self._loaded:
+                            tdm = self.data_manager.table(
+                                table, create=False)
+                            if tdm is not None \
+                                    and name in tdm.segment_names:
+                                # already serving a local copy (realtime
+                                # commit on this server) — leave it to its
+                                # owner, don't re-download or track it
+                                continue
                             try:
                                 seg = load_segment(
                                     self._localize(table, st["dir_path"]))
@@ -131,6 +148,99 @@ class ServerRole:
                     tdm.remove_segment(name)
                 self._loaded.discard((table, name))
                 log.info("unloaded %s/%s", table, name)
+            self._ensure_realtime(blob)
+
+    def _ensure_realtime(self, blob: dict) -> None:
+        """Start one consumer per (REALTIME table, stream partition) —
+        every registered server consumes every partition, the completion
+        FSM on the controller elects exactly one committer per segment
+        (ref RealtimeTableDataManager + the CONSUMING state transition)."""
+        from pinot_tpu.controller.coordination import RemoteCompletionManager
+        from pinot_tpu.ingest.realtime_manager import \
+            RealtimeSegmentDataManager
+        from pinot_tpu.ingest.stream import StreamConfig, get_stream_factory
+        from pinot_tpu.models import Schema, TableConfig
+        import pinot_tpu.ingest.tcp_stream  # noqa: F401 — registers 'tcp'
+
+        for logical, cfg_d in blob.get("tables", {}).items():
+            cfg = TableConfig.from_dict(cfg_d)
+            sic = cfg.ingestion.stream
+            if cfg.table_type.value != "REALTIME" or sic is None:
+                continue
+            schema_d = blob.get("schemas", {}).get(logical)
+            if schema_d is None:
+                continue
+            schema = Schema.from_dict(schema_d)
+            props = dict(sic.properties)
+            stream_cfg = StreamConfig(
+                stream_type=sic.stream_type, topic=sic.topic,
+                properties=props,
+                flush_threshold_rows=int(
+                    props.get("flushThresholdRows", 100_000)),
+                flush_threshold_time_ms=int(
+                    props.get("flushThresholdTimeMs", 6 * 3600 * 1000)))
+            physical = cfg.table_name_with_type
+            partitions = self._rt_partitions.get(physical)
+            if partitions is None:
+                # discover once per table, not per watch notification
+                try:
+                    meta = get_stream_factory(stream_cfg) \
+                        .create_metadata_provider(stream_cfg)
+                    partitions = meta.partition_ids()
+                    close = getattr(meta, "close", None)
+                    if close is not None:
+                        close()
+                except Exception:  # noqa: BLE001 — stream not up yet
+                    log.warning("stream metadata unavailable for %s",
+                                physical)
+                    continue
+                self._rt_partitions[physical] = partitions
+            store = None
+            if blob.get("deep_store_uri"):
+                from pinot_tpu.segment.fs import SegmentDeepStore
+                store = SegmentDeepStore(blob["deep_store_uri"])
+            for pid in partitions:
+                key = (physical, pid)
+                if key in self._rt_managers:
+                    continue
+                tdm = self.data_manager.table(physical)
+                seg_store = os.path.join(self.download_dir, "rt", physical)
+                holder: Dict[str, object] = {}
+                mgr = RealtimeSegmentDataManager(
+                    cfg, schema, stream_cfg, pid, tdm, seg_store,
+                    completion_manager=RemoteCompletionManager(self.client),
+                    instance_id=self.instance_id,
+                    deep_store=store,
+                    on_commit=self._rt_committed(physical, pid, holder),
+                    on_open=self._rt_opened(physical, pid))
+                holder["mgr"] = mgr
+                mgr.start()
+                self._rt_managers[key] = mgr
+                log.info("consuming %s partition %d", physical, pid)
+
+    def _rt_opened(self, physical: str, pid: int):
+        def cb(segment_name: str):
+            self.client.request("add_segment_replica", segment={
+                "name": segment_name, "table": physical,
+                "instances": [self.instance_id], "dir_path": None,
+                "num_docs": 0, "partition_id": pid,
+                "status": "CONSUMING"})
+        return cb
+
+    def _rt_committed(self, physical: str, pid: int, holder: dict):
+        def cb(segment_name: str, offset):
+            mgr = holder.get("mgr")
+            uri = getattr(mgr, "last_commit_uri", None)
+            from pinot_tpu.segment.fs import is_store_uri
+            self.client.request("add_segment_replica", segment={
+                "name": segment_name, "table": physical,
+                "instances": [self.instance_id],
+                # only durable (store) locations are worth persisting —
+                # a local build dir dies with this server
+                "dir_path": uri if uri and is_store_uri(uri) else None,
+                "num_docs": 0, "partition_id": pid,
+                "end_offset": str(offset), "status": "ONLINE"})
+        return cb
 
     def _localize(self, table: str, dir_path: str) -> str:
         """A deep-store URI downloads through PinotFS into the local cache

@@ -22,7 +22,6 @@ import json
 import logging
 import socket
 import socketserver
-import struct
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
@@ -34,37 +33,13 @@ from pinot_tpu.controller.cluster_state import (
     ClusterState, InstanceState, SegmentState)
 from pinot_tpu.controller.completion import SegmentCompletionManager
 from pinot_tpu.models import Schema, TableConfig
+from pinot_tpu.utils.netframe import (FramedChannel, recv_exact,
+                                      recv_frame, send_frame)
 
-_LEN = struct.Struct("<I")
-MAX_FRAME = 64 << 20
-
-
-def _send_frame(sock: socket.socket, obj: Any) -> None:
-    payload = json.dumps(obj).encode()
-    sock.sendall(_LEN.pack(len(payload)) + payload)
-
-
-def _recv_frame(sock: socket.socket) -> Optional[dict]:
-    hdr = _recv_exact(sock, 4)
-    if hdr is None:
-        return None
-    n = _LEN.unpack(hdr)[0]
-    if n > MAX_FRAME:
-        raise ValueError(f"frame too large: {n}")
-    body = _recv_exact(sock, n)
-    if body is None:
-        return None
-    return json.loads(body)
-
-
-def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
-    buf = b""
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
-            return None
-        buf += chunk
-    return buf
+# wire helpers shared with the TCP stream connector (utils/netframe.py)
+_send_frame = send_frame
+_recv_frame = recv_frame
+_recv_exact = recv_exact
 
 
 class CoordinationServer:
@@ -73,9 +48,13 @@ class CoordinationServer:
 
     def __init__(self, state: ClusterState,
                  completion: Optional[SegmentCompletionManager] = None,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 deep_store_uri: Optional[str] = None):
         self.state = state
         self.completion = completion or SegmentCompletionManager()
+        #: cluster-wide deep-store base URI; servers build their
+        #: SegmentDeepStore from it (ref controller.data.dir config)
+        self.deep_store_uri = deep_store_uri
         self.version = 0
         self._watchers: List[socket.socket] = []
         self._lock = threading.Lock()
@@ -195,6 +174,37 @@ class CoordinationServer:
         if op == "upsert_segment":
             self.state.upsert_segment(SegmentState.from_dict(req["segment"]))
             return {"ok": True}
+        if op == "add_segment_replica":
+            # merge-register: realtime replicas report the same segment
+            # independently (CONSUMING open / commit), so instances UNION
+            # instead of overwriting (ref IdealState instance-map updates)
+            st = SegmentState.from_dict(req["segment"])
+            with self.state._lock:
+                cur = self.state.segments.setdefault(st.table, {}) \
+                    .get(st.name)
+                if cur is not None:
+                    for inst in st.instances:
+                        if inst not in cur.instances:
+                            cur.instances.append(inst)
+                    if st.dir_path:
+                        # a deep-store URI is durable; never let a KEEP
+                        # replica's local path displace the committer's
+                        from pinot_tpu.segment.fs import is_store_uri
+                        if not (cur.dir_path
+                                and is_store_uri(cur.dir_path)
+                                and not is_store_uri(st.dir_path)):
+                            cur.dir_path = st.dir_path
+                    if st.end_offset:
+                        cur.end_offset = st.end_offset
+                    if st.num_docs:
+                        cur.num_docs = st.num_docs
+                    if st.status != cur.status and st.status == "ONLINE":
+                        cur.status = st.status  # CONSUMING -> ONLINE seal
+                    st = cur
+                self.state.segments[st.table][st.name] = st
+            self.state._persist()
+            self.state._notify(st.table)
+            return {"segment": st.to_dict()}
         if op == "remove_segment":
             st = self.state.remove_segment(req["table"], req["name"])
             return {"ok": st is not None}
@@ -271,6 +281,7 @@ class CoordinationServer:
         with self.state._lock:
             return {
                 "version": self.version,
+                "deep_store_uri": self.deep_store_uri,
                 "tables": {k: v.to_dict()
                            for k, v in self.state.tables.items()},
                 "schemas": {k: v.to_dict()
@@ -289,52 +300,18 @@ class CoordinationClient:
     the watch push channel (the ZK client session analog)."""
 
     def __init__(self, address: str, timeout: float = 30.0):
-        host, port = address.rsplit(":", 1)
-        self.host, self.port = host, int(port)
-        self.timeout = timeout
-        self._sock: Optional[socket.socket] = None
-        self._lock = threading.Lock()
+        self._ch = FramedChannel(address, timeout=timeout)
+        self.host, self.port = self._ch.host, self._ch.port
         self._watch_stop = threading.Event()
         self._watch_thread: Optional[threading.Thread] = None
 
     # ------------------------------------------------------------------
-    def _connect(self) -> socket.socket:
-        if self._sock is None:
-            self._sock = socket.create_connection(
-                (self.host, self.port), timeout=self.timeout)
-        return self._sock
-
     def request(self, op: str, **kwargs) -> dict:
-        req = {"op": op, **kwargs}
-        with self._lock:
-            for attempt in (0, 1):  # one reconnect on a dropped channel
-                try:
-                    sock = self._connect()
-                    _send_frame(sock, req)
-                    resp = _recv_frame(sock)
-                    if resp is None:
-                        raise ConnectionError("coordination channel closed")
-                    break
-                except (ConnectionError, OSError):
-                    self._close_locked()
-                    if attempt:
-                        raise
-        if "error" in resp:
-            raise RuntimeError(f"coordination error: {resp['error']}")
-        return resp
-
-    def _close_locked(self) -> None:
-        if self._sock is not None:
-            try:
-                self._sock.close()
-            except OSError:
-                pass
-            self._sock = None
+        return self._ch.request({"op": op, **kwargs})
 
     def close(self) -> None:
         self.stop_watch()
-        with self._lock:
-            self._close_locked()
+        self._ch.close()
 
     # -- typed helpers --------------------------------------------------
     def get_state(self) -> dict:

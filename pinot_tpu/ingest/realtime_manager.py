@@ -41,7 +41,8 @@ class RealtimeSegmentDataManager:
                  on_commit: Optional[Callable[[str, LongMsgOffset], None]] = None,
                  ingestion_delay_tracker=None,
                  completion_manager=None, instance_id: str = "server_0",
-                 deep_store=None):
+                 deep_store=None,
+                 on_open: Optional[Callable[[str], None]] = None):
         """completion_manager: a controller SegmentCompletionManager for
         multi-replica coordination (exactly one replica commits per
         segment, ref BlockingSegmentCompletionFSM); None = single-replica
@@ -61,6 +62,13 @@ class RealtimeSegmentDataManager:
         self.completion = completion_manager
         self.instance_id = instance_id
         self.deep_store = deep_store
+        #: fires with the new CONSUMING segment's name at each rotation —
+        #: cluster roles register it so brokers route consuming rows
+        self.on_open = on_open
+        #: durable location of the most recent commit (deep-store URI when
+        #: one is configured, else the local build dir); cluster roles
+        #: persist it in SegmentState so restarted servers can recover
+        self.last_commit_uri: Optional[str] = None
         self._catchup_target: Optional[int] = None
         self._catchup_deadline = 0.0
         #: a DISCARD rewound current_offset: the in-flight fetched batch
@@ -115,6 +123,11 @@ class RealtimeSegmentDataManager:
         self.mutable = MutableSegment(self._segment_name(), self.table_config,
                                       self.schema)
         self.tdm.add_segment(self.mutable)  # immediately queryable
+        if self.on_open is not None:
+            try:
+                self.on_open(self.mutable.segment_name)
+            except Exception:  # noqa: BLE001 — registration is advisory
+                log.exception("on_open callback failed")
 
     # ------------------------------------------------------------------
     def start(self) -> None:
@@ -241,6 +254,7 @@ class RealtimeSegmentDataManager:
                     # the unlocked controller round-trip — finalize only
                     # the segment this build actually sealed
                     if self.mutable is sealed:
+                        self.last_commit_uri = advertised
                         self._finalize_commit(out_dir)
             else:
                 # de-elected while building (slow committer past the
@@ -285,6 +299,7 @@ class RealtimeSegmentDataManager:
                 path = download_segment(
                     path, os.path.join(self.store_dir, "_downloads"))
             with self._seal_lock:
+                self.last_commit_uri = resp.download_path
                 immutable = load_segment(path)
                 self.tdm.add_segment(immutable)
                 self.current_offset = LongMsgOffset(resp.offset)
@@ -310,10 +325,11 @@ class RealtimeSegmentDataManager:
         Returns the built segment directory (the completion protocol
         advertises it as the peer-download location)."""
         out_dir = self._build_immutable()
+        self.last_commit_uri = out_dir
         if self.deep_store is not None and self.completion is None:
             # single-replica durability (the protocol path uploads before
             # commit-end instead; KEEP re-uploads would be redundant)
-            self.deep_store.upload(
+            self.last_commit_uri = self.deep_store.upload(
                 out_dir, self.table_config.table_name_with_type,
                 self.mutable.segment_name)
         self._finalize_commit(out_dir)

@@ -205,7 +205,14 @@ def main(argv=None) -> int:
                         help="coordination service + maintenance loops")
     sc.add_argument("--state-dir", required=True)
     sc.add_argument("--port", type=int, default=9000)
+    sc.add_argument("--deep-store", default=None,
+                    help="deep-store base URI (e.g. file:///data/store)")
     sc.set_defaults(fn=cmd_start_controller)
+
+    sst = sub.add_parser("StartStreamServer",
+                         help="TCP stream broker (topic partition logs)")
+    sst.add_argument("--port", type=int, default=0)
+    sst.set_defaults(fn=cmd_start_stream_server)
 
     ss = sub.add_parser("StartServer", help="query server joined to a "
                                             "controller")
@@ -241,7 +248,23 @@ def main(argv=None) -> int:
 
 def cmd_start_controller(args) -> int:
     from pinot_tpu.cluster.roles import run_controller
-    run_controller(args.state_dir, port=args.port)
+    run_controller(args.state_dir, port=args.port,
+                   deep_store_uri=args.deep_store)
+    return 0
+
+
+def cmd_start_stream_server(args) -> int:
+    import time as _time
+
+    from pinot_tpu.ingest.tcp_stream import StreamServer
+    server = StreamServer(port=args.port)
+    server.start()
+    print(f"stream server listening on {server.address}", flush=True)
+    try:
+        while True:
+            _time.sleep(3600)
+    except KeyboardInterrupt:
+        server.stop()
     return 0
 
 

@@ -1,0 +1,91 @@
+"""Shared framed-JSON TCP protocol: u32 little-endian length | JSON.
+
+Used by the coordination service (controller/coordination.py) and the TCP
+stream connector (ingest/tcp_stream.py) — one implementation of framing,
+frame-size limits, and the reconnecting request channel, so wire fixes
+land everywhere at once.
+"""
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+from typing import Any, Optional
+
+LEN = struct.Struct("<I")
+MAX_FRAME = 64 << 20
+
+
+def send_frame(sock: socket.socket, obj: Any) -> None:
+    payload = json.dumps(obj).encode()
+    sock.sendall(LEN.pack(len(payload)) + payload)
+
+
+def recv_frame(sock: socket.socket) -> Optional[dict]:
+    hdr = recv_exact(sock, 4)
+    if hdr is None:
+        return None
+    n = LEN.unpack(hdr)[0]
+    if n > MAX_FRAME:
+        raise ValueError(f"frame too large: {n}")
+    body = recv_exact(sock, n)
+    return None if body is None else json.loads(body)
+
+
+def recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+class FramedChannel:
+    """Thread-safe blocking request/response channel with one reconnect.
+
+    retry=False callers (non-idempotent ops like stream publish) surface
+    the connection error instead of re-sending a request the server may
+    have already applied."""
+
+    def __init__(self, address: str, timeout: Optional[float] = 30.0):
+        host, port = address.rsplit(":", 1)
+        self.host, self.port = host, int(port)
+        self.timeout = timeout
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+
+    def request(self, req: dict, retry: bool = True) -> dict:
+        with self._lock:
+            attempts = (0, 1) if retry else (1,)
+            for attempt in attempts:
+                try:
+                    if self._sock is None:
+                        self._sock = socket.create_connection(
+                            (self.host, self.port), timeout=self.timeout)
+                    send_frame(self._sock, req)
+                    resp = recv_frame(self._sock)
+                    if resp is None:
+                        raise ConnectionError("channel closed")
+                    break
+                except (ConnectionError, OSError):
+                    self._close_locked()
+                    if attempt:
+                        raise
+        if "error" in resp:
+            raise RuntimeError(f"remote error: {resp['error']}")
+        return resp
+
+    def _close_locked(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def close(self) -> None:
+        with self._lock:
+            self._close_locked()

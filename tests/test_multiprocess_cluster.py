@@ -242,3 +242,103 @@ def test_server_restart_recovers_from_deep_store(tmp_path):
                 out, _ = proc.communicate()
             if out:
                 print(f"--- {name} ---\n{out[-2000:]}")
+
+
+def test_multiprocess_realtime_replicas_over_tcp_stream(tmp_path):
+    """Two server PROCESSES consume the same TCP stream partition; the
+    controller's completion FSM elects exactly one committer per segment;
+    committed segments land in the deep store; killing a replica leaves
+    correct answers (ref LLCRealtimeClusterIntegrationTest +
+    SegmentCompletionIntegrationTest, promoted to real processes)."""
+    from pinot_tpu.ingest.tcp_stream import StreamProducer, StreamServer
+    from pinot_tpu.models.table_config import (IngestionConfig,
+                                               StreamIngestionConfig)
+    from pinot_tpu.models import TableType
+
+    coord_port = _free_port()
+    http_port = _free_port()
+    coordinator = f"127.0.0.1:{coord_port}"
+    stream = StreamServer()
+    stream.start()
+    procs = {}
+    try:
+        procs["controller"] = _spawn(
+            ["StartController", "--state-dir", str(tmp_path / "state"),
+             "--port", str(coord_port),
+             "--deep-store", f"file://{tmp_path}/store"])
+        _wait(lambda: _coord_up(coordinator), desc="controller up")
+        for i in range(2):
+            procs[f"server_{i}"] = _spawn(
+                ["StartServer", "--instance-id", f"rs{i}",
+                 "--coordinator", coordinator])
+        procs["broker"] = _spawn(
+            ["StartBroker", "--coordinator", coordinator,
+             "--http-port", str(http_port)])
+
+        client = CoordinationClient(coordinator)
+        _wait(lambda: len(client.get_state()["instances"]) == 2,
+              desc="servers registered")
+
+        prod = StreamProducer(stream.address)
+        prod.create_topic("events")
+        schema = Schema("rte", [
+            FieldSpec("id", DataType.INT, FieldType.DIMENSION),
+            FieldSpec("v", DataType.INT, FieldType.METRIC)])
+        cfg = TableConfig(name="rte", table_type=TableType.REALTIME)
+        cfg.ingestion = IngestionConfig(stream=StreamIngestionConfig(
+            stream_type="tcp", topic="events",
+            properties={"bootstrap": stream.address,
+                        "flushThresholdRows": "100",
+                        "flushThresholdTimeMs": "3600000"}))
+        client.add_table(cfg, schema)
+
+        for i in range(250):
+            prod.publish("events", {"id": i, "v": i})
+
+        sql = "SELECT COUNT(*), SUM(id) FROM rte"
+        expect = [250, float(sum(range(250)))]
+
+        def caught_up():
+            resp = _post_query(http_port, sql)
+            rows = (resp.get("resultTable") or {}).get("rows")
+            return bool(rows) and rows[0] == expect and \
+                not resp.get("exceptions")
+        _wait(caught_up, timeout=60, desc="realtime rows via broker")
+
+        # exactly-one-committer held across PROCESSES: committed segments
+        # exist with BOTH replicas registered
+        blob = client.get_state()
+        segs = blob["segments"].get("rte_REALTIME", {})
+        online = [s for s in segs.values() if s["status"] == "ONLINE"]
+        assert len(online) >= 2, segs
+        for s in online:
+            assert set(s["instances"]) == {"rs0", "rs1"}, s
+
+        # chaos: kill one replica; the survivor keeps serving AND consuming
+        victim = procs.pop("server_1")
+        os.kill(victim.pid, signal.SIGKILL)
+        victim.wait(timeout=10)
+        for i in range(250, 400):
+            prod.publish("events", {"id": i, "v": i})
+        expect2 = [400, float(sum(range(400)))]
+
+        def still_correct():
+            resp = _post_query(http_port, sql)
+            rows = (resp.get("resultTable") or {}).get("rows")
+            return bool(rows) and rows[0] == expect2 and \
+                not resp.get("exceptions")
+        _wait(still_correct, timeout=60,
+              desc="survivor consumes + serves after replica kill")
+    finally:
+        stream.stop()
+        for name, proc in procs.items():
+            if proc.poll() is None:
+                proc.terminate()
+        for name, proc in procs.items():
+            try:
+                out, _ = proc.communicate(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                out, _ = proc.communicate()
+            if out:
+                print(f"--- {name} ---\n{out[-2000:]}")

@@ -1,0 +1,125 @@
+"""TCP stream connector: the network stream SPI (Kafka-consumer analog).
+
+Ref: pinot-plugins/pinot-stream-ingestion/pinot-kafka-2.0
+KafkaPartitionLevelConsumer.java, KafkaStreamMetadataProvider — VERDICT
+r4 missing #3 / next-round task 7: the SPI must work OUTSIDE the process.
+"""
+import time
+
+import numpy as np
+import pytest
+
+from pinot_tpu.ingest.stream import LongMsgOffset, StreamConfig
+from pinot_tpu.ingest.tcp_stream import (StreamProducer, StreamServer,
+                                         TcpStreamConsumerFactory)
+from pinot_tpu.models import (DataType, FieldSpec, FieldType, Schema,
+                              TableConfig, TableType)
+
+
+@pytest.fixture()
+def stream_server():
+    server = StreamServer()
+    server.start()
+    yield server
+    server.stop()
+
+
+def _config(server, topic, flush_rows=100):
+    return StreamConfig(stream_type="tcp", topic=topic,
+                        flush_threshold_rows=flush_rows,
+                        flush_threshold_time_ms=3_600_000,
+                        properties={"bootstrap": server.address})
+
+
+class TestTcpStreamSpi:
+    def test_publish_fetch_roundtrip(self, stream_server):
+        prod = StreamProducer(stream_server.address)
+        prod.create_topic("t1", partitions=2)
+        for i in range(10):
+            prod.publish("t1", {"i": i}, partition=i % 2)
+        factory = TcpStreamConsumerFactory()
+        cfg = _config(stream_server, "t1")
+        meta = factory.create_metadata_provider(cfg)
+        assert meta.partition_ids() == [0, 1]
+        consumer = factory.create_partition_consumer(cfg, 0)
+        batch = consumer.fetch_messages(LongMsgOffset(0), 1000)
+        assert [m.value["i"] for m in batch.messages] == [0, 2, 4, 6, 8]
+        assert batch.next_offset == LongMsgOffset(5)
+        # incremental fetch from a checkpoint
+        prod.publish("t1", {"i": 10}, partition=0)
+        batch2 = consumer.fetch_messages(batch.next_offset, 1000)
+        assert [m.value["i"] for m in batch2.messages] == [10]
+        consumer.close()
+        prod.close()
+
+    def test_offset_criteria(self, stream_server):
+        prod = StreamProducer(stream_server.address)
+        prod.create_topic("t2")
+        for i in range(7):
+            prod.publish("t2", {"i": i})
+        factory = TcpStreamConsumerFactory()
+        meta = factory.create_metadata_provider(_config(stream_server, "t2"))
+        assert meta.start_offset(0, "smallest") == LongMsgOffset(0)
+        assert meta.start_offset(0, "largest") == LongMsgOffset(7)
+
+
+class TestRealtimeOverTcp:
+    def test_consume_seal_and_checkpoint_resume(self, stream_server,
+                                                tmp_path):
+        from pinot_tpu.ingest.realtime_manager import \
+            RealtimeSegmentDataManager
+        from pinot_tpu.query.executor import QueryExecutor
+        from pinot_tpu.server.data_manager import TableDataManager
+
+        prod = StreamProducer(stream_server.address)
+        prod.create_topic("rtt")
+        for i in range(250):
+            prod.publish("rtt", {"id": i, "v": i})
+        schema = Schema("rtt", [
+            FieldSpec("id", DataType.INT, FieldType.DIMENSION),
+            FieldSpec("v", DataType.INT, FieldType.METRIC)])
+        tc = TableConfig(name="rtt", table_type=TableType.REALTIME)
+        commits = []
+        tdm = TableDataManager("rtt_REALTIME")
+        mgr = RealtimeSegmentDataManager(
+            tc, schema, _config(stream_server, "rtt"), 0, tdm,
+            str(tmp_path / "segs"),
+            on_commit=lambda n, off: commits.append((n, off)))
+        mgr.start()
+        deadline = time.time() + 30
+        while time.time() < deadline and len(commits) < 2:
+            time.sleep(0.05)
+        mgr.stop()
+        assert len(commits) >= 2, commits
+        # all 250 rows visible across sealed + consuming segments
+        sdms = tdm.acquire_segments()
+        try:
+            ex = QueryExecutor([s.segment for s in sdms], use_tpu=False)
+            r = ex.execute("SELECT COUNT(*), SUM(id) FROM rtt")
+            assert r.rows[0] == (250, float(sum(range(250))))
+        finally:
+            TableDataManager.release_all(sdms)
+
+        # checkpoint resume: a NEW manager from the last commit offset
+        # consumes only the tail (no replay of committed rows)
+        last_offset = commits[-1][1]
+        for i in range(250, 300):
+            prod.publish("rtt", {"id": i, "v": i})
+        tdm2 = TableDataManager("rtt_REALTIME")
+        mgr2 = RealtimeSegmentDataManager(
+            tc, schema, _config(stream_server, "rtt"), 0, tdm2,
+            str(tmp_path / "segs2"), start_offset=last_offset)
+        mgr2.start()
+        deadline = time.time() + 20
+        want = 300 - int(str(last_offset))
+        while time.time() < deadline:
+            sdms = tdm2.acquire_segments()
+            try:
+                total = sum(s.segment.num_docs for s in sdms)
+            finally:
+                TableDataManager.release_all(sdms)
+            if total >= want:
+                break
+            time.sleep(0.05)
+        mgr2.stop()
+        assert total == want
