@@ -31,11 +31,11 @@ class MiniClusterServer:
         # shared device engine (ref QueryRunner.java:258)
         from pinot_tpu.mse.dispatcher import make_leaf_query_fn, make_scan_fn
         from pinot_tpu.mse.runtime import MseWorker
+        engine_fn = self.executor._shared_engine if use_tpu else None
         self.mse_worker = MseWorker(
-            instance_id, make_scan_fn(self.data_manager),
-            leaf_query_fn=make_leaf_query_fn(
-                self.data_manager,
-                self.executor._shared_engine if use_tpu else None))
+            instance_id,
+            make_scan_fn(self.data_manager, engine_fn=engine_fn),
+            leaf_query_fn=make_leaf_query_fn(self.data_manager, engine_fn))
 
     def start(self) -> None:
         self.transport.start()

@@ -85,10 +85,16 @@ class ServerRole:
         self._loaded: Set[tuple] = set()  # (physical_table, segment_name)
         #: (physical_table, partition_id) -> RealtimeSegmentDataManager
         self._rt_managers: Dict[tuple, object] = {}
-        #: physical_table -> discovered stream partition ids (cached so a
-        #: watch storm doesn't re-dial the stream broker per notification)
-        self._rt_partitions: Dict[str, list] = {}
+        #: physical_table -> (partition ids, discovered-at) — cached so a
+        #: watch storm doesn't re-dial the stream broker per notification,
+        #: refreshed periodically so added partitions start consuming
+        #: (ref KafkaStreamMetadataProvider.fetchPartitionCount re-polls)
+        self._rt_partitions: Dict[str, tuple] = {}
+        self._stopping = False
         self._reconcile_lock = threading.Lock()
+
+    #: partition-discovery refresh interval
+    RT_PARTITION_TTL_S = 30.0
 
     def start(self) -> None:
         self.transport.start()
@@ -98,7 +104,10 @@ class ServerRole:
         self.client.watch(lambda _v: self.reconcile())
 
     def stop(self) -> None:
-        for mgr in self._rt_managers.values():
+        with self._reconcile_lock:  # no reconcile mid-shutdown
+            self._stopping = True
+            managers = list(self._rt_managers.values())
+        for mgr in managers:
             mgr.stop()
         self.client.close()
         self.transport.stop()
@@ -111,6 +120,8 @@ class ServerRole:
         ref SegmentOnlineOfflineStateModelFactory.java:44)."""
         from pinot_tpu.segment.loader import load_segment
         with self._reconcile_lock:
+            if self._stopping:
+                return
             try:
                 blob = self.client.get_state()
             except (ConnectionError, OSError, RuntimeError):
@@ -180,9 +191,13 @@ class ServerRole:
                 flush_threshold_time_ms=int(
                     props.get("flushThresholdTimeMs", 6 * 3600 * 1000)))
             physical = cfg.table_name_with_type
-            partitions = self._rt_partitions.get(physical)
-            if partitions is None:
-                # discover once per table, not per watch notification
+            cached = self._rt_partitions.get(physical)
+            if cached is not None and \
+                    time.time() - cached[1] < self.RT_PARTITION_TTL_S:
+                partitions = cached[0]
+            else:
+                # (re)discover: cheap enough per TTL, and added topic
+                # partitions start consuming without a server restart
                 try:
                     meta = get_stream_factory(stream_cfg) \
                         .create_metadata_provider(stream_cfg)
@@ -191,10 +206,12 @@ class ServerRole:
                     if close is not None:
                         close()
                 except Exception:  # noqa: BLE001 — stream not up yet
-                    log.warning("stream metadata unavailable for %s",
-                                physical)
-                    continue
-                self._rt_partitions[physical] = partitions
+                    if cached is None:
+                        log.warning("stream metadata unavailable for %s",
+                                    physical)
+                        continue
+                    partitions = cached[0]
+                self._rt_partitions[physical] = (partitions, time.time())
             store = None
             if blob.get("deep_store_uri"):
                 from pinot_tpu.segment.fs import SegmentDeepStore
@@ -205,18 +222,50 @@ class ServerRole:
                     continue
                 tdm = self.data_manager.table(physical)
                 seg_store = os.path.join(self.download_dir, "rt", physical)
+                # resume AFTER this partition's committed segments: the
+                # persisted end_offset/seq are the replay checkpoint (ref
+                # StreamPartitionMsgOffset in segment ZK metadata)
+                start_offset, start_seq = self._rt_checkpoint(
+                    blob, physical, pid)
                 holder: Dict[str, object] = {}
                 mgr = RealtimeSegmentDataManager(
                     cfg, schema, stream_cfg, pid, tdm, seg_store,
+                    start_offset=start_offset,
                     completion_manager=RemoteCompletionManager(self.client),
                     instance_id=self.instance_id,
                     deep_store=store,
                     on_commit=self._rt_committed(physical, pid, holder),
-                    on_open=self._rt_opened(physical, pid))
+                    on_open=self._rt_opened(physical, pid),
+                    start_seq=start_seq)
                 holder["mgr"] = mgr
                 mgr.start()
                 self._rt_managers[key] = mgr
-                log.info("consuming %s partition %d", physical, pid)
+                log.info("consuming %s partition %d from %s (seq %d)",
+                         physical, pid, start_offset, start_seq)
+
+    @staticmethod
+    def _rt_checkpoint(blob: dict, physical: str, pid: int):
+        """(resume offset, next seq) from the persisted segment states —
+        max committed end_offset and max seen sequence + 1."""
+        from pinot_tpu.ingest.stream import LongMsgOffset
+        best_off = None
+        next_seq = 0
+        for name, st in blob.get("segments", {}).get(physical, {}).items():
+            if st.get("partition_id") != pid:
+                continue
+            parts = name.split("__")
+            if len(parts) >= 3:
+                try:
+                    next_seq = max(next_seq, int(parts[2]) + 1)
+                except ValueError:
+                    pass
+            off = st.get("end_offset")
+            if st.get("status") == "ONLINE" and off is not None:
+                off_i = int(str(off))
+                if best_off is None or off_i > best_off:
+                    best_off = off_i
+        return (LongMsgOffset(best_off) if best_off is not None else None,
+                next_seq)
 
     def _rt_opened(self, physical: str, pid: int):
         def cb(segment_name: str):
@@ -238,7 +287,8 @@ class ServerRole:
                 # only durable (store) locations are worth persisting —
                 # a local build dir dies with this server
                 "dir_path": uri if uri and is_store_uri(uri) else None,
-                "num_docs": 0, "partition_id": pid,
+                "num_docs": getattr(mgr, "last_commit_docs", 0),
+                "partition_id": pid,
                 "end_offset": str(offset), "status": "ONLINE"})
         return cb
 

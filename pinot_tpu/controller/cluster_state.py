@@ -116,6 +116,38 @@ class ClusterState:
         with self._lock:
             return list(self.segments.get(table, {}).values())
 
+    def merge_segment_replica(self, st: SegmentState,
+                              prefer_store_uri: bool = True
+                              ) -> SegmentState:
+        """Merge-register a replica's report of a segment: instances
+        UNION (realtime replicas report the same segment independently),
+        scalar fields update when provided, CONSUMING->ONLINE promotes,
+        and a durable deep-store dir_path is never displaced by a local
+        path (ref IdealState instance-map updates)."""
+        from pinot_tpu.segment.fs import is_store_uri
+        with self._lock:
+            cur = self.segments.setdefault(st.table, {}).get(st.name)
+            if cur is not None:
+                for inst in st.instances:
+                    if inst not in cur.instances:
+                        cur.instances.append(inst)
+                if st.dir_path and not (
+                        prefer_store_uri and cur.dir_path
+                        and is_store_uri(cur.dir_path)
+                        and not is_store_uri(st.dir_path)):
+                    cur.dir_path = st.dir_path
+                if st.end_offset:
+                    cur.end_offset = st.end_offset
+                if st.num_docs:
+                    cur.num_docs = st.num_docs
+                if st.status == "ONLINE" and cur.status != "ONLINE":
+                    cur.status = "ONLINE"  # CONSUMING -> ONLINE seal
+                st = cur
+            self.segments[st.table][st.name] = st
+        self._persist()
+        self._notify(st.table)
+        return st
+
     def set_assignment(self, table: str, assignment: Dict[str, List[str]]) -> None:
         """Bulk update segment->instances (rebalance commit)."""
         with self._lock:

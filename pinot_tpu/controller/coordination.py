@@ -175,35 +175,8 @@ class CoordinationServer:
             self.state.upsert_segment(SegmentState.from_dict(req["segment"]))
             return {"ok": True}
         if op == "add_segment_replica":
-            # merge-register: realtime replicas report the same segment
-            # independently (CONSUMING open / commit), so instances UNION
-            # instead of overwriting (ref IdealState instance-map updates)
-            st = SegmentState.from_dict(req["segment"])
-            with self.state._lock:
-                cur = self.state.segments.setdefault(st.table, {}) \
-                    .get(st.name)
-                if cur is not None:
-                    for inst in st.instances:
-                        if inst not in cur.instances:
-                            cur.instances.append(inst)
-                    if st.dir_path:
-                        # a deep-store URI is durable; never let a KEEP
-                        # replica's local path displace the committer's
-                        from pinot_tpu.segment.fs import is_store_uri
-                        if not (cur.dir_path
-                                and is_store_uri(cur.dir_path)
-                                and not is_store_uri(st.dir_path)):
-                            cur.dir_path = st.dir_path
-                    if st.end_offset:
-                        cur.end_offset = st.end_offset
-                    if st.num_docs:
-                        cur.num_docs = st.num_docs
-                    if st.status != cur.status and st.status == "ONLINE":
-                        cur.status = st.status  # CONSUMING -> ONLINE seal
-                    st = cur
-                self.state.segments[st.table][st.name] = st
-            self.state._persist()
-            self.state._notify(st.table)
+            st = self.state.merge_segment_replica(
+                SegmentState.from_dict(req["segment"]))
             return {"segment": st.to_dict()}
         if op == "remove_segment":
             st = self.state.remove_segment(req["table"], req["name"])

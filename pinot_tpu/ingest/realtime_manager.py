@@ -42,7 +42,8 @@ class RealtimeSegmentDataManager:
                  ingestion_delay_tracker=None,
                  completion_manager=None, instance_id: str = "server_0",
                  deep_store=None,
-                 on_open: Optional[Callable[[str], None]] = None):
+                 on_open: Optional[Callable[[str], None]] = None,
+                 start_seq: int = 0):
         """completion_manager: a controller SegmentCompletionManager for
         multi-replica coordination (exactly one replica commits per
         segment, ref BlockingSegmentCompletionFSM); None = single-replica
@@ -69,6 +70,9 @@ class RealtimeSegmentDataManager:
         #: one is configured, else the local build dir); cluster roles
         #: persist it in SegmentState so restarted servers can recover
         self.last_commit_uri: Optional[str] = None
+        #: row count of the most recently committed segment (cluster roles
+        #: report it in SegmentState so merge bucketing sees real sizes)
+        self.last_commit_docs: int = 0
         self._catchup_target: Optional[int] = None
         self._catchup_deadline = 0.0
         #: a DISCARD rewound current_offset: the in-flight fetched batch
@@ -99,7 +103,10 @@ class RealtimeSegmentDataManager:
                                              stream_config.offset_criteria)
         self.current_offset = start_offset
         self.error_count = 0
-        self._seq = 0
+        #: start_seq: sequence of the next CONSUMING segment — a restarted
+        #: server resumes AFTER its committed segments (ref LLCSegmentName
+        #: sequencing), never replaying seq 0
+        self._seq = start_seq
         #: index/seal mutual exclusion: a commit snapshots + swaps the
         #: mutable segment; rows must not land in it concurrently or they
         #: are lost while the checkpoint advances past them
@@ -301,6 +308,7 @@ class RealtimeSegmentDataManager:
             with self._seal_lock:
                 self.last_commit_uri = resp.download_path
                 immutable = load_segment(path)
+                self.last_commit_docs = immutable.num_docs
                 self.tdm.add_segment(immutable)
                 self.current_offset = LongMsgOffset(resp.offset)
                 self._restart_fetch = True
@@ -359,6 +367,7 @@ class RealtimeSegmentDataManager:
     def _finalize_commit(self, out_dir: str) -> None:
         sealed = self.mutable
         immutable = load_segment(out_dir)
+        self.last_commit_docs = immutable.num_docs
         if self.upsert_manager is not None:
             # transfer validity: the immutable is a row-for-row rebuild of
             # the mutable, so it SHARES the valid bitmap and takes over the

@@ -41,12 +41,13 @@ def _resolve_table(data_manager, table: str):
     return tdm
 
 
-def make_scan_fn(data_manager) -> ScanFn:
-    """Leaf scan over an instance's local segments: filter mask + column
-    materialization per segment, concatenated columnar (the
-    LeafStageTransferableBlockOperator analog over the single-stage
-    segment layer)."""
+def make_scan_fn(data_manager, engine_fn=None) -> ScanFn:
+    """Leaf scan over an instance's local segments: filtered doc ids come
+    from the device top-K/selection kernel when an engine is available
+    (ref QueryRunner.java:258 — ALL leaf stages ride the v1 engine), with
+    numpy fallback per segment; only winning rows materialize."""
     from pinot_tpu.query.filter import SegmentColumnProvider, evaluate_filter
+    from pinot_tpu.segment.loader import ImmutableSegment
 
     def scan(table: str, columns: List[str], filt) -> Block:
         tdm = _resolve_table(data_manager, table)
@@ -54,24 +55,41 @@ def make_scan_fn(data_manager) -> ScanFn:
             return Block(columns, [np.empty(0, object) for _ in columns])
         sdms = tdm.acquire_segments(None)
         try:
+            segs = [s.segment for s in sdms]
+            # device pushdown for stageable immutable segments
+            device_ids: dict = {}
+            engine = engine_fn() if engine_fn is not None else None
+            if engine is not None and filt is not None:
+                candidates = [
+                    s for s in segs
+                    if isinstance(s, ImmutableSegment)
+                    and getattr(s, "valid_doc_ids", None) is None]
+                if candidates:
+                    ids = engine.filtered_doc_ids(candidates, filt)
+                    device_ids = {id(s): ix
+                                  for s, ix in zip(candidates, ids)
+                                  if ix is not None}
             blocks = []
-            for sdm in sdms:
-                seg = sdm.segment
+            for seg in segs:
                 provider = SegmentColumnProvider(seg)
-                mask = evaluate_filter(seg, filt, provider)
-                valid = getattr(seg, "valid_doc_ids", None)
-                if valid is not None:
-                    vmask = valid.to_mask()
-                    if len(vmask) < seg.num_docs:
-                        vmask = np.concatenate(
-                            [vmask, np.zeros(seg.num_docs - len(vmask), bool)])
-                    mask = mask & vmask[:seg.num_docs]
+                idx = device_ids.get(id(seg))
+                if idx is None:
+                    mask = evaluate_filter(seg, filt, provider)
+                    valid = getattr(seg, "valid_doc_ids", None)
+                    if valid is not None:
+                        vmask = valid.to_mask()
+                        if len(vmask) < seg.num_docs:
+                            vmask = np.concatenate(
+                                [vmask,
+                                 np.zeros(seg.num_docs - len(vmask), bool)])
+                        mask = mask & vmask[:seg.num_docs]
+                    idx = np.flatnonzero(mask)
                 arrays = []
                 for c in columns:
                     vals = np.asarray(provider.column(c))
                     if vals.ndim == 0:
                         vals = np.broadcast_to(vals, (seg.num_docs,))
-                    arrays.append(vals[mask])
+                    arrays.append(vals[idx])
                 blocks.append(Block(columns, arrays))
             return Block.concat(blocks) if blocks else \
                 Block(columns, [np.empty(0, object) for _ in columns])

