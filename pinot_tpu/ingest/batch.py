@@ -27,46 +27,69 @@ from pinot_tpu.segment.creator import SegmentCreator
 
 
 def read_records(path: str, fmt: Optional[str] = None) -> Iterator[Dict[str, Any]]:
-    """One file -> record dicts (ref RecordReader plugins)."""
-    fmt = fmt or _infer_format(path)
-    if fmt == "csv":
-        with open(path, newline="") as f:
-            for row in csv.DictReader(f):
-                yield {k: (None if v == "" else v) for k, v in row.items()}
-    elif fmt in ("json", "jsonl", "ndjson"):
-        with open(path) as f:
-            head = f.read(1)
-            f.seek(0)
-            if head == "[":
-                for rec in json.load(f):
-                    yield rec
-            else:
-                for line in f:
-                    line = line.strip()
-                    if line:
-                        yield json.loads(line)
-    elif fmt == "parquet":
-        try:
-            import pyarrow.parquet as pq  # type: ignore
-        except ImportError as e:
-            raise RuntimeError(
-                "parquet input requires the pyarrow wheel (input-format "
-                "plugin not installed)") from e
-        for batch in pq.ParquetFile(path).iter_batches():
-            for rec in batch.to_pylist():
-                yield rec
-    elif fmt == "avro":
-        try:
-            import fastavro  # type: ignore
-        except ImportError as e:
-            raise RuntimeError(
-                "avro input requires the fastavro wheel (input-format "
-                "plugin not installed)") from e
-        with open(path, "rb") as f:
-            for rec in fastavro.reader(f):
-                yield rec
-    else:
-        raise ValueError(f"unsupported input format {fmt!r}")
+    """One file -> record dicts. Readers resolve through the plugin
+    registry (ref RecordExtractor plugins loaded by PluginManager); the
+    built-in formats below register through the same seam."""
+    from pinot_tpu.utils import plugins
+    fmt = (fmt or _infer_format(path)).lower()
+    try:
+        reader = plugins.get("input_format", fmt)
+    except KeyError as e:
+        raise ValueError(f"unsupported input format {fmt!r}: {e}") from e
+    yield from reader(path)
+
+
+def _read_csv(path: str) -> Iterator[Dict[str, Any]]:
+    with open(path, newline="") as f:
+        for row in csv.DictReader(f):
+            yield {k: (None if v == "" else v) for k, v in row.items()}
+
+
+def _read_json(path: str) -> Iterator[Dict[str, Any]]:
+    with open(path) as f:
+        head = f.read(1)
+        f.seek(0)
+        if head == "[":
+            yield from json.load(f)
+        else:
+            for line in f:
+                line = line.strip()
+                if line:
+                    yield json.loads(line)
+
+
+def _read_parquet(path: str) -> Iterator[Dict[str, Any]]:
+    try:
+        import pyarrow.parquet as pq  # type: ignore
+    except ImportError as e:
+        raise RuntimeError(
+            "parquet input requires the pyarrow wheel (input-format "
+            "plugin not installed)") from e
+    for batch in pq.ParquetFile(path).iter_batches():
+        yield from batch.to_pylist()
+
+
+def _read_avro(path: str) -> Iterator[Dict[str, Any]]:
+    try:
+        import fastavro  # type: ignore
+    except ImportError as e:
+        raise RuntimeError(
+            "avro input requires the fastavro wheel (input-format "
+            "plugin not installed)") from e
+    with open(path, "rb") as f:
+        yield from fastavro.reader(f)
+
+
+def _register_builtin_formats() -> None:
+    from pinot_tpu.utils import plugins
+    plugins.register("input_format", "csv", _read_csv)
+    for name in ("json", "jsonl", "ndjson"):
+        plugins.register("input_format", name, _read_json)
+    plugins.register("input_format", "parquet", _read_parquet)
+    plugins.register("input_format", "avro", _read_avro)
+
+
+_register_builtin_formats()
 
 
 def _infer_format(path: str) -> str:

@@ -93,16 +93,16 @@ class StreamConsumerFactory(abc.ABC):
     def create_metadata_provider(self, config: StreamConfig) -> StreamMetadataProvider: ...
 
 
-_FACTORIES: Dict[str, StreamConsumerFactory] = {}
-
-
 def register_stream_factory(stream_type: str, factory: StreamConsumerFactory) -> None:
-    _FACTORIES[stream_type] = factory
+    """Stream consumers register through the central plugin registry
+    (ref StreamConsumerFactoryProvider over PluginManager)."""
+    from pinot_tpu.utils import plugins
+    plugins.register("stream", stream_type, factory)
 
 
 def get_stream_factory(config: StreamConfig) -> StreamConsumerFactory:
-    f = _FACTORIES.get(config.stream_type)
-    if f is None:
-        raise ValueError(f"no stream factory registered for {config.stream_type!r}"
-                         f" (registered: {sorted(_FACTORIES)})")
-    return f
+    from pinot_tpu.utils import plugins
+    try:
+        return plugins.get("stream", config.stream_type)
+    except KeyError as e:
+        raise ValueError(str(e)) from e

@@ -124,10 +124,11 @@ def resolve_predicate(seg: ImmutableSegment, fn: Function) -> Optional[ResolvedP
         pattern = _lit(1)
         if pattern is None:
             return None
-        rx = re.compile(like_to_regex(pattern) if name == "like" else pattern)
-        dict_vals = d.values
-        matcher = np.array([bool(rx.search(str(v))) for v in dict_vals.tolist()])
-        ids = np.nonzero(matcher)[0].astype(np.int32)
+        # FST-index path (ref LuceneFSTIndexReader): anchored literal
+        # prefixes become O(log n) dictId ranges instead of a full
+        # dictionary regex scan; results cache per (dictionary, pattern)
+        regex = like_to_regex(pattern) if name == "like" else pattern
+        ids = d.fst_index.matching_dict_ids(regex)
         if len(ids) == 0:
             return ResolvedPredicate(col, "none")
         # contiguous match ranges collapse to a range predicate

@@ -239,3 +239,15 @@ def clp_enricher(fields: Sequence[str]):
             record[f + "_dictionaryVars"] = dv
             record[f + "_encodedVars"] = ev
     return enrich
+
+
+# register as an index plugin (the IndexPlugin/ServiceLoader seam —
+# segment build and load resolve 'clp_forward' through the registry)
+def _register() -> None:
+    import sys
+
+    from pinot_tpu.utils import plugins
+    plugins.register("index", "clp_forward", sys.modules[__name__])
+
+
+_register()

@@ -99,7 +99,10 @@ class SegmentCreator:
         # CLP log columns: template/variable split instead of plain fwd
         # (ref CLPForwardIndexCreatorV2; SURVEY.md §2.2 y-scope addition)
         if name in idx_cfg.clp_columns:
-            from pinot_tpu.segment import clp
+            # resolved through the plugin registry — the CLP codec is a
+            # shipped plugin, not a hardwired import (ref IndexPlugin)
+            from pinot_tpu.utils import plugins
+            clp = plugins.get_or_load("index", "clp_forward")
             if spec.data_type.stored_type is not DataType.STRING:
                 raise ValueError(f"CLP column {name!r} must be STRING-typed")
             meta.has_dictionary = False

@@ -55,6 +55,16 @@ class Dictionary:
     def values(self) -> np.ndarray:
         return self._values
 
+    @property
+    def fst_index(self):
+        """Lazy FST-style regex/prefix index over the sorted terms (ref
+        LuceneFSTIndexReader; see segment/fst_index.py)."""
+        fst = getattr(self, "_fst", None)
+        if fst is None:
+            from pinot_tpu.segment.fst_index import FstIndex
+            fst = self._fst = FstIndex(self._values)
+        return fst
+
     def index_of(self, value: Any) -> int:
         """DictId of value, or -1 (ref Dictionary.indexOf null handling).
 

@@ -92,23 +92,23 @@ class LocalPinotFS(PinotFS):
         shutil.copyfile(self._path(src_uri), dst_path)
 
 
-_SCHEMES: Dict[str, Callable[[], PinotFS]] = {
-    "file": LocalPinotFS,
-    "": LocalPinotFS,
-}
-
-
 def register_fs(scheme: str, factory: Callable[[], PinotFS]) -> None:
-    """Plugin seam (ref PinotFSFactory.register)."""
-    _SCHEMES[scheme] = factory
+    """Plugin seam (ref PinotFSFactory.register over PluginManager)."""
+    from pinot_tpu.utils import plugins
+    plugins.register("fs", scheme or "file", factory)
 
 
 def get_fs(uri: str) -> PinotFS:
-    scheme = urlparse(uri).scheme
-    factory = _SCHEMES.get(scheme)
-    if factory is None:
+    from pinot_tpu.utils import plugins
+    scheme = urlparse(uri).scheme or "file"
+    try:
+        factory = plugins.get("fs", scheme)
+    except KeyError:
         raise ValueError(f"no PinotFS registered for scheme {scheme!r}")
     return factory()
+
+
+register_fs("file", LocalPinotFS)
 
 
 # ---------------------------------------------------------------------------

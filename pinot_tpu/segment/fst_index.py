@@ -1,0 +1,147 @@
+"""FST-style regex/prefix index over sorted term dictionaries.
+
+Reference parity: pinot-segment-local
+segment/index/readers/LuceneFSTIndexReader.java + the native FST package
+(segment/local/utils/nativefst/ImmutableFST.java) — REGEXP_LIKE / LIKE
+'pre%' on a dictionary column should not regex-scan the whole dictionary
+per query.
+
+Clean-room design: the segment's term dictionary is ALREADY a sorted
+array (the Lucene term-dictionary property), so the index is
+(a) an anchored-literal-prefix decomposition of the pattern,
+(b) O(log n) binary-search candidate ranges over the sorted terms, and
+(c) residual regex verification only inside the candidate range,
+with a per-segment LRU of resolved (pattern -> dictId set) so repeated
+filters cost one lookup. Patterns with no usable anchored prefix fall
+back to a full dictionary scan (Lucene pays an automaton walk there too).
+"""
+from __future__ import annotations
+
+import re
+from collections import OrderedDict
+from typing import Optional, Tuple
+
+import numpy as np
+
+#: regex metacharacters that end a literal prefix
+_META = set(".^$*+?{}[]()|\\")
+
+
+def literal_prefix(pattern: str) -> Tuple[Optional[str], bool]:
+    """(anchored literal prefix, whole_pattern_is_prefix) of a regex.
+
+    Returns (None, False) when the pattern is not start-anchored (a
+    'search' semantics match can begin anywhere, so no range narrowing is
+    sound). whole=True means the pattern is exactly '^literal.*'-shaped
+    ('pre%' LIKE translations), so candidates need NO regex verification.
+    """
+    if not pattern.startswith("^"):
+        return None, False
+    if _has_toplevel_alternation(pattern):
+        # '^ab|cd' anchors only the FIRST branch — no sound range exists
+        return None, False
+    i, n = 1, len(pattern)
+    out = []
+    while i < n:
+        c = pattern[i]
+        if c == "\\" and i + 1 < n and pattern[i + 1] in _META:
+            out.append(pattern[i + 1])
+            i += 2
+            continue
+        if c in _META:
+            break
+        out.append(c)
+        i += 1
+    # a quantifier that can match ZERO occurrences ('*', '?', '{0,..}')
+    # makes the last collected literal optional — drop it from the prefix
+    # ('^abc*' matches 'ab')
+    if i < n and pattern[i] in "*?{" and out:
+        out.pop()
+    prefix = "".join(out)
+    if not prefix:
+        return None, False
+    rest = pattern[i:]
+    # '$' alone is exact-match, NOT prefix-match ('^abc$' must not accept
+    # 'abcd'), so it still verifies candidates with the regex
+    whole = rest in ("", ".*", ".*$")
+    return prefix, whole
+
+
+def _has_toplevel_alternation(pattern: str) -> bool:
+    depth = 0
+    i, n = 0, len(pattern)
+    while i < n:
+        c = pattern[i]
+        if c == "\\":
+            i += 2
+            continue
+        if c == "[":  # character class: skip to its closing bracket
+            i += 1
+            if i < n and pattern[i] == "]":
+                i += 1
+            while i < n and pattern[i] != "]":
+                i += 2 if pattern[i] == "\\" else 1
+        elif c == "(":
+            depth += 1
+        elif c == ")":
+            depth = max(0, depth - 1)
+        elif c == "|" and depth == 0:
+            return True
+        i += 1
+    return False
+
+
+def prefix_range(sorted_terms: np.ndarray, prefix: str) -> Tuple[int, int]:
+    """[lo, hi) dictId range of terms starting with `prefix` — two binary
+    searches over the sorted dictionary (the FST arc-walk analog)."""
+    lo = int(np.searchsorted(sorted_terms, prefix, side="left"))
+    hi = int(np.searchsorted(sorted_terms, prefix + "\U0010FFFF",
+                             side="right"))
+    return lo, hi
+
+
+class FstIndex:
+    """Per-column regex resolver over the sorted dictionary terms."""
+
+    CACHE_SIZE = 128
+
+    def __init__(self, sorted_terms: np.ndarray):
+        #: term dictionary, value-sorted (the segment dictionary invariant)
+        self.terms = np.asarray(sorted_terms)
+        self._cache: "OrderedDict[str, np.ndarray]" = OrderedDict()
+
+    def matching_dict_ids(self, pattern: str) -> np.ndarray:
+        """Sorted int32 dictIds whose term matches the (search-semantics)
+        regex pattern."""
+        hit = self._cache.get(pattern)
+        if hit is not None:
+            self._cache.move_to_end(pattern)
+            return hit
+        ids = self._resolve(pattern)
+        self._cache[pattern] = ids
+        if len(self._cache) > self.CACHE_SIZE:
+            self._cache.popitem(last=False)
+        return ids
+
+    def _resolve(self, pattern: str) -> np.ndarray:
+        prefix, whole = literal_prefix(pattern)
+        if self.terms.dtype.kind not in "OSU" or (
+                len(self.terms) and
+                not isinstance(self.terms[0], (str, np.str_))):
+            prefix = None  # numeric/bytes dictionary: no str prefix order
+        if prefix is not None:
+            lo, hi = prefix_range(self.terms, prefix)
+            if lo >= hi:
+                return np.empty(0, np.int32)
+            if whole:
+                return np.arange(lo, hi, dtype=np.int32)
+            rx = re.compile(pattern)
+            keep = [i for i in range(lo, hi)
+                    if rx.search(str(self.terms[i]))]
+            return np.asarray(keep, np.int32)
+        # no sound range: full scan (documented fallback)
+        rx = re.compile(pattern)
+        mask = np.fromiter((bool(rx.search(str(v)))
+                            for v in self.terms.tolist()),
+                           dtype=bool, count=len(self.terms))
+        return np.nonzero(mask)[0].astype(np.int32)

@@ -76,9 +76,9 @@ class DataSource:
     def clp_reader(self):
         """CLP log column sub-reader (ref DataSource CLP getter)."""
         if getattr(self, "_clp", None) is None and self._has(it.CLP):
-            from pinot_tpu.segment.clp import (CLPForwardIndexReader,
-                                               unpack_compressed)
-            self._clp = CLPForwardIndexReader(unpack_compressed(
+            from pinot_tpu.utils import plugins
+            clp = plugins.get_or_load("index", "clp_forward")
+            self._clp = clp.CLPForwardIndexReader(clp.unpack_compressed(
                 self._seg.dir.get_buffer(self.metadata.name, it.CLP)))
         return getattr(self, "_clp", None)
 

