@@ -495,12 +495,98 @@ def window_block(block: Block, partition: Sequence[Expression],
             arg_cache[e] = got
         return got
 
+    def frame_bounds(over: Function):
+        """Explicit ROWS BETWEEN frame -> (fstart, fend, empty) arrays,
+        or None for the default frame (ref operator/window/ frame
+        handling: RowBasedWindowFrame)."""
+        if len(over.args) < 4:
+            return None
+        fr = over.args[3]
+        assert isinstance(fr, Function) and fr.name == "__frame"
+        lo = fr.args[1].value  # type: ignore[union-attr]
+        hi = fr.args[2].value  # type: ignore[union-attr]
+        if lo == "uf" or hi == "up":
+            raise ValueError("invalid ROWS frame bounds")
+        if lo != "up" and hi != "uf" and int(lo) > int(hi):
+            raise ValueError(
+                f"ROWS frame start after end ({lo} > {hi})")
+        fstart = part_start if lo == "up" else \
+            np.clip(pos + int(lo), part_start, part_end)
+        fend = part_end if hi == "uf" else \
+            np.clip(pos + int(hi), part_start, part_end)
+        # truly-empty frames (entirely before/after the partition)
+        empty = np.zeros(n, bool)
+        if lo not in ("up",) and hi not in ("uf",):
+            empty |= (pos + int(hi) < part_start) | \
+                (pos + int(lo) > part_end)
+        elif hi not in ("uf",):
+            empty |= pos + int(hi) < part_start
+        elif lo not in ("up",):
+            empty |= pos + int(lo) > part_end
+        return fstart, fend, empty, lo, hi
+
+    def framed_agg(name, inner, bounds):
+        fstart, fend, empty, lo, hi = bounds
+        if name == "count":
+            res = (fend - fstart + 1).astype(np.float64)
+            res[empty] = 0
+            return res.astype(np.int64)
+        v = sorted_arg(inner.args[0])
+        if name in ("first_value", "last_value"):
+            res = np.empty(n, object)
+            src = fstart if name == "first_value" else fend
+            res[~empty] = v[src[~empty]]
+            res[empty] = None
+            return res
+        v = v.astype(np.float64, copy=False)
+        if name in ("sum", "avg"):
+            cum = np.cumsum(v)
+            total = cum[fend] - cum[fstart] + v[fstart]
+            if name == "avg":
+                total = total / np.maximum(fend - fstart + 1, 1)
+            out = np.empty(n, object)
+            out[~empty] = total[~empty]
+            out[empty] = None
+            return out
+        assert name in ("min", "max")
+        op = np.minimum if name == "min" else np.maximum
+        if lo == "up":
+            sc = _segmented_scan(v, part_start, op)
+            res = sc[fend]
+        elif hi == "uf":
+            # backward scan: reverse, scan with reversed partition marks
+            rv = v[::-1]
+            rstart = (n - 1) - part_end[::-1]
+            sc = _segmented_scan(rv, rstart, op)
+            res = sc[::-1][fstart]
+        else:
+            width = int(hi) - int(lo)
+            if width > 65536:
+                raise ValueError("ROWS frame too wide")
+            ident = np.inf if name == "min" else -np.inf
+            res = np.full(n, ident)
+            for d in range(int(lo), int(hi) + 1):
+                src = pos + d
+                ok = (src >= part_start) & (src <= part_end)
+                shifted = v[np.clip(src, 0, n - 1)]
+                res = np.where(ok, op(res, shifted), res)
+        out = np.empty(n, object)
+        out[~empty] = res[~empty]
+        out[empty] = None
+        return out
+
+    FRAMEABLE = ("sum", "count", "avg", "min", "max",
+                 "first_value", "last_value")
+
     out_cols: List[np.ndarray] = []
     for over in over_nodes:
         inner = over.args[0]
         assert isinstance(inner, Function)
         name = inner.name
-        if name == "row_number":
+        bounds = frame_bounds(over)
+        if bounds is not None and name in FRAMEABLE:
+            res = framed_agg(name, inner, bounds)
+        elif name == "row_number":
             res = (pos - part_start + 1).astype(np.int64)
         elif name == "rank":
             peer_first = np.maximum.accumulate(np.where(peer_mark, pos, 0))

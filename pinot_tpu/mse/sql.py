@@ -253,15 +253,19 @@ class _MseParser(_Parser):
 
     def _call(self, name: str) -> Expression:
         """Extend the base call grammar with the window suffix:
-        fn(args) OVER (PARTITION BY e,... ORDER BY e [ASC|DESC],...).
-        Encoded as over(fn, __partition(p...), __orderby(asc(k)|desc(k)...))
-        so the node stays a plain hashable expression tree."""
-        from pinot_tpu.query.expressions import func
+        fn(args) OVER (PARTITION BY e,... ORDER BY e [ASC|DESC],...
+        [ROWS BETWEEN <bound> AND <bound>]).
+        Encoded as over(fn, __partition(p...), __orderby(asc(k)|desc(k)...)
+        [, __frame('rows', lo, hi)]) so the node stays a plain hashable
+        expression tree; bounds are ints (rows preceding = negative,
+        following = positive) or the strings 'up'/'uf' for unbounded."""
+        from pinot_tpu.query.expressions import Literal, func
         e = super()._call(name)
         if self.accept_kw("OVER"):
             self.expect_op("(")
             parts: List[Expression] = []
             okeys: List[Expression] = []
+            frame = None
             if self.accept_kw("PARTITION"):
                 self.expect_kw("BY")
                 parts = self._expr_list()
@@ -269,10 +273,43 @@ class _MseParser(_Parser):
                 self.expect_kw("BY")
                 for k, asc in self._order_list():
                     okeys.append(func("asc" if asc else "desc", k))
+            if self.accept_kw("ROWS"):
+                self.expect_kw("BETWEEN")
+                lo = self._frame_bound()
+                self.expect_kw("AND")
+                hi = self._frame_bound()
+                frame = func("__frame", Literal("rows"), Literal(lo),
+                             Literal(hi))
             self.expect_op(")")
-            e = func("over", e, func("__partition", *parts),
-                     func("__orderby", *okeys))
+            args = [e, func("__partition", *parts),
+                    func("__orderby", *okeys)]
+            if frame is not None:
+                args.append(frame)
+            e = func("over", *args)
         return e
+
+    def _frame_bound(self):
+        """UNBOUNDED PRECEDING|FOLLOWING / CURRENT ROW / <n> PRECEDING /
+        <n> FOLLOWING -> 'up' | 'uf' | 0 | -n | +n"""
+        if self.accept_kw("UNBOUNDED"):
+            if self.accept_kw("PRECEDING"):
+                return "up"
+            self.expect_kw("FOLLOWING")
+            return "uf"
+        if self.accept_kw("CURRENT"):
+            self.expect_kw("ROW")
+            return 0
+        t = self.next()
+        try:
+            n = int(t.text)
+        except ValueError:
+            from pinot_tpu.query.parser import SqlParseError
+            raise SqlParseError(
+                f"expected frame bound at {t.pos}, got {t.text!r}")
+        if self.accept_kw("PRECEDING"):
+            return -n
+        self.expect_kw("FOLLOWING")
+        return n
 
     def _from_item(self) -> FromItem:
         if self.accept_op("("):

@@ -579,3 +579,135 @@ class DistinctCountMVAggregation(_MVMixin, AggregationFunction):
     def final_dtype(self):
         return "INT"
 
+
+
+@register
+class DistinctCountTupleAggregation(DistinctCountThetaAggregation):
+    """Tuple-sketch distinct count rides the same KMV machinery (ref
+    DistinctCountTupleSketchAggregationFunction — the tuple sketch is a
+    theta sketch with per-key summaries; distinct counting only needs the
+    key set)."""
+    names = ("distinctcounttuplesketch", "distinctcountrawintegersumtuplesketch")
+
+
+# ---------------------------------------------------------------------------
+# funnel + collection aggregations
+# ---------------------------------------------------------------------------
+
+@register
+class FunnelCountAggregation(AggregationFunction):
+    """funnelcount(correlate_col, step1_cond, step2_cond, ...) — per-step
+    counts of correlation ids that satisfied ALL steps up to k
+    (ref FunnelCountAggregationFunction's set-intersection strategy; the
+    ordered/window variants are the reference's non-default modes).
+
+    Intermediate: list of per-step id SETS (prefix-intersection deferred
+    to extract so merges stay unions)."""
+    names = ("funnelcount", "funnel_count")
+    multi_arg = True
+
+    def aggregate(self, values, mask):
+        corr = values[0]
+        steps = values[1:]
+        out = []
+        for s in steps:
+            m = mask & (np.asarray(s).astype(bool))
+            out.append({_scalar(v) for v in corr[m]})
+        return out
+
+    def aggregate_grouped(self, values, keys, num_groups, mask):
+        out = [self.identity() for _ in range(num_groups)]
+        corr = values[0]
+        for si, s in enumerate(values[1:]):
+            m = mask & (np.asarray(s).astype(bool))
+            k = keys[m]
+            c = corr[m]
+            for g, v in zip(k, c):
+                while len(out[g]) <= si:
+                    out[g].append(set())
+                out[g][si].add(_scalar(v))
+        return out
+
+    def merge(self, a, b):
+        n = max(len(a), len(b))
+        out = []
+        for i in range(n):
+            sa = a[i] if i < len(a) else set()
+            sb = b[i] if i < len(b) else set()
+            out.append(sa | sb)
+        return out
+
+    def identity(self):
+        return [set() for _ in self.args[1:]]
+
+    def extract_final(self, inter):
+        counts = []
+        reached = None
+        for s in inter:
+            reached = set(s) if reached is None else (reached & s)
+            counts.append(len(reached))
+        return counts
+
+    @property
+    def final_dtype(self):
+        return "LONG_ARRAY"
+
+
+@register
+class FunnelCompleteCountAggregation(FunnelCountAggregation):
+    """Count of ids completing EVERY step (ref
+    FunnelCompleteCountAggregationFunction)."""
+    names = ("funnelcompletecount",)
+
+    def extract_final(self, inter):
+        counts = super().extract_final(inter)
+        return counts[-1] if counts else 0
+
+    @property
+    def final_dtype(self):
+        return "LONG"
+
+
+@register
+class ArrayAggAggregation(AggregationFunction):
+    """arrayagg(col[, 'dataType'][, distinct]) — collect values (ref
+    ArrayAggFunction family)."""
+    names = ("arrayagg", "array_agg", "listagg")
+
+    def _distinct(self) -> bool:
+        from pinot_tpu.query.expressions import Literal
+        return any(isinstance(a, Literal) and str(a.value).lower() == "true"
+                   for a in self.args[1:])
+
+    def aggregate(self, values, mask):
+        return [_scalar(v) for v in values[mask]]
+
+    def aggregate_grouped(self, values, keys, num_groups, mask):
+        k = keys[mask]
+        v = values[mask]
+        order = np.argsort(k, kind="stable")
+        k, v = k[order], v[order]
+        bounds = np.searchsorted(k, np.arange(num_groups + 1))
+        return [[_scalar(x) for x in v[bounds[g]:bounds[g + 1]]]
+                for g in range(num_groups)]
+
+    def merge(self, a, b):
+        return a + b
+
+    def identity(self):
+        return []
+
+    def extract_final(self, inter):
+        if self._distinct():
+            seen = []
+            have = set()
+            for v in inter:
+                if v not in have:
+                    have.add(v)
+                    seen.append(v)
+            return seen
+        return inter
+
+    @property
+    def final_dtype(self):
+        return "ARRAY"

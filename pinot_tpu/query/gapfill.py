@@ -1,0 +1,79 @@
+"""Gapfill: fill missing time buckets in group-by results.
+
+Reference parity: pinot-core query/reduce/ gapfill processors
+(GapfillProcessor.java + BaseGapfillProcessor — the GAPFILL table
+function fills absent time buckets per key combination with
+FILL_DEFAULT_VALUE / FILL_PREVIOUS_VALUE).
+
+Activation here is option-driven (per-query SET options, the same
+mechanism the reference uses for engine selection):
+
+    SET gapfillTimeCol = ts_bucket;   -- a GROUP BY column in the select
+    SET gapfillStart = 0;             -- first bucket (inclusive)
+    SET gapfillEnd = 100;             -- end (exclusive)
+    SET gapfillStep = 10;             -- bucket width
+    SET gapfillMode = PREVIOUS;       -- PREVIOUS | ZERO | NULL
+
+Missing buckets are inserted per combination of the remaining group-by
+columns; aggregate columns fill with the previous bucket's value (or
+0/NULL per mode), matching FILL_PREVIOUS_VALUE semantics.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+
+def maybe_gapfill(ctx, table):
+    """Apply gapfill when the query options ask for it; returns the
+    (possibly new) ResultTable."""
+    opts = ctx.options
+    col = opts.get("gapfillTimeCol")
+    if not col or table is None:
+        return table
+    try:
+        start = int(opts["gapfillStart"])
+        end = int(opts["gapfillEnd"])
+        step = int(opts["gapfillStep"])
+    except (KeyError, ValueError):
+        return table
+    if step <= 0 or col not in table.columns:
+        return table
+    mode = opts.get("gapfillMode", "PREVIOUS").upper()
+    tcol = table.columns.index(col)
+    # key columns = the other GROUP BY output columns
+    group_names = {str(g) for g in ctx.group_by}
+    key_idx = [i for i, c in enumerate(table.columns)
+               if c != col and (c in group_names or str(c) in group_names)]
+    fill_idx = [i for i in range(len(table.columns))
+                if i != tcol and i not in key_idx]
+
+    by_key: Dict[Tuple, Dict[int, tuple]] = {}
+    for row in table.rows:
+        key = tuple(row[i] for i in key_idx)
+        by_key.setdefault(key, {})[int(row[tcol])] = row
+
+    out: List[tuple] = []
+    for key, buckets in by_key.items():
+        prev: Optional[tuple] = None
+        # emit ALL real buckets (even off-grid / out of [start, end)) plus
+        # the missing grid buckets — gapfill inserts, never drops data
+        times = sorted(set(buckets) | set(range(start, end, step)))
+        for t in times:
+            row = buckets.get(t)
+            if row is None:
+                filled = [None] * len(table.columns)
+                filled[tcol] = t
+                for pos_k, i in enumerate(key_idx):
+                    filled[i] = key[pos_k]
+                for i in fill_idx:
+                    if mode == "PREVIOUS" and prev is not None:
+                        filled[i] = prev[i]
+                    elif mode == "ZERO":
+                        filled[i] = 0
+                    else:
+                        filled[i] = None
+                row = tuple(filled)
+            out.append(row)
+            prev = row
+    from pinot_tpu.query.reduce import ResultTable
+    return ResultTable(table.columns, table.column_types, out)

@@ -192,11 +192,36 @@ def _reduce_group_by(ctx: QueryContext, results: List[GroupByResult],
         sort_key = tuple(eval_scalar(e, bindings) for e, _ in ctx.order_by)
         rows.append((sort_key, out_row))
 
+    names = ctx.result_column_names()
+    types = [_result_type(e, ctx) for e in ctx.select]
+    if "gapfillTimeCol" in ctx.options:
+        # fill BEFORE sort/limit so ordering + limit apply to the filled
+        # series (ref GapfillProcessor running inside the reducer)
+        from pinot_tpu.query.gapfill import maybe_gapfill
+        filled = maybe_gapfill(
+            ctx, ResultTable(names, types, [r for _, r in rows]))
+        if ctx.order_by:
+            # re-derive sort keys positionally for filled rows: only
+            # select-column references are supported post-fill
+            keyed = []
+            for row in filled.rows:
+                bindings = {Identifier(n): v
+                            for n, v in zip(names, row)}
+                for e, v in zip(ctx.select, row):
+                    bindings[e] = v
+                keyed.append((tuple(
+                    eval_scalar(e, bindings) for e, _ in ctx.order_by),
+                    row))
+            keyed = _sorted_by_keys(keyed,
+                                    [asc for _, asc in ctx.order_by])
+            filled_rows = [r for _, r in keyed]
+        else:
+            filled_rows = list(filled.rows)
+        out = filled_rows[ctx.offset:ctx.offset + ctx.limit]
+        return ResultTable(names, types, out)
     if ctx.order_by:
         rows = _sorted_by_keys(rows, [asc for _, asc in ctx.order_by])
     out = [r for _, r in rows][ctx.offset:ctx.offset + ctx.limit]
-    names = ctx.result_column_names()
-    types = [_result_type(e, ctx) for e in ctx.select]
     return ResultTable(names, types, out)
 
 

@@ -110,6 +110,8 @@ def load_plugin_dir(plugins_dir: str) -> List[str]:
             spec.loader.exec_module(mod)
             loaded.append(mod_name)
         except Exception:  # noqa: BLE001
+            # a half-initialized module must not stay importable
+            sys.modules.pop(mod_name, None)
             log.exception("failed to load plugin %s", path)
     return loaded
 

@@ -1,0 +1,156 @@
+"""Funnel, arrayagg, tuple-sketch, and gapfill aggregation families.
+
+Ref: pinot-core query/aggregation/function/FunnelCountAggregationFunction,
+ArrayAggFunction, DistinctCountTupleSketchAggregationFunction;
+query/reduce/ GapfillProcessor — VERDICT r4 missing #9 / task 10.
+"""
+import numpy as np
+import pytest
+
+from pinot_tpu.models import (DataType, FieldSpec, FieldType, Schema,
+                              TableConfig)
+from pinot_tpu.query.executor import QueryExecutor
+from pinot_tpu.segment.creator import SegmentCreator
+from pinot_tpu.segment.loader import load_segment
+
+
+@pytest.fixture(scope="module")
+def events_seg(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("funnel")
+    schema = Schema("ev", [
+        FieldSpec("user_id", DataType.INT, FieldType.DIMENSION),
+        FieldSpec("action", DataType.STRING, FieldType.DIMENSION),
+        FieldSpec("ts", DataType.INT, FieldType.DIMENSION),
+    ])
+    tc = TableConfig(name="ev")
+    # users 0-9 view; 0-5 cart; 0-2 buy; user 11 carts WITHOUT viewing
+    rows = []
+    for u in range(10):
+        rows.append((u, "view", u))
+    for u in range(6):
+        rows.append((u, "cart", 100 + u))
+    for u in range(3):
+        rows.append((u, "buy", 200 + u))
+    rows.append((11, "cart", 300))
+    cols = {"user_id": np.array([r[0] for r in rows]),
+            "action": np.array([r[1] for r in rows], object),
+            "ts": np.array([r[2] for r in rows])}
+    out = str(tmp / "s0")
+    SegmentCreator(tc, schema).build(cols, out, "s0")
+    return load_segment(out)
+
+
+class TestFunnel:
+    def test_funnelcount(self, events_seg):
+        ex = QueryExecutor([events_seg], use_tpu=False)
+        r = ex.execute(
+            "SELECT FUNNELCOUNT(user_id, action = 'view', "
+            "action = 'cart', action = 'buy') FROM ev")
+        assert r.rows[0][0] == [10, 6, 3]
+
+    def test_funnel_requires_earlier_steps(self, events_seg):
+        # user 11 carted without viewing: step-2 count excludes them
+        ex = QueryExecutor([events_seg], use_tpu=False)
+        r = ex.execute(
+            "SELECT FUNNELCOUNT(user_id, action = 'view', "
+            "action = 'cart') FROM ev")
+        assert r.rows[0][0] == [10, 6]
+
+    def test_funnelcompletecount(self, events_seg):
+        ex = QueryExecutor([events_seg], use_tpu=False)
+        r = ex.execute(
+            "SELECT FUNNELCOMPLETECOUNT(user_id, action = 'view', "
+            "action = 'cart', action = 'buy') FROM ev")
+        assert r.rows[0][0] == 3
+
+    def test_funnel_multi_segment_merge(self, events_seg, tmp_path):
+        # second segment: user 6 completes cart+buy (viewed in seg 1)
+        schema = Schema("ev", [
+            FieldSpec("user_id", DataType.INT, FieldType.DIMENSION),
+            FieldSpec("action", DataType.STRING, FieldType.DIMENSION),
+            FieldSpec("ts", DataType.INT, FieldType.DIMENSION)])
+        tc = TableConfig(name="ev")
+        cols = {"user_id": np.array([6, 6]),
+                "action": np.array(["cart", "buy"], object),
+                "ts": np.array([400, 401])}
+        out = str(tmp_path / "s1")
+        SegmentCreator(tc, schema).build(cols, out, "s1")
+        seg2 = load_segment(out)
+        ex = QueryExecutor([events_seg, seg2], use_tpu=False)
+        r = ex.execute(
+            "SELECT FUNNELCOUNT(user_id, action = 'view', "
+            "action = 'cart', action = 'buy') FROM ev")
+        assert r.rows[0][0] == [10, 7, 4]
+
+
+class TestArrayAgg:
+    def test_arrayagg_grouped(self, events_seg):
+        ex = QueryExecutor([events_seg], use_tpu=False)
+        r = ex.execute(
+            "SELECT action, ARRAYAGG(user_id) FROM ev "
+            "GROUP BY action ORDER BY action")
+        got = {row[0]: sorted(row[1]) for row in r.rows}
+        assert got["buy"] == [0, 1, 2]
+        assert got["cart"] == [0, 1, 2, 3, 4, 5, 11]
+
+    def test_tuple_sketch_alias(self, events_seg):
+        ex = QueryExecutor([events_seg], use_tpu=False)
+        r = ex.execute(
+            "SELECT DISTINCTCOUNTTUPLESKETCH(user_id) FROM ev")
+        assert r.rows[0][0] == 11
+
+
+class TestGapfill:
+    def test_gapfill_previous_and_zero(self, tmp_path):
+        schema = Schema("m", [
+            FieldSpec("bucket", DataType.INT, FieldType.DIMENSION),
+            FieldSpec("host", DataType.STRING, FieldType.DIMENSION),
+            FieldSpec("v", DataType.INT, FieldType.METRIC)])
+        tc = TableConfig(name="m")
+        # host a has buckets 0, 20; host b has 10 only
+        cols = {"bucket": np.array([0, 20, 10]),
+                "host": np.array(["a", "a", "b"], object),
+                "v": np.array([5, 7, 9])}
+        out = str(tmp_path / "s0")
+        SegmentCreator(tc, schema).build(cols, out, "s0")
+        seg = load_segment(out)
+        ex = QueryExecutor([seg], use_tpu=False)
+        sql = ("SET gapfillTimeCol = bucket; SET gapfillStart = 0; "
+               "SET gapfillEnd = 30; SET gapfillStep = 10; "
+               "SET gapfillMode = PREVIOUS; "
+               "SELECT bucket, host, SUM(v) FROM m "
+               "GROUP BY bucket, host LIMIT 100")
+        r = ex.execute(sql)
+        rows = {(row[1], row[0]): row[2] for row in r.rows}
+        assert rows[("a", 0)] == 5.0
+        assert rows[("a", 10)] == 5.0   # filled with previous
+        assert rows[("a", 20)] == 7.0
+        assert rows[("b", 10)] == 9.0
+        assert rows[("b", 0)] is None   # no previous yet
+        assert rows[("b", 20)] == 9.0
+        assert len(r.rows) == 6
+
+
+class TestGapfillEdges:
+    def test_off_grid_rows_kept(self, tmp_path):
+        schema = Schema("m2", [
+            FieldSpec("bucket", DataType.INT, FieldType.DIMENSION),
+            FieldSpec("v", DataType.INT, FieldType.METRIC)])
+        tc = TableConfig(name="m2")
+        cols = {"bucket": np.array([5, 35]), "v": np.array([1, 2])}
+        out = str(tmp_path / "s0")
+        SegmentCreator(tc, schema).build(cols, out, "s0")
+        seg = load_segment(out)
+        ex = QueryExecutor([seg], use_tpu=False)
+        sql = ("SET gapfillTimeCol = bucket; SET gapfillStart = 0; "
+               "SET gapfillEnd = 30; SET gapfillStep = 10; "
+               "SET gapfillMode = ZERO; "
+               "SELECT bucket, SUM(v) FROM m2 GROUP BY bucket "
+               "ORDER BY bucket LIMIT 100")
+        r = ex.execute(sql)
+        got = {row[0]: row[1] for row in r.rows}
+        # real off-grid rows survive; grid gaps filled with 0
+        assert got[5] == 1.0 and got[35] == 2.0
+        assert got[0] == 0 and got[10] == 0 and got[20] == 0
+        # ordered by bucket including filled rows
+        assert [row[0] for row in r.rows] == sorted(got)
