@@ -58,6 +58,8 @@ class IndexingConfig:
     text_index_columns: List[str] = field(default_factory=list)
     fst_index_columns: List[str] = field(default_factory=list)
     vector_index_columns: List[str] = field(default_factory=list)
+    geo_index_columns: List[str] = field(default_factory=list)
+    map_index_columns: List[str] = field(default_factory=list)
     clp_columns: List[str] = field(default_factory=list)
     star_tree_configs: List[StarTreeIndexConfig] = field(default_factory=list)
     # Chunk compression for raw (no-dictionary) columns.
@@ -77,6 +79,8 @@ class IndexingConfig:
             "textIndexColumns": self.text_index_columns,
             "fstIndexColumns": self.fst_index_columns,
             "vectorIndexColumns": self.vector_index_columns,
+            "geoIndexColumns": self.geo_index_columns,
+            "mapIndexColumns": self.map_index_columns,
             "clpColumns": self.clp_columns,
             "starTreeIndexConfigs": [c.to_dict() for c in self.star_tree_configs],
             "compression": self.compression,
@@ -97,6 +101,8 @@ class IndexingConfig:
             text_index_columns=d.get("textIndexColumns", []),
             fst_index_columns=d.get("fstIndexColumns", []),
             vector_index_columns=d.get("vectorIndexColumns", []),
+            geo_index_columns=d.get("geoIndexColumns", []),
+            map_index_columns=d.get("mapIndexColumns", []),
             clp_columns=d.get("clpColumns", []),
             star_tree_configs=[StarTreeIndexConfig.from_dict(c)
                                for c in d.get("starTreeIndexConfigs", [])],

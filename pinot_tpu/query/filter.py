@@ -242,6 +242,10 @@ def evaluate_filter(seg: ImmutableSegment, expr: Optional[Expression],
             return _json_match_mask(seg, expr)
         if expr.name == "text_match":
             return _text_match_mask(seg, expr)
+        if expr.name == "vector_similarity":
+            return _vector_similarity_mask(seg, expr)
+        if expr.name in ("st_within_distance", "geo_within_distance"):
+            return _geo_distance_mask(seg, expr)
         pred = resolve_predicate(seg, expr)
         if pred is not None:
             return predicate_mask(seg, pred)
@@ -298,6 +302,50 @@ def parse_filter_string(s: str) -> Expression:
     return e
 
 
+def _vector_similarity_mask(seg: ImmutableSegment, fn: Function) -> np.ndarray:
+    """vector_similarity(col, 'json query vector', topK) — the K nearest
+    docs by the index's metric (ref VectorSimilarityFilterOperator over
+    the HNSW reader; here exact/IVF matmul search,
+    segment/vector_index.py)."""
+    import json as _json
+    col = fn.args[0]
+    assert isinstance(col, Identifier), "vector_similarity needs a column"
+    q = fn.args[1]
+    assert isinstance(q, Literal), "vector_similarity needs a query vector"
+    k = int(fn.args[2].value) if len(fn.args) > 2 \
+        and isinstance(fn.args[2], Literal) else 10
+    ds = seg.data_source(col.name)
+    index = getattr(ds, "vector_index", None)
+    if index is None:
+        raise ValueError(f"no vector index on column {col.name!r}")
+    ids = index.top_k(np.asarray(_json.loads(str(q.value)), np.float32), k)
+    mask = np.zeros(seg.num_docs, dtype=bool)
+    mask[ids] = True
+    return mask
+
+
+def _geo_distance_mask(seg: ImmutableSegment, fn: Function) -> np.ndarray:
+    """st_within_distance(col, lat, lng, meters) — grid-cell candidates +
+    exact haversine (ref H3IndexFilterOperator / ST_DISTANCE < r
+    rewrite); falls back to a full haversine scan without an index."""
+    col = fn.args[0]
+    assert isinstance(col, Identifier), "st_within_distance needs a column"
+    lat = float(fn.args[1].value)   # type: ignore[union-attr]
+    lng = float(fn.args[2].value)   # type: ignore[union-attr]
+    meters = float(fn.args[3].value)  # type: ignore[union-attr]
+    ds = seg.data_source(col.name)
+    index = getattr(ds, "geo_index", None)
+    mask = np.zeros(seg.num_docs, dtype=bool)
+    if index is not None:
+        mask[index.within_distance(lat, lng, meters)] = True
+        return mask
+    from pinot_tpu.segment.geo_index import haversine_m, parse_point
+    pts = [parse_point(v) for v in ds.values()]
+    d = haversine_m(np.asarray([p[0] for p in pts]),
+                    np.asarray([p[1] for p in pts]), lat, lng)
+    return d <= meters  # NaN distances compare False: bad rows never match
+
+
 def _json_match_mask(seg: ImmutableSegment, fn: Function) -> np.ndarray:
     """json_match(col, 'predicate over "$.paths"') — index-backed when the
     column carries a JSON index (ref JsonMatchFilterOperator +
@@ -348,6 +396,13 @@ class SegmentColumnProvider:
 
     def column(self, name: str) -> np.ndarray:
         return self._seg.data_source(name).values()
+
+    def data_source(self, name: str):
+        """Index-aware access for transforms (map_value's dense keys)."""
+        try:
+            return self._seg.data_source(name)
+        except (KeyError, ValueError):
+            return None
 
     def mv_lists(self, name: str):
         """Multi-value column as per-doc lists (for MV-aware transforms)."""

@@ -38,10 +38,19 @@ def maybe_gapfill(ctx, table):
         return table
     if step <= 0 or col not in table.columns:
         return table
+    #: guard against grid bombs (SET gapfillStep=1 over a huge range):
+    #: more buckets than this skips the fill rather than OOMing the broker
+    if (end - start) // step > 100_000:
+        return table
     mode = opts.get("gapfillMode", "PREVIOUS").upper()
     tcol = table.columns.index(col)
-    # key columns = the other GROUP BY output columns
+    # key columns = the other GROUP BY output columns; if a GROUP BY
+    # column is NOT selected, distinct groups would collapse onto one
+    # (key, time) slot and silently drop rows — bail instead
     group_names = {str(g) for g in ctx.group_by}
+    selected = set(table.columns)
+    if not group_names <= (selected | {col}):
+        return table
     key_idx = [i for i, c in enumerate(table.columns)
                if c != col and (c in group_names or str(c) in group_names)]
     fill_idx = [i for i in range(len(table.columns))
@@ -53,11 +62,12 @@ def maybe_gapfill(ctx, table):
         by_key.setdefault(key, {})[int(row[tcol])] = row
 
     out: List[tuple] = []
+    grid = set(range(start, end, step))  # built once, shared across keys
     for key, buckets in by_key.items():
         prev: Optional[tuple] = None
         # emit ALL real buckets (even off-grid / out of [start, end)) plus
         # the missing grid buckets — gapfill inserts, never drops data
-        times = sorted(set(buckets) | set(range(start, end, step)))
+        times = sorted(set(buckets) | grid)
         for t in times:
             row = buckets.get(t)
             if row is None:

@@ -198,31 +198,38 @@ def _reduce_group_by(ctx: QueryContext, results: List[GroupByResult],
         # fill BEFORE sort/limit so ordering + limit apply to the filled
         # series (ref GapfillProcessor running inside the reducer)
         from pinot_tpu.query.gapfill import maybe_gapfill
-        filled = maybe_gapfill(
-            ctx, ResultTable(names, types, [r for _, r in rows]))
-        if ctx.order_by:
-            # re-derive sort keys positionally for filled rows: only
-            # select-column references are supported post-fill
-            keyed = []
-            for row in filled.rows:
-                bindings = {Identifier(n): v
-                            for n, v in zip(names, row)}
-                for e, v in zip(ctx.select, row):
-                    bindings[e] = v
-                keyed.append((tuple(
-                    eval_scalar(e, bindings) for e, _ in ctx.order_by),
-                    row))
-            keyed = _sorted_by_keys(keyed,
-                                    [asc for _, asc in ctx.order_by])
-            filled_rows = [r for _, r in keyed]
-        else:
-            filled_rows = list(filled.rows)
-        out = filled_rows[ctx.offset:ctx.offset + ctx.limit]
-        return ResultTable(names, types, out)
+        pre = ResultTable(names, types, [r for _, r in rows])
+        filled = maybe_gapfill(ctx, pre)
+        if filled is not pre:  # options were valid and fill applied
+            try:
+                return ResultTable(
+                    names, types,
+                    _sort_limit_filled(ctx, names, filled.rows))
+            except (ValueError, KeyError):
+                # ORDER BY references something not reconstructible from
+                # the output row (e.g. an unselected column): fall back
+                # to the unfilled path rather than failing the query
+                pass
     if ctx.order_by:
         rows = _sorted_by_keys(rows, [asc for _, asc in ctx.order_by])
     out = [r for _, r in rows][ctx.offset:ctx.offset + ctx.limit]
     return ResultTable(names, types, out)
+
+
+def _sort_limit_filled(ctx: QueryContext, names, filled_rows):
+    """ORDER BY + OFFSET/LIMIT over gap-filled rows: sort keys re-derive
+    from the output columns (select expressions + aliases)."""
+    if not ctx.order_by:
+        return list(filled_rows)[ctx.offset:ctx.offset + ctx.limit]
+    keyed = []
+    for row in filled_rows:
+        bindings = {Identifier(n): v for n, v in zip(names, row)}
+        for e, v in zip(ctx.select, row):
+            bindings[e] = v
+        keyed.append((tuple(eval_scalar(e, bindings)
+                            for e, _ in ctx.order_by), row))
+    keyed = _sorted_by_keys(keyed, [asc for _, asc in ctx.order_by])
+    return [r for _, r in keyed][ctx.offset:ctx.offset + ctx.limit]
 
 
 def _sorted_by_keys(rows, ascs: List[bool]):

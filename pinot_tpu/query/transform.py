@@ -275,11 +275,57 @@ _SPECIAL: Dict[str, Callable] = {
         [x[::-1] for x in np.asarray(evaluate(e.args[0], p)).astype(str)]),
     "coalesce": lambda e, p: _coalesce(e, p),
     "json_extract_scalar": lambda e, p: _json_extract_scalar(e, p),
+    "map_value": lambda e, p: _map_value(e, p),
+    "st_distance": lambda e, p: _st_distance(e, p),
     "json_extract_key": lambda e, p: _json_extract_key(e, p),
     "json_format": lambda e, p: np.array(
         [_json_format_one(v) for v in np.asarray(evaluate(e.args[0], p))],
         dtype=object),
 }
+
+
+def _map_value(expr: Function, p: ColumnProvider):
+    """map_value(col, 'key'[, default]) — index-backed dense sub-column
+    when the segment carries a map index (ref segment/index/map/ dense
+    keys), JSON parse per row otherwise."""
+    col = expr.args[0]
+    key = str(expr.args[1].value)  # type: ignore[union-attr]
+    default = expr.args[2].value if len(expr.args) > 2 else None  # type: ignore[union-attr]
+    index = None
+    ds_getter = getattr(p, "data_source", None)
+    if isinstance(col, Identifier) and ds_getter is not None:
+        ds = ds_getter(col.name)
+        index = getattr(ds, "map_index", None) if ds is not None else None
+    if index is not None:
+        sub = index.value_column(key)
+        if sub is None:
+            return np.full(index.num_docs, default, object)
+        out = sub.copy()
+        if default is not None:
+            out[out == None] = default  # noqa: E711
+        return out
+    vals = np.asarray(evaluate(col, p))
+    out = np.full(len(vals), default, object)
+    for i, v in enumerate(vals):
+        try:
+            m = json.loads(str(v))
+            if isinstance(m, dict) and key in m:
+                out[i] = m[key]
+        except ValueError:
+            pass
+    return out
+
+
+def _st_distance(expr: Function, p: ColumnProvider):
+    """st_distance(col, 'lat,lng') — haversine meters to a fixed point
+    (ref StDistanceFunction; points are 'lat,lng' strings here)."""
+    from pinot_tpu.segment.geo_index import haversine_m
+    vals = np.asarray(evaluate(expr.args[0], p)).astype(str)
+    ref = str(expr.args[1].value)  # type: ignore[union-attr]
+    rlat, rlng = (float(x) for x in ref.split(","))
+    lats = np.array([float(s.split(",")[0]) for s in vals])
+    lngs = np.array([float(s.split(",")[1]) for s in vals])
+    return haversine_m(lats, lngs, rlat, rlng)
 
 
 def _json_format_one(v) -> str:

@@ -183,6 +183,31 @@ class SegmentCreator:
             buffers[index_key(name, it.TEXT)] = \
                 TextIndex.build(values, num_docs).to_bytes()
             meta.indexes.append(it.TEXT)
+        if name in idx_cfg.vector_index_columns:
+            # vectors arrive as JSON-array strings (or lists); the index
+            # holds the dense [n, d] block (ref HnswVectorIndexCreator)
+            import json as _json
+
+            from pinot_tpu.segment.vector_index import VectorIndex
+            vecs = [(_json.loads(v) if isinstance(v, (str, bytes)) else v)
+                    for v in values]
+            buffers[index_key(name, it.VECTOR)] = \
+                VectorIndex.build(np.asarray(vecs, np.float32)).to_bytes()
+            meta.indexes.append(it.VECTOR)
+        if name in idx_cfg.geo_index_columns:
+            # points arrive as 'lat,lng' strings (ref geospatial creator);
+            # malformed points parse to NaN and index into no cell
+            from pinot_tpu.segment.geo_index import GeoIndex, parse_point
+            pts = [parse_point(v) for v in values]
+            buffers[index_key(name, it.GEO)] = \
+                GeoIndex.build([p[0] for p in pts],
+                               [p[1] for p in pts]).to_bytes()
+            meta.indexes.append(it.GEO)
+        if name in idx_cfg.map_index_columns:
+            from pinot_tpu.segment.map_index import MapIndex
+            buffers[index_key(name, it.MAP)] = \
+                MapIndex.build(values, num_docs).to_bytes()
+            meta.indexes.append(it.MAP)
 
     # ------------------------------------------------------------------
     def _build_mv(self, spec: FieldSpec, data: Optional[ColumnData], num_docs: int,

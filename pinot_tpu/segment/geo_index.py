@@ -1,0 +1,148 @@
+"""Geospatial index: hierarchical grid cells with posting lists.
+
+Reference parity: pinot-segment-local
+segment/index/readers/geospatial/ + creator/impl/geospatial/ (H3
+hex-cell index behind ST_DISTANCE range filters,
+core/operator/filter/H3IndexFilterOperator: cover the query circle with
+cells at the index resolution, union the postings, exact-verify the
+boundary cells).
+
+Clean-room cell scheme (no H3 dependency): a fixed-resolution
+equirectangular lat/lng grid — cell id packs (lat_bin, lng_bin) into an
+int64. Square cells change the covering geometry but not the algorithm:
+candidate = union of postings of all cells intersecting the circle's
+bounding box, then exact haversine verification (the reference verifies
+boundary cells the same way). Points are (lat, lng) float64 pairs.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Dict, Tuple
+
+import numpy as np
+
+EARTH_RADIUS_M = 6_371_008.8
+
+_HDR = struct.Struct("<IdI")
+
+
+def haversine_m(lat1, lng1, lat2, lng2) -> np.ndarray:
+    """Great-circle distance in meters (vectorized)."""
+    p1, p2 = np.radians(lat1), np.radians(lat2)
+    dp = p2 - p1
+    dl = np.radians(lng2) - np.radians(lng1)
+    a = np.sin(dp / 2) ** 2 + np.cos(p1) * np.cos(p2) * np.sin(dl / 2) ** 2
+    return 2 * EARTH_RADIUS_M * np.arcsin(np.sqrt(np.clip(a, 0, 1)))
+
+
+def parse_point(v) -> Tuple[float, float]:
+    """'lat,lng' -> floats; malformed/null -> (nan, nan) so the row never
+    matches a distance query (shared by index build + scan fallback so
+    both paths agree on bad data)."""
+    try:
+        a, b = str(v).split(",")
+        return float(a), float(b)
+    except (ValueError, AttributeError, TypeError):
+        return float("nan"), float("nan")
+
+
+class GeoIndex:
+    """Fixed-resolution grid cells -> doc posting lists."""
+
+    #: default cell edge in degrees (~1.1 km of latitude)
+    DEFAULT_RES_DEG = 0.01
+
+    def __init__(self, lats: np.ndarray, lngs: np.ndarray,
+                 res_deg: float, cells: Dict[int, np.ndarray]):
+        self.lats = lats
+        self.lngs = lngs
+        self.res_deg = res_deg
+        self.cells = cells
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def build(cls, lats, lngs, res_deg: float = DEFAULT_RES_DEG
+              ) -> "GeoIndex":
+        lats = np.asarray(lats, np.float64)
+        lngs = np.asarray(lngs, np.float64)
+        # NaN coordinates (malformed/null points) index into no cell, so
+        # they can never spuriously match a distance query
+        valid = ~(np.isnan(lats) | np.isnan(lngs))
+        ids = cls._cell_ids(np.where(valid, lats, 0.0),
+                            np.where(valid, lngs, 0.0), res_deg)
+        ids = np.where(valid, ids, np.int64(-1))
+        order = np.argsort(ids, kind="stable")
+        order = order[ids[order] >= 0]
+        if len(order) == 0:
+            return cls(lats, lngs, res_deg, {})
+        sorted_ids = ids[order]
+        bounds = np.flatnonzero(np.r_[True, sorted_ids[1:]
+                                      != sorted_ids[:-1]])
+        cells: Dict[int, np.ndarray] = {}
+        for i, b in enumerate(bounds):
+            e = bounds[i + 1] if i + 1 < len(bounds) else len(sorted_ids)
+            cells[int(sorted_ids[b])] = np.sort(order[b:e]).astype(np.int32)
+        return cls(lats, lngs, res_deg, cells)
+
+    @staticmethod
+    def _cell_ids(lats, lngs, res_deg: float) -> np.ndarray:
+        la = np.floor((np.asarray(lats) + 90.0) / res_deg).astype(np.int64)
+        lo = np.floor((np.asarray(lngs) + 180.0) / res_deg).astype(np.int64)
+        return (la << 32) | lo
+
+    # ------------------------------------------------------------------
+    def within_distance(self, lat: float, lng: float,
+                        meters: float) -> np.ndarray:
+        """Sorted doc ids within `meters` of the point (exact — the grid
+        only prunes candidates, ref H3IndexFilterOperator's full-match +
+        boundary-verify split)."""
+        # degree extent of the radius (lng shrinks by cos(lat))
+        dlat = np.degrees(meters / EARTH_RADIUS_M)
+        coslat = max(np.cos(np.radians(lat)), 1e-6)
+        dlng = dlat / coslat
+        la_lo = int(np.floor((lat - dlat + 90.0) / self.res_deg))
+        la_hi = int(np.floor((lat + dlat + 90.0) / self.res_deg))
+        lo_lo = int(np.floor((lng - dlng + 180.0) / self.res_deg))
+        lo_hi = int(np.floor((lng + dlng + 180.0) / self.res_deg))
+        # longitude wraps at the antimeridian: bins are modulo the globe
+        # (a query at lng 179.99 must probe cells stored near -180)
+        nlng = max(int(round(360.0 / self.res_deg)), 1)
+        cand_parts = []
+        for la in range(la_lo, la_hi + 1):
+            for lo in range(lo_lo, lo_hi + 1):
+                ids = self.cells.get((la << 32) | (lo % nlng))
+                if ids is not None:
+                    cand_parts.append(ids)
+        if not cand_parts:
+            return np.empty(0, np.int32)
+        cand = np.concatenate(cand_parts)
+        d = haversine_m(self.lats[cand], self.lngs[cand], lat, lng)
+        return np.sort(cand[d <= meters]).astype(np.int32)
+
+    # ------------------------------------------------------------------
+    def to_bytes(self) -> bytes:
+        n = len(self.lats)
+        out = [_HDR.pack(n, self.res_deg, len(self.cells)),
+               self.lats.astype("<f8").tobytes(),
+               self.lngs.astype("<f8").tobytes()]
+        for cid, ids in self.cells.items():
+            out.append(struct.pack("<qI", cid, len(ids)))
+            out.append(ids.astype("<i4").tobytes())
+        return b"".join(out)
+
+    @classmethod
+    def from_bytes(cls, buf) -> "GeoIndex":
+        buf = bytes(buf)
+        n, res, ncells = _HDR.unpack_from(buf, 0)
+        pos = _HDR.size
+        lats = np.frombuffer(buf, "<f8", n, pos).copy()
+        pos += 8 * n
+        lngs = np.frombuffer(buf, "<f8", n, pos).copy()
+        pos += 8 * n
+        cells: Dict[int, np.ndarray] = {}
+        for _ in range(ncells):
+            cid, cnt = struct.unpack_from("<qI", buf, pos)
+            pos += 12
+            cells[cid] = np.frombuffer(buf, "<i4", cnt, pos).copy()
+            pos += 4 * cnt
+        return cls(lats, lngs, res, cells)

@@ -15,9 +15,11 @@ JSON = "json_index"
 TEXT = "text_index"
 FST = "fst_index"
 VECTOR = "vector_index"
+GEO = "geo_index"
+MAP = "map_index"
 STARTREE = "startree_index"
 STARTREE_DATA = "startree_data"
 CLP = "clp_forward"  # y-scope CLP log-compressed forward index
 
 ALL = [DICTIONARY, FORWARD, INVERTED, RANGE, SORTED, BLOOM, NULLVECTOR,
-       JSON, TEXT, FST, VECTOR, STARTREE, STARTREE_DATA, CLP]
+       JSON, TEXT, FST, VECTOR, GEO, MAP, STARTREE, STARTREE_DATA, CLP]

@@ -149,6 +149,33 @@ class DataSource:
         return self._text
 
     @property
+    def vector_index(self):
+        """Ref DataSource.getVectorIndex."""
+        if getattr(self, "_vector", None) is None and self._has(it.VECTOR):
+            from pinot_tpu.segment.vector_index import VectorIndex
+            self._vector = VectorIndex.from_bytes(
+                self._seg.dir.get_buffer(self.metadata.name, it.VECTOR))
+        return getattr(self, "_vector", None)
+
+    @property
+    def geo_index(self):
+        """Ref DataSource.getH3Index."""
+        if getattr(self, "_geo", None) is None and self._has(it.GEO):
+            from pinot_tpu.segment.geo_index import GeoIndex
+            self._geo = GeoIndex.from_bytes(
+                self._seg.dir.get_buffer(self.metadata.name, it.GEO))
+        return getattr(self, "_geo", None)
+
+    @property
+    def map_index(self):
+        """Ref DataSource.getMapIndex (segment/index/map/)."""
+        if getattr(self, "_map", None) is None and self._has(it.MAP):
+            from pinot_tpu.segment.map_index import MapIndex
+            self._map = MapIndex.from_bytes(
+                self._seg.dir.get_buffer(self.metadata.name, it.MAP))
+        return getattr(self, "_map", None)
+
+    @property
     def null_value_vector(self) -> Optional[Bitmap]:
         if self._nullvec is None and self._has(it.NULLVECTOR):
             self._nullvec = Bitmap.from_bytes(

@@ -1,0 +1,103 @@
+"""Map index: per-key dense sub-columns for map-typed columns.
+
+Reference parity: pinot-segment-local segment/index/map/ — MAP columns
+(string key -> scalar value per row) store each observed key as its own
+dense sub-column so `map_value(col, 'key')` reads column-speed instead
+of parsing per row (the reference's dense-key mode; rare keys stay in
+the fallback path).
+
+Clean-room layout: keys observed at build time each get a value array of
+length num_docs (None where absent) serialized as a JSON-lines-free
+binary; lookups are O(1) per key.
+"""
+from __future__ import annotations
+
+import json
+import struct
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+_U32 = struct.Struct("<I")
+
+
+class MapIndex:
+    def __init__(self, columns: Dict[str, np.ndarray], num_docs: int):
+        #: key -> dense [num_docs] object array (None = absent)
+        self.columns = columns
+        self.num_docs = num_docs
+
+    @classmethod
+    def build(cls, values, num_docs: int) -> "MapIndex":
+        """values: per-doc dicts (or JSON object strings)."""
+        cols: Dict[str, np.ndarray] = {}
+        for doc_id, raw in enumerate(values):
+            m = raw
+            if isinstance(raw, (str, bytes)):
+                try:
+                    m = json.loads(raw)
+                except ValueError:
+                    m = None
+            if not isinstance(m, dict):
+                continue
+            for k, v in m.items():
+                col = cols.get(k)
+                if col is None:
+                    col = cols[k] = np.full(num_docs, None, object)
+                col[doc_id] = v
+        return cls(cols, num_docs)
+
+    # ------------------------------------------------------------------
+    def keys(self) -> List[str]:
+        return sorted(self.columns)
+
+    def value_column(self, key: str) -> Optional[np.ndarray]:
+        """Dense per-doc values for a key (None where the row's map lacks
+        it); None when the key was never observed."""
+        return self.columns.get(key)
+
+    def docs_with_key(self, key: str) -> np.ndarray:
+        col = self.columns.get(key)
+        if col is None:
+            return np.empty(0, np.int32)
+        return np.flatnonzero(col != None).astype(np.int32)  # noqa: E711
+
+    def docs_with_value(self, key: str, value: Any) -> np.ndarray:
+        col = self.columns.get(key)
+        if col is None:
+            return np.empty(0, np.int32)
+        return np.flatnonzero(col == value).astype(np.int32)
+
+    # ------------------------------------------------------------------
+    def to_bytes(self) -> bytes:
+        out = [_U32.pack(self.num_docs), _U32.pack(len(self.columns))]
+        for k in self.keys():
+            col = self.columns[k]
+            kb = k.encode()
+            payload = json.dumps(col.tolist()).encode()
+            out += [_U32.pack(len(kb)), kb,
+                    _U32.pack(len(payload)), payload]
+        return b"".join(out)
+
+    @classmethod
+    def from_bytes(cls, buf) -> "MapIndex":
+        buf = bytes(buf)
+        pos = 0
+
+        def u32():
+            nonlocal pos
+            v = _U32.unpack_from(buf, pos)[0]
+            pos += 4
+            return v
+
+        num_docs = u32()
+        cols: Dict[str, np.ndarray] = {}
+        for _ in range(u32()):
+            ln = u32()
+            k = buf[pos:pos + ln].decode()
+            pos += ln
+            pn = u32()
+            vals = json.loads(buf[pos:pos + pn])
+            pos += pn
+            cols[k] = np.array(vals, object)
+        return cls(cols, num_docs)

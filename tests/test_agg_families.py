@@ -154,3 +154,47 @@ class TestGapfillEdges:
         assert got[0] == 0 and got[10] == 0 and got[20] == 0
         # ordered by bucket including filled rows
         assert [row[0] for row in r.rows] == sorted(got)
+
+
+class TestGapfillGuards:
+    def test_unselected_group_col_bails(self, tmp_path):
+        schema = Schema("m3", [
+            FieldSpec("bucket", DataType.INT, FieldType.DIMENSION),
+            FieldSpec("host", DataType.STRING, FieldType.DIMENSION),
+            FieldSpec("v", DataType.INT, FieldType.METRIC)])
+        tc = TableConfig(name="m3")
+        cols = {"bucket": np.array([0, 0, 10]),
+                "host": np.array(["a", "b", "b"], object),
+                "v": np.array([5, 7, 9])}
+        out = str(tmp_path / "s0")
+        SegmentCreator(tc, schema).build(cols, out, "s0")
+        seg = load_segment(out)
+        ex = QueryExecutor([seg], use_tpu=False)
+        base = ("SET gapfillTimeCol = bucket; SET gapfillStart = 0; "
+                "SET gapfillEnd = 30; SET gapfillStep = 10; ")
+        # host is grouped but NOT selected: gapfill must bail, keeping
+        # ALL three rows (no silent collapse)
+        r = ex.execute(base + "SELECT bucket, SUM(v) FROM m3 "
+                              "GROUP BY bucket, host LIMIT 100")
+        assert sorted(row[1] for row in r.rows) == [5.0, 7.0, 9.0]
+        # ORDER BY an unselected column under gapfill: no crash
+        r2 = ex.execute(base + "SELECT bucket, SUM(v) FROM m3 "
+                               "GROUP BY bucket, host "
+                               "ORDER BY host LIMIT 100")
+        assert len(r2.rows) == 3
+
+    def test_grid_bomb_skipped(self, tmp_path):
+        schema = Schema("m4", [
+            FieldSpec("bucket", DataType.INT, FieldType.DIMENSION),
+            FieldSpec("v", DataType.INT, FieldType.METRIC)])
+        tc = TableConfig(name="m4")
+        cols = {"bucket": np.array([0]), "v": np.array([1])}
+        out = str(tmp_path / "s0")
+        SegmentCreator(tc, schema).build(cols, out, "s0")
+        seg = load_segment(out)
+        ex = QueryExecutor([seg], use_tpu=False)
+        r = ex.execute("SET gapfillTimeCol = bucket; SET gapfillStart = 0; "
+                       "SET gapfillEnd = 1000000000; SET gapfillStep = 1; "
+                       "SELECT bucket, SUM(v) FROM m4 GROUP BY bucket "
+                       "LIMIT 10")
+        assert len(r.rows) == 1  # fill skipped, data intact

@@ -1,0 +1,207 @@
+"""Vector, geospatial, and map index families.
+
+Ref: pinot-segment-local creator/impl/vector/HnswVectorIndexCreator.java +
+readers/vector/, readers/geospatial/ (H3), segment/index/map/ — VERDICT
+r4 missing #6: the last absent index families.
+"""
+import json
+
+import numpy as np
+import pytest
+
+from pinot_tpu.models import (DataType, FieldSpec, FieldType, Schema,
+                              TableConfig)
+from pinot_tpu.query.executor import QueryExecutor
+from pinot_tpu.segment.creator import SegmentCreator
+from pinot_tpu.segment.geo_index import GeoIndex, haversine_m
+from pinot_tpu.segment.loader import load_segment
+from pinot_tpu.segment.map_index import MapIndex
+from pinot_tpu.segment.vector_index import VectorIndex
+
+
+class TestVectorIndex:
+    def test_exact_topk_cosine(self):
+        rng = np.random.default_rng(0)
+        v = rng.normal(size=(500, 16)).astype(np.float32)
+        ix = VectorIndex.build(v)
+        q = v[123] + rng.normal(scale=0.01, size=16).astype(np.float32)
+        top = ix.top_k(q, 5)
+        assert top[0] == 123
+        # parity with a naive cosine ranking
+        vn = v / np.linalg.norm(v, axis=1, keepdims=True)
+        qn = q / np.linalg.norm(q)
+        naive = np.argsort(vn @ qn)[::-1][:5]
+        assert set(top) == set(naive)
+
+    def test_ivf_recall(self):
+        rng = np.random.default_rng(1)
+        v = rng.normal(size=(8000, 8)).astype(np.float32)
+        ix = VectorIndex.build(v)
+        assert ix.centroids is not None  # coarse layer engaged
+        hits = 0
+        for i in range(20):
+            q = v[i * 37]
+            if i * 37 in ix.top_k(q, 10, nprobe=8):
+                hits += 1
+        assert hits >= 18  # high self-recall
+
+    def test_serde_roundtrip(self):
+        rng = np.random.default_rng(2)
+        v = rng.normal(size=(100, 4)).astype(np.float32)
+        ix = VectorIndex.build(v)
+        ix2 = VectorIndex.from_bytes(ix.to_bytes())
+        q = rng.normal(size=4).astype(np.float32)
+        assert ix.top_k(q, 7).tolist() == ix2.top_k(q, 7).tolist()
+
+    def test_sql_vector_similarity(self, tmp_path):
+        rng = np.random.default_rng(3)
+        n, d = 1000, 8
+        vecs = rng.normal(size=(n, d)).astype(np.float32)
+        schema = Schema("emb", [
+            FieldSpec("id", DataType.INT, FieldType.DIMENSION),
+            FieldSpec("vec", DataType.STRING, FieldType.DIMENSION)])
+        tc = TableConfig(name="emb")
+        tc.indexing.vector_index_columns = ["vec"]
+        cols = {"id": np.arange(n),
+                "vec": np.array([json.dumps([round(float(x), 5)
+                                             for x in row])
+                                 for row in vecs], object)}
+        out = str(tmp_path / "s0")
+        SegmentCreator(tc, schema).build(cols, out, "s0")
+        seg = load_segment(out)
+        ex = QueryExecutor([seg], use_tpu=False)
+        q = json.dumps([round(float(x), 5) for x in vecs[42]])
+        r = ex.execute(
+            f"SELECT id FROM emb WHERE vector_similarity(vec, '{q}', 3)")
+        ids = {row[0] for row in r.rows}
+        assert 42 in ids and len(ids) == 3
+
+
+class TestGeoIndex:
+    # a few points around Paris (lat, lng)
+    POINTS = [(48.8566, 2.3522),    # Paris center
+              (48.8606, 2.3376),    # Louvre (~1.2 km)
+              (48.8049, 2.1204),    # Versailles (~18 km)
+              (45.7640, 4.8357),    # Lyon (~390 km)
+              (51.5074, -0.1278)]   # London (~344 km)
+
+    def test_within_distance(self):
+        lats = [p[0] for p in self.POINTS]
+        lngs = [p[1] for p in self.POINTS]
+        ix = GeoIndex.build(lats, lngs)
+        near = ix.within_distance(48.8566, 2.3522, 5_000)
+        assert near.tolist() == [0, 1]
+        wide = ix.within_distance(48.8566, 2.3522, 25_000)
+        assert wide.tolist() == [0, 1, 2]
+
+    def test_matches_exact_haversine(self):
+        rng = np.random.default_rng(4)
+        lats = rng.uniform(48.0, 49.5, 5000)
+        lngs = rng.uniform(1.5, 3.5, 5000)
+        ix = GeoIndex.build(lats, lngs)
+        got = ix.within_distance(48.8566, 2.3522, 20_000)
+        d = haversine_m(lats, lngs, 48.8566, 2.3522)
+        want = np.flatnonzero(d <= 20_000)
+        assert got.tolist() == want.tolist()
+
+    def test_serde_and_sql(self, tmp_path):
+        schema = Schema("poi", [
+            FieldSpec("name", DataType.STRING, FieldType.DIMENSION),
+            FieldSpec("loc", DataType.STRING, FieldType.DIMENSION)])
+        tc = TableConfig(name="poi")
+        tc.indexing.geo_index_columns = ["loc"]
+        names = ["center", "louvre", "versailles", "lyon", "london"]
+        cols = {"name": np.array(names, object),
+                "loc": np.array([f"{a},{b}" for a, b in self.POINTS],
+                                object)}
+        out = str(tmp_path / "s0")
+        SegmentCreator(tc, schema).build(cols, out, "s0")
+        seg = load_segment(out)
+        assert seg.data_source("loc").geo_index is not None
+        ex = QueryExecutor([seg], use_tpu=False)
+        r = ex.execute("SELECT name FROM poi WHERE "
+                       "st_within_distance(loc, 48.8566, 2.3522, 5000)")
+        assert {row[0] for row in r.rows} == {"center", "louvre"}
+        # st_distance transform agrees
+        r2 = ex.execute("SELECT name, st_distance(loc, '48.8566,2.3522') "
+                        "FROM poi ORDER BY name LIMIT 10")
+        dist = {row[0]: row[1] for row in r2.rows}
+        assert dist["center"] < 10
+        assert 300_000 < dist["london"] < 400_000
+
+
+class TestMapIndex:
+    DOCS = [{"os": "linux", "ram": 64},
+            {"os": "mac", "ram": 16},
+            {"os": "linux"},
+            {}]
+
+    def test_build_and_lookup(self):
+        vals = [json.dumps(d) for d in self.DOCS]
+        ix = MapIndex.build(vals, len(vals))
+        assert ix.keys() == ["os", "ram"]
+        assert ix.docs_with_key("ram").tolist() == [0, 1]
+        assert ix.docs_with_value("os", "linux").tolist() == [0, 2]
+        ix2 = MapIndex.from_bytes(ix.to_bytes())
+        assert ix2.value_column("ram").tolist() == [64, 16, None, None]
+
+    def test_sql_map_value(self, tmp_path):
+        schema = Schema("hosts", [
+            FieldSpec("id", DataType.INT, FieldType.DIMENSION),
+            FieldSpec("attrs", DataType.STRING, FieldType.DIMENSION)])
+        tc = TableConfig(name="hosts")
+        tc.indexing.map_index_columns = ["attrs"]
+        cols = {"id": np.arange(4),
+                "attrs": np.array([json.dumps(d) for d in self.DOCS],
+                                  object)}
+        out = str(tmp_path / "s0")
+        SegmentCreator(tc, schema).build(cols, out, "s0")
+        seg = load_segment(out)
+        assert seg.data_source("attrs").map_index is not None
+        ex = QueryExecutor([seg], use_tpu=False)
+        r = ex.execute("SELECT id, map_value(attrs, 'os') FROM hosts "
+                       "ORDER BY id LIMIT 10")
+        assert [row[1] for row in r.rows] == ["linux", "mac", "linux", None]
+        r2 = ex.execute("SELECT id FROM hosts "
+                        "WHERE map_value(attrs, 'os') = 'linux'")
+        assert sorted(row[0] for row in r2.rows) == [0, 2]
+
+
+class TestReviewEdges:
+    def test_topk_zero_and_empty(self):
+        ix = VectorIndex.build(np.random.default_rng(0)
+                               .normal(size=(5, 4)).astype(np.float32))
+        assert ix.top_k(np.ones(4, np.float32), 0).tolist() == []
+        empty = VectorIndex.build(np.empty((0, 4), np.float32))
+        assert empty.top_k(np.ones(4, np.float32), 3).tolist() == []
+
+    def test_antimeridian_wraparound(self):
+        lats = [0.0, 0.0]
+        lngs = [179.995, -179.995]  # ~1.1 km apart across the date line
+        ix = GeoIndex.build(lats, lngs)
+        got = ix.within_distance(0.0, 179.995, 5_000)
+        assert got.tolist() == [0, 1]
+
+    def test_malformed_points_never_match(self, tmp_path):
+        schema = Schema("g", [
+            FieldSpec("loc", DataType.STRING, FieldType.DIMENSION)])
+        tc = TableConfig(name="g")
+        cols = {"loc": np.array(["0.05,0.05", "bad", ""], object)}
+        # WITHOUT an index: scan fallback must not crash, bad rows excluded
+        out = str(tmp_path / "noidx")
+        SegmentCreator(tc, schema).build(cols, out, "noidx")
+        seg = load_segment(out)
+        ex = QueryExecutor([seg], use_tpu=False)
+        r = ex.execute("SELECT COUNT(*) FROM g WHERE "
+                       "st_within_distance(loc, 0.0, 0.0, 50000)")
+        assert r.rows[0][0] == 1
+        # WITH an index: same answer (bad rows index into no cell)
+        tc2 = TableConfig(name="g")
+        tc2.indexing.geo_index_columns = ["loc"]
+        out2 = str(tmp_path / "idx")
+        SegmentCreator(tc2, schema).build(cols, out2, "idx")
+        seg2 = load_segment(out2)
+        ex2 = QueryExecutor([seg2], use_tpu=False)
+        r2 = ex2.execute("SELECT COUNT(*) FROM g WHERE "
+                         "st_within_distance(loc, 0.0, 0.0, 50000)")
+        assert r2.rows[0][0] == 1
