@@ -25,9 +25,14 @@ class BrokerRequestHandler:
     def __init__(self, routing: BrokerRoutingManager,
                  connections: Dict[str, ServerConnection],
                  max_fanout_threads: int = 16,
-                 mse_dispatcher=None, failure_detector=None):
+                 mse_dispatcher=None, failure_detector=None,
+                 quota_manager=None):
         self.routing = routing
         self.connections = connections
+        #: per-table QPS limits (ref queryquota/; None = no quotas)
+        self.quota_manager = quota_manager
+        #: adaptive selector stats feed (routing.selector, may be None)
+        self._selector = getattr(routing, "selector", None)
         #: multi-stage dispatcher (mse/dispatcher.py); when set, queries the
         #: single-stage grammar rejects (joins, subqueries) — or that opt in
         #: via useMultistageEngine — go through it (ref
@@ -46,6 +51,35 @@ class BrokerRequestHandler:
         with self._lock:
             self._request_id += 1
             return self._request_id
+
+    def _check_quota(self, table: str) -> bool:
+        """QPS quota on the LOGICAL name — quotas register unsuffixed, so
+        a _OFFLINE/_REALTIME-suffixed query must hit the same bucket
+        (ref HelixExternalViewBasedQueryQuotaManager: over-quota queries
+        are rejected, not queued)."""
+        if self.quota_manager is None:
+            return True
+        base = table
+        for suffix in ("_OFFLINE", "_REALTIME"):
+            if base.endswith(suffix):
+                base = base[: -len(suffix)]
+        return self.quota_manager.try_acquire(base)
+
+    def _timed_request(self, conn, server, physical_table, sql,
+                       segment_names, request_id, extra_filter):
+        """conn.request wrapped with adaptive-selector stats (latency +
+        in-flight, ref adaptiveserverselector's ServerRoutingStats)."""
+        sel = self._selector
+        if sel is None:
+            return conn.request(physical_table, sql, segment_names,
+                                request_id, extra_filter)
+        sel.record_start(server)
+        t0 = time.time()
+        try:
+            return conn.request(physical_table, sql, segment_names,
+                                request_id, extra_filter)
+        finally:
+            sel.record_end(server, time.time() - t0)
 
     def handle(self, sql: str) -> BrokerResponse:
         start = time.time()
@@ -67,6 +101,10 @@ class BrokerRequestHandler:
         if self.mse_dispatcher is not None and \
                 query.options.get("useMultistageEngine", "").lower() == "true":
             return self.mse_dispatcher.submit(sql)
+        if not self._check_quota(ctx.table):
+            return _error_response(
+                429, f"QuotaExceededError: table {ctx.table} is over its "
+                     f"QPS quota", start)
         route = self.routing.get_route(ctx.table)
         if route is None:
             return _error_response(
@@ -96,8 +134,8 @@ class BrokerRequestHandler:
                 # ANDed into the filter TREE server-side — splicing SQL
                 # text is unsound (keywords inside identifiers/literals)
                 out.append((self._pool.submit(
-                    conn.request, physical_table, sql, segment_names,
-                    request_id, extra_filter),
+                    self._timed_request, conn, server, physical_table, sql,
+                    segment_names, request_id, extra_filter),
                     server, physical_table, segment_names, extra_filter))
             return out
 
@@ -163,3 +201,81 @@ def _error_response(code: int, message: str, start: float) -> BrokerResponse:
     resp.exceptions = [{"errorCode": code, "message": message}]
     resp.time_used_ms = (time.time() - start) * 1000.0
     return resp
+
+
+class StreamingMixin:
+    """Per-block streaming consumption for selection queries (ref
+    transport/grpc streaming + core/query/reduce/StreamingReduceService):
+    server frames deserialize incrementally and row collection stops at
+    OFFSET+LIMIT (remaining frames drain undecoded to keep the channel
+    clean). Aggregations/group-bys fall back to the buffered path — their
+    reduce needs all partials anyway."""
+
+    def handle_streaming(self, sql: str) -> BrokerResponse:
+        start = time.time()
+        try:
+            ctx = QueryContext.from_sql(sql)
+        except (SqlParseError, ValueError) as e:
+            return _error_response(150, f"SQLParsingError: {e}", start)
+        if ctx.aggregations or ctx.group_by or ctx.distinct or ctx.order_by:
+            return self.handle(sql)
+        if not self._check_quota(ctx.table):
+            return _error_response(
+                429, f"QuotaExceededError: table {ctx.table} is over its "
+                     f"QPS quota", start)
+        route = self.routing.get_route(ctx.table)
+        if route is None:
+            return _error_response(
+                190, f"TableDoesNotExistError: {ctx.table}", start)
+        plan = route.route(ctx, unhealthy=self.failure_detector
+                           .unhealthy_servers())
+        request_id = self._next_id()
+        needed = ctx.offset + ctx.limit
+        results, exceptions, extra_stats = [], [], []
+        rows_seen = 0
+        blocks = 0
+        for server, physical_table, names, extra in plan:
+            conn = self.connections.get(server)
+            if conn is None:
+                exceptions.append({"errorCode": 427,
+                                   "message": f"ServerNotConnected: {server}"})
+                continue
+            if self._selector is not None:
+                self._selector.record_start(server)
+            t0 = time.time()
+            try:
+                for frame in conn.request_streaming(
+                        physical_table, sql, names, request_id, extra):
+                    blocks += 1
+                    if rows_seen >= needed:
+                        continue  # drain to EOS, skip decoding
+                    server_results, server_exc, stats = \
+                        datatable.deserialize_results(frame)
+                    exceptions.extend(server_exc)
+                    if stats is not None:
+                        extra_stats.append(stats)
+                    for r in server_results:
+                        results.append(r)
+                        rows_seen += len(getattr(r, "rows", []))
+                self.failure_detector.mark_success(server)
+            except Exception as e:  # noqa: BLE001
+                self.failure_detector.mark_failure(server)
+                exceptions.append({"errorCode": 427,
+                                   "message": f"ServerError: {e}"})
+            finally:
+                if self._selector is not None:
+                    self._selector.record_end(server, time.time() - t0)
+        resp = reduce_results(ctx, results)
+        for s in extra_stats:
+            resp.stats.merge(s)
+        resp.exceptions = exceptions
+        resp.num_servers_queried = len(plan)
+        resp.num_servers_responded = len(plan) - sum(
+            1 for e in exceptions if "ServerError" in e.get("message", ""))
+        resp.time_used_ms = (time.time() - start) * 1000.0
+        resp.num_streamed_blocks = blocks
+        return resp
+
+
+class StreamingBrokerRequestHandler(StreamingMixin, BrokerRequestHandler):
+    """BrokerRequestHandler + the streaming response plane."""

@@ -41,12 +41,17 @@ class RoutingTable:
 
     def __init__(self, offline: Optional[TableRoute] = None,
                  realtime: Optional[TableRoute] = None,
-                 time_boundary: Optional[int] = None):
+                 time_boundary: Optional[int] = None,
+                 selector=None):
         self.offline = offline
         self.realtime = realtime
         #: hybrid split: offline serves time <= boundary, realtime the rest
         #: (ref TimeBoundaryManager.java:56)
         self.time_boundary = time_boundary
+        #: optional AdaptiveServerSelector (broker/adaptive.py) — when
+        #: set, replica choice prefers low-latency/low-in-flight servers
+        #: (ref routing/adaptiveserverselector/); None = round-robin
+        self.selector = selector
         self._rr = 0
         self._lock = threading.Lock()
 
@@ -86,7 +91,14 @@ class RoutingTable:
         per_server: Dict[str, List[str]] = {}
         with self._lock:
             for seg in selected:
-                server = _pick_replica(seg.servers, self._rr, unhealthy)
+                if self.selector is not None:
+                    server = self.selector.pick(seg.servers, unhealthy,
+                                                self._rr)
+                    if server is None:  # all unhealthy: any replica
+                        server = _pick_replica(seg.servers, self._rr,
+                                               unhealthy)
+                else:
+                    server = _pick_replica(seg.servers, self._rr, unhealthy)
                 if server is None:
                     continue
                 per_server.setdefault(server, []).append(seg.name)
@@ -192,11 +204,15 @@ class BrokerRoutingManager:
     Rebuilt from cluster state on assignment changes (the ExternalView
     watch analog is a callback from the controller-lite)."""
 
-    def __init__(self):
+    def __init__(self, selector=None):
         self._tables: Dict[str, RoutingTable] = {}
+        #: shared AdaptiveServerSelector attached to every route
+        self.selector = selector
         self._lock = threading.Lock()
 
     def set_route(self, logical_table: str, routing: RoutingTable) -> None:
+        if routing.selector is None:
+            routing.selector = self.selector
         with self._lock:
             self._tables[logical_table] = routing
 

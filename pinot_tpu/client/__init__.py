@@ -1,0 +1,20 @@
+"""Python client library for the broker HTTP edge.
+
+Reference parity: pinot-clients/pinot-java-client (broker Connection +
+ResultSetGroup) and pinot-jdbc-client's cursor surface — a dependency-free
+client users embed in applications:
+
+    from pinot_tpu.client import connect
+    conn = connect("localhost:8099")
+    rs = conn.execute("SELECT COUNT(*) FROM events")
+    rs.rows, rs.columns
+
+    cur = conn.cursor()           # DB-API 2.0-style
+    cur.execute("SELECT a, b FROM t WHERE a > %(lo)s", {"lo": 3})
+    cur.fetchall()
+"""
+from pinot_tpu.client.connection import (Connection, Cursor, PinotClientError,
+                                         ResultSet, connect)
+
+__all__ = ["connect", "Connection", "Cursor", "ResultSet",
+           "PinotClientError"]

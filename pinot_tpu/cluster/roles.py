@@ -30,6 +30,7 @@ log = logging.getLogger(__name__)
 
 def run_controller(state_dir: str, port: int = 0, host: str = "127.0.0.1",
                    deep_store_uri: Optional[str] = None,
+                   http_port: Optional[int] = None,
                    ready_event: Optional[threading.Event] = None,
                    stop_event: Optional[threading.Event] = None) -> None:
     from pinot_tpu.controller.cluster_state import ClusterState
@@ -40,6 +41,13 @@ def run_controller(state_dir: str, port: int = 0, host: str = "127.0.0.1",
     server = CoordinationServer(state, host=host, port=port,
                                 deep_store_uri=deep_store_uri)
     server.start()
+    rest = None
+    if http_port is not None:
+        from pinot_tpu.controller.http_api import ControllerHttpServer
+        rest = ControllerHttpServer(state, coordination=server,
+                                    host=host, port=http_port)
+        rest.start()
+        print(f"controller REST on {rest.host}:{rest.port}", flush=True)
     print(f"controller listening on {server.address}", flush=True)
     if ready_event is not None:
         ready_event.set()
@@ -54,6 +62,8 @@ def run_controller(state_dir: str, port: int = 0, host: str = "127.0.0.1",
                 except Exception:  # noqa: BLE001 — periodic must survive
                     log.exception("retention pass failed")
     finally:
+        if rest is not None:
+            rest.stop()
         server.stop()
 
 
@@ -333,10 +343,16 @@ class BrokerRole:
         from pinot_tpu.broker.routing import BrokerRoutingManager
         from pinot_tpu.server.query_server import ServerConnection
 
+        from pinot_tpu.broker.adaptive import AdaptiveServerSelector
+        from pinot_tpu.broker.quota import QueryQuotaManager
+
         self.client = CoordinationClient(coordinator)
-        self.routing = BrokerRoutingManager()
+        self.routing = BrokerRoutingManager(
+            selector=AdaptiveServerSelector())
         self.connections: Dict[str, ServerConnection] = {}
-        self.handler = BrokerRequestHandler(self.routing, self.connections)
+        self.quotas = QueryQuotaManager()
+        self.handler = BrokerRequestHandler(self.routing, self.connections,
+                                            quota_manager=self.quotas)
         self.http = BrokerHttpServer(self.handler, host=host, port=http_port)
         self._rebuild_lock = threading.Lock()
 
@@ -380,6 +396,8 @@ class BrokerRole:
                     inst["host"], inst["port"])
             for logical, cfg_d in blob.get("tables", {}).items():
                 cfg = TableConfig.from_dict(cfg_d)
+                self.quotas.set_quota(
+                    logical, cfg.query.max_queries_per_second)
                 physical = cfg.table_name_with_type
                 route = TableRoute(physical,
                                    time_column=cfg.retention.time_column)

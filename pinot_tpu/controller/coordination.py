@@ -107,7 +107,8 @@ class CoordinationServer:
         self._thread.start()
 
     def stop(self) -> None:
-        self._server.shutdown()
+        if self._thread is not None:  # shutdown() blocks unless serving
+            self._server.shutdown()
         self._server.server_close()
 
     @property
@@ -152,6 +153,10 @@ class CoordinationServer:
             cfg = TableConfig.from_dict(req["config"])
             schema = Schema.from_dict(req["schema"])
             self.state.add_table(cfg, schema)
+            self._notify()
+            return {"ok": True}
+        if op == "drop_table":
+            self.state.drop_table(req["table"])
             self._notify()
             return {"ok": True}
         if op == "register_instance":

@@ -1,0 +1,152 @@
+"""Controller REST API over the cluster state.
+
+Reference parity: pinot-controller api/resources/ (62 Jersey resources;
+the operational core here): table CRUD, schema read, segment listing and
+upload registration, instance listing, health — the surface ops tooling
+and the React UI call (the UI itself is out of scope; the API it needs
+is not).
+
+  GET    /health
+  GET    /tables                      -> {"tables": [...]}
+  GET    /tables/{name}               -> {"tableConfig": ..., "schema": ...}
+  POST   /tables                      <- {"tableConfig": ..., "schema": ...}
+  DELETE /tables/{name}
+  GET    /tables/{name}/segments      -> per-physical-table segment states
+  POST   /tables/{name}/segments      <- {"segDir": path, "tableType": ...}
+  GET    /instances
+"""
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from pinot_tpu.controller.cluster_state import ClusterState
+from pinot_tpu.models import Schema, TableConfig
+
+
+class ControllerHttpServer:
+    def __init__(self, state: ClusterState, coordination=None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.state = state
+        self.coordination = coordination  # CoordinationServer (optional)
+        api = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet
+                pass
+
+            def _reply(self, code: int, payload) -> None:
+                body = json.dumps(payload, default=str).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _body(self) -> dict:
+                n = int(self.headers.get("Content-Length", 0))
+                return json.loads(self.rfile.read(n)) if n else {}
+
+            def do_GET(self):
+                try:
+                    self._route("GET")
+                except Exception as e:  # noqa: BLE001
+                    self._reply(500, {"error": str(e)})
+
+            def do_POST(self):
+                try:
+                    self._route("POST")
+                except Exception as e:  # noqa: BLE001
+                    self._reply(500, {"error": str(e)})
+
+            def do_DELETE(self):
+                try:
+                    self._route("DELETE")
+                except Exception as e:  # noqa: BLE001
+                    self._reply(500, {"error": str(e)})
+
+            def _route(self, method: str):
+                path = self.path.rstrip("/")
+                if method == "GET" and path == "/health":
+                    return self._reply(200, {"status": "OK"})
+                if path == "/tables" and method == "GET":
+                    return self._reply(
+                        200, {"tables": sorted(api.state.tables)})
+                if path == "/tables" and method == "POST":
+                    body = self._body()
+                    cfg = TableConfig.from_dict(body["tableConfig"])
+                    schema = Schema.from_dict(body["schema"])
+                    # through coordination when present: watchers (brokers
+                    # /servers) must see the change notification
+                    if api.coordination is not None:
+                        api.coordination._dispatch({
+                            "op": "add_table",
+                            "config": cfg.to_dict(),
+                            "schema": schema.to_dict()})
+                    else:
+                        api.state.add_table(cfg, schema)
+                    return self._reply(200, {"status": f"added {cfg.name}"})
+                if path == "/instances" and method == "GET":
+                    return self._reply(200, {
+                        "instances": {k: vars(v).copy() for k, v in
+                                      api.state.instances.items()}})
+                m = re.fullmatch(r"/tables/([^/]+)", path)
+                if m:
+                    name = m.group(1)
+                    if method == "GET":
+                        cfg = api.state.tables.get(name)
+                        if cfg is None:
+                            return self._reply(
+                                404, {"error": f"no table {name}"})
+                        schema = api.state.schemas.get(name)
+                        return self._reply(200, {
+                            "tableConfig": cfg.to_dict(),
+                            "schema": schema.to_dict() if schema else None})
+                    if method == "DELETE":
+                        if api.coordination is not None:
+                            api.coordination._dispatch(
+                                {"op": "drop_table", "table": name})
+                        else:
+                            api.state.drop_table(name)
+                        return self._reply(200,
+                                           {"status": f"dropped {name}"})
+                m = re.fullmatch(r"/tables/([^/]+)/segments", path)
+                if m:
+                    name = m.group(1)
+                    if method == "GET":
+                        out = {}
+                        for suffix in ("_OFFLINE", "_REALTIME"):
+                            segs = api.state.segments.get(name + suffix)
+                            if segs:
+                                out[name + suffix] = {
+                                    n: s.to_dict() for n, s in segs.items()}
+                        return self._reply(200, out)
+                    if method == "POST":
+                        body = self._body()
+                        if api.coordination is None:
+                            return self._reply(
+                                503, {"error": "no coordination service"})
+                        r = api.coordination._dispatch({
+                            "op": "upload_segment", "table": name,
+                            "seg_dir": body["segDir"],
+                            "table_type": body.get("tableType", "OFFLINE")})
+                        return self._reply(200, r)
+                self._reply(404, {"error": f"no route {method} {path}"})
+
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+        self.host, self.port = self._server.server_address
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name=f"controller-http-{self.port}")
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._thread is not None:  # shutdown() blocks unless serving
+            self._server.shutdown()
+        self._server.server_close()

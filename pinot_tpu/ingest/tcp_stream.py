@@ -77,7 +77,8 @@ class StreamServer:
         self._thread.start()
 
     def stop(self) -> None:
-        self._server.shutdown()
+        if self._thread is not None:  # shutdown() blocks unless serving
+            self._server.shutdown()
         self._server.server_close()
 
     # ------------------------------------------------------------------

@@ -216,15 +216,19 @@ class QueryConfig:
 
     timeout_ms: Optional[int] = None
     max_rows_in_join: Optional[int] = None
+    #: broker-enforced QPS quota (ref QuotaConfig maxQueriesPerSecond)
+    max_queries_per_second: Optional[float] = None
     expression_override_map: Dict[str, str] = field(default_factory=dict)
 
     def to_dict(self) -> dict:
         return {"timeoutMs": self.timeout_ms, "maxRowsInJoin": self.max_rows_in_join,
+                "maxQueriesPerSecond": self.max_queries_per_second,
                 "expressionOverrideMap": self.expression_override_map}
 
     @classmethod
     def from_dict(cls, d: dict) -> "QueryConfig":
         return cls(timeout_ms=d.get("timeoutMs"), max_rows_in_join=d.get("maxRowsInJoin"),
+                   max_queries_per_second=d.get("maxQueriesPerSecond"),
                    expression_override_map=d.get("expressionOverrideMap", {}))
 
 

@@ -318,14 +318,14 @@ def _map_value(expr: Function, p: ColumnProvider):
 
 def _st_distance(expr: Function, p: ColumnProvider):
     """st_distance(col, 'lat,lng') — haversine meters to a fixed point
-    (ref StDistanceFunction; points are 'lat,lng' strings here)."""
-    from pinot_tpu.segment.geo_index import haversine_m
-    vals = np.asarray(evaluate(expr.args[0], p)).astype(str)
-    ref = str(expr.args[1].value)  # type: ignore[union-attr]
-    rlat, rlng = (float(x) for x in ref.split(","))
-    lats = np.array([float(s.split(",")[0]) for s in vals])
-    lngs = np.array([float(s.split(",")[1]) for s in vals])
-    return haversine_m(lats, lngs, rlat, rlng)
+    (ref StDistanceFunction; points are 'lat,lng' strings here).
+    Malformed/null points yield NaN (same contract as the geo index)."""
+    from pinot_tpu.segment.geo_index import haversine_m, parse_point
+    vals = np.asarray(evaluate(expr.args[0], p))
+    rlat, rlng = parse_point(expr.args[1].value)  # type: ignore[union-attr]
+    pts = [parse_point(v) for v in vals]
+    return haversine_m(np.array([a for a, _ in pts]),
+                       np.array([b for _, b in pts]), rlat, rlng)
 
 
 def _json_format_one(v) -> str:

@@ -92,6 +92,51 @@ class ServerQueryExecutor:
         finally:
             timer.__exit__(None, None, None)
 
+    #: segments per streamed response frame
+    STREAM_CHUNK_SEGMENTS = 4
+
+    def execute_streaming(self, table_name: str, sql_or_ctx,
+                          segments: Optional[List[str]] = None,
+                          extra_filter: Optional[str] = None) -> List[bytes]:
+        """Per-block response frames for large results (ref
+        GrpcQueryServer's streaming Submit + StreamingInstanceResponse
+        PlanNode): segments execute in chunks, each chunk serializing to
+        its own DataTable frame so neither side materializes the full
+        result. Returns the frame list (the transport streams them)."""
+        try:
+            ctx = (sql_or_ctx if isinstance(sql_or_ctx, QueryContext)
+                   else QueryContext.from_sql(sql_or_ctx))
+            if extra_filter:
+                from pinot_tpu.ingest.transforms import parse_expression
+                from pinot_tpu.query.expressions import func
+                extra = parse_expression(extra_filter)
+                ctx.filter = extra if ctx.filter is None \
+                    else func("and", ctx.filter, extra)
+            tdm = self.data_manager.table(table_name, create=False)
+            if tdm is None:
+                return [datatable.serialize_results(
+                    [], [{"errorCode": 190,
+                          "message": f"table {table_name} not found"}])]
+            sdms = tdm.acquire_segments(segments)
+            try:
+                frames = []
+                chunk = self.STREAM_CHUNK_SEGMENTS
+                segs = [s.segment for s in sdms]
+                for i in range(0, max(len(segs), 1), chunk):
+                    ex = QueryExecutor(segs[i:i + chunk],
+                                       use_tpu=self.use_tpu,
+                                       engine=self._shared_engine())
+                    results, prune_stats = ex.execute_context(ctx)
+                    frames.append(datatable.serialize_results(
+                        results, extra_stats=prune_stats))
+                return frames
+            finally:
+                TableDataManager.release_all(sdms)
+        except Exception as e:  # noqa: BLE001
+            return [datatable.serialize_results(
+                [], [{"errorCode": 200,
+                      "message": f"{type(e).__name__}: {e}"}])]
+
 
 class QueryServer:
     """Asyncio TCP server (the Netty QueryServer analog)."""
@@ -121,6 +166,23 @@ class QueryServer:
                 n = _LEN.unpack(hdr)[0]
                 payload = await reader.readexactly(n)
                 req = json.loads(payload)
+                if req.get("streaming"):
+                    # per-block response stream (ref GrpcQueryServer.Submit
+                    # server-stream): one DataTable frame per segment
+                    # chunk, then a zero-length EOS frame
+                    fut = self.scheduler.submit(
+                        lambda r=req: self.executor.execute_streaming(
+                            r["tableName"], r["sql"], r.get("segments"),
+                            r.get("extraFilter")),
+                        table=req.get("tableName", ""),
+                        workload=req.get("workload", "primary"))
+                    frames = await asyncio.wrap_future(fut)
+                    for frame in frames:
+                        writer.write(_LEN.pack(len(frame)) + frame)
+                        await writer.drain()
+                    writer.write(_LEN.pack(0))  # EOS
+                    await writer.drain()
+                    continue
                 fut = self.scheduler.submit(
                     lambda r=req: self.executor.execute(
                         r["tableName"], r["sql"], r.get("segments"),
@@ -219,8 +281,38 @@ class ServerConnection:
                 sock.sendall(_LEN.pack(len(payload)) + payload)
                 return self._read_frame(sock)
 
+    def request_streaming(self, table_name: str, sql: str,
+                          segments: Optional[List[str]] = None,
+                          request_id: int = 0,
+                          extra_filter: Optional[str] = None):
+        """Generator of per-block DataTable payloads until the server's
+        zero-length EOS frame (ref GrpcQueryServer server-stream). The
+        channel lock is held for the whole stream — frames of one query
+        must not interleave with another request's."""
+        payload = json.dumps({
+            "requestId": request_id, "tableName": table_name, "sql": sql,
+            "segments": segments, "extraFilter": extra_filter,
+            "streaming": True}).encode()
+        with self._lock:
+            completed = False
+            try:
+                sock = self._connect()
+                sock.sendall(_LEN.pack(len(payload)) + payload)
+                while True:
+                    frame = self._read_frame(sock, allow_empty=True)
+                    if not frame:
+                        completed = True
+                        return  # EOS
+                    yield frame
+            finally:
+                if not completed:
+                    # consumer aborted (or the read failed) mid-stream:
+                    # unread frames would poison the next request on this
+                    # channel — drop it and let request() re-dial
+                    self.close()
+
     @staticmethod
-    def _read_frame(sock: socket.socket) -> bytes:
+    def _read_frame(sock: socket.socket, allow_empty: bool = False) -> bytes:
         hdr = b""
         while len(hdr) < 4:
             chunk = sock.recv(4 - len(hdr))

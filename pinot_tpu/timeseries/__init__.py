@@ -1,0 +1,22 @@
+"""Time-series query engine SPI + the builtin 'simpleql' language.
+
+Reference parity: pinot-timeseries (pinot-timeseries-spi: TimeBuckets,
+TimeSeriesBlock, BaseTimeSeriesPlanNode, TimeSeriesLogicalPlanner;
+pinot-timeseries-planner; language plugins under
+pinot-plugins/pinot-timeseries-lang, e.g. the m3ql pipe language).
+Languages register through the plugin registry (kind 'timeseries_lang')
+and plan into the shared node tree executed by engine.execute_plan.
+"""
+from pinot_tpu.timeseries.spi import (BaseTimeSeriesPlanNode,
+                                      LeafTimeSeriesPlanNode,
+                                      TimeBuckets, TimeSeries,
+                                      TimeSeriesBlock,
+                                      TimeSeriesAggregationNode,
+                                      TimeSeriesTransformNode,
+                                      get_language, register_language)
+from pinot_tpu.timeseries.engine import execute_plan, query
+
+__all__ = ["TimeBuckets", "TimeSeries", "TimeSeriesBlock",
+           "BaseTimeSeriesPlanNode", "LeafTimeSeriesPlanNode",
+           "TimeSeriesAggregationNode", "TimeSeriesTransformNode",
+           "register_language", "get_language", "execute_plan", "query"]

@@ -1,0 +1,191 @@
+"""Time-series plan execution + the builtin 'simpleql' pipe language.
+
+Reference parity: pinot-timeseries-planner executing the SPI plan tree
+over the leaf engine, and pinot-plugins/pinot-timeseries-lang/
+pinot-timeseries-m3ql's pipe syntax. The builtin language:
+
+    fetch(table, metric, time_col, start, end, step)
+      [ | where(<sql predicate>) ]
+      [ | groupby(tag1, tag2) ]
+      [ | sum() | avg() | min() | max() ]        # cross-series, drop tags
+      [ | sum(tag) ... ]                          # cross-series, keep tags
+      [ | keep_last_value() | scale(x) | rate() ] # per-series transforms
+
+Leaf fetches ride the regular query engine (SQL GROUP BY over the time
+bucket + tags — device offload included when the engine supports the
+shape), so the TSDB layer adds no second storage path.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from pinot_tpu.timeseries.spi import (BaseTimeSeriesPlanNode,
+                                      LeafTimeSeriesPlanNode, TimeBuckets,
+                                      TimeSeries, TimeSeriesAggregationNode,
+                                      TimeSeriesBlock,
+                                      TimeSeriesTransformNode,
+                                      register_language)
+
+
+def execute_plan(node: BaseTimeSeriesPlanNode, executor) -> TimeSeriesBlock:
+    """executor: a query executor with .execute(sql) (QueryExecutor or a
+    broker handler) — the leaf bridge (ref LeafTimeSeriesPlanNode)."""
+    if isinstance(node, LeafTimeSeriesPlanNode):
+        return _execute_leaf(node, executor)
+    if isinstance(node, TimeSeriesAggregationNode):
+        return _aggregate(execute_plan(node.child, executor), node)
+    if isinstance(node, TimeSeriesTransformNode):
+        return _transform(execute_plan(node.child, executor), node)
+    raise ValueError(f"unknown plan node {type(node).__name__}")
+
+
+def _execute_leaf(node: LeafTimeSeriesPlanNode, executor) -> TimeSeriesBlock:
+    b = node.buckets
+    bucket_expr = (f"floor(({node.time_column} - {b.start}) / {b.step})")
+    tags = list(node.group_by_tags)
+    select = [bucket_expr] + tags + [
+        f"{node.value_agg}({node.metric_column})"]
+    where = (f"{node.time_column} >= {b.start} AND "
+             f"{node.time_column} < {b.end}")
+    if node.filter_sql:
+        where += f" AND ({node.filter_sql})"
+    group = ", ".join([bucket_expr] + tags)
+    sql = (f"SELECT {', '.join(select)} FROM {node.table} "
+           f"WHERE {where} GROUP BY {group} "
+           f"LIMIT {b.count * 10_000}")
+    resp = executor.execute(sql)
+    if getattr(resp, "exceptions", None):
+        raise RuntimeError(f"leaf query failed: {resp.exceptions}")
+    rows = resp.result_table.rows if hasattr(resp, "result_table") and \
+        resp.result_table is not None else resp.rows
+    series: Dict[Tuple, TimeSeries] = {}
+    for row in rows:
+        bucket = int(row[0])
+        if not 0 <= bucket < b.count:
+            continue
+        tag_vals = row[1:1 + len(tags)]
+        val = float(row[1 + len(tags)])
+        key = tuple(tag_vals)
+        s = series.get(key)
+        if s is None:
+            s = series[key] = TimeSeries(
+                tags=dict(zip(tags, tag_vals)),
+                values=np.full(b.count, np.nan))
+        s.values[bucket] = val
+    return TimeSeriesBlock(b, list(series.values()))
+
+
+def _aggregate(block: TimeSeriesBlock,
+               node: TimeSeriesAggregationNode) -> TimeSeriesBlock:
+    groups: Dict[Tuple, List[TimeSeries]] = {}
+    for s in block.series:
+        key = tuple((t, s.tags.get(t)) for t in node.by_tags)
+        groups.setdefault(key, []).append(s)
+    out = []
+    for key, members in groups.items():
+        stack = np.vstack([m.values for m in members])
+        with np.errstate(all="ignore"):
+            if node.agg == "sum":
+                vals = np.nansum(stack, axis=0)
+                vals[np.all(np.isnan(stack), axis=0)] = np.nan
+            elif node.agg == "avg":
+                vals = np.nanmean(stack, axis=0)
+            elif node.agg == "min":
+                vals = np.nanmin(stack, axis=0)
+            elif node.agg == "max":
+                vals = np.nanmax(stack, axis=0)
+            else:
+                raise ValueError(f"unknown series agg {node.agg!r}")
+        out.append(TimeSeries(tags=dict(key), values=vals))
+    return TimeSeriesBlock(block.buckets, out)
+
+
+def _transform(block: TimeSeriesBlock,
+               node: TimeSeriesTransformNode) -> TimeSeriesBlock:
+    out = []
+    for s in block.series:
+        v = s.values.copy()
+        if node.fn == "keep_last_value":
+            last = np.nan
+            for i in range(len(v)):
+                if np.isnan(v[i]):
+                    v[i] = last
+                else:
+                    last = v[i]
+        elif node.fn == "scale":
+            v = v * (node.arg if node.arg is not None else 1.0)
+        elif node.fn == "rate":
+            # per-second first derivative over the bucket step
+            dv = np.diff(v, prepend=np.nan)
+            v = dv / block.buckets.step
+        else:
+            raise ValueError(f"unknown transform {node.fn!r}")
+        out.append(TimeSeries(tags=dict(s.tags), values=v))
+    return TimeSeriesBlock(block.buckets, out)
+
+
+# ---------------------------------------------------------------------------
+# builtin 'simpleql' pipe language (the m3ql-plugin analog)
+# ---------------------------------------------------------------------------
+
+_STAGE_RX = re.compile(r"(\w+)\s*\(([^)]*)\)\s*$")
+
+
+def _parse_simpleql(text: str, _ctx=None) -> BaseTimeSeriesPlanNode:
+    stages = [s.strip() for s in text.split("|")]
+    m = _STAGE_RX.match(stages[0])
+    if m is None or m.group(1) != "fetch":
+        raise ValueError("simpleql must start with fetch(table, metric, "
+                         "time_col, start, end, step)")
+    args = [a.strip() for a in m.group(2).split(",")]
+    if len(args) != 6:
+        raise ValueError("fetch needs 6 arguments")
+    table, metric, time_col = args[0], args[1], args[2]
+    start, end, step = int(args[3]), int(args[4]), int(args[5])
+    count = max((end - start) // step, 1)
+    buckets = TimeBuckets(start, step, count)
+    group_tags: Tuple[str, ...] = ()
+    filter_sql: Optional[str] = None
+    plan_stages = []
+    for raw in stages[1:]:
+        m = _STAGE_RX.match(raw)
+        if m is None:
+            raise ValueError(f"bad simpleql stage {raw!r}")
+        name = m.group(1)
+        args = [a.strip() for a in m.group(2).split(",") if a.strip()]
+        if name == "where":
+            filter_sql = m.group(2).strip()
+        elif name == "groupby":
+            group_tags = tuple(args)
+        else:
+            plan_stages.append((name, args))
+    node: BaseTimeSeriesPlanNode = LeafTimeSeriesPlanNode(
+        table=table, metric_column=metric, time_column=time_col,
+        buckets=buckets, group_by_tags=group_tags, filter_sql=filter_sql)
+    for name, args in plan_stages:
+        if name in ("sum", "avg", "min", "max"):
+            node = TimeSeriesAggregationNode(node, agg=name,
+                                             by_tags=tuple(args))
+        elif name in ("keep_last_value", "rate"):
+            node = TimeSeriesTransformNode(node, fn=name)
+        elif name == "scale":
+            node = TimeSeriesTransformNode(
+                node, fn="scale", arg=float(args[0]) if args else 1.0)
+        else:
+            raise ValueError(f"unknown simpleql stage {name!r}")
+    return node
+
+
+register_language("simpleql", _parse_simpleql)
+
+
+def query(text: str, executor, language: str = "simpleql"
+          ) -> TimeSeriesBlock:
+    """Parse + execute a time-series query (the TSDB entry point, ref
+    the time-series broker request handler)."""
+    from pinot_tpu.timeseries.spi import get_language
+    planner = get_language(language)
+    return execute_plan(planner(text, None), executor)

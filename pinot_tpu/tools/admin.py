@@ -207,6 +207,8 @@ def main(argv=None) -> int:
     sc.add_argument("--port", type=int, default=9000)
     sc.add_argument("--deep-store", default=None,
                     help="deep-store base URI (e.g. file:///data/store)")
+    sc.add_argument("--http-port", type=int, default=None,
+                    help="controller REST API port (disabled when unset)")
     sc.set_defaults(fn=cmd_start_controller)
 
     sst = sub.add_parser("StartStreamServer",
@@ -251,7 +253,8 @@ def main(argv=None) -> int:
 def cmd_start_controller(args) -> int:
     from pinot_tpu.cluster.roles import run_controller
     run_controller(args.state_dir, port=args.port,
-                   deep_store_uri=args.deep_store)
+                   deep_store_uri=args.deep_store,
+                   http_port=getattr(args, "http_port", None))
     return 0
 
 

@@ -1,0 +1,137 @@
+"""Time-series engine SPI + streaming response plane.
+
+Ref: pinot-timeseries (spi/planner + m3ql language plugin),
+core/transport/grpc/GrpcQueryServer.java:65 + StreamingReduceService —
+VERDICT r4 missing #4/#8.
+"""
+import numpy as np
+import pytest
+
+from pinot_tpu.models import (DataType, FieldSpec, FieldType, Schema,
+                              TableConfig)
+from pinot_tpu.query.executor import QueryExecutor
+from pinot_tpu.segment.creator import SegmentCreator
+from pinot_tpu.segment.loader import load_segment
+from pinot_tpu.timeseries import TimeBuckets, query
+
+
+@pytest.fixture(scope="module")
+def metrics_seg(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("tsdb")
+    schema = Schema("metrics", [
+        FieldSpec("ts", DataType.INT, FieldType.DIMENSION),
+        FieldSpec("host", DataType.STRING, FieldType.DIMENSION),
+        FieldSpec("cpu", DataType.INT, FieldType.METRIC)])
+    tc = TableConfig(name="metrics")
+    # two hosts, 100 seconds of per-second points; host b misses 40-59
+    rows = []
+    for t in range(100):
+        rows.append((t, "a", t))
+        if not 40 <= t < 60:
+            rows.append((t, "b", 2 * t))
+    cols = {"ts": np.array([r[0] for r in rows]),
+            "host": np.array([r[1] for r in rows], object),
+            "cpu": np.array([r[2] for r in rows])}
+    out = str(tmp / "s0")
+    SegmentCreator(tc, schema).build(cols, out, "s0")
+    return load_segment(out)
+
+
+class TestTimeSeries:
+    def test_buckets(self):
+        b = TimeBuckets(0, 10, 10)
+        assert b.end == 100
+        assert b.index_of(np.array([0, 9, 10, 99, 100])).tolist() == \
+            [0, 0, 1, 9, -1]
+
+    def test_fetch_groupby(self, metrics_seg):
+        ex = QueryExecutor([metrics_seg], use_tpu=False)
+        block = query("fetch(metrics, cpu, ts, 0, 100, 10) "
+                      "| groupby(host)", ex)
+        assert block.buckets.count == 10
+        by = {s.tags["host"]: s for s in block.series}
+        # host a bucket 0 = sum(0..9) = 45
+        assert by["a"].values[0] == 45
+        # host b bucket 4/5 have no data
+        assert np.isnan(by["b"].values[4]) and np.isnan(by["b"].values[5])
+        assert by["b"].values[0] == 90
+
+    def test_cross_series_sum_and_transforms(self, metrics_seg):
+        ex = QueryExecutor([metrics_seg], use_tpu=False)
+        block = query("fetch(metrics, cpu, ts, 0, 100, 10) "
+                      "| groupby(host) | sum()", ex)
+        assert len(block.series) == 1
+        v = block.series[0].values
+        assert v[0] == 45 + 90          # both hosts
+        assert v[4] == sum(range(40, 50))  # host a only (b gap)
+        # keep_last_value fills gaps per series
+        block2 = query("fetch(metrics, cpu, ts, 0, 100, 10) "
+                       "| groupby(host) | keep_last_value()", ex)
+        by = {s.tags["host"]: s for s in block2.series}
+        assert by["b"].values[4] == by["b"].values[3]
+        # scale
+        block3 = query("fetch(metrics, cpu, ts, 0, 100, 10) | sum() "
+                       "| scale(0.5)", ex)
+        assert block3.series[0].values[0] == (45 + 90) / 2
+
+    def test_where_filter(self, metrics_seg):
+        ex = QueryExecutor([metrics_seg], use_tpu=False)
+        block = query("fetch(metrics, cpu, ts, 0, 100, 10) "
+                      "| where(host = 'a') | sum()", ex)
+        assert block.series[0].values[0] == 45
+
+    def test_language_registry(self):
+        from pinot_tpu.timeseries import get_language
+        assert get_language("simpleql") is not None
+        with pytest.raises(KeyError):
+            get_language("promql")
+
+
+class TestStreamingPlane:
+    def test_server_streams_blocks_and_broker_reduces(self, tmp_path):
+        from pinot_tpu.broker.request_handler import \
+            StreamingBrokerRequestHandler
+        from pinot_tpu.broker.routing import (BrokerRoutingManager,
+                                              RoutingTable, SegmentInfo,
+                                              TableRoute)
+        from pinot_tpu.server.data_manager import InstanceDataManager
+        from pinot_tpu.server.query_server import (QueryServer,
+                                                   ServerConnection,
+                                                   ServerQueryExecutor)
+        schema = Schema("big", [
+            FieldSpec("id", DataType.INT, FieldType.DIMENSION)])
+        tc = TableConfig(name="big")
+        dm = InstanceDataManager("s0")
+        creator = SegmentCreator(tc, schema)
+        route = TableRoute("big_OFFLINE")
+        n_segs = 10
+        for i in range(n_segs):
+            out = str(tmp_path / f"seg{i}")
+            creator.build({"id": np.arange(100) + i * 100}, out, f"big_{i}")
+            dm.table("big_OFFLINE").add_segment(load_segment(out))
+            route.segments[f"big_{i}"] = SegmentInfo(f"big_{i}", ["s0"])
+        server = QueryServer(ServerQueryExecutor(dm, use_tpu=False))
+        server.start()
+        try:
+            conn = ServerConnection(server.host, server.port)
+            # raw stream: multiple frames then EOS
+            frames = list(conn.request_streaming(
+                "big_OFFLINE", "SELECT id FROM big LIMIT 10000", None))
+            assert len(frames) >= 3  # ceil(10 segs / 4 per chunk)
+
+            routing = BrokerRoutingManager()
+            rt = RoutingTable()
+            rt.offline = route
+            routing.set_route("big", rt)
+            handler = StreamingBrokerRequestHandler(
+                routing, {"s0": ServerConnection(server.host, server.port)})
+            resp = handler.handle_streaming(
+                "SELECT id FROM big ORDER BY id LIMIT 5")
+            # order-by falls back to buffered path but still answers
+            assert [r[0] for r in resp.result_table.rows] == [0, 1, 2, 3, 4]
+            resp2 = handler.handle_streaming("SELECT id FROM big LIMIT 7")
+            assert len(resp2.result_table.rows) == 7
+            assert not resp2.exceptions
+            assert getattr(resp2, "num_streamed_blocks", 0) >= 3
+        finally:
+            server.stop()
