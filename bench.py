@@ -44,8 +44,39 @@ DATA_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "bench_data"
 QUERY = ("SELECT SUM(lo_extendedprice * lo_discount), COUNT(*) FROM ssb "
          "WHERE lo_orderdate BETWEEN 19940101 AND 19940131 "
          "AND lo_discount BETWEEN 4 AND 6 AND lo_quantity BETWEEN 26 AND 35")
-#: bytes the kernel reads per row: 3 int dict-id planes + 2 f32 value planes
-BYTES_PER_ROW = 5 * 4
+#: bytes the kernel reads per row with cardinality-aware id staging:
+#: i8 discount ids + i16 orderdate ids + i8 quantity ids + 2 f32 values
+#: (the engine reports the ACTUAL staged bytes at runtime; this is the
+#: fallback for the derived GB/s when introspection fails)
+BYTES_PER_ROW = 1 + 2 + 1 + 4 + 4
+
+
+def measure_device_kernel(ex, segments, iters: int = 20):
+    """Direct steady-state kernel timing (device only — no link, no host
+    assembly): the number VERDICT r4 asked for (device_time_ms) plus the
+    actual staged bytes so GB/s is measured, not modeled."""
+    import jax
+
+    from pinot_tpu.ops import kernels as _k
+    from pinot_tpu.query.context import QueryContext
+    eng = ex.tpu_engine
+    ctx = QueryContext.from_sql(QUERY)
+    with eng._engine_lock:
+        plan_info = eng._plan(segments, ctx)
+        if plan_info is None:
+            return None, None
+        plan, _slots = plan_info
+        cols, params, num_docs, _S, D, G = eng._stage(segments, ctx, plan)
+        kern = _k.compiled_kernel(plan)
+    jax.block_until_ready(kern(cols, params, num_docs, D=D, G=G))
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(iters):
+        out = kern(cols, params, num_docs, D=D, G=G)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+    nbytes = sum(v.nbytes for v in cols.values())
+    return dt, nbytes
 
 
 def build_data():
@@ -176,7 +207,11 @@ def main():
     # this bench host has few cores (often 1) — threads can't speed numpy
     # up there, so the honest host baseline is whichever config is fastest
     host_best = max(cpu1_rps, cpu8_rps)
-    eff_gbps = rows_per_sec * BYTES_PER_ROW / 1e9
+    dev_dt, staged_bytes = measure_device_kernel(tpu_ex, segments)
+    if staged_bytes is None:
+        staged_bytes = total_rows * BYTES_PER_ROW
+    eff_gbps = staged_bytes / 1e9 / pipe_dt
+    dev_gbps = staged_bytes / 1e9 / dev_dt if dev_dt else 0.0
     out = {
         "metric": "ssb_q1_scan_agg_rows_per_sec_per_chip",
         "value": round(rows_per_sec),
@@ -192,6 +227,15 @@ def main():
         "link_rt_ms": round(measure_link_rt_ms(), 1),
         "effective_gbps": round(eff_gbps, 1),
         "roofline_frac_v5e": round(eff_gbps / 819.0, 3),
+        # device-only steady-state kernel (no link/host costs): with
+        # cardinality-aware i8/i16 id staging the kernel reads ~40% fewer
+        # bytes and is now VPU-COMPUTE-bound (mask evaluation + exact-sum
+        # planes), not HBM-bound — GB/s understates the win; rows/s is
+        # the honest headline
+        "device_time_ms": round(dev_dt * 1e3, 2) if dev_dt else None,
+        "device_rows_per_sec": round(total_rows / dev_dt) if dev_dt else None,
+        "device_gbps": round(dev_gbps, 1),
+        "staged_bytes_per_row": round(staged_bytes / total_rows, 1),
         "host_rows_per_sec_8t": round(cpu8_rps),
         "host_rows_per_sec_1t": round(cpu1_rps),
         "vs_host_1t": round(rows_per_sec / cpu1_rps, 2),

@@ -96,15 +96,22 @@ class BrokerRequestHandler:
                 except (SqlParseError, ValueError):
                     return _error_response(
                         150, f"SQLParsingError: {e}", start)
+                # MSE queries are NOT a quota bypass: meter the root table
+                root = getattr(getattr(parsed, "from_item", None),
+                               "table", None)
+                if root and not self._check_quota(root):
+                    return _error_response(
+                        429, f"QuotaExceededError: table {root} is over "
+                             f"its QPS quota", start)
                 return self.mse_dispatcher.submit(sql, parsed)
             return _error_response(150, f"SQLParsingError: {e}", start)
-        if self.mse_dispatcher is not None and \
-                query.options.get("useMultistageEngine", "").lower() == "true":
-            return self.mse_dispatcher.submit(sql)
         if not self._check_quota(ctx.table):
             return _error_response(
                 429, f"QuotaExceededError: table {ctx.table} is over its "
                      f"QPS quota", start)
+        if self.mse_dispatcher is not None and \
+                query.options.get("useMultistageEngine", "").lower() == "true":
+            return self.mse_dispatcher.submit(sql)
         route = self.routing.get_route(ctx.table)
         if route is None:
             return _error_response(
@@ -215,9 +222,13 @@ class StreamingMixin:
         start = time.time()
         try:
             ctx = QueryContext.from_sql(sql)
-        except (SqlParseError, ValueError) as e:
-            return _error_response(150, f"SQLParsingError: {e}", start)
-        if ctx.aggregations or ctx.group_by or ctx.distinct or ctx.order_by:
+        except (SqlParseError, ValueError):
+            # joins/subqueries: same MSE delegation as the buffered path
+            return self.handle(sql)
+        if ctx.aggregations or ctx.group_by or ctx.distinct \
+                or ctx.order_by \
+                or ctx.options.get("useMultistageEngine",
+                                   "").lower() == "true":
             return self.handle(sql)
         if not self._check_quota(ctx.table):
             return _error_response(

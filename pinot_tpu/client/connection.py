@@ -55,7 +55,19 @@ class Connection:
         """Run SQL (with optional %(name)s parameter substitution — values
         are SQL-escaped client-side) and raise on broker exceptions."""
         if params:
-            sql = sql % {k: _quote(v) for k, v in params.items()}
+            # token-targeted replacement, NOT the % operator: a literal %
+            # in the SQL (LIKE '%x%', modulo) must never be interpreted
+            # as a format spec
+            import re as _re
+            quoted = {k: _quote(v) for k, v in params.items()}
+
+            def _sub(m):
+                key = m.group(1)
+                if key not in quoted:
+                    raise PinotClientError(f"missing parameter {key!r}")
+                return quoted[key]
+
+            sql = _re.sub(r"%\((\w+)\)s", _sub, sql)
         req = urllib.request.Request(
             f"{self.base}/query/sql",
             data=json.dumps({"sql": sql}).encode(),

@@ -73,8 +73,9 @@ class ControllerHttpServer:
                 if method == "GET" and path == "/health":
                     return self._reply(200, {"status": "OK"})
                 if path == "/tables" and method == "GET":
-                    return self._reply(
-                        200, {"tables": sorted(api.state.tables)})
+                    with api.state._lock:
+                        names = sorted(api.state.tables)
+                    return self._reply(200, {"tables": names})
                 if path == "/tables" and method == "POST":
                     body = self._body()
                     cfg = TableConfig.from_dict(body["tableConfig"])
@@ -90,9 +91,10 @@ class ControllerHttpServer:
                         api.state.add_table(cfg, schema)
                     return self._reply(200, {"status": f"added {cfg.name}"})
                 if path == "/instances" and method == "GET":
-                    return self._reply(200, {
-                        "instances": {k: vars(v).copy() for k, v in
-                                      api.state.instances.items()}})
+                    with api.state._lock:
+                        insts = {k: vars(v).copy() for k, v in
+                                 api.state.instances.items()}
+                    return self._reply(200, {"instances": insts})
                 m = re.fullmatch(r"/tables/([^/]+)", path)
                 if m:
                     name = m.group(1)
@@ -118,11 +120,13 @@ class ControllerHttpServer:
                     name = m.group(1)
                     if method == "GET":
                         out = {}
-                        for suffix in ("_OFFLINE", "_REALTIME"):
-                            segs = api.state.segments.get(name + suffix)
-                            if segs:
-                                out[name + suffix] = {
-                                    n: s.to_dict() for n, s in segs.items()}
+                        with api.state._lock:
+                            for suffix in ("_OFFLINE", "_REALTIME"):
+                                segs = api.state.segments.get(name + suffix)
+                                if segs:
+                                    out[name + suffix] = {
+                                        n: s.to_dict()
+                                        for n, s in segs.items()}
                         return self._reply(200, out)
                     if method == "POST":
                         body = self._body()

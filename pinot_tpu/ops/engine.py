@@ -432,15 +432,21 @@ class TpuOperatorExecutor:
             # the scalar so the function still sees its 'sum' slot.
             # Grouped sums stay f32 (scalar-slot packing) — documented
             # approximation.
-            exact_int_sum = (
-                not ctx.group_by
-                and arg_ir is not None
-                and not jax.config.read("jax_enable_x64")
-                and self._int_ir_bounds(segments, arg_ir) is not None)
+            int_bounds = None
+            if not ctx.group_by and arg_ir is not None \
+                    and not jax.config.read("jax_enable_x64"):
+                int_bounds = self._int_ir_bounds(segments, arg_ir)
             mapping = {}
             for op in spec_ops:
-                if op == "sum" and exact_int_sum:
-                    op_key = "isum"
+                if op == "sum" and int_bounds is not None:
+                    lo_b, hi_b = int_bounds
+                    if lo_b >= 0:
+                        # non-negative: fewer, wider unsigned planes
+                        planes = max(
+                            1, (max(hi_b, 1).bit_length() + 6) // 7)
+                        op_key = f"isum:u{planes}"
+                    else:
+                        op_key = "isum"
                     key = (op_key, vidx, fidx)
                     if key not in slot_index:
                         slot_index[key] = len(agg_ops)
@@ -750,9 +756,20 @@ class TpuOperatorExecutor:
         vdt = np.float64 if jax.config.read("jax_enable_x64") else np.float32
 
         for col in plan.dict_cols:
+            # cardinality-aware id width: HBM bandwidth is the roofline,
+            # so an 11-value dictionary column reads 4x fewer bytes as i8
+            # (SURVEY §7 hard-parts: pick per-column by bit width)
+            card = max(s.metadata.columns[col].cardinality
+                       for s in segments)
+            if card <= 127:
+                idt = np.int8
+            elif card <= 32767:
+                idt = np.int16
+            else:
+                idt = np.int32
             cols["ids:" + col] = self._stacked(
-                segments, S, D, col, "ids",
-                lambda ds: ds.dict_ids().astype(np.int32), np.int32)
+                segments, S, D, col, f"ids{np.dtype(idt).itemsize}",
+                lambda ds, _t=idt: ds.dict_ids().astype(_t), idt)
         for col in plan.raw_cols:
             self._check_value_precision(segments, col, vdt)
             cols["val:" + col] = self._stacked(
@@ -1216,8 +1233,13 @@ class TpuOperatorExecutor:
                     for op, j in mapping.items():
                         off = 1 + slot_offsets[j]
                         w = widths[j]
-                        if plan.agg_ops[j][0] == "isum":
+                        plan_op = plan.agg_ops[j][0]
+                        if plan_op == "isum":
                             slots[op] = _isum_value(packed[s, off:off + w])
+                            continue
+                        if plan_op.startswith("isum:u"):
+                            slots[op] = _isum_u_value(
+                                packed[s, off:off + w])
                             continue
                         slots[op] = packed[s, off] if w == 1 \
                             else packed[s, off:off + w]
@@ -1274,6 +1296,16 @@ def _isum_value(planes: np.ndarray) -> float:
     for k in range(kernels.ISUM_PLANES):
         s = int(planes[2 * k]) * 4096 + int(planes[2 * k + 1])
         total += s << (6 * k)
+    return float(total)
+
+
+def _isum_u_value(planes: np.ndarray) -> float:
+    """Rebuild the exact non-negative int sum from unsigned 7-bit plane
+    halves (kernels._isum_u_slot)."""
+    total = 0
+    for k in range(len(planes) // 2):
+        s = int(planes[2 * k]) * 4096 + int(planes[2 * k + 1])
+        total += s << (kernels.ISUM_U_BITS * k)
     return float(total)
 
 

@@ -55,12 +55,17 @@ def _eval_filter(node, plan: DevicePlan, cols: Dict[str, jnp.ndarray],
     leaf = plan.leaves[i]
     if leaf.kind == "range":
         ids = cols["ids:" + leaf.column]
-        lo = params[f"leaf{i}:lo"][:, None]
-        hi = params[f"leaf{i}:hi"][:, None]
+        lo = _clamp_to(params[f"leaf{i}:lo"], ids.dtype)[:, None]
+        hi = _clamp_to(params[f"leaf{i}:hi"], ids.dtype)[:, None]
         return (ids >= lo) & (ids <= hi)
     if leaf.kind == "neq":
         ids = cols["ids:" + leaf.column]
-        return ids != params[f"leaf{i}:idx"][:, None]
+        idx = params[f"leaf{i}:idx"]
+        if ids.dtype != idx.dtype:
+            # -1 and in-range ids fit any narrow id dtype
+            idx = jnp.clip(idx, jnp.iinfo(ids.dtype).min,
+                           jnp.iinfo(ids.dtype).max).astype(ids.dtype)
+        return ids != idx[:, None]
     if leaf.kind == "lut":
         ids = cols["ids:" + leaf.column]
         table = params[f"leaf{i}:lut"]  # [S, C] bool
@@ -83,6 +88,16 @@ def _eval_filter(node, plan: DevicePlan, cols: Dict[str, jnp.ndarray],
         le = (vhi < b_hi) | ((vhi == b_hi) & (vlo <= b_lo))
         return ge & le
     raise ValueError(f"unknown leaf kind {leaf.kind}")
+
+
+def _clamp_to(arr, dtype):
+    """Compare-bound params clamp into a narrow id dtype so comparisons
+    run at the block's native width (an out-of-range sentinel like
+    2^31-1 clamps to 'matches everything', preserving semantics)."""
+    if arr.dtype == dtype:
+        return arr
+    info = jnp.iinfo(dtype)
+    return jnp.clip(arr, info.min, info.max).astype(dtype)
 
 
 def _eval_value(ir, cols: Dict[str, jnp.ndarray],
@@ -229,6 +244,8 @@ def slot_width(op: str) -> int:
         return int(op.split(":")[1])
     if op == "isum":
         return ISUM_WIDTH
+    if op.startswith("isum:u"):
+        return 2 * int(op.split(":")[1][1:])
     return 1
 
 
@@ -280,6 +297,27 @@ def _isum_slot(vi, mv) -> jnp.ndarray:
             p = vi >> jnp.int32(30)  # signed top digit
         s = jnp.sum(p, axis=1, dtype=jnp.int32)
         parts.append((s >> jnp.int32(12)).astype(dt))  # signed hi half
+        parts.append((s & jnp.int32(4095)).astype(dt))
+    return jnp.stack(parts, axis=1)
+
+
+#: unsigned isum digit width: 127 * 2^24 docs < 2^31, so 7-bit planes are
+#: i32-safe at the engine's doc cap while needing ceil(bits/7) planes —
+#: fewer shift+mask+sum passes than the signed 6x6 scheme
+ISUM_U_BITS = 7
+
+
+def _isum_u_slot(op: str, vi, mv) -> jnp.ndarray:
+    """Non-negative exact SUM: ceil(bits/7) unsigned planes (plan-time
+    bounds prove the value fits), same f32-exact (hi, lo) halves."""
+    planes = int(op.split(":")[1][1:])
+    vi = jnp.where(mv, vi, 0)
+    dt = _value_dtype()
+    parts = []
+    for k in range(planes):
+        p = (vi >> jnp.int32(ISUM_U_BITS * k)) & jnp.int32(127)
+        s = jnp.sum(p, axis=1, dtype=jnp.int32)
+        parts.append((s >> jnp.int32(12)).astype(dt))
         parts.append((s & jnp.int32(4095)).astype(dt))
     return jnp.stack(parts, axis=1)
 
@@ -380,6 +418,10 @@ def _compute_slots(plan: DevicePlan, cols, params, valid, G: int = 0):
         if op == "isum":
             vi = _eval_value_int(plan.value_irs[vidx], cols)
             slots.append((op, _isum_slot(vi, m & valid)))
+            continue
+        if op.startswith("isum:u"):
+            vi = _eval_value_int(plan.value_irs[vidx], cols)
+            slots.append((op, _isum_u_slot(op, vi, m & valid)))
             continue
         vals = None if vidx is None else values[vidx]
         slots.append((op, _masked_reduce(op, vals, m, valid)))

@@ -97,12 +97,13 @@ class ServerQueryExecutor:
 
     def execute_streaming(self, table_name: str, sql_or_ctx,
                           segments: Optional[List[str]] = None,
-                          extra_filter: Optional[str] = None) -> List[bytes]:
+                          extra_filter: Optional[str] = None):
         """Per-block response frames for large results (ref
         GrpcQueryServer's streaming Submit + StreamingInstanceResponse
-        PlanNode): segments execute in chunks, each chunk serializing to
-        its own DataTable frame so neither side materializes the full
-        result. Returns the frame list (the transport streams them)."""
+        PlanNode): a GENERATOR — each segment chunk executes and
+        serializes lazily as the transport consumes it, so the server
+        never materializes the full result and the first frame ships
+        while later chunks still compute."""
         try:
             ctx = (sql_or_ctx if isinstance(sql_or_ctx, QueryContext)
                    else QueryContext.from_sql(sql_or_ctx))
@@ -114,12 +115,12 @@ class ServerQueryExecutor:
                     else func("and", ctx.filter, extra)
             tdm = self.data_manager.table(table_name, create=False)
             if tdm is None:
-                return [datatable.serialize_results(
+                yield datatable.serialize_results(
                     [], [{"errorCode": 190,
-                          "message": f"table {table_name} not found"}])]
+                          "message": f"table {table_name} not found"}])
+                return
             sdms = tdm.acquire_segments(segments)
             try:
-                frames = []
                 chunk = self.STREAM_CHUNK_SEGMENTS
                 segs = [s.segment for s in sdms]
                 for i in range(0, max(len(segs), 1), chunk):
@@ -127,15 +128,14 @@ class ServerQueryExecutor:
                                        use_tpu=self.use_tpu,
                                        engine=self._shared_engine())
                     results, prune_stats = ex.execute_context(ctx)
-                    frames.append(datatable.serialize_results(
-                        results, extra_stats=prune_stats))
-                return frames
+                    yield datatable.serialize_results(
+                        results, extra_stats=prune_stats)
             finally:
                 TableDataManager.release_all(sdms)
         except Exception as e:  # noqa: BLE001
-            return [datatable.serialize_results(
+            yield datatable.serialize_results(
                 [], [{"errorCode": 200,
-                      "message": f"{type(e).__name__}: {e}"}])]
+                      "message": f"{type(e).__name__}: {e}"}])
 
 
 class QueryServer:
@@ -168,16 +168,22 @@ class QueryServer:
                 req = json.loads(payload)
                 if req.get("streaming"):
                     # per-block response stream (ref GrpcQueryServer.Submit
-                    # server-stream): one DataTable frame per segment
-                    # chunk, then a zero-length EOS frame
+                    # server-stream): each frame computes lazily in the
+                    # worker pool and ships immediately — first byte out
+                    # while later chunks still execute; zero-length EOS
                     fut = self.scheduler.submit(
                         lambda r=req: self.executor.execute_streaming(
                             r["tableName"], r["sql"], r.get("segments"),
                             r.get("extraFilter")),
                         table=req.get("tableName", ""),
                         workload=req.get("workload", "primary"))
-                    frames = await asyncio.wrap_future(fut)
-                    for frame in frames:
+                    gen = await asyncio.wrap_future(fut)
+                    loop = asyncio.get_running_loop()
+                    while True:
+                        frame = await loop.run_in_executor(
+                            self._pool, lambda: next(gen, None))
+                        if frame is None:
+                            break
                         writer.write(_LEN.pack(len(frame)) + frame)
                         await writer.drain()
                     writer.write(_LEN.pack(0))  # EOS

@@ -53,14 +53,20 @@ def _execute_leaf(node: LeafTimeSeriesPlanNode, executor) -> TimeSeriesBlock:
     if node.filter_sql:
         where += f" AND ({node.filter_sql})"
     group = ", ".join([bucket_expr] + tags)
+    limit = b.count * 10_000
     sql = (f"SELECT {', '.join(select)} FROM {node.table} "
            f"WHERE {where} GROUP BY {group} "
-           f"LIMIT {b.count * 10_000}")
+           f"LIMIT {limit}")
     resp = executor.execute(sql)
     if getattr(resp, "exceptions", None):
         raise RuntimeError(f"leaf query failed: {resp.exceptions}")
     rows = resp.result_table.rows if hasattr(resp, "result_table") and \
         resp.result_table is not None else resp.rows
+    if len(rows) >= limit:
+        # silent truncation would make downstream sums wrong — fail loud
+        raise RuntimeError(
+            f"leaf fetch hit the {limit}-group cap (too many tag "
+            f"combinations); narrow the filter or group by fewer tags")
     series: Dict[Tuple, TimeSeries] = {}
     for row in rows:
         bucket = int(row[0])
