@@ -96,13 +96,13 @@ class BrokerRequestHandler:
                 except (SqlParseError, ValueError):
                     return _error_response(
                         150, f"SQLParsingError: {e}", start)
-                # MSE queries are NOT a quota bypass: meter the root table
-                root = getattr(getattr(parsed, "from_item", None),
-                               "table", None)
-                if root and not self._check_quota(root):
-                    return _error_response(
-                        429, f"QuotaExceededError: table {root} is over "
-                             f"its QPS quota", start)
+                # MSE queries are NOT a quota bypass: meter EVERY table
+                # the tree reads (set operands + subquery roots included)
+                for t in _mse_tables(parsed):
+                    if not self._check_quota(t):
+                        return _error_response(
+                            429, f"QuotaExceededError: table {t} is over "
+                                 f"its QPS quota", start)
                 return self.mse_dispatcher.submit(sql, parsed)
             return _error_response(150, f"SQLParsingError: {e}", start)
         if not self._check_quota(ctx.table):
@@ -201,6 +201,32 @@ class BrokerRequestHandler:
         resp.num_servers_responded = responded
         resp.time_used_ms = (time.time() - start) * 1000.0
         return resp
+
+
+def _mse_tables(parsed) -> set:
+    """All physical table names an MSE query tree reads (from items,
+    joins, subqueries, set operands) — the quota surface."""
+    out: set = set()
+
+    def walk(q):
+        if q is None:
+            return
+        for attr in ("left", "right"):  # MseSetQuery operands
+            walk(getattr(q, attr, None))
+        fi = getattr(q, "from_item", None)
+        if fi is not None:
+            if getattr(fi, "table", None):
+                out.add(fi.table)
+            walk(getattr(fi, "subquery", None))
+        for j in getattr(q, "joins", []) or []:
+            item = getattr(j, "item", None) or getattr(j, "from_item", None)
+            if item is not None:
+                if getattr(item, "table", None):
+                    out.add(item.table)
+                walk(getattr(item, "subquery", None))
+
+    walk(parsed)
+    return out
 
 
 def _error_response(code: int, message: str, start: float) -> BrokerResponse:

@@ -37,9 +37,16 @@ def run_controller(state_dir: str, port: int = 0, host: str = "127.0.0.1",
     from pinot_tpu.controller.coordination import CoordinationServer
     from pinot_tpu.controller.maintenance import run_retention
 
+    from pinot_tpu.utils.config import PinotConfiguration
+    cfg = PinotConfiguration()
     state = ClusterState(persist_dir=state_dir)
     server = CoordinationServer(state, host=host, port=port,
-                                deep_store_uri=deep_store_uri)
+                                deep_store_uri=deep_store_uri
+                                or cfg.get_str(
+                                    "pinot.controller.deep.store.uri")
+                                or None)
+    server.LIVENESS_TTL_S = cfg.get_float(
+        "pinot.coordination.liveness.ttl.seconds")
     server.start()
     rest = None
     if http_port is not None:
@@ -52,10 +59,12 @@ def run_controller(state_dir: str, port: int = 0, host: str = "127.0.0.1",
     if ready_event is not None:
         ready_event.set()
     stop = stop_event or threading.Event()
+    retention_every = cfg.get_float(
+        "pinot.controller.retention.frequency.seconds")
     last_maintenance = time.time()
     try:
         while not stop.wait(1.0):
-            if time.time() - last_maintenance > 60:
+            if time.time() - last_maintenance > retention_every:
                 last_maintenance = time.time()
                 try:
                     run_retention(state)
@@ -73,20 +82,27 @@ class ServerRole:
     def __init__(self, instance_id: str, coordinator: str,
                  query_port: int = 0, host: str = "127.0.0.1",
                  use_tpu: bool = False,
-                 download_dir: Optional[str] = None):
+                 download_dir: Optional[str] = None,
+                 config=None):
         import tempfile
 
         from pinot_tpu.server.data_manager import InstanceDataManager
         from pinot_tpu.server.query_server import (
             QueryServer, ServerQueryExecutor)
+        from pinot_tpu.utils.config import PinotConfiguration
 
+        cfg = config or PinotConfiguration()
+        self.config = cfg
         self.instance_id = instance_id
         self.client = CoordinationClient(coordinator)
         self.data_manager = InstanceDataManager(instance_id)
         self.executor = ServerQueryExecutor(self.data_manager,
-                                            use_tpu=use_tpu)
-        self.transport = QueryServer(self.executor, host=host,
-                                     port=query_port)
+                                            use_tpu=use_tpu, config=cfg)
+        self.transport = QueryServer(
+            self.executor, host=host,
+            port=query_port or cfg.get_int("pinot.server.query.port"),
+            num_threads=cfg.get_int("pinot.server.query.num.threads"),
+            scheduler=cfg.get_str("pinot.server.query.scheduler"))
         #: local cache for deep-store segment downloads — deterministic
         #: per instance so restarts REUSE extracted copies instead of
         #: leaking a fresh tempdir per process lifetime
@@ -312,11 +328,11 @@ class ServerRole:
 
 
 def run_server(instance_id: str, coordinator: str, query_port: int = 0,
-               use_tpu: bool = False,
+               use_tpu: bool = False, config=None,
                ready_event: Optional[threading.Event] = None,
                stop_event: Optional[threading.Event] = None) -> None:
     role = ServerRole(instance_id, coordinator, query_port=query_port,
-                      use_tpu=use_tpu)
+                      use_tpu=use_tpu, config=config)
     role.start()
     print(f"server {instance_id} listening on "
           f"{role.transport.host}:{role.transport.port}", flush=True)
@@ -337,22 +353,26 @@ class BrokerRole:
     """One broker process: HTTP edge + routing rebuilt from watches."""
 
     def __init__(self, coordinator: str, http_port: int = 0,
-                 host: str = "127.0.0.1"):
+                 host: str = "127.0.0.1", config=None):
+        from pinot_tpu.broker.adaptive import AdaptiveServerSelector
         from pinot_tpu.broker.http_api import BrokerHttpServer
+        from pinot_tpu.broker.quota import QueryQuotaManager
         from pinot_tpu.broker.request_handler import BrokerRequestHandler
         from pinot_tpu.broker.routing import BrokerRoutingManager
         from pinot_tpu.server.query_server import ServerConnection
+        from pinot_tpu.utils.config import PinotConfiguration
 
-        from pinot_tpu.broker.adaptive import AdaptiveServerSelector
-        from pinot_tpu.broker.quota import QueryQuotaManager
-
+        cfg = config or PinotConfiguration()
         self.client = CoordinationClient(coordinator)
         self.routing = BrokerRoutingManager(
-            selector=AdaptiveServerSelector())
+            selector=AdaptiveServerSelector(
+                mode=cfg.get_str("pinot.broker.adaptive.selector")))
         self.connections: Dict[str, ServerConnection] = {}
         self.quotas = QueryQuotaManager()
-        self.handler = BrokerRequestHandler(self.routing, self.connections,
-                                            quota_manager=self.quotas)
+        self.handler = BrokerRequestHandler(
+            self.routing, self.connections,
+            max_fanout_threads=cfg.get_int("pinot.broker.fanout.threads"),
+            quota_manager=self.quotas)
         self.http = BrokerHttpServer(self.handler, host=host, port=http_port)
         self._rebuild_lock = threading.Lock()
 

@@ -60,12 +60,15 @@ MAX_DOCS_PER_SEGMENT = 1 << 24
 
 
 class TpuOperatorExecutor:
-    def __init__(self, devices: Optional[Sequence] = None, mesh=None):
+    def __init__(self, devices: Optional[Sequence] = None, mesh=None,
+                 config=None):
         """mesh: an explicit (segments, docs) jax Mesh — blocks shard over
         BOTH axes and the kernel runs under shard_map with psum/pmin/pmax
         collectives over `docs` (SURVEY §2.6 rows 6-7). Without one, >1
         device gets a segments-only mesh (GSPMD partitions the reductions);
-        one device runs the plain jit kernel."""
+        one device runs the plain jit kernel.
+        config: a PinotConfiguration for the cache budgets (the server
+        passes its instance config through; None reads env/defaults)."""
         self._doc_axis = 1
         if mesh is not None:
             self._mesh = mesh
@@ -95,10 +98,16 @@ class TpuOperatorExecutor:
         self._host_rows: "OrderedDict[tuple, Any]" = OrderedDict()
         self._host_bytes = 0
         import os as _os
+
+        from pinot_tpu.utils.config import PinotConfiguration
+        _cfg = config or PinotConfiguration()
+        # legacy short env names still win for compatibility
         self.host_budget_bytes = int(_os.environ.get(
-            "PINOT_TPU_HOST_ROW_CACHE_BYTES", 16 << 30))
+            "PINOT_TPU_HOST_ROW_CACHE_BYTES",
+            _cfg.get_int("pinot.server.host.row.cache.bytes")))
         self.cache_budget_bytes = int(_os.environ.get(
-            "PINOT_TPU_HBM_CACHE_BYTES", 8 << 30))
+            "PINOT_TPU_HBM_CACHE_BYTES",
+            _cfg.get_int("pinot.server.hbm.cache.bytes")))
         #: staging lock only: cache mutation (plan/stage/evict) serializes,
         #: but kernel dispatch + result fetch run OUTSIDE it so concurrent
         #: queries overlap their device round trips (the host<->TPU link

@@ -35,9 +35,17 @@ class ServerQueryExecutor:
     """Ref ServerQueryExecutorV1Impl: executes one query over this server's
     segments for a table."""
 
-    def __init__(self, data_manager: InstanceDataManager, use_tpu: bool = True):
+    def __init__(self, data_manager: InstanceDataManager, use_tpu: bool = True,
+                 config=None):
         self.data_manager = data_manager
         self.use_tpu = use_tpu
+        #: instance config (PinotConfiguration); threads through to the
+        #: device engine's cache budgets and the streaming chunk size
+        self.config = config
+        if config is not None:
+            self.STREAM_CHUNK_SEGMENTS = config.get_int(
+                "pinot.server.stream.chunk.segments",
+                self.STREAM_CHUNK_SEGMENTS)
         #: ONE engine for the server's lifetime — it owns the HBM block
         #: cache, which must survive across requests
         self._engine = None
@@ -49,7 +57,7 @@ class ServerQueryExecutor:
         with self._engine_lock:
             if self._engine is None:
                 from pinot_tpu.ops.engine import TpuOperatorExecutor
-                self._engine = TpuOperatorExecutor()
+                self._engine = TpuOperatorExecutor(config=self.config)
             return self._engine
 
     def execute(self, table_name: str, sql_or_ctx,
@@ -168,20 +176,19 @@ class QueryServer:
                 req = json.loads(payload)
                 if req.get("streaming"):
                     # per-block response stream (ref GrpcQueryServer.Submit
-                    # server-stream): each frame computes lazily in the
-                    # worker pool and ships immediately — first byte out
-                    # while later chunks still execute; zero-length EOS
-                    fut = self.scheduler.submit(
-                        lambda r=req: self.executor.execute_streaming(
-                            r["tableName"], r["sql"], r.get("segments"),
-                            r.get("extraFilter")),
-                        table=req.get("tableName", ""),
-                        workload=req.get("workload", "primary"))
-                    gen = await asyncio.wrap_future(fut)
-                    loop = asyncio.get_running_loop()
+                    # server-stream): generator creation is cheap; EACH
+                    # frame's execution is its own scheduler submission so
+                    # priority/binary-workload accounting still throttles
+                    # streaming work, and frames ship as they compute
+                    gen = self.executor.execute_streaming(
+                        req["tableName"], req["sql"], req.get("segments"),
+                        req.get("extraFilter"))
                     while True:
-                        frame = await loop.run_in_executor(
-                            self._pool, lambda: next(gen, None))
+                        fut = self.scheduler.submit(
+                            lambda g=gen: next(g, None),
+                            table=req.get("tableName", ""),
+                            workload=req.get("workload", "primary"))
+                        frame = await asyncio.wrap_future(fut)
                         if frame is None:
                             break
                         writer.write(_LEN.pack(len(frame)) + frame)

@@ -54,15 +54,17 @@ def _execute_leaf(node: LeafTimeSeriesPlanNode, executor) -> TimeSeriesBlock:
         where += f" AND ({node.filter_sql})"
     group = ", ".join([bucket_expr] + tags)
     limit = b.count * 10_000
+    # fetch limit+1 so exactly-limit results are distinguishable from
+    # truncation
     sql = (f"SELECT {', '.join(select)} FROM {node.table} "
            f"WHERE {where} GROUP BY {group} "
-           f"LIMIT {limit}")
+           f"LIMIT {limit + 1}")
     resp = executor.execute(sql)
     if getattr(resp, "exceptions", None):
         raise RuntimeError(f"leaf query failed: {resp.exceptions}")
     rows = resp.result_table.rows if hasattr(resp, "result_table") and \
         resp.result_table is not None else resp.rows
-    if len(rows) >= limit:
+    if len(rows) > limit:
         # silent truncation would make downstream sums wrong — fail loud
         raise RuntimeError(
             f"leaf fetch hit the {limit}-group cap (too many tag "

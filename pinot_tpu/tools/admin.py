@@ -224,6 +224,8 @@ def main(argv=None) -> int:
     ss.add_argument("--tpu", action="store_true")
     ss.add_argument("--plugins-dir", default=None,
                     help="directory of plugin modules to load at startup")
+    ss.add_argument("--config", default=None,
+                    help="instance .properties file (PinotConfiguration)")
     ss.set_defaults(fn=cmd_start_server)
 
     sb = sub.add_parser("StartBroker", help="HTTP broker joined to a "
@@ -276,12 +278,14 @@ def cmd_start_stream_server(args) -> int:
 def cmd_start_server(args) -> int:
     from pinot_tpu.cluster.roles import run_server
     from pinot_tpu.utils import plugins
+    from pinot_tpu.utils.config import PinotConfiguration
     plugins.load_builtin_plugins()
     if getattr(args, "plugins_dir", None):
         loaded = plugins.load_plugin_dir(args.plugins_dir)
         print(f"loaded plugins: {loaded}", flush=True)
+    cfg = PinotConfiguration(getattr(args, "config", None))
     run_server(args.instance_id, args.coordinator,
-               query_port=args.query_port, use_tpu=args.tpu)
+               query_port=args.query_port, use_tpu=args.tpu, config=cfg)
     return 0
 
 

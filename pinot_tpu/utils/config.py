@@ -1,0 +1,110 @@
+"""Instance configuration: layered properties + env overrides.
+
+Reference parity: pinot-spi env/PinotConfiguration.java (commons-config
+over properties files with relaxed env-var overrides) + the
+CommonConstants key catalog (utils/CommonConstants.java — all config
+keys in one place). Precedence, highest first:
+
+  1. explicit overrides passed to the constructor
+  2. environment variables: `pinot.server.query.port` reads
+     `PINOT_TPU_SERVER_QUERY_PORT` (relaxed upper-snake mapping)
+  3. a java-style .properties file (key=value, '#' comments)
+  4. catalog defaults (KEYS below)
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+ENV_PREFIX = "PINOT_TPU_"
+
+#: the CommonConstants analog — every tunable in one catalog with its
+#: default (subsystems read through a PinotConfiguration, not os.environ)
+KEYS: Dict[str, Any] = {
+    "pinot.server.query.port": 0,
+    "pinot.server.query.num.threads": 8,
+    "pinot.server.query.scheduler": "fcfs",     # fcfs | priority | binary
+    "pinot.server.stream.chunk.segments": 4,
+    "pinot.server.hbm.cache.bytes": 8 << 30,
+    "pinot.server.host.row.cache.bytes": 16 << 30,
+    "pinot.broker.http.port": 8099,
+    "pinot.broker.fanout.threads": 16,
+    "pinot.broker.adaptive.selector": "hybrid",  # latency|inflight|hybrid
+    "pinot.controller.port": 9000,
+    "pinot.controller.deep.store.uri": "",
+    "pinot.controller.retention.frequency.seconds": 60,
+    "pinot.coordination.liveness.ttl.seconds": 15.0,
+}
+
+
+def _env_name(key: str) -> str:
+    # 'pinot.server.query.port' -> PINOT_TPU_SERVER_QUERY_PORT (the
+    # shared 'pinot.' prefix folds into the env prefix)
+    if key.startswith("pinot."):
+        key = key[len("pinot."):]
+    return ENV_PREFIX + key.replace(".", "_").upper()
+
+
+class PinotConfiguration:
+    def __init__(self, properties_file: Optional[str] = None,
+                 overrides: Optional[Dict[str, Any]] = None):
+        self._file: Dict[str, str] = {}
+        if properties_file:
+            self._file = load_properties(properties_file)
+        self._overrides = dict(overrides or {})
+
+    # ------------------------------------------------------------------
+    def get(self, key: str, default: Any = None) -> Any:
+        if key in self._overrides:
+            return self._overrides[key]
+        env = os.environ.get(_env_name(key))
+        if env is not None:
+            return env
+        if key in self._file:
+            return self._file[key]
+        if key in KEYS:
+            return KEYS[key]
+        return default
+
+    def get_int(self, key: str, default: int = 0) -> int:
+        return int(self.get(key, default))
+
+    def get_float(self, key: str, default: float = 0.0) -> float:
+        return float(self.get(key, default))
+
+    def get_bool(self, key: str, default: bool = False) -> bool:
+        v = self.get(key, default)
+        if isinstance(v, bool):
+            return v
+        return str(v).strip().lower() in ("1", "true", "yes", "on")
+
+    def get_str(self, key: str, default: str = "") -> str:
+        return str(self.get(key, default))
+
+    def subset(self, prefix: str) -> Dict[str, Any]:
+        """All effective keys under a dotted prefix (catalog + file +
+        overrides; env consulted per key)."""
+        if not prefix.endswith("."):
+            prefix += "."
+        names = {k for k in KEYS if k.startswith(prefix)}
+        names |= {k for k in self._file if k.startswith(prefix)}
+        names |= {k for k in self._overrides if k.startswith(prefix)}
+        return {k[len(prefix):]: self.get(k) for k in sorted(names)}
+
+
+def load_properties(path: str) -> Dict[str, str]:
+    """Minimal java .properties reader (key=value / key: value)."""
+    out: Dict[str, str] = {}
+    with open(path) as f:
+        for raw in f:
+            line = raw.strip()
+            if not line or line.startswith(("#", "!")):
+                continue
+            # split at the FIRST occurrence of either separator (java
+            # .properties semantics — 'k: a=b' must not split at '=')
+            cuts = [i for i in (line.find("="), line.find(":")) if i >= 0]
+            if not cuts:
+                continue
+            i = min(cuts)
+            out[line[:i].strip()] = line[i + 1:].strip()
+    return out

@@ -165,3 +165,52 @@ class TestControllerRest:
         finally:
             rest.stop()
             coord.stop()
+
+
+class TestPinotConfiguration:
+    def test_layering(self, tmp_path, monkeypatch):
+        from pinot_tpu.utils.config import KEYS, PinotConfiguration
+        props = tmp_path / "server.properties"
+        props.write_text("# instance config\n"
+                         "pinot.server.query.scheduler=priority\n"
+                         "pinot.server.query.num.threads: 4\n")
+        cfg = PinotConfiguration(str(props))
+        # file beats catalog default
+        assert cfg.get_str("pinot.server.query.scheduler") == "priority"
+        assert cfg.get_int("pinot.server.query.num.threads") == 4
+        # catalog default when unset anywhere
+        assert cfg.get_int("pinot.broker.http.port") == 8099
+        # env beats file (relaxed name mapping)
+        monkeypatch.setenv("PINOT_TPU_SERVER_QUERY_SCHEDULER", "binary")
+        assert cfg.get_str("pinot.server.query.scheduler") == "binary"
+        # explicit overrides beat env
+        cfg2 = PinotConfiguration(
+            str(props),
+            overrides={"pinot.server.query.scheduler": "fcfs"})
+        assert cfg2.get_str("pinot.server.query.scheduler") == "fcfs"
+        # subset view
+        sub = cfg.subset("pinot.server.query.")
+        assert int(sub["num.threads"]) == 4
+        assert set(KEYS) >= {"pinot.server.query.port"}
+
+    def test_bools_and_missing(self):
+        from pinot_tpu.utils.config import PinotConfiguration
+        cfg = PinotConfiguration(
+            overrides={"x.flag": "Yes", "y.flag": "0"})
+        assert cfg.get_bool("x.flag") is True
+        assert cfg.get_bool("y.flag") is False
+        assert cfg.get("not.a.key", "dflt") == "dflt"
+
+    def test_server_scheduler_from_config(self):
+        from pinot_tpu.server.data_manager import InstanceDataManager
+        from pinot_tpu.server.query_server import (QueryServer,
+                                                   ServerQueryExecutor)
+        from pinot_tpu.server.scheduler import make_scheduler
+        srv = QueryServer(
+            ServerQueryExecutor(InstanceDataManager("x"), use_tpu=False),
+            scheduler="priority", num_threads=2)
+        try:
+            assert type(srv.scheduler) is type(
+                make_scheduler("priority", 2))
+        finally:
+            srv.scheduler.stop()
