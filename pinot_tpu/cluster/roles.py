@@ -30,15 +30,17 @@ log = logging.getLogger(__name__)
 
 def run_controller(state_dir: str, port: int = 0, host: str = "127.0.0.1",
                    deep_store_uri: Optional[str] = None,
-                   http_port: Optional[int] = None,
+                   http_port: Optional[int] = None, config=None,
                    ready_event: Optional[threading.Event] = None,
                    stop_event: Optional[threading.Event] = None) -> None:
     from pinot_tpu.controller.cluster_state import ClusterState
     from pinot_tpu.controller.coordination import CoordinationServer
     from pinot_tpu.controller.maintenance import run_retention
-
     from pinot_tpu.utils.config import PinotConfiguration
-    cfg = PinotConfiguration()
+
+    cfg = config or PinotConfiguration()
+    if not port:
+        port = cfg.get_int("pinot.controller.port")
     state = ClusterState(persist_dir=state_dir)
     server = CoordinationServer(state, host=host, port=port,
                                 deep_store_uri=deep_store_uri
@@ -438,10 +440,15 @@ class BrokerRole:
                 self.routing.set_route(logical, rt)
 
 
-def run_broker(coordinator: str, http_port: int = 0,
+def run_broker(coordinator: str, http_port: int = 0, config=None,
                ready_event: Optional[threading.Event] = None,
                stop_event: Optional[threading.Event] = None) -> None:
-    role = BrokerRole(coordinator, http_port=http_port)
+    from pinot_tpu.utils.config import PinotConfiguration
+    cfg = config or PinotConfiguration()
+    role = BrokerRole(coordinator,
+                      http_port=http_port
+                      or cfg.get_int("pinot.broker.http.port"),
+                      config=cfg)
     role.start()
     print(f"broker http on 127.0.0.1:{role.http.port}", flush=True)
     if ready_event is not None:

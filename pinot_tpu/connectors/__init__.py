@@ -1,0 +1,14 @@
+"""Data-frame connectors: bulk load/read between frameworks and tables.
+
+Reference parity: pinot-connectors (Spark 2/3 DataSource, Flink sink) —
+the ecosystem bridges. Python's dataframe ecosystem is pandas/pyarrow,
+so the connector surface here is:
+
+    from pinot_tpu.connectors import pandas_connector as pc
+    pc.write_dataframe(df, table_config, schema, out_dir)   # -> segments
+    pc.upload_dataframe(df, cfg, schema, client[, store])   # -> cluster
+    df = pc.read_sql("SELECT ...", broker="host:port")      # -> DataFrame
+"""
+from pinot_tpu.connectors import pandas_connector
+
+__all__ = ["pandas_connector"]

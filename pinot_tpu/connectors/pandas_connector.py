@@ -1,0 +1,77 @@
+"""pandas connector: DataFrame -> segments -> cluster, and SQL -> DataFrame.
+
+Reference parity: pinot-connectors/pinot-spark-3-connector (write path:
+partition the frame, build segments, push to the controller; read path:
+query through the broker into the engine's native frame type). pandas is
+the Python ecosystem's dataframe, so it plays Spark's role here. Imports
+of pandas are deferred — the connector is optional, like the reference's
+plugin jars.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+from pinot_tpu.models import Schema, TableConfig
+
+
+def write_dataframe(df, table_config: TableConfig, schema: Schema,
+                    out_dir: str, rows_per_segment: Optional[int] = None,
+                    segment_prefix: Optional[str] = None) -> List[str]:
+    """Build segment directories from a DataFrame (the Spark connector's
+    write path without the push). Returns the segment dirs."""
+    from pinot_tpu.segment.creator import SegmentCreator
+    creator = SegmentCreator(table_config, schema)
+    n = len(df)
+    per = rows_per_segment or max(n, 1)
+    prefix = segment_prefix or table_config.name
+    out: List[str] = []
+    field_names = [f.name for f in schema.fields if not f.virtual]
+    for i, start in enumerate(range(0, max(n, 1), per)):
+        part = df.iloc[start:start + per]
+        cols = {c: part[c].to_numpy() for c in field_names
+                if c in part.columns}
+        seg_dir = os.path.join(out_dir, f"{prefix}_{i}")
+        creator.build(cols, seg_dir, f"{prefix}_{i}")
+        out.append(seg_dir)
+    return out
+
+
+def upload_dataframe(df, table_config: TableConfig, schema: Schema,
+                     client, out_dir: str,
+                     rows_per_segment: Optional[int] = None,
+                     deep_store=None) -> List[dict]:
+    """write_dataframe + register every segment with the coordination
+    client (ref the connector's controller push); with a deep_store the
+    tars upload there and servers fetch via PinotFS."""
+    client.add_table(table_config, schema)
+    dirs = write_dataframe(df, table_config, schema, out_dir,
+                           rows_per_segment)
+    out = []
+    for d in dirs:
+        if deep_store is not None:
+            out.append(client.upload_segment_to_store(
+                table_config.name, d, deep_store))
+        else:
+            out.append(client.upload_segment(table_config.name, d))
+    return out
+
+
+def read_sql(sql: str, broker: str, timeout: float = 60.0):
+    """Query through the broker into a DataFrame (the read path)."""
+    import pandas as pd
+
+    from pinot_tpu.client import connect
+    rs = connect(broker, timeout=timeout).execute(sql)
+    return pd.DataFrame(rs.rows, columns=rs.columns)
+
+
+def from_segments(segments, sql: str):
+    """Local (embedded) read: run SQL over loaded segments -> DataFrame
+    (useful in notebooks without a cluster)."""
+    import pandas as pd
+
+    from pinot_tpu.query.executor import QueryExecutor
+    resp = QueryExecutor(list(segments), use_tpu=False).execute(sql)
+    table = resp.result_table
+    return pd.DataFrame(table.rows, columns=table.columns)

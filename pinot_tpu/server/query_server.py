@@ -20,7 +20,6 @@ import socket
 import struct
 import threading
 import time
-from concurrent.futures import ThreadPoolExecutor
 from typing import List, Optional
 
 from pinot_tpu.query.context import QueryContext
@@ -43,9 +42,10 @@ class ServerQueryExecutor:
         #: device engine's cache budgets and the streaming chunk size
         self.config = config
         if config is not None:
+            # the catalog default applies whenever a config is present
+            # (the class attribute only backs config-less construction)
             self.STREAM_CHUNK_SEGMENTS = config.get_int(
-                "pinot.server.stream.chunk.segments",
-                self.STREAM_CHUNK_SEGMENTS)
+                "pinot.server.stream.chunk.segments")
         #: ONE engine for the server's lifetime — it owns the HBM block
         #: cache, which must survive across requests
         self._engine = None
@@ -160,7 +160,6 @@ class QueryServer:
         #: fcfs | priority | binary); owns the query worker threads
         self.scheduler = make_scheduler(scheduler, num_threads)
         self.scheduler.start()
-        self._pool = ThreadPoolExecutor(max_workers=num_threads)
         self._server: Optional[asyncio.AbstractServer] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._thread: Optional[threading.Thread] = None
@@ -252,7 +251,6 @@ class QueryServer:
         if self._thread is not None:
             self._thread.join(timeout=5)
         self.scheduler.stop()
-        self._pool.shutdown(wait=False)
 
 
 class ServerConnection:

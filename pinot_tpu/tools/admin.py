@@ -209,6 +209,8 @@ def main(argv=None) -> int:
                     help="deep-store base URI (e.g. file:///data/store)")
     sc.add_argument("--http-port", type=int, default=None,
                     help="controller REST API port (disabled when unset)")
+    sc.add_argument("--config", default=None,
+                    help="instance .properties file (PinotConfiguration)")
     sc.set_defaults(fn=cmd_start_controller)
 
     sst = sub.add_parser("StartStreamServer",
@@ -232,6 +234,8 @@ def main(argv=None) -> int:
                                             "controller")
     sb.add_argument("--coordinator", required=True, help="host:port")
     sb.add_argument("--http-port", type=int, default=0)
+    sb.add_argument("--config", default=None,
+                    help="instance .properties file (PinotConfiguration)")
     sb.set_defaults(fn=cmd_start_broker)
 
     at = sub.add_parser("AddTable", help="register table config + schema "
@@ -254,9 +258,11 @@ def main(argv=None) -> int:
 
 def cmd_start_controller(args) -> int:
     from pinot_tpu.cluster.roles import run_controller
+    from pinot_tpu.utils.config import PinotConfiguration
     run_controller(args.state_dir, port=args.port,
                    deep_store_uri=args.deep_store,
-                   http_port=getattr(args, "http_port", None))
+                   http_port=getattr(args, "http_port", None),
+                   config=PinotConfiguration(getattr(args, "config", None)))
     return 0
 
 
@@ -291,7 +297,9 @@ def cmd_start_server(args) -> int:
 
 def cmd_start_broker(args) -> int:
     from pinot_tpu.cluster.roles import run_broker
-    run_broker(args.coordinator, http_port=args.http_port)
+    from pinot_tpu.utils.config import PinotConfiguration
+    run_broker(args.coordinator, http_port=args.http_port,
+               config=PinotConfiguration(getattr(args, "config", None)))
     return 0
 
 

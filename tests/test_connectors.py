@@ -1,0 +1,66 @@
+"""pandas connector: DataFrame write/read paths.
+
+Ref: pinot-connectors (Spark DataSource write -> segments -> push; read
+through the broker) — the dataframe-ecosystem bridge.
+"""
+import numpy as np
+import pytest
+
+pd = pytest.importorskip("pandas")
+
+from pinot_tpu.connectors import pandas_connector as pc
+from pinot_tpu.models import (DataType, FieldSpec, FieldType, Schema,
+                              TableConfig)
+from pinot_tpu.segment.loader import load_segment
+
+
+@pytest.fixture()
+def frame():
+    rng = np.random.default_rng(0)
+    return pd.DataFrame({
+        "city": rng.choice(["sf", "nyc", "sea"], size=1000),
+        "sales": rng.integers(0, 100, size=1000)})
+
+
+def _schema():
+    return Schema("s", [
+        FieldSpec("city", DataType.STRING, FieldType.DIMENSION),
+        FieldSpec("sales", DataType.INT, FieldType.METRIC)])
+
+
+class TestPandasConnector:
+    def test_write_and_embedded_read(self, frame, tmp_path):
+        cfg = TableConfig(name="s")
+        dirs = pc.write_dataframe(frame, cfg, _schema(), str(tmp_path),
+                                  rows_per_segment=300)
+        assert len(dirs) == 4  # 1000 rows / 300
+        segs = [load_segment(d) for d in dirs]
+        assert sum(s.num_docs for s in segs) == 1000
+        out = pc.from_segments(
+            segs, "SELECT city, SUM(sales) FROM s GROUP BY city "
+                  "ORDER BY city LIMIT 10")
+        want = frame.groupby("city")["sales"].sum()
+        got = dict(zip(out["city"], out["sum(sales)"]))
+        for city, total in want.items():
+            assert got[city] == float(total)
+
+    def test_upload_and_broker_read(self, frame, tmp_path):
+        from pinot_tpu.controller.cluster_state import (ClusterState,
+                                                        InstanceState)
+        from pinot_tpu.controller.coordination import (CoordinationClient,
+                                                       CoordinationServer)
+        state = ClusterState()
+        state.register_instance(InstanceState("s0"))
+        coord = CoordinationServer(state)
+        coord.start()
+        client = CoordinationClient(coord.address)
+        try:
+            cfg = TableConfig(name="s")
+            res = pc.upload_dataframe(frame, cfg, _schema(), client,
+                                      str(tmp_path), rows_per_segment=500)
+            assert len(res) == 2
+            assert all(r["segment"]["instances"] == ["s0"] for r in res)
+            assert len(state.segments["s_OFFLINE"]) == 2
+        finally:
+            client.close()
+            coord.stop()
