@@ -27,10 +27,26 @@ def write_dataframe(df, table_config: TableConfig, schema: Schema,
     prefix = segment_prefix or table_config.name
     out: List[str] = []
     field_names = [f.name for f in schema.fields if not f.virtual]
-    for i, start in enumerate(range(0, max(n, 1), per)):
+    ing = table_config.ingestion
+    pipeline = None
+    if ing is not None and (ing.transform_configs or ing.filter_function):
+        # configured ingestion transforms/filters apply here exactly as
+        # in run_ingestion_job — the two ingest paths must agree on data
+        from pinot_tpu.ingest.transforms import TransformPipeline
+        pipeline = TransformPipeline(table_config, schema)
+    for i, start in enumerate(range(0, n, per)):
         part = df.iloc[start:start + per]
-        cols = {c: part[c].to_numpy() for c in field_names
-                if c in part.columns}
+        if pipeline is not None:
+            from pinot_tpu.ingest.batch import _rows_to_columns
+            rows = []
+            for rec in part.to_dict("records"):
+                t = pipeline.transform(rec)
+                if t is not None:
+                    rows.append(t)
+            cols = _rows_to_columns(rows, schema)
+        else:
+            cols = {c: part[c].to_numpy() for c in field_names
+                    if c in part.columns}
         seg_dir = os.path.join(out_dir, f"{prefix}_{i}")
         creator.build(cols, seg_dir, f"{prefix}_{i}")
         out.append(seg_dir)

@@ -204,7 +204,8 @@ def main(argv=None) -> int:
     sc = sub.add_parser("StartController",
                         help="coordination service + maintenance loops")
     sc.add_argument("--state-dir", required=True)
-    sc.add_argument("--port", type=int, default=9000)
+    # default 0 = resolve through PinotConfiguration (catalog default 9000)
+    sc.add_argument("--port", type=int, default=0)
     sc.add_argument("--deep-store", default=None,
                     help="deep-store base URI (e.g. file:///data/store)")
     sc.add_argument("--http-port", type=int, default=None,
