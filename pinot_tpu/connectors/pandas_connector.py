@@ -34,22 +34,29 @@ def write_dataframe(df, table_config: TableConfig, schema: Schema,
         # in run_ingestion_job — the two ingest paths must agree on data
         from pinot_tpu.ingest.transforms import TransformPipeline
         pipeline = TransformPipeline(table_config, schema)
-    for i, start in enumerate(range(0, n, per)):
+    seg_i = 0
+    for start in range(0, n, per):
         part = df.iloc[start:start + per]
         if pipeline is not None:
             from pinot_tpu.ingest.batch import _rows_to_columns
+            # pandas encodes missing values as NaN; the pipeline's null
+            # handling expects None (as the CSV/JSON readers produce)
+            part = part.astype(object).where(part.notna(), None)
             rows = []
             for rec in part.to_dict("records"):
                 t = pipeline.transform(rec)
                 if t is not None:
                     rows.append(t)
+            if not rows:
+                continue  # filter dropped the whole chunk: no segment
             cols = _rows_to_columns(rows, schema)
         else:
             cols = {c: part[c].to_numpy() for c in field_names
                     if c in part.columns}
-        seg_dir = os.path.join(out_dir, f"{prefix}_{i}")
-        creator.build(cols, seg_dir, f"{prefix}_{i}")
+        seg_dir = os.path.join(out_dir, f"{prefix}_{seg_i}")
+        creator.build(cols, seg_dir, f"{prefix}_{seg_i}")
         out.append(seg_dir)
+        seg_i += 1
     return out
 
 

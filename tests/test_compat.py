@@ -1,0 +1,63 @@
+"""Format-compatibility verifier: golden segments must stay readable.
+
+Ref: compatibility-verifier/ (compCheck.sh runs old/new versions side by
+side through yaml-scripted ops). Single-language analog: a segment built
+by an EARLIER revision is committed as a fixture
+(tests/golden/golden_segment_v1.tar.gz + its expected answers); every
+future revision must load it and answer identically — a breaking change
+to the on-disk format or query semantics fails here, not in production.
+"""
+import json
+import os
+import tarfile
+
+import pytest
+
+from pinot_tpu.query.executor import QueryExecutor
+from pinot_tpu.segment.loader import load_segment
+
+GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "golden")
+
+
+@pytest.fixture(scope="module")
+def golden_segment(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("golden")
+    with tarfile.open(os.path.join(GOLDEN_DIR,
+                                   "golden_segment_v1.tar.gz")) as tar:
+        tar.extractall(tmp, filter="data")
+    return load_segment(str(tmp / "golden_0"))
+
+
+@pytest.fixture(scope="module")
+def answers():
+    with open(os.path.join(GOLDEN_DIR, "golden_answers.json")) as f:
+        return json.load(f)
+
+
+class TestGoldenCompat:
+    def test_loads_and_answers(self, golden_segment, answers):
+        ex = QueryExecutor([golden_segment], use_tpu=False)
+        r = ex.execute("SELECT COUNT(*), SUM(v) FROM golden")
+        assert r.rows[0] == (answers["count"], float(answers["sum_v"]))
+
+    def test_index_backed_paths(self, golden_segment, answers):
+        ex = QueryExecutor([golden_segment], use_tpu=False)
+        assert ex.execute(
+            "SELECT DISTINCTCOUNT(name) FROM golden"
+        ).rows[0][0] == answers["distinct_names"]
+        assert ex.execute(
+            "SELECT COUNT(*) FROM golden WHERE v > 500"
+        ).rows[0][0] == answers["v_gt_500"]
+        assert ex.execute(
+            "SELECT COUNT(*) FROM golden WHERE "
+            "json_match(tags, '\"k\" = 3')"
+        ).rows[0][0] == answers["json_k3"]
+        assert ex.execute(
+            "SELECT COUNT(*) FROM golden WHERE "
+            "text_match(name, 'alpha')"
+        ).rows[0][0] == answers["count"]
+
+    def test_device_path_agrees(self, golden_segment, answers):
+        dev = QueryExecutor([golden_segment], use_tpu=True)
+        r = dev.execute("SELECT COUNT(*), SUM(v) FROM golden")
+        assert r.rows[0] == (answers["count"], float(answers["sum_v"]))
