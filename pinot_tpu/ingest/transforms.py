@@ -67,17 +67,29 @@ class TransformPipeline:
     def transform(self, record: Dict[str, Any]) -> Optional[Dict[str, Any]]:
         from pinot_tpu.query import transform as texpr
 
-        # 1. filter (ref FilterTransformer): truthy filter result -> DROP
+        # 1. filter (ref FilterTransformer): truthy filter result -> DROP;
+        # a filter over a null input cannot be truthy (SQL three-valued
+        # logic: NULL predicate = not matched = keep the row)
         if self._filter_expr is not None:
-            out = texpr.evaluate(self._filter_expr, _ScalarProvider(record))
+            try:
+                out = texpr.evaluate(self._filter_expr,
+                                     _ScalarProvider(record))
+            except TypeError:
+                out = False
             if bool(np.asarray(out).reshape(-1)[0]):
                 return None
-        # 2. expression transforms (ref ExpressionTransformer)
+        # 2. expression transforms (ref ExpressionTransformer); an
+        # expression over a null input yields null (-> the null default
+        # in step 4), never a crash
         if self._transforms:
             record = dict(record)
             for col, expr in self._transforms:
                 if record.get(col) is None:
-                    out = texpr.evaluate(expr, _ScalarProvider(record))
+                    try:
+                        out = texpr.evaluate(expr, _ScalarProvider(record))
+                    except TypeError:
+                        record[col] = None
+                        continue
                     record[col] = _scalar(out)
         # 3. enrichers
         for fn in self._enrichers:
