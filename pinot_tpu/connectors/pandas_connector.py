@@ -44,7 +44,14 @@ def write_dataframe(df, table_config: TableConfig, schema: Schema,
             part = part.astype(object).where(part.notna(), None)
             rows = []
             for rec in part.to_dict("records"):
-                t = pipeline.transform(rec)
+                try:
+                    t = pipeline.transform(rec)
+                except Exception:  # noqa: BLE001 — poison rows skip+log,
+                    # matching the batch/realtime per-record guards
+                    import logging
+                    logging.getLogger(__name__).exception(
+                        "skipping untransformable record")
+                    continue
                 if t is not None:
                     rows.append(t)
             if not rows:

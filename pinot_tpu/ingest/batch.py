@@ -121,10 +121,21 @@ def run_ingestion_job(spec: IngestionJobSpec) -> List[str]:
     prefix = spec.segment_name_prefix or spec.table_config.name
     out_dirs: List[str] = []
     seq = 0
+    skipped = 0
     for path in files:
         buf: List[Dict[str, Any]] = []
         for rec in read_records(path, spec.input_format):
-            out = pipeline.transform(rec)
+            try:
+                out = pipeline.transform(rec)
+            except Exception:  # noqa: BLE001 — one poison row must not
+                # kill the whole job (the realtime consumer's per-record
+                # guard, mirrored; ref: reference skips + meters bad rows)
+                skipped += 1
+                if skipped <= 10:
+                    import logging
+                    logging.getLogger(__name__).exception(
+                        "skipping untransformable record in %s", path)
+                continue
             if out is not None:
                 buf.append(out)
             if spec.rows_per_segment and len(buf) >= spec.rows_per_segment:

@@ -14,6 +14,7 @@ import numpy as np
 
 from pinot_tpu.models import FieldSpec, Schema, TableConfig
 from pinot_tpu.query.expressions import Expression
+from pinot_tpu.query.expressions import Function as _Fn
 from pinot_tpu.query.parser import _Parser, tokenize
 
 
@@ -64,32 +65,67 @@ class TransformPipeline:
         """Ref recordtransformer/enricher/ (e.g. CLPEncodingEnricher)."""
         self._enrichers.append(fn)
 
+    # functions that legitimately consume nulls — null propagation must
+    # not short-circuit them
+    _NULL_TOLERANT = frozenset(
+        {"coalesce", "case", "is_null", "is_not_null",
+         "json_extract_scalar"})
+
     def transform(self, record: Dict[str, Any]) -> Optional[Dict[str, Any]]:
         from pinot_tpu.query import transform as texpr
 
-        # 1. filter (ref FilterTransformer): truthy filter result -> DROP;
-        # a filter over a null input cannot be truthy (SQL three-valued
-        # logic: NULL predicate = not matched = keep the row)
+        # 0. best-effort numeric coercion for schema fields arriving as
+        # strings (CSV readers deliver text): filters and transforms must
+        # compare numbers, not strings. Unparseable values stay as-is and
+        # surface through the per-record guards.
+        coerced = None
+        for spec in self.schema.fields:
+            v = record.get(spec.name)
+            if isinstance(v, str) and \
+                    spec.data_type.np_dtype.kind in "iuf":
+                try:
+                    conv = spec.data_type.convert(v)
+                except (TypeError, ValueError):
+                    continue
+                if coerced is None:
+                    coerced = record = dict(record)
+                record[spec.name] = conv
+
+        # 1. filter (ref FilterTransformer): truthy filter result -> DROP.
+        # SQL three-valued logic: a SIMPLE predicate over NULL is not
+        # matched (row kept, no evaluation); composed filters (AND/OR/NOT)
+        # still evaluate — 'a = 1 OR b = 2' with b NULL can be TRUE via a —
+        # with null-caused evaluation errors meaning not-matched. Filters
+        # with no null inputs let genuine type errors (MV misconfig)
+        # propagate to the per-record guards.
         if self._filter_expr is not None:
-            try:
-                out = texpr.evaluate(self._filter_expr,
-                                     _ScalarProvider(record))
-            except TypeError:
-                out = False
-            if bool(np.asarray(out).reshape(-1)[0]):
-                return None
-        # 2. expression transforms (ref ExpressionTransformer); an
-        # expression over a null input yields null (-> the null default
-        # in step 4), never a crash
+            refs_null = _references_null(self._filter_expr, record)
+            composed = isinstance(self._filter_expr, _Fn) and \
+                self._filter_expr.name in ("and", "or", "not")
+            if not refs_null or composed:
+                if refs_null:
+                    try:
+                        out = texpr.evaluate(self._filter_expr,
+                                             _ScalarProvider(record))
+                    except TypeError:
+                        out = False  # NULL branch decided: not matched
+                else:
+                    out = texpr.evaluate(self._filter_expr,
+                                         _ScalarProvider(record))
+                if bool(np.asarray(out).reshape(-1)[0]):
+                    return None
+        # 2. expression transforms (ref ExpressionTransformer): SQL null
+        # propagation — an expression whose input column is NULL yields
+        # NULL (-> the type default in step 4) unless the top-level
+        # function is null-tolerant (coalesce/case/is_null)
         if self._transforms:
             record = dict(record)
             for col, expr in self._transforms:
                 if record.get(col) is None:
-                    try:
-                        out = texpr.evaluate(expr, _ScalarProvider(record))
-                    except TypeError:
+                    if _references_null(expr, record):
                         record[col] = None
                         continue
+                    out = texpr.evaluate(expr, _ScalarProvider(record))
                     record[col] = _scalar(out)
         # 3. enrichers
         for fn in self._enrichers:
@@ -117,3 +153,25 @@ def _scalar(v: Any) -> Any:
     arr = np.asarray(v).reshape(-1)
     x = arr[0]
     return x.item() if isinstance(x, np.generic) else x
+
+
+def _references_null(expr, record) -> bool:
+    """True when the expression reads a column that is NULL in this record
+    — SQL null-propagation test. Null-tolerant functions (coalesce/case/
+    is_null) consume DIRECT null column references, but nulls inside
+    their non-trivial sub-expressions still propagate
+    ('coalesce(a, b + 1)' with b NULL is NULL)."""
+    from pinot_tpu.query.expressions import Function, Identifier
+
+    def walk(e) -> bool:
+        if isinstance(e, Identifier):
+            return record.get(e.name) is None
+        if isinstance(e, Function):
+            if e.name in TransformPipeline._NULL_TOLERANT:
+                # the function's own evaluator handles nulls (coalesce
+                # treats a null-propagating argument as missing per-arg)
+                return False
+            return any(walk(a) for a in e.args)
+        return False
+
+    return walk(expr)

@@ -406,19 +406,33 @@ def _json_extract_key(expr: Function, p: ColumnProvider):
     return out
 
 
+def _missing_mask(arr: np.ndarray) -> np.ndarray:
+    """Per-element missing test: NaN for float arrays, None/NaN elements
+    for object arrays (ingestion records carry None, not NaN)."""
+    if arr.dtype.kind == "f":
+        return np.isnan(arr)
+    if arr.dtype.kind == "O":
+        return np.fromiter(
+            (x is None or (isinstance(x, float) and np.isnan(x))
+             for x in arr), dtype=bool, count=len(arr))
+    return np.zeros(len(arr), dtype=bool)
+
+
 def _coalesce(expr: Function, p: ColumnProvider):
     n = p.num_docs
     result = None
     for a in expr.args:
-        v = _broadcast(evaluate(a, p), n)
+        try:
+            v = _broadcast(evaluate(a, p), n)
+        except TypeError:
+            continue  # null-propagating sub-expression: the arg is NULL
         if result is None:
             result = v.copy()
-            if result.dtype.kind == "f":
-                missing = np.isnan(result)
-            else:
-                missing = np.zeros(n, dtype=bool)
         else:
             result = np.where(missing, v, result)
-            if result.dtype.kind == "f":
-                missing &= np.isnan(result)
+        missing = _missing_mask(result)
+        if not missing.any():
+            break
+    if result is None:  # every argument was NULL
+        result = np.full(n, None, dtype=object)
     return result

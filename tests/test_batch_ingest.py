@@ -117,3 +117,47 @@ class TestAdminCli:
         rc = admin.main(["Quickstart", "--rows", "5000", "--no-tpu",
                          "--exit-after-queries", "--port", "0"])
         assert rc == 0
+
+
+class TestNullSemantics:
+    """SQL null handling in the transform pipeline (review round-5):
+    simple predicates over NULL keep the row, OR with a TRUE branch still
+    drops, expressions over NULL yield NULL, coalesce short-circuits."""
+
+    def _pipeline(self, filter_fn=None, transforms=None):
+        from pinot_tpu.ingest.transforms import TransformPipeline
+        from pinot_tpu.models import (DataType, FieldSpec, FieldType,
+                                      Schema, TableConfig)
+        from pinot_tpu.models.table_config import IngestionConfig
+        schema = Schema("t", [
+            FieldSpec("a", DataType.INT, FieldType.DIMENSION),
+            FieldSpec("b", DataType.INT, FieldType.DIMENSION),
+            FieldSpec("c", DataType.INT, FieldType.DIMENSION)])
+        tc = TableConfig(name="t")
+        tc.ingestion = IngestionConfig(
+            filter_function=filter_fn,
+            transform_configs=transforms or [])
+        return TransformPipeline(tc, schema)
+
+    def test_simple_filter_over_null_keeps_row(self):
+        p = self._pipeline(filter_fn="a > 100")
+        assert p.transform({"a": None, "b": 1}) is not None
+        assert p.transform({"a": 200, "b": 1}) is None  # dropped
+
+    def test_or_filter_with_true_branch_drops(self):
+        p = self._pipeline(filter_fn="a = 1 OR b = 2")
+        assert p.transform({"a": 1, "b": None}) is None   # TRUE OR NULL
+        assert p.transform({"a": 3, "b": None}) is not None
+
+    def test_expression_over_null_yields_default(self):
+        p = self._pipeline(transforms=[
+            {"columnName": "c", "transformFunction": "a * 2"}])
+        out = p.transform({"a": None, "b": 0})
+        assert out["c"] is None  # null -> creator default fills
+
+    def test_coalesce_short_circuits_and_propagates(self):
+        p = self._pipeline(transforms=[
+            {"columnName": "c", "transformFunction": "coalesce(a, b + 1)"}])
+        assert p.transform({"a": 7, "b": None})["c"] == 7
+        assert p.transform({"a": None, "b": 4})["c"] == 5
+        assert p.transform({"a": None, "b": None})["c"] is None
