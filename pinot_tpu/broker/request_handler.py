@@ -26,9 +26,19 @@ class BrokerRequestHandler:
                  connections: Dict[str, ServerConnection],
                  max_fanout_threads: int = 16,
                  mse_dispatcher=None, failure_detector=None,
-                 quota_manager=None):
+                 quota_manager=None, config=None, result_cache=None):
         self.routing = routing
         self.connections = connections
+        #: tier-1 whole-result cache (cache/broker_cache.py). Off unless a
+        #: config enables pinot.broker.result.cache.enabled or a built
+        #: cache is injected — failover semantics (a repeated query must
+        #: re-exercise dead servers) are opt-out, not silently cached away.
+        if result_cache is None and config is not None:
+            from pinot_tpu.cache.broker_cache import BrokerResultCache
+            from pinot_tpu.utils.metrics import get_registry
+            result_cache = BrokerResultCache.from_config(
+                config, metrics=get_registry("broker"))
+        self.result_cache = result_cache
         #: per-table QPS limits (ref queryquota/; None = no quotas)
         self.quota_manager = quota_manager
         #: adaptive selector stats feed (routing.selector, may be None)
@@ -117,6 +127,30 @@ class BrokerRequestHandler:
             return _error_response(
                 190, f"TableDoesNotExistError: {ctx.table}", start)
 
+        # -- tier-1 whole-result cache ---------------------------------
+        # keyed by (query fingerprint, table, routing epoch): the epoch
+        # hashes the segment set + versions, so segment add/replace/remove
+        # invalidates by construction. Tables with consuming segments are
+        # skipped unless cache_realtime — appends don't move the epoch.
+        cache_key = None
+        if self.result_cache is not None and self.result_cache.enabled \
+                and not ctx.explain \
+                and ctx.options.get("trace", "").lower() != "true":
+            from pinot_tpu.cache.broker_cache import cache_bypassed
+            if not cache_bypassed(ctx.options) and \
+                    (self.result_cache.cache_realtime
+                     or not route.has_realtime):
+                epoch = route.epoch()
+                if not epoch.startswith("<torn:"):
+                    # a torn epoch never repeats: a get can't hit and a
+                    # put would leak an unaddressable entry — skip both
+                    cache_key = (ctx.fingerprint(), ctx.table, epoch)
+                    hit = self.result_cache.get(*cache_key)
+                    if hit is not None:
+                        hit.cache_hit = True
+                        hit.time_used_ms = (time.time() - start) * 1000.0
+                        return hit
+
         plan = route.route(ctx, unhealthy=self.failure_detector
                            .unhealthy_servers())
         request_id = self._next_id()
@@ -200,6 +234,9 @@ class BrokerRequestHandler:
         resp.num_servers_queried = len(attempted)
         resp.num_servers_responded = responded
         resp.time_used_ms = (time.time() - start) * 1000.0
+        if cache_key is not None:
+            # put() itself refuses partial/errored responses
+            self.result_cache.put(*cache_key, resp)
         return resp
 
 

@@ -8,6 +8,8 @@ tables.
 """
 from __future__ import annotations
 
+import hashlib
+import itertools
 import random
 import threading
 from dataclasses import dataclass, field
@@ -15,6 +17,11 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from pinot_tpu.query.context import QueryContext
 from pinot_tpu.query.expressions import Expression, Function, Identifier, Literal
+
+
+#: never-repeating suffix for epochs computed during a torn (concurrently
+#: mutated) segment-set iteration — see RoutingTable.epoch()
+_torn_epochs = itertools.count(1)
 
 
 @dataclass
@@ -26,6 +33,9 @@ class SegmentInfo:
     num_partitions: int = 0
     start_time: Optional[int] = None         # time-range pruning
     end_time: Optional[int] = None
+    #: segment content version (CRC); feeds the routing epoch so a
+    #: replace-by-name invalidates broker result-cache entries
+    version: int = 0
 
 
 @dataclass
@@ -54,6 +64,50 @@ class RoutingTable:
         self.selector = selector
         self._rr = 0
         self._lock = threading.Lock()
+
+    @property
+    def has_realtime(self) -> bool:
+        return self.realtime is not None and bool(self.realtime.segments)
+
+    def epoch(self) -> str:
+        """Content hash of the result-affecting routing state: per-side
+        segment sets with their versions, plus the hybrid time boundary.
+        Any segment add / replace (version change) / remove or boundary
+        move yields a new epoch, which is how the broker result cache
+        invalidates — stale entries stop being addressable (no explicit
+        purge fan-out, TTL + LRU reclaim the bytes). Replica placement is
+        deliberately EXCLUDED: moving a segment between servers does not
+        change query results.
+
+        Reads race segment-set mutation (routing mutators don't lock the
+        dicts — same read-mostly convention as route()); a torn iteration
+        returns a never-repeating epoch, degrading that one query to a
+        cache miss instead of failing it."""
+        for _ in range(3):
+            try:
+                h = hashlib.sha1()
+                for side in (self.offline, self.realtime):
+                    if side is None:
+                        h.update(b"<none>\0")
+                        continue
+                    h.update(side.table_name.encode())
+                    h.update(b"\0")
+                    for name in sorted(side.segments):
+                        info = side.segments.get(name)
+                        if info is None:
+                            raise RuntimeError("segment set changed")
+                        # NUL-delimited fields: names routinely end in
+                        # digits, so 'day_1'+'2345' must not hash like
+                        # 'day_12'+'345'
+                        h.update(name.encode())
+                        h.update(b"\0")
+                        h.update(str(info.version).encode())
+                        h.update(b"\0")
+                h.update(str(self.time_boundary).encode())
+                return h.hexdigest()
+            except RuntimeError:  # dict resized mid-iteration
+                continue
+        return f"<torn:{id(self)}:{next(_torn_epochs)}>"
 
     def route(self, ctx: QueryContext, unhealthy: Optional[Set[str]] = None
               ) -> List[Tuple[str, str, List[str], Optional[str]]]:

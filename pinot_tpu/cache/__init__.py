@@ -1,0 +1,25 @@
+"""Two-tier query result caching.
+
+Reference parity: the Druid-style split the OLAP world converged on —
+broker whole-result caching (Druid `useResultLevelCache`, Pinot's broker
+response cache proposals) and historical/server per-segment partial
+caching (Druid `populateCache`/`useCache` on immutable segments only).
+Tier 1 (`BrokerResultCache`) memoizes the final BrokerResponse keyed by
+(query fingerprint, table, routing epoch); tier 2 (`SegmentResultCache`)
+memoizes per-segment aggregation/group-by/distinct partials keyed by
+(segment name, segment version, plan fingerprint). Both invalidate by
+version, never by mutation-in-place: a segment add/replace/remove changes
+the key, so stale entries simply stop being addressable and age out via
+TTL + LRU byte pressure.
+"""
+from pinot_tpu.cache.core import CacheStats, LruTtlCache
+from pinot_tpu.cache.broker_cache import BrokerResultCache
+from pinot_tpu.cache.segment_cache import SegmentResultCache, segment_version
+
+__all__ = [
+    "BrokerResultCache",
+    "CacheStats",
+    "LruTtlCache",
+    "SegmentResultCache",
+    "segment_version",
+]

@@ -1,0 +1,95 @@
+"""Tier 1: broker whole-result cache.
+
+Reference parity: Druid's `useResultLevelCache` — memoize the FINAL
+merged response, keyed by (canonical query fingerprint, logical table,
+routing epoch). The epoch is a content hash over the route's segment set
+and per-segment versions (broker/routing.py `RoutingTable.epoch`), so a
+segment add / replace / remove or time-boundary move changes the key and
+stale entries stop being addressable — no explicit invalidation fan-out.
+
+Tables with a realtime side are NOT cached by default: consuming
+segments grow without any routing change, and a whole-result hit would
+hide freshly ingested rows. `cache_realtime=True` opts in for
+append-rare realtime tables that can tolerate TTL-bounded staleness.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Optional
+
+from pinot_tpu.cache.core import (LruTtlCache, cache_bypassed,  # noqa: F401
+                                  dumps, loads)
+from pinot_tpu.query.reduce import BrokerResponse
+
+#: default per-instance metric label — several handlers in one process
+#: (tests run multiple MiniClusters) share the 'broker' registry
+_broker_ids = itertools.count(0)
+
+
+class BrokerResultCache:
+    """Whole BrokerResponse objects keyed by
+    (query fingerprint, table, routing epoch)."""
+
+    def __init__(self, max_bytes: int = 64 << 20, ttl_seconds: float = 60.0,
+                 enabled: bool = True, cache_realtime: bool = False,
+                 metrics=None, labels: Optional[dict] = None):
+        """labels: metric labels (e.g. {'broker': id}) — several broker
+        handlers in one process share the 'broker' registry, so unlabeled
+        gauges would clobber each other."""
+        self.enabled = enabled
+        self.cache_realtime = cache_realtime
+        if metrics is not None and labels is None:
+            labels = {"broker": f"b{next(_broker_ids)}"}
+        self._cache = LruTtlCache(max_bytes, ttl_seconds, metrics=metrics,
+                                  metric_prefix="result_cache",
+                                  labels=labels)
+
+    @classmethod
+    def from_config(cls, config, metrics=None,
+                    labels: Optional[dict] = None) -> "BrokerResultCache":
+        return cls(
+            max_bytes=config.get_int("pinot.broker.result.cache.bytes"),
+            ttl_seconds=config.get_float(
+                "pinot.broker.result.cache.ttl.seconds"),
+            enabled=config.get_bool("pinot.broker.result.cache.enabled"),
+            cache_realtime=config.get_bool(
+                "pinot.broker.result.cache.realtime"),
+            metrics=metrics, labels=labels)
+
+    # ------------------------------------------------------------------
+    def get(self, fingerprint: str, table: str,
+            epoch: str) -> Optional[BrokerResponse]:
+        if not self.enabled:
+            return None
+        payload = self._cache.get((fingerprint, table, epoch))
+        return loads(payload) if payload is not None else None
+
+    def put(self, fingerprint: str, table: str, epoch: str,
+            resp: BrokerResponse) -> bool:
+        """Cache only COMPLETE, clean responses — a partial answer (server
+        error, missing replica) must re-execute next time, not be replayed
+        for a TTL."""
+        if not self.enabled or resp.exceptions or resp.trace is not None \
+                or resp.num_servers_responded != resp.num_servers_queried:
+            return False
+        payload = dumps(resp)
+        if payload is None:
+            return False
+        return self._cache.put((fingerprint, table, epoch), payload)
+
+    def invalidate_table(self, table: str) -> int:
+        return self._cache.invalidate(lambda k: k[1] == table)
+
+    def clear(self) -> None:
+        self._cache.clear()
+
+    @property
+    def stats(self):
+        return self._cache.stats
+
+    @property
+    def size_bytes(self) -> int:
+        return self._cache.size_bytes
+
+    def __len__(self) -> int:
+        return len(self._cache)

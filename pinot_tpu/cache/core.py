@@ -1,0 +1,167 @@
+"""Shared cache mechanics: LRU + TTL + byte budget over opaque payloads.
+
+Both tiers store PICKLED payloads, not live objects: downstream reduce
+code mutates result containers in place (IndexedTable-style merges), so
+handing out a shared object would let one query's merge corrupt the next
+query's cached partial. Serializing on put / deserializing on get makes
+every hit a private copy and gives an honest byte count for the budget.
+"""
+from __future__ import annotations
+
+import pickle
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Any, Callable, Hashable, Optional, Tuple
+
+
+@dataclass
+class CacheStats:
+    hits: int = 0
+    misses: int = 0
+    puts: int = 0
+    evictions: int = 0
+    expirations: int = 0
+    invalidations: int = 0
+
+    @property
+    def hit_ratio(self) -> float:
+        total = self.hits + self.misses
+        return self.hits / total if total else 0.0
+
+
+class LruTtlCache:
+    """Thread-safe LRU over byte payloads with a TTL and a byte budget.
+
+    Keys are arbitrary hashables; values are bytes. Eviction order is
+    least-recently-USED (get refreshes recency). A payload larger than
+    the whole budget is refused rather than evicting everything else.
+    """
+
+    def __init__(self, max_bytes: int, ttl_seconds: float,
+                 metrics=None, metric_prefix: str = "cache",
+                 labels: Optional[dict] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.max_bytes = int(max_bytes)
+        self.ttl_seconds = float(ttl_seconds)
+        self.stats = CacheStats()
+        self._entries: "OrderedDict[Hashable, Tuple[float, bytes]]" = \
+            OrderedDict()
+        self._bytes = 0
+        self._lock = threading.Lock()
+        self._clock = clock
+        #: optional MetricsRegistry; hit/miss/eviction meters + byte gauge
+        self._metrics = metrics
+        self._metric_prefix = metric_prefix
+        self._labels = labels
+
+    # ------------------------------------------------------------------
+    def _meter(self, name: str) -> None:
+        if self._metrics is not None:
+            self._metrics.add_meter(f"{self._metric_prefix}_{name}",
+                                    labels=self._labels)
+
+    def _gauge_bytes(self) -> None:
+        if self._metrics is not None:
+            self._metrics.set_gauge(f"{self._metric_prefix}_bytes",
+                                    self._bytes, labels=self._labels)
+            self._metrics.set_gauge(f"{self._metric_prefix}_entries",
+                                    len(self._entries), labels=self._labels)
+
+    # ------------------------------------------------------------------
+    def get(self, key: Hashable) -> Optional[bytes]:
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                self.stats.misses += 1
+                self._meter("misses")
+                return None
+            expires_at, payload = entry
+            if self._clock() >= expires_at:
+                del self._entries[key]
+                self._bytes -= len(payload)
+                self.stats.expirations += 1
+                self.stats.misses += 1
+                self._meter("misses")
+                self._gauge_bytes()
+                return None
+            self._entries.move_to_end(key)
+            self.stats.hits += 1
+            self._meter("hits")
+            return payload
+
+    def put(self, key: Hashable, payload: bytes) -> bool:
+        n = len(payload)
+        if n > self.max_bytes:
+            return False  # would evict the entire cache for one entry
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= len(old[1])
+            self._entries[key] = (self._clock() + self.ttl_seconds, payload)
+            self._bytes += n
+            self.stats.puts += 1
+            while self._bytes > self.max_bytes:
+                _, (_, evicted) = self._entries.popitem(last=False)
+                self._bytes -= len(evicted)
+                self.stats.evictions += 1
+                self._meter("evictions")
+            self._gauge_bytes()
+        return True
+
+    # ------------------------------------------------------------------
+    def invalidate(self, predicate: Callable[[Hashable], bool]) -> int:
+        """Drop every entry whose key matches; returns the count."""
+        with self._lock:
+            doomed = [k for k in self._entries if predicate(k)]
+            for k in doomed:
+                _, payload = self._entries.pop(k)
+                self._bytes -= len(payload)
+            self.stats.invalidations += len(doomed)
+            self._gauge_bytes()
+            return len(doomed)
+
+    def clear(self) -> None:
+        with self._lock:
+            self.stats.invalidations += len(self._entries)
+            self._entries.clear()
+            self._bytes = 0
+            self._gauge_bytes()
+
+    # ------------------------------------------------------------------
+    @property
+    def size_bytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
+#: query options that steer the cache itself (never part of the result)
+OPT_SKIP_CACHE = "skipcache"
+OPT_USE_CACHE = "usecache"
+
+
+def cache_bypassed(options: dict) -> bool:
+    """True when the query opts out of BOTH tiers via skipCache=true /
+    useCache=false."""
+    opts = {k.lower(): str(v).lower() for k, v in options.items()}
+    return (opts.get(OPT_SKIP_CACHE) == "true"
+            or opts.get(OPT_USE_CACHE) == "false")
+
+
+def dumps(obj: Any) -> Optional[bytes]:
+    """Pickle, or None when the object is not serializable (e.g. a result
+    carrying a live device buffer) — callers skip caching, never fail the
+    query over it."""
+    try:
+        return pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    except Exception:  # noqa: BLE001 — any serde failure means "don't cache"
+        return None
+
+
+def loads(payload: bytes) -> Any:
+    return pickle.loads(payload)

@@ -1,0 +1,125 @@
+"""Tier 2: server-side per-segment partial-result cache.
+
+Reference parity: Druid's historical segment cache (`useCache` /
+`populateCache`, immutable segments only) mapped onto this repo's
+ImmutableSegment / consuming-segment split. Cached unit: ONE segment's
+aggregation / group-by / distinct partial for ONE plan fingerprint.
+Consuming (mutable) segments and upsert segments (live `valid_doc_ids`)
+are never cached — the mutable tail always re-executes, which is exactly
+what keeps hybrid tables fresh while the immutable bulk is served from
+cache.
+
+Invalidation is version-based: the key carries `segment_version()` —
+content CRC when the segment has one, else a per-process generation
+stamp — so a replace-by-name simply addresses a different key and the
+old entry ages out. `TableDataManager` additionally calls
+`invalidate_segment` on replace/remove for prompt byte reclamation.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Any, Optional
+
+from pinot_tpu.cache.core import LruTtlCache, dumps, loads
+from pinot_tpu.query.context import QueryContext
+from pinot_tpu.segment.loader import ImmutableSegment
+
+#: per-process generation stamps for segments without a content CRC —
+#: monotonically increasing, never reused, so two same-named segment
+#: objects (a replace) can never collide on a key
+_gen_counter = itertools.count(1)
+_gen_lock = threading.Lock()
+
+
+def segment_version(segment: Any):
+    """Stable version token for a loaded segment: the content CRC when
+    present (survives reload of the same directory), else a per-object
+    generation stamp (unique per process)."""
+    crc = getattr(getattr(segment, "metadata", None), "crc", 0)
+    if crc:
+        return ("crc", crc)
+    gen = getattr(segment, "_ptpu_cache_gen", None)
+    if gen is None:
+        with _gen_lock:
+            gen = getattr(segment, "_ptpu_cache_gen", None)
+            if gen is None:
+                gen = next(_gen_counter)
+                try:
+                    segment._ptpu_cache_gen = gen
+                except AttributeError:
+                    return ("id", id(segment))  # slotted object: best effort
+    return ("gen", gen)
+
+
+def is_cacheable_segment(segment: Any) -> bool:
+    """Immutable AND no live validity bitmap (upsert mutates
+    `valid_doc_ids` in place without a version change)."""
+    return (isinstance(segment, ImmutableSegment)
+            and getattr(segment, "valid_doc_ids", None) is None)
+
+
+def is_cacheable_shape(ctx: QueryContext) -> bool:
+    """Aggregation / group-by / distinct partials only: selection results
+    are large, cheap to recompute, and LIMIT-dependent per segment."""
+    return bool(ctx.aggregations) or ctx.distinct
+
+
+class SegmentResultCache:
+    """Per-segment partial results keyed by
+    (segment name, segment version, plan fingerprint)."""
+
+    def __init__(self, max_bytes: int = 256 << 20,
+                 ttl_seconds: float = 300.0, enabled: bool = True,
+                 metrics=None, labels: Optional[dict] = None):
+        """labels: metric labels (e.g. {'instance': id}) — several server
+        instances in one process share the 'server' registry, so unlabeled
+        gauges would clobber each other."""
+        self.enabled = enabled
+        self._cache = LruTtlCache(max_bytes, ttl_seconds, metrics=metrics,
+                                  metric_prefix="segment_result_cache",
+                                  labels=labels)
+
+    @classmethod
+    def from_config(cls, config, metrics=None,
+                    labels: Optional[dict] = None) -> "SegmentResultCache":
+        return cls(
+            max_bytes=config.get_int("pinot.server.segment.cache.bytes"),
+            ttl_seconds=config.get_float(
+                "pinot.server.segment.cache.ttl.seconds"),
+            enabled=config.get_bool("pinot.server.segment.cache.enabled"),
+            metrics=metrics, labels=labels)
+
+    # ------------------------------------------------------------------
+    def get(self, segment: Any, plan_fp: str) -> Optional[Any]:
+        if not self.enabled or not is_cacheable_segment(segment):
+            return None
+        payload = self._cache.get(
+            (segment.name, segment_version(segment), plan_fp))
+        return loads(payload) if payload is not None else None
+
+    def put(self, segment: Any, plan_fp: str, result: Any) -> bool:
+        if not self.enabled or not is_cacheable_segment(segment):
+            return False
+        payload = dumps(result)
+        if payload is None:
+            return False
+        return self._cache.put(
+            (segment.name, segment_version(segment), plan_fp), payload)
+
+    def invalidate_segment(self, name: str) -> int:
+        return self._cache.invalidate(lambda k: k[0] == name)
+
+    def clear(self) -> None:
+        self._cache.clear()
+
+    @property
+    def stats(self):
+        return self._cache.stats
+
+    @property
+    def size_bytes(self) -> int:
+        return self._cache.size_bytes
+
+    def __len__(self) -> int:
+        return len(self._cache)

@@ -52,7 +52,8 @@ class MiniClusterServer:
 
 
 class MiniCluster:
-    def __init__(self, num_servers: int = 2, use_tpu: bool = False):
+    def __init__(self, num_servers: int = 2, use_tpu: bool = False,
+                 result_cache: bool = False):
         self.servers: List[MiniClusterServer] = [
             MiniClusterServer(f"server_{i}", use_tpu=use_tpu)
             for i in range(num_servers)]
@@ -61,6 +62,8 @@ class MiniCluster:
         self.broker: Optional[BrokerRequestHandler] = None
         self.http: Optional[BrokerHttpServer] = None
         self._routes: Dict[str, RoutingTable] = {}
+        #: opt-in tier-1 broker result cache (cache/broker_cache.py)
+        self._result_cache_enabled = result_cache
 
     # ------------------------------------------------------------------
     def start(self, with_http: bool = False) -> None:
@@ -73,8 +76,15 @@ class MiniCluster:
             workers={s.instance_id: s.mse_worker for s in self.servers},
             catalog_fn=self._catalog,
             table_workers_fn=self._table_workers)
+        result_cache = None
+        if self._result_cache_enabled:
+            from pinot_tpu.cache.broker_cache import BrokerResultCache
+            from pinot_tpu.utils.metrics import get_registry
+            result_cache = BrokerResultCache(
+                metrics=get_registry("broker"))
         self.broker = BrokerRequestHandler(self.routing, self._connections,
-                                           mse_dispatcher=self.mse)
+                                           mse_dispatcher=self.mse,
+                                           result_cache=result_cache)
         if with_http:
             self.http = BrokerHttpServer(self.broker)
             self.http.start()
@@ -154,7 +164,23 @@ class MiniCluster:
         route.segments[segment.name] = SegmentInfo(
             name=segment.name,
             servers=[self.servers[i].instance_id for i in targets],
-            start_time=meta.start_time, end_time=meta.end_time)
+            start_time=meta.start_time, end_time=meta.end_time,
+            version=meta.crc)
+
+    def remove_segment(self, table_name: str, segment_name: str,
+                       table_type: str = "OFFLINE") -> None:
+        """Unload from every server and drop from routing (bumps the
+        routing epoch, so tier-1 cache entries go unaddressable)."""
+        physical = f"{table_name}_{table_type}"
+        for s in self.servers:
+            tdm = s.data_manager.table(physical, create=False)
+            if tdm is not None:
+                tdm.remove_segment(segment_name)
+        rt = self._routes.get(table_name)
+        route = None if rt is None else (
+            rt.offline if table_type == "OFFLINE" else rt.realtime)
+        if route is not None:
+            route.segments.pop(segment_name, None)
 
     def query(self, sql: str):
         assert self.broker is not None, "cluster not started"

@@ -374,7 +374,7 @@ class BrokerRole:
         self.handler = BrokerRequestHandler(
             self.routing, self.connections,
             max_fanout_threads=cfg.get_int("pinot.broker.fanout.threads"),
-            quota_manager=self.quotas)
+            quota_manager=self.quotas, config=cfg)
         self.http = BrokerHttpServer(self.handler, host=host, port=http_port)
         self._rebuild_lock = threading.Lock()
 
@@ -431,7 +431,8 @@ class BrokerRole:
                         name=name, servers=list(st.get("instances", ())),
                         partition_id=st.get("partition_id"),
                         start_time=st.get("start_time"),
-                        end_time=st.get("end_time"))
+                        end_time=st.get("end_time"),
+                        version=st.get("crc", 0) or 0)
                 rt = RoutingTable()
                 if cfg.table_type.value == "REALTIME":
                     rt.realtime = route

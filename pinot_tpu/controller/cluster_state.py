@@ -32,6 +32,9 @@ class SegmentState:
     #: realtime replay checkpoint (ref StreamPartitionMsgOffset in ZK meta)
     end_offset: Optional[str] = None
     status: str = "ONLINE"          # ONLINE | CONSUMING | OFFLINE
+    #: content CRC — feeds the broker routing epoch so replacing a
+    #: segment invalidates result-cache entries cluster-wide
+    crc: int = 0
 
     def to_dict(self) -> dict:
         return self.__dict__.copy()

@@ -59,7 +59,7 @@ class Controller:
             name=meta.segment_name, table=physical, instances=instances,
             dir_path=seg_dir, num_docs=meta.num_docs,
             start_time=meta.start_time, end_time=meta.end_time,
-            partition_id=partition_id)
+            partition_id=partition_id, crc=meta.crc)
         self.state.upsert_segment(st)
         for inst in instances:
             hooks = self._server_hooks.get(inst)

@@ -19,7 +19,9 @@ Responsibilities:
 """
 from __future__ import annotations
 
+import contextlib
 import threading
+from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -57,6 +59,32 @@ def _pow2(n: int, floor: int = 128) -> int:
 # doc-padding cap: counts are packed in float32 when x64 is off, which is
 # exact only below 2^24; segments larger than this are rejected to host
 MAX_DOCS_PER_SEGMENT = 1 << 24
+
+#: XLA's intra-process CPU collectives rendezvous by (devices, op) — two
+#: partitioned computations dispatched concurrently (even from DIFFERENT
+#: engine instances: the host-platform devices are process-global)
+#: interleave their rendezvous and deadlock. Serialize multi-device
+#: dispatch process-wide on CPU backends; real accelerators have a
+#: hardware-ordered collective queue and keep fully concurrent dispatch.
+_CPU_COLLECTIVE_LOCK = threading.Lock()
+
+
+def _dispatch_guard(engine: "TpuOperatorExecutor", kernel):
+    """Lock to hold across a kernel dispatch + result fetch: the global
+    CPU collective lock for PARTITIONED execution on host devices, a
+    no-op everywhere else (single device, a real accelerator, or a
+    non-XLA kernel stand-in — only staged computations can carry the
+    collectives that rendezvous). EVERY staged kernel on a mesh engine
+    is partitioned: _put stages inputs with NamedSharding, so even the
+    plain-jit kernels (group-by without a docs axis, top-N) compile to
+    GSPMD programs with all-gathers — the doc_axis==1 compiled_kernel
+    path is exactly what deadlocked the suite, so don't narrow this to
+    the shard_map branch."""
+    if engine._mesh is not None and engine.devices \
+            and getattr(engine.devices[0], "platform", "") == "cpu" \
+            and isinstance(kernel, jax.stages.Wrapped):
+        return _CPU_COLLECTIVE_LOCK
+    return contextlib.nullcontext()
 
 
 class TpuOperatorExecutor:
@@ -121,14 +149,18 @@ class TpuOperatorExecutor:
         self._engine_lock = threading.RLock()
         #: resolved predicate parameter arrays per (batch, plan, filter) —
         #: repeat queries then cost zero host->device param uploads;
-        #: bounded by simple size cap (entries are tiny)
-        self._params_cache: Dict[tuple, Any] = {}
+        #: bounded LRU (hot filter parameters survive cache pressure
+        #: instead of a wholesale clear dropping them all at once)
+        self._params_cache: "OrderedDict[tuple, Any]" = OrderedDict()
 
     # ------------------------------------------------------------------
     # capability check (structural)
     # ------------------------------------------------------------------
     #: cap on selection/order-by top-K offload (limit + offset)
     TOPN_MAX_K = 8192
+
+    #: LRU capacity of the predicate-parameter cache (entries are tiny)
+    PARAMS_CACHE_ENTRIES = 4096
 
     def supports(self, ctx: QueryContext) -> bool:
         if ctx.distinct:
@@ -257,7 +289,8 @@ class TpuOperatorExecutor:
                 kernel = kernels.compiled_sharded_kernel(plan, self._mesh)
             else:
                 kernel = kernels.compiled_kernel(plan)
-        packed = np.asarray(kernel(cols, params, num_docs, D=D, G=G))
+        with _dispatch_guard(self, kernel):
+            packed = np.asarray(kernel(cols, params, num_docs, D=D, G=G))
         results = self._assemble(segments, ctx, plan, packed, S_real, slots_of_fn)
         return results, []
 
@@ -294,7 +327,8 @@ class TpuOperatorExecutor:
             except _NotStageable:
                 return [], segments
             kernel = kernels.compiled_topn_kernel(plan)
-        packed = np.asarray(kernel(cols, params, num_docs, D=D))
+        with _dispatch_guard(self, kernel):
+            packed = np.asarray(kernel(cols, params, num_docs, D=D))
         return self._assemble_topn(segments, ctx, packed, S_real), []
 
     # ------------------------------------------------------------------
@@ -574,7 +608,8 @@ class TpuOperatorExecutor:
             except _NotStageable:
                 return nothing
             kernel = kernels.compiled_topn_kernel(plan)
-        packed = np.asarray(kernel(cols, params, num_docs, D=D))
+        with _dispatch_guard(self, kernel):
+            packed = np.asarray(kernel(cols, params, num_docs, D=D))
         out = []
         for s, seg in enumerate(segments[:S_real]):
             matched = int(packed[s, 0])
@@ -837,12 +872,11 @@ class TpuOperatorExecutor:
         # expression trees, so they key the resolved literals exactly)
         pkey = (_batch_id(segments), plan, ctx.filter,
                 tuple(ctx.agg_filters), S)
-        if len(self._params_cache) > 4096:
-            self._params_cache.clear()
         cached = self._params_cache.get(pkey)
         if cached is not None:
             csegs, cparams, cnum_docs = cached
             if all(a is b for a, b in zip(csegs, segments)):
+                self._params_cache.move_to_end(pkey)  # LRU refresh
                 params.update(cparams)
                 return cols, params, cnum_docs, S_real, D, G
         # leaf expressions in the exact order _plan appended leaves:
@@ -933,6 +967,9 @@ class TpuOperatorExecutor:
         num_docs_dev = self._put(num_docs)
         leaf_params = {k: v for k, v in params.items() if k.startswith("leaf")}
         self._params_cache[pkey] = (tuple(segments), leaf_params, num_docs_dev)
+        self._params_cache.move_to_end(pkey)
+        while len(self._params_cache) > self.PARAMS_CACHE_ENTRIES:
+            self._params_cache.popitem(last=False)  # evict coldest only
         return cols, params, num_docs_dev, S_real, D, G
 
     def _stage_gkey(self, segments, S, D, plan: DevicePlan):

@@ -9,6 +9,7 @@ aggregation / group-by / selection / distinct.
 """
 from __future__ import annotations
 
+import hashlib
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -123,6 +124,44 @@ class QueryContext:
     @property
     def is_selection_query(self) -> bool:
         return not self.aggregations and not self.distinct
+
+    #: options that steer caching/observability, not the result — two
+    #: queries differing only here MUST share a fingerprint
+    _FINGERPRINT_OPT_DENYLIST = frozenset(
+        {"skipcache", "usecache", "trace", "timeoutms"})
+
+    def fingerprint(self) -> str:
+        """Canonical digest of everything that determines the RESULT:
+        table, select list (post-alias-strip) + aliases, distinct flag,
+        filter / group-by / having / order-by trees, limit/offset, and
+        result-affecting options. Shared by both cache tiers: the broker
+        keys whole responses on it, the server keys per-segment partials
+        on it (the time-boundary extra filter is ANDed into `filter`
+        before server-side execution, so it participates naturally).
+
+        Expression nodes are frozen dataclasses with deterministic
+        `__str__`, which makes str() a stable serialization — no salted
+        `hash()` anywhere, so the digest is reproducible across
+        processes."""
+        opts = sorted(
+            (k.lower(), str(v)) for k, v in self.options.items()
+            if k.lower() not in self._FINGERPRINT_OPT_DENYLIST)
+        parts = [
+            "tbl:" + self.table,
+            "sel:" + "|".join(str(e) for e in self.select),
+            "als:" + "|".join(a or "" for a in self.aliases),
+            "dst:" + str(self.distinct),
+            "flt:" + (str(self.filter) if self.filter is not None else ""),
+            "gby:" + "|".join(str(e) for e in self.group_by),
+            "hav:" + (str(self.having) if self.having is not None else ""),
+            "oby:" + "|".join(f"{e}/{'asc' if asc else 'desc'}"
+                              for e, asc in self.order_by),
+            "lim:" + str(self.limit),
+            "off:" + str(self.offset),
+            "exp:" + str(self.explain),
+            "opt:" + "|".join(f"{k}={v}" for k, v in opts),
+        ]
+        return hashlib.sha256("\n".join(parts).encode()).hexdigest()
 
     def filter_columns(self) -> List[str]:
         return self.filter.columns() if self.filter is not None else []

@@ -16,6 +16,8 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Any, List, Optional, Sequence
 
 from pinot_tpu.query import executor_cpu
+from pinot_tpu.cache.core import cache_bypassed
+from pinot_tpu.cache.segment_cache import is_cacheable_shape
 from pinot_tpu.utils import tracing
 from pinot_tpu.query.context import QueryContext
 from pinot_tpu.query.pruner import prune_segments
@@ -28,14 +30,18 @@ class QueryExecutor:
     """Executes queries over a set of loaded segments (one 'server')."""
 
     def __init__(self, segments: Sequence[ImmutableSegment],
-                 use_tpu: bool = True, max_threads: int = 8, engine=None):
+                 use_tpu: bool = True, max_threads: int = 8, engine=None,
+                 segment_cache=None):
         """engine: a shared TpuOperatorExecutor. Long-lived callers (the
         server) MUST pass one — the engine owns the HBM block cache, and a
-        per-request engine would re-upload every column on every query."""
+        per-request engine would re-upload every column on every query.
+        segment_cache: a shared SegmentResultCache (cache/segment_cache.py)
+        — same lifetime rule as the engine; None disables tier-2 caching."""
         self.segments = list(segments)
         self.max_threads = max_threads
         self._tpu_engine = engine
         self._use_tpu = use_tpu
+        self._segment_cache = segment_cache
 
     @property
     def tpu_engine(self):
@@ -57,29 +63,67 @@ class QueryExecutor:
                 prune_stats.total_docs += seg.num_docs
         results: List[Any] = []
 
+        # tier-2 segment result cache: immutable segments with a cached
+        # partial for this plan fingerprint skip execution entirely;
+        # consuming/upsert segments never hit (is_cacheable_segment), so
+        # the mutable tail of a hybrid table always re-executes
+        cache = self._segment_cache
+        plan_fp: Optional[str] = None
+        to_run = selected
+        cache_hits = 0
+        if cache is not None and cache.enabled and is_cacheable_shape(ctx) \
+                and not cache_bypassed(ctx.options):
+            plan_fp = ctx.fingerprint()
+            with tracing.Scope("SegmentResultCache") as sc:
+                to_run = []
+                for s in selected:
+                    hit = cache.get(s, plan_fp)
+                    if hit is not None:
+                        results.append(hit)
+                        cache_hits += 1
+                    else:
+                        to_run.append(s)
+                sc.set(cacheHit=cache_hits > 0, cacheHits=cache_hits,
+                       cacheMisses=len(to_run))
+            # mirror on the enclosing request node so trace consumers see
+            # cacheHit without walking children
+            tracing.annotate(cacheHit=cache_hits > 0)
+
         # consuming (mutable) segments always run host-side: their columns
         # are unsorted-dict/append buffers, not stageable blocks
         device_candidates = [
-            s for s in selected
+            s for s in to_run
             if isinstance(s, ImmutableSegment)
             and getattr(s, "valid_doc_ids", None) is None]
         dc = set(id(s) for s in device_candidates)
-        host_only = [s for s in selected if id(s) not in dc]
+        host_only = [s for s in to_run if id(s) not in dc]
         remaining = device_candidates
         if self._use_tpu and device_candidates:
             engine = self.tpu_engine
             if engine is not None and engine.supports(ctx):
                 device_results, remaining = engine.execute(device_candidates, ctx)
                 results.extend(device_results)
+                # engine results are positional per candidate when nothing
+                # fell back; only then is the segment<->result mapping
+                # known for cache population
+                if plan_fp is not None and not remaining \
+                        and len(device_results) == len(device_candidates):
+                    for s, r in zip(device_candidates, device_results):
+                        cache.put(s, plan_fp, r)
         remaining = list(remaining) + host_only
         if remaining:
+            def run_one(s):
+                r = executor_cpu.execute_segment(s, ctx)
+                if plan_fp is not None:
+                    cache.put(s, plan_fp, r)  # no-op for mutable segments
+                return r
+
             if len(remaining) == 1:
-                results.append(executor_cpu.execute_segment(remaining[0], ctx))
+                results.append(run_one(remaining[0]))
             else:
                 with ThreadPoolExecutor(
                         max_workers=min(len(remaining), self.max_threads)) as pool:
-                    results.extend(pool.map(
-                        lambda s: executor_cpu.execute_segment(s, ctx), remaining))
+                    results.extend(pool.map(run_one, remaining))
         return results, prune_stats
 
     def execute(self, sql: str) -> BrokerResponse:

@@ -45,6 +45,9 @@ class BrokerResponse:
     num_servers_responded: int = 0
     num_groups_limit_reached: bool = False
     trace: Optional[dict] = None  # operator trace tree when trace=true
+    #: True when this response was served from the broker result cache
+    #: (tier 1); never True on a freshly executed response
+    cache_hit: bool = False
 
     def to_dict(self) -> dict:
         d = {
@@ -61,6 +64,7 @@ class BrokerResponse:
             "totalDocs": self.stats.total_docs,
             "numGroupsLimitReached": self.num_groups_limit_reached,
             "timeUsedMs": self.time_used_ms,
+            "cacheHit": self.cache_hit,
         }
         if self.trace is not None:
             d["traceInfo"] = self.trace

@@ -56,18 +56,35 @@ class SegmentDataManager:
 class TableDataManager:
     """Ref BaseTableDataManager — one per table on a server."""
 
-    def __init__(self, table_name: str):
+    def __init__(self, table_name: str, listener=None):
         self.table_name = table_name
         self._segments: Dict[str, SegmentDataManager] = {}
         self._lock = threading.Lock()
+        #: monotonically increasing segment-set version, bumped on every
+        #: add/replace/remove — cache tiers key/invalidate on it
+        self._version = 0
+        #: optional callback(event, table_name, segment_name) fired AFTER
+        #: the mutation commits; events: "add" | "replace" | "remove"
+        self._listener = listener
+
+    @property
+    def version(self) -> int:
+        with self._lock:
+            return self._version
+
+    def _notify(self, event: str, segment_name: str) -> None:
+        if self._listener is not None:
+            self._listener(event, self.table_name, segment_name)
 
     def add_segment(self, segment: ImmutableSegment) -> None:
         sdm = SegmentDataManager(segment)
         with self._lock:
             old = self._segments.get(segment.name)
             self._segments[segment.name] = sdm
+            self._version += 1
         if old is not None:
             old.offload()
+        self._notify("replace" if old is not None else "add", segment.name)
 
     def add_segment_from_dir(self, seg_dir: str) -> None:
         self.add_segment(load_segment(seg_dir))
@@ -75,8 +92,11 @@ class TableDataManager:
     def remove_segment(self, name: str) -> None:
         with self._lock:
             sdm = self._segments.pop(name, None)
+            if sdm is not None:
+                self._version += 1
         if sdm is not None:
             sdm.offload()
+            self._notify("remove", name)
 
     def acquire_segments(self, names: Optional[Sequence[str]] = None
                          ) -> List[SegmentDataManager]:
@@ -106,8 +126,10 @@ class TableDataManager:
         with self._lock:
             sdms = list(self._segments.values())
             self._segments.clear()
+            self._version += 1
         for sdm in sdms:
             sdm.offload()
+            self._notify("remove", sdm.name)
 
 
 class InstanceDataManager:
@@ -117,12 +139,28 @@ class InstanceDataManager:
         self.instance_id = instance_id
         self._tables: Dict[str, TableDataManager] = {}
         self._lock = threading.Lock()
+        self._segment_listeners: List = []
+
+    def add_segment_listener(self, fn) -> None:
+        """Register callback(event, table_name, segment_name) fired on
+        every table's segment add/replace/remove (covers tables created
+        after registration too)."""
+        with self._lock:
+            self._segment_listeners.append(fn)
+
+    def _dispatch_segment_event(self, event: str, table_name: str,
+                                segment_name: str) -> None:
+        with self._lock:
+            listeners = list(self._segment_listeners)
+        for fn in listeners:
+            fn(event, table_name, segment_name)
 
     def table(self, table_name: str, create: bool = True) -> Optional[TableDataManager]:
         with self._lock:
             tdm = self._tables.get(table_name)
             if tdm is None and create:
-                tdm = TableDataManager(table_name)
+                tdm = TableDataManager(table_name,
+                                       listener=self._dispatch_segment_event)
                 self._tables[table_name] = tdm
             return tdm
 

@@ -50,6 +50,28 @@ class ServerQueryExecutor:
         #: cache, which must survive across requests
         self._engine = None
         self._engine_lock = threading.Lock()
+        #: tier-2 per-segment partial-result cache — shared across requests
+        #: for the same reason as the engine. Version-keyed entries go
+        #: stale-unaddressable on replace; the data-manager hook below
+        #: additionally reclaims their bytes promptly.
+        from pinot_tpu.cache.segment_cache import SegmentResultCache
+        from pinot_tpu.utils.metrics import get_registry
+        labels = {"instance": data_manager.instance_id}
+        if config is not None:
+            self.segment_cache = SegmentResultCache.from_config(
+                config, metrics=get_registry("server"), labels=labels)
+        else:
+            self.segment_cache = SegmentResultCache(
+                metrics=get_registry("server"), labels=labels)
+        data_manager.add_segment_listener(self._on_segment_event)
+
+    def _on_segment_event(self, event: str, table_name: str,
+                          segment_name: str) -> None:
+        """TableDataManager version-bump hook: drop cached partials for a
+        replaced/removed segment immediately (version keying already makes
+        them unreachable; this reclaims the bytes)."""
+        if event in ("replace", "remove"):
+            self.segment_cache.invalidate_segment(segment_name)
 
     def _shared_engine(self):
         if not self.use_tpu:
@@ -87,7 +109,8 @@ class ServerQueryExecutor:
             try:
                 ex = QueryExecutor([s.segment for s in sdms],
                                    use_tpu=self.use_tpu,
-                                   engine=self._shared_engine())
+                                   engine=self._shared_engine(),
+                                   segment_cache=self.segment_cache)
                 results, prune_stats = ex.execute_context(ctx)
                 return datatable.serialize_results(results,
                                                    extra_stats=prune_stats)
@@ -134,7 +157,8 @@ class ServerQueryExecutor:
                 for i in range(0, max(len(segs), 1), chunk):
                     ex = QueryExecutor(segs[i:i + chunk],
                                        use_tpu=self.use_tpu,
-                                       engine=self._shared_engine())
+                                       engine=self._shared_engine(),
+                                       segment_cache=self.segment_cache)
                     results, prune_stats = ex.execute_context(ctx)
                     yield datatable.serialize_results(
                         results, extra_stats=prune_stats)

@@ -27,9 +27,20 @@ KEYS: Dict[str, Any] = {
     "pinot.server.stream.chunk.segments": 4,
     "pinot.server.hbm.cache.bytes": 8 << 30,
     "pinot.server.host.row.cache.bytes": 16 << 30,
+    "pinot.server.segment.cache.enabled": True,   # tier-2 partial cache
+    "pinot.server.segment.cache.bytes": 256 << 20,
+    "pinot.server.segment.cache.ttl.seconds": 300.0,
     "pinot.broker.http.port": 8099,
     "pinot.broker.fanout.threads": 16,
     "pinot.broker.adaptive.selector": "hybrid",  # latency|inflight|hybrid
+    # tier-1 whole-result cache: opt-in — a cached response bypasses
+    # scatter/gather entirely, including failure detection
+    "pinot.broker.result.cache.enabled": False,
+    "pinot.broker.result.cache.bytes": 64 << 20,
+    "pinot.broker.result.cache.ttl.seconds": 60.0,
+    # cache tables with a consuming side (appends don't move the routing
+    # epoch, so hits may be TTL-stale) — off unless you can tolerate that
+    "pinot.broker.result.cache.realtime": False,
     "pinot.controller.port": 9000,
     "pinot.controller.deep.store.uri": "",
     "pinot.controller.retention.frequency.seconds": 60,

@@ -9,6 +9,8 @@ with the same meter/gauge/timer trio, exported as Prometheus text
 """
 from __future__ import annotations
 
+import math
+import random
 import threading
 import time
 from collections import defaultdict
@@ -22,17 +24,43 @@ def _key(name: str, labels: Optional[Dict[str, str]]) -> _Key:
 
 
 class Timer:
-    __slots__ = ("count", "total_ms", "max_ms")
+    """count/sum/max plus p50/p95/p99 from a fixed-size reservoir
+    (Vitter's algorithm R — every observation has equal probability of
+    being sampled, so tails survive long runs; a keep-last-N window
+    would forget cold-start latencies the moment traffic warms up)."""
+
+    __slots__ = ("count", "total_ms", "max_ms", "_reservoir", "_rng")
+
+    RESERVOIR_SIZE = 256
 
     def __init__(self):
         self.count = 0
         self.total_ms = 0.0
         self.max_ms = 0.0
+        self._reservoir: List[float] = []
+        # private PRNG: seeded for reproducible tests, and never touches
+        # the global random state
+        self._rng = random.Random(0x5EED)
 
     def update(self, ms: float) -> None:
         self.count += 1
         self.total_ms += ms
         self.max_ms = max(self.max_ms, ms)
+        if len(self._reservoir) < self.RESERVOIR_SIZE:
+            self._reservoir.append(ms)
+        else:
+            j = self._rng.randrange(self.count)
+            if j < self.RESERVOIR_SIZE:
+                self._reservoir[j] = ms
+
+    def quantile(self, q: float) -> float:
+        """Empirical quantile estimate from the reservoir (0 when no
+        observations yet)."""
+        if not self._reservoir:
+            return 0.0
+        s = sorted(self._reservoir)
+        idx = min(len(s) - 1, max(0, math.ceil(q * len(s)) - 1))
+        return s[idx]
 
 
 class MetricsRegistry:
@@ -91,29 +119,50 @@ class MetricsRegistry:
             return self._timers.get(_key(name, labels), Timer())
 
     def prometheus_text(self) -> str:
-        """Prometheus exposition format (the JMX-reporter analog)."""
+        """Prometheus exposition format (the JMX-reporter analog).
+
+        `# TYPE` is emitted once per metric NAME — two label sets of the
+        same metric share one family header (duplicate TYPE lines are
+        invalid exposition and make scrapers reject the whole page)."""
         out: List[str] = []
         prefix = f"pinot_tpu_{self.role}_"
+        typed: set = set()
+
+        def type_line(base: str, kind: str) -> None:
+            if base not in typed:
+                typed.add(base)
+                out.append(f"# TYPE {base} {kind}")
+
         with self._lock:
             for (name, labels), v in sorted(self._meters.items()):
-                out.append(f"# TYPE {prefix}{name} counter")
+                type_line(f"{prefix}{name}", "counter")
                 out.append(f"{prefix}{name}{_fmt(labels)} {v:g}")
             for (name, labels), v in sorted(self._gauges.items()):
-                out.append(f"# TYPE {prefix}{name} gauge")
+                type_line(f"{prefix}{name}", "gauge")
                 out.append(f"{prefix}{name}{_fmt(labels)} {v:g}")
             for (name, labels), t in sorted(self._timers.items()):
                 base = f"{prefix}{name}"
-                out.append(f"# TYPE {base} summary")
+                type_line(base, "summary")
+                for q in (0.5, 0.95, 0.99):
+                    qlabels = labels + (("quantile", f"{q:g}"),)
+                    out.append(f"{base}{_fmt(qlabels)} {t.quantile(q):g}")
                 out.append(f"{base}_count{_fmt(labels)} {t.count}")
                 out.append(f"{base}_sum_ms{_fmt(labels)} {t.total_ms:g}")
                 out.append(f"{base}_max_ms{_fmt(labels)} {t.max_ms:g}")
         return "\n".join(out) + "\n"
 
 
+def _escape(v: str) -> str:
+    """Label-value escaping per the exposition spec: backslash, quote,
+    newline."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _fmt(labels: Tuple[Tuple[str, str], ...]) -> str:
     if not labels:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in labels)
+    inner = ",".join(f'{k}="{_escape(v)}"' for k, v in labels)
     return "{" + inner + "}"
 
 

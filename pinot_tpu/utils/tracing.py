@@ -84,3 +84,12 @@ class RequestTrace:
 
 def active() -> bool:
     return _current.get() is not None
+
+
+def annotate(**attrs) -> None:
+    """Attach attrs to the CURRENT trace node (no-op when tracing is off).
+    Used for cross-cutting marks like cacheHit that belong to whichever
+    operator is running, not to a new child scope."""
+    node = _current.get()
+    if node is not None:
+        node.attrs.update(attrs)
