@@ -5,6 +5,13 @@ dashboard-style group-by over immutable segments, per-tier hit ratios,
 and a freshness check (a realtime append must change the answer on the
 very next query — the mutable tail never serves from cache).
 
+`--remote` measures the distributed fabric instead: an in-process
+cache-server role (cache/remote.py) mounted as L2 under a TieredCache,
+reporting cold vs L1-warm vs L2-warm p50 (L2-warm = a fresh replica with
+an empty L1 pulling a sibling's partials over the wire) plus the raw
+remote round-trip overhead, and writing BENCH_cache_remote.json next to
+this file.
+
 Runnable anywhere: `JAX_PLATFORMS=cpu python bench_cache.py` uses the
 host executor; on a TPU host the device engine path is exercised too.
 """
@@ -57,6 +64,98 @@ def build_segments():
 
 def p50(xs):
     return statistics.median(xs) * 1000.0
+
+
+def main_remote() -> None:
+    """Fabric mode: cold vs L1-warm vs L2-warm p50 + remote RTT."""
+    from pinot_tpu.cache import (CacheServer, LruTtlCache,
+                                 RemoteCacheBackend, SegmentResultCache,
+                                 TieredCache)
+    from pinot_tpu.cache.segment_cache import segment_remote_key
+    from pinot_tpu.query.executor import QueryExecutor
+
+    import jax
+    use_tpu = jax.devices()[0].platform != "cpu"
+    _, _, segs = build_segments()
+    server = CacheServer(max_bytes=512 << 20, ttl_seconds=600.0)
+    server.start()
+
+    def tiered_cache():
+        """A fresh replica: empty L1 over the SHARED warm L2."""
+        return SegmentResultCache(backend=TieredCache(
+            LruTtlCache(256 << 20, 600.0),
+            RemoteCacheBackend(server.address), segment_remote_key))
+
+    def run(cache):
+        t0 = time.perf_counter()
+        r = QueryExecutor(segs, use_tpu=use_tpu,
+                          segment_cache=cache).execute(QUERY)
+        return time.perf_counter() - t0, r
+
+    try:
+        # cold: both tiers empty every iteration
+        cold = []
+        for _ in range(ITERS):
+            server.cache.clear()
+            replica = tiered_cache()
+            dt, cold_resp = run(replica)
+            cold.append(dt)
+            replica._cache.close()
+        baseline_rows = cold_resp.result_table.rows
+
+        # L1-warm: one replica, primed, repeated dashboard refresh
+        server.cache.clear()
+        primed = tiered_cache()
+        run(primed)
+        l1_warm = []
+        for _ in range(ITERS):
+            dt, r = run(primed)
+            l1_warm.append(dt)
+        assert r.result_table.rows == baseline_rows, "L1 corrupted rows"
+
+        # L2-warm: a NEW replica each iteration — empty L1, warm shared
+        # tier — i.e. the rollout/cold-replica path the fabric exists for
+        l2_warm = []
+        for _ in range(ITERS):
+            replica = tiered_cache()
+            dt, r = run(replica)
+            l2_warm.append(dt)
+            assert replica._cache.l2.hits >= len(segs), "L2 did not serve"
+            replica._cache.close()
+        assert r.result_table.rows == baseline_rows, "L2 corrupted rows"
+
+        # raw remote round trip: GET of one representative entry
+        be = RemoteCacheBackend(server.address)
+        probe_key = next(iter(server.cache._entries))
+        rtts = []
+        for _ in range(200):
+            t0 = time.perf_counter()
+            be.get(probe_key)
+            rtts.append(time.perf_counter() - t0)
+        be.close()
+        primed._cache.close()
+    finally:
+        server.stop()
+
+    cold_p50, l1_p50, l2_p50 = p50(cold), p50(l1_warm), p50(l2_warm)
+    out = {
+        "metric": "remote_cache_l2_warm_speedup",
+        "value": round(cold_p50 / l2_p50, 2) if l2_p50 else None,
+        "unit": "x",
+        "cold_p50_ms": round(cold_p50, 3),
+        "l1_warm_p50_ms": round(l1_p50, 3),
+        "l2_warm_p50_ms": round(l2_p50, 3),
+        "remote_rtt_p50_ms": round(p50(rtts), 3),
+        "l2_over_l1_overhead_ms": round(l2_p50 - l1_p50, 3),
+        "num_segments": NUM_SEGMENTS,
+        "docs_per_segment": DOCS_PER_SEGMENT,
+        "use_tpu": use_tpu,
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_cache_remote.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
 
 
 def main() -> None:
@@ -127,4 +226,7 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if "--remote" in sys.argv[1:]:
+        main_remote()
+    else:
+        main()

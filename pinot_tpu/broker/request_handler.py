@@ -29,6 +29,7 @@ class BrokerRequestHandler:
                  quota_manager=None, config=None, result_cache=None):
         self.routing = routing
         self.connections = connections
+        self.config = config
         #: tier-1 whole-result cache (cache/broker_cache.py). Off unless a
         #: config enables pinot.broker.result.cache.enabled or a built
         #: cache is injected — failover semantics (a repeated query must
@@ -61,6 +62,14 @@ class BrokerRequestHandler:
         with self._lock:
             self._request_id += 1
             return self._request_id
+
+    def _hybrid_offline_enabled(self) -> bool:
+        """Hybrid offline-partial caching rides the result cache; the
+        knob exists to switch the behavior off independently."""
+        if self.config is not None:
+            return self.config.get_bool(
+                "pinot.broker.result.cache.hybrid.offline", True)
+        return True
 
     def _check_quota(self, table: str) -> bool:
         """QPS quota on the LOGICAL name — quotas register unsuffixed, so
@@ -133,13 +142,15 @@ class BrokerRequestHandler:
         # invalidates by construction. Tables with consuming segments are
         # skipped unless cache_realtime — appends don't move the epoch.
         cache_key = None
+        offline_key = None  # hybrid offline-partial cache key
+        cacheable = False
         if self.result_cache is not None and self.result_cache.enabled \
                 and not ctx.explain \
                 and ctx.options.get("trace", "").lower() != "true":
             from pinot_tpu.cache.broker_cache import cache_bypassed
-            if not cache_bypassed(ctx.options) and \
-                    (self.result_cache.cache_realtime
-                     or not route.has_realtime):
+            cacheable = not cache_bypassed(ctx.options)
+            if cacheable and (self.result_cache.cache_realtime
+                              or not route.has_realtime):
                 epoch = route.epoch()
                 if not epoch.startswith("<torn:"):
                     # a torn epoch never repeats: a get can't hit and a
@@ -159,6 +170,48 @@ class BrokerRequestHandler:
         attempted: set = set()
         failed_servers: set = set()
 
+        # -- hybrid-table offline-partial cache ------------------------
+        # when the whole result is uncacheable because of a consuming
+        # side, the OFFLINE side's merged partial still is: keyed by the
+        # offline epoch, so only the realtime entries re-scatter. The
+        # partial is the raw per-server result list — reduce merges it
+        # with the realtime side's fresh results exactly as if the
+        # offline servers had answered.
+        offline_results: list = []
+        offline_stats: list = []
+        offline_failed = [False]
+        if cacheable and cache_key is None \
+                and route.offline is not None and route.has_realtime \
+                and self._hybrid_offline_enabled():
+            off_epoch = route.offline_epoch()
+            if not off_epoch.startswith("<torn:"):
+                key = (ctx.fingerprint(), ctx.table, off_epoch)
+                # READ whenever the epoch is clean: stored partials are
+                # complete by construction (see the PUT gate), so during
+                # an offline-server outage the cache is strictly better
+                # than the degraded scatter routing would attempt
+                cached = self.result_cache.get_offline_partial(*key)
+                if cached is not None:
+                    cached_results, cached_stats = cached
+                    results.extend(cached_results)
+                    if cached_stats is not None:
+                        server_stats.append(cached_stats)
+                    plan = [e for e in plan
+                            if not e[1].endswith("_OFFLINE")]
+                else:
+                    # PUT only when the plan covers every unpruned
+                    # offline segment: a segment with no placeable
+                    # replica is silently dropped from the plan (routing
+                    # tolerates it; the query degrades), but the epoch
+                    # hashes the segment SET, not placement — a partial
+                    # missing those rows would be served as complete
+                    # until TTL
+                    planned_off = {n for _srv, tbl, names, _ef in plan
+                                   if tbl.endswith("_OFFLINE")
+                                   for n in names}
+                    if planned_off == route.offline_segments_for(ctx):
+                        offline_key = key
+
         def submit(entries):
             out = []
             for server, physical_table, segment_names, extra_filter in entries:
@@ -170,6 +223,8 @@ class BrokerRequestHandler:
                     exceptions.append(
                         {"errorCode": 427,
                          "message": f"ServerNotConnected: {server}"})
+                    if physical_table.endswith("_OFFLINE"):
+                        offline_failed[0] = True
                     continue
                 # the time-boundary predicate travels as a separate field,
                 # ANDed into the filter TREE server-side — splicing SQL
@@ -189,6 +244,13 @@ class BrokerRequestHandler:
                     server_results, server_exc, stats_extra = \
                         datatable.deserialize_results(payload)
                     results.extend(server_results)
+                    if table.endswith("_OFFLINE"):
+                        if server_exc:
+                            offline_failed[0] = True
+                        else:
+                            offline_results.extend(server_results)
+                            if stats_extra is not None:
+                                offline_stats.append(stats_extra)
                     exceptions.extend(server_exc)
                     if stats_extra is not None:
                         server_stats.append(stats_extra)
@@ -199,6 +261,8 @@ class BrokerRequestHandler:
                     # skips it until the backoff expires, ref
                     # ConnectionFailureDetector) and retry the segments on
                     # surviving replicas ONCE
+                    if table.endswith("_OFFLINE"):
+                        offline_failed[0] = True
                     self.failure_detector.mark_failure(server)
                     failed_servers.add(server)
                     if retried:
@@ -226,6 +290,23 @@ class BrokerRequestHandler:
         retry_plan = gather(submit(plan), retried=False)
         if retry_plan:
             gather(submit(retry_plan), retried=True)
+
+        if offline_key is not None and offline_results \
+                and not offline_failed[0]:
+            # complete, clean offline side: reusable until the offline
+            # epoch moves (a retry-salvaged round is conservatively NOT
+            # cached — offline_failed stays set once any entry failed).
+            # Server-level stats ride along so a cache-served response
+            # reports the same pruning counts as an uncached run.
+            merged_stats = None
+            if offline_stats:
+                from pinot_tpu.query.results import ExecutionStats
+                merged_stats = ExecutionStats()
+                for s in offline_stats:
+                    merged_stats.merge(s)
+            self.result_cache.put_offline_partial(*offline_key,
+                                                  offline_results,
+                                                  stats=merged_stats)
 
         resp = reduce_results(ctx, results)
         for extra in server_stats:

@@ -38,12 +38,85 @@ class SegmentInfo:
     version: int = 0
 
 
+class _ObservedSegments(dict):
+    """Segment dict that bumps its owner's mutation counter on EVERY
+    mutating operation. The routing mutation API is direct dict
+    assignment (roles.py rebuild, mini.py add/remove), so memoizing
+    epoch() safely requires the invalidation hook to live in the dict
+    itself — every mutation site is covered by construction, including
+    future ones."""
+
+    __slots__ = ("_route",)
+
+    def __init__(self, route: "TableRoute", *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._route = route
+
+    def _bump(self):
+        # next() on itertools.count is atomic at the C level; a plain
+        # `+= 1` is load/add/store and can LOSE an increment when two
+        # threads mutate concurrently (routing mutators take no lock),
+        # leaving the epoch memo valid for a set it no longer matches.
+        # Racing bumps may store out of order — the worst case is a
+        # spurious recompute, never a stale memo (the memo is only kept
+        # while token == current counter).
+        self._route.mutation_version = next(self._route._mut_counter)
+
+    def __setitem__(self, k, v):
+        super().__setitem__(k, v)
+        self._bump()
+
+    def __delitem__(self, k):
+        super().__delitem__(k)
+        self._bump()
+
+    def pop(self, *args):
+        try:
+            return super().pop(*args)
+        finally:
+            self._bump()
+
+    def popitem(self):
+        try:
+            return super().popitem()
+        finally:
+            self._bump()
+
+    def clear(self):
+        super().clear()
+        self._bump()
+
+    def update(self, *args, **kwargs):
+        super().update(*args, **kwargs)
+        self._bump()
+
+    def __ior__(self, other):
+        # dict.__ior__ would mutate in place WITHOUT going through
+        # update() — the one hole in "every mutation site is covered"
+        self.update(other)
+        return self
+
+    def setdefault(self, k, default=None):
+        try:
+            return super().setdefault(k, default)
+        finally:
+            self._bump()
+
+
 @dataclass
 class TableRoute:
     """Routing state for one physical table (OFFLINE or REALTIME)."""
     table_name: str
     segments: Dict[str, SegmentInfo] = field(default_factory=dict)
     time_column: Optional[str] = None
+    #: bumped by _ObservedSegments on every segment-dict mutation; the
+    #: epoch memo keys on it (counter read/compare is GIL-atomic)
+    mutation_version: int = 0
+
+    def __post_init__(self):
+        self._mut_counter = itertools.count(self.mutation_version + 1)
+        if not isinstance(self.segments, _ObservedSegments):
+            self.segments = _ObservedSegments(self, self.segments)
 
 
 class RoutingTable:
@@ -64,6 +137,14 @@ class RoutingTable:
         self.selector = selector
         self._rr = 0
         self._lock = threading.Lock()
+        #: memoized epochs: validity-token tuple -> epoch string. One
+        #: entry per side-selection ('both' and 'offline' cache
+        #: independently); pins the route objects it hashed so id() reuse
+        #: after gc can never alias a stale memo.
+        self._epoch_memo: Dict[str, tuple] = {}
+        #: number of actual O(#segments) hash passes (test observability
+        #: for the memoization contract)
+        self.epoch_computes = 0
 
     @property
     def has_realtime(self) -> bool:
@@ -79,14 +160,64 @@ class RoutingTable:
         deliberately EXCLUDED: moving a segment between servers does not
         change query results.
 
-        Reads race segment-set mutation (routing mutators don't lock the
-        dicts — same read-mostly convention as route()); a torn iteration
-        returns a never-repeating epoch, degrading that one query to a
-        cache miss instead of failing it."""
+        MEMOIZED: the O(#segments) hash runs once per segment-set
+        mutation, not once per cacheable query — `TableRoute.segments` is
+        an observing dict that bumps `mutation_version` at every mutation
+        site, and the memo is keyed on (route identity, mutation_version,
+        time_boundary). Mutating a SegmentInfo IN PLACE does not move the
+        counter; routing rebuilds always swap whole SegmentInfo objects.
+        """
+        return self._memoized_epoch("both", (self.offline, self.realtime))
+
+    def offline_epoch(self) -> str:
+        """Epoch of ONLY the offline side (+ time boundary, which shapes
+        the offline extra filter). Key for hybrid-table offline-partial
+        caching: realtime appends/commits don't move it, so the offline
+        partial stays addressable while the consuming side re-executes."""
+        return self._memoized_epoch("offline", (self.offline,))
+
+    def offline_segments_for(self, ctx: QueryContext) -> set:
+        """Names of offline segments a COMPLETE plan for `ctx` must
+        cover (everything routing wouldn't prune). Callers caching the
+        offline partial compare this against what the plan actually
+        placed: a segment with no live replica is silently dropped by
+        _route_physical, and placement is deliberately outside the
+        epoch, so coverage must be checked separately."""
+        if self.offline is None:
+            return set()
+        return {s.name for s in self.offline.segments.values()
+                if not _prunable(s, ctx)}
+
+    def _memoized_epoch(self, which: str, sides: tuple) -> str:
+        # identity + mutation counter, never TableRoute.__eq__ (a
+        # dataclass eq would walk the whole segment dict — the exact
+        # O(#segments) cost being memoized away). The memo entry pins the
+        # route objects it hashed, so an id() can't be reused for a
+        # different live route while its memo is current.
+        token = (tuple(id(s) if s is not None else None for s in sides),
+                 tuple(s.mutation_version if s is not None else -1
+                       for s in sides),
+                 self.time_boundary)
+        memo = self._epoch_memo.get(which)
+        if memo is not None and memo[0] == token:
+            return memo[2]
+        value = self._compute_epoch(sides)
+        if not value.startswith("<torn:"):
+            # torn epochs never repeat by design — memoizing one would
+            # repeat it; tuple assignment is atomic under the GIL
+            self._epoch_memo[which] = (token, sides, value)
+        return value
+
+    def _compute_epoch(self, sides: tuple) -> str:
+        """Reads race segment-set mutation (routing mutators don't lock
+        the dicts — same read-mostly convention as route()); a torn
+        iteration returns a never-repeating epoch, degrading that one
+        query to a cache miss instead of failing it."""
+        self.epoch_computes += 1
         for _ in range(3):
             try:
                 h = hashlib.sha1()
-                for side in (self.offline, self.realtime):
+                for side in sides:
                     if side is None:
                         h.update(b"<none>\0")
                         continue
@@ -260,6 +391,11 @@ class BrokerRoutingManager:
 
     def __init__(self, selector=None):
         self._tables: Dict[str, RoutingTable] = {}
+        #: memoized single-side views for suffix-addressed queries
+        #: ('tbl_OFFLINE'): a fresh wrapper per get_route would carry an
+        #: empty epoch memo, re-hashing O(#segments) per query — the
+        #: exact cost the epoch memoization removes
+        self._suffix_views: Dict[str, RoutingTable] = {}
         #: shared AdaptiveServerSelector attached to every route
         self.selector = selector
         self._lock = threading.Lock()
@@ -269,6 +405,8 @@ class BrokerRoutingManager:
             routing.selector = self.selector
         with self._lock:
             self._tables[logical_table] = routing
+            for suffix in ("_OFFLINE", "_REALTIME"):
+                self._suffix_views.pop(logical_table + suffix, None)
 
     def get_route(self, table: str) -> Optional[RoutingTable]:
         base = table
@@ -279,11 +417,18 @@ class BrokerRoutingManager:
             rt = self._tables.get(base)
             if rt is None:
                 return None
-            if table.endswith("_OFFLINE"):
-                return RoutingTable(offline=rt.offline)
-            if table.endswith("_REALTIME"):
-                return RoutingTable(realtime=rt.realtime)
-            return rt
+            if base == table:
+                return rt
+            view = self._suffix_views.get(table)
+            if view is None:
+                # the view SHARES the underlying TableRoute, so segment
+                # mutations flow through; only the memo lives here
+                view = (RoutingTable(offline=rt.offline)
+                        if table.endswith("_OFFLINE")
+                        else RoutingTable(realtime=rt.realtime))
+                view.selector = rt.selector
+                self._suffix_views[table] = view
+            return view
 
     @property
     def table_names(self) -> List[str]:

@@ -11,15 +11,33 @@ memoizes per-segment aggregation/group-by/distinct partials keyed by
 version, never by mutation-in-place: a segment add/replace/remove changes
 the key, so stale entries simply stop being addressable and age out via
 TTL + LRU byte pressure.
+
+Distributed fabric (this PR's subsystem): a standalone cache-server role
+(`cache/remote.py` CacheServer) shares one byte budget across replicas;
+`RemoteCacheBackend` mounts it with pooling + timeouts + a circuit
+breaker, and `TieredCache` (`cache/tiered.py`) composes the local
+`LruTtlCache` as L1 with the remote tier as L2 behind the same byte
+interface — selected per tier via
+`pinot.broker.result.cache.backend` / `pinot.server.segment.cache.backend`
+(= local | tiered). `cache/warmup.py` replays a per-table fingerprint log
+against freshly loaded immutable segments so rollouts start warm.
 """
 from pinot_tpu.cache.core import CacheStats, LruTtlCache
 from pinot_tpu.cache.broker_cache import BrokerResultCache
+from pinot_tpu.cache.remote import CacheServer, RemoteCacheBackend
 from pinot_tpu.cache.segment_cache import SegmentResultCache, segment_version
+from pinot_tpu.cache.tiered import TieredCache
+from pinot_tpu.cache.warmup import FingerprintLog, SegmentWarmup
 
 __all__ = [
     "BrokerResultCache",
+    "CacheServer",
     "CacheStats",
+    "FingerprintLog",
     "LruTtlCache",
+    "RemoteCacheBackend",
     "SegmentResultCache",
+    "SegmentWarmup",
+    "TieredCache",
     "segment_version",
 ]

@@ -18,7 +18,9 @@ import itertools
 from typing import Optional
 
 from pinot_tpu.cache.core import (LruTtlCache, cache_bypassed,  # noqa: F401
-                                  dumps, loads)
+                                  dumps, loads, wire_dumps_response,
+                                  wire_dumps_results, wire_loads_response,
+                                  wire_loads_results_stats)
 from pinot_tpu.query.reduce import BrokerResponse
 
 #: default per-instance metric label — several handlers in one process
@@ -26,27 +28,62 @@ from pinot_tpu.query.reduce import BrokerResponse
 _broker_ids = itertools.count(0)
 
 
+def broker_remote_key(key) -> Optional[str]:
+    """Tuple key -> wire key string. Epochs are content hashes of the
+    segment set (never torn ones — the handler skips those before the
+    cache sees them), and fingerprints are sha256 of the canonical plan,
+    so identical keys on two brokers really do address the same answer.
+    Offline-partial keys carry a distinct prefix so they can never
+    collide with whole-result keys."""
+    if len(key) == 4 and key[0] == "off":
+        _, fingerprint, table, epoch = key
+        return f"off|{table}|{epoch}|{fingerprint}"
+    fingerprint, table, epoch = key
+    return f"res|{table}|{epoch}|{fingerprint}"
+
+
 class BrokerResultCache:
     """Whole BrokerResponse objects keyed by
-    (query fingerprint, table, routing epoch)."""
+    (query fingerprint, table, routing epoch), plus — for hybrid tables —
+    the offline side's merged partial keyed by the OFFLINE routing epoch
+    (a hybrid query then only re-scatters to the realtime side)."""
 
     def __init__(self, max_bytes: int = 64 << 20, ttl_seconds: float = 60.0,
                  enabled: bool = True, cache_realtime: bool = False,
-                 metrics=None, labels: Optional[dict] = None):
+                 metrics=None, labels: Optional[dict] = None,
+                 backend=None):
         """labels: metric labels (e.g. {'broker': id}) — several broker
         handlers in one process share the 'broker' registry, so unlabeled
-        gauges would clobber each other."""
+        gauges would clobber each other.
+        backend: a prebuilt cache (cache/tiered.py TieredCache) replacing
+        the default local LruTtlCache; remote-capable backends use the
+        typed wire codec instead of pickle (a shared store must never
+        feed pickle.loads) and fall through on undecodable entries."""
         self.enabled = enabled
         self.cache_realtime = cache_realtime
         if metrics is not None and labels is None:
             labels = {"broker": f"b{next(_broker_ids)}"}
-        self._cache = LruTtlCache(max_bytes, ttl_seconds, metrics=metrics,
-                                  metric_prefix="result_cache",
-                                  labels=labels)
+        if backend is not None:
+            self._cache = backend
+            self._wire = getattr(backend, "wire_codec", False)
+        else:
+            self._cache = LruTtlCache(max_bytes, ttl_seconds,
+                                      metrics=metrics,
+                                      metric_prefix="result_cache",
+                                      labels=labels)
+            self._wire = False
 
     @classmethod
     def from_config(cls, config, metrics=None,
                     labels: Optional[dict] = None) -> "BrokerResultCache":
+        if metrics is not None and labels is None:
+            labels = {"broker": f"b{next(_broker_ids)}"}
+        backend = None
+        if config.get_str("pinot.broker.result.cache.backend") == "tiered":
+            from pinot_tpu.cache.tiered import tiered_backend_from_config
+            backend = tiered_backend_from_config(
+                config, "pinot.broker.result.cache", "result_cache",
+                broker_remote_key, metrics=metrics, labels=labels)
         return cls(
             max_bytes=config.get_int("pinot.broker.result.cache.bytes"),
             ttl_seconds=config.get_float(
@@ -54,7 +91,7 @@ class BrokerResultCache:
             enabled=config.get_bool("pinot.broker.result.cache.enabled"),
             cache_realtime=config.get_bool(
                 "pinot.broker.result.cache.realtime"),
-            metrics=metrics, labels=labels)
+            metrics=metrics, labels=labels, backend=backend)
 
     # ------------------------------------------------------------------
     def get(self, fingerprint: str, table: str,
@@ -62,7 +99,10 @@ class BrokerResultCache:
         if not self.enabled:
             return None
         payload = self._cache.get((fingerprint, table, epoch))
-        return loads(payload) if payload is not None else None
+        if payload is None:
+            return None
+        return (wire_loads_response(payload) if self._wire
+                else loads(payload))
 
     def put(self, fingerprint: str, table: str, epoch: str,
             resp: BrokerResponse) -> bool:
@@ -72,16 +112,53 @@ class BrokerResultCache:
         if not self.enabled or resp.exceptions or resp.trace is not None \
                 or resp.num_servers_responded != resp.num_servers_queried:
             return False
-        payload = dumps(resp)
+        payload = (wire_dumps_response(resp) if self._wire else dumps(resp))
         if payload is None:
             return False
         return self._cache.put((fingerprint, table, epoch), payload)
 
+    # -- hybrid-table offline partials ---------------------------------
+    def get_offline_partial(self, fingerprint: str, table: str,
+                            offline_epoch: str) -> Optional[tuple]:
+        """(results, server-level ExecutionStats or None) — the offline
+        side's merged per-server results for a hybrid query, keyed by
+        the OFFLINE epoch: realtime appends don't move it, so the
+        immutable side stays served from cache while the consuming side
+        re-executes every time. The stats ride along so a cache-served
+        response reports the same pruning counts as an uncached run."""
+        if not self.enabled:
+            return None
+        payload = self._cache.get(("off", fingerprint, table, offline_epoch))
+        if payload is None:
+            return None
+        return (wire_loads_results_stats(payload) if self._wire
+                else loads(payload))
+
+    def put_offline_partial(self, fingerprint: str, table: str,
+                            offline_epoch: str, results: list,
+                            stats=None) -> bool:
+        if not self.enabled or not results:
+            return False
+        payload = (wire_dumps_results(results, extra_stats=stats)
+                   if self._wire else dumps((list(results), stats)))
+        if payload is None:
+            return False
+        return self._cache.put(("off", fingerprint, table, offline_epoch),
+                               payload)
+
     def invalidate_table(self, table: str) -> int:
-        return self._cache.invalidate(lambda k: k[1] == table)
+        return self._cache.invalidate(
+            lambda k: (k[2] if len(k) == 4 else k[1]) == table)
 
     def clear(self) -> None:
         self._cache.clear()
+
+    def close(self) -> None:
+        """Release a tiered backend's remote connection pool (no-op for
+        the local backend)."""
+        close = getattr(self._cache, "close", None)
+        if close is not None:
+            close()
 
     @property
     def stats(self):

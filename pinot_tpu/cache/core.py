@@ -71,6 +71,15 @@ class LruTtlCache:
 
     # ------------------------------------------------------------------
     def get(self, key: Hashable) -> Optional[bytes]:
+        hit = self.get_with_ttl(key)
+        return None if hit is None else hit[0]
+
+    def get_with_ttl(self, key: Hashable
+                     ) -> Optional[Tuple[bytes, float]]:
+        """(payload, remaining seconds) or None. The remaining TTL lets
+        a tier serving another tier (the cache server) pass freshness
+        DOWN: an L1 back-fill stamped with a fresh full TTL would extend
+        the operator's staleness budget by up to 2x."""
         with self._lock:
             entry = self._entries.get(key)
             if entry is None:
@@ -78,7 +87,8 @@ class LruTtlCache:
                 self._meter("misses")
                 return None
             expires_at, payload = entry
-            if self._clock() >= expires_at:
+            now = self._clock()
+            if now >= expires_at:
                 del self._entries[key]
                 self._bytes -= len(payload)
                 self.stats.expirations += 1
@@ -89,17 +99,22 @@ class LruTtlCache:
             self._entries.move_to_end(key)
             self.stats.hits += 1
             self._meter("hits")
-            return payload
+            return payload, expires_at - now
 
-    def put(self, key: Hashable, payload: bytes) -> bool:
+    def put(self, key: Hashable, payload: bytes,
+            ttl_seconds: Optional[float] = None) -> bool:
+        """ttl_seconds overrides the cache default for THIS entry — the
+        remote cache server stores entries from tiers with different
+        freshness budgets, so TTL travels with the payload."""
         n = len(payload)
         if n > self.max_bytes:
             return False  # would evict the entire cache for one entry
+        ttl = self.ttl_seconds if ttl_seconds is None else float(ttl_seconds)
         with self._lock:
             old = self._entries.pop(key, None)
             if old is not None:
                 self._bytes -= len(old[1])
-            self._entries[key] = (self._clock() + self.ttl_seconds, payload)
+            self._entries[key] = (self._clock() + ttl, payload)
             self._bytes += n
             self.stats.puts += 1
             while self._bytes > self.max_bytes:
@@ -121,6 +136,19 @@ class LruTtlCache:
             self.stats.invalidations += len(doomed)
             self._gauge_bytes()
             return len(doomed)
+
+    def remove(self, key: Hashable) -> bool:
+        """O(1) keyed drop (invalidate() is a full scan — the cache
+        server's single-key DELETE must not stall every replica's
+        GET/SET behind an O(#entries) walk under the lock)."""
+        with self._lock:
+            entry = self._entries.pop(key, None)
+            if entry is None:
+                return False
+            self._bytes -= len(entry[1])
+            self.stats.invalidations += 1
+            self._gauge_bytes()
+            return True
 
     def clear(self) -> None:
         with self._lock:
@@ -165,3 +193,99 @@ def dumps(obj: Any) -> Optional[bytes]:
 
 def loads(payload: bytes) -> Any:
     return pickle.loads(payload)
+
+
+# ---------------------------------------------------------------------------
+# Wire codec: payloads that may cross the process boundary to the remote
+# cache tier. Pickle is fine for in-process copies but must never be
+# deserialized from a SHARED store (a poisoned entry would execute code on
+# every replica), so remote-capable tiers use the same typed DataTable
+# encoding the server->broker plane already speaks (server/datatable.py).
+# Decoding NEVER raises: an undecodable/foreign entry degrades to a miss.
+# ---------------------------------------------------------------------------
+
+#: payload discriminator tags (first byte of a wire payload)
+_WIRE_RESULTS = b"R"   # list of shape-tagged segment/partial results
+_WIRE_RESPONSE = b"B"  # one whole BrokerResponse
+
+
+def wire_dumps_results(results: list, extra_stats=None) -> Optional[bytes]:
+    """Encode a list of segment-result objects (+ optional server-level
+    ExecutionStats riding alongside, e.g. pruning counts for a cached
+    offline partial); None when any element is outside the typed
+    registry (callers skip caching, never fail)."""
+    from pinot_tpu.server import datatable
+    try:
+        return _WIRE_RESULTS + datatable.serialize_results(
+            list(results), extra_stats=extra_stats)
+    except Exception:  # noqa: BLE001 — "don't cache", never "fail query"
+        return None
+
+
+def wire_loads_results(payload: bytes) -> Optional[list]:
+    out = wire_loads_results_stats(payload)
+    return None if out is None else out[0]
+
+
+def wire_loads_results_stats(payload: bytes) -> Optional[tuple]:
+    """(results, extra ExecutionStats or None), or None on any decode
+    failure — undecodable entry == miss."""
+    from pinot_tpu.server import datatable
+    try:
+        if not payload or payload[:1] != _WIRE_RESULTS:
+            return None
+        results, exceptions, stats = \
+            datatable.deserialize_results(payload[1:])
+        if exceptions:
+            return None
+        return results, stats
+    except Exception:  # noqa: BLE001 — undecodable entry == miss
+        return None
+
+
+def wire_dumps_response(resp: Any) -> Optional[bytes]:
+    """Encode a BrokerResponse (trace-less, complete — the broker cache
+    refuses anything else before calling this)."""
+    from pinot_tpu.server import datatable
+    try:
+        rt = resp.result_table
+        table = (None if rt is None
+                 else (list(rt.columns), list(rt.column_types),
+                       [tuple(r) for r in rt.rows]))
+        blob = (
+            table,
+            [(int(e.get("errorCode", 200)), str(e.get("message", "")))
+             for e in resp.exceptions],
+            datatable._stats_tuple(resp.stats),
+            int(resp.num_servers_queried),
+            int(resp.num_servers_responded),
+            bool(resp.num_groups_limit_reached),
+        )
+        return _WIRE_RESPONSE + datatable.serialize_value(blob)
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def wire_loads_response(payload: bytes) -> Optional[Any]:
+    from pinot_tpu.query.reduce import BrokerResponse, ResultTable
+    from pinot_tpu.server import datatable
+    try:
+        if not payload or payload[:1] != _WIRE_RESPONSE:
+            return None
+        table, exc, stats, queried, responded, groups_limit = \
+            datatable.deserialize_value(payload[1:])
+        resp = BrokerResponse()
+        if table is not None:
+            cols, types, rows = table
+            resp.result_table = ResultTable(list(cols), list(types),
+                                            [tuple(r) for r in rows])
+        resp.exceptions = [{"errorCode": c, "message": m} for c, m in exc]
+        resp.stats = datatable._stats_from(stats)
+        resp.num_servers_queried = queried
+        resp.num_servers_responded = responded
+        resp.num_groups_limit_reached = groups_limit
+        return resp
+    except Exception:  # noqa: BLE001 — undecodable entry == miss
+        return None
+
+

@@ -1,0 +1,403 @@
+"""Remote cache tier: standalone cache-server role + resilient client.
+
+Reference parity: the memcached-style shared tier Druid deploys behind
+`useCache`/`populateCache` (druid.cache.type=memcached) and Pinot's
+shared-response-store proposals. One cache-server process holds a single
+`LruTtlCache` byte budget; every broker/server replica mounts it as L2
+through `RemoteCacheBackend`, so a result computed once warms the whole
+fleet.
+
+Wire protocol (utils/netframe.py framing, u32 LE length-prefixed):
+
+  request : JSON {"op": get|set|delete|stats|clear|ping, "key": str,
+                  "ttl": float?}  [+ one RAW payload frame when op=set]
+  response: JSON {"ok": bool, "hit": bool?, "stats": {...}?, "error": str?}
+            [+ one RAW payload frame when op=get hit]
+
+Keys are STRINGS: callers map their tuple keys to stable strings (and
+return None for keys that must not be shared — e.g. segment versions
+that are process-local generation stamps, not content CRCs).
+
+Failure semantics: the client NEVER raises into a query. Every error
+path returns miss/False, feeds the circuit breaker (CLOSED -> OPEN after
+K consecutive failures, OPEN -> HALF_OPEN probe after a cooldown,
+HALF_OPEN -> CLOSED on one success), and is metered. An unreachable
+cache server therefore degrades the fabric to L1-only at the cost of one
+fast refused connection per probe window.
+"""
+from __future__ import annotations
+
+import logging
+import queue
+import socket
+import socketserver
+import threading
+import time
+from typing import Dict, Optional
+
+from pinot_tpu.cache.core import LruTtlCache
+from pinot_tpu.utils.netframe import (MAX_FRAME, recv_frame, recv_raw_frame,
+                                      send_frame, send_raw_frame)
+
+log = logging.getLogger(__name__)
+
+
+class CacheServer:
+    """The cache-server role: GET/SET/DELETE/STATS over TCP, per-entry TTL.
+
+    One thread per connection (socketserver.ThreadingTCPServer, same shape
+    as controller/coordination.py); the LruTtlCache lock makes each op
+    atomic, so concurrent SET/GET on one key always observe a whole
+    payload, never a torn one."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 max_bytes: int = 512 << 20, ttl_seconds: float = 300.0,
+                 metrics=None):
+        self.cache = LruTtlCache(max_bytes, ttl_seconds, metrics=metrics,
+                                 metric_prefix="cache_server")
+        server = self
+
+        class _Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                sock = self.request
+                with server._conn_lock:
+                    server._conns.add(sock)
+                try:
+                    while True:
+                        req = recv_frame(sock)
+                        if req is None:
+                            return
+                        server._serve_one(sock, req)
+                except (ConnectionError, OSError, ValueError):
+                    pass  # client vanished / oversized frame: drop conn
+                finally:
+                    with server._conn_lock:
+                        server._conns.discard(sock)
+
+        class _Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._conns: set = set()
+        self._conn_lock = threading.Lock()
+        self._server = _Server((host, port), _Handler)
+        self.host, self.port = self._server.server_address
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------
+    def _serve_one(self, sock: socket.socket, req: dict) -> None:
+        op = req.get("op")
+        key = req.get("key")
+        if op == "set":
+            # payload frame ALWAYS follows a set header — read it even
+            # when the entry will be refused, or the stream desyncs
+            payload = recv_raw_frame(sock)
+            if payload is None:
+                raise ConnectionError("set without payload")
+            ok = isinstance(key, str) and self.cache.put(
+                key, payload, ttl_seconds=req.get("ttl"))
+            send_frame(sock, {"ok": bool(ok)})
+        elif op == "get":
+            hit = (self.cache.get_with_ttl(key)
+                   if isinstance(key, str) else None)
+            if hit is None:
+                send_frame(sock, {"ok": True, "hit": False})
+            else:
+                payload, remaining = hit
+                # remaining TTL rides along so the client's L1 back-fill
+                # inherits the entry's freshness instead of restarting it
+                send_frame(sock, {"ok": True, "hit": True,
+                                  "ttl": round(remaining, 3)})
+                send_raw_frame(sock, payload)
+        elif op == "delete":
+            n = int(self.cache.remove(key))
+            send_frame(sock, {"ok": True, "deleted": n})
+        elif op == "stats":
+            st = self.cache.stats
+            send_frame(sock, {"ok": True, "stats": {
+                "hits": st.hits, "misses": st.misses, "puts": st.puts,
+                "evictions": st.evictions, "expirations": st.expirations,
+                "entries": len(self.cache),
+                "bytes": self.cache.size_bytes}})
+        elif op == "clear":
+            self.cache.clear()
+            send_frame(sock, {"ok": True})
+        elif op == "ping":
+            send_frame(sock, {"ok": True})
+        else:
+            send_frame(sock, {"ok": False, "error": f"bad op {op!r}"})
+
+    # ------------------------------------------------------------------
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name=f"cache-server-{self.port}")
+        self._thread.start()
+
+    def stop(self) -> None:
+        """Full outage semantics, matching a process kill: the listener
+        closes AND every established connection is severed, so in-process
+        fault-injection tests exercise the same client error paths a real
+        crash would."""
+        self._server.shutdown()
+        self._server.server_close()
+        with self._conn_lock:
+            conns = list(self._conns)
+        for sock in conns:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+
+# ---------------------------------------------------------------------------
+# client side
+# ---------------------------------------------------------------------------
+
+#: circuit states (exported as the breaker gauge value)
+CIRCUIT_CLOSED, CIRCUIT_HALF_OPEN, CIRCUIT_OPEN = 0, 1, 2
+
+
+class CircuitBreaker:
+    """Trip after `failure_threshold` CONSECUTIVE failures; after
+    `reset_seconds` let exactly ONE probe through (half-open); a probe
+    success closes the circuit, a probe failure re-opens the window."""
+
+    def __init__(self, failure_threshold: int = 3,
+                 reset_seconds: float = 5.0,
+                 clock=time.monotonic, on_state_change=None):
+        self.failure_threshold = max(1, int(failure_threshold))
+        self.reset_seconds = float(reset_seconds)
+        self._clock = clock
+        self._state = CIRCUIT_CLOSED
+        self._failures = 0
+        self._opened_at = 0.0
+        self._probe_in_flight = False
+        self._lock = threading.Lock()
+        self._on_state_change = on_state_change
+
+    def _set_state(self, state: int) -> None:
+        if state != self._state:
+            self._state = state
+            if self._on_state_change is not None:
+                self._on_state_change(state)
+
+    @property
+    def state(self) -> int:
+        with self._lock:
+            if self._state == CIRCUIT_OPEN and \
+                    self._clock() - self._opened_at >= self.reset_seconds:
+                return CIRCUIT_HALF_OPEN
+            return self._state
+
+    def allow(self) -> bool:
+        """May a request go out now? In half-open, only the first caller
+        gets through until its verdict lands."""
+        with self._lock:
+            if self._state == CIRCUIT_CLOSED:
+                return True
+            if self._clock() - self._opened_at >= self.reset_seconds:
+                self._set_state(CIRCUIT_HALF_OPEN)
+                if not self._probe_in_flight:
+                    self._probe_in_flight = True
+                    return True
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._failures = 0
+            self._probe_in_flight = False
+            self._set_state(CIRCUIT_CLOSED)
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._probe_in_flight = False
+            if self._state != CIRCUIT_CLOSED:
+                # failed probe: restart the cooldown window
+                self._opened_at = self._clock()
+                self._set_state(CIRCUIT_OPEN)
+                return
+            self._failures += 1
+            if self._failures >= self.failure_threshold:
+                self._opened_at = self._clock()
+                self._set_state(CIRCUIT_OPEN)
+
+
+class _CacheConnection:
+    """One pooled socket to the cache server. NOT thread-safe by itself —
+    the pool hands a connection to one request at a time."""
+
+    def __init__(self, host: str, port: int, timeout: float):
+        self.host, self.port, self.timeout = host, port, timeout
+        self._sock: Optional[socket.socket] = None
+
+    def _connect(self) -> socket.socket:
+        if self._sock is None:
+            self._sock = socket.create_connection(
+                (self.host, self.port), timeout=self.timeout)
+        return self._sock
+
+    def request(self, header: dict,
+                payload: Optional[bytes] = None) -> tuple:
+        """Returns (response header dict, response payload or None)."""
+        sock = self._connect()
+        send_frame(sock, header)
+        if payload is not None:
+            send_raw_frame(sock, payload)
+        resp = recv_frame(sock)
+        if resp is None:
+            raise ConnectionError("cache server closed connection")
+        body = None
+        if resp.get("hit"):
+            body = recv_raw_frame(sock)
+            if body is None:
+                raise ConnectionError("cache server closed mid-payload")
+        return resp, body
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+
+class RemoteCacheBackend:
+    """Client for one cache server: connection pool + timeouts + breaker.
+
+    All operations are total functions — get returns None, put/delete
+    return False on ANY failure (timeout, refused, breaker open, frame
+    too large), never an exception. Metrics: remote_cache_{hits,misses,
+    errors,rejected} meters, remote_cache_request timer, and a
+    remote_cache_breaker_state gauge (0=closed 1=half-open 2=open)."""
+
+    def __init__(self, address: str, timeout_seconds: float = 2.0,
+                 pool_size: int = 2, failure_threshold: int = 3,
+                 reset_seconds: float = 5.0, metrics=None,
+                 labels: Optional[dict] = None):
+        host, port = address.rsplit(":", 1)
+        self.host, self.port = host, int(port)
+        self.timeout = float(timeout_seconds)
+        self._metrics = metrics
+        self._labels = labels
+        self.breaker = CircuitBreaker(failure_threshold, reset_seconds,
+                                      on_state_change=self._gauge_state)
+        self._pool: "queue.Queue[_CacheConnection]" = queue.Queue()
+        for _ in range(max(1, int(pool_size))):
+            self._pool.put(_CacheConnection(host, self.port, self.timeout))
+        self._gauge_state(CIRCUIT_CLOSED)
+        #: local tallies mirroring the meters (cheap asserts in tests)
+        self.hits = 0
+        self.misses = 0
+        self.errors = 0
+
+    # -- metrics -------------------------------------------------------
+    def _meter(self, name: str) -> None:
+        if self._metrics is not None:
+            self._metrics.add_meter(f"remote_cache_{name}",
+                                    labels=self._labels)
+
+    def _gauge_state(self, state: int) -> None:
+        if self._metrics is not None:
+            self._metrics.set_gauge("remote_cache_breaker_state", state,
+                                    labels=self._labels)
+
+    # -- core request plumbing ----------------------------------------
+    def _request(self, header: dict,
+                 payload: Optional[bytes] = None) -> Optional[tuple]:
+        """One breaker-guarded round trip; None when rejected/failed."""
+        if not self.breaker.allow():
+            self._meter("rejected")
+            return None
+        try:
+            conn = self._pool.get(timeout=self.timeout)
+        except queue.Empty:
+            # every pooled channel busy past the deadline: treat as a
+            # availability failure, not a correctness one
+            self.errors += 1
+            self._meter("errors")
+            self.breaker.record_failure()
+            return None
+        try:
+            t0 = time.perf_counter()
+            out = conn.request(header, payload)
+            if self._metrics is not None:
+                self._metrics.add_timing(
+                    "remote_cache_request",
+                    (time.perf_counter() - t0) * 1000.0, labels=self._labels)
+            self.breaker.record_success()
+            return out
+        except (ConnectionError, OSError, ValueError) as e:
+            conn.close()
+            self.errors += 1
+            self._meter("errors")
+            self.breaker.record_failure()
+            log.debug("remote cache request failed: %s", e)
+            return None
+        finally:
+            self._pool.put(conn)
+
+    # -- public ops ----------------------------------------------------
+    def get(self, key: str) -> Optional[bytes]:
+        hit = self.get_with_ttl(key)
+        return None if hit is None else hit[0]
+
+    def get_with_ttl(self, key: str
+                     ) -> Optional[tuple]:
+        """(payload, remaining server-side TTL seconds or None)."""
+        out = self._request({"op": "get", "key": key})
+        if out is None:
+            return None
+        resp, body = out
+        if resp.get("hit") and body is not None:
+            self.hits += 1
+            self._meter("hits")
+            ttl = resp.get("ttl")
+            return body, (float(ttl) if ttl is not None else None)
+        self.misses += 1
+        self._meter("misses")
+        return None
+
+    def put(self, key: str, payload: bytes,
+            ttl_seconds: Optional[float] = None) -> bool:
+        if len(payload) > MAX_FRAME:
+            return False
+        header: Dict[str, object] = {"op": "set", "key": key}
+        if ttl_seconds is not None:
+            header["ttl"] = float(ttl_seconds)
+        out = self._request(header, payload)
+        return bool(out is not None and out[0].get("ok"))
+
+    def delete(self, key: str) -> bool:
+        out = self._request({"op": "delete", "key": key})
+        return bool(out is not None and out[0].get("ok"))
+
+    def stats(self) -> Optional[dict]:
+        out = self._request({"op": "stats"})
+        return out[0].get("stats") if out is not None else None
+
+    def clear(self) -> bool:
+        out = self._request({"op": "clear"})
+        return bool(out is not None and out[0].get("ok"))
+
+    def ping(self) -> bool:
+        out = self._request({"op": "ping"})
+        return bool(out is not None and out[0].get("ok"))
+
+    def close(self) -> None:
+        while True:
+            try:
+                self._pool.get_nowait().close()
+            except queue.Empty:
+                return

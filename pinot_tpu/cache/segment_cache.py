@@ -21,7 +21,8 @@ import itertools
 import threading
 from typing import Any, Optional
 
-from pinot_tpu.cache.core import LruTtlCache, dumps, loads
+from pinot_tpu.cache.core import (LruTtlCache, dumps, loads,
+                                  wire_dumps_results, wire_loads_results)
 from pinot_tpu.query.context import QueryContext
 from pinot_tpu.segment.loader import ImmutableSegment
 
@@ -65,53 +66,106 @@ def is_cacheable_shape(ctx: QueryContext) -> bool:
     return bool(ctx.aggregations) or ctx.distinct
 
 
+def segment_remote_key(key) -> Optional[str]:
+    """Tuple key -> wire key string for the shared remote tier, or None
+    when the entry must stay process-local: 'gen'/'id' version stamps are
+    per-process counters — identical stamps on two instances would alias
+    DIFFERENT segment contents, so only content-CRC versions are shared."""
+    name, version, plan_fp = key
+    if not (isinstance(version, tuple) and version[0] == "crc"):
+        return None
+    return f"seg|{name}|crc:{version[1]}|{plan_fp}"
+
+
 class SegmentResultCache:
     """Per-segment partial results keyed by
     (segment name, segment version, plan fingerprint)."""
 
     def __init__(self, max_bytes: int = 256 << 20,
                  ttl_seconds: float = 300.0, enabled: bool = True,
-                 metrics=None, labels: Optional[dict] = None):
+                 metrics=None, labels: Optional[dict] = None,
+                 backend=None):
         """labels: metric labels (e.g. {'instance': id}) — several server
         instances in one process share the 'server' registry, so unlabeled
-        gauges would clobber each other."""
+        gauges would clobber each other.
+        backend: a prebuilt cache (e.g. cache/tiered.py TieredCache) to
+        use instead of the default local LruTtlCache. Remote-capable
+        backends switch the payload codec from pickle to the typed wire
+        encoding (cache/core.py wire_*): a shared store must never feed
+        pickle.loads, and an undecodable entry degrades to a miss."""
         self.enabled = enabled
-        self._cache = LruTtlCache(max_bytes, ttl_seconds, metrics=metrics,
-                                  metric_prefix="segment_result_cache",
-                                  labels=labels)
+        if backend is not None:
+            self._cache = backend
+            self._wire = getattr(backend, "wire_codec", False)
+        else:
+            self._cache = LruTtlCache(max_bytes, ttl_seconds,
+                                      metrics=metrics,
+                                      metric_prefix="segment_result_cache",
+                                      labels=labels)
+            self._wire = False
 
     @classmethod
     def from_config(cls, config, metrics=None,
                     labels: Optional[dict] = None) -> "SegmentResultCache":
+        backend = None
+        if config.get_str("pinot.server.segment.cache.backend") == "tiered":
+            from pinot_tpu.cache.tiered import tiered_backend_from_config
+            backend = tiered_backend_from_config(
+                config, "pinot.server.segment.cache",
+                "segment_result_cache", segment_remote_key,
+                metrics=metrics, labels=labels)
         return cls(
             max_bytes=config.get_int("pinot.server.segment.cache.bytes"),
             ttl_seconds=config.get_float(
                 "pinot.server.segment.cache.ttl.seconds"),
             enabled=config.get_bool("pinot.server.segment.cache.enabled"),
-            metrics=metrics, labels=labels)
+            metrics=metrics, labels=labels, backend=backend)
 
     # ------------------------------------------------------------------
+    def _decode(self, payload: bytes) -> Optional[Any]:
+        if self._wire:
+            results = wire_loads_results(payload)
+            return results[0] if results else None
+        return loads(payload)
+
+    def _encode(self, result: Any) -> Optional[bytes]:
+        return wire_dumps_results([result]) if self._wire else dumps(result)
+
     def get(self, segment: Any, plan_fp: str) -> Optional[Any]:
         if not self.enabled or not is_cacheable_segment(segment):
             return None
         payload = self._cache.get(
             (segment.name, segment_version(segment), plan_fp))
-        return loads(payload) if payload is not None else None
+        return self._decode(payload) if payload is not None else None
 
     def put(self, segment: Any, plan_fp: str, result: Any) -> bool:
         if not self.enabled or not is_cacheable_segment(segment):
             return False
-        payload = dumps(result)
+        payload = self._encode(result)
         if payload is None:
             return False
         return self._cache.put(
             (segment.name, segment_version(segment), plan_fp), payload)
 
-    def invalidate_segment(self, name: str) -> int:
-        return self._cache.invalidate(lambda k: k[0] == name)
+    def invalidate_segment(self, name: str, except_version=None) -> int:
+        """Drop cached partials for the named segment. except_version
+        spares entries of ONE version — a refresh-push replaces the
+        segment right after warmup populated the NEW version's entries,
+        and a name-only purge would wipe that warmup work along with the
+        stale version."""
+        return self._cache.invalidate(
+            lambda k: k[0] == name and (except_version is None
+                                        or k[1] != except_version))
 
     def clear(self) -> None:
         self._cache.clear()
+
+    def close(self) -> None:
+        """Release a tiered backend's remote connection pool (no-op for
+        the local backend)."""
+        close = getattr(self._cache, "close", None)
+        if close is not None:
+            close()
 
     @property
     def stats(self):

@@ -1,0 +1,154 @@
+"""TieredCache: process-local L1 over a shared remote L2.
+
+Composes the PR-1 `LruTtlCache` (L1) with a `RemoteCacheBackend` (L2)
+behind the SAME byte-payload interface, so `BrokerResultCache` and
+`SegmentResultCache` swap it in by config knob with zero call-site
+changes:
+
+  get: L1 first; on miss ask L2 (when the key is shareable and the
+       circuit allows); an L2 hit back-fills L1 so the next read is
+       local. Hits annotate the active trace node with cacheTier.
+  put: write-through — L1 always, L2 best-effort (failures feed the
+       breaker and are invisible to the query).
+
+`remote_key_fn(key) -> Optional[str]` maps the caller's tuple key to a
+stable wire string, or None for keys that MUST stay local — segment
+versions that are per-process generation stamps rather than content
+CRCs would collide across instances, so they never leave the process.
+
+Invalidation stays version-based: predicates run on L1 only; remote
+entries for a replaced segment/epoch are already unaddressable under
+their old key string and age out by TTL on the cache server.
+"""
+from __future__ import annotations
+
+from typing import Callable, Hashable, Optional
+
+from pinot_tpu.cache.core import LruTtlCache
+from pinot_tpu.cache.remote import RemoteCacheBackend
+from pinot_tpu.utils import tracing
+
+
+class TieredCache:
+    """L1 (local LruTtlCache) + L2 (RemoteCacheBackend) as one cache."""
+
+    #: entries from this backend may come from a SHARED store: callers
+    #: must encode/decode with the typed wire codec (cache/core.py
+    #: wire_*), never pickle — a poisoned shared entry fed to
+    #: pickle.loads would execute code on every replica. Any future
+    #: remote-capable backend must set this flag too.
+    wire_codec = True
+
+    def __init__(self, l1: LruTtlCache, l2: RemoteCacheBackend,
+                 remote_key_fn: Callable[[Hashable], Optional[str]],
+                 l2_ttl_seconds: Optional[float] = None):
+        self.l1 = l1
+        self.l2 = l2
+        self._remote_key = remote_key_fn
+        #: TTL stamped on remote entries; defaults to the L1 budget so
+        #: both tiers age together
+        self.l2_ttl_seconds = (l1.ttl_seconds if l2_ttl_seconds is None
+                               else float(l2_ttl_seconds))
+
+    # ------------------------------------------------------------------
+    def get(self, key: Hashable) -> Optional[bytes]:
+        payload, _tier = self.get_with_tier(key)
+        return payload
+
+    def get_with_tier(self, key: Hashable):
+        """(payload, tier) where tier is 'L1', 'L2' or None on miss."""
+        payload = self.l1.get(key)
+        if payload is not None:
+            self._annotate("L1")
+            return payload, "L1"
+        rkey = self._remote_key(key)
+        if rkey is not None:
+            hit = self.l2.get_with_ttl(rkey)
+            if hit is not None:
+                payload, remaining = hit
+                # back-fill L1 so the replica pays the RTT once — capped
+                # at the entry's REMAINING L2 TTL: a fresh full L1 TTL
+                # would stretch the staleness budget up to 2x (TTL is
+                # the only freshness bound for cache_realtime tables)
+                ttl = (self.l1.ttl_seconds if remaining is None
+                       else min(self.l1.ttl_seconds, remaining))
+                self.l1.put(key, payload, ttl_seconds=ttl)
+                self._annotate("L2")
+                return payload, "L2"
+        return None, None
+
+    def put(self, key: Hashable, payload: bytes) -> bool:
+        ok = self.l1.put(key, payload)
+        rkey = self._remote_key(key)
+        if rkey is not None:
+            self.l2.put(rkey, payload, ttl_seconds=self.l2_ttl_seconds)
+        return ok
+
+    @staticmethod
+    def _annotate(tier: str) -> None:
+        # L2 marks dominate: one remote hit in a request is the
+        # interesting signal even when sibling segments hit L1
+        if tier == "L2" or tracing.get_attr("cacheTier") is None:
+            tracing.annotate(cacheTier=tier)
+
+    # -- parity with LruTtlCache ---------------------------------------
+    def invalidate(self, predicate: Callable[[Hashable], bool]) -> int:
+        return self.l1.invalidate(predicate)
+
+    def clear(self, remote: bool = False) -> None:
+        """L1 always; the SHARED remote tier only on explicit request
+        (benchmarks measuring cold-start) — a routine local clear must
+        not cold-start every other replica."""
+        self.l1.clear()
+        if remote:
+            self.l2.clear()
+
+    @property
+    def stats(self):
+        return self.l1.stats
+
+    @property
+    def max_bytes(self) -> int:
+        return self.l1.max_bytes
+
+    @property
+    def ttl_seconds(self) -> float:
+        return self.l1.ttl_seconds
+
+    @property
+    def size_bytes(self) -> int:
+        return self.l1.size_bytes
+
+    def __len__(self) -> int:
+        return len(self.l1)
+
+    def close(self) -> None:
+        self.l2.close()
+
+
+def tiered_backend_from_config(config, tier_prefix: str, metric_prefix: str,
+                               remote_key_fn, metrics=None,
+                               labels=None) -> TieredCache:
+    """One tier's L1+L2 from the shared config knobs — the single place
+    both `BrokerResultCache.from_config` and
+    `SegmentResultCache.from_config` assemble their tiered backend, so
+    a new remote knob lands in both tiers at once.
+
+    tier_prefix: the tier's key family (e.g. 'pinot.broker.result.cache'
+    — supplies `.bytes`, `.ttl.seconds`, `.remote.address`); the client
+    knobs under 'pinot.cache.remote.*' are shared by every mount."""
+    l1 = LruTtlCache(config.get_int(f"{tier_prefix}.bytes"),
+                     config.get_float(f"{tier_prefix}.ttl.seconds"),
+                     metrics=metrics, metric_prefix=metric_prefix,
+                     labels=labels)
+    l2 = RemoteCacheBackend(
+        config.get_str(f"{tier_prefix}.remote.address"),
+        timeout_seconds=config.get_float(
+            "pinot.cache.remote.timeout.seconds"),
+        pool_size=config.get_int("pinot.cache.remote.pool.size"),
+        failure_threshold=config.get_int(
+            "pinot.cache.remote.breaker.failures"),
+        reset_seconds=config.get_float(
+            "pinot.cache.remote.breaker.reset.seconds"),
+        metrics=metrics, labels=labels)
+    return TieredCache(l1, l2, remote_key_fn)

@@ -1,0 +1,141 @@
+"""Segment warmup: replay recently cached query plans on segment load.
+
+ROADMAP item delivered: a rollout of a fresh immutable segment should not
+start cold. Every time the server caches a tier-2 partial it also logs
+(table, plan fingerprint, canonical SQL) into a per-table recency log;
+when a new immutable segment arrives, the warmup pass replays the logged
+plans against JUST that segment — populating the segment cache (and,
+through a tiered backend, the shared remote tier) — BEFORE the segment is
+published for queries. The first routed query then hits tier 2 instead of
+scanning.
+
+The log stores the SQL, not a parsed context: QueryContext is cheap to
+rebuild, and SQL is the only representation that round-trips the plan
+fingerprint exactly (fingerprint() is derived from the parsed tree).
+
+Failure semantics: warmup is strictly best-effort — any per-plan error is
+swallowed (the segment still loads, it just starts cold for that plan),
+and the pass is bounded by `max_plans` so a hot table's log can't stall
+segment rollout.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+
+class FingerprintLog:
+    """Per-table bounded recency log: plan fingerprint -> canonical SQL.
+
+    Re-recording an already-logged fingerprint refreshes its recency (an
+    OrderedDict move-to-end), so the replay set tracks the CURRENT
+    dashboard mix, not the first N plans ever seen."""
+
+    def __init__(self, max_plans_per_table: int = 64):
+        self.max_plans_per_table = max(1, int(max_plans_per_table))
+        self._tables: Dict[str, "OrderedDict[str, tuple]"] = {}
+        self._lock = threading.Lock()
+
+    def record(self, table: str, fingerprint: str, sql: str,
+               extra_filter: Optional[str] = None) -> None:
+        """extra_filter: the hybrid time-boundary predicate that was
+        ANDed into the plan server-side — the fingerprint covers the
+        merged tree, so replay needs it to reproduce the same key."""
+        with self._lock:
+            plans = self._tables.setdefault(table, OrderedDict())
+            if fingerprint in plans:
+                plans.move_to_end(fingerprint)
+            plans[fingerprint] = (sql, extra_filter)
+            while len(plans) > self.max_plans_per_table:
+                plans.popitem(last=False)
+
+    def plans(self, table: str) -> List[Tuple[str, str, Optional[str]]]:
+        """[(fingerprint, sql, extra_filter)] most-recent-last."""
+        with self._lock:
+            return [(fp, sql, extra)
+                    for fp, (sql, extra)
+                    in self._tables.get(table, OrderedDict()).items()]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return sum(len(p) for p in self._tables.values())
+
+
+class SegmentWarmup:
+    """The warmup pass: replay a table's logged plans on one segment."""
+
+    def __init__(self, fingerprint_log: FingerprintLog, segment_cache,
+                 max_plans: int = 32, use_tpu: bool = False,
+                 engine_fn=None, metrics=None,
+                 labels: Optional[dict] = None):
+        """engine_fn: zero-arg callable returning the server's shared
+        device engine (or None) — resolved lazily per warmup so the
+        engine exists by the time segments start arriving."""
+        self.log = fingerprint_log
+        self.segment_cache = segment_cache
+        self.max_plans = max(1, int(max_plans))
+        self.use_tpu = use_tpu
+        self._engine_fn = engine_fn
+        self._metrics = metrics
+        self._labels = labels
+        #: local tallies (cheap asserts in tests)
+        self.segments_warmed = 0
+        self.entries_warmed = 0
+
+    def warm(self, table: str, segment: Any) -> int:
+        """Replay logged plans against `segment`; returns entries warmed.
+        Never raises — a failed warmup only costs cold-start."""
+        from pinot_tpu.cache.segment_cache import (is_cacheable_segment,
+                                                   is_cacheable_shape)
+        from pinot_tpu.query.context import QueryContext
+        from pinot_tpu.query.executor import QueryExecutor
+
+        if self.segment_cache is None or not self.segment_cache.enabled \
+                or not is_cacheable_segment(segment):
+            return 0
+        plans = self.log.plans(table)
+        if not plans:
+            return 0
+        warmed = 0
+        # most recent plans first — when the budget cuts, keep the mix
+        # dashboards are refreshing NOW
+        for fingerprint, sql, extra_filter in reversed(
+                plans[-self.max_plans:]):
+            try:
+                ctx = QueryContext.from_sql(sql)
+                # the SAME merge the server execute path applies — the
+                # fingerprint hashes the merged tree, so any divergence
+                # would warm keys no routed query ever looks up
+                from pinot_tpu.query.context import merge_extra_filter
+                merge_extra_filter(ctx, extra_filter)
+                if not is_cacheable_shape(ctx):
+                    continue
+                if self.segment_cache.get(segment, fingerprint) is not None:
+                    # already warm — an L2 hit here ALSO back-filled L1,
+                    # which is exactly the rollout warmup we want
+                    warmed += 1
+                    continue
+                engine = self._engine_fn() if self._engine_fn else None
+                ex = QueryExecutor([segment], use_tpu=self.use_tpu,
+                                   engine=engine,
+                                   segment_cache=self.segment_cache)
+                ex.execute_context(ctx)
+                if self.segment_cache.get(segment, fingerprint) is not None:
+                    warmed += 1
+            except Exception:  # noqa: BLE001 — warmup must never block load
+                log.debug("warmup plan failed for %s on %s",
+                          fingerprint, getattr(segment, "name", "?"),
+                          exc_info=True)
+        if warmed:
+            self.segments_warmed += 1
+            self.entries_warmed += warmed
+            if self._metrics is not None:
+                self._metrics.add_meter("segment_warmup_segments",
+                                        labels=self._labels)
+                self._metrics.add_meter("segment_warmup_entries", warmed,
+                                        labels=self._labels)
+        return warmed

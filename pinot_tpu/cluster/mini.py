@@ -21,10 +21,11 @@ from pinot_tpu.server.query_server import (
 
 
 class MiniClusterServer:
-    def __init__(self, instance_id: str, use_tpu: bool = False):
+    def __init__(self, instance_id: str, use_tpu: bool = False, config=None):
         self.instance_id = instance_id
         self.data_manager = InstanceDataManager(instance_id)
-        self.executor = ServerQueryExecutor(self.data_manager, use_tpu=use_tpu)
+        self.executor = ServerQueryExecutor(self.data_manager,
+                                            use_tpu=use_tpu, config=config)
         self.transport = QueryServer(self.executor)
         # multi-stage worker endpoint (mailbox data plane + stage executor);
         # leaf aggregates route through the single-stage executor and its
@@ -45,6 +46,7 @@ class MiniClusterServer:
         self.mse_worker.stop()
         self.transport.stop()
         self.data_manager.shutdown()
+        self.executor.segment_cache.close()
 
     @property
     def address(self) -> str:
@@ -53,19 +55,60 @@ class MiniClusterServer:
 
 class MiniCluster:
     def __init__(self, num_servers: int = 2, use_tpu: bool = False,
-                 result_cache: bool = False):
+                 result_cache: bool = False, num_brokers: int = 1,
+                 cache_server: bool = False, config=None):
+        """cache_server: start an in-process CacheServer (the remote L2
+        role) and point every tier at it — brokers' result caches and
+        servers' segment caches become `tiered` automatically, so
+        replicas warm each other (cache/remote.py). config: a base
+        PinotConfiguration; cache_server=True layers the fabric knobs on
+        top of it."""
+        from pinot_tpu.utils.config import PinotConfiguration
+        self.cache_server = None
+        overrides = {}
+        if cache_server:
+            from pinot_tpu.cache.remote import CacheServer
+            from pinot_tpu.utils.metrics import get_registry
+            self.cache_server = CacheServer(
+                metrics=get_registry("cache_server"))
+            self.cache_server.start()
+            overrides = {
+                "pinot.server.segment.cache.backend": "tiered",
+                "pinot.server.segment.cache.remote.address":
+                    self.cache_server.address,
+                "pinot.broker.result.cache.backend": "tiered",
+                "pinot.broker.result.cache.remote.address":
+                    self.cache_server.address,
+            }
+        if overrides:
+            config = (config or PinotConfiguration()).with_overrides(overrides)
+        self.config = config
         self.servers: List[MiniClusterServer] = [
-            MiniClusterServer(f"server_{i}", use_tpu=use_tpu)
+            MiniClusterServer(f"server_{i}", use_tpu=use_tpu, config=config)
             for i in range(num_servers)]
         self.routing = BrokerRoutingManager()
         self._connections: Dict[str, ServerConnection] = {}
         self.broker: Optional[BrokerRequestHandler] = None
+        self.brokers: List[BrokerRequestHandler] = []
+        self._num_brokers = max(1, int(num_brokers))
         self.http: Optional[BrokerHttpServer] = None
         self._routes: Dict[str, RoutingTable] = {}
         #: opt-in tier-1 broker result cache (cache/broker_cache.py)
         self._result_cache_enabled = result_cache
 
     # ------------------------------------------------------------------
+    def _make_result_cache(self):
+        if not self._result_cache_enabled:
+            return None
+        from pinot_tpu.cache.broker_cache import BrokerResultCache
+        from pinot_tpu.utils.metrics import get_registry
+        if self.config is not None:
+            cfg = self.config.with_overrides(
+                {"pinot.broker.result.cache.enabled": True})
+            return BrokerResultCache.from_config(
+                cfg, metrics=get_registry("broker"))
+        return BrokerResultCache(metrics=get_registry("broker"))
+
     def start(self, with_http: bool = False) -> None:
         for s in self.servers:
             s.start()
@@ -76,15 +119,16 @@ class MiniCluster:
             workers={s.instance_id: s.mse_worker for s in self.servers},
             catalog_fn=self._catalog,
             table_workers_fn=self._table_workers)
-        result_cache = None
-        if self._result_cache_enabled:
-            from pinot_tpu.cache.broker_cache import BrokerResultCache
-            from pinot_tpu.utils.metrics import get_registry
-            result_cache = BrokerResultCache(
-                metrics=get_registry("broker"))
-        self.broker = BrokerRequestHandler(self.routing, self._connections,
-                                           mse_dispatcher=self.mse,
-                                           result_cache=result_cache)
+        # N broker replicas over the SAME routing view and server
+        # connections — each with its own (L1) result cache, sharing L2
+        # through the cache server when one is running
+        self.brokers = [
+            BrokerRequestHandler(self.routing, self._connections,
+                                 mse_dispatcher=self.mse,
+                                 result_cache=self._make_result_cache(),
+                                 config=self.config)
+            for _ in range(self._num_brokers)]
+        self.broker = self.brokers[0]
         if with_http:
             self.http = BrokerHttpServer(self.broker)
             self.http.start()
@@ -96,8 +140,13 @@ class MiniCluster:
             self.mse.stop()
         for c in self._connections.values():
             c.close()
+        for b in self.brokers:
+            if b.result_cache is not None:
+                b.result_cache.close()
         for s in self.servers:
             s.stop()
+        if self.cache_server is not None:
+            self.cache_server.stop()
 
     # -- multi-stage catalog / placement ------------------------------------
     def _catalog(self):

@@ -78,6 +78,38 @@ def run_controller(state_dir: str, port: int = 0, host: str = "127.0.0.1",
         server.stop()
 
 
+def run_cache_server(port: int = 0, host: str = "127.0.0.1", config=None,
+                     ready_event: Optional[threading.Event] = None,
+                     stop_event: Optional[threading.Event] = None) -> None:
+    """The cache-server role: one shared LruTtlCache byte budget serving
+    GET/SET/DELETE/STATS over TCP (cache/remote.py) — the L2 every
+    broker's result cache and server's segment cache mounts when its
+    backend knob says `tiered`. Stateless across restarts by design:
+    entries are recomputable, so durability would buy nothing."""
+    from pinot_tpu.cache.remote import CacheServer
+    from pinot_tpu.utils.config import PinotConfiguration
+    from pinot_tpu.utils.metrics import get_registry
+
+    cfg = config or PinotConfiguration()
+    if not port:
+        port = cfg.get_int("pinot.cache.server.port")
+    server = CacheServer(
+        host=host, port=port,
+        max_bytes=cfg.get_int("pinot.cache.server.bytes"),
+        ttl_seconds=cfg.get_float("pinot.cache.server.ttl.seconds"),
+        metrics=get_registry("cache_server"))
+    server.start()
+    print(f"cache server listening on {server.address}", flush=True)
+    if ready_event is not None:
+        ready_event.set()
+    stop = stop_event or threading.Event()
+    try:
+        while not stop.wait(2.0):
+            pass
+    finally:
+        server.stop()
+
+
 class ServerRole:
     """One server process: query transport + data manager + state watch."""
 

@@ -143,6 +143,9 @@ class QueryContext:
         `__str__`, which makes str() a stable serialization — no salted
         `hash()` anywhere, so the digest is reproducible across
         processes."""
+        memo = self.__dict__.get("_fp_memo")
+        if memo is not None:
+            return memo
         opts = sorted(
             (k.lower(), str(v)) for k, v in self.options.items()
             if k.lower() not in self._FINGERPRINT_OPT_DENYLIST)
@@ -161,7 +164,13 @@ class QueryContext:
             "exp:" + str(self.explain),
             "opt:" + "|".join(f"{k}={v}" for k, v in opts),
         ]
-        return hashlib.sha256("\n".join(parts).encode()).hexdigest()
+        # memoized: the server hot path fingerprints once for the warmup
+        # plan log and once for tier-2 cache keys; recomputing the full
+        # canonical serialization + sha256 per call is pure waste. The
+        # ONE post-parse mutation site (merge_extra_filter) invalidates.
+        fp = hashlib.sha256("\n".join(parts).encode()).hexdigest()
+        self._fp_memo = fp
+        return fp
 
     def filter_columns(self) -> List[str]:
         return self.filter.columns() if self.filter is not None else []
@@ -179,3 +188,20 @@ def _column_name(e: Expression) -> str:
     if isinstance(e, Function) and is_aggregation(e.name):
         return get_aggregation(e.name, e.args).result_name
     return str(e)
+
+
+def merge_extra_filter(ctx: QueryContext,
+                       extra_filter: Optional[str]) -> None:
+    """AND an expression string (the hybrid time-boundary predicate) into
+    ctx.filter, in place. This is the ONE canonical merge: tier-2 cache
+    keys hash the MERGED tree via ctx.fingerprint(), so the warmup replay
+    (cache/warmup.py) must merge bit-for-bit identically to the server
+    execute path (server/query_server.py) — both call here."""
+    if not extra_filter:
+        return
+    from pinot_tpu.ingest.transforms import parse_expression
+    from pinot_tpu.query.expressions import func
+    extra = parse_expression(extra_filter)
+    ctx.filter = (extra if ctx.filter is None
+                  else func("and", ctx.filter, extra))
+    ctx.__dict__.pop("_fp_memo", None)  # filter changed: digest is stale

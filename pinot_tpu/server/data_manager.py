@@ -56,7 +56,7 @@ class SegmentDataManager:
 class TableDataManager:
     """Ref BaseTableDataManager — one per table on a server."""
 
-    def __init__(self, table_name: str, listener=None):
+    def __init__(self, table_name: str, listener=None, warmup=None):
         self.table_name = table_name
         self._segments: Dict[str, SegmentDataManager] = {}
         self._lock = threading.Lock()
@@ -66,6 +66,12 @@ class TableDataManager:
         #: optional callback(event, table_name, segment_name) fired AFTER
         #: the mutation commits; events: "add" | "replace" | "remove"
         self._listener = listener
+        #: optional callback(table_name, segment) run BEFORE a segment is
+        #: published to queries — the cache-warmup replay hook
+        #: (cache/warmup.py): the first routed query on a fresh immutable
+        #: segment should hit tier 2, not scan. Must never raise into the
+        #: load path; failures only cost cold-start.
+        self._warmup = warmup
 
     @property
     def version(self) -> int:
@@ -77,6 +83,13 @@ class TableDataManager:
             self._listener(event, self.table_name, segment_name)
 
     def add_segment(self, segment: ImmutableSegment) -> None:
+        if self._warmup is not None:
+            # replay logged plans BEFORE the segment enters the serving
+            # map — its first query then hits warm cache tiers
+            try:
+                self._warmup(self.table_name, segment)
+            except Exception:  # noqa: BLE001 — warmup must not block load
+                pass
         sdm = SegmentDataManager(segment)
         with self._lock:
             old = self._segments.get(segment.name)
@@ -140,6 +153,7 @@ class InstanceDataManager:
         self._tables: Dict[str, TableDataManager] = {}
         self._lock = threading.Lock()
         self._segment_listeners: List = []
+        self._warmup_hook = None
 
     def add_segment_listener(self, fn) -> None:
         """Register callback(event, table_name, segment_name) fired on
@@ -147,6 +161,20 @@ class InstanceDataManager:
         after registration too)."""
         with self._lock:
             self._segment_listeners.append(fn)
+
+    def set_warmup_hook(self, fn) -> None:
+        """callback(table_name, segment) run before each segment add on
+        EVERY table (existing and future) — the cache-warmup replay.
+        Tables always route through _dispatch_warmup, so registration
+        order vs. table creation order doesn't matter."""
+        with self._lock:
+            self._warmup_hook = fn
+
+    def _dispatch_warmup(self, table_name: str, segment) -> None:
+        with self._lock:
+            fn = self._warmup_hook
+        if fn is not None:
+            fn(table_name, segment)
 
     def _dispatch_segment_event(self, event: str, table_name: str,
                                 segment_name: str) -> None:
@@ -160,7 +188,8 @@ class InstanceDataManager:
             tdm = self._tables.get(table_name)
             if tdm is None and create:
                 tdm = TableDataManager(table_name,
-                                       listener=self._dispatch_segment_event)
+                                       listener=self._dispatch_segment_event,
+                                       warmup=self._dispatch_warmup)
                 self._tables[table_name] = tdm
             return tdm
 

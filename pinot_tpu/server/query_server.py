@@ -55,6 +55,7 @@ class ServerQueryExecutor:
         #: stale-unaddressable on replace; the data-manager hook below
         #: additionally reclaims their bytes promptly.
         from pinot_tpu.cache.segment_cache import SegmentResultCache
+        from pinot_tpu.cache.warmup import FingerprintLog, SegmentWarmup
         from pinot_tpu.utils.metrics import get_registry
         labels = {"instance": data_manager.instance_id}
         if config is not None:
@@ -64,14 +65,69 @@ class ServerQueryExecutor:
             self.segment_cache = SegmentResultCache(
                 metrics=get_registry("server"), labels=labels)
         data_manager.add_segment_listener(self._on_segment_event)
+        # warmup fabric: log cacheable plans per table; replay them on
+        # every fresh immutable segment BEFORE it serves queries, so a
+        # rollout's first routed query hits tier 2 (cache/warmup.py)
+        warm_on = (config is None or config.get_bool(
+            "pinot.server.segment.warmup.enabled", True))
+        log_size = (config.get_int(
+            "pinot.server.segment.warmup.log.plans.per.table")
+            if config is not None else 64)
+        max_plans = (config.get_int("pinot.server.segment.warmup.max.plans")
+                     if config is not None else 32)
+        # a knob explicitly set to 0 means OFF (the classes themselves
+        # clamp to >=1, so 0 must be honored here, not passed through)
+        warm_on = warm_on and log_size > 0 and max_plans > 0
+        self._plan_log_enabled = warm_on
+        self.fingerprint_log = FingerprintLog(max(1, log_size))
+        self.warmup = SegmentWarmup(
+            self.fingerprint_log, self.segment_cache,
+            max_plans=max(1, max_plans), use_tpu=use_tpu,
+            engine_fn=self._shared_engine,
+            metrics=get_registry("server"), labels=labels)
+        if warm_on:
+            data_manager.set_warmup_hook(self.warmup.warm)
 
     def _on_segment_event(self, event: str, table_name: str,
                           segment_name: str) -> None:
         """TableDataManager version-bump hook: drop cached partials for a
         replaced/removed segment immediately (version keying already makes
-        them unreachable; this reclaims the bytes)."""
-        if event in ("replace", "remove"):
-            self.segment_cache.invalidate_segment(segment_name)
+        them unreachable; this reclaims the bytes). On replace, entries
+        for the LIVE version are spared — warmup just populated them
+        (add_segment warms before the swap commits), and wiping them
+        would re-introduce the rollout cold start warmup exists to
+        remove."""
+        if event not in ("replace", "remove"):
+            return
+        keep = None
+        if event == "replace":
+            from pinot_tpu.cache.segment_cache import segment_version
+            tdm = self.data_manager.table(table_name, create=False)
+            if tdm is not None:
+                sdms = tdm.acquire_segments([segment_name])
+                try:
+                    if sdms:
+                        keep = segment_version(sdms[0].segment)
+                finally:
+                    type(tdm).release_all(sdms)
+        self.segment_cache.invalidate_segment(segment_name,
+                                              except_version=keep)
+
+    def _record_plan(self, table_name: str, ctx, sql_or_ctx,
+                     extra_filter) -> None:
+        """Feed the warmup fingerprint log: cacheable-shape queries only
+        (the replay would be a no-op otherwise), and only when the raw
+        SQL is available to replay. extra_filter (the hybrid
+        time-boundary predicate) is logged alongside — the fingerprint
+        covers the MERGED filter tree, so replay must merge it too."""
+        if not self._plan_log_enabled or not isinstance(sql_or_ctx, str):
+            return
+        from pinot_tpu.cache.core import cache_bypassed
+        from pinot_tpu.cache.segment_cache import is_cacheable_shape
+        if is_cacheable_shape(ctx) and not cache_bypassed(ctx.options):
+            self.fingerprint_log.record(table_name, ctx.fingerprint(),
+                                        sql_or_ctx,
+                                        extra_filter=extra_filter)
 
     def _shared_engine(self):
         if not self.use_tpu:
@@ -96,11 +152,9 @@ class ServerQueryExecutor:
         try:
             ctx = (sql_or_ctx if isinstance(sql_or_ctx, QueryContext)
                    else QueryContext.from_sql(sql_or_ctx))
-            if extra_filter:
-                from pinot_tpu.ingest.transforms import parse_expression
-                from pinot_tpu.query.expressions import func
-                extra = parse_expression(extra_filter)
-                ctx.filter = extra if ctx.filter is None                     else func("and", ctx.filter, extra)
+            from pinot_tpu.query.context import merge_extra_filter
+            merge_extra_filter(ctx, extra_filter)
+            self._record_plan(table_name, ctx, sql_or_ctx, extra_filter)
             tdm = self.data_manager.table(table_name, create=False)
             if tdm is None:
                 return datatable.serialize_results(
@@ -138,12 +192,8 @@ class ServerQueryExecutor:
         try:
             ctx = (sql_or_ctx if isinstance(sql_or_ctx, QueryContext)
                    else QueryContext.from_sql(sql_or_ctx))
-            if extra_filter:
-                from pinot_tpu.ingest.transforms import parse_expression
-                from pinot_tpu.query.expressions import func
-                extra = parse_expression(extra_filter)
-                ctx.filter = extra if ctx.filter is None \
-                    else func("and", ctx.filter, extra)
+            from pinot_tpu.query.context import merge_extra_filter
+            merge_extra_filter(ctx, extra_filter)
             tdm = self.data_manager.table(table_name, create=False)
             if tdm is None:
                 yield datatable.serialize_results(

@@ -231,6 +231,13 @@ def main(argv=None) -> int:
                     help="instance .properties file (PinotConfiguration)")
     ss.set_defaults(fn=cmd_start_server)
 
+    scs = sub.add_parser("StartCacheServer",
+                         help="shared L2 cache tier (remote cache role)")
+    scs.add_argument("--port", type=int, default=0)
+    scs.add_argument("--config", default=None,
+                     help="instance .properties file (PinotConfiguration)")
+    scs.set_defaults(fn=cmd_start_cache_server)
+
     sb = sub.add_parser("StartBroker", help="HTTP broker joined to a "
                                             "controller")
     sb.add_argument("--coordinator", required=True, help="host:port")
@@ -293,6 +300,14 @@ def cmd_start_server(args) -> int:
     cfg = PinotConfiguration(getattr(args, "config", None))
     run_server(args.instance_id, args.coordinator,
                query_port=args.query_port, use_tpu=args.tpu, config=cfg)
+    return 0
+
+
+def cmd_start_cache_server(args) -> int:
+    from pinot_tpu.cluster.roles import run_cache_server
+    from pinot_tpu.utils.config import PinotConfiguration
+    run_cache_server(port=args.port,
+                     config=PinotConfiguration(getattr(args, "config", None)))
     return 0
 
 

@@ -30,6 +30,15 @@ KEYS: Dict[str, Any] = {
     "pinot.server.segment.cache.enabled": True,   # tier-2 partial cache
     "pinot.server.segment.cache.bytes": 256 << 20,
     "pinot.server.segment.cache.ttl.seconds": 300.0,
+    # tier-2 backend: local (process-private L1) | tiered (L1 + shared
+    # remote L2 at .remote.address — a cache-server role instance)
+    "pinot.server.segment.cache.backend": "local",
+    "pinot.server.segment.cache.remote.address": "127.0.0.1:9600",
+    # warmup: replay the recent-plan fingerprint log against freshly
+    # loaded immutable segments BEFORE they serve queries
+    "pinot.server.segment.warmup.enabled": True,
+    "pinot.server.segment.warmup.max.plans": 32,
+    "pinot.server.segment.warmup.log.plans.per.table": 64,
     "pinot.broker.http.port": 8099,
     "pinot.broker.fanout.threads": 16,
     "pinot.broker.adaptive.selector": "hybrid",  # latency|inflight|hybrid
@@ -41,6 +50,21 @@ KEYS: Dict[str, Any] = {
     # cache tables with a consuming side (appends don't move the routing
     # epoch, so hits may be TTL-stale) — off unless you can tolerate that
     "pinot.broker.result.cache.realtime": False,
+    # tier-1 backend: local | tiered (shared remote L2, see server keys)
+    "pinot.broker.result.cache.backend": "local",
+    "pinot.broker.result.cache.remote.address": "127.0.0.1:9600",
+    # hybrid tables: cache the offline side's merged partial keyed by the
+    # OFFLINE epoch so only the realtime side re-scatters
+    "pinot.broker.result.cache.hybrid.offline": True,
+    # the cache-server role (cluster/roles.py run_cache_server)
+    "pinot.cache.server.port": 9600,
+    "pinot.cache.server.bytes": 512 << 20,
+    "pinot.cache.server.ttl.seconds": 300.0,
+    # shared remote-client knobs (both tiers' L2 mounts)
+    "pinot.cache.remote.timeout.seconds": 2.0,
+    "pinot.cache.remote.pool.size": 2,
+    "pinot.cache.remote.breaker.failures": 3,
+    "pinot.cache.remote.breaker.reset.seconds": 5.0,
     "pinot.controller.port": 9000,
     "pinot.controller.deep.store.uri": "",
     "pinot.controller.retention.frequency.seconds": 60,
@@ -91,6 +115,15 @@ class PinotConfiguration:
 
     def get_str(self, key: str, default: str = "") -> str:
         return str(self.get(key, default))
+
+    def with_overrides(self, extra: Dict[str, Any]) -> "PinotConfiguration":
+        """A derived config: same properties-file contents, overrides
+        layered on top of (and winning over) the existing ones. Use this
+        instead of rebuilding from `_overrides` alone — that would drop
+        every file-based setting."""
+        derived = PinotConfiguration(overrides={**self._overrides, **extra})
+        derived._file = dict(self._file)
+        return derived
 
     def subset(self, prefix: str) -> Dict[str, Any]:
         """All effective keys under a dotted prefix (catalog + file +

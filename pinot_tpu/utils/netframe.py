@@ -18,19 +18,30 @@ MAX_FRAME = 64 << 20
 
 
 def send_frame(sock: socket.socket, obj: Any) -> None:
-    payload = json.dumps(obj).encode()
-    sock.sendall(LEN.pack(len(payload)) + payload)
+    send_raw_frame(sock, json.dumps(obj).encode())
 
 
 def recv_frame(sock: socket.socket) -> Optional[dict]:
+    body = recv_raw_frame(sock)
+    return None if body is None else json.loads(body)
+
+
+def send_raw_frame(sock: socket.socket, payload: bytes) -> None:
+    """Length-prefixed RAW bytes (no JSON) — used for binary payloads
+    (cache entries) interleaved with JSON control frames on one channel.
+    JSON frames are the same framing with a json.dumps/loads layer, so
+    both kinds stay in sync by construction."""
+    sock.sendall(LEN.pack(len(payload)) + payload)
+
+
+def recv_raw_frame(sock: socket.socket) -> Optional[bytes]:
     hdr = recv_exact(sock, 4)
     if hdr is None:
         return None
     n = LEN.unpack(hdr)[0]
     if n > MAX_FRAME:
         raise ValueError(f"frame too large: {n}")
-    body = recv_exact(sock, n)
-    return None if body is None else json.loads(body)
+    return recv_exact(sock, n)
 
 
 def recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:

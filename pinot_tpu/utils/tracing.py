@@ -86,6 +86,14 @@ def active() -> bool:
     return _current.get() is not None
 
 
+def get_attr(name: str, default: Any = None) -> Any:
+    """Read an attr off the CURRENT trace node (default when tracing is
+    off or the attr is unset) — lets cross-cutting annotators implement
+    set-if-absent / dominance rules."""
+    node = _current.get()
+    return default if node is None else node.attrs.get(name, default)
+
+
 def annotate(**attrs) -> None:
     """Attach attrs to the CURRENT trace node (no-op when tracing is off).
     Used for cross-cutting marks like cacheHit that belong to whichever

@@ -1,0 +1,833 @@
+"""Distributed cache fabric (pinot_tpu/cache/remote|tiered|warmup):
+cache-server role, tiered L1/L2 backends, circuit breaker, segment
+warmup replay, hybrid offline-partial caching, epoch memoization.
+
+The hard parts covered explicitly: a cache-server outage must degrade to
+local-only with ZERO failed queries (breaker open -> half-open -> closed
+on recovery), concurrent SET/GET on one key must never return a torn
+payload, and replicas must serve hits for work only a sibling performed.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pinot_tpu.cache import (CacheServer, FingerprintLog, LruTtlCache,
+                             RemoteCacheBackend, SegmentResultCache,
+                             TieredCache, segment_version)
+from pinot_tpu.cache.core import (wire_dumps_response, wire_dumps_results,
+                                  wire_loads_response, wire_loads_results)
+from pinot_tpu.cache.remote import (CIRCUIT_CLOSED, CIRCUIT_HALF_OPEN,
+                                    CIRCUIT_OPEN, CircuitBreaker)
+from pinot_tpu.cache.segment_cache import segment_remote_key
+from pinot_tpu.cluster.mini import MiniCluster
+from pinot_tpu.models import Schema, TableConfig
+from pinot_tpu.query.context import QueryContext
+from pinot_tpu.segment.creator import SegmentCreator
+from pinot_tpu.segment.loader import load_segment
+from pinot_tpu.utils.config import PinotConfiguration
+
+
+def _schema():
+    return Schema.from_dict({
+        "schemaName": "t",
+        "dimensionFieldSpecs": [{"name": "d", "dataType": "LONG"}],
+        "metricFieldSpecs": [{"name": "m", "dataType": "LONG"}]})
+
+
+def _table_config():
+    return TableConfig.from_dict({"tableName": "t", "tableType": "OFFLINE"})
+
+
+def _build(tmp_path, name, d, m):
+    out = str(tmp_path / name)
+    SegmentCreator(_table_config(), _schema()).build(
+        {"d": np.asarray(d, np.int64), "m": np.asarray(m, np.int64)},
+        out, name)
+    return load_segment(out)
+
+
+@pytest.fixture()
+def cache_server():
+    s = CacheServer(max_bytes=8 << 20, ttl_seconds=60.0)
+    s.start()
+    yield s
+    s.stop()
+
+
+# ---------------------------------------------------------------------------
+class TestCacheServerProtocol:
+    def test_get_set_delete_stats_roundtrip(self, cache_server):
+        be = RemoteCacheBackend(cache_server.address)
+        try:
+            assert be.ping()
+            assert be.get("k") is None          # miss on empty
+            assert be.put("k", b"payload")
+            assert be.get("k") == b"payload"
+            st = be.stats()
+            assert st["entries"] == 1 and st["hits"] == 1
+            assert be.delete("k")
+            assert be.get("k") is None
+            assert be.put("a", b"1") and be.put("b", b"2")
+            assert be.clear()
+            assert be.stats()["entries"] == 0
+        finally:
+            be.close()
+
+    def test_delete_is_keyed_not_a_scan(self, cache_server):
+        be = RemoteCacheBackend(cache_server.address)
+        try:
+            be.put("a", b"xx")
+            be.put("b", b"yy")
+            assert be.delete("a")
+            assert be.get("a") is None and be.get("b") == b"yy"
+            # O(1) keyed remove on the underlying cache
+            assert not cache_server.cache.remove("a")   # already gone
+            assert cache_server.cache.remove("b")
+            assert cache_server.cache.size_bytes == 0
+        finally:
+            be.close()
+
+    def test_per_entry_ttl(self, cache_server):
+        be = RemoteCacheBackend(cache_server.address)
+        try:
+            be.put("short", b"x", ttl_seconds=0.05)
+            be.put("long", b"y")                # server default: 60s
+            assert be.get("short") == b"x"
+            time.sleep(0.12)
+            assert be.get("short") is None      # expired server-side
+            assert be.get("long") == b"y"
+        finally:
+            be.close()
+
+    def test_bad_op_and_bad_key_are_refused_not_fatal(self, cache_server):
+        import socket
+
+        from pinot_tpu.utils.netframe import recv_frame, send_frame
+        sock = socket.create_connection(
+            (cache_server.host, cache_server.port), timeout=2)
+        try:
+            send_frame(sock, {"op": "bogus"})
+            assert recv_frame(sock)["ok"] is False
+            send_frame(sock, {"op": "get", "key": 123})  # non-string key
+            assert recv_frame(sock) == {"ok": True, "hit": False}
+            # the connection survived both
+            send_frame(sock, {"op": "ping"})
+            assert recv_frame(sock)["ok"] is True
+        finally:
+            sock.close()
+
+
+class TestCircuitBreaker:
+    def test_transitions_closed_open_halfopen_closed(self):
+        t = [0.0]
+        br = CircuitBreaker(failure_threshold=3, reset_seconds=5.0,
+                            clock=lambda: t[0])
+        assert br.state == CIRCUIT_CLOSED and br.allow()
+        br.record_failure()
+        br.record_failure()
+        assert br.state == CIRCUIT_CLOSED      # below threshold
+        br.record_failure()
+        assert br.state == CIRCUIT_OPEN
+        assert not br.allow()                  # open: reject fast
+        t[0] = 5.1
+        assert br.state == CIRCUIT_HALF_OPEN
+        assert br.allow()                      # exactly ONE probe
+        assert not br.allow()                  # second caller still held
+        br.record_success()
+        assert br.state == CIRCUIT_CLOSED and br.allow()
+
+    def test_failed_probe_restarts_cooldown(self):
+        t = [0.0]
+        br = CircuitBreaker(failure_threshold=1, reset_seconds=5.0,
+                            clock=lambda: t[0])
+        br.record_failure()
+        assert br.state == CIRCUIT_OPEN
+        t[0] = 5.1
+        assert br.allow()                      # half-open probe
+        br.record_failure()                    # probe failed
+        assert br.state == CIRCUIT_OPEN
+        t[0] = 9.0                             # inside the NEW window
+        assert not br.allow()
+        t[0] = 10.3
+        assert br.allow()
+
+    def test_success_resets_consecutive_count(self):
+        br = CircuitBreaker(failure_threshold=3)
+        br.record_failure()
+        br.record_failure()
+        br.record_success()                    # consecutive run broken
+        br.record_failure()
+        br.record_failure()
+        assert br.state == CIRCUIT_CLOSED
+
+
+class TestRemoteBackendResilience:
+    @staticmethod
+    def _dead_address() -> str:
+        # a port nothing listens on anymore
+        srv = CacheServer()
+        srv.start()
+        addr = srv.address
+        srv.stop()
+        return addr
+
+    def test_unreachable_server_never_raises(self):
+        addr = self._dead_address()
+        be = RemoteCacheBackend(addr, timeout_seconds=0.5,
+                                failure_threshold=3, reset_seconds=60.0)
+        try:
+            for _ in range(4):
+                assert be.get("k") is None
+                assert not be.put("k", b"v")
+            assert be.breaker.state == CIRCUIT_OPEN
+            assert be.errors >= 3
+            # open circuit: requests are rejected without touching a socket
+            t0 = time.perf_counter()
+            assert be.get("k") is None
+            assert time.perf_counter() - t0 < 0.1
+        finally:
+            be.close()
+
+    def test_oversized_payload_refused_client_side(self, cache_server):
+        from pinot_tpu.utils.netframe import MAX_FRAME
+        be = RemoteCacheBackend(cache_server.address)
+        try:
+            class _Huge(bytes):
+                def __len__(self):
+                    return MAX_FRAME + 1
+            assert not be.put("k", _Huge())
+            assert be.breaker.state == CIRCUIT_CLOSED  # no failure recorded
+        finally:
+            be.close()
+
+    def test_breaker_state_exported_as_gauge(self):
+        from pinot_tpu.utils.metrics import MetricsRegistry
+        m = MetricsRegistry("fabric_test")
+        be = RemoteCacheBackend(self._dead_address(), timeout_seconds=0.3,
+                                failure_threshold=1, reset_seconds=60.0,
+                                metrics=m, labels={"role": "t"})
+        try:
+            be.get("k")
+            assert be.breaker.state == CIRCUIT_OPEN
+            text = m.prometheus_text()
+            assert "remote_cache_breaker_state" in text
+            assert 'remote_cache_breaker_state{role="t"} 2' in text
+        finally:
+            be.close()
+
+
+# ---------------------------------------------------------------------------
+class TestTieredCache:
+    def test_l2_hit_backfills_l1(self, cache_server):
+        str_key = lambda k: str(k)  # noqa: E731
+        a = TieredCache(LruTtlCache(1 << 20, 60),
+                        RemoteCacheBackend(cache_server.address), str_key)
+        b = TieredCache(LruTtlCache(1 << 20, 60),
+                        RemoteCacheBackend(cache_server.address), str_key)
+        try:
+            a.put("k", b"shared")
+            # b never stored it: L1 miss, L2 hit, L1 back-fill
+            payload, tier = b.get_with_tier("k")
+            assert payload == b"shared" and tier == "L2"
+            assert b.l1.get("k") == b"shared"
+            payload, tier = b.get_with_tier("k")
+            assert tier == "L1"                # RTT paid exactly once
+        finally:
+            a.close()
+            b.close()
+
+    def test_non_shareable_keys_stay_local(self, cache_server):
+        tc = TieredCache(LruTtlCache(1 << 20, 60),
+                         RemoteCacheBackend(cache_server.address),
+                         lambda k: None)       # nothing is shareable
+        try:
+            tc.put("k", b"private")
+            assert tc.get("k") == b"private"   # L1 serves it
+            assert cache_server.cache.stats.puts == 0  # never hit the wire
+        finally:
+            tc.close()
+
+    def test_backfill_inherits_remaining_l2_ttl(self, cache_server):
+        """An L2 hit back-fills L1 with the entry's REMAINING TTL — a
+        fresh full TTL would stretch the staleness budget up to 2x
+        (TTL is the only freshness bound for cache_realtime tables)."""
+        a = RemoteCacheBackend(cache_server.address)
+        b = TieredCache(LruTtlCache(1 << 20, 60),
+                        RemoteCacheBackend(cache_server.address), str)
+        try:
+            a.put("k", b"v", ttl_seconds=0.15)
+            payload, tier = b.get_with_tier("k")
+            assert payload == b"v" and tier == "L2"
+            time.sleep(0.2)
+            # without TTL inheritance this would live 60s in b's L1
+            assert b.l1.get("k") is None
+        finally:
+            a.close()
+            b.close()
+
+    def test_local_clear_spares_the_shared_tier(self, cache_server):
+        tc = TieredCache(LruTtlCache(1 << 20, 60),
+                         RemoteCacheBackend(cache_server.address), str)
+        try:
+            tc.put("k", b"v")
+            tc.clear()                         # routine local clear
+            assert len(tc.l1) == 0
+            assert tc.get("k") == b"v"         # L2 still warm
+            tc.clear(remote=True)
+            assert tc.l2.get("k") is None
+        finally:
+            tc.close()
+
+
+class TestTornPayloads:
+    def test_concurrent_set_get_one_key_never_torn(self, cache_server):
+        """Satellite: hammer one key from writer + reader threads through
+        real sockets; every read must be a WHOLE payload, never a splice
+        of two writes."""
+        patterns = [bytes([0x41 + i]) * 4096 for i in range(4)]
+        be = RemoteCacheBackend(cache_server.address, pool_size=4)
+        be.put("k", patterns[0])
+        stop = threading.Event()
+        errs = []
+
+        def writer(idx):
+            i = idx
+            while not stop.is_set():
+                be.put("k", patterns[i % len(patterns)])
+                i += 1
+
+        def reader():
+            while not stop.is_set():
+                got = be.get("k")
+                if got is not None and got not in patterns:
+                    errs.append(got[:8])
+                    return
+
+        threads = [threading.Thread(target=writer, args=(i,), daemon=True)
+                   for i in range(2)]
+        threads += [threading.Thread(target=reader, daemon=True)
+                    for _ in range(2)]
+        for t in threads:
+            t.start()
+        time.sleep(1.0)
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+        be.close()
+        assert not errs, f"torn payload observed: {errs}"
+
+
+# ---------------------------------------------------------------------------
+class TestWireCodec:
+    """Satellite: payloads crossing the wire use the typed DataTable serde
+    (a shared store must never feed pickle.loads), and an undecodable
+    entry is a MISS, never an exception."""
+
+    def _resp(self):
+        from pinot_tpu.query.reduce import BrokerResponse, ResultTable
+        r = BrokerResponse(result_table=ResultTable(
+            ["d", "cnt"], ["LONG", "LONG"], [(1, 10), (2, 20)]))
+        r.num_servers_queried = 2
+        r.num_servers_responded = 2
+        r.stats.num_docs_scanned = 42
+        return r
+
+    def test_response_roundtrip(self):
+        payload = wire_dumps_response(self._resp())
+        assert payload is not None and payload[:1] == b"B"
+        back = wire_loads_response(payload)
+        assert back.result_table.rows == [(1, 10), (2, 20)]
+        assert back.result_table.columns == ["d", "cnt"]
+        assert back.num_servers_queried == 2
+        assert back.stats.num_docs_scanned == 42
+
+    def test_results_roundtrip(self):
+        from pinot_tpu.query.results import AggregationResult, ExecutionStats
+        res = AggregationResult([3.0], ExecutionStats(num_docs_scanned=7))
+        payload = wire_dumps_results([res])
+        assert payload is not None and payload[:1] == b"R"
+        back = wire_loads_results(payload)
+        assert len(back) == 1
+        assert back[0].intermediates == [3.0]
+        assert back[0].stats.num_docs_scanned == 7
+
+    def test_results_roundtrip_with_server_stats(self):
+        from pinot_tpu.cache.core import wire_loads_results_stats
+        from pinot_tpu.query.results import AggregationResult, ExecutionStats
+        res = AggregationResult([1.0], ExecutionStats())
+        extra = ExecutionStats(num_segments_pruned=5)
+        payload = wire_dumps_results([res], extra_stats=extra)
+        back, stats = wire_loads_results_stats(payload)
+        assert len(back) == 1
+        assert stats.num_segments_pruned == 5
+
+    def test_undecodable_entries_fall_through(self):
+        import pickle
+        for garbage in (b"", b"Rjunk", b"Bjunk", b"\x00\x01\x02",
+                        pickle.dumps({"poisoned": True})):
+            assert wire_loads_results(garbage) is None
+            assert wire_loads_response(garbage) is None
+
+    def test_unencodable_objects_skip_caching(self):
+        assert wire_dumps_results([object()]) is None
+        assert wire_dumps_response(object()) is None
+
+    def test_tiered_segment_cache_treats_garbage_as_miss(self, cache_server,
+                                                         tmp_path):
+        seg = _build(tmp_path, "wc0", range(10), range(10))
+        backend = TieredCache(LruTtlCache(1 << 20, 60),
+                              RemoteCacheBackend(cache_server.address),
+                              segment_remote_key)
+        sc = SegmentResultCache(backend=backend)
+        fp = QueryContext.from_sql("SELECT SUM(m) FROM t").fingerprint()
+        rkey = segment_remote_key((seg.name, segment_version(seg), fp))
+        assert rkey is not None                # crc-versioned: shareable
+        backend.l2.put(rkey, b"corrupted entry")
+        assert sc.get(seg, fp) is None         # miss, not an exception
+        backend.close()
+
+    def test_generation_stamped_segments_never_shared(self):
+        # non-crc versions are process-local counters: sharing them would
+        # alias different contents across instances
+        assert segment_remote_key(("s", ("gen", 3), "fp")) is None
+        assert segment_remote_key(("s", ("id", 12345), "fp")) is None
+        assert segment_remote_key(("s", ("crc", 99), "fp")) is not None
+
+
+# ---------------------------------------------------------------------------
+class TestEpochMemoization:
+    """Satellite: epoch() hashes the segment set once per mutation, not
+    once per cacheable query."""
+
+    def _route(self):
+        from pinot_tpu.broker.routing import (RoutingTable, SegmentInfo,
+                                              TableRoute)
+        tr = TableRoute("t_OFFLINE")
+        tr.segments["s0"] = SegmentInfo("s0", ["srv0"], version=1)
+        return RoutingTable(offline=tr), tr, SegmentInfo
+
+    def test_no_mutation_hashes_once(self):
+        rt, _, _ = self._route()
+        e1 = rt.epoch()
+        e2 = rt.epoch()
+        assert e1 == e2
+        assert rt.epoch_computes == 1
+
+    def test_every_mutation_kind_invalidates(self):
+        rt, tr, SegmentInfo = self._route()
+        seen = {rt.epoch()}
+        tr.segments["s1"] = SegmentInfo("s1", ["srv0"], version=2)   # set
+        seen.add(rt.epoch())
+        del tr.segments["s1"]                                        # del
+        seen.add(rt.epoch())
+        tr.segments.update(s2=SegmentInfo("s2", ["srv0"], version=3))
+        seen.add(rt.epoch())
+        tr.segments.pop("s2")
+        seen.add(rt.epoch())
+        tr.segments.clear()
+        seen.add(rt.epoch())
+        assert rt.epoch_computes == 6          # one hash per mutation
+        assert len(seen) == 4  # {s0}, {s0,s1}, {s0,s2}, {} (adds repeat)
+
+    def test_time_boundary_invalidates(self):
+        rt, _, _ = self._route()
+        e1 = rt.epoch()
+        rt.time_boundary = 42
+        assert rt.epoch() != e1
+        assert rt.epoch_computes == 2
+
+    def test_suffix_addressed_route_keeps_memo(self):
+        """get_route('t_OFFLINE') must return a cached single-side view —
+        a fresh wrapper per call would carry an empty memo and re-hash
+        every query."""
+        from pinot_tpu.broker.routing import (BrokerRoutingManager,
+                                              RoutingTable, SegmentInfo,
+                                              TableRoute)
+        mgr = BrokerRoutingManager()
+        tr = TableRoute("t_OFFLINE")
+        tr.segments["s0"] = SegmentInfo("s0", ["srv"], version=1)
+        mgr.set_route("t", RoutingTable(offline=tr))
+        view = mgr.get_route("t_OFFLINE")
+        assert mgr.get_route("t_OFFLINE") is view
+        e = view.epoch()
+        assert mgr.get_route("t_OFFLINE").epoch() == e
+        assert view.epoch_computes == 1
+        # mutations flow through the SHARED TableRoute into the view
+        tr.segments["s1"] = SegmentInfo("s1", ["srv"], version=2)
+        assert mgr.get_route("t_OFFLINE").epoch() != e
+        # set_route drops the stale view
+        mgr.set_route("t", RoutingTable(offline=TableRoute("t_OFFLINE")))
+        assert mgr.get_route("t_OFFLINE") is not view
+
+    def test_concurrent_mutations_never_lose_an_invalidation(self):
+        """mutation_version bumps must be atomic: a lost increment would
+        leave the memo valid for a segment set it no longer matches."""
+        from pinot_tpu.broker.routing import (RoutingTable, SegmentInfo,
+                                              TableRoute)
+        tr = TableRoute("t_OFFLINE")
+        rt = RoutingTable(offline=tr)
+        n_threads, per_thread = 4, 200
+        barrier = threading.Barrier(n_threads)
+
+        def mutate(tid):
+            barrier.wait()
+            for i in range(per_thread):
+                tr.segments[f"s{tid}_{i}"] = SegmentInfo(
+                    f"s{tid}_{i}", ["srv"], version=i)
+        threads = [threading.Thread(target=mutate, args=(t,))
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        e_settled = rt.epoch()
+        # every one of the 800 bumps was observed: the memoized epoch
+        # reflects the full final segment set
+        tr2 = TableRoute("t_OFFLINE")
+        for k, v in tr.segments.items():
+            tr2.segments[k] = v
+        assert RoutingTable(offline=tr2).epoch() == e_settled
+
+    def test_offline_epoch_survives_realtime_mutation(self):
+        from pinot_tpu.broker.routing import (RoutingTable, SegmentInfo,
+                                              TableRoute)
+        off, rt_side = TableRoute("t_OFFLINE"), TableRoute("t_REALTIME")
+        off.segments["o0"] = SegmentInfo("o0", ["srv0"], version=1)
+        rt = RoutingTable(offline=off, realtime=rt_side)
+        eo = rt.offline_epoch()
+        n = rt.epoch_computes
+        rt_side.segments["r0"] = SegmentInfo("r0", ["srv1"], version=9)
+        assert rt.offline_epoch() == eo        # key stays addressable
+        assert rt.epoch_computes == n          # and was not re-hashed
+        assert rt.epoch() != eo                # but the FULL epoch moved
+
+
+# ---------------------------------------------------------------------------
+class TestFingerprintLog:
+    def test_bounded_with_recency_refresh(self):
+        fl = FingerprintLog(max_plans_per_table=3)
+        for i in range(3):
+            fl.record("t", f"fp{i}", f"sql{i}")
+        fl.record("t", "fp0", "sql0")          # refresh oldest
+        fl.record("t", "fp3", "sql3")          # evicts fp1, NOT fp0
+        fps = [fp for fp, _, _ in fl.plans("t")]
+        assert fps == ["fp2", "fp0", "fp3"]
+        assert len(fl) == 3
+
+    def test_extra_filter_travels(self):
+        fl = FingerprintLog()
+        fl.record("t", "fp", "SELECT 1", extra_filter="ts <= 99")
+        assert fl.plans("t") == [("fp", "SELECT 1", "ts <= 99")]
+
+
+# ---------------------------------------------------------------------------
+@pytest.fixture()
+def fabric_cluster(tmp_path):
+    """Two brokers + two servers sharing one in-process cache server,
+    with fast breaker knobs for the fault-injection tests."""
+    cfg = PinotConfiguration(overrides={
+        "pinot.cache.remote.timeout.seconds": 1.0,
+        "pinot.cache.remote.breaker.reset.seconds": 0.3,
+    })
+    c = MiniCluster(num_servers=2, result_cache=True, num_brokers=2,
+                    cache_server=True, config=cfg)
+    c.start()
+    c.add_table("t")
+    for i in range(4):
+        c.add_segment("t", _build(tmp_path, f"f{i}", range(100), [i] * 100),
+                      server_idx=i % 2)
+    yield c, tmp_path
+    c.stop()
+
+
+class TestFabricSharing:
+    def test_broker_b_hits_what_only_broker_a_executed(self, fabric_cluster):
+        c, _ = fabric_cluster
+        sql = "SELECT COUNT(*), SUM(m) FROM t WHERE d < 50"
+        cold = c.brokers[0].handle(sql)
+        assert not cold.exceptions and not cold.cache_hit
+        warm = c.brokers[1].handle(sql)        # this broker never executed
+        assert warm.cache_hit
+        assert warm.result_table.rows == cold.result_table.rows
+        # the hit came over the wire: broker B's L2 client saw it
+        assert c.brokers[1].result_cache._cache.l2.hits >= 1
+
+    def test_server_replica_serves_partials_it_never_scanned(
+            self, fabric_cluster):
+        c, tmp_path = fabric_cluster
+        sql = "SELECT SUM(m) FROM t"
+        c.brokers[0].handle(sql)               # all segments cached, L2 too
+        seg = _build(tmp_path, "f0", range(100), [0] * 100)  # f0's content
+        sc1 = c.servers[1].executor.segment_cache
+        fp = QueryContext.from_sql(sql).fingerprint()
+        # server 1 never scanned f0 (it lives on server 0), yet its
+        # tiered cache serves the partial from the shared tier
+        l2_hits = sc1._cache.l2.hits
+        assert sc1.get(seg, fp) is not None
+        assert sc1._cache.l2.hits == l2_hits + 1
+
+    def test_warmup_on_replica_load(self, fabric_cluster):
+        c, tmp_path = fabric_cluster
+        sql = "SELECT COUNT(*), SUM(m) FROM t WHERE d >= 10"
+        c.brokers[0].handle(sql)               # logs the plan on both servers
+        # replicate f0 (server 0's segment) onto server 1: the load-time
+        # warmup replays the log and finds the partial already shared
+        seg = _build(tmp_path, "f0", range(100), [0] * 100)
+        warm = c.servers[1].executor.warmup
+        before = warm.entries_warmed
+        c.servers[1].data_manager.table("t_OFFLINE").add_segment(seg)
+        assert warm.entries_warmed > before
+        assert warm.segments_warmed >= 1
+
+
+class TestWarmupAcceptance:
+    def test_fresh_segment_first_query_hits_tier2(self, tmp_path):
+        """Loading an immutable segment replays the fingerprint log, so
+        its FIRST routed query is a tier-2 hit, not a scan."""
+        c = MiniCluster(num_servers=1)
+        c.start()
+        try:
+            c.add_table("t")
+            c.add_segment("t", _build(tmp_path, "w0", range(100), [1] * 100),
+                          server_idx=0)
+            sql = "SELECT d, SUM(m) FROM t GROUP BY d ORDER BY d LIMIT 5"
+            c.query(sql)                       # caches w0 + logs the plan
+            warm = c.servers[0].executor.warmup
+            assert warm.entries_warmed == 0    # nothing replayed yet
+            c.add_segment("t", _build(tmp_path, "w1", range(50), [2] * 50),
+                          server_idx=0)
+            assert warm.entries_warmed >= 1    # replayed on load
+            sc = c.servers[0].executor.segment_cache
+            hits0, misses0 = sc.stats.hits, sc.stats.misses
+            r = c.query(sql)                   # first query routed at w1
+            assert not r.exceptions
+            assert sc.stats.hits == hits0 + 2  # BOTH segments hit
+            assert sc.stats.misses == misses0  # w1 never missed
+        finally:
+            c.stop()
+
+    def test_replace_keeps_warmed_new_version(self, tmp_path):
+        """A refresh-push replaces the segment right after warmup ran on
+        the new version; the replace purge must spare those entries or
+        the rollout starts cold anyway."""
+        c = MiniCluster(num_servers=1)
+        c.start()
+        try:
+            c.add_table("t")
+            c.add_segment("t", _build(tmp_path, "rw0", range(100), [1] * 100),
+                          server_idx=0)
+            sql = "SELECT SUM(m) FROM t"
+            c.query(sql)                       # cache + log the plan
+            out = str(tmp_path / "rw0v2")      # same name, new content
+            SegmentCreator(_table_config(), _schema()).build(
+                {"d": np.arange(100, dtype=np.int64),
+                 "m": np.full(100, 5, np.int64)}, out, "rw0")
+            seg2 = load_segment(out)
+            c.add_segment("t", seg2, server_idx=0)  # warm, then replace
+            sc = c.servers[0].executor.segment_cache
+            fp = QueryContext.from_sql(sql).fingerprint()
+            assert sc.get(seg2, fp) is not None  # warmup survived the purge
+            r = c.query(sql)
+            assert not r.exceptions
+            assert r.rows[0][0] == 500           # and it is the NEW data
+        finally:
+            c.stop()
+
+    def test_zero_knobs_disable_warmup(self, tmp_path):
+        cfg = PinotConfiguration(overrides={
+            "pinot.server.segment.warmup.max.plans": 0})
+        c = MiniCluster(num_servers=1, config=cfg)
+        c.start()
+        try:
+            c.add_table("t")
+            c.add_segment("t", _build(tmp_path, "z0", range(10), [1] * 10),
+                          server_idx=0)
+            c.query("SELECT SUM(m) FROM t")
+            assert len(c.servers[0].executor.fingerprint_log) == 0
+            c.add_segment("t", _build(tmp_path, "z1", range(10), [2] * 10),
+                          server_idx=0)
+            assert c.servers[0].executor.warmup.entries_warmed == 0
+        finally:
+            c.stop()
+
+    def test_warmup_disabled_by_config(self, tmp_path):
+        cfg = PinotConfiguration(overrides={
+            "pinot.server.segment.warmup.enabled": False})
+        c = MiniCluster(num_servers=1, config=cfg)
+        c.start()
+        try:
+            c.add_table("t")
+            c.add_segment("t", _build(tmp_path, "wd0", range(10), [1] * 10),
+                          server_idx=0)
+            c.query("SELECT SUM(m) FROM t")
+            c.add_segment("t", _build(tmp_path, "wd1", range(10), [2] * 10),
+                          server_idx=0)
+            assert c.servers[0].executor.warmup.entries_warmed == 0
+        finally:
+            c.stop()
+
+
+class TestFaultInjection:
+    def test_outage_degrades_to_local_only_with_zero_failures(
+            self, fabric_cluster):
+        """Satellite + acceptance: kill the cache server mid-query-loop —
+        zero failed queries, breaker opens (visible in metrics), L1 keeps
+        serving repeats; a restarted server closes the breaker again."""
+        c, _ = fabric_cluster
+        broker = c.brokers[0]
+        l2 = broker.result_cache._cache.l2
+
+        queries = [f"SELECT COUNT(*), SUM(m) FROM t WHERE d < {n}"
+                   for n in range(2, 12)]
+        for sql in queries[:4]:                # healthy fabric
+            assert not broker.handle(sql).exceptions
+        assert l2.breaker.state == CIRCUIT_CLOSED
+
+        port = c.cache_server.port
+        c.cache_server.stop()                  # ---- outage ----
+        for sql in queries[4:]:                # fresh plans force L2 traffic
+            r = broker.handle(sql)
+            assert not r.exceptions, r.exceptions
+        assert l2.breaker.state == CIRCUIT_OPEN
+        # L1-only operation: repeats still hit locally
+        assert broker.handle(queries[5]).cache_hit
+        from pinot_tpu.utils.metrics import get_registry
+        assert "remote_cache_breaker_state" in \
+            get_registry("broker").prometheus_text()
+
+        # ---- recovery: same port, breaker probes half-open -> closed ----
+        restarted = CacheServer(port=port, max_bytes=8 << 20)
+        restarted.start()
+        c.cache_server = restarted             # fixture stop() reaps it
+        time.sleep(0.35)                       # past the reset window
+        assert l2.breaker.state == CIRCUIT_HALF_OPEN
+        r = broker.handle("SELECT SUM(m) FROM t WHERE d > 90")  # probe rides
+        assert not r.exceptions
+        assert l2.breaker.state == CIRCUIT_CLOSED
+        # the fabric is shared again: broker B hits broker A's fresh entry
+        assert c.brokers[1].handle(
+            "SELECT SUM(m) FROM t WHERE d > 90").cache_hit
+
+    def test_server_side_tier_degrades_too(self, fabric_cluster):
+        c, tmp_path = fabric_cluster
+        c.cache_server.stop()
+        # segment loads (warmup replay) and queries keep working L1-only
+        c.add_segment("t", _build(tmp_path, "deg0", range(30), [5] * 30),
+                      server_idx=0)
+        r = c.brokers[0].handle("SELECT COUNT(*) FROM t")
+        assert not r.exceptions
+        assert r.rows[0][0] == 430
+
+
+# ---------------------------------------------------------------------------
+class TestHybridOfflinePartial:
+    """Satellite: the offline side of a hybrid table is cached against the
+    OFFLINE epoch; only the realtime side re-scatters."""
+
+    def _hybrid(self, tmp_path_factory, result_cache=True):
+        from pinot_tpu.models import (DataType, FieldSpec, FieldType, Schema,
+                                      TableConfig, TableType)
+        schema = Schema("hy", [
+            FieldSpec("ts", DataType.LONG, FieldType.DATE_TIME),
+            FieldSpec("val", DataType.INT, FieldType.METRIC)])
+        tc = TableConfig("hy", TableType.OFFLINE)
+        tc.retention.time_column = "ts"
+
+        def build(tmp, arrs, name):
+            out = str(tmp / name)
+            SegmentCreator(tc, schema).build(arrs, out, name)
+            return load_segment(out)
+
+        off = build(tmp_path_factory.mktemp("hy_off"), {
+            "ts": np.arange(0, 100, dtype=np.int64),
+            "val": np.ones(100, dtype=np.int32)}, "hy_off")
+        rt = build(tmp_path_factory.mktemp("hy_rt"), {
+            "ts": np.arange(80, 200, dtype=np.int64),
+            "val": np.full(120, 2, dtype=np.int32)}, "hy_rt")
+        c = MiniCluster(num_servers=2, result_cache=result_cache)
+        c.start()
+        c.add_table("hy", "OFFLINE", time_column="ts")
+        c.add_table("hy", "REALTIME", time_column="ts", time_boundary=99)
+        c.add_segment("hy", off, 0, "OFFLINE")    # offline ONLY on server 0
+        c.add_segment("hy", rt, 1, "REALTIME")    # realtime ONLY on server 1
+        return c
+
+    def test_offline_side_served_from_cache(self, tmp_path_factory):
+        c = self._hybrid(tmp_path_factory)
+        try:
+            sql = "SELECT COUNT(*), SUM(val) FROM hy"
+            first = c.query(sql)
+            assert not first.exceptions
+            assert first.rows[0] == (200, pytest.approx(300))
+            assert not first.cache_hit         # whole-result uncacheable
+            # sever the OFFLINE server: if the cached offline partial is
+            # real, the next hybrid query still answers completely
+            c.servers[0].transport.stop()
+            c._connections["server_0"].close()
+            again = c.query(sql)
+            assert not again.exceptions, again.exceptions
+            assert again.rows == first.rows
+            # bypass must re-scatter to the dead offline server and fail
+            r = c.query(sql + " OPTION(skipCache=true)")
+            assert r.exceptions
+        finally:
+            c.stop()
+
+    def test_realtime_side_stays_fresh(self, tmp_path_factory):
+        from pinot_tpu.ingest.mutable_segment import MutableSegment
+        from pinot_tpu.models import (DataType, FieldSpec, FieldType, Schema,
+                                      TableConfig, TableType)
+        c = self._hybrid(tmp_path_factory)
+        try:
+            schema = Schema("hy", [
+                FieldSpec("ts", DataType.LONG, FieldType.DATE_TIME),
+                FieldSpec("val", DataType.INT, FieldType.METRIC)])
+            mut = MutableSegment("hy__0__0__1",
+                                 TableConfig("hy", TableType.REALTIME),
+                                 schema)
+            mut.index({"ts": 300, "val": 7})
+            c.servers[1].data_manager.table("hy_REALTIME").add_segment(mut)
+            rt = c.routing.get_route("hy").realtime
+            from pinot_tpu.broker.routing import SegmentInfo
+            rt.segments[mut.name] = SegmentInfo(
+                mut.name, ["server_1"], version=0)
+            sql = "SELECT COUNT(*), SUM(val) FROM hy"
+            n1 = c.query(sql).rows[0][0]
+            mut.index({"ts": 301, "val": 7})   # append: no epoch move
+            n2 = c.query(sql).rows[0][0]       # offline from cache, RT fresh
+            assert n2 == n1 + 1
+        finally:
+            c.stop()
+
+    def test_incomplete_offline_plan_not_cached(self, tmp_path_factory):
+        """A segment with no placeable replica is silently dropped from
+        the plan, and placement is outside the epoch — a partial missing
+        its rows must NOT be cached as complete."""
+        from pinot_tpu.broker.routing import SegmentInfo
+        c = self._hybrid(tmp_path_factory)
+        try:
+            rt = c.routing.get_route("hy")
+            rt.offline.segments["ghost"] = SegmentInfo("ghost", [], version=7)
+            sql = "SELECT COUNT(*) FROM hy"
+            r = c.query(sql)
+            assert not r.exceptions        # routing tolerates the drop
+            fp = QueryContext.from_sql(sql).fingerprint()
+            assert c.broker.result_cache.get_offline_partial(
+                fp, "hy", rt.offline_epoch()) is None
+        finally:
+            c.stop()
+
+    def test_disabled_by_knob(self, tmp_path_factory):
+        c = self._hybrid(tmp_path_factory)
+        try:
+            c.broker.config = PinotConfiguration(overrides={
+                "pinot.broker.result.cache.hybrid.offline": False})
+            sql = "SELECT COUNT(*) FROM hy"
+            c.query(sql)
+            c.servers[0].transport.stop()
+            c._connections["server_0"].close()
+            assert c.query(sql).exceptions     # nothing was cached
+        finally:
+            c.stop()
