@@ -13,6 +13,7 @@ Scores are 'lower is better':
 """
 from __future__ import annotations
 
+import math
 import threading
 from typing import Dict, List, Optional, Set
 
@@ -38,6 +39,19 @@ class AdaptiveServerSelector:
             cur = self._ewma.get(server)
             self._ewma[server] = latency_s if cur is None else \
                 (1 - self.alpha) * cur + self.alpha * latency_s
+
+    def latency_quantile(self, q: float) -> float:
+        """Quantile (seconds) over the per-server latency EWMAs — the
+        hedged-scatter trigger delay: a request still pending past the
+        fleet's p95 is in the slow tail worth hedging ("The Tail at
+        Scale"). 0.0 until any latency has been observed (callers clamp
+        with the configured floor)."""
+        with self._lock:
+            vals = sorted(self._ewma.values())
+        if not vals:
+            return 0.0
+        idx = min(len(vals) - 1, max(0, math.ceil(q * len(vals)) - 1))
+        return vals[idx]
 
     # -- selection -------------------------------------------------------
     def score(self, server: str) -> float:

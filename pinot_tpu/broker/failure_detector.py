@@ -40,6 +40,18 @@ class ConnectionFailureDetector:
                           self.max_backoff_s)
             e.retry_at = time.time() + backoff
 
+    def mark_timeout(self, server: str) -> None:
+        """A deadline miss is evidence of SLOWNESS, not death: apply one
+        flat base backoff so the next few queries prefer other replicas,
+        without the exponential escalation (or failure-count growth)
+        reserved for hard connection failures — a recovered server
+        re-enters routing after a single interval."""
+        with self._lock:
+            e = self._entries.get(server)
+            if e is None:
+                e = self._entries[server] = _Entry()
+            e.retry_at = max(e.retry_at, time.time() + self.base_backoff_s)
+
     def mark_success(self, server: str) -> None:
         with self._lock:
             self._entries.pop(server, None)

@@ -9,7 +9,9 @@ from __future__ import annotations
 
 import threading
 import time
-from concurrent.futures import ThreadPoolExecutor
+import uuid
+from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor
+from concurrent.futures import wait as _fut_wait
 from typing import Dict, List, Optional, Tuple
 
 from pinot_tpu.query.context import QueryContext
@@ -19,6 +21,35 @@ from pinot_tpu.query.reduce import BrokerResponse, reduce_results
 from pinot_tpu.server import datatable
 from pinot_tpu.server.query_server import ServerConnection
 from pinot_tpu.broker.routing import BrokerRoutingManager
+from pinot_tpu.utils.accounting import BrokerTimeoutError
+from pinot_tpu.utils.failpoints import fire
+
+
+class _ScatterUnit:
+    """One plan entry's lifecycle through scatter/gather: a primary
+    attempt, at most one hedge (speculative duplicate on another
+    replica), and — on hard failure — a one-shot retry that spawns fresh
+    units. `done` flips exactly once, when the FIRST clean response for
+    this (table, segment set) merges; every later duplicate is discarded,
+    so hedged partials can never double-count."""
+
+    __slots__ = ("server", "table", "names", "extra", "retried",
+                 "done", "hedge_tried", "hedged", "live", "fallback")
+
+    def __init__(self, server: str, table: str, names: List[str],
+                 extra: Optional[str], retried: bool = False):
+        self.server = server          # primary replica (hedges exclude it)
+        self.table = table
+        self.names = names
+        self.extra = extra
+        self.retried = retried        # retry units never hedge or re-retry
+        self.done = False
+        self.hedge_tried = False      # placement attempted (once only)
+        self.hedged = False           # a hedge request is actually in flight
+        self.live = 0                 # in-flight attempts
+        #: an ERRORED payload received while a twin was still racing —
+        #: held back so a clean twin can win, merged only if none does
+        self.fallback = None
 
 
 class BrokerRequestHandler:
@@ -40,6 +71,37 @@ class BrokerRequestHandler:
             result_cache = BrokerResultCache.from_config(
                 config, metrics=get_registry("broker"))
         self.result_cache = result_cache
+        from pinot_tpu.utils.metrics import get_registry
+        self._metrics = get_registry("broker")
+        #: pruned-to-zero memo (cache/broker_cache.py NegativeResultCache)
+        #: — independent of the whole-result cache and on by default
+        from pinot_tpu.cache.broker_cache import NegativeResultCache
+        # share THIS broker's metric label with the result cache so the
+        # two caches' series correlate; fall back to a fresh label when
+        # no result cache exists to borrow from
+        from pinot_tpu.cache.broker_cache import _broker_ids
+        neg_labels = getattr(self.result_cache, "labels", None) or \
+            {"broker": f"b{next(_broker_ids)}"}
+        if config is not None:
+            self._negative_cache = NegativeResultCache.from_config(
+                config, metrics=self._metrics, labels=neg_labels)
+            self._hedge_enabled = config.get_bool(
+                "pinot.broker.hedge.enabled")
+            self._hedge_min_s = config.get_int(
+                "pinot.broker.hedge.delay.min.ms") / 1000.0
+            self._hedge_max_s = config.get_int(
+                "pinot.broker.hedge.delay.max.ms") / 1000.0
+            self._default_timeout_ms = float(
+                config.get_int("pinot.broker.timeout.ms"))
+        else:
+            self._negative_cache = NegativeResultCache(
+                metrics=self._metrics, labels=neg_labels)
+            self._hedge_enabled = False
+            self._hedge_min_s, self._hedge_max_s = 0.025, 1.0
+            self._default_timeout_ms = 60000.0
+        #: query ids must be unique ACROSS brokers — two brokers' counters
+        #: both start at 1, and the server's accountant keys cancels by id
+        self._broker_nonce = uuid.uuid4().hex[:6]
         #: per-table QPS limits (ref queryquota/; None = no quotas)
         self.quota_manager = quota_manager
         #: adaptive selector stats feed (routing.selector, may be None)
@@ -55,6 +117,12 @@ class BrokerRequestHandler:
             failure_detector = ConnectionFailureDetector()
         self.failure_detector = failure_detector
         self._pool = ThreadPoolExecutor(max_workers=max_fanout_threads)
+        #: cancels get their OWN tiny pool: at deadline expiry the
+        #: fan-out pool's threads are blocked on the very reads being
+        #: cancelled, so a cancel queued there would fire only after the
+        #: abandoned read drained — defeating its purpose
+        self._cancel_pool = ThreadPoolExecutor(
+            max_workers=2, thread_name_prefix="broker-cancel")
         self._request_id = 0
         self._lock = threading.Lock()
 
@@ -84,19 +152,57 @@ class BrokerRequestHandler:
                 base = base[: -len(suffix)]
         return self.quota_manager.try_acquire(base)
 
+    def _timeout_ms(self, ctx: QueryContext) -> float:
+        """End-to-end budget for one query, highest precedence first:
+        OPTION(timeoutMs=...) / SET timeoutMs, a per-table config
+        override (`pinot.broker.timeout.ms.<logicalTable>`), then
+        `pinot.broker.timeout.ms`."""
+        opt = ctx.options.get("timeoutMs")
+        if opt:
+            try:
+                return max(1.0, float(opt))
+            except ValueError:
+                pass
+        if self.config is not None:
+            per_table = self.config.get(
+                f"pinot.broker.timeout.ms.{ctx.table}")
+            if per_table is not None:
+                return max(1.0, float(per_table))
+        return self._default_timeout_ms
+
+    def _hedge_delay_s(self) -> Optional[float]:
+        """Adaptive hedge trigger: p95 over the selector's per-server
+        latency EWMAs, clamped to the configured floor/ceiling. None
+        when hedging is off."""
+        if not self._hedge_enabled:
+            return None
+        base = (self._selector.latency_quantile(0.95)
+                if self._selector is not None else 0.0)
+        return min(max(base, self._hedge_min_s), self._hedge_max_s)
+
     def _timed_request(self, conn, server, physical_table, sql,
-                       segment_names, request_id, extra_filter):
+                       segment_names, request_id, extra_filter,
+                       deadline=None, query_id=None):
         """conn.request wrapped with adaptive-selector stats (latency +
-        in-flight, ref adaptiveserverselector's ServerRoutingStats)."""
+        in-flight, ref adaptiveserverselector's ServerRoutingStats).
+        The remaining budget is computed HERE, on the pool thread at
+        send time — computing it at submit time would inflate the
+        shipped budget by however long the task sat in the fan-out
+        queue."""
+        fire("broker.scatter.before", server=server, table=physical_table)
+        timeout_ms = (max(1.0, (deadline - time.time()) * 1000.0)
+                      if deadline is not None else None)
         sel = self._selector
         if sel is None:
             return conn.request(physical_table, sql, segment_names,
-                                request_id, extra_filter)
+                                request_id, extra_filter,
+                                timeout_ms=timeout_ms, query_id=query_id)
         sel.record_start(server)
         t0 = time.time()
         try:
             return conn.request(physical_table, sql, segment_names,
-                                request_id, extra_filter)
+                                request_id, extra_filter,
+                                timeout_ms=timeout_ms, query_id=query_id)
         finally:
             sel.record_end(server, time.time() - t0)
 
@@ -162,9 +268,38 @@ class BrokerRequestHandler:
                         hit.time_used_ms = (time.time() - start) * 1000.0
                         return hit
 
+        # -- negative cache: pruned-to-zero plans ----------------------
+        # independent of (and cheaper than) the whole-result cache: a
+        # dashboard misfire whose pruning selects NO segment has an empty
+        # answer by construction — memoize the emptiness, epoch-keyed,
+        # and skip routing + scatter + reduce on repeats
+        neg_key = None
+        if self._negative_cache.enabled and not ctx.explain \
+                and ctx.options.get("trace", "").lower() != "true":
+            from pinot_tpu.cache.broker_cache import cache_bypassed
+            if not cache_bypassed(ctx.options):
+                neg_epoch = route.epoch()
+                if not neg_epoch.startswith("<torn:"):
+                    neg_key = (ctx.fingerprint(), ctx.table, neg_epoch)
+                    if self._negative_cache.hit(*neg_key):
+                        resp = reduce_results(ctx, [])
+                        resp.cache_hit = True
+                        resp.time_used_ms = (time.time() - start) * 1000.0
+                        return resp
+
         plan = route.route(ctx, unhealthy=self.failure_detector
                            .unhealthy_servers())
+        if neg_key is not None and not plan and route.prunes_to_zero(ctx):
+            self._negative_cache.put(*neg_key)
         request_id = self._next_id()
+        #: unique across brokers — the server accountant keys cancels on it
+        query_id = f"{self._broker_nonce}-{request_id}"
+        #: end-to-end budget: servers get the REMAINING slice at send
+        #: time, waits below derive from it, and expiry cancels leftovers
+        timeout_ms = self._timeout_ms(ctx)
+        deadline = start + timeout_ms / 1000.0
+        hedge_delay_s = self._hedge_delay_s()
+        hedge_at = None if hedge_delay_s is None else start + hedge_delay_s
         results, exceptions, server_stats = [], [], []
         responded = 0
         attempted: set = set()
@@ -212,84 +347,231 @@ class BrokerRequestHandler:
                     if planned_off == route.offline_segments_for(ctx):
                         offline_key = key
 
-        def submit(entries):
-            out = []
-            for server, physical_table, segment_names, extra_filter in entries:
+        units: List[_ScatterUnit] = []
+        fut_map: Dict = {}  # live future -> (unit, server, is_hedge, aid)
+        attempt_seq = [0]
+
+        def launch(unit: _ScatterUnit, server: str,
+                   is_hedge: bool = False) -> bool:
+            conn = self.connections.get(server)
+            if conn is None:
+                if is_hedge:
+                    # a hedge that can't launch is simply no hedge — the
+                    # primary is still racing and may return the whole
+                    # answer; an exception here would poison it
+                    return False
                 attempted.add(server)
-                conn = self.connections.get(server)
-                if conn is None:
-                    # a silently skipped server would return a clean-looking
-                    # partial aggregate; surface it as a server error
-                    exceptions.append(
-                        {"errorCode": 427,
-                         "message": f"ServerNotConnected: {server}"})
-                    if physical_table.endswith("_OFFLINE"):
-                        offline_failed[0] = True
-                    continue
-                # the time-boundary predicate travels as a separate field,
-                # ANDed into the filter TREE server-side — splicing SQL
-                # text is unsound (keywords inside identifiers/literals)
-                out.append((self._pool.submit(
-                    self._timed_request, conn, server, physical_table, sql,
-                    segment_names, request_id, extra_filter),
-                    server, physical_table, segment_names, extra_filter))
-            return out
+                # a silently skipped server would return a clean-looking
+                # partial aggregate; surface it as a server error
+                exceptions.append(
+                    {"errorCode": 427,
+                     "message": f"ServerNotConnected: {server}"})
+                if unit.table.endswith("_OFFLINE"):
+                    offline_failed[0] = True
+                return False
+            attempted.add(server)
+            # per-ATTEMPT id: server-side registration and cancels key on
+            # it, so cancelling a hedge loser can never tombstone a later
+            # retry of this query that lands on the same server
+            attempt_seq[0] += 1
+            aid = f"{query_id}.{attempt_seq[0]}"
+            # the time-boundary predicate travels as a separate field,
+            # ANDed into the filter TREE server-side — splicing SQL
+            # text is unsound (keywords inside identifiers/literals).
+            # The server receives the REMAINING budget, not the original:
+            # queue time and earlier rounds already spent part of it
+            # (_timed_request derives it from the deadline at send time).
+            fut = self._pool.submit(
+                self._timed_request, conn, server, unit.table, sql,
+                unit.names, request_id, unit.extra, deadline, aid)
+            fut_map[fut] = (unit, server, is_hedge, aid)
+            unit.live += 1
+            return True
 
-        def gather(entries, retried: bool):
+        def cancel_attempt(server: str, aid: str) -> None:
+            conn = self.connections.get(server)
+            if conn is not None:
+                self._cancel_pool.submit(conn.cancel, aid)
+
+        def cancel_duplicates(unit: _ScatterUnit) -> None:
+            """The race resolved: stop the losing attempt server-side so
+            abandoned work frees its scheduler thread. Attempt-scoped, so
+            nothing else of this query is touched."""
+            for _f, (u, server, _h, aid) in list(fut_map.items()):
+                if u is unit:
+                    cancel_attempt(server, aid)
+
+        def merge(unit: _ScatterUnit, server_results, server_exc,
+                  stats_extra) -> None:
             nonlocal responded
-            failed = []
-            for fut, server, table, names, extra in entries:
-                try:
-                    payload = fut.result(timeout=60)
-                    server_results, server_exc, stats_extra = \
-                        datatable.deserialize_results(payload)
-                    results.extend(server_results)
-                    if table.endswith("_OFFLINE"):
-                        if server_exc:
-                            offline_failed[0] = True
-                        else:
-                            offline_results.extend(server_results)
-                            if stats_extra is not None:
-                                offline_stats.append(stats_extra)
-                    exceptions.extend(server_exc)
+            results.extend(server_results)
+            if unit.table.endswith("_OFFLINE"):
+                if server_exc:
+                    offline_failed[0] = True
+                else:
+                    offline_results.extend(server_results)
                     if stats_extra is not None:
-                        server_stats.append(stats_extra)
-                    responded += 1
-                    self.failure_detector.mark_success(server)
-                except Exception as e:  # noqa: BLE001 — partial results
-                    # connection-level failure: mark unhealthy (routing
-                    # skips it until the backoff expires, ref
-                    # ConnectionFailureDetector) and retry the segments on
-                    # surviving replicas ONCE
-                    if table.endswith("_OFFLINE"):
-                        offline_failed[0] = True
-                    self.failure_detector.mark_failure(server)
-                    failed_servers.add(server)
-                    if retried:
-                        exceptions.append({"errorCode": 427,
-                                           "message": f"ServerError: {e}"})
-                        continue
-                    # exclude everything known-bad: this round's failures
-                    # AND the detector's unhealthy set, or the single
-                    # retry can land on another dead server while a
-                    # healthy replica exists
-                    exclude = failed_servers | \
-                        self.failure_detector.unhealthy_servers()
-                    rerouted, unplaced = route.reroute_segments(
-                        table, names, exclude=exclude, extra_filter=extra)
-                    if unplaced:
-                        # segments with no surviving replica: surface the
-                        # loss instead of a clean-looking partial answer
-                        exceptions.append({
-                            "errorCode": 427,
-                            "message": (f"ServerError: {e} "
-                                        f"(segments lost: {unplaced})")})
-                    failed.extend(rerouted)
-            return failed
+                        offline_stats.append(stats_extra)
+            exceptions.extend(server_exc)
+            if stats_extra is not None:
+                server_stats.append(stats_extra)
+            responded += 1
 
-        retry_plan = gather(submit(plan), retried=False)
-        if retry_plan:
-            gather(submit(retry_plan), retried=True)
+        def process(fut) -> None:
+            unit, server, is_hedge, _aid = fut_map.pop(fut)
+            unit.live -= 1
+            try:
+                payload = fut.result()
+                server_results, server_exc, stats_extra = \
+                    datatable.deserialize_results(payload)
+            except Exception as e:  # noqa: BLE001 — partial results
+                # connection-level failure: mark unhealthy (routing skips
+                # it until the backoff expires, ref
+                # ConnectionFailureDetector) and retry the segments on
+                # surviving replicas ONCE — sharing, not resetting, the
+                # original deadline budget
+                self.failure_detector.mark_failure(server)
+                failed_servers.add(server)
+                if unit.done or unit.live > 0:
+                    # a hedge twin already merged (or is still racing):
+                    # this failure loses/defers — it must NOT poison the
+                    # offline-partial cache, the data is (or may yet be)
+                    # complete from the twin
+                    return
+                unit.done = True
+                if unit.table.endswith("_OFFLINE"):
+                    offline_failed[0] = True
+                if unit.fallback is not None:
+                    # the twin already delivered an (errored) payload we
+                    # held back hoping for a clean one: a server DID
+                    # answer, so merge it rather than retry/re-fail
+                    merge(unit, *unit.fallback)
+                    return
+                if unit.retried:
+                    exceptions.append({"errorCode": 427,
+                                       "message": f"ServerError: {e}"})
+                    return
+                # exclude everything known-bad: this round's failures
+                # AND the detector's unhealthy set, or the single
+                # retry can land on another dead server while a
+                # healthy replica exists
+                exclude = failed_servers | \
+                    self.failure_detector.unhealthy_servers()
+                rerouted, unplaced = route.reroute_segments(
+                    unit.table, unit.names, exclude=exclude,
+                    extra_filter=unit.extra)
+                if unplaced:
+                    # segments with no surviving replica: surface the
+                    # loss instead of a clean-looking partial answer
+                    exceptions.append({
+                        "errorCode": 427,
+                        "message": (f"ServerError: {e} "
+                                    f"(segments lost: {unplaced})")})
+                for rserver, rtable, rnames, rextra in rerouted:
+                    child = _ScatterUnit(rserver, rtable, rnames, rextra,
+                                         retried=True)
+                    units.append(child)
+                    if not launch(child, rserver):
+                        child.done = True
+                return
+            self.failure_detector.mark_success(server)
+            if unit.done:
+                return  # hedge race loser — drop, never double-merge
+            if server_exc and unit.live > 0:
+                # an ERRORED payload while a twin still races: hold it
+                # back — first CLEAN response wins; this merges only if
+                # no twin delivers a clean answer
+                unit.fallback = (server_results, server_exc, stats_extra)
+                return
+            unit.done = True
+            if unit.hedged:
+                self._metrics.add_meter(
+                    "hedge_won" if is_hedge else "hedge_wasted")
+                cancel_duplicates(unit)
+            merge(unit, server_results, server_exc, stats_extra)
+
+        def maybe_hedge() -> None:
+            """Past the adaptive delay, duplicate each still-pending
+            primary onto a different healthy replica ("The Tail at
+            Scale"): first clean response wins, the loser is cancelled.
+            One hedge per unit, whole-entry only — a hedge split across
+            servers couldn't dedupe against its primary's segment set."""
+            if hedge_at is None or time.time() < hedge_at:
+                return
+            for unit in list(units):
+                if unit.done or unit.live == 0 or unit.hedge_tried \
+                        or unit.retried:
+                    continue
+                unit.hedge_tried = True
+                exclude = ({unit.server} | failed_servers
+                           | self.failure_detector.unhealthy_servers())
+                entries, unplaced = route.reroute_segments(
+                    unit.table, unit.names, exclude=exclude,
+                    extra_filter=unit.extra)
+                if unplaced or len(entries) != 1:
+                    continue  # no single healthy replica holds the set
+                if (deadline - time.time()) * 1000.0 < 1.0:
+                    continue  # no budget left to hedge into
+                if launch(unit, entries[0][0], is_hedge=True):
+                    unit.hedged = True
+                    self._metrics.add_meter("hedge_issued")
+
+        for server, physical_table, segment_names, extra_filter in plan:
+            unit = _ScatterUnit(server, physical_table, segment_names,
+                                extra_filter)
+            units.append(unit)
+            if not launch(unit, server):
+                unit.done = True
+
+        # -- gather: deadline-derived waits, no per-future magic numbers.
+        # Exit as soon as every UNIT resolved — a hedge race's losing
+        # future may stay in flight long after its unit completed, and
+        # waiting for it would forfeit the hedge's entire latency win.
+        while fut_map and not all(u.done for u in units):
+            now = time.time()
+            if now >= deadline:
+                break
+            wait_until = deadline
+            if hedge_at is not None and any(
+                    not u.done and not u.hedge_tried and not u.retried
+                    for u in units):
+                wait_until = min(wait_until, hedge_at)
+            done, _pending = _fut_wait(list(fut_map),
+                                       timeout=max(0.0, wait_until - now),
+                                       return_when=FIRST_COMPLETED)
+            for fut in done:
+                process(fut)
+            maybe_hedge()
+
+        abandoned: Dict[int, Tuple[_ScatterUnit, List[str]]] = {}
+        for fut, (unit, server, _h, aid) in fut_map.items():
+            if not unit.done:
+                abandoned.setdefault(id(unit), (unit, []))[1].append(server)
+                cancel_attempt(server, aid)
+        if abandoned:
+            # deadline expired with work outstanding: surface a typed
+            # 250 partial per abandoned unit, cancel the server-side
+            # work (attempt-scoped), and cool the slow servers so the
+            # next queries prefer other replicas
+            for unit, servers in abandoned.values():
+                unit.done = True
+                if unit.fallback is not None:
+                    # better an errored answer a server actually gave
+                    # than nothing — the 250 below still records that
+                    # the clean twin never arrived
+                    merge(unit, *unit.fallback)
+                if unit.table.endswith("_OFFLINE"):
+                    offline_failed[0] = True
+                for server in servers:
+                    self.failure_detector.mark_timeout(server)
+                exceptions.append({
+                    "errorCode": BrokerTimeoutError.ERROR_CODE,
+                    "message": (
+                        f"BrokerTimeoutError: server(s) {sorted(servers)} "
+                        f"did not respond within {int(timeout_ms)}ms "
+                        f"({len(unit.names or [])} segments abandoned)")})
+            self._metrics.add_meter("deadline_expired")
+        fut_map.clear()
 
         if offline_key is not None and offline_results \
                 and not offline_failed[0]:
@@ -312,11 +594,19 @@ class BrokerRequestHandler:
         for extra in server_stats:
             resp.stats.merge(extra)
         resp.exceptions = exceptions
+        # any exception here means data went missing (timeout, dead
+        # server, lost segments) or a server answered with an error —
+        # either way the merged answer is not the whole answer
+        resp.partial_result = bool(exceptions)
         resp.num_servers_queried = len(attempted)
         resp.num_servers_responded = responded
         resp.time_used_ms = (time.time() - start) * 1000.0
         if cache_key is not None:
-            # put() itself refuses partial/errored responses
+            # put() itself refuses partial/errored responses. Hedged and
+            # retry-salvaged rounds land queried != responded, which the
+            # gate also refuses — DELIBERATELY: a repeat of that query
+            # must re-exercise the slow/dead server, not replay a cached
+            # answer past it (same failover-semantics rule as PR 1).
             self.result_cache.put(*cache_key, resp)
         return resp
 

@@ -188,6 +188,20 @@ class RoutingTable:
         return {s.name for s in self.offline.segments.values()
                 if not _prunable(s, ctx)}
 
+    def prunes_to_zero(self, ctx: QueryContext) -> bool:
+        """True when routing would select NO segment for `ctx` purely by
+        pruning (or the table is empty) — the negative-cache gate. A
+        segment dropped because no replica is placeable does NOT count:
+        placement is outside the epoch, so caching that empty answer
+        would outlive the outage."""
+        for side in (self.offline, self.realtime):
+            if side is None:
+                continue
+            for seg in side.segments.values():
+                if not _prunable(seg, ctx):
+                    return False
+        return True
+
     def _memoized_epoch(self, which: str, sides: tuple) -> str:
         # identity + mutation counter, never TableRoute.__eq__ (a
         # dataclass eq would walk the whole segment dict — the exact

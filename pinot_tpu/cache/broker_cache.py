@@ -42,6 +42,61 @@ def broker_remote_key(key) -> Optional[str]:
     return f"res|{table}|{epoch}|{fingerprint}"
 
 
+class NegativeResultCache:
+    """ROADMAP item: memoize EMPTY answers for pruned-to-zero plans.
+
+    Dashboards routinely misfire queries whose partition/time pruning
+    selects no segment at all; the answer is empty by construction, yet
+    each one still pays routing + scatter + reduce. Entries are sentinel
+    bytes keyed by (fingerprint, table, routing epoch) — a segment
+    add/replace/remove moves the epoch, so a plan that STOPS pruning to
+    zero stops hitting by construction. `skipCache` bypasses it (the
+    handler checks cache_bypassed before consulting), and hit/miss
+    meters ride the LruTtlCache prefix (`negative_cache_{hits,misses}`).
+
+    Independent of the whole-result cache: it works (and defaults ON)
+    even when `pinot.broker.result.cache.enabled` is false, because a
+    memoized empty answer can never serve stale DATA — only a stale
+    "nothing matches", bounded by epoch + TTL."""
+
+    _SENTINEL = b"0"
+
+    def __init__(self, max_bytes: int = 1 << 20, ttl_seconds: float = 60.0,
+                 enabled: bool = True, metrics=None,
+                 labels: Optional[dict] = None):
+        self.enabled = enabled
+        self._cache = LruTtlCache(max_bytes, ttl_seconds, metrics=metrics,
+                                  metric_prefix="negative_cache",
+                                  labels=labels)
+
+    @classmethod
+    def from_config(cls, config, metrics=None,
+                    labels: Optional[dict] = None) -> "NegativeResultCache":
+        return cls(
+            max_bytes=config.get_int("pinot.broker.negative.cache.bytes"),
+            ttl_seconds=config.get_float(
+                "pinot.broker.negative.cache.ttl.seconds"),
+            enabled=config.get_bool("pinot.broker.negative.cache.enabled"),
+            metrics=metrics, labels=labels)
+
+    def hit(self, fingerprint: str, table: str, epoch: str) -> bool:
+        if not self.enabled:
+            return False
+        return self._cache.get((fingerprint, table, epoch)) is not None
+
+    def put(self, fingerprint: str, table: str, epoch: str) -> bool:
+        if not self.enabled:
+            return False
+        return self._cache.put((fingerprint, table, epoch), self._SENTINEL)
+
+    def __len__(self) -> int:
+        return len(self._cache)
+
+    @property
+    def stats(self):
+        return self._cache.stats
+
+
 class BrokerResultCache:
     """Whole BrokerResponse objects keyed by
     (query fingerprint, table, routing epoch), plus — for hybrid tables —
@@ -63,6 +118,10 @@ class BrokerResultCache:
         self.cache_realtime = cache_realtime
         if metrics is not None and labels is None:
             labels = {"broker": f"b{next(_broker_ids)}"}
+        #: exposed so sibling caches of the SAME broker (negative cache)
+        #: can share the instance label instead of minting their own —
+        #: dashboards correlate per-broker metrics by this label
+        self.labels = labels
         if backend is not None:
             self._cache = backend
             self._wire = getattr(backend, "wire_codec", False)
@@ -107,9 +166,10 @@ class BrokerResultCache:
     def put(self, fingerprint: str, table: str, epoch: str,
             resp: BrokerResponse) -> bool:
         """Cache only COMPLETE, clean responses — a partial answer (server
-        error, missing replica) must re-execute next time, not be replayed
-        for a TTL."""
+        error, missing replica, deadline miss) must re-execute next time,
+        not be replayed for a TTL."""
         if not self.enabled or resp.exceptions or resp.trace is not None \
+                or resp.partial_result \
                 or resp.num_servers_responded != resp.num_servers_queried:
             return False
         payload = (wire_dumps_response(resp) if self._wire else dumps(resp))

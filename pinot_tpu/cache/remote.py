@@ -36,6 +36,7 @@ import time
 from typing import Dict, Optional
 
 from pinot_tpu.cache.core import LruTtlCache
+from pinot_tpu.utils.failpoints import FailpointError, fire
 from pinot_tpu.utils.netframe import (MAX_FRAME, recv_frame, recv_raw_frame,
                                       send_frame, send_raw_frame)
 
@@ -356,6 +357,15 @@ class RemoteCacheBackend:
     def get_with_ttl(self, key: str
                      ) -> Optional[tuple]:
         """(payload, remaining server-side TTL seconds or None)."""
+        try:
+            # chaos site: a slow/dead/lying remote tier — the breaker and
+            # the total-function contract below must absorb all of it
+            fire("cache.remote.get", key=key)
+        except (ConnectionError, FailpointError):
+            self.errors += 1
+            self._meter("errors")
+            self.breaker.record_failure()
+            return None
         out = self._request({"op": "get", "key": key})
         if out is None:
             return None

@@ -20,7 +20,9 @@ segment rollout.
 """
 from __future__ import annotations
 
+import json
 import logging
+import os
 import threading
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
@@ -33,12 +35,100 @@ class FingerprintLog:
 
     Re-recording an already-logged fingerprint refreshes its recency (an
     OrderedDict move-to-end), so the replay set tracks the CURRENT
-    dashboard mix, not the first N plans ever seen."""
+    dashboard mix, not the first N plans ever seen.
 
-    def __init__(self, max_plans_per_table: int = 64):
+    journal_path (ROADMAP item): an append-only JSON-lines journal of
+    every record(), reloaded at construction — a RESTARTED server warms
+    fresh segments from its pre-restart traffic instead of an empty log.
+    The journal compacts to a snapshot of the live (bounded) plan set
+    whenever it grows past journal_max_bytes, via atomic tmp+rename.
+    Torn/corrupt journals degrade line-by-line to whatever parses (a
+    half-written tail costs one plan, never the log); an unreadable file
+    degrades to empty. Journal I/O failures are swallowed — persistence
+    is an optimization, the in-memory log is the source of truth."""
+
+    def __init__(self, max_plans_per_table: int = 64,
+                 journal_path: Optional[str] = None,
+                 journal_max_bytes: int = 1 << 20):
         self.max_plans_per_table = max(1, int(max_plans_per_table))
         self._tables: Dict[str, "OrderedDict[str, tuple]"] = {}
         self._lock = threading.Lock()
+        self.journal_path = journal_path
+        self.journal_max_bytes = max(4096, int(journal_max_bytes))
+        #: kept-open append handle + in-memory size mirror: record() is
+        #: on the query path, so it pays one buffered write + flush, not
+        #: an open/close + getsize syscall pair per plan
+        self._journal_file = None
+        self._journal_bytes = 0
+        if journal_path:
+            self._replay_journal()
+
+    # -- journal -------------------------------------------------------
+    def _replay_journal(self) -> None:
+        try:
+            # errors="replace": a binary-garbage journal must degrade to
+            # per-line JSON failures (skipped below), not a decode crash
+            with open(self.journal_path, encoding="utf-8",
+                      errors="replace") as f:
+                lines = f.readlines()
+        except OSError:
+            return  # no journal yet (first boot) or unreadable: start cold
+        for raw in lines:
+            try:
+                e = json.loads(raw)
+                table, fp, sql = e["t"], e["f"], e["s"]
+            except (ValueError, TypeError, KeyError):
+                continue  # torn/corrupt line: skip it, keep the rest
+            plans = self._tables.setdefault(table, OrderedDict())
+            if fp in plans:
+                plans.move_to_end(fp)
+            plans[fp] = (sql, e.get("x"))
+            while len(plans) > self.max_plans_per_table:
+                plans.popitem(last=False)
+
+    def _append_journal_locked(self, table: str, fingerprint: str,
+                               sql: str, extra_filter) -> None:
+        line = json.dumps({"t": table, "f": fingerprint, "s": sql,
+                           "x": extra_filter}) + "\n"
+        try:
+            if self._journal_file is None:
+                self._journal_file = open(self.journal_path, "a",
+                                          encoding="utf-8")
+                self._journal_bytes = os.path.getsize(self.journal_path)
+            self._journal_file.write(line)
+            self._journal_file.flush()  # torn tail = at most one line
+            self._journal_bytes += len(line.encode("utf-8"))
+            if self._journal_bytes > self.journal_max_bytes:
+                self._compact_locked()
+        except OSError:
+            log.debug("fingerprint journal write failed", exc_info=True)
+
+    def _compact_locked(self) -> None:
+        """Rewrite the journal as a snapshot of the LIVE plan set (the
+        bound already dropped everything else), atomically: a crash
+        mid-compaction leaves either the old or the new file, never a
+        mix."""
+        if self._journal_file is not None:
+            self._journal_file.close()
+            self._journal_file = None
+        tmp = self.journal_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            for table, plans in self._tables.items():
+                for fp, (sql, extra) in plans.items():
+                    f.write(json.dumps({"t": table, "f": fp, "s": sql,
+                                        "x": extra}) + "\n")
+        os.replace(tmp, self.journal_path)
+        self._journal_bytes = os.path.getsize(self.journal_path)
+
+    def close(self) -> None:
+        """Release the journal handle (in-memory state stays usable)."""
+        with self._lock:
+            if self._journal_file is not None:
+                try:
+                    self._journal_file.close()
+                except OSError:
+                    pass
+                self._journal_file = None
 
     def record(self, table: str, fingerprint: str, sql: str,
                extra_filter: Optional[str] = None) -> None:
@@ -52,6 +142,9 @@ class FingerprintLog:
             plans[fingerprint] = (sql, extra_filter)
             while len(plans) > self.max_plans_per_table:
                 plans.popitem(last=False)
+            if self.journal_path:
+                self._append_journal_locked(table, fingerprint, sql,
+                                            extra_filter)
 
     def plans(self, table: str) -> List[Tuple[str, str, Optional[str]]]:
         """[(fingerprint, sql, extra_filter)] most-recent-last."""

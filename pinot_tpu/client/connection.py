@@ -22,6 +22,20 @@ class PinotClientError(Exception):
         self.exceptions = exceptions or []
 
 
+class PinotTimeoutError(PinotClientError):
+    """The query exceeded its end-to-end deadline (broker errorCode 250).
+    `result_set` carries whatever partial answer the broker assembled
+    before the budget ran out (partialResult=true)."""
+
+    def __init__(self, message: str, exceptions: Optional[list] = None,
+                 result_set: Optional["ResultSet"] = None):
+        super().__init__(message, exceptions)
+        self.result_set = result_set
+
+
+_TIMEOUT_ERROR_CODE = 250
+
+
 class ResultSet:
     def __init__(self, payload: dict):
         table = payload.get("resultTable") or {}
@@ -30,6 +44,9 @@ class ResultSet:
         self.column_types: List[str] = schema.get("columnDataTypes", [])
         self.rows: List[list] = table.get("rows", [])
         self.exceptions: List[dict] = payload.get("exceptions", [])
+        #: broker-declared incompleteness: a server timed out or died and
+        #: the rows above are only part of the answer
+        self.partial_result: bool = bool(payload.get("partialResult"))
         self.stats: Dict[str, Any] = {
             k: v for k, v in payload.items()
             if k not in ("resultTable", "exceptions")}
@@ -51,9 +68,14 @@ class Connection:
 
     # ------------------------------------------------------------------
     def execute(self, sql: str,
-                params: Optional[Dict[str, Any]] = None) -> ResultSet:
+                params: Optional[Dict[str, Any]] = None,
+                timeout_ms: Optional[float] = None) -> ResultSet:
         """Run SQL (with optional %(name)s parameter substitution — values
-        are SQL-escaped client-side) and raise on broker exceptions."""
+        are SQL-escaped client-side) and raise on broker exceptions.
+        timeout_ms: per-query end-to-end budget, shipped as the broker's
+        `SET timeoutMs` option AND used (plus grace) as the HTTP read
+        timeout; a deadline miss raises PinotTimeoutError carrying the
+        broker's partial result."""
         if params:
             # token-targeted replacement, NOT the % operator: a literal %
             # in the SQL (LIKE '%x%', modulo) must never be interpreted
@@ -68,20 +90,31 @@ class Connection:
                 return quoted[key]
 
             sql = _re.sub(r"%\((\w+)\)s", _sub, sql)
+        http_timeout = self.timeout
+        if timeout_ms is not None:
+            # leading SET statements are the option channel the broker
+            # parser already speaks — no URL/body schema change needed
+            sql = f"SET timeoutMs = {int(timeout_ms)}; {sql}"
+            http_timeout = timeout_ms / 1000.0 + 5.0
         req = urllib.request.Request(
             f"{self.base}/query/sql",
             data=json.dumps({"sql": sql}).encode(),
             headers={"Content-Type": "application/json"})
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            with urllib.request.urlopen(req, timeout=http_timeout) as r:
                 payload = json.loads(r.read())
         except urllib.error.URLError as e:
             raise PinotClientError(f"broker unreachable: {e}") from e
         rs = ResultSet(payload)
         if rs.exceptions:
-            raise PinotClientError(
-                "; ".join(str(x.get("message", x))
-                          for x in rs.exceptions), rs.exceptions)
+            message = "; ".join(str(x.get("message", x))
+                                for x in rs.exceptions)
+            if any(x.get("errorCode") == _TIMEOUT_ERROR_CODE
+                   for x in rs.exceptions):
+                # typed miss: the partial rides along instead of vanishing
+                raise PinotTimeoutError(message, rs.exceptions,
+                                        result_set=rs)
+            raise PinotClientError(message, rs.exceptions)
         return rs
 
     def cursor(self) -> "Cursor":

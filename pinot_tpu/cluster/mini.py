@@ -47,6 +47,7 @@ class MiniClusterServer:
         self.transport.stop()
         self.data_manager.shutdown()
         self.executor.segment_cache.close()
+        self.executor.fingerprint_log.close()
 
     @property
     def address(self) -> str:
@@ -56,14 +57,22 @@ class MiniClusterServer:
 class MiniCluster:
     def __init__(self, num_servers: int = 2, use_tpu: bool = False,
                  result_cache: bool = False, num_brokers: int = 1,
-                 cache_server: bool = False, config=None):
+                 cache_server: bool = False, config=None, chaos=None):
         """cache_server: start an in-process CacheServer (the remote L2
         role) and point every tier at it — brokers' result caches and
         servers' segment caches become `tiered` automatically, so
         replicas warm each other (cache/remote.py). config: a base
         PinotConfiguration; cache_server=True layers the fabric knobs on
-        top of it."""
+        top of it. chaos: a utils.failpoints.FaultSchedule (or a plain
+        [(site, policy-kwargs), ...] list) armed at start() and disarmed
+        at stop() — deterministic fault injection for the whole cluster's
+        deadline / hedge / retry paths."""
         from pinot_tpu.utils.config import PinotConfiguration
+        from pinot_tpu.utils.failpoints import FaultSchedule
+        self.chaos: Optional[FaultSchedule] = None
+        if chaos is not None:
+            self.chaos = (chaos if isinstance(chaos, FaultSchedule)
+                          else FaultSchedule(list(chaos)))
         self.cache_server = None
         overrides = {}
         if cache_server:
@@ -110,6 +119,8 @@ class MiniCluster:
         return BrokerResultCache(metrics=get_registry("broker"))
 
     def start(self, with_http: bool = False) -> None:
+        if self.chaos is not None:
+            self.chaos.arm()
         for s in self.servers:
             s.start()
             self._connections[s.instance_id] = ServerConnection(
@@ -147,6 +158,8 @@ class MiniCluster:
             s.stop()
         if self.cache_server is not None:
             self.cache_server.stop()
+        if self.chaos is not None:
+            self.chaos.disarm()
 
     # -- multi-stage catalog / placement ------------------------------------
     def _catalog(self):

@@ -172,6 +172,7 @@ class ServerRole:
         self.client.close()
         self.transport.stop()
         self.data_manager.shutdown()
+        self.executor.fingerprint_log.close()
 
     # ------------------------------------------------------------------
     def reconcile(self) -> None:

@@ -19,6 +19,7 @@ from pinot_tpu.query import executor_cpu
 from pinot_tpu.cache.core import cache_bypassed
 from pinot_tpu.cache.segment_cache import is_cacheable_shape
 from pinot_tpu.utils import tracing
+from pinot_tpu.utils.failpoints import fire
 from pinot_tpu.query.context import QueryContext
 from pinot_tpu.query.pruner import prune_segments
 from pinot_tpu.query.reduce import BrokerResponse, reduce_results
@@ -31,17 +32,24 @@ class QueryExecutor:
 
     def __init__(self, segments: Sequence[ImmutableSegment],
                  use_tpu: bool = True, max_threads: int = 8, engine=None,
-                 segment_cache=None):
+                 segment_cache=None, cancel_check=None):
         """engine: a shared TpuOperatorExecutor. Long-lived callers (the
         server) MUST pass one — the engine owns the HBM block cache, and a
         per-request engine would re-upload every column on every query.
         segment_cache: a shared SegmentResultCache (cache/segment_cache.py)
-        — same lifetime rule as the engine; None disables tier-2 caching."""
+        — same lifetime rule as the engine; None disables tier-2 caching.
+        cancel_check: zero-arg callable polled between segments (the
+        ResourceAccountant.check_cancelled discipline, ref
+        Tracing.ThreadAccountantOps.sample in DocIdSetOperator:70) —
+        raises to stop the loop when the query is cancelled or past its
+        deadline. Segment granularity is the unit of work here; finer
+        checks would sit inside jit'd kernels where Python can't poll."""
         self.segments = list(segments)
         self.max_threads = max_threads
         self._tpu_engine = engine
         self._use_tpu = use_tpu
         self._segment_cache = segment_cache
+        self._cancel_check = cancel_check
 
     @property
     def tpu_engine(self):
@@ -99,6 +107,8 @@ class QueryExecutor:
         host_only = [s for s in to_run if id(s) not in dc]
         remaining = device_candidates
         if self._use_tpu and device_candidates:
+            if self._cancel_check is not None:
+                self._cancel_check()
             engine = self.tpu_engine
             if engine is not None and engine.supports(ctx):
                 device_results, remaining = engine.execute(device_candidates, ctx)
@@ -113,6 +123,14 @@ class QueryExecutor:
         remaining = list(remaining) + host_only
         if remaining:
             def run_one(s):
+                # cooperative cancel poll per segment: a deadline-expired
+                # or broker-cancelled query stops HERE instead of
+                # finishing work nobody will read (the failpoint site
+                # lets chaos tests make each segment arbitrarily slow)
+                if self._cancel_check is not None:
+                    self._cancel_check()
+                fire("server.execute.segment",
+                     segment=getattr(s, "name", None))
                 r = executor_cpu.execute_segment(s, ctx)
                 if plan_fp is not None:
                     cache.put(s, plan_fp, r)  # no-op for mutable segments

@@ -48,6 +48,10 @@ class BrokerResponse:
     #: True when this response was served from the broker result cache
     #: (tier 1); never True on a freshly executed response
     cache_hit: bool = False
+    #: True when the answer is known-incomplete (a server timed out, died
+    #: mid-query, or segments had no surviving replica) — the exceptions
+    #: list carries the why (ref BrokerResponseNative partialResult)
+    partial_result: bool = False
 
     def to_dict(self) -> dict:
         d = {
@@ -65,6 +69,7 @@ class BrokerResponse:
             "numGroupsLimitReached": self.num_groups_limit_reached,
             "timeUsedMs": self.time_used_ms,
             "cacheHit": self.cache_hit,
+            "partialResult": self.partial_result,
         }
         if self.trace is not None:
             d["traceInfo"] = self.trace

@@ -26,8 +26,27 @@ from pinot_tpu.query.context import QueryContext
 from pinot_tpu.query.executor import QueryExecutor
 from pinot_tpu.server import datatable
 from pinot_tpu.server.data_manager import InstanceDataManager, TableDataManager
+from pinot_tpu.utils.accounting import (BrokerTimeoutError,
+                                        QueryCancelledError,
+                                        ResourceAccountant)
+from pinot_tpu.utils.failpoints import fire
 
 _LEN = struct.Struct("<I")
+
+#: extra seconds a broker-side socket read waits past the shipped budget —
+#: covers the server's own deadline grace + scheduling jitter, so the
+#: server's typed 250 response (not a raw socket timeout) is the normal
+#: way a deadline surfaces
+_SOCKET_GRACE_S = 2.0
+
+
+def _timeout_response(e: BaseException) -> bytes:
+    """The typed deadline-miss payload (ref QueryException
+    EXECUTION_TIMEOUT_ERROR_CODE): empty results + an errorCode-250
+    entry; the broker merges it as a partial, never a hang."""
+    return datatable.serialize_results(
+        [], [{"errorCode": BrokerTimeoutError.ERROR_CODE,
+              "message": f"BrokerTimeoutError: {e}"}])
 
 
 class ServerQueryExecutor:
@@ -41,6 +60,14 @@ class ServerQueryExecutor:
         #: instance config (PinotConfiguration); threads through to the
         #: device engine's cache budgets and the streaming chunk size
         self.config = config
+        #: per-query deadline/cancel registry: the broker ships the
+        #: REMAINING budget with each request, and a broker-side expiry
+        #: sends an explicit cancel keyed by the query id — either way
+        #: the segment loop's cooperative checks stop abandoned work
+        self.accountant = ResourceAccountant()
+        self.deadline_grace_s = (
+            config.get_int("pinot.server.query.deadline.grace.ms") / 1000.0
+            if config is not None else 0.05)
         if config is not None:
             # the catalog default applies whenever a config is present
             # (the class attribute only backs config-less construction)
@@ -79,7 +106,23 @@ class ServerQueryExecutor:
         # clamp to >=1, so 0 must be honored here, not passed through)
         warm_on = warm_on and log_size > 0 and max_plans > 0
         self._plan_log_enabled = warm_on
-        self.fingerprint_log = FingerprintLog(max(1, log_size))
+        # journal (ROADMAP): persist the plan log so a restart warms from
+        # pre-restart traffic; one file per instance, off when dir unset
+        journal_path = None
+        journal_max = 1 << 20
+        if config is not None:
+            journal_dir = config.get_str(
+                "pinot.server.segment.warmup.journal.dir")
+            if journal_dir:
+                import os
+                os.makedirs(journal_dir, exist_ok=True)
+                journal_path = os.path.join(
+                    journal_dir, f"{data_manager.instance_id}.fplog.jsonl")
+                journal_max = config.get_int(
+                    "pinot.server.segment.warmup.journal.max.bytes")
+        self.fingerprint_log = FingerprintLog(max(1, log_size),
+                                              journal_path=journal_path,
+                                              journal_max_bytes=journal_max)
         self.warmup = SegmentWarmup(
             self.fingerprint_log, self.segment_cache,
             max_plans=max(1, max_plans), use_tpu=use_tpu,
@@ -138,18 +181,47 @@ class ServerQueryExecutor:
                 self._engine = TpuOperatorExecutor(config=self.config)
             return self._engine
 
+    def cancel(self, query_id) -> bool:
+        """Broker-initiated cancel (rides ResourceAccountant.cancel): the
+        next cooperative check in the query's segment loop raises and the
+        worker thread frees. A cancel for a query still sitting in the
+        scheduler queue is a no-op here — the shipped deadline kills it
+        at pick-up instead."""
+        return self.accountant.cancel(str(query_id))
+
     def execute(self, table_name: str, sql_or_ctx,
                 segments: Optional[List[str]] = None,
-                extra_filter: Optional[str] = None):
+                extra_filter: Optional[str] = None,
+                query_id=None, timeout_ms: Optional[float] = None,
+                deadline: Optional[float] = None):
         """Returns serialized DataTable bytes. extra_filter (an expression
         string, e.g. the hybrid time-boundary predicate) is ANDed into the
-        filter tree — the reference rewrites the BrokerRequest the same way."""
+        filter tree — the reference rewrites the BrokerRequest the same way.
+        timeout_ms: REMAINING broker budget; the local deadline (plus a
+        small grace for clock skew) cancels the segment loop
+        cooperatively and answers with an errorCode-250 partial.
+        deadline: ARRIVAL-anchored absolute deadline (the transport
+        handler computes it when the request is read) — it wins over
+        timeout_ms, which anchored here would silently extend the budget
+        by however long the request waited in the scheduler queue."""
         from pinot_tpu.utils.metrics import get_registry
         metrics = get_registry("server")
         metrics.add_meter("queries", labels={"table": table_name})
         timer = metrics.time("query_execution", labels={"table": table_name})
         timer.__enter__()
+        qid = None if query_id is None else str(query_id)
+        cancel_check = None
+        if qid is not None:
+            if deadline is not None:
+                timeout_s = deadline - time.time()
+            else:
+                timeout_s = (float(timeout_ms) / 1000.0
+                             + self.deadline_grace_s if timeout_ms else None)
+            self.accountant.begin_query(qid, timeout_s)
+            cancel_check = self.accountant.checker(qid)
         try:
+            fire("server.execute.before",
+                 instance=self.data_manager.instance_id, table=table_name)
             ctx = (sql_or_ctx if isinstance(sql_or_ctx, QueryContext)
                    else QueryContext.from_sql(sql_or_ctx))
             from pinot_tpu.query.context import merge_extra_filter
@@ -164,17 +236,26 @@ class ServerQueryExecutor:
                 ex = QueryExecutor([s.segment for s in sdms],
                                    use_tpu=self.use_tpu,
                                    engine=self._shared_engine(),
-                                   segment_cache=self.segment_cache)
+                                   segment_cache=self.segment_cache,
+                                   cancel_check=cancel_check)
                 results, prune_stats = ex.execute_context(ctx)
                 return datatable.serialize_results(results,
                                                    extra_stats=prune_stats)
             finally:
                 TableDataManager.release_all(sdms)
+        except (QueryCancelledError, BrokerTimeoutError) as e:
+            # late work is CANCELLED, not silently finished: drop any
+            # half-built partials (merging them would risk double counts
+            # against a hedged replica) and answer with the typed 250
+            metrics.add_meter("queries_killed", labels={"table": table_name})
+            return _timeout_response(e)
         except Exception as e:  # noqa: BLE001 — server must answer, not die
             metrics.add_meter("query_exceptions", labels={"table": table_name})
             return datatable.serialize_results(
                 [], [{"errorCode": 200, "message": f"{type(e).__name__}: {e}"}])
         finally:
+            if qid is not None:
+                self.accountant.finish_query(qid)
             timer.__exit__(None, None, None)
 
     #: segments per streamed response frame
@@ -247,6 +328,25 @@ class QueryServer:
                 n = _LEN.unpack(hdr)[0]
                 payload = await reader.readexactly(n)
                 req = json.loads(payload)
+                if "cancel" in req:
+                    # out-of-band cancel (ref InstanceRequestHandler's
+                    # CANCEL_QUERY): arrives on its OWN short-lived
+                    # connection because the originating channel is
+                    # blocked waiting for the very response being
+                    # cancelled
+                    ok = self.executor.cancel(req["cancel"])
+                    ack = json.dumps({"cancelled": bool(ok)}).encode()
+                    writer.write(_LEN.pack(len(ack)) + ack)
+                    await writer.drain()
+                    continue
+                # REMAINING broker budget -> local absolute deadline; the
+                # scheduler refuses to start work whose whole budget was
+                # spent in its queue, the executor's cooperative checks
+                # stop work that expires mid-scan
+                timeout_ms = req.get("timeoutMs")
+                deadline = (time.time() + float(timeout_ms) / 1000.0
+                            + self.executor.deadline_grace_s
+                            if timeout_ms else None)
                 if req.get("streaming"):
                     # per-block response stream (ref GrpcQueryServer.Submit
                     # server-stream): generator creation is cheap; EACH
@@ -260,8 +360,14 @@ class QueryServer:
                         fut = self.scheduler.submit(
                             lambda g=gen: next(g, None),
                             table=req.get("tableName", ""),
-                            workload=req.get("workload", "primary"))
-                        frame = await asyncio.wrap_future(fut)
+                            workload=req.get("workload", "primary"),
+                            deadline=deadline)
+                        try:
+                            frame = await asyncio.wrap_future(fut)
+                        except (QueryCancelledError, BrokerTimeoutError) as e:
+                            frame = _timeout_response(e)
+                            writer.write(_LEN.pack(len(frame)) + frame)
+                            frame = None
                         if frame is None:
                             break
                         writer.write(_LEN.pack(len(frame)) + frame)
@@ -270,12 +376,24 @@ class QueryServer:
                     await writer.drain()
                     continue
                 fut = self.scheduler.submit(
-                    lambda r=req: self.executor.execute(
+                    lambda r=req, d=deadline: self.executor.execute(
                         r["tableName"], r["sql"], r.get("segments"),
-                        r.get("extraFilter")),
+                        r.get("extraFilter"),
+                        query_id=r.get("queryId") or r.get("requestId"),
+                        timeout_ms=r.get("timeoutMs"), deadline=d),
                     table=req.get("tableName", ""),
-                    workload=req.get("workload", "primary"))
-                resp = await asyncio.wrap_future(fut)
+                    workload=req.get("workload", "primary"),
+                    deadline=deadline)
+                try:
+                    resp = await asyncio.wrap_future(fut)
+                except (QueryCancelledError, BrokerTimeoutError) as e:
+                    # reap any cancel tombstone for this id NOW — the
+                    # guard killed the query before execute()'s own
+                    # begin/finish pair could run, so nothing else will
+                    qid = req.get("queryId") or req.get("requestId")
+                    if qid is not None:
+                        self.executor.accountant.finish_query(str(qid))
+                    resp = _timeout_response(e)
                 writer.write(_LEN.pack(len(resp)) + resp)
                 await writer.drain()
         except (asyncio.IncompleteReadError, ConnectionResetError):
@@ -343,15 +461,23 @@ class ServerConnection:
     def request(self, table_name: str, sql: str,
                 segments: Optional[List[str]] = None,
                 request_id: int = 0,
-                extra_filter: Optional[str] = None) -> bytes:
+                extra_filter: Optional[str] = None,
+                timeout_ms: Optional[float] = None,
+                query_id=None) -> bytes:
+        """timeout_ms: remaining query budget, shipped to the server AND
+        used as this channel's read timeout (+grace) so a dead server
+        can't pin a broker fan-out thread past the deadline."""
         payload = json.dumps({
             "requestId": request_id, "tableName": table_name, "sql": sql,
-            "segments": segments, "extraFilter": extra_filter}).encode()
+            "segments": segments, "extraFilter": extra_filter,
+            "timeoutMs": timeout_ms,
+            "queryId": query_id}).encode()
         with self._lock:
             try:
                 sock = self._connect()
+                self._set_timeout(sock, timeout_ms)
                 sock.sendall(_LEN.pack(len(payload)) + payload)
-                return self._read_frame(sock)
+                return self._fire_response(self._read_frame(sock))
             except socket.timeout:
                 # a slow query, NOT a dead channel: retransmitting would run
                 # it twice server-side; drop the channel and surface the
@@ -363,8 +489,36 @@ class ServerConnection:
                 # one reconnect attempt (ref channel re-establish)
                 self.close()
                 sock = self._connect()
+                self._set_timeout(sock, timeout_ms)
                 sock.sendall(_LEN.pack(len(payload)) + payload)
-                return self._read_frame(sock)
+                return self._fire_response(self._read_frame(sock))
+
+    def _fire_response(self, payload: bytes) -> bytes:
+        """Chaos site on the response payload: torn bytes here exercise
+        the broker's deserialize-failure -> retry path."""
+        return fire("connection.request", payload=payload,
+                    server=f"{self.host}:{self.port}")
+
+    @staticmethod
+    def _set_timeout(sock: socket.socket,
+                     timeout_ms: Optional[float]) -> None:
+        sock.settimeout(float(timeout_ms) / 1000.0 + _SOCKET_GRACE_S
+                        if timeout_ms else 30.0)
+
+    def cancel(self, query_id) -> bool:
+        """Best-effort out-of-band cancel on a FRESH socket — the pooled
+        channel is blocked waiting for the response being cancelled.
+        Never raises: cancellation is advisory; the server's own deadline
+        is the backstop."""
+        try:
+            with socket.create_connection((self.host, self.port),
+                                          timeout=2.0) as sock:
+                msg = json.dumps({"cancel": str(query_id)}).encode()
+                sock.sendall(_LEN.pack(len(msg)) + msg)
+                ack = json.loads(self._read_frame(sock))
+                return bool(ack.get("cancelled"))
+        except (OSError, ValueError):
+            return False
 
     def request_streaming(self, table_name: str, sql: str,
                           segments: Optional[List[str]] = None,

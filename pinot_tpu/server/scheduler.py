@@ -19,13 +19,37 @@ from collections import deque
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Callable, Deque, Dict, Optional
 
+from pinot_tpu.utils.accounting import BrokerTimeoutError
+
 
 class QueryScheduler:
-    """submit(fn, table=..., workload=...) -> Future running fn()."""
+    """submit(fn, table=..., workload=..., deadline=...) -> Future running
+    fn(). deadline is an absolute time.time() timestamp: work that is
+    STILL QUEUED when its deadline passes must not occupy a worker thread
+    — the future completes with BrokerTimeoutError instead (ref
+    QueryScheduler.java's timeout handling around the query runners)."""
 
     def submit(self, fn: Callable[[], bytes], table: str = "",
-               workload: str = "primary") -> Future:
+               workload: str = "primary",
+               deadline: Optional[float] = None) -> Future:
         raise NotImplementedError
+
+    @staticmethod
+    def _guard(fn: Callable[[], bytes],
+               deadline: Optional[float]) -> Callable[[], bytes]:
+        """Wrap fn with a pick-up-time deadline check. The check runs on
+        the worker thread at execution start, so a request that sat in
+        the queue past its whole budget fails in O(1) instead of burning
+        a thread on an answer the broker already abandoned."""
+        if deadline is None:
+            return fn
+
+        def run():
+            if time.time() > deadline:
+                raise BrokerTimeoutError(
+                    "query deadline expired before execution started")
+            return fn()
+        return run
 
     def start(self) -> None:
         pass
@@ -41,8 +65,9 @@ class FCFSQueryScheduler(QueryScheduler):
         self._pool = ThreadPoolExecutor(max_workers=num_threads,
                                         thread_name_prefix="query-fcfs")
 
-    def submit(self, fn, table: str = "", workload: str = "primary") -> Future:
-        return self._pool.submit(fn)
+    def submit(self, fn, table: str = "", workload: str = "primary",
+               deadline: Optional[float] = None) -> Future:
+        return self._pool.submit(self._guard(fn, deadline))
 
     def stop(self) -> None:
         self._pool.shutdown(wait=False)
@@ -85,13 +110,14 @@ class TokenPriorityScheduler(QueryScheduler):
             self._stopped = True
             self._lock.notify_all()
 
-    def submit(self, fn, table: str = "", workload: str = "primary") -> Future:
+    def submit(self, fn, table: str = "", workload: str = "primary",
+               deadline: Optional[float] = None) -> Future:
         fut: Future = Future()
         with self._lock:
             g = self._groups.get(table)
             if g is None:
                 g = self._groups[table] = _Group(self.tokens_per_interval)
-            g.pending.append((fut, fn))
+            g.pending.append((fut, self._guard(fn, deadline)))
             self._lock.notify()
         return fut
 
@@ -157,9 +183,10 @@ class BinaryWorkloadScheduler(QueryScheduler):
             max_workers=max(secondary_threads, 1),
             thread_name_prefix="query-secondary")
 
-    def submit(self, fn, table: str = "", workload: str = "primary") -> Future:
+    def submit(self, fn, table: str = "", workload: str = "primary",
+               deadline: Optional[float] = None) -> Future:
         pool = self._primary if workload != "secondary" else self._secondary
-        return pool.submit(fn)
+        return pool.submit(self._guard(fn, deadline))
 
     def stop(self) -> None:
         self._primary.shutdown(wait=False)

@@ -22,6 +22,16 @@ class QueryCancelledError(RuntimeError):
     pass
 
 
+class BrokerTimeoutError(RuntimeError):
+    """A query exceeded its end-to-end deadline (ref QueryException
+    EXECUTION_TIMEOUT_ERROR_CODE = 250). Raised broker-side when a server
+    misses the budget, and server-side when the per-query deadline kills
+    the segment loop — the response carries it as an errorCode-250 entry
+    with partialResult=true, never a hang."""
+
+    ERROR_CODE = 250
+
+
 @dataclass
 class QueryUsage:
     query_id: str
@@ -30,6 +40,8 @@ class QueryUsage:
     bytes_allocated: int = 0
     cancelled: bool = False
     threads: int = 0
+    #: absolute wall-clock deadline (time.time() domain); None = no budget
+    deadline: Optional[float] = None
 
 
 class ResourceAccountant:
@@ -42,6 +54,41 @@ class ResourceAccountant:
         self._tls = threading.local()
         self.memory_limit_bytes = memory_limit_bytes
         self.query_timeout_s = query_timeout_s
+
+    # -- per-query deadline registration -------------------------------------
+    def begin_query(self, query_id: str,
+                    timeout_s: Optional[float] = None) -> QueryUsage:
+        """Register a query with an optional remaining-time budget. The
+        deadline is enforced by checker() polls (cooperative, same
+        discipline as check_cancelled) and by the watcher sweep."""
+        with self._lock:
+            u = self._queries.get(query_id)
+            if u is None:
+                u = QueryUsage(query_id)
+                self._queries[query_id] = u
+            if timeout_s is not None:
+                u.deadline = time.time() + timeout_s
+            return u
+
+    def check_query(self, query_id: str) -> None:
+        """Cooperative cancel/deadline poll for an EXPLICIT query id — the
+        executor's per-segment loop runs on pool threads that never called
+        setup_worker, so the thread-local path can't see them."""
+        with self._lock:
+            u = self._queries.get(query_id)
+        if u is None:
+            return
+        if u.cancelled:
+            raise QueryCancelledError(f"query {query_id} cancelled")
+        if u.deadline is not None and time.time() > u.deadline:
+            u.cancelled = True
+            raise BrokerTimeoutError(
+                f"query {query_id} exceeded its deadline")
+
+    def checker(self, query_id: str):
+        """Zero-arg closure for hot loops: raises when the query is
+        cancelled or past its deadline, else returns None."""
+        return lambda: self.check_query(query_id)
 
     # -- per-thread registration (ref setupRunner / clear) -------------------
     def setup_worker(self, query_id: str) -> None:
@@ -82,14 +129,35 @@ class ResourceAccountant:
             return
         with self._lock:
             u = self._queries.get(qid)
-        if u is not None and u.cancelled:
+        if u is None:
+            return
+        if u.cancelled:
             raise QueryCancelledError(f"query {qid} cancelled by accountant")
+        if u.deadline is not None and time.time() > u.deadline:
+            u.cancelled = True
+            raise BrokerTimeoutError(f"query {qid} exceeded its deadline")
+
+    #: cancel tombstones older than this are swept (a cancel whose query
+    #: never arrives must not accumulate forever)
+    TOMBSTONE_TTL_S = 300.0
 
     def cancel(self, query_id: str) -> bool:
+        """Sticky: cancelling an id that has not begun yet leaves a
+        cancelled TOMBSTONE, so a request still sitting in the scheduler
+        queue (its begin_query hasn't run) dies at its first cooperative
+        check instead of executing in full — the hedge-loser case.
+        finish_query reaps it after that run; stale tombstones for
+        requests that never arrive are swept here by age."""
         with self._lock:
             u = self._queries.get(query_id)
             if u is None:
-                return False
+                now = time.time()
+                for qid in [qid for qid, e in self._queries.items()
+                            if e.cancelled and e.threads == 0
+                            and now - e.start_time > self.TOMBSTONE_TTL_S]:
+                    del self._queries[qid]
+                u = QueryUsage(query_id)
+                self._queries[query_id] = u
             u.cancelled = True
             return True
 
@@ -109,6 +177,11 @@ class ResourceAccountant:
         now = time.time()
         with self._lock:
             live = [u for u in self._queries.values() if not u.cancelled]
+            for u in live:
+                if u.deadline is not None and now > u.deadline:
+                    u.cancelled = True
+                    killed.append(u.query_id)
+            live = [u for u in live if not u.cancelled]
             if self.query_timeout_s is not None:
                 for u in live:
                     if now - u.start_time > self.query_timeout_s:

@@ -39,9 +39,34 @@ KEYS: Dict[str, Any] = {
     "pinot.server.segment.warmup.enabled": True,
     "pinot.server.segment.warmup.max.plans": 32,
     "pinot.server.segment.warmup.log.plans.per.table": 64,
+    # fingerprint-log journal: persist the warmup plan log so a restarted
+    # server warms from history, not an empty log ("" = in-memory only)
+    "pinot.server.segment.warmup.journal.dir": "",
+    "pinot.server.segment.warmup.journal.max.bytes": 1 << 20,
+    # server-side grace added to the broker-shipped remaining budget
+    # before the local deadline trips (absorbs clock skew + queue jitter)
+    "pinot.server.query.deadline.grace.ms": 50,
     "pinot.broker.http.port": 8099,
     "pinot.broker.fanout.threads": 16,
     "pinot.broker.adaptive.selector": "hybrid",  # latency|inflight|hybrid
+    # end-to-end query budget (ref CommonConstants BROKER_TIMEOUT_MS):
+    # OPTION(timeoutMs=...) > table override > this default. The broker
+    # ships the REMAINING budget to servers, waits deadline-derived
+    # times, and cancels still-pending server work on expiry.
+    "pinot.broker.timeout.ms": 60000,
+    # hedged scatter (speculative retry, "The Tail at Scale"): after an
+    # adaptive delay — p95 over the selector's per-server latency EWMAs,
+    # clamped to [delay.min, delay.max] — re-issue still-pending plan
+    # entries on a different healthy replica and keep the first clean
+    # response. Off by default: it doubles worst-case fan-out.
+    "pinot.broker.hedge.enabled": False,
+    "pinot.broker.hedge.delay.min.ms": 25,
+    "pinot.broker.hedge.delay.max.ms": 1000,
+    # negative cache: memoize pruned-to-zero plans (epoch-keyed) so
+    # dashboard misfires skip routing + scatter entirely
+    "pinot.broker.negative.cache.enabled": True,
+    "pinot.broker.negative.cache.bytes": 1 << 20,
+    "pinot.broker.negative.cache.ttl.seconds": 60.0,
     # tier-1 whole-result cache: opt-in — a cached response bypasses
     # scatter/gather entirely, including failure detection
     "pinot.broker.result.cache.enabled": False,

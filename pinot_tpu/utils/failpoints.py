@@ -1,0 +1,218 @@
+"""Deterministic failpoint (chaos) registry.
+
+Reference parity: the reference ecosystem provokes slow/dead-replica
+scenarios with external chaos tooling; here fault injection is a
+first-class, deterministic library feature so every deadline / hedge /
+retry path has a reproducible test. Named sites are compiled into the
+production code as ``fire("site.name", ...)`` calls; when the site is
+unarmed the call is a dict lookup + None check (sub-microsecond), so the
+hooks are safe to leave in hot-ish control paths (they are NOT placed in
+per-row loops).
+
+Sites currently compiled in:
+
+  broker.scatter.before    — before the broker fans a plan entry out
+  server.execute.before    — server-side, before a query executes
+  server.execute.segment   — per segment in the execution loop
+  netframe.send            — every framed send (coordination, cache, stream)
+  connection.request       — broker->server request, response payload hook
+  cache.remote.get         — remote cache-tier GET
+
+Policies are armed per site with deterministic, seeded behavior:
+
+  fp.arm("server.execute.before", delay=0.5)                 # fixed delay
+  fp.arm("netframe.send", error=ConnectionError("chaos"))    # raise
+  fp.arm("connection.request", torn=True)                    # truncate payload
+  fp.arm("cache.remote.get", drop=True)                      # ConnectionError
+  fp.arm(site, delay=0.1, exponential=True, seed=7)          # seeded exp delay
+  fp.arm(site, error=..., times=1)                           # one-shot
+  fp.arm(site, delay=1.0, probability=0.3, seed=42)          # seeded coin
+  fp.arm(site, delay=1.0, where={"instance": "server_0"})    # ctx match
+
+Every ``hit()`` decision (fired or skipped) is appended to the policy's
+``decisions`` list, so a schedule replayed with the same seed can be
+asserted identical — chaos that reproduces exactly (ISSUE 3).
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class FailpointError(RuntimeError):
+    """Default error raised by an armed ``error=True`` policy."""
+
+
+class TornPayloadError(ValueError):
+    """Raised by consumers that detect a payload truncated by chaos."""
+
+
+class Failpoint:
+    """One armed site: action + trigger discipline + decision log."""
+
+    def __init__(self, site: str, delay: float = 0.0,
+                 exponential: bool = False,
+                 error: Optional[BaseException] = None,
+                 drop: bool = False, torn: bool = False,
+                 times: Optional[int] = None, probability: float = 1.0,
+                 seed: int = 0,
+                 where: Optional[Dict[str, Any]] = None):
+        self.site = site
+        self.delay = float(delay)
+        self.exponential = exponential
+        self.error = error
+        self.drop = drop
+        self.torn = torn
+        self.times = times
+        self.probability = float(probability)
+        self.where = dict(where or {})
+        # private seeded PRNG: decisions depend ONLY on (seed, hit order),
+        # never on the global random state, so a schedule replays exactly
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        #: per-hit decision log: (fired, delay_applied) tuples
+        self.decisions: List[Tuple[bool, float]] = []
+        self.hits = 0
+        self.fired = 0
+
+    def _matches(self, ctx: Dict[str, Any]) -> bool:
+        return all(ctx.get(k) == v for k, v in self.where.items())
+
+    def apply(self, ctx: Dict[str, Any],
+              payload: Optional[bytes]) -> Optional[bytes]:
+        """Run the policy for one hit; returns the (possibly mutated)
+        payload, sleeps, or raises — per the armed action."""
+        with self._lock:
+            if not self._matches(ctx):
+                return payload
+            self.hits += 1
+            if self.times is not None and self.fired >= self.times:
+                self.decisions.append((False, 0.0))
+                return payload
+            # the PRNG advances once per MATCHED hit whether or not the
+            # coin lands, so decision N is a pure function of (seed, N)
+            roll = self._rng.random()
+            if roll >= self.probability:
+                self.decisions.append((False, 0.0))
+                return payload
+            self.fired += 1
+            wait = self.delay
+            if wait and self.exponential:
+                wait = self._rng.expovariate(1.0 / wait)
+            self.decisions.append((True, wait))
+        if wait:
+            time.sleep(wait)
+        if self.error is not None:
+            raise self.error
+        if self.drop:
+            raise ConnectionError(f"failpoint {self.site}: connection drop")
+        if self.torn and payload is not None:
+            return payload[: max(1, len(payload) // 2)]
+        return payload
+
+
+class FailpointRegistry:
+    """Process-global site registry. Unarmed sites cost one dict get."""
+
+    def __init__(self):
+        self._sites: Dict[str, List[Failpoint]] = {}
+        self._lock = threading.Lock()
+
+    # -- arming --------------------------------------------------------
+    def arm(self, site: str, **kwargs) -> Failpoint:
+        fp = Failpoint(site, **kwargs)
+        with self._lock:
+            self._sites.setdefault(site, []).append(fp)
+        return fp
+
+    def disarm(self, site: str) -> None:
+        with self._lock:
+            self._sites.pop(site, None)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._sites.clear()
+
+    def armed(self, site: str, **kwargs) -> "_Armed":
+        """Context manager: ``with failpoints.armed(site, delay=1): ...``"""
+        return _Armed(self, site, kwargs)
+
+    # -- the hot call --------------------------------------------------
+    def hit(self, site: str, payload: Optional[bytes] = None,
+            **ctx) -> Optional[bytes]:
+        fps = self._sites.get(site)
+        if not fps:
+            return payload
+        for fp in list(fps):
+            payload = fp.apply(ctx, payload)
+        return payload
+
+    def count(self, site: str) -> int:
+        """Total fired actions across the site's armed policies."""
+        with self._lock:
+            return sum(fp.fired for fp in self._sites.get(site, []))
+
+
+class _Armed:
+    def __init__(self, registry: FailpointRegistry, site: str, kwargs: dict):
+        self._registry = registry
+        self._site = site
+        self._kwargs = kwargs
+        self.failpoint: Optional[Failpoint] = None
+
+    def __enter__(self) -> Failpoint:
+        self.failpoint = self._registry.arm(self._site, **self._kwargs)
+        return self.failpoint
+
+    def __exit__(self, *exc) -> None:
+        with self._registry._lock:
+            fps = self._registry._sites.get(self._site)
+            if fps and self.failpoint in fps:
+                fps.remove(self.failpoint)
+                if not fps:
+                    del self._registry._sites[self._site]
+
+
+class FaultSchedule:
+    """A named batch of (site, policy-kwargs) armed/disarmed together —
+    the ``MiniCluster(chaos=...)`` payload.
+
+    >>> sched = FaultSchedule([("server.execute.before",
+    ...                         {"delay": 0.5, "where": {"instance": "s0"}})])
+    >>> sched.arm(); ...; sched.disarm()
+    """
+
+    def __init__(self, entries: List[Tuple[str, Dict[str, Any]]]):
+        self.entries = list(entries)
+        self.failpoints: List[Failpoint] = []
+
+    def arm(self, registry: Optional[FailpointRegistry] = None) -> None:
+        registry = registry or failpoints
+        self.failpoints = [registry.arm(site, **kwargs)
+                           for site, kwargs in self.entries]
+
+    def disarm(self, registry: Optional[FailpointRegistry] = None) -> None:
+        registry = registry or failpoints
+        with registry._lock:
+            for fp in self.failpoints:
+                fps = registry._sites.get(fp.site)
+                if fps and fp in fps:
+                    fps.remove(fp)
+                    if not fps:
+                        del registry._sites[fp.site]
+        self.failpoints = []
+
+    def decisions(self) -> List[List[Tuple[bool, float]]]:
+        """Per-entry decision logs — assert two same-seed runs equal."""
+        return [list(fp.decisions) for fp in self.failpoints]
+
+
+#: the process-global registry production sites fire against
+failpoints = FailpointRegistry()
+
+#: module-level alias used at instrumented sites:
+#:   from pinot_tpu.utils.failpoints import fire
+#:   payload = fire("connection.request", payload=payload, server=name)
+fire = failpoints.hit

@@ -13,6 +13,8 @@ import struct
 import threading
 from typing import Any, Optional
 
+from pinot_tpu.utils.failpoints import fire
+
 LEN = struct.Struct("<I")
 MAX_FRAME = 64 << 20
 
@@ -31,6 +33,12 @@ def send_raw_frame(sock: socket.socket, payload: bytes) -> None:
     (cache entries) interleaved with JSON control frames on one channel.
     JSON frames are the same framing with a json.dumps/loads layer, so
     both kinds stay in sync by construction."""
+    # chaos site: delay / drop / tear ANY framed send (coordination,
+    # cache fabric, stream connector). A torn payload ships truncated
+    # bytes under a matching header — the frame arrives whole but its
+    # content no longer decodes, the half-written-entry failure the
+    # decode layers must degrade on (cache: miss; JSON: error surface)
+    payload = fire("netframe.send", payload=payload)
     sock.sendall(LEN.pack(len(payload)) + payload)
 
 

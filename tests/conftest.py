@@ -27,3 +27,8 @@ jax.config.update("jax_enable_x64", True)
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running tests excluded from tier-1 CI")
+    config.addinivalue_line(
+        "markers", "chaos: deterministic fault-injection suites "
+        "(utils/failpoints.py) — seeded and reproducible, so they run in "
+        "tier-1; the marker exists to select/deselect them explicitly "
+        "(e.g. -m chaos / -m 'not chaos')")

@@ -1,0 +1,513 @@
+"""Reliability layer: end-to-end deadlines, hedged scatter, failpoints.
+
+ISSUE 3 acceptance: with a failpoint delaying one server past the query
+deadline the broker returns within timeoutMs + epsilon with
+partialResult=true and a typed 250 exception (no 60s stall); the
+server-side segment loop observes the cancel and stops early; against a
+delayed-but-healthy replica the hedged request wins and the aggregate
+equals the unhedged result; chaos schedules reproduce exactly across two
+runs with the same seed.
+"""
+import threading
+import time
+
+import pytest
+
+from pinot_tpu.cluster.mini import MiniCluster
+from pinot_tpu.server.query_server import ServerConnection
+from pinot_tpu.server.scheduler import make_scheduler
+from pinot_tpu.utils.accounting import BrokerTimeoutError
+from pinot_tpu.utils.config import PinotConfiguration
+from pinot_tpu.utils.failpoints import (FailpointError, FailpointRegistry,
+                                        FaultSchedule, failpoints)
+from pinot_tpu.utils.metrics import get_registry
+from tests.queries.harness import (
+    build_segments, synthetic_columns, synthetic_schema,
+    synthetic_table_config)
+
+NUM_SEGMENTS = 4
+DOCS = 400
+COUNT_SUM = "SELECT COUNT(*), SUM(intCol) FROM testTable"
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoints.clear()
+    yield
+    failpoints.clear()
+
+
+def _segments(tmp_path_factory, name):
+    tmp = tmp_path_factory.mktemp(name)
+    data = [synthetic_columns(DOCS, seed=11 + i) for i in range(NUM_SEGMENTS)]
+    return build_segments(tmp, synthetic_schema(), synthetic_table_config(),
+                          data)
+
+
+def _cluster(segs, config=None, replicated=False, **kwargs):
+    c = MiniCluster(num_servers=2, config=config, **kwargs)
+    c.start()
+    c.add_table("testTable")
+    for i, seg in enumerate(segs):
+        c.add_segment("testTable", seg, server_idx=i % 2,
+                      replicas=[(i + 1) % 2] if replicated else ())
+    return c
+
+
+# ---------------------------------------------------------------------------
+# Failpoint registry semantics
+# ---------------------------------------------------------------------------
+
+class TestFailpoints:
+    def test_unarmed_site_passthrough(self):
+        reg = FailpointRegistry()
+        assert reg.hit("nope", payload=b"x") == b"x"
+        assert reg.count("nope") == 0
+
+    def test_delay_error_drop_torn(self):
+        reg = FailpointRegistry()
+        reg.arm("a", delay=0.05)
+        t0 = time.time()
+        reg.hit("a")
+        assert time.time() - t0 >= 0.05
+        reg.arm("b", error=FailpointError("boom"))
+        with pytest.raises(FailpointError):
+            reg.hit("b")
+        reg.arm("c", drop=True)
+        with pytest.raises(ConnectionError):
+            reg.hit("c")
+        reg.arm("d", torn=True)
+        assert reg.hit("d", payload=b"0123456789") == b"01234"
+
+    def test_one_shot_and_where_match(self):
+        reg = FailpointRegistry()
+        fp = reg.arm("s", error=FailpointError("x"), times=1,
+                     where={"instance": "server_0"})
+        # non-matching context never fires and never consumes the shot
+        assert reg.hit("s", instance="server_1", payload=b"p") == b"p"
+        with pytest.raises(FailpointError):
+            reg.hit("s", instance="server_0")
+        # one-shot exhausted
+        assert reg.hit("s", instance="server_0", payload=b"p") == b"p"
+        assert fp.fired == 1 and fp.hits == 2
+
+    def test_probability_seeded_reproducible(self):
+        def run(seed):
+            reg = FailpointRegistry()
+            fp = reg.arm("p", delay=0.0, probability=0.5, seed=seed)
+            for _ in range(32):
+                reg.hit("p")
+            return [d[0] for d in fp.decisions]
+
+        a, b = run(42), run(42)
+        assert a == b  # same seed -> identical schedule
+        assert any(a) and not all(a)  # the coin actually flips
+        assert run(7) != a  # a different seed moves the schedule
+
+    def test_exponential_delay_seeded(self):
+        def run():
+            reg = FailpointRegistry()
+            fp = reg.arm("e", delay=0.001, exponential=True, seed=3)
+            for _ in range(8):
+                reg.hit("e")
+            return [d[1] for d in fp.decisions]
+
+        a, b = run(), run()
+        assert a == b
+        assert len(set(a)) > 1  # actually exponential, not fixed
+
+    def test_armed_context_manager(self):
+        with failpoints.armed("ctx.site", error=FailpointError("x")):
+            with pytest.raises(FailpointError):
+                failpoints.hit("ctx.site")
+        assert failpoints.hit("ctx.site", payload=b"p") == b"p"
+
+
+# ---------------------------------------------------------------------------
+# Deadline propagation
+# ---------------------------------------------------------------------------
+
+#: server-side per-segment fan-out width (QueryExecutor max_threads):
+#: cooperative checks run at segment START, so observing a mid-loop stop
+#: needs MORE segments than worker threads — two waves, the second of
+#: which must see the cancel/deadline
+_POOL_WIDTH = 8
+_MANY_SEGMENTS = 12
+
+
+@pytest.mark.chaos
+class TestDeadlines:
+    @pytest.fixture(scope="class")
+    def cluster(self, tmp_path_factory):
+        c = _cluster(_segments(tmp_path_factory, "deadline"))
+        # a 12-segment table pinned to server_0: per-segment chaos gets
+        # two execution waves there (12 > the 8-thread segment pool)
+        tmp = tmp_path_factory.mktemp("deadline_many")
+        many = build_segments(
+            tmp, synthetic_schema(), synthetic_table_config(),
+            [synthetic_columns(50, seed=100 + i)
+             for i in range(_MANY_SEGMENTS)])
+        c.add_table("manyTable")
+        for seg in many:
+            c.add_segment("manyTable", seg, server_idx=0)
+        yield c
+        c.stop()
+
+    def test_deadline_expiry_returns_partial_not_hang(self, cluster):
+        """One server stuck past the budget: the broker answers within
+        timeoutMs + epsilon with partialResult + a typed 250, and the
+        healthy server's rows are present."""
+        with failpoints.armed("server.execute.before", delay=3.0,
+                              where={"instance": "server_0"}):
+            t0 = time.time()
+            resp = cluster.query(COUNT_SUM + " OPTION(timeoutMs=300)")
+            elapsed = time.time() - t0
+        assert elapsed < 1.0, f"stalled {elapsed:.2f}s past the deadline"
+        assert resp.partial_result is True
+        codes = [e["errorCode"] for e in resp.exceptions]
+        assert 250 in codes
+        assert "BrokerTimeoutError" in resp.exceptions[0]["message"]
+        # the healthy replica's partial made it into the answer
+        assert resp.rows[0][0] == DOCS * (NUM_SEGMENTS // 2)
+        assert resp.num_servers_queried == 2
+        assert resp.num_servers_responded == 1
+
+    def test_clean_run_is_not_partial(self, cluster):
+        resp = cluster.query(COUNT_SUM + " OPTION(timeoutMs=30000)")
+        assert resp.exceptions == [] and resp.partial_result is False
+        assert resp.rows[0][0] == DOCS * NUM_SEGMENTS
+
+    def test_deadline_observed_mid_segment_loop(self, cluster):
+        """Per-segment delays on a 12-segment server: the shipped
+        remaining budget expires between the first and second execution
+        wave, so the loop's cooperative check stops it — the server
+        answers a typed 250 without finishing every segment."""
+        with failpoints.armed("server.execute.segment", delay=0.5) as fp:
+            resp = cluster.query(
+                "SELECT COUNT(*) FROM manyTable OPTION(timeoutMs=300)")
+            # wave 1 (8 segments) is already in flight when the budget
+            # expires; wave 2's segment-start checks must all refuse
+            assert fp.fired <= _POOL_WIDTH, \
+                f"segment loop ran past the deadline ({fp.fired} fired)"
+        assert resp.partial_result is True
+        assert any(e["errorCode"] == 250 for e in resp.exceptions)
+
+    def test_broker_cancel_stops_server_segment_loop(self, cluster):
+        """Out-of-band cancel (the broker-expiry message) observed by the
+        segment loop: the blocked request returns a 250 promptly and the
+        second execution wave never runs."""
+        server = cluster.servers[0]
+        conn = ServerConnection(server.transport.host, server.transport.port)
+        try:
+            done = []
+            with failpoints.armed("server.execute.segment",
+                                  delay=0.3) as fp:
+                t = threading.Thread(
+                    target=lambda: done.append(conn.request(
+                        "manyTable_OFFLINE",
+                        "SELECT COUNT(*) FROM manyTable", None,
+                        request_id=991, query_id="cancel-me")))
+                t.start()
+                time.sleep(0.15)  # wave 1 is mid-sleep
+                cancel_conn = ServerConnection(server.transport.host,
+                                               server.transport.port)
+                assert cancel_conn.cancel("cancel-me") is True
+                cancel_conn.close()
+                t.join(timeout=5)
+                assert not t.is_alive(), "cancel did not unblock the query"
+                assert fp.fired <= _POOL_WIDTH, \
+                    "segment loop ran past the cancel"
+            from pinot_tpu.server import datatable
+            _results, exc, _stats = datatable.deserialize_results(done[0])
+            assert any(e["errorCode"] == 250 for e in exc)
+        finally:
+            conn.close()
+
+    def test_scheduler_refuses_expired_queue_work(self):
+        sched = make_scheduler("fcfs", 2)
+        try:
+            fut = sched.submit(lambda: b"ran", deadline=time.time() - 1.0)
+            with pytest.raises(BrokerTimeoutError):
+                fut.result(timeout=5)
+            # a live deadline still runs
+            fut = sched.submit(lambda: b"ran", deadline=time.time() + 5.0)
+            assert fut.result(timeout=5) == b"ran"
+        finally:
+            sched.stop()
+
+    def test_client_surfaces_typed_timeout_with_partial(self,
+                                                        tmp_path_factory):
+        """DB-API client: a deadline miss raises PinotTimeoutError (not a
+        generic failure) and carries the broker's partial rows."""
+        from pinot_tpu.client.connection import PinotTimeoutError, connect
+        segs = _segments(tmp_path_factory, "client_deadline")
+        c = MiniCluster(num_servers=2)
+        c.start(with_http=True)
+        c.add_table("testTable")
+        for i, seg in enumerate(segs):
+            c.add_segment("testTable", seg, server_idx=i % 2)
+        try:
+            conn = connect(f"127.0.0.1:{c.http.port}")
+            assert conn.execute(COUNT_SUM).rows[0][0] == DOCS * NUM_SEGMENTS
+            with failpoints.armed("server.execute.before", delay=3.0,
+                                  where={"instance": "server_0"}):
+                with pytest.raises(PinotTimeoutError) as exc_info:
+                    conn.execute(COUNT_SUM, timeout_ms=300)
+            rs = exc_info.value.result_set
+            assert rs is not None and rs.partial_result is True
+            assert rs.rows[0][0] == DOCS * (NUM_SEGMENTS // 2)
+        finally:
+            c.stop()
+
+    def test_set_statement_timeout(self, cluster):
+        """SET timeoutMs (the client Connection's channel) binds the
+        budget exactly like OPTION(...)."""
+        with failpoints.armed("server.execute.before", delay=3.0,
+                              where={"instance": "server_1"}):
+            t0 = time.time()
+            resp = cluster.query(f"SET timeoutMs = 300; {COUNT_SUM}")
+            elapsed = time.time() - t0
+        assert elapsed < 1.0 and resp.partial_result is True
+
+
+# ---------------------------------------------------------------------------
+# Hedged scatter
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+class TestHedging:
+    @pytest.fixture(scope="class")
+    def cluster(self, tmp_path_factory):
+        cfg = PinotConfiguration(overrides={
+            "pinot.broker.hedge.enabled": True,
+            "pinot.broker.hedge.delay.min.ms": 60,
+        })
+        c = _cluster(_segments(tmp_path_factory, "hedge"), config=cfg,
+                     replicated=True)
+        yield c
+        c.stop()
+
+    def _meters(self):
+        m = get_registry("broker")
+        return {name: m.meter(name)
+                for name in ("hedge_issued", "hedge_won", "hedge_wasted")}
+
+    def test_hedge_wins_against_delayed_replica(self, cluster):
+        base = cluster.query(COUNT_SUM)
+        assert base.exceptions == []
+        before = self._meters()
+        with failpoints.armed("server.execute.before", delay=1.5,
+                              where={"instance": "server_0"}):
+            t0 = time.time()
+            resp = cluster.query(COUNT_SUM)
+            elapsed = time.time() - t0
+        after = self._meters()
+        # the hedge rescued the latency AND the aggregate is bit-equal
+        # to the unhedged answer — duplicates never double-merge
+        assert elapsed < 1.0, f"hedge did not rescue: {elapsed:.2f}s"
+        assert resp.rows == base.rows
+        assert resp.exceptions == [] and resp.partial_result is False
+        assert after["hedge_issued"] >= before["hedge_issued"] + 1
+        assert after["hedge_won"] >= before["hedge_won"] + 1
+
+    def test_hedge_loses_cleanly_against_fast_primary(self, cluster):
+        """Primary slower than the hedge delay but faster than the hedge
+        replica: the primary wins, the duplicate is discarded, and the
+        aggregate still equals the unhedged answer."""
+        base = cluster.query(COUNT_SUM)
+        before = self._meters()
+        # server_0 (primary for half the segments) is slow enough to
+        # trigger hedging but beats the even-slower hedge target
+        with failpoints.armed("server.execute.before", delay=0.2,
+                              where={"instance": "server_0"}), \
+             failpoints.armed("server.execute.before", delay=1.0,
+                              where={"instance": "server_1"}):
+            resp = cluster.query(
+                COUNT_SUM + " OPTION(timeoutMs=10000)")
+        after = self._meters()
+        assert resp.rows == base.rows
+        assert resp.exceptions == [] and resp.partial_result is False
+        assert after["hedge_issued"] >= before["hedge_issued"] + 1
+        assert after["hedge_wasted"] >= before["hedge_wasted"] + 1
+
+    def test_errored_hedge_holds_for_clean_primary(self, tmp_path_factory):
+        """First CLEAN response wins: a hedge that instantly answers with
+        an in-payload error must not beat a slow-but-healthy primary —
+        the errored payload is held back and the clean twin merges."""
+        cfg = PinotConfiguration(overrides={
+            "pinot.broker.hedge.enabled": True,
+            "pinot.broker.hedge.delay.min.ms": 60,
+        })
+        segs = _segments(tmp_path_factory, "hedge_fallback")
+        c = MiniCluster(num_servers=2, config=cfg)
+        c.start()
+        c.add_table("testTable")
+        # ONE segment, primary on server_0 (fresh route, rr=0), replica
+        # on server_1 — the hedge target is deterministic
+        c.add_segment("testTable", segs[0], server_idx=0, replicas=[1])
+        try:
+            with failpoints.armed("server.execute.before", delay=0.3,
+                                  where={"instance": "server_0"}), \
+                 failpoints.armed("server.execute.before",
+                                  error=FailpointError("hedge replica bad"),
+                                  where={"instance": "server_1"}):
+                resp = c.query("SELECT COUNT(*) FROM testTable")
+            assert resp.rows[0][0] == DOCS
+            assert resp.exceptions == [] and resp.partial_result is False
+        finally:
+            c.stop()
+
+    def test_hedged_duplicates_never_double_count(self, cluster):
+        """Both replicas answer (one late): COUNT must match exactly —
+        the canonical double-merge symptom would be 2x."""
+        base = cluster.query("SELECT COUNT(*) FROM testTable")
+        with failpoints.armed("server.execute.before", delay=0.15,
+                              where={"instance": "server_1"}):
+            for _ in range(3):
+                resp = cluster.query("SELECT COUNT(*) FROM testTable")
+                assert resp.rows == base.rows
+
+
+# ---------------------------------------------------------------------------
+# MiniCluster chaos schedules
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+class TestChaosSchedules:
+    def _run(self, segs, seed):
+        sched = FaultSchedule([
+            ("server.execute.before",
+             {"error": FailpointError("chaos"), "probability": 0.5,
+              "seed": seed, "where": {"instance": "server_0"}}),
+        ])
+        c = _cluster(segs, chaos=sched)
+        try:
+            outcomes = []
+            for _ in range(12):
+                resp = c.query("SELECT COUNT(*) FROM testTable")
+                outcomes.append(bool(resp.exceptions))
+            return outcomes, sched.decisions()
+        finally:
+            c.stop()
+
+    def test_schedule_reproducible_across_runs(self, tmp_path_factory):
+        segs = _segments(tmp_path_factory, "chaos")
+        out_a, dec_a = self._run(segs, seed=1234)
+        out_b, dec_b = self._run(segs, seed=1234)
+        assert dec_a == dec_b, "same seed must replay the same schedule"
+        assert out_a == out_b, "same schedule must produce the same outcomes"
+        assert any(out_a) and not all(out_a)
+        out_c, dec_c = self._run(segs, seed=99)
+        assert dec_c != dec_a
+
+
+# ---------------------------------------------------------------------------
+# Negative cache (pruned-to-zero plans)
+# ---------------------------------------------------------------------------
+
+class TestNegativeCache:
+    @pytest.fixture()
+    def empty_cluster(self):
+        c = MiniCluster(num_servers=1)
+        c.start()
+        c.add_table("emptyTable")
+        yield c
+        c.stop()
+
+    def test_pruned_to_zero_memoized_epoch_keyed(self, empty_cluster,
+                                                 tmp_path_factory):
+        c = empty_cluster
+        neg = c.broker._negative_cache
+        q = "SELECT COUNT(*) FROM emptyTable"
+        r1 = c.query(q)
+        assert r1.exceptions == [] and r1.cache_hit is False
+        assert len(neg) == 1
+        r2 = c.query(q)
+        assert r2.cache_hit is True  # served without routing or scatter
+        assert r2.rows == r1.rows
+        hits_before = neg.stats.hits
+        # skipCache bypasses the memo entirely
+        r3 = c.query(q + " OPTION(skipCache=true)")
+        assert r3.cache_hit is False
+        assert neg.stats.hits == hits_before
+        # a segment arrival moves the epoch: the empty answer stops
+        # being addressable by construction
+        segs = _segments(tmp_path_factory, "negcache")
+        c.add_segment("emptyTable", segs[0], server_idx=0)
+        r4 = c.query(q)
+        assert r4.cache_hit is False
+        assert r4.rows[0][0] == DOCS
+
+    def test_nonempty_plan_never_negative_cached(self, tmp_path_factory):
+        segs = _segments(tmp_path_factory, "negcache2")
+        c = _cluster(segs)
+        try:
+            c.query(COUNT_SUM)
+            assert len(c.broker._negative_cache) == 0
+        finally:
+            c.stop()
+
+
+# ---------------------------------------------------------------------------
+# FingerprintLog journal persistence
+# ---------------------------------------------------------------------------
+
+class TestFingerprintJournal:
+    def _log(self, path, **kw):
+        from pinot_tpu.cache.warmup import FingerprintLog
+        return FingerprintLog(8, journal_path=str(path), **kw)
+
+    def test_restart_reloads_history(self, tmp_path):
+        p = tmp_path / "fp.jsonl"
+        log = self._log(p)
+        log.record("t1", "fp1", "SELECT 1", extra_filter="x <= 5")
+        log.record("t1", "fp2", "SELECT 2")
+        log.record("t2", "fp3", "SELECT 3")
+        reborn = self._log(p)
+        assert reborn.plans("t1") == [("fp1", "SELECT 1", "x <= 5"),
+                                      ("fp2", "SELECT 2", None)]
+        assert reborn.plans("t2") == [("fp3", "SELECT 3", None)]
+
+    def test_torn_and_corrupt_lines_degrade_per_line(self, tmp_path):
+        p = tmp_path / "fp.jsonl"
+        log = self._log(p)
+        log.record("t", "fp1", "SELECT 1")
+        log.record("t", "fp2", "SELECT 2")
+        with open(p, "a") as f:
+            f.write('{"t": "t", "f": "fp3", "s": "SELECT 3"')  # torn tail
+        reborn = self._log(p)
+        assert [fp for fp, _s, _x in reborn.plans("t")] == ["fp1", "fp2"]
+        # a wholly binary file degrades to empty, not an exception
+        p2 = tmp_path / "junk.jsonl"
+        p2.write_bytes(b"\x00\xff garbage \x00")
+        assert len(self._log(p2)) == 0
+
+    def test_journal_caps_and_compacts(self, tmp_path):
+        p = tmp_path / "fp.jsonl"
+        log = self._log(p, journal_max_bytes=4096)
+        for i in range(400):
+            log.record("t", f"fp{i}", f"SELECT {i} FROM x")
+        # bounded on disk AND the reloaded view matches the live bound
+        assert p.stat().st_size < 3 * 4096
+        reborn = self._log(p, journal_max_bytes=4096)
+        assert [e[0] for e in reborn.plans("t")] == \
+               [e[0] for e in log.plans("t")]
+
+    def test_server_warms_from_journal_after_restart(self, tmp_path_factory):
+        """End to end: run queries, tear the cluster down, start a fresh
+        one over the same journal dir — the new server's log already
+        holds the pre-restart plans."""
+        jdir = tmp_path_factory.mktemp("journal")
+        cfg = PinotConfiguration(overrides={
+            "pinot.server.segment.warmup.journal.dir": str(jdir)})
+        segs = _segments(tmp_path_factory, "journal_segs")
+        c = _cluster(segs, config=cfg)
+        try:
+            c.query(COUNT_SUM)
+        finally:
+            c.stop()
+        c2 = _cluster(segs, config=cfg)
+        try:
+            assert len(c2.servers[0].executor.fingerprint_log) > 0
+        finally:
+            c2.stop()
