@@ -282,6 +282,252 @@ def deadline_overhead_main():
         f"deadline checks cost {overhead_pct:.2f}% p50 (>{2.0}%)"
 
 
+def concurrency_main(smoke: bool = False):
+    """--concurrency [--smoke]: A/B the dispatch pipeline (ISSUE 4).
+
+    Closed-loop N-client driver over fingerprint-equal queries with
+    per-client literals (the dashboard-fleet case), run twice IN THE
+    SAME PROCESS: dispatch.mode=serialized (the pre-PR inline dispatch:
+    collective-bearing kernels hold the process-global lock across
+    dispatch + fetch) vs pipelined (dispatch ring + shared-plan
+    micro-batching + staging/compute overlap). Records aggregate QPS,
+    single-client p50, batch-size stats, and the steady-state retrace
+    count; asserts the acceptance bars (full mode) and writes
+    BENCH_dispatch.json. --smoke shrinks data + durations to fit the
+    tier-1 timeout.
+
+    On CPU hosts the bench forces the 8-virtual-device mesh the server
+    runs under in CI — that is exactly the configuration where the old
+    path serializes every kernel process-wide, which is the bottleneck
+    this pipeline removes."""
+    import statistics as stats
+    import tempfile
+    import threading
+
+    import jax
+
+    try:
+        jax.config.update("jax_num_cpu_devices", 8)
+    except AttributeError:
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                                   + " --xla_force_host_platform_device_count=8")
+
+    from pinot_tpu.models import (DataType, FieldSpec, FieldType, Schema,
+                                  TableConfig, TableType)
+    from pinot_tpu.ops import dispatch as dispatch_mod
+    from pinot_tpu.ops import kernels
+    from pinot_tpu.ops.engine import TpuOperatorExecutor
+    from pinot_tpu.query.context import QueryContext
+    from pinot_tpu.query.executor import QueryExecutor
+    from pinot_tpu.segment.creator import SegmentCreator
+    from pinot_tpu.segment.loader import load_segment
+    from pinot_tpu.utils.config import PinotConfiguration
+
+    # the serving regime the pipeline targets (and the TPU reality:
+    # BENCH_r05 device time ~9.8ms vs ~119ms serialized query): per-query
+    # DEVICE COMPUTE is small next to per-launch overhead, so the win is
+    # amortizing launches, not adding FLOPs. Small segments put the CPU
+    # stand-in in the same regime; scale up on real accelerators.
+    num_segments = 4
+    docs = 2_000
+    clients = 8
+    duration_s = 1.2 if smoke else 6.0
+    p50_iters = 12 if smoke else 40
+
+    schema = Schema("ssb", [
+        FieldSpec("lo_orderdate", DataType.INT, FieldType.DIMENSION),
+        FieldSpec("lo_discount", DataType.INT, FieldType.DIMENSION),
+        FieldSpec("lo_quantity", DataType.INT, FieldType.DIMENSION),
+        FieldSpec("lo_extendedprice", DataType.INT, FieldType.METRIC),
+    ])
+    tc = TableConfig("ssb", TableType.OFFLINE)
+    tc.indexing.no_dictionary_columns = ["lo_extendedprice"]
+    tc.indexing.compression = "PASS_THROUGH"
+    creator = SegmentCreator(tc, schema)
+    tmp = tempfile.mkdtemp(prefix="bench_dispatch_")
+    dates = np.array([y * 10000 + m * 100 + d
+                      for y in range(1992, 1999)
+                      for m in range(1, 13) for d in range(1, 29)],
+                     dtype=np.int32)
+    segments = []
+    for i in range(num_segments):
+        rng = np.random.default_rng(3000 + i)
+        out = os.path.join(tmp, f"seg_{i}")
+        creator.build({
+            "lo_orderdate": dates[rng.integers(0, len(dates), docs)],
+            "lo_discount": rng.integers(0, 11, docs).astype(np.int32),
+            "lo_quantity": rng.integers(1, 51, docs).astype(np.int32),
+            "lo_extendedprice": rng.integers(
+                90_000, 10_000_000, docs).astype(np.int32),
+        }, out, f"ssb_{i}")
+        segments.append(load_segment(out))
+    total_rows = sum(s.num_docs for s in segments)
+
+    # the dashboard fleet: one plan fingerprint, per-client literals
+    queries = [
+        ("SELECT SUM(lo_extendedprice * lo_discount), COUNT(*) FROM ssb "
+         "WHERE lo_orderdate BETWEEN 19940101 AND 19940131 "
+         f"AND lo_discount BETWEEN {a} AND {a + 2} "
+         "AND lo_quantity BETWEEN 26 AND 35")
+        for a in range(clients)]
+
+    def warm_batch_buckets(engine):
+        """Deterministically trace every batched (plan, bucket) shape the
+        measured window can produce, so steady-state retraces are a real
+        regression signal, not warmup noise."""
+        prep = engine._prepare_agg(
+            segments, QueryContext.from_sql(queries[0]))
+        assert prep is not None, "bench query must stage on-device"
+        launch = prep[3]
+        guard = dispatch_mod._CPU_COLLECTIVE_LOCK if launch.collective \
+            else None
+        b = 2
+        while b <= max(2, dispatch_mod._pow2(clients)):
+            kern = dispatch_mod.compiled_batched_kernel(launch.plan, b)
+            plist = (launch.params,) * b
+            if guard is not None:
+                with guard:
+                    jax.block_until_ready(kern(
+                        launch.cols, plist, launch.num_docs,
+                        D=launch.D, G=launch.G))
+            else:
+                jax.block_until_ready(kern(
+                    launch.cols, plist, launch.num_docs,
+                    D=launch.D, G=launch.G))
+            b *= 2
+
+    # clients drive the SERVER-SIDE execution path
+    # (QueryExecutor.execute_context, what query_server.py calls per
+    # request) with pre-parsed contexts: SQL parse + broker reduce are
+    # per-request Python that the GIL serializes in this reproduction
+    # regardless of dispatch — a JVM/C++ server does them on independent
+    # cores, so including them would just measure the GIL, not the
+    # pipeline under test
+    def make_mode(mode):
+        engine = TpuOperatorExecutor(config=PinotConfiguration(
+            overrides={"pinot.server.dispatch.mode": mode}))
+        ex = QueryExecutor(segments, use_tpu=True, engine=engine)
+        ctxs = [QueryContext.from_sql(q) for q in queries]
+        for c in ctxs:  # stage + compile the single-kernel path
+            results, _stats = ex.execute_context(c)
+            assert results
+        return engine, ex, ctxs
+
+    eng_ser, ex_ser, ctxs_ser = make_mode("serialized")
+    eng_pipe, ex_pipe, ctxs_pipe = make_mode("pipelined")
+    warm_batch_buckets(eng_pipe)
+
+    # single-client p50: STRICTLY INTERLEAVED A/B samples, so ambient
+    # drift (thermal, noisy neighbors, allocator state) hits both modes
+    # equally instead of masquerading as pipeline overhead
+    def one(ex, ctxs, i):
+        t0 = time.perf_counter()
+        ex.execute_context(ctxs[i % len(ctxs)])
+        return (time.perf_counter() - t0) * 1e3
+
+    for i in range(4):
+        one(ex_ser, ctxs_ser, i), one(ex_pipe, ctxs_pipe, i)
+    lat_ser, lat_pipe = [], []
+    for i in range(p50_iters):
+        # alternate which mode goes first within the pair: a fixed order
+        # hands the second call a systematically warmer CPU
+        if i % 2 == 0:
+            lat_ser.append(one(ex_ser, ctxs_ser, i))
+            lat_pipe.append(one(ex_pipe, ctxs_pipe, i))
+        else:
+            lat_pipe.append(one(ex_pipe, ctxs_pipe, i))
+            lat_ser.append(one(ex_ser, ctxs_ser, i))
+
+    def closed_window(ex, ctxs, window_s):
+        counts = [0] * clients
+        stop_at = time.perf_counter() + window_s
+
+        def client(ci):
+            j = 0
+            while time.perf_counter() < stop_at:
+                ex.execute_context(ctxs[(ci + j) % len(ctxs)])
+                counts[ci] += 1
+                j += 1
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return sum(counts), time.perf_counter() - t0
+
+    # ALTERNATING closed-loop windows (ser/pipe/ser/pipe...): one long
+    # window per mode would compare two different moments of a shared
+    # box; interleaved short windows hand ambient drift to both modes
+    reg = eng_pipe._dispatcher._metrics
+    batch_t0 = reg.timer("dispatch_batch_size")
+    batch_c0, batch_max0 = batch_t0.count, batch_t0.max_ms
+    traces0 = kernels.trace_count()
+    rounds = 2 if smoke else 6
+    ser_n = ser_wall = pipe_n = pipe_wall = 0.0
+    for _r in range(rounds):
+        n, w = closed_window(ex_ser, ctxs_ser, duration_s / rounds)
+        ser_n += n
+        ser_wall += w
+        n, w = closed_window(ex_pipe, ctxs_pipe, duration_s / rounds)
+        pipe_n += n
+        pipe_wall += w
+    batch_t = reg.timer("dispatch_batch_size")
+    serialized = {"qps": ser_n / ser_wall, "queries_completed": int(ser_n)}
+    pipelined = {
+        "qps": pipe_n / pipe_wall,
+        "queries_completed": int(pipe_n),
+        "retraces_steady": kernels.trace_count() - traces0,
+        "batch_launches": batch_t.count - batch_c0,
+        "batch_size_max": max(batch_t.max_ms, batch_max0),
+    }
+    serialized["p50_single_ms"] = round(stats.median(lat_ser), 2)
+    pipelined["p50_single_ms"] = round(stats.median(lat_pipe), 2)
+    # PAIRED median delta: sample i of each mode ran back-to-back, so
+    # the per-pair difference cancels ambient drift (cpu frequency,
+    # noisy neighbors) that makes the two independent medians swing
+    # ±10% on a small shared box
+    paired_delta_ms = stats.median(
+        p - s for s, p in zip(lat_ser, lat_pipe))
+    speedup = pipelined["qps"] / max(serialized["qps"], 1e-9)
+    p50_delta_pct = paired_delta_ms / serialized["p50_single_ms"] * 100.0
+    out = {
+        "metric": "concurrent_dispatch_qps_speedup",
+        "value": round(speedup, 2),
+        "unit": "x",
+        "clients": clients,
+        "duration_s": duration_s,
+        "num_segments": num_segments,
+        "docs_per_segment": docs,
+        "total_rows": total_rows,
+        "smoke": smoke,
+        "serialized": {k: (round(v, 2) if isinstance(v, float) else v)
+                       for k, v in serialized.items()},
+        "pipelined": {k: (round(v, 2) if isinstance(v, float) else v)
+                      for k, v in pipelined.items()},
+        "p50_single_delta_pct": round(p50_delta_pct, 2),
+        "p50_paired_delta_ms": round(paired_delta_ms, 3),
+        "asserted": {"min_speedup": 2.0, "max_p50_regress_pct": 5.0,
+                     "max_steady_retraces": 0},
+    }
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_dispatch.json"), "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps(out))
+    assert pipelined["retraces_steady"] == 0, \
+        f"steady-state retraces: {pipelined['retraces_steady']}"
+    if not smoke:
+        assert speedup >= 2.0, f"pipelined speedup {speedup:.2f}x < 2x"
+        # epsilon absorbs scheduler noise on few-ms medians (the lone-
+        # query fast path makes the two single-client code paths nearly
+        # identical; any real regression shows up far above this)
+        assert p50_delta_pct < 5.0 or paired_delta_ms < 0.5, \
+            f"single-client p50 regressed {p50_delta_pct:.1f}% " \
+            f"({paired_delta_ms:.2f}ms paired)"
+
+
 def main():
     os.makedirs(DATA_DIR, exist_ok=True)
     build_data()
@@ -353,5 +599,7 @@ def main():
 if __name__ == "__main__":
     if "--deadline-overhead" in sys.argv:
         deadline_overhead_main()
+    elif "--concurrency" in sys.argv:
+        concurrency_main(smoke="--smoke" in sys.argv)
     else:
         main()

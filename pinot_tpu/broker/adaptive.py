@@ -17,6 +17,8 @@ import math
 import threading
 from typing import Dict, List, Optional, Set
 
+from pinot_tpu.utils.metrics import Timer
+
 
 class AdaptiveServerSelector:
     def __init__(self, mode: str = "hybrid", alpha: float = 0.3):
@@ -25,6 +27,11 @@ class AdaptiveServerSelector:
         self.alpha = alpha
         self._ewma: Dict[str, float] = {}
         self._inflight: Dict[str, int] = {}
+        #: per-server latency RESERVOIRS (utils/metrics.Timer, Vitter R):
+        #: every request's latency has equal sampling probability, so the
+        #: pooled samples carry the TRUE per-request tail — an EWMA
+        #: smooths exactly the spikes a hedge trigger needs to see
+        self._timers: Dict[str, Timer] = {}
         self._lock = threading.Lock()
 
     # -- stats feed (the broker wraps every server request) --------------
@@ -39,19 +46,32 @@ class AdaptiveServerSelector:
             cur = self._ewma.get(server)
             self._ewma[server] = latency_s if cur is None else \
                 (1 - self.alpha) * cur + self.alpha * latency_s
+            t = self._timers.get(server)
+            if t is None:
+                t = self._timers[server] = Timer()
+            t.update(latency_s * 1e3)
 
     def latency_quantile(self, q: float) -> float:
-        """Quantile (seconds) over the per-server latency EWMAs — the
-        hedged-scatter trigger delay: a request still pending past the
-        fleet's p95 is in the slow tail worth hedging ("The Tail at
-        Scale"). 0.0 until any latency has been observed (callers clamp
-        with the configured floor)."""
+        """Quantile (seconds) over the POOLED per-server latency
+        reservoirs — the hedged-scatter trigger delay: a request still
+        pending past the fleet's p95 is in the slow tail worth hedging
+        ("The Tail at Scale"). Pooled raw samples replace the earlier
+        p95-of-EWMA: quantiles of smoothed means understate tails (an
+        EWMA never reaches the spikes), so hedges fired either too early
+        or, after a calm stretch, far too late. Caveat: reservoirs are
+        fixed-size, so the pool weights SERVERS equally, not requests —
+        a low-traffic outlier replica is over-represented relative to
+        its request share (volume-weighted pooling is a follow-up); the
+        tail spikes themselves are still carried faithfully, which is
+        what the trigger needs. 0.0 until any latency has been observed
+        (callers clamp with the configured floor)."""
         with self._lock:
-            vals = sorted(self._ewma.values())
+            vals = sorted(s for t in self._timers.values()
+                          for s in t.samples)
         if not vals:
             return 0.0
         idx = min(len(vals) - 1, max(0, math.ceil(q * len(vals)) - 1))
-        return vals[idx]
+        return vals[idx] / 1e3
 
     # -- selection -------------------------------------------------------
     def score(self, server: str) -> float:

@@ -171,8 +171,9 @@ class BrokerRequestHandler:
         return self._default_timeout_ms
 
     def _hedge_delay_s(self) -> Optional[float]:
-        """Adaptive hedge trigger: p95 over the selector's per-server
-        latency EWMAs, clamped to the configured floor/ceiling. None
+        """Adaptive hedge trigger: p95 over the selector's pooled
+        per-server latency reservoirs (true per-request tails, not
+        smoothed means), clamped to the configured floor/ceiling. None
         when hedging is off."""
         if not self._hedge_enabled:
             return None

@@ -31,16 +31,56 @@ import logging
 import queue
 import socket
 import socketserver
+import struct
 import threading
 import time
 from typing import Dict, Optional
 
 from pinot_tpu.cache.core import LruTtlCache
+from pinot_tpu.segment import codec
 from pinot_tpu.utils.failpoints import FailpointError, fire
 from pinot_tpu.utils.netframe import (MAX_FRAME, recv_frame, recv_raw_frame,
                                       send_frame, send_raw_frame)
 
 log = logging.getLogger(__name__)
+
+#: compressed-payload wrapper: magic + u8 codec id + u32 raw size, then
+#: the codec output. Distinct from the DataTable wire magic ('PDT1'), so
+#: raw entries can never be mistaken for wrapped ones.
+_COMPRESS_MAGIC = b"PZC1"
+_COMPRESS_HDR = struct.Struct("<BI")
+
+
+def _wrap_payload(payload: bytes, threshold: int) -> bytes:
+    """Compress payloads at/above the threshold with the segment codecs
+    (ZSTANDARD when the wheel is present, GZIP otherwise — codec.resolve
+    picks, and the wrapper records the codec actually used so readers
+    never guess). Incompressible payloads ship raw: the wrapper is only
+    kept when it actually shrinks the wire bytes."""
+    if threshold <= 0 or len(payload) < threshold:
+        return payload
+    cid, comp = codec.compress(payload, codec.ZSTANDARD)
+    wrapped = _COMPRESS_MAGIC + _COMPRESS_HDR.pack(cid, len(payload)) + comp
+    return wrapped if len(wrapped) < len(payload) else payload
+
+
+def _unwrap_payload(payload: bytes) -> Optional[bytes]:
+    """Transparent decode of a wrapped payload; raw payloads pass
+    through. None on a torn/corrupt wrapper — callers degrade to miss
+    (the shared-tier contract: never raise into a query)."""
+    if not payload.startswith(_COMPRESS_MAGIC):
+        return payload
+    try:
+        cid, raw_size = _COMPRESS_HDR.unpack_from(payload,
+                                                  len(_COMPRESS_MAGIC))
+        out = codec.decompress(
+            payload[len(_COMPRESS_MAGIC) + _COMPRESS_HDR.size:],
+            cid, raw_size)
+        if len(out) != raw_size:
+            return None
+        return out
+    except Exception:  # noqa: BLE001 — torn/corrupt entry = miss
+        return None
 
 
 class CacheServer:
@@ -286,10 +326,18 @@ class RemoteCacheBackend:
     def __init__(self, address: str, timeout_seconds: float = 2.0,
                  pool_size: int = 2, failure_threshold: int = 3,
                  reset_seconds: float = 5.0, metrics=None,
-                 labels: Optional[dict] = None):
+                 labels: Optional[dict] = None,
+                 compress_threshold: int = 0):
         host, port = address.rsplit(":", 1)
         self.host, self.port = host, int(port)
         self.timeout = float(timeout_seconds)
+        #: payloads at/above this size are codec-wrapped before the wire
+        #: (pinot.cache.server.compress.threshold.bytes; <= 0 disables).
+        #: Compression is CLIENT-side: the cache server stores opaque
+        #: bytes, so one compressing client warms the whole fleet and
+        #: every mount must share the wrapper format (it does — the
+        #: magic + codec id ride in the payload itself)
+        self.compress_threshold = int(compress_threshold)
         self._metrics = metrics
         self._labels = labels
         self.breaker = CircuitBreaker(failure_threshold, reset_seconds,
@@ -371,6 +419,13 @@ class RemoteCacheBackend:
             return None
         resp, body = out
         if resp.get("hit") and body is not None:
+            body = _unwrap_payload(body)
+            if body is None:
+                # torn/corrupt compressed entry: degrade to miss (the
+                # caller recomputes; the entry ages out or is rewritten)
+                self.misses += 1
+                self._meter("misses")
+                return None
             self.hits += 1
             self._meter("hits")
             ttl = resp.get("ttl")
@@ -381,6 +436,12 @@ class RemoteCacheBackend:
 
     def put(self, key: str, payload: bytes,
             ttl_seconds: Optional[float] = None) -> bool:
+        wrapped = _wrap_payload(payload, self.compress_threshold)
+        if wrapped is not payload:
+            if self._metrics is not None:
+                self._metrics.add_meter("remote_cache_compressed_bytes",
+                                        len(wrapped), labels=self._labels)
+            payload = wrapped
         if len(payload) > MAX_FRAME:
             return False
         header: Dict[str, object] = {"op": "set", "key": key}

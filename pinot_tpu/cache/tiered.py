@@ -150,5 +150,7 @@ def tiered_backend_from_config(config, tier_prefix: str, metric_prefix: str,
             "pinot.cache.remote.breaker.failures"),
         reset_seconds=config.get_float(
             "pinot.cache.remote.breaker.reset.seconds"),
-        metrics=metrics, labels=labels)
+        metrics=metrics, labels=labels,
+        compress_threshold=config.get_int(
+            "pinot.cache.server.compress.threshold.bytes"))
     return TieredCache(l1, l2, remote_key_fn)

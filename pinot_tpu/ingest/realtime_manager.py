@@ -27,6 +27,7 @@ from pinot_tpu.models import Schema, TableConfig
 from pinot_tpu.segment.creator import SegmentCreator
 from pinot_tpu.segment.loader import load_segment
 from pinot_tpu.server.data_manager import TableDataManager
+from pinot_tpu.utils.failpoints import fire
 
 log = logging.getLogger(__name__)
 
@@ -152,6 +153,12 @@ class RealtimeSegmentDataManager:
     def _consume_loop(self) -> None:
         while not self._stop.is_set():
             try:
+                # chaos site: a slow/failing upstream fetch — the
+                # consumer must back off and resume, never die (seeded
+                # FaultSchedules drive it deterministically)
+                fire("ingest.realtime.consume",
+                     table=self.table_config.name,
+                     partition=self.partition_id)
                 batch = self.consumer.fetch_messages(self.current_offset, 100)
             except Exception:  # noqa: BLE001
                 log.exception("fetch failed; backing off")

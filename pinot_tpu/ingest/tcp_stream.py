@@ -30,6 +30,7 @@ from pinot_tpu.ingest.stream import (LongMsgOffset, MessageBatch,
                                      StreamConsumerFactory, StreamMessage,
                                      StreamMetadataProvider,
                                      register_stream_factory)
+from pinot_tpu.utils.failpoints import fire
 from pinot_tpu.utils.netframe import (FramedChannel, recv_frame,
                                       send_frame)
 
@@ -162,6 +163,12 @@ class TcpPartitionConsumer(PartitionGroupConsumer):
 
     def fetch_messages(self, start_offset: LongMsgOffset,
                        timeout_ms: int) -> MessageBatch:
+        # chaos site: delay/fail/drop a fetch frame on the wire edge —
+        # errors surface to the realtime manager's backoff path exactly
+        # like a dead stream broker would
+        fire("ingest.tcp.frame", topic=self.topic,
+             partition=self.partition_id,
+             start=int(start_offset.offset))
         r = self._ch.request({"op": "fetch", "topic": self.topic,
                               "partition": self.partition_id,
                               "start": start_offset.offset, "max": 500})

@@ -1,0 +1,482 @@
+"""KernelDispatcher: the engine's pipelined device-launch stage.
+
+Reference parity: the role of pinot-core's per-server query worker pool
+(query/scheduler/QueryScheduler.java submitting segment work to
+executors) — but shaped like an inference-serving dispatcher, because
+the hot path here is ONE device program per query, not N segment tasks:
+
+  * dispatch ring — a single dispatch thread + bounded queue replaces
+    the ad-hoc dispatch lock: callers enqueue STAGED launches (columns
+    already HBM-resident, predicate params already resolved) and get
+    futures. The ring orders collective-bearing programs on host
+    platforms (XLA's intra-process CPU collectives deadlock when two
+    partitioned programs interleave their rendezvous), while real
+    accelerators keep fully concurrent submission through a launch pool.
+  * shared-plan micro-batching — concurrent queries whose `DevicePlan`
+    and segment batch match but whose leaf predicate parameters differ
+    (the dashboard-fleet case: same shape, different literals) coalesce
+    within a bounded window into ONE launch with a leading query-params
+    axis (vmap over the staged `params` pytree); results split back per
+    caller. The batched kernel is cached by (plan, batch-size bucket) —
+    a cross-query retrace is a bug, and `kernels.trace_count()` /
+    the `kernel_retrace` meter make one loud.
+  * staging/compute overlap — device->host result fetch runs on a fetch
+    pool OFF the ring, so the next launch overlaps the previous fetch;
+    `execute_async` staging runs on a staging pool so host-side padding
+    + `jax.device_put` for query N+1 proceed while query N's kernel
+    occupies the device (`staging_overlap_ms` measures exactly that).
+
+Deadline/cancel checks are honored while a launch waits in the ring: a
+cancelled query's future fails and the query leaves its batch before
+launch. Chaos tests hook the ring via the `server.dispatch.before`
+failpoint site (delay a dispatch, fail it, or reorder around it).
+
+Knobs (utils/config.py): pinot.server.dispatch.mode (pipelined |
+serialized — the latter reproduces the pre-ring inline dispatch for
+A/B), .ring.size, .batch.window.ms, .batch.max.
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from pinot_tpu.ops import kernels
+from pinot_tpu.utils.failpoints import fire
+
+#: XLA's intra-process CPU collectives rendezvous by (devices, op) — two
+#: partitioned computations RUNNING concurrently (even from different
+#: engine instances: host-platform devices are process-global)
+#: interleave their rendezvous and deadlock. Collective-bearing launches
+#: therefore hold this process-global lock across dispatch +
+#: block_until_ready; real accelerators have a hardware-ordered
+#: collective queue and never take it.
+_CPU_COLLECTIVE_LOCK = threading.Lock()
+
+#: shared worker pools (module-level: fetch/launch work is engine-
+#: agnostic, and per-engine pools would leak threads across the many
+#: short-lived engines tests create)
+_LAUNCH_THREADS = 8
+_FETCH_THREADS = 4
+_STAGING_THREADS = 4
+_pools_lock = threading.Lock()
+_launch_pool: Optional[ThreadPoolExecutor] = None
+_fetch_pool: Optional[ThreadPoolExecutor] = None
+_staging_pool: Optional[ThreadPoolExecutor] = None
+
+
+def launch_pool() -> ThreadPoolExecutor:
+    global _launch_pool
+    with _pools_lock:
+        if _launch_pool is None:
+            _launch_pool = ThreadPoolExecutor(
+                max_workers=_LAUNCH_THREADS,
+                thread_name_prefix="kernel-launch")
+        return _launch_pool
+
+
+def fetch_pool() -> ThreadPoolExecutor:
+    global _fetch_pool
+    with _pools_lock:
+        if _fetch_pool is None:
+            _fetch_pool = ThreadPoolExecutor(
+                max_workers=_FETCH_THREADS,
+                thread_name_prefix="kernel-fetch")
+        return _fetch_pool
+
+
+def staging_pool() -> ThreadPoolExecutor:
+    global _staging_pool
+    with _pools_lock:
+        if _staging_pool is None:
+            _staging_pool = ThreadPoolExecutor(
+                max_workers=_STAGING_THREADS,
+                thread_name_prefix="kernel-staging")
+        return _staging_pool
+
+
+def _pow2(n: int) -> int:
+    v = 1
+    while v < n:
+        v *= 2
+    return v
+
+
+@functools.lru_cache(maxsize=256)
+def compiled_batched_kernel(plan, B: int):
+    """One jit per (plan, batch-size bucket B): vmap of the single-query
+    kernel over a leading query-params axis. Column blocks and num_docs
+    broadcast (in_axes=None via closure) — the whole point is that B
+    queries share one pass over the staged data. Stacking the per-query
+    params happens INSIDE the jit so GSPMD owns the resulting sharding
+    on mesh engines. Dispatchers pad partial batches up to B with
+    replicated leader params, so jit's shape cache sees only bucketed
+    batch sizes — steady state is zero retraces."""
+    base = kernels.make_kernel(plan)
+
+    def fn(cols, plist, num_docs, D, G=0):
+        stacked = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *plist)
+        return jax.vmap(
+            lambda p: base(cols, p, num_docs, D=D, G=G))(stacked)
+
+    return jax.jit(fn, static_argnames=("D", "G"))
+
+
+class Launch:
+    """One staged device launch waiting in the ring.
+
+    `call` runs the already-compiled single-query kernel; the batching
+    fields (plan/cols/params/num_docs/D/G) are only read when
+    `batch_key` is set and the ring coalesces this launch with
+    fingerprint-equal peers. `cancel_check` is polled while queued —
+    raising removes the launch from its batch and fails the future with
+    the raised error (the ResourceAccountant deadline/cancel checker)."""
+
+    __slots__ = ("call", "plan", "cols", "params", "num_docs", "D", "G",
+                 "batch_key", "collective", "cancel_check", "site_ctx",
+                 "future")
+
+    def __init__(self, call: Callable[[], Any], plan=None, cols=None,
+                 params=None, num_docs=None, D: int = 0, G: int = 0,
+                 batch_key: Optional[tuple] = None, collective: bool = False,
+                 cancel_check: Optional[Callable[[], None]] = None,
+                 site_ctx: Optional[Dict[str, Any]] = None):
+        self.call = call
+        self.plan = plan
+        self.cols = cols
+        self.params = params
+        self.num_docs = num_docs
+        self.D = D
+        self.G = G
+        self.batch_key = batch_key
+        self.collective = collective
+        self.cancel_check = cancel_check
+        self.site_ctx = site_ctx or {}
+        self.future: Future = Future()
+
+
+class KernelDispatcher:
+    """Owns device launches for one engine: ring + batching + overlap."""
+
+    #: ring thread exits after this much idle time (a fresh submit
+    #: respawns it) — engines are created freely in tests and a
+    #: threads-forever design would leak one per instance
+    IDLE_EXIT_S = 5.0
+
+    def __init__(self, config=None, metrics=None,
+                 labels: Optional[Dict[str, str]] = None):
+        from pinot_tpu.utils.config import PinotConfiguration
+        from pinot_tpu.utils.metrics import get_registry
+        cfg = config or PinotConfiguration()
+        self.mode = cfg.get_str("pinot.server.dispatch.mode") or "pipelined"
+        self.ring_size = max(1, cfg.get_int("pinot.server.dispatch.ring.size"))
+        self.batch_max = max(1, cfg.get_int("pinot.server.dispatch.batch.max"))
+        self.window_s = max(
+            0.0, cfg.get_float("pinot.server.dispatch.batch.window.ms") / 1e3)
+        self._metrics = metrics if metrics is not None \
+            else get_registry("server")
+        self._labels = labels
+        self._cv = threading.Condition()
+        self._pending: List[Launch] = []
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+        #: callers currently inside an engine execute for this engine —
+        #: the batching window only waits when >1 (a lone client never
+        #: pays window latency for a batch that cannot form)
+        self._active = 0
+        # device-busy clock: wall time with >=1 launch in flight, so
+        # staging can measure how much of itself overlapped compute
+        self._busy_lock = threading.Lock()
+        self._inflight = 0
+        self._busy_accum = 0.0
+        self._busy_since = 0.0
+        self._trace_seen = kernels.trace_count()
+        self._trace_meter_lock = threading.Lock()
+
+    # -- caller accounting --------------------------------------------
+    @contextlib.contextmanager
+    def active(self):
+        self.enter_active()
+        try:
+            yield
+        finally:
+            self.exit_active()
+
+    def enter_active(self) -> None:
+        with self._cv:
+            self._active += 1
+            self._cv.notify_all()
+
+    def exit_active(self) -> None:
+        with self._cv:
+            self._active = max(0, self._active - 1)
+            self._cv.notify_all()
+
+    # -- device-busy clock --------------------------------------------
+    def _busy_begin(self) -> None:
+        with self._busy_lock:
+            if self._inflight == 0:
+                self._busy_since = time.monotonic()
+            self._inflight += 1
+
+    def _busy_end(self) -> None:
+        with self._busy_lock:
+            self._inflight -= 1
+            if self._inflight == 0:
+                self._busy_accum += time.monotonic() - self._busy_since
+
+    def busy_ms(self) -> float:
+        """Cumulative wall-ms during which >=1 launch was in flight."""
+        with self._busy_lock:
+            total = self._busy_accum
+            if self._inflight > 0:
+                total += time.monotonic() - self._busy_since
+        return total * 1e3
+
+    # -- metrics helpers ----------------------------------------------
+    def observe(self, name: str, value: float) -> None:
+        self._metrics.add_timing(name, value, labels=self._labels)
+
+    def _set_depth_locked(self) -> None:
+        self._metrics.set_gauge("dispatch_queue_depth", len(self._pending),
+                                labels=self._labels)
+
+    def _meter_traces(self) -> None:
+        # read-modify-write under a lock: finishes land concurrently on
+        # caller/launch/fetch threads, and a racy double-read would
+        # double-count the retrace meter precisely under the concurrent
+        # load it exists to watch
+        with self._trace_meter_lock:
+            now = kernels.trace_count()
+            delta = now - self._trace_seen
+            if delta <= 0:
+                return
+            self._trace_seen = now
+        self._metrics.add_meter("kernel_retrace", delta,
+                                labels=self._labels)
+
+    # -- submission ----------------------------------------------------
+    def submit(self, launch: Launch) -> Future:
+        """Enqueue a staged launch; returns its future (an np.ndarray of
+        the packed kernel output, or the launch's error). Blocks for ring
+        space (backpressure), polling the launch's cancel check."""
+        if self.mode == "serialized":
+            return self._submit_serialized(launch)
+        with self._cv:
+            idle = (self._active <= 1 and not self._pending
+                    and self._inflight == 0)
+        if idle:
+            # lone-query fast path: no concurrency means nothing to
+            # coalesce or overlap — dispatch inline and pay ZERO ring
+            # latency (single-stream p50 stays at the pre-ring floor).
+            # A racing second caller just falls back to the collective
+            # lock inside, which is the pre-ring behavior anyway.
+            return self._submit_serialized(launch)
+        with self._cv:
+            while len(self._pending) >= self.ring_size and not self._closed:
+                if launch.cancel_check is not None:
+                    try:
+                        launch.cancel_check()
+                    except BaseException as e:  # noqa: BLE001
+                        launch.future.set_exception(e)
+                        return launch.future
+                self._cv.wait(0.05)
+            if self._closed:
+                launch.future.set_exception(
+                    RuntimeError("dispatcher closed"))
+                return launch.future
+            self._pending.append(launch)
+            self._set_depth_locked()
+            self._ensure_thread_locked()
+            self._cv.notify_all()
+        return launch.future
+
+    def _submit_serialized(self, launch: Launch) -> Future:
+        """Inline dispatch + fetch on the caller thread, the collective
+        lock held across both: the exact pre-PR `_dispatch_guard`
+        behavior. Serves both the `serialized` compat mode (A/B baseline
+        + escape hatch) and the pipelined mode's lone-query fast path.
+        The dispatch failpoint fires here too, so chaos schedules hit
+        every dispatch regardless of path."""
+        try:
+            fire("server.dispatch.before", **launch.site_ctx)
+            if launch.cancel_check is not None:
+                launch.cancel_check()
+            guard = _CPU_COLLECTIVE_LOCK if launch.collective \
+                else contextlib.nullcontext()
+            self._busy_begin()
+            try:
+                with guard:
+                    packed = np.asarray(launch.call())
+            finally:
+                self._busy_end()
+                self._meter_traces()
+            launch.future.set_result(packed)
+        except BaseException as e:  # noqa: BLE001 — future carries it
+            launch.future.set_exception(e)
+        return launch.future
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            for it in self._pending:
+                it.future.set_exception(RuntimeError("dispatcher closed"))
+            self._pending.clear()
+            self._cv.notify_all()
+
+    # -- ring thread ---------------------------------------------------
+    def _ensure_thread_locked(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="kernel-dispatch")
+            self._thread.start()
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                end = time.monotonic() + self.IDLE_EXIT_S
+                while not self._pending:
+                    if self._closed:
+                        self._thread = None
+                        return
+                    left = end - time.monotonic()
+                    if left <= 0:
+                        self._thread = None
+                        return
+                    self._cv.wait(left)
+                leader = self._pending.pop(0)
+                self._set_depth_locked()
+                self._cv.notify_all()
+            self._dispatch_one(leader)
+
+    def _dispatch_one(self, leader: Launch) -> None:
+        """Every exit path MUST complete every popped launch's future —
+        a future left unset strands its caller in .result() forever (the
+        unbounded-wait class the deadline work removed), so the whole
+        body is guarded and failures fan out to the batch."""
+        batch = [leader]
+        try:
+            # chaos hook: delay/fail a dispatch inside the ring (a delay
+            # here also widens the coalescing window, which is exactly
+            # what a chaos test wants to provoke batching determinism)
+            fire("server.dispatch.before", **leader.site_ctx)
+            batch = self._coalesce(leader)
+            self._dispatch_batch(batch)
+        except BaseException as e:  # noqa: BLE001 — futures carry it
+            for it in batch:
+                if not it.future.done():
+                    it.future.set_exception(e)
+
+    def _dispatch_batch(self, batch: List[Launch]) -> None:
+        # deadline/cancel checks honored while queued: a cancelled query
+        # leaves the batch before launch
+        live: List[Launch] = []
+        for it in batch:
+            try:
+                if it.cancel_check is not None:
+                    it.cancel_check()
+                live.append(it)
+            except BaseException as e:  # noqa: BLE001
+                it.future.set_exception(e)
+        if not live:
+            return
+        self.observe("dispatch_batch_size", float(len(live)))
+        batched = len(live) > 1
+        if batched:
+            # pad to the batch-size bucket with replicated leader params
+            # so jit's shape cache only ever sees bucketed batch sizes
+            bucket = _pow2(len(live))
+            plist = tuple(it.params for it in live) \
+                + (live[0].params,) * (bucket - len(live))
+            kern = compiled_batched_kernel(live[0].plan, bucket)
+            lead = live[0]
+            call = lambda: kern(lead.cols, plist, lead.num_docs,  # noqa: E731
+                                D=lead.D, G=lead.G)
+        else:
+            call = live[0].call
+        if live[0].collective:
+            # CPU-collective ordering: ONE partitioned program in flight
+            # process-wide; block on the ring (compute completion), then
+            # hand the ready buffers to the fetch pool so the NEXT
+            # launch overlaps this result's host assembly
+            self._busy_begin()
+            try:
+                with _CPU_COLLECTIVE_LOCK:
+                    out = call()
+                    jax.block_until_ready(out)
+            except BaseException as e:  # noqa: BLE001
+                self._busy_end()
+                for it in live:
+                    it.future.set_exception(e)
+                return
+            fetch_pool().submit(self._finish, live, out, batched)
+        else:
+            # fully concurrent submission (real accelerators order their
+            # own queue; non-partitioned host programs don't rendezvous)
+            launch_pool().submit(self._run_and_finish, live, call, batched)
+
+    def _coalesce(self, leader: Launch) -> List[Launch]:
+        """Collect fingerprint-equal launches behind the leader, waiting
+        up to the batching window — but only while the engine observably
+        has more callers than the batch holds (a lone query never waits)."""
+        batch = [leader]
+        if leader.batch_key is None or self.batch_max <= 1:
+            return batch
+        deadline = time.monotonic() + self.window_s
+        with self._cv:
+            while True:
+                i = 0
+                while i < len(self._pending) and len(batch) < self.batch_max:
+                    if self._pending[i].batch_key == leader.batch_key:
+                        batch.append(self._pending.pop(i))
+                        self._cv.notify_all()
+                    else:
+                        i += 1
+                target = min(self.batch_max, max(1, self._active))
+                if len(batch) >= target:
+                    break
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    break
+                self._cv.wait(left)
+            self._set_depth_locked()
+        return batch
+
+    def _run_and_finish(self, live: List[Launch], call, batched: bool) -> None:
+        self._busy_begin()
+        try:
+            out = call()
+        except BaseException as e:  # noqa: BLE001
+            self._busy_end()
+            for it in live:
+                it.future.set_exception(e)
+            self._meter_traces()
+            return
+        self._finish(live, out, batched)
+
+    def _finish(self, live: List[Launch], out, batched: bool) -> None:
+        """Fetch (device->host) + split per caller; runs OFF the ring.
+        The busy interval (opened at launch) closes when the fetch lands."""
+        try:
+            arr = np.asarray(out)
+            if batched:
+                for i, it in enumerate(live):
+                    it.future.set_result(arr[i])
+            else:
+                live[0].future.set_result(arr)
+        except BaseException as e:  # noqa: BLE001
+            for it in live:
+                if not it.future.done():
+                    it.future.set_exception(e)
+        finally:
+            self._busy_end()
+            self._meter_traces()

@@ -19,7 +19,6 @@ Responsibilities:
 """
 from __future__ import annotations
 
-import contextlib
 import threading
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -29,7 +28,9 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from pinot_tpu.ops import dispatch as dispatch_mod
 from pinot_tpu.ops import kernels
+from pinot_tpu.ops.dispatch import KernelDispatcher, Launch
 from pinot_tpu.ops.plan_ir import DeviceLeaf, DevicePlan
 from pinot_tpu.query.context import QueryContext
 from pinot_tpu.query.expressions import (
@@ -60,43 +61,20 @@ def _pow2(n: int, floor: int = 128) -> int:
 # exact only below 2^24; segments larger than this are rejected to host
 MAX_DOCS_PER_SEGMENT = 1 << 24
 
-#: XLA's intra-process CPU collectives rendezvous by (devices, op) — two
-#: partitioned computations dispatched concurrently (even from DIFFERENT
-#: engine instances: the host-platform devices are process-global)
-#: interleave their rendezvous and deadlock. Serialize multi-device
-#: dispatch process-wide on CPU backends; real accelerators have a
-#: hardware-ordered collective queue and keep fully concurrent dispatch.
-_CPU_COLLECTIVE_LOCK = threading.Lock()
-
-
-def _dispatch_guard(engine: "TpuOperatorExecutor", kernel):
-    """Lock to hold across a kernel dispatch + result fetch: the global
-    CPU collective lock for PARTITIONED execution on host devices, a
-    no-op everywhere else (single device, a real accelerator, or a
-    non-XLA kernel stand-in — only staged computations can carry the
-    collectives that rendezvous). EVERY staged kernel on a mesh engine
-    is partitioned: _put stages inputs with NamedSharding, so even the
-    plain-jit kernels (group-by without a docs axis, top-N) compile to
-    GSPMD programs with all-gathers — the doc_axis==1 compiled_kernel
-    path is exactly what deadlocked the suite, so don't narrow this to
-    the shard_map branch."""
-    if engine._mesh is not None and engine.devices \
-            and getattr(engine.devices[0], "platform", "") == "cpu" \
-            and isinstance(kernel, jax.stages.Wrapped):
-        return _CPU_COLLECTIVE_LOCK
-    return contextlib.nullcontext()
-
 
 class TpuOperatorExecutor:
     def __init__(self, devices: Optional[Sequence] = None, mesh=None,
-                 config=None):
+                 config=None, metrics_labels=None):
         """mesh: an explicit (segments, docs) jax Mesh — blocks shard over
         BOTH axes and the kernel runs under shard_map with psum/pmin/pmax
         collectives over `docs` (SURVEY §2.6 rows 6-7). Without one, >1
         device gets a segments-only mesh (GSPMD partitions the reductions);
         one device runs the plain jit kernel.
-        config: a PinotConfiguration for the cache budgets (the server
-        passes its instance config through; None reads env/defaults)."""
+        config: a PinotConfiguration for the cache budgets and the
+        dispatch-ring knobs (the server passes its instance config
+        through; None reads env/defaults).
+        metrics_labels: labels for the dispatcher's metrics (the server
+        passes its instance id)."""
         self._doc_axis = 1
         if mesh is not None:
             self._mesh = mesh
@@ -152,6 +130,11 @@ class TpuOperatorExecutor:
         #: bounded LRU (hot filter parameters survive cache pressure
         #: instead of a wholesale clear dropping them all at once)
         self._params_cache: "OrderedDict[tuple, Any]" = OrderedDict()
+        #: pipelined dispatch stage: ring + micro-batching + fetch
+        #: overlap (ops/dispatch.py); owns NO engine state — staging
+        #: stays under the engine lock, launches ride the ring
+        self._dispatcher = KernelDispatcher(config=_cfg,
+                                            labels=metrics_labels)
 
     # ------------------------------------------------------------------
     # capability check (structural)
@@ -262,40 +245,137 @@ class TpuOperatorExecutor:
     # ------------------------------------------------------------------
     # execution
     # ------------------------------------------------------------------
-    def execute(self, segments: List[ImmutableSegment], ctx: QueryContext
-                ) -> Tuple[List[Any], List[ImmutableSegment]]:
-        """Returns (device results, segments to fall back to host).
+    def _needs_cpu_ordering(self, kernel) -> bool:
+        """True when this kernel's execution must be ordered process-wide
+        (dispatch.py's collective lock): PARTITIONED execution on host
+        devices. EVERY staged kernel on a mesh engine is partitioned:
+        _put stages inputs with NamedSharding, so even the plain-jit
+        kernels (group-by without a docs axis, top-N) compile to GSPMD
+        programs with all-gathers — the doc_axis==1 compiled_kernel path
+        is exactly what deadlocked the suite, so don't narrow this to the
+        shard_map branch. Single device, real accelerators, and non-XLA
+        kernel stand-ins never order."""
+        return self._mesh is not None and bool(self.devices) \
+            and getattr(self.devices[0], "platform", "") == "cpu" \
+            and isinstance(kernel, jax.stages.Wrapped)
 
-        Plan + staging run under the engine lock (they mutate the block
-        caches); dispatch and the device->host result fetch run outside it,
-        so N server threads overlap their round trips on the async device
-        queue instead of serializing behind one ~100ms sync each.
-        """
-        if ctx.distinct:
-            return self._execute_distinct(segments, ctx)
-        if not ctx.aggregations:
-            return self._execute_topn(segments, ctx)
+    def _prepare_agg(self, segments: List[ImmutableSegment],
+                     ctx: QueryContext, cancel_check=None):
+        """Plan + stage under the engine lock (they mutate the block
+        caches), then wrap the launch for the dispatch ring. Returns
+        (plan, slots_of_fn, S_real, Launch), or None -> host fallback.
+        The staging_overlap_ms histogram records how much of this staging
+        ran while another query's kernel occupied the device — the
+        pipeline's third leg (staging/compute overlap)."""
+        busy0 = self._dispatcher.busy_ms()
         with self._engine_lock:
             plan_info = self._plan(segments, ctx)
             if plan_info is None:
-                return [], segments
+                return None
             plan, slots_of_fn = plan_info
             try:
                 cols, params, num_docs, S_real, D, G = self._stage(
                     segments, ctx, plan)
             except _NotStageable:
-                return [], segments
+                return None
             if self._doc_axis > 1:
                 kernel = kernels.compiled_sharded_kernel(plan, self._mesh)
+                batchable = False  # vmap over shard_map: not supported
             else:
                 kernel = kernels.compiled_kernel(plan)
-        with _dispatch_guard(self, kernel):
-            packed = np.asarray(kernel(cols, params, num_docs, D=D, G=G))
+                batchable = isinstance(kernel, jax.stages.Wrapped)
+        overlap = self._dispatcher.busy_ms() - busy0
+        if overlap > 0:
+            self._dispatcher.observe("staging_overlap_ms", overlap)
+        batch_key = None
+        if batchable and self._dispatcher.batch_max > 1:
+            # fingerprint-equal queries (same plan + same staged segment
+            # batch + same shape bucket) may coalesce into one launch
+            batch_key = (plan, _batch_id(segments), D, G)
+        launch = Launch(
+            call=lambda: kernel(cols, params, num_docs, D=D, G=G),
+            plan=plan, cols=cols, params=params, num_docs=num_docs,
+            D=D, G=G, batch_key=batch_key,
+            collective=self._needs_cpu_ordering(kernel),
+            cancel_check=cancel_check,
+            site_ctx={"table": ctx.table, "mode": "agg"})
+        return plan, slots_of_fn, S_real, launch
+
+    def execute(self, segments: List[ImmutableSegment], ctx: QueryContext,
+                cancel_check=None
+                ) -> Tuple[List[Any], List[ImmutableSegment]]:
+        """Returns (device results, segments to fall back to host).
+
+        Plan + staging run under the engine lock (they mutate the block
+        caches); the launch rides the dispatch ring, which coalesces
+        fingerprint-equal concurrent queries into one batched kernel and
+        fetches results off-ring — N server threads overlap their device
+        round trips instead of serializing behind one ~100ms sync each.
+        cancel_check: polled while the launch waits in the ring (a
+        cancelled/deadline-expired query leaves its batch before launch).
+        """
+        if ctx.distinct:
+            return self._execute_distinct(segments, ctx, cancel_check)
+        if not ctx.aggregations:
+            return self._execute_topn(segments, ctx, cancel_check)
+        with self._dispatcher.active():
+            prep = self._prepare_agg(segments, ctx, cancel_check)
+            if prep is None:
+                return [], segments
+            plan, slots_of_fn, S_real, launch = prep
+            packed = self._dispatcher.submit(launch).result()
         results = self._assemble(segments, ctx, plan, packed, S_real, slots_of_fn)
         return results, []
 
+    def execute_async(self, segments: List[ImmutableSegment],
+                      ctx: QueryContext, cancel_check=None):
+        """Future of (device results, host-fallback segments): staging
+        runs on the dispatch staging pool, so the caller can execute its
+        host-path segments while this query's padding + device_put (and
+        then its kernel) proceed — query N+1 stages while query N
+        computes. Non-agg shapes (top-N / DISTINCT) and the serialized
+        compat mode run inline on the caller, exactly like execute()."""
+        from concurrent.futures import Future as _Future
+        if ctx.distinct or not ctx.aggregations \
+                or self._dispatcher.mode == "serialized":
+            fut: "_Future" = _Future()
+            try:
+                fut.set_result(self.execute(segments, ctx, cancel_check))
+            except BaseException as e:  # noqa: BLE001 — future carries it
+                fut.set_exception(e)
+            return fut
+        out: "_Future" = _Future()
+        self._dispatcher.enter_active()
+        out.add_done_callback(lambda _f: self._dispatcher.exit_active())
+
+        def stage_and_enqueue():
+            try:
+                prep = self._prepare_agg(segments, ctx, cancel_check)
+                if prep is None:
+                    out.set_result(([], segments))
+                    return
+                plan, slots_of_fn, S_real, launch = prep
+                lfut = self._dispatcher.submit(launch)
+
+                def finish(f):
+                    try:
+                        packed = f.result()
+                        out.set_result((self._assemble(
+                            segments, ctx, plan, packed, S_real,
+                            slots_of_fn), []))
+                    except BaseException as e:  # noqa: BLE001
+                        out.set_exception(e)
+
+                lfut.add_done_callback(finish)
+            except BaseException as e:  # noqa: BLE001
+                out.set_exception(e)
+
+        dispatch_mod.staging_pool().submit(stage_and_enqueue)
+        return out
+
     # ------------------------------------------------------------------
-    def _execute_distinct(self, segments, ctx: QueryContext):
+    def _execute_distinct(self, segments, ctx: QueryContext,
+                          cancel_check=None):
         """DISTINCT d1..dk = a presence-only GROUP BY d1..dk: reuse the
         whole group-by kernel path and convert keys to DistinctResult rows
         (ref DistinctOperator; dictionary-based distinct)."""
@@ -307,14 +387,14 @@ class TpuOperatorExecutor:
             filter=ctx.filter, group_by=sel, having=None, order_by=[],
             limit=ctx.limit, offset=0, options=dict(ctx.options))
         gctx._extract_aggregations()
-        results, remaining = self.execute(segments, gctx)
+        results, remaining = self.execute(segments, gctx, cancel_check)
         from pinot_tpu.query.results import DistinctResult
         out = [DistinctResult(set(r.groups.keys()), r.stats)
                for r in results]
         return out, remaining
 
     # ------------------------------------------------------------------
-    def _execute_topn(self, segments, ctx: QueryContext):
+    def _execute_topn(self, segments, ctx: QueryContext, cancel_check=None):
         if self._doc_axis > 1:
             return [], segments  # top-K across doc shards: host path
         with self._engine_lock:
@@ -327,8 +407,12 @@ class TpuOperatorExecutor:
             except _NotStageable:
                 return [], segments
             kernel = kernels.compiled_topn_kernel(plan)
-        with _dispatch_guard(self, kernel):
-            packed = np.asarray(kernel(cols, params, num_docs, D=D))
+        with self._dispatcher.active():
+            packed = self._dispatcher.submit(Launch(
+                call=lambda: kernel(cols, params, num_docs, D=D),
+                collective=self._needs_cpu_ordering(kernel),
+                cancel_check=cancel_check,
+                site_ctx={"table": ctx.table, "mode": "topn"})).result()
         return self._assemble_topn(segments, ctx, packed, S_real), []
 
     # ------------------------------------------------------------------
@@ -608,8 +692,11 @@ class TpuOperatorExecutor:
             except _NotStageable:
                 return nothing
             kernel = kernels.compiled_topn_kernel(plan)
-        with _dispatch_guard(self, kernel):
-            packed = np.asarray(kernel(cols, params, num_docs, D=D))
+        with self._dispatcher.active():
+            packed = self._dispatcher.submit(Launch(
+                call=lambda: kernel(cols, params, num_docs, D=D),
+                collective=self._needs_cpu_ordering(kernel),
+                site_ctx={"mode": "doc_ids"})).result()
         out = []
         for s, seg in enumerate(segments[:S_real]):
             matched = int(packed[s, 0])

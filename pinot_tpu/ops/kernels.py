@@ -14,6 +14,7 @@ the engine pads inputs to bucketed sizes to bound recompiles
 from __future__ import annotations
 
 import functools
+import threading
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -25,6 +26,27 @@ from pinot_tpu.ops.plan_ir import DeviceLeaf, DevicePlan
 # is used instead of scatter-add
 ONEHOT_MAX_GROUPS = 1024
 _ONEHOT_CHUNK = 4096
+
+# ---------------------------------------------------------------------------
+# trace (recompile) accounting: kernel bodies run at TRACE time only, so a
+# counter bumped inside them counts XLA compilations, not dispatches. The
+# dispatch ring meters the delta as `kernel_retrace` — steady-state traffic
+# over warmed shape buckets must keep this flat (a growing count means a
+# shape/bucket leak re-compiling the hot path).
+# ---------------------------------------------------------------------------
+_trace_lock = threading.Lock()
+_trace_count = 0
+
+
+def note_trace() -> None:
+    global _trace_count
+    with _trace_lock:
+        _trace_count += 1
+
+
+def trace_count() -> int:
+    with _trace_lock:
+        return _trace_count
 
 
 def _value_dtype() -> jnp.dtype:
@@ -445,6 +467,7 @@ def make_kernel(plan: DevicePlan):
     """
 
     def kernel(cols, params, num_docs, D, G=0):
+        note_trace()  # body runs at trace time: counts compiles
         valid = jnp.arange(D, dtype=jnp.int32)[None, :] < num_docs[:, None]
         slots, matched = _compute_slots(plan, cols, params, valid, G)
         if plan.num_groups or G:
@@ -475,6 +498,7 @@ def make_topn_kernel(plan: DevicePlan):
     """
 
     def kernel(cols, params, num_docs, D):
+        note_trace()  # body runs at trace time: counts compiles
         valid = jnp.arange(D, dtype=jnp.int32)[None, :] < num_docs[:, None]
         if plan.filter_ir is not None:
             mask = _eval_filter(plan.filter_ir, plan, cols, params) & valid
@@ -561,6 +585,7 @@ def make_sharded_kernel(plan: DevicePlan, mesh):
     doc_shards = dict(zip(mesh.axis_names, mesh.devices.shape)).get("docs", 1)
 
     def local(cols, params, num_docs, D, G=0):
+        note_trace()  # body runs at trace time: counts compiles
         d_local = D // doc_shards
         doc_pos = (jax.lax.axis_index("docs") * d_local
                    + jnp.arange(d_local, dtype=jnp.int32))[None, :]

@@ -106,42 +106,71 @@ class QueryExecutor:
         dc = set(id(s) for s in device_candidates)
         host_only = [s for s in to_run if id(s) not in dc]
         remaining = device_candidates
+        device_fut = None
+        device_results_now = None
         if self._use_tpu and device_candidates:
             if self._cancel_check is not None:
                 self._cancel_check()
             engine = self.tpu_engine
             if engine is not None and engine.supports(ctx):
-                device_results, remaining = engine.execute(device_candidates, ctx)
-                results.extend(device_results)
-                # engine results are positional per candidate when nothing
-                # fell back; only then is the segment<->result mapping
-                # known for cache population
-                if plan_fp is not None and not remaining \
-                        and len(device_results) == len(device_candidates):
-                    for s, r in zip(device_candidates, device_results):
-                        cache.put(s, plan_fp, r)
-        remaining = list(remaining) + host_only
-        if remaining:
-            def run_one(s):
-                # cooperative cancel poll per segment: a deadline-expired
-                # or broker-cancelled query stops HERE instead of
-                # finishing work nobody will read (the failpoint site
-                # lets chaos tests make each segment arbitrarily slow)
-                if self._cancel_check is not None:
-                    self._cancel_check()
-                fire("server.execute.segment",
-                     segment=getattr(s, "name", None))
-                r = executor_cpu.execute_segment(s, ctx)
-                if plan_fp is not None:
-                    cache.put(s, plan_fp, r)  # no-op for mutable segments
-                return r
+                if host_only:
+                    # staging + launch ride the engine's dispatch
+                    # pipeline; the future resolves off-thread, so this
+                    # server thread executes its host-path segments IN
+                    # PARALLEL with the device round trip instead of
+                    # after it
+                    device_fut = engine.execute_async(
+                        device_candidates, ctx,
+                        cancel_check=self._cancel_check)
+                else:
+                    # nothing to overlap within this query: skip the
+                    # async hop (lone-query p50 stays at the floor);
+                    # cross-query overlap still happens in the ring
+                    device_results_now, remaining = engine.execute(
+                        device_candidates, ctx,
+                        cancel_check=self._cancel_check)
+                if device_fut is not None:
+                    remaining = []
 
-            if len(remaining) == 1:
-                results.append(run_one(remaining[0]))
-            else:
-                with ThreadPoolExecutor(
-                        max_workers=min(len(remaining), self.max_threads)) as pool:
-                    results.extend(pool.map(run_one, remaining))
+        def run_one(s):
+            # cooperative cancel poll per segment: a deadline-expired
+            # or broker-cancelled query stops HERE instead of
+            # finishing work nobody will read (the failpoint site
+            # lets chaos tests make each segment arbitrarily slow)
+            if self._cancel_check is not None:
+                self._cancel_check()
+            fire("server.execute.segment",
+                 segment=getattr(s, "name", None))
+            r = executor_cpu.execute_segment(s, ctx)
+            if plan_fp is not None:
+                cache.put(s, plan_fp, r)  # no-op for mutable segments
+            return r
+
+        def run_host(seg_list):
+            if not seg_list:
+                return []
+            if len(seg_list) == 1:
+                return [run_one(seg_list[0])]
+            with ThreadPoolExecutor(
+                    max_workers=min(len(seg_list), self.max_threads)) as pool:
+                return list(pool.map(run_one, seg_list))
+
+        # host-only segments overlap the in-flight device future
+        host_results = run_host(host_only)
+        if device_fut is not None:
+            device_results_now, remaining = device_fut.result()
+        if device_results_now is not None:
+            results.extend(device_results_now)
+            # engine results are positional per candidate when nothing
+            # fell back; only then is the segment<->result mapping
+            # known for cache population
+            if plan_fp is not None and not remaining \
+                    and len(device_results_now) == len(device_candidates):
+                for s, r in zip(device_candidates, device_results_now):
+                    cache.put(s, plan_fp, r)
+        results.extend(host_results)
+        # device fallbacks (shapes/columns the engine rejected) run last
+        results.extend(run_host(list(remaining)))
         return results, prune_stats
 
     def execute(self, sql: str) -> BrokerResponse:

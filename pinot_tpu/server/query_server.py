@@ -178,7 +178,13 @@ class ServerQueryExecutor:
         with self._engine_lock:
             if self._engine is None:
                 from pinot_tpu.ops.engine import TpuOperatorExecutor
-                self._engine = TpuOperatorExecutor(config=self.config)
+                # instance labels thread through to the dispatch-ring
+                # metrics (dispatch_queue_depth / dispatch_batch_size /
+                # kernel_retrace / staging_overlap_ms)
+                self._engine = TpuOperatorExecutor(
+                    config=self.config,
+                    metrics_labels={
+                        "instance": self.data_manager.instance_id})
             return self._engine
 
     def cancel(self, query_id) -> bool:
@@ -308,12 +314,15 @@ class QueryServer:
                  port: int = 0, num_threads: int = 8,
                  scheduler: str = "fcfs"):
         from pinot_tpu.server.scheduler import make_scheduler
+        from pinot_tpu.utils.metrics import get_registry
         self.executor = executor
         self.host = host
         self.port = port
         #: pluggable query scheduler (ref QuerySchedulerFactory.java:45 —
         #: fcfs | priority | binary); owns the query worker threads
-        self.scheduler = make_scheduler(scheduler, num_threads)
+        self.scheduler = make_scheduler(
+            scheduler, num_threads, metrics=get_registry("server"),
+            labels={"instance": executor.data_manager.instance_id})
         self.scheduler.start()
         self._server: Optional[asyncio.AbstractServer] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None

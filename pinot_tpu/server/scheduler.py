@@ -29,6 +29,38 @@ class QueryScheduler:
     — the future completes with BrokerTimeoutError instead (ref
     QueryScheduler.java's timeout handling around the query runners)."""
 
+    #: optional metrics hookup (attach_metrics): scheduler_inflight gauge
+    #: tracks submitted-but-unfinished queries — with the dispatch ring
+    #: downstream, queue wait HERE vs wait IN THE RING separates "server
+    #: saturated" from "device saturated" when diagnosing tail latency
+    _metrics = None
+    _labels = None
+
+    def attach_metrics(self, metrics, labels=None) -> "QueryScheduler":
+        self._metrics = metrics
+        self._labels = labels
+        self._inflight = 0
+        self._mlock = threading.Lock()
+        return self
+
+    def _track(self, fut: Future) -> Future:
+        m = self._metrics
+        if m is None:
+            return fut
+        with self._mlock:
+            self._inflight += 1
+            m.set_gauge("scheduler_inflight", self._inflight,
+                        labels=self._labels)
+
+        def done(_f):
+            with self._mlock:
+                self._inflight -= 1
+                m.set_gauge("scheduler_inflight", self._inflight,
+                            labels=self._labels)
+
+        fut.add_done_callback(done)
+        return fut
+
     def submit(self, fn: Callable[[], bytes], table: str = "",
                workload: str = "primary",
                deadline: Optional[float] = None) -> Future:
@@ -67,7 +99,7 @@ class FCFSQueryScheduler(QueryScheduler):
 
     def submit(self, fn, table: str = "", workload: str = "primary",
                deadline: Optional[float] = None) -> Future:
-        return self._pool.submit(self._guard(fn, deadline))
+        return self._track(self._pool.submit(self._guard(fn, deadline)))
 
     def stop(self) -> None:
         self._pool.shutdown(wait=False)
@@ -119,7 +151,7 @@ class TokenPriorityScheduler(QueryScheduler):
                 g = self._groups[table] = _Group(self.tokens_per_interval)
             g.pending.append((fut, self._guard(fn, deadline)))
             self._lock.notify()
-        return fut
+        return self._track(fut)
 
     # ------------------------------------------------------------------
     def _refill_locked(self, now: float) -> None:
@@ -186,7 +218,7 @@ class BinaryWorkloadScheduler(QueryScheduler):
     def submit(self, fn, table: str = "", workload: str = "primary",
                deadline: Optional[float] = None) -> Future:
         pool = self._primary if workload != "secondary" else self._secondary
-        return pool.submit(self._guard(fn, deadline))
+        return self._track(pool.submit(self._guard(fn, deadline)))
 
     def stop(self) -> None:
         self._primary.shutdown(wait=False)
@@ -194,13 +226,17 @@ class BinaryWorkloadScheduler(QueryScheduler):
 
 
 def make_scheduler(name: str = "fcfs", num_threads: int = 8,
-                   **kwargs) -> QueryScheduler:
+                   metrics=None, labels=None, **kwargs) -> QueryScheduler:
     """Ref QuerySchedulerFactory.create (QuerySchedulerFactory.java:45)."""
     name = (name or "fcfs").lower()
     if name == "fcfs":
-        return FCFSQueryScheduler(num_threads)
-    if name in ("priority", "token"):
-        return TokenPriorityScheduler(num_threads, **kwargs)
-    if name in ("binary", "binary_workload", "binaryworkload"):
-        return BinaryWorkloadScheduler(num_threads, **kwargs)
-    raise ValueError(f"unknown scheduler {name!r}")
+        sched: QueryScheduler = FCFSQueryScheduler(num_threads)
+    elif name in ("priority", "token"):
+        sched = TokenPriorityScheduler(num_threads, **kwargs)
+    elif name in ("binary", "binary_workload", "binaryworkload"):
+        sched = BinaryWorkloadScheduler(num_threads, **kwargs)
+    else:
+        raise ValueError(f"unknown scheduler {name!r}")
+    if metrics is not None:
+        sched.attach_metrics(metrics, labels)
+    return sched

@@ -25,6 +25,17 @@ KEYS: Dict[str, Any] = {
     "pinot.server.query.num.threads": 8,
     "pinot.server.query.scheduler": "fcfs",     # fcfs | priority | binary
     "pinot.server.stream.chunk.segments": 4,
+    # concurrent-query dispatch pipeline (ops/dispatch.py):
+    # mode 'pipelined' = dispatch ring + shared-plan micro-batching +
+    # staging/compute overlap; 'serialized' reproduces the pre-ring
+    # inline dispatch (A/B baseline + escape hatch)
+    "pinot.server.dispatch.mode": "pipelined",
+    "pinot.server.dispatch.ring.size": 64,      # bounded launch queue
+    # micro-batch coalescing: fingerprint-equal concurrent queries merge
+    # into one launch within this window (only waited when >1 caller is
+    # active), capped at batch.max per launch
+    "pinot.server.dispatch.batch.window.ms": 2.0,
+    "pinot.server.dispatch.batch.max": 16,
     "pinot.server.hbm.cache.bytes": 8 << 30,
     "pinot.server.host.row.cache.bytes": 16 << 30,
     "pinot.server.segment.cache.enabled": True,   # tier-2 partial cache
@@ -55,10 +66,11 @@ KEYS: Dict[str, Any] = {
     # times, and cancels still-pending server work on expiry.
     "pinot.broker.timeout.ms": 60000,
     # hedged scatter (speculative retry, "The Tail at Scale"): after an
-    # adaptive delay — p95 over the selector's per-server latency EWMAs,
-    # clamped to [delay.min, delay.max] — re-issue still-pending plan
-    # entries on a different healthy replica and keep the first clean
-    # response. Off by default: it doubles worst-case fan-out.
+    # adaptive delay — p95 over the selector's pooled per-server latency
+    # reservoirs (true per-request tails), clamped to [delay.min,
+    # delay.max] — re-issue still-pending plan entries on a different
+    # healthy replica and keep the first clean response. Off by default:
+    # it doubles worst-case fan-out.
     "pinot.broker.hedge.enabled": False,
     "pinot.broker.hedge.delay.min.ms": 25,
     "pinot.broker.hedge.delay.max.ms": 1000,
@@ -85,6 +97,10 @@ KEYS: Dict[str, Any] = {
     "pinot.cache.server.port": 9600,
     "pinot.cache.server.bytes": 512 << 20,
     "pinot.cache.server.ttl.seconds": 300.0,
+    # remote-tier payload compression: payloads at/above this size are
+    # wrapped with a segment/codec.py codec before the wire (and decoded
+    # transparently on GET); <= 0 disables
+    "pinot.cache.server.compress.threshold.bytes": 16384,
     # shared remote-client knobs (both tiers' L2 mounts)
     "pinot.cache.remote.timeout.seconds": 2.0,
     "pinot.cache.remote.pool.size": 2,

@@ -62,6 +62,14 @@ class Timer:
         idx = min(len(s) - 1, max(0, math.ceil(q * len(s)) - 1))
         return s[idx]
 
+    @property
+    def samples(self) -> Tuple[float, ...]:
+        """Snapshot of the reservoir's per-observation samples (ms) —
+        consumers pooling tails across several timers (e.g. the broker's
+        adaptive hedge delay over per-server reservoirs) read the raw
+        samples instead of mixing already-collapsed quantiles."""
+        return tuple(self._reservoir)
+
 
 class MetricsRegistry:
     """Ref PinotMetricsRegistry — meters (counters), gauges, timers."""

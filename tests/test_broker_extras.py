@@ -214,3 +214,42 @@ class TestPinotConfiguration:
                 make_scheduler("priority", 2))
         finally:
             srv.scheduler.stop()
+
+
+class TestAdaptiveHedgeTail:
+    """latency_quantile feeds the hedge delay from TRUE per-request tails
+    (pooled per-server Timer reservoirs), not p95-of-EWMA smoothed means
+    (ISSUE 4 satellite / ROADMAP reliability follow-up)."""
+
+    def test_quantile_sees_tail_requests_ewma_hides(self):
+        sel = AdaptiveServerSelector(alpha=0.3)
+        # 99 fast requests + 1 huge spike on one server: an EWMA ending
+        # on fast traffic forgets the spike entirely
+        for i in range(99):
+            sel.record_start("s1")
+            sel.record_end("s1", 0.010)
+        sel.record_start("s1")
+        sel.record_end("s1", 2.0)
+        for _ in range(20):
+            sel.record_start("s1")
+            sel.record_end("s1", 0.010)
+        # the smoothed mean is far below the spike...
+        assert sel._ewma["s1"] < 0.1
+        # ...but the per-request p99+ still carries it
+        assert sel.latency_quantile(0.999) == pytest.approx(2.0)
+        # and the p50 stays at the fast floor (hedges don't fire early)
+        assert sel.latency_quantile(0.5) == pytest.approx(0.010)
+
+    def test_quantile_pools_across_servers(self):
+        sel = AdaptiveServerSelector()
+        for _ in range(10):
+            sel.record_start("fast")
+            sel.record_end("fast", 0.01)
+            sel.record_start("slow")
+            sel.record_end("slow", 0.2)
+        q95 = sel.latency_quantile(0.95)
+        assert q95 == pytest.approx(0.2)
+        assert sel.latency_quantile(0.0) <= 0.01 + 1e-9
+
+    def test_zero_until_observed(self):
+        assert AdaptiveServerSelector().latency_quantile(0.95) == 0.0

@@ -831,3 +831,72 @@ class TestHybridOfflinePartial:
             assert c.query(sql).exceptions     # nothing was cached
         finally:
             c.stop()
+
+
+# ---------------------------------------------------------------------------
+class TestRemoteCompression:
+    """Remote-tier payload compression (ISSUE 4 satellite): payloads at/
+    above the threshold ride the wire codec-wrapped, decode transparently
+    on GET, and corrupt entries degrade to miss — never an exception."""
+
+    def test_transparent_roundtrip_and_smaller_wire_bytes(self, cache_server):
+        from pinot_tpu.utils.metrics import MetricsRegistry
+        m = MetricsRegistry("compress_test")
+        be = RemoteCacheBackend(cache_server.address, metrics=m,
+                                compress_threshold=1024)
+        try:
+            payload = b"PDT1" + b"abcdefgh" * 4096  # compressible, 32KB+
+            assert be.put("big", payload)
+            assert be.get("big") == payload
+            stored = cache_server.cache.size_bytes
+            assert 0 < stored < len(payload) // 2
+            meter = m.meter("remote_cache_compressed_bytes")
+            assert 0 < meter < len(payload)
+            # below-threshold payloads ship raw
+            small = b"PDT1" + b"x" * 100
+            assert be.put("small", small)
+            assert be.get("small") == small
+            assert cache_server.cache.size_bytes == stored + len(small)
+        finally:
+            be.close()
+
+    def test_incompressible_payload_ships_raw(self, cache_server):
+        import os as _os
+        be = RemoteCacheBackend(cache_server.address, compress_threshold=64)
+        try:
+            noise = _os.urandom(4096)  # wrapper would only grow it
+            assert be.put("noise", noise)
+            assert be.get("noise") == noise
+            assert cache_server.cache.size_bytes == len(noise)
+        finally:
+            be.close()
+
+    def test_torn_compressed_entry_degrades_to_miss(self, cache_server):
+        be = RemoteCacheBackend(cache_server.address, compress_threshold=64)
+        raw = RemoteCacheBackend(cache_server.address)  # no compression
+        try:
+            assert be.put("k", b"PDT1" + b"data" * 1024)
+            # corrupt the stored entry in place: keep the wrapper magic,
+            # truncate the codec body
+            stored = cache_server.cache.get("k")
+            assert stored[:4] == b"PZC1"
+            cache_server.cache.put("k", stored[: len(stored) // 2])
+            assert be.get("k") is None          # miss, not an exception
+            # uncompressed entries are untouched by the unwrap path
+            assert raw.put("plain", b"PDT1plain")
+            assert be.get("plain") == b"PDT1plain"
+        finally:
+            be.close()
+            raw.close()
+
+    def test_config_wires_threshold_into_tiered_backend(self):
+        from pinot_tpu.cache.tiered import tiered_backend_from_config
+        from pinot_tpu.utils.config import PinotConfiguration
+        cfg = PinotConfiguration(overrides={
+            "pinot.cache.server.compress.threshold.bytes": 2048})
+        t = tiered_backend_from_config(
+            cfg, "pinot.server.segment.cache", "seg", lambda k: None)
+        try:
+            assert t.l2.compress_threshold == 2048
+        finally:
+            t.close()

@@ -1,0 +1,309 @@
+"""Concurrent-query dispatch pipeline (ops/dispatch.py).
+
+Pins the tentpole properties deterministically:
+  * shared-plan micro-batching — fingerprint-equal concurrent queries
+    coalesce into ONE vmapped launch and split back per caller,
+    BIT-IDENTICAL to per-query execution (property-tested over random
+    literal sets)
+  * cancel/deadline discipline — a cancelled query leaves its batch
+    before launch; a deadline that expires while queued surfaces as
+    BrokerTimeoutError without executing
+  * retrace guard — steady-state traffic over warmed (plan, batch-size
+    bucket) shapes compiles NOTHING new (kernels.trace_count is the
+    compile odometer; a regression here re-compiles the hot path per
+    query and tanks serving latency)
+  * seeded chaos — the server.dispatch.before failpoint replays exactly
+
+Determinism trick: a one-shot delay failpoint on server.dispatch.before
+holds the ring on the FIRST pop while the remaining threads enqueue, so
+the batch composition is exact rather than a scheduling race.
+"""
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from pinot_tpu.models import (DataType, FieldSpec, FieldType, Schema,
+                              TableConfig, TableType)
+from pinot_tpu.ops import kernels
+from pinot_tpu.ops.engine import TpuOperatorExecutor
+from pinot_tpu.query.context import QueryContext
+from pinot_tpu.segment.creator import SegmentCreator
+from pinot_tpu.segment.loader import load_segment
+from pinot_tpu.utils.accounting import (BrokerTimeoutError,
+                                        QueryCancelledError,
+                                        ResourceAccountant)
+from pinot_tpu.utils.config import PinotConfiguration
+from pinot_tpu.utils.failpoints import FailpointError, failpoints
+
+HOLD_S = 0.25  # ring-hold long enough for peers to stage + enqueue
+
+
+@pytest.fixture()
+def segs(tmp_path):
+    schema = Schema("t", [
+        FieldSpec("d", DataType.INT, FieldType.DIMENSION),
+        FieldSpec("m", DataType.INT, FieldType.METRIC)])
+    tc = TableConfig("t", TableType.OFFLINE)
+    tc.indexing.no_dictionary_columns = ["m"]
+    creator = SegmentCreator(tc, schema)
+    rng = np.random.default_rng(11)
+    out = []
+    for i in range(3):
+        cols = {"d": rng.integers(0, 10, 4000).astype(np.int32),
+                "m": rng.integers(0, 100, 4000).astype(np.int32)}
+        p = str(tmp_path / f"s{i}")
+        creator.build(cols, p, f"t_{i}")
+        out.append(load_segment(p))
+    return out
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoints.clear()
+    yield
+    failpoints.clear()
+
+
+def make_engine(**overrides):
+    return TpuOperatorExecutor(config=PinotConfiguration(overrides=overrides))
+
+
+def agg_values(results):
+    """Comparable value tuple per segment result (exact: int sums/counts
+    stay integral in f64, so equality is bit-meaningful)."""
+    out = []
+    for r in results:
+        if hasattr(r, "groups"):
+            out.append(tuple(sorted(
+                (k, tuple(float(v) for v in inters))
+                for k, inters in r.groups.items())))
+        else:
+            out.append(tuple(float(v) for v in r.intermediates))
+    return tuple(out)
+
+
+def run_concurrent(eng, segs, ctxs, hold=HOLD_S):
+    """Run ctxs concurrently with the ring held on the first pop, so all
+    of them are enqueued before coalescing — deterministic batching.
+    times=2: the first delay may be consumed by a racing thread's
+    lone-query fast path (inline dispatch); the second then holds the
+    ring leader while the rest enqueue."""
+    failpoints.arm("server.dispatch.before", delay=hold, times=2)
+    try:
+        with ThreadPoolExecutor(len(ctxs)) as pool:
+            futs = [pool.submit(eng.execute, segs, c) for c in ctxs]
+            return [f.result() for f in futs]
+    finally:
+        failpoints.disarm("server.dispatch.before")
+
+
+class TestMicroBatching:
+    def test_coalesce_and_split_matches_per_query(self, segs):
+        eng = make_engine()
+        ctxs = [QueryContext.from_sql(
+            f"SELECT SUM(m), COUNT(*), MIN(m) FROM t WHERE d < {k}")
+            for k in range(1, 7)]
+        singles = [agg_values(eng.execute(segs, c)[0]) for c in ctxs]
+        reg = eng._dispatcher._metrics
+        max0 = reg.timer("dispatch_batch_size").max_ms
+        got = run_concurrent(eng, segs, ctxs)
+        assert all(not rem for _r, rem in got)
+        assert [agg_values(r) for r, _rem in got] == singles
+        # batching actually happened (not six serialized singles)
+        assert reg.timer("dispatch_batch_size").max_ms >= max(max0, 2)
+
+    def test_group_by_batched_matches_per_query(self, segs):
+        eng = make_engine()
+        ctxs = [QueryContext.from_sql(
+            f"SELECT d, SUM(m) FROM t WHERE m BETWEEN {a} AND {a + 40} "
+            f"GROUP BY d") for a in (0, 10, 20, 30)]
+        singles = [agg_values(eng.execute(segs, c)[0]) for c in ctxs]
+        got = run_concurrent(eng, segs, ctxs)
+        assert [agg_values(r) for r, _rem in got] == singles
+
+    def test_bit_identical_property_over_random_literal_sets(self, segs):
+        """Property: for ANY plan-fingerprint-equal query set, batched
+        execution is bit-identical to per-query execution."""
+        eng = make_engine()
+        rng = np.random.default_rng(23)
+        for _trial in range(4):
+            k = int(rng.integers(2, 9))
+            bounds = rng.integers(0, 100, size=(k, 2))
+            ctxs = [QueryContext.from_sql(
+                "SELECT SUM(m), COUNT(*), MAX(m) FROM t "
+                f"WHERE m BETWEEN {min(a, b)} AND {max(a, b)} AND d < 8")
+                for a, b in bounds]
+            singles = [agg_values(eng.execute(segs, c)[0]) for c in ctxs]
+            got = run_concurrent(eng, segs, ctxs)
+            assert [agg_values(r) for r, _rem in got] == singles
+
+    def test_serialized_mode_matches_pipelined(self, segs):
+        """The A/B baseline mode (pre-ring inline dispatch) must stay
+        result-identical — it's both the bench baseline and the escape
+        hatch."""
+        pipe = make_engine()
+        ser = make_engine(**{"pinot.server.dispatch.mode": "serialized"})
+        for sql in ("SELECT SUM(m), COUNT(*) FROM t WHERE d < 5",
+                    "SELECT d, COUNT(*) FROM t GROUP BY d"):
+            ctx = QueryContext.from_sql(sql)
+            a, _ = pipe.execute(segs, ctx)
+            b, _ = ser.execute(segs, ctx)
+            assert agg_values(a) == agg_values(b)
+
+
+class TestCancelAndDeadline:
+    def test_cancelled_query_leaves_batch_before_launch(self, segs):
+        eng = make_engine()
+        ctxs = [QueryContext.from_sql(
+            f"SELECT SUM(m), COUNT(*) FROM t WHERE d < {k}")
+            for k in range(1, 5)]
+        singles = [agg_values(eng.execute(segs, c)[0]) for c in ctxs]
+
+        def cancelled():
+            raise QueryCancelledError("cancelled by test")
+
+        failpoints.arm("server.dispatch.before", delay=HOLD_S, times=2)
+        try:
+            with ThreadPoolExecutor(4) as pool:
+                futs = [pool.submit(eng.execute, segs, c,
+                                    cancelled if i == 1 else None)
+                        for i, c in enumerate(ctxs)]
+                with pytest.raises(QueryCancelledError):
+                    futs[1].result()
+                # survivors split correctly without the cancelled member
+                for i in (0, 2, 3):
+                    res, rem = futs[i].result()
+                    assert not rem
+                    assert agg_values(res) == singles[i]
+        finally:
+            failpoints.disarm("server.dispatch.before")
+
+    def test_deadline_honored_while_queued(self, segs):
+        eng = make_engine()
+        ctx = QueryContext.from_sql("SELECT SUM(m) FROM t WHERE d < 5")
+        eng.execute(segs, ctx)  # warm (staging off the timed path)
+        acc = ResourceAccountant()
+        acc.begin_query("q-dl", timeout_s=0.02)
+        # hold the ring so the query sits QUEUED past its whole budget
+        failpoints.arm("server.dispatch.before", delay=0.2, times=1)
+        try:
+            with ThreadPoolExecutor(2) as pool:
+                blocker = pool.submit(eng.execute, segs, ctx)
+                time.sleep(0.05)  # ring now busy; budget now expired
+                with pytest.raises(BrokerTimeoutError):
+                    eng.execute(segs, ctx, acc.checker("q-dl"))
+                blocker.result()
+        finally:
+            failpoints.disarm("server.dispatch.before")
+            acc.finish_query("q-dl")
+
+
+class TestRetraceGuard:
+    def test_steady_state_zero_retrace(self, segs):
+        """CI guard: warmed (plan, shape, batch-size bucket) traffic must
+        not compile ANYTHING — a compile-cache miss here re-traces the
+        hot path per query in production."""
+        eng = make_engine()
+
+        def round_of(base):
+            ctxs = [QueryContext.from_sql(
+                f"SELECT SUM(m), COUNT(*) FROM t WHERE d < {base + k}")
+                for k in range(8)]
+            got = run_concurrent(eng, segs, ctxs)
+            assert all(not rem for _r, rem in got)
+
+        ctx0 = QueryContext.from_sql("SELECT SUM(m), COUNT(*) FROM t "
+                                     "WHERE d < 1")
+        eng.execute(segs, ctx0)      # warm the single-kernel shape
+        round_of(0)                  # warm the bucket-8 batched shape
+        before = kernels.trace_count()
+        meter0 = eng._dispatcher._metrics.meter("kernel_retrace")
+        round_of(1)                  # same shapes, fresh literals
+        round_of(2)
+        eng.execute(segs, ctx0)
+        assert kernels.trace_count() == before, \
+            "steady-state traffic re-compiled a kernel"
+        assert eng._dispatcher._metrics.meter("kernel_retrace") == meter0
+
+
+class TestDispatchChaos:
+    def test_seeded_chaos_replays_exactly(self, segs):
+        eng = make_engine()
+        ctx = QueryContext.from_sql("SELECT SUM(m), COUNT(*) FROM t "
+                                    "WHERE d < 4")
+        eng.execute(segs, ctx)  # warm: compiles happen outside the chaos
+
+        def run_round():
+            fp = failpoints.arm("server.dispatch.before",
+                                error=FailpointError("dispatch chaos"),
+                                probability=0.5, seed=1234)
+            outcomes = []
+            try:
+                for _ in range(10):
+                    try:
+                        res, rem = eng.execute(segs, ctx)
+                        assert not rem
+                        outcomes.append("ok")
+                    except FailpointError:
+                        outcomes.append("chaos")
+            finally:
+                failpoints.disarm("server.dispatch.before")
+            return outcomes, list(fp.decisions)
+
+        o1, d1 = run_round()
+        o2, d2 = run_round()
+        assert o1 == o2 and d1 == d2  # same seed -> exact replay
+        assert "chaos" in o1 and "ok" in o1  # both paths exercised
+
+    def test_dispatch_error_fails_only_that_query(self, segs):
+        eng = make_engine()
+        ctx = QueryContext.from_sql("SELECT COUNT(*) FROM t WHERE d < 3")
+        eng.execute(segs, ctx)
+        failpoints.arm("server.dispatch.before",
+                       error=FailpointError("one-shot"), times=1)
+        try:
+            with pytest.raises(FailpointError):
+                eng.execute(segs, ctx)
+        finally:
+            failpoints.disarm("server.dispatch.before")
+        res, rem = eng.execute(segs, ctx)  # ring fully recovered
+        assert not rem and res
+
+
+class TestPipelineMetrics:
+    def test_dispatch_metrics_populated(self, segs):
+        eng = make_engine()
+        reg = eng._dispatcher._metrics
+        c0 = reg.timer("dispatch_batch_size").count
+        ctxs = [QueryContext.from_sql(
+            f"SELECT SUM(m), COUNT(*) FROM t WHERE d < {k}")
+            for k in range(1, 5)]
+        for c in ctxs:
+            eng.execute(segs, c)
+        run_concurrent(eng, segs, ctxs)
+        t = reg.timer("dispatch_batch_size")
+        assert t.count > c0
+        assert t.max_ms >= 2  # a real batch formed
+        assert reg.gauge("dispatch_queue_depth") is not None
+        assert reg.meter("kernel_retrace") > 0  # compiles were metered
+
+    def test_execute_async_overlaps_caller(self, segs):
+        """execute_async returns before the device result lands, so the
+        caller can run host-path work in parallel."""
+        eng = make_engine()
+        ctx = QueryContext.from_sql("SELECT SUM(m), COUNT(*) FROM t "
+                                    "WHERE d < 6")
+        want = agg_values(eng.execute(segs, ctx)[0])
+        failpoints.arm("server.dispatch.before", delay=0.2, times=1)
+        try:
+            t0 = time.perf_counter()
+            fut = eng.execute_async(segs, ctx)
+            submitted_in = time.perf_counter() - t0
+            res, rem = fut.result(timeout=10)
+        finally:
+            failpoints.disarm("server.dispatch.before")
+        assert submitted_in < 0.15, "execute_async blocked the caller"
+        assert not rem and agg_values(res) == want

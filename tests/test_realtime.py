@@ -150,3 +150,34 @@ class TestIngestionDelay:
         t.record(0, now_ms - 5000)
         assert t.delay_ms(0) == pytest.approx(5000, abs=2000)
         assert t.delay_ms(1) is None
+
+
+@pytest.mark.chaos
+class TestIngestChaos:
+    """ingest.realtime.consume failpoint: the consumer loop must absorb a
+    failing upstream (back off, resume, lose nothing)."""
+
+    def test_consumer_survives_fetch_chaos(self, tmp_path):
+        from pinot_tpu.utils.failpoints import FailpointError, failpoints
+        topic = InMemoryStream("rt_chaos", num_partitions=1)
+        failpoints.arm("ingest.realtime.consume",
+                       error=FailpointError("upstream down"), times=2)
+        try:
+            tdm = TableDataManager("rt_REALTIME")
+            sc = StreamConfig(stream_type="inmemory", topic="rt_chaos",
+                              flush_threshold_rows=1000)
+            for i in range(50):
+                topic.publish({"id": i, "name": "a", "score": 1.0})
+            mgr = RealtimeSegmentDataManager(
+                make_config(), make_schema(), sc, 0, tdm, str(tmp_path))
+            mgr.start()
+            deadline = time.time() + 20
+            while time.time() < deadline and mgr.mutable.num_docs < 50:
+                time.sleep(0.05)
+            mgr.stop()
+            # both chaos hits consumed by backoff, zero rows lost
+            assert mgr.mutable.num_docs == 50
+            assert failpoints.count("ingest.realtime.consume") == 2
+        finally:
+            failpoints.disarm("ingest.realtime.consume")
+            InMemoryStream.delete("rt_chaos")

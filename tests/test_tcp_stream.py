@@ -123,3 +123,52 @@ class TestRealtimeOverTcp:
             time.sleep(0.05)
         mgr2.stop()
         assert total == want
+
+
+@pytest.mark.chaos
+class TestTcpStreamChaos:
+    """ingest.tcp.frame failpoint: the wire edge of the consumer SPI."""
+
+    def test_fetch_failpoint_error_surfaces(self, stream_server):
+        from pinot_tpu.utils.failpoints import FailpointError, failpoints
+        prod = StreamProducer(stream_server.address)
+        prod.create_topic("tchaos")
+        for i in range(5):
+            prod.publish("tchaos", {"i": i})
+        consumer = TcpStreamConsumerFactory().create_partition_consumer(
+            _config(stream_server, "tchaos"), 0)
+        failpoints.arm("ingest.tcp.frame",
+                       error=FailpointError("wire chaos"), times=1)
+        try:
+            with pytest.raises(FailpointError):
+                consumer.fetch_messages(LongMsgOffset(0), 1000)
+            # one-shot: the next fetch succeeds (backoff-and-retry works)
+            batch = consumer.fetch_messages(LongMsgOffset(0), 1000)
+            assert [m.value["i"] for m in batch.messages] == list(range(5))
+        finally:
+            failpoints.disarm("ingest.tcp.frame")
+            consumer.close()
+            prod.close()
+
+    def test_where_filter_scopes_to_partition(self, stream_server):
+        from pinot_tpu.utils.failpoints import FailpointError, failpoints
+        prod = StreamProducer(stream_server.address)
+        prod.create_topic("tchaos2", partitions=2)
+        for i in range(4):
+            prod.publish("tchaos2", {"i": i}, partition=i % 2)
+        factory = TcpStreamConsumerFactory()
+        cfg = _config(stream_server, "tchaos2")
+        c0 = factory.create_partition_consumer(cfg, 0)
+        c1 = factory.create_partition_consumer(cfg, 1)
+        failpoints.arm("ingest.tcp.frame",
+                       error=FailpointError("partition 1 only"),
+                       where={"partition": 1})
+        try:
+            assert len(c0.fetch_messages(LongMsgOffset(0), 1000).messages) == 2
+            with pytest.raises(FailpointError):
+                c1.fetch_messages(LongMsgOffset(0), 1000)
+        finally:
+            failpoints.disarm("ingest.tcp.frame")
+            c0.close()
+            c1.close()
+            prod.close()
