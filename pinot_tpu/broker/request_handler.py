@@ -131,6 +131,14 @@ class BrokerRequestHandler:
             self._request_id += 1
             return self._request_id
 
+    def on_segments_replaced(self, table: str) -> None:
+        """Cache-coherence hook for a segment swap (minion merge-rollup /
+        purge commit): the routing epoch already moved, making result-
+        cache entries unaddressable; negative entries for the table are
+        additionally DROPPED — a "prunes to zero" memo recorded against
+        the old segment set must not linger in budget either."""
+        self._negative_cache.drop_table(table)
+
     def _hybrid_offline_enabled(self) -> bool:
         """Hybrid offline-partial caching rides the result cache; the
         knob exists to switch the behavior off independently."""
@@ -146,11 +154,8 @@ class BrokerRequestHandler:
         are rejected, not queued)."""
         if self.quota_manager is None:
             return True
-        base = table
-        for suffix in ("_OFFLINE", "_REALTIME"):
-            if base.endswith(suffix):
-                base = base[: -len(suffix)]
-        return self.quota_manager.try_acquire(base)
+        from pinot_tpu.models import base_table_name
+        return self.quota_manager.try_acquire(base_table_name(table))
 
     def _timeout_ms(self, ctx: QueryContext) -> float:
         """End-to-end budget for one query, highest precedence first:

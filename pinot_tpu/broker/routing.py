@@ -423,10 +423,8 @@ class BrokerRoutingManager:
                 self._suffix_views.pop(logical_table + suffix, None)
 
     def get_route(self, table: str) -> Optional[RoutingTable]:
-        base = table
-        for suffix in ("_OFFLINE", "_REALTIME"):
-            if base.endswith(suffix):
-                base = base[: -len(suffix)]
+        from pinot_tpu.models import base_table_name
+        base = base_table_name(table)
         with self._lock:
             rt = self._tables.get(base)
             if rt is None:

@@ -92,6 +92,18 @@ class NegativeResultCache:
     def __len__(self) -> int:
         return len(self._cache)
 
+    def drop_table(self, table: str) -> int:
+        """Explicitly drop every entry for `table` (matching either the
+        logical name or its _OFFLINE/_REALTIME physical forms). Epoch
+        keying already makes post-swap entries unaddressable; a segment
+        replace (minion merge/purge) calls this anyway so stale
+        "nothing matches" memos stop occupying budget immediately
+        instead of waiting out TTL + LRU."""
+        from pinot_tpu.models import base_table_name
+        base = base_table_name(table)
+        return self._cache.invalidate(
+            lambda k: base_table_name(k[1]) == base)
+
     @property
     def stats(self):
         return self._cache.stats

@@ -8,6 +8,9 @@ assignment strategies layer on top, pinot_tpu/controller).
 """
 from __future__ import annotations
 
+import os
+import shutil
+import tempfile
 from typing import Dict, List, Optional, Sequence
 
 from pinot_tpu.broker.http_api import BrokerHttpServer
@@ -55,9 +58,25 @@ class MiniClusterServer:
 
 
 class MiniCluster:
+    #: fast-cycle task-fabric knobs for the embedded harness; any key
+    #: the caller's config explicitly sets (override or properties file)
+    #: wins over these
+    MINION_DEFAULTS = {
+        "pinot.minion.poll.seconds": 0.05,
+        "pinot.minion.heartbeat.seconds": 0.25,
+        "pinot.controller.task.lease.seconds": 2.0,
+        "pinot.controller.task.retry.backoff.seconds": 0.1,
+        "pinot.controller.task.retry.backoff.cap.seconds": 1.0,
+        "pinot.controller.task.frequency.seconds": 0.5,
+        # embedded clusters submit tasks explicitly; the generator scan
+        # stays opt-in so tests control exactly what runs
+        "pinot.controller.task.generators.enabled": False,
+    }
+
     def __init__(self, num_servers: int = 2, use_tpu: bool = False,
                  result_cache: bool = False, num_brokers: int = 1,
-                 cache_server: bool = False, config=None, chaos=None):
+                 cache_server: bool = False, config=None, chaos=None,
+                 minions: int = 0):
         """cache_server: start an in-process CacheServer (the remote L2
         role) and point every tier at it — brokers' result caches and
         servers' segment caches become `tiered` automatically, so
@@ -66,7 +85,12 @@ class MiniCluster:
         top of it. chaos: a utils.failpoints.FaultSchedule (or a plain
         [(site, policy-kwargs), ...] list) armed at start() and disarmed
         at stop() — deterministic fault injection for the whole cluster's
-        deadline / hedge / retry paths."""
+        deadline / hedge / retry paths. minions: start N MinionWorker
+        roles plus the controller-side task fabric (ClusterState +
+        TaskManager + a real CoordinationServer over TCP) and a tempdir
+        deep store — submit_task()/wait_task() drive merge-rollup /
+        purge / realtime-to-offline tasks end to end, with committed
+        swaps applied to the embedded servers, routing, and caches."""
         from pinot_tpu.utils.config import PinotConfiguration
         from pinot_tpu.utils.failpoints import FaultSchedule
         self.chaos: Optional[FaultSchedule] = None
@@ -74,6 +98,13 @@ class MiniCluster:
             self.chaos = (chaos if isinstance(chaos, FaultSchedule)
                           else FaultSchedule(list(chaos)))
         self.cache_server = None
+        self._num_minions = max(0, int(minions))
+        if self._num_minions:
+            cfg = config or PinotConfiguration()
+            # defaults only for keys the caller didn't set explicitly
+            config = cfg.with_overrides({
+                k: v for k, v in self.MINION_DEFAULTS.items()
+                if not cfg.is_set(k)})
         overrides = {}
         if cache_server:
             from pinot_tpu.cache.remote import CacheServer
@@ -104,6 +135,24 @@ class MiniCluster:
         self._routes: Dict[str, RoutingTable] = {}
         #: opt-in tier-1 broker result cache (cache/broker_cache.py)
         self._result_cache_enabled = result_cache
+        # -- minion task fabric (ISSUE 5) ------------------------------
+        self.cluster_state = None
+        self.task_manager = None
+        self.coordination = None
+        self.minions: List = []
+        self._minion_tmp: Optional[str] = None
+        if self._num_minions:
+            from pinot_tpu.controller.cluster_state import ClusterState
+            from pinot_tpu.controller.task_manager import TaskManager
+            self._minion_tmp = tempfile.mkdtemp(prefix="pinot_tpu_fabric_")
+            self.deep_store_uri = \
+                f"file://{os.path.join(self._minion_tmp, 'store')}"
+            self.cluster_state = ClusterState()
+            self.task_manager = TaskManager(
+                self.cluster_state, config=self.config,
+                journal_path=os.path.join(self._minion_tmp,
+                                          "tasks.journal"),
+                on_replace=self._apply_replacement)
 
     # ------------------------------------------------------------------
     def _make_result_cache(self):
@@ -143,8 +192,33 @@ class MiniCluster:
         if with_http:
             self.http = BrokerHttpServer(self.broker)
             self.http.start()
+        if self._num_minions:
+            # the fabric is REAL wire: a CoordinationServer over TCP and
+            # worker clients speaking netframe lease/heartbeat/commit ops
+            from pinot_tpu.controller.coordination import CoordinationServer
+            from pinot_tpu.minion.worker import MinionWorker
+            self.coordination = CoordinationServer(
+                self.cluster_state, deep_store_uri=self.deep_store_uri,
+                task_manager=self.task_manager)
+            self.coordination.start()
+            self.task_manager.start()
+            for i in range(self._num_minions):
+                w = MinionWorker(
+                    f"minion_{i}", self.coordination.address,
+                    work_dir=os.path.join(self._minion_tmp, f"minion_{i}"),
+                    config=self.config)
+                w.start()
+                self.minions.append(w)
 
     def stop(self) -> None:
+        for w in self.minions:
+            w.stop()
+        self.minions = []
+        if self.task_manager is not None:
+            self.task_manager.stop()
+        if self.coordination is not None:
+            self.coordination.stop()
+            self.coordination = None
         if self.http is not None:
             self.http.stop()
         if getattr(self, "mse", None) is not None:
@@ -160,18 +234,19 @@ class MiniCluster:
             self.cache_server.stop()
         if self.chaos is not None:
             self.chaos.disarm()
+        if self._minion_tmp is not None:
+            shutil.rmtree(self._minion_tmp, ignore_errors=True)
+            self._minion_tmp = None
 
     # -- multi-stage catalog / placement ------------------------------------
     def _catalog(self):
         """Logical table -> column names, unioned over all servers."""
+        from pinot_tpu.models import base_table_name
         cat = {}
         for s in self.servers:
             dm = s.data_manager
             for phys in dm.table_names:
-                logical = phys
-                for suffix in ("_OFFLINE", "_REALTIME"):
-                    if phys.endswith(suffix):
-                        logical = phys[: -len(suffix)]
+                logical = base_table_name(phys)
                 tdm = dm.table(phys, create=False)
                 sdms = tdm.acquire_segments(None)
                 try:
@@ -198,7 +273,11 @@ class MiniCluster:
     # ------------------------------------------------------------------
     def add_table(self, table_name: str, table_type: str = "OFFLINE",
                   time_column: Optional[str] = None,
-                  time_boundary: Optional[int] = None) -> None:
+                  time_boundary: Optional[int] = None,
+                  table_config=None, schema=None) -> None:
+        """table_config/schema: required for minion tasks over the table
+        (executors rebuild segments from the schema); mirrored into the
+        fabric's ClusterState when the cluster runs minions."""
         rt = self._routes.get(table_name)
         if rt is None:
             rt = RoutingTable()
@@ -211,6 +290,9 @@ class MiniCluster:
         if time_boundary is not None:
             rt.time_boundary = time_boundary
         self.routing.set_route(table_name, rt)
+        if self.cluster_state is not None and table_config is not None \
+                and schema is not None:
+            self.cluster_state.add_table(table_config, schema)
 
     def add_segment(self, table_name: str, segment: ImmutableSegment,
                     server_idx: int, table_type: str = "OFFLINE",
@@ -228,6 +310,16 @@ class MiniCluster:
             servers=[self.servers[i].instance_id for i in targets],
             start_time=meta.start_time, end_time=meta.end_time,
             version=meta.crc)
+        if self.cluster_state is not None:
+            # mirror into the fabric's state so generators see the
+            # segment and task executors can localize it by dir_path
+            from pinot_tpu.controller.cluster_state import SegmentState
+            self.cluster_state.upsert_segment(SegmentState(
+                name=segment.name, table=physical,
+                instances=[self.servers[i].instance_id for i in targets],
+                dir_path=segment.dir.path, num_docs=segment.num_docs,
+                start_time=meta.start_time, end_time=meta.end_time,
+                crc=meta.crc))
 
     def remove_segment(self, table_name: str, segment_name: str,
                        table_type: str = "OFFLINE") -> None:
@@ -243,7 +335,111 @@ class MiniCluster:
             rt.offline if table_type == "OFFLINE" else rt.realtime)
         if route is not None:
             route.segments.pop(segment_name, None)
+        if self.cluster_state is not None:
+            self.cluster_state.remove_segment(
+                f"{table_name}_{table_type}", segment_name)
 
     def query(self, sql: str):
         assert self.broker is not None, "cluster not started"
         return self.broker.handle(sql)
+
+    # -- minion task fabric --------------------------------------------
+    def submit_task(self, task) -> dict:
+        """Submit a TaskConfig to the fabric's queue; a minion worker
+        leases and runs it. Returns the queued entry (dict)."""
+        assert self.task_manager is not None, \
+            "MiniCluster(minions=N) required for background tasks"
+        return self.task_manager.submit(task).to_dict()
+
+    def task(self, task_id: str) -> Optional[dict]:
+        e = self.task_manager.queue.get(task_id)
+        return e.to_dict() if e is not None else None
+
+    def wait_task(self, task_id: str, timeout_s: float = 30.0) -> dict:
+        """Block until the task reaches a terminal state (COMPLETED /
+        FAILED / CANCELLED) or raise on timeout."""
+        import time as _time
+        from pinot_tpu.controller.task_manager import TERMINAL
+        deadline = _time.time() + timeout_s
+        while _time.time() < deadline:
+            e = self.task(task_id)
+            if e is not None and e["state"] in TERMINAL:
+                return e
+            _time.sleep(0.02)
+        raise TimeoutError(
+            f"task {task_id} not terminal after {timeout_s}s: "
+            f"{self.task(task_id)}")
+
+    def _apply_replacement(self, adds, removes) -> None:
+        """Push a committed segment swap into the embedded cluster: load
+        + WARM the new segments on their target servers first (warmup
+        replays logged plans before the segment is routable), then swap
+        each affected route's segment dict atomically (one reference
+        assignment — queries see the old or the new set, never half),
+        then unload retired segments and drop the brokers' negative-
+        cache entries for the table. The routing epoch moves with the
+        swap, so whole-result/partial cache entries for the old set go
+        unaddressable by construction."""
+        from pinot_tpu.broker.routing import TableRoute, _ObservedSegments
+        from pinot_tpu.models import split_physical_table_name
+        from pinot_tpu.segment.fs import localize_segment
+        from pinot_tpu.segment.loader import load_segment
+        id_to_server = {s.instance_id: s for s in self.servers}
+        by_route: Dict[tuple, dict] = {}
+
+        def split(physical: str) -> tuple:
+            logical, ttype = split_physical_table_name(physical)
+            return logical, ttype or "OFFLINE"
+
+        for st in adds:
+            local = localize_segment(
+                st.dir_path,
+                os.path.join(self._minion_tmp, "localized", st.table))
+            seg = load_segment(local)
+            servers = [id_to_server[i] for i in st.instances
+                       if i in id_to_server] or [self.servers[0]]
+            for srv in servers:
+                srv.data_manager.table(st.table).add_segment(seg)
+            ops = by_route.setdefault(split(st.table), {"add": [], "rm": []})
+            ops["add"].append(SegmentInfo(
+                name=st.name, servers=[s.instance_id for s in servers],
+                start_time=st.start_time, end_time=st.end_time,
+                version=st.crc))
+        for table, name in removes:
+            by_route.setdefault(split(table), {"add": [], "rm": []})[
+                "rm"].append(name)
+        for (logical, ttype), ops in by_route.items():
+            rt = self._routes.get(logical)
+            if rt is None:
+                rt = RoutingTable()
+                self._routes[logical] = rt
+            physical = f"{logical}_{ttype}"
+            route = rt.offline if ttype == "OFFLINE" else rt.realtime
+            if route is None:
+                cfg = (self.cluster_state.tables.get(logical)
+                       if self.cluster_state is not None else None)
+                route = TableRoute(
+                    physical,
+                    time_column=cfg.retention.time_column if cfg else None)
+                if ttype == "OFFLINE":
+                    rt.offline = route
+                else:
+                    rt.realtime = route
+                self.routing.set_route(logical, rt)  # reset suffix views
+            # atomic swap: build the post-swap dict, then ONE reference
+            # assignment + counter bump (epoch memo invalidation)
+            snap = dict(route.segments)
+            for name in ops["rm"]:
+                snap.pop(name, None)
+            for info in ops["add"]:
+                snap[info.name] = info
+            route.segments = _ObservedSegments(route, snap)
+            route.mutation_version = next(route._mut_counter)
+        for table, name in removes:
+            for srv in self.servers:
+                tdm = srv.data_manager.table(table, create=False)
+                if tdm is not None:
+                    tdm.remove_segment(name)
+        for logical, _ttype in by_route:
+            for b in self.brokers:
+                b.on_segments_replaced(logical)

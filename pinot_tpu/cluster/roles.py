@@ -1,4 +1,4 @@
-"""Separate-process cluster roles: controller, server, broker.
+"""Separate-process cluster roles: controller, server, broker, minion.
 
 Reference parity: the role starters — BaseControllerStarter.java:150,
 BaseServerStarter.java:135 (start():578 joins Helix as PARTICIPANT,
@@ -36,25 +36,34 @@ def run_controller(state_dir: str, port: int = 0, host: str = "127.0.0.1",
     from pinot_tpu.controller.cluster_state import ClusterState
     from pinot_tpu.controller.coordination import CoordinationServer
     from pinot_tpu.controller.maintenance import run_retention
+    from pinot_tpu.controller.task_manager import TaskManager
     from pinot_tpu.utils.config import PinotConfiguration
 
     cfg = config or PinotConfiguration()
     if not port:
         port = cfg.get_int("pinot.controller.port")
     state = ClusterState(persist_dir=state_dir)
+    # the minion task fabric: durable (journaled) queue + generator
+    # cadence + lease-expiry sweeps, served over the coordination channel
+    tasks = TaskManager(
+        state, config=cfg,
+        journal_path=os.path.join(state_dir, "tasks.journal"))
     server = CoordinationServer(state, host=host, port=port,
                                 deep_store_uri=deep_store_uri
                                 or cfg.get_str(
                                     "pinot.controller.deep.store.uri")
-                                or None)
+                                or None,
+                                task_manager=tasks)
     server.LIVENESS_TTL_S = cfg.get_float(
         "pinot.coordination.liveness.ttl.seconds")
     server.start()
+    tasks.start()
     rest = None
     if http_port is not None:
         from pinot_tpu.controller.http_api import ControllerHttpServer
         rest = ControllerHttpServer(state, coordination=server,
-                                    host=host, port=http_port)
+                                    host=host, port=http_port,
+                                    task_manager=tasks)
         rest.start()
         print(f"controller REST on {rest.host}:{rest.port}", flush=True)
     print(f"controller listening on {server.address}", flush=True)
@@ -75,6 +84,7 @@ def run_controller(state_dir: str, port: int = 0, host: str = "127.0.0.1",
     finally:
         if rest is not None:
             rest.stop()
+        tasks.stop()
         server.stop()
 
 
@@ -108,6 +118,37 @@ def run_cache_server(port: int = 0, host: str = "127.0.0.1", config=None,
             pass
     finally:
         server.stop()
+
+
+def run_minion(instance_id: str, coordinator: str,
+               task_types=None, work_dir=None, config=None,
+               ready_event: Optional[threading.Event] = None,
+               stop_event: Optional[threading.Event] = None) -> None:
+    """The minion role: one background-task worker process leasing work
+    from the controller's task queue (minion/worker.py). Modeled on
+    run_cache_server — stateless across restarts: in-flight work is
+    protected by the lease protocol (an unfinished task's lease expires
+    and requeues), and committed work lives in the deep store + cluster
+    state, so a killed minion loses nothing."""
+    from pinot_tpu.minion.worker import MinionWorker
+    from pinot_tpu.utils.config import PinotConfiguration
+
+    cfg = config or PinotConfiguration()
+    worker = MinionWorker(instance_id, coordinator, work_dir=work_dir,
+                          task_types=task_types, config=cfg)
+    worker.start()
+    print(f"minion {instance_id} polling {coordinator}", flush=True)
+    if ready_event is not None:
+        ready_event.set()
+    stop = stop_event or threading.Event()
+    try:
+        while not stop.wait(2.0):
+            try:
+                worker.client.request("heartbeat", instance_id=instance_id)
+            except (ConnectionError, OSError, RuntimeError):
+                pass
+    finally:
+        worker.stop()
 
 
 class ServerRole:

@@ -12,4 +12,14 @@ control plane of SURVEY.md §7.4).
 from pinot_tpu.controller.cluster_state import ClusterState, SegmentState
 from pinot_tpu.controller.controller import Controller
 
-__all__ = ["ClusterState", "SegmentState", "Controller"]
+__all__ = ["ClusterState", "SegmentState", "Controller", "TaskManager",
+           "TaskQueue"]
+
+
+def __getattr__(name):
+    # lazy: task_manager pulls in the task executors (segment creator
+    # stack); importing the package for ClusterState alone stays light
+    if name in ("TaskManager", "TaskQueue"):
+        from pinot_tpu.controller import task_manager
+        return getattr(task_manager, name)
+    raise AttributeError(name)

@@ -97,8 +97,17 @@ class ClusterState:
         self._persist()
 
     def live_instances(self) -> List[InstanceState]:
+        """Enabled SERVER instances — role-tagged instances (minion
+        workers register with tags=['minion']) never receive segment
+        assignments (ref Helix instance tags gating assignment)."""
         with self._lock:
-            return [i for i in self.instances.values() if i.enabled]
+            return [i for i in self.instances.values()
+                    if i.enabled and "minion" not in i.tags]
+
+    def minion_instances(self) -> List[InstanceState]:
+        with self._lock:
+            return [i for i in self.instances.values()
+                    if i.enabled and "minion" in i.tags]
 
     # -- segments ------------------------------------------------------------
     def upsert_segment(self, state: SegmentState) -> None:
@@ -150,6 +159,29 @@ class ClusterState:
         self._persist()
         self._notify(st.table)
         return st
+
+    def replace_segments(self, adds: List[SegmentState],
+                         removes: List) -> None:
+        """Atomic segment swap (the minion segment-replace commit): all
+        `adds` upserted and all `removes` [(table, name)] dropped under
+        ONE lock hold, ONE persist, ONE notification per affected table
+        — watchers (brokers rebuilding routes, servers reconciling) see
+        the swapped set, never a half-applied one. Removing an absent
+        segment is a no-op, so replaying a committed swap (re-leased
+        task after a crash mid-commit) converges instead of corrupting."""
+        tables = []
+        with self._lock:
+            for st in adds:
+                self.segments.setdefault(st.table, {})[st.name] = st
+                if st.table not in tables:
+                    tables.append(st.table)
+            for table, name in removes:
+                self.segments.get(table, {}).pop(name, None)
+                if table not in tables:
+                    tables.append(table)
+        self._persist()
+        for table in tables:
+            self._notify(table)
 
     def set_assignment(self, table: str, assignment: Dict[str, List[str]]) -> None:
         """Bulk update segment->instances (rebalance commit)."""

@@ -49,9 +49,14 @@ class CoordinationServer:
     def __init__(self, state: ClusterState,
                  completion: Optional[SegmentCompletionManager] = None,
                  host: str = "127.0.0.1", port: int = 0,
-                 deep_store_uri: Optional[str] = None):
+                 deep_store_uri: Optional[str] = None,
+                 task_manager=None):
         self.state = state
         self.completion = completion or SegmentCompletionManager()
+        #: controller/task_manager.py TaskManager — when present, the
+        #: minion task ops (task_lease / task_renew / segment_replace ...)
+        #: ride this channel, the Helix Task Framework analog
+        self.task_manager = task_manager
         #: cluster-wide deep-store base URI; servers build their
         #: SegmentDeepStore from it (ref controller.data.dir config)
         self.deep_store_uri = deep_store_uri
@@ -206,6 +211,51 @@ class CoordinationServer:
                 self.state.upsert_segment(
                     SegmentState.from_dict(req["segment_state"]))
             return {"status": status}
+        if op.startswith("task_") or op == "segment_replace":
+            return self._dispatch_task(op, req)
+        raise ValueError(f"unknown op {op!r}")
+
+    def _dispatch_task(self, op: str, req: dict) -> dict:
+        """Minion task-fabric ops (ref the Helix Task Framework RPCs +
+        the controller task REST resources)."""
+        from pinot_tpu.controller.tasks import TaskConfig
+        tm = self.task_manager
+        if tm is None:
+            raise ValueError("no task manager on this controller")
+        if op == "task_submit":
+            t = req["task"]
+            e = tm.submit(TaskConfig(
+                t["taskType"], t["table"], list(t.get("segments", ())),
+                dict(t.get("params", ())), task_id=t.get("taskId", "")))
+            return {"task": e.to_dict()}
+        if op == "task_lease":
+            e = tm.lease(req["worker"], req.get("task_types") or None)
+            return {"task": e.to_dict() if e is not None else None}
+        if op == "task_renew":
+            return tm.queue.renew(req["task_id"], req["worker"],
+                                  progress=req.get("progress"))
+        if op == "task_complete":
+            ok = tm.queue.complete(req["task_id"], req["worker"],
+                                   result=req.get("result"))
+            return {"ok": ok}
+        if op == "task_fail":
+            ok = tm.queue.fail(req["task_id"], req["worker"],
+                               error=req.get("error", ""),
+                               cancelled=req.get("cancelled", False))
+            return {"ok": ok}
+        if op == "task_cancel":
+            state = tm.queue.cancel(req["task_id"])
+            return {"ok": state is not None, "state": state}
+        if op == "task_get":
+            e = tm.queue.get(req["task_id"])
+            return {"task": e.to_dict() if e is not None else None}
+        if op == "task_list":
+            return {"tasks": [e.to_dict()
+                              for e in tm.queue.list(req.get("state"))]}
+        if op == "segment_replace":
+            return tm.segment_replace(
+                req.get("task_id", ""), req.get("adds", ()),
+                [tuple(r) for r in req.get("removes", ())])
         raise ValueError(f"unknown op {op!r}")
 
     #: instances silent for this long are disabled (heartbeats come every

@@ -14,6 +14,11 @@ is not).
   GET    /tables/{name}/segments      -> per-physical-table segment states
   POST   /tables/{name}/segments      <- {"segDir": path, "tableType": ...}
   GET    /instances
+  GET    /tasks[?state=PENDING]       -> task-fabric queue entries
+  GET    /tasks/{id}                  -> one task's lifecycle record
+  POST   /tasks                       <- {"taskType", "table", "segments",
+                                          "params"} (submit)
+  POST   /tasks/{id}/cancel
 """
 from __future__ import annotations
 
@@ -29,9 +34,14 @@ from pinot_tpu.models import Schema, TableConfig
 
 class ControllerHttpServer:
     def __init__(self, state: ClusterState, coordination=None,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 task_manager=None):
         self.state = state
         self.coordination = coordination  # CoordinationServer (optional)
+        # task fabric (controller/task_manager.py); falls back to the
+        # coordination server's manager so both wirings expose /tasks
+        self.task_manager = task_manager or getattr(
+            coordination, "task_manager", None)
         api = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -69,9 +79,12 @@ class ControllerHttpServer:
                     self._reply(500, {"error": str(e)})
 
             def _route(self, method: str):
-                path = self.path.rstrip("/")
+                path, _, query = self.path.partition("?")
+                path = path.rstrip("/")
                 if method == "GET" and path == "/health":
                     return self._reply(200, {"status": "OK"})
+                if path == "/tasks" or path.startswith("/tasks/"):
+                    return self._route_tasks(method, path, query)
                 if path == "/tables" and method == "GET":
                     with api.state._lock:
                         names = sorted(api.state.tables)
@@ -138,6 +151,40 @@ class ControllerHttpServer:
                             "seg_dir": body["segDir"],
                             "table_type": body.get("tableType", "OFFLINE")})
                         return self._reply(200, r)
+                self._reply(404, {"error": f"no route {method} {path}"})
+
+            def _route_tasks(self, method: str, path: str, query: str):
+                """Task-fabric surface (ref PinotTaskRestletResource)."""
+                from urllib.parse import parse_qs
+                tm = api.task_manager
+                if tm is None:
+                    return self._reply(503, {"error": "no task manager"})
+                if path == "/tasks" and method == "GET":
+                    state = (parse_qs(query).get("state") or [None])[0]
+                    return self._reply(200, {"tasks": [
+                        e.to_dict() for e in tm.queue.list(state)]})
+                if path == "/tasks" and method == "POST":
+                    from pinot_tpu.controller.tasks import TaskConfig
+                    b = self._body()
+                    e = tm.submit(TaskConfig(
+                        b["taskType"], b["table"],
+                        list(b.get("segments", ())),
+                        dict(b.get("params", {}))))
+                    return self._reply(200, {"task": e.to_dict()})
+                m = re.fullmatch(r"/tasks/([^/]+)", path)
+                if m and method == "GET":
+                    e = tm.queue.get(m.group(1))
+                    if e is None:
+                        return self._reply(
+                            404, {"error": f"no task {m.group(1)}"})
+                    return self._reply(200, {"task": e.to_dict()})
+                m = re.fullmatch(r"/tasks/([^/]+)/cancel", path)
+                if m and method == "POST":
+                    state = tm.queue.cancel(m.group(1))
+                    if state is None:
+                        return self._reply(
+                            404, {"error": f"no task {m.group(1)}"})
+                    return self._reply(200, {"state": state})
                 self._reply(404, {"error": f"no route {method} {path}"})
 
         self._server = ThreadingHTTPServer((host, port), _Handler)

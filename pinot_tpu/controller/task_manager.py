@@ -1,0 +1,536 @@
+"""Distributed minion task fabric — controller side.
+
+Reference parity: pinot-controller minion/PinotTaskManager:84 bridging
+task *generators* to distributed minion *executors* through the Helix
+Task Framework. Without Helix, the controller owns a durable task queue
+(journaled like the warmup FingerprintLog — JSON-lines, reloaded at
+boot, compacted atomically) and hands work to minion workers through
+LEASES: a worker polls for tasks matching its declared task types,
+renews its lease with heartbeats while running, and an expired lease
+requeues the task with capped exponential backoff. The generate/execute
+split of controller/tasks.py is unchanged — generators still scan
+ClusterState; execution just moved off the controller's threads.
+
+Task state machine (exposed over coordination ops + the controller
+HTTP API)::
+
+    PENDING --lease--> LEASED --renew--> RUNNING --complete--> COMPLETED
+       ^                  |                 |
+       +---- requeue with backoff ---------+   (fail/expire, attempts
+       |                                        remaining)
+       +---- fail/expire, attempts exhausted -----------------> FAILED
+    cancel: PENDING -> CANCELLED immediately; LEASED/RUNNING set
+    cancel_requested, the next heartbeat tells the worker to abort and
+    its fail report lands the task in CANCELLED.
+
+Commit protocol: a finished task's output segments are uploaded to the
+deep store by the worker, then committed through ONE controller-side
+``segment_replace`` — an atomic ClusterState swap (adds upserted +
+removes dropped under a single lock/persist/notify), which moves the
+broker routing epoch (invalidating PR-1/2 result caches) and triggers
+server reconcile loads (which warm the new segment via PR-2
+SegmentWarmup before it serves). The swap is IDEMPOTENT: replaying it
+(crashed worker, re-leased task) upserts the same deterministic segment
+names and no-ops the already-removed ones, so crash-mid-commit never
+duplicates or loses segments.
+
+Failpoint sites (ROADMAP open item — controller coordination chaos):
+``controller.task.assign`` (lease grant), ``controller.task.lease.renew``
+(heartbeat), ``controller.segment.replace`` (the swap). The worker-side
+``minion.task.execute`` site lives in minion/worker.py.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+import uuid
+from collections import OrderedDict
+from dataclasses import asdict, dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from pinot_tpu.controller.cluster_state import ClusterState, SegmentState
+from pinot_tpu.controller.tasks import TaskConfig
+from pinot_tpu.utils.failpoints import fire
+
+log = logging.getLogger(__name__)
+
+#: task states
+PENDING = "PENDING"
+LEASED = "LEASED"
+RUNNING = "RUNNING"
+COMPLETED = "COMPLETED"
+FAILED = "FAILED"
+CANCELLED = "CANCELLED"
+
+TERMINAL = (COMPLETED, FAILED, CANCELLED)
+ACTIVE = (PENDING, LEASED, RUNNING)
+
+
+@dataclass
+class TaskEntry:
+    """One task's full lifecycle record (the Helix TaskConfig + context
+    ZNode analog). Wall-clock times throughout — the journal must stay
+    meaningful across a controller restart."""
+    task_id: str
+    task_type: str
+    table: str
+    segments: List[str] = field(default_factory=list)
+    params: Dict[str, Any] = field(default_factory=dict)
+    state: str = PENDING
+    worker: Optional[str] = None
+    lease_expiry: float = 0.0
+    attempts: int = 0
+    max_attempts: int = 3
+    #: backoff gate: a requeued task is not leasable before this time
+    not_before: float = 0.0
+    cancel_requested: bool = False
+    progress: str = ""
+    result: Optional[dict] = None
+    error: Optional[str] = None
+    created_at: float = 0.0
+    updated_at: float = 0.0
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TaskEntry":
+        return cls(**d)
+
+    def to_config(self) -> TaskConfig:
+        return TaskConfig(self.task_type, self.table, list(self.segments),
+                          dict(self.params), task_id=self.task_id)
+
+
+class TaskQueue:
+    """Durable lease-based task queue.
+
+    journal_path: append-only JSON-lines of task-entry snapshots, one
+    per state transition; reloaded at construction (last snapshot per id
+    wins), so PENDING/LEASED tasks survive a controller restart — a
+    reloaded LEASED task keeps its (wall-clock) lease and requeues
+    through the normal expiry sweep. Compacts to a snapshot of live
+    entries via atomic tmp+rename once it outgrows journal_max_bytes;
+    torn tail lines degrade to the previous snapshot of that task.
+    Journal I/O failures are swallowed: the in-memory queue is the
+    source of truth, persistence is crash insurance.
+    """
+
+    def __init__(self, journal_path: Optional[str] = None,
+                 lease_ttl_s: float = 30.0, max_attempts: int = 3,
+                 backoff_s: float = 1.0, backoff_cap_s: float = 30.0,
+                 journal_max_bytes: int = 1 << 20, max_done: int = 256,
+                 metrics=None):
+        self.lease_ttl_s = float(lease_ttl_s)
+        self.max_attempts = max(1, int(max_attempts))
+        self.backoff_s = float(backoff_s)
+        self.backoff_cap_s = float(backoff_cap_s)
+        self.max_done = max(1, int(max_done))
+        self._tasks: "Dict[str, TaskEntry]" = {}
+        self._lock = threading.Lock()
+        self._metrics = metrics
+        self.journal_path = journal_path
+        self.journal_max_bytes = max(4096, int(journal_max_bytes))
+        self._journal_file = None
+        self._journal_bytes = 0
+        if journal_path:
+            self._replay_journal()
+
+    # -- journal (FingerprintLog discipline) ---------------------------
+    def _replay_journal(self) -> None:
+        try:
+            with open(self.journal_path, encoding="utf-8",
+                      errors="replace") as f:
+                lines = f.readlines()
+        except OSError:
+            return  # first boot or unreadable: start empty
+        for raw in lines:
+            try:
+                e = TaskEntry.from_dict(json.loads(raw))
+            except (ValueError, TypeError, KeyError):
+                continue  # torn/corrupt line: keep the rest
+            self._tasks[e.task_id] = e
+
+    def _journal_locked(self, entry: TaskEntry) -> None:
+        if not self.journal_path:
+            return
+        line = json.dumps(entry.to_dict()) + "\n"
+        try:
+            if self._journal_file is None:
+                self._journal_file = open(self.journal_path, "a",
+                                          encoding="utf-8")
+                self._journal_bytes = os.path.getsize(self.journal_path)
+            self._journal_file.write(line)
+            self._journal_file.flush()
+            self._journal_bytes += len(line.encode("utf-8"))
+            if self._journal_bytes > self.journal_max_bytes:
+                self._compact_locked()
+        except OSError:
+            log.debug("task journal write failed", exc_info=True)
+
+    def _compact_locked(self) -> None:
+        if self._journal_file is not None:
+            self._journal_file.close()
+            self._journal_file = None
+        tmp = self.journal_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            for e in self._tasks.values():
+                f.write(json.dumps(e.to_dict()) + "\n")
+        os.replace(tmp, self.journal_path)
+        self._journal_bytes = os.path.getsize(self.journal_path)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._journal_file is not None:
+                try:
+                    self._journal_file.close()
+                except OSError:
+                    pass
+                self._journal_file = None
+
+    # -- helpers -------------------------------------------------------
+    def _touch_locked(self, e: TaskEntry) -> None:
+        e.updated_at = time.time()
+        self._journal_locked(e)
+        self._set_depth_locked()
+
+    def _set_depth_locked(self) -> None:
+        if self._metrics is not None:
+            depth = sum(1 for t in self._tasks.values()
+                        if t.state in ACTIVE)
+            self._metrics.set_gauge("task_queue_depth", depth)
+
+    def _meter(self, name: str, task_type: str) -> None:
+        if self._metrics is not None:
+            self._metrics.add_meter(name, labels={"taskType": task_type})
+
+    def _evict_done_locked(self) -> None:
+        done = [e for e in self._tasks.values() if e.state in TERMINAL]
+        if len(done) <= self.max_done:
+            return
+        done.sort(key=lambda e: e.updated_at)
+        for e in done[: len(done) - self.max_done]:
+            del self._tasks[e.task_id]
+
+    # -- queue API -----------------------------------------------------
+    def submit(self, task: TaskConfig,
+               max_attempts: Optional[int] = None) -> TaskEntry:
+        task_id = task.task_id or \
+            f"Task_{task.task_type}_{uuid.uuid4().hex[:12]}"
+        with self._lock:
+            existing = self._tasks.get(task_id)
+            if existing is not None:
+                return existing  # idempotent re-submit
+            e = TaskEntry(
+                task_id=task_id, task_type=task.task_type, table=task.table,
+                segments=list(task.segments), params=dict(task.params),
+                max_attempts=max_attempts or self.max_attempts,
+                created_at=time.time())
+            self._tasks[task_id] = e
+            self._touch_locked(e)
+            return e
+
+    def has_active(self, task_type: str, table: str,
+                   segments: List[str]) -> bool:
+        """Generator dedupe: an ACTIVE task already covers this exact
+        input set (ref PinotTaskManager's non-duplicate scheduling)."""
+        want = sorted(segments)
+        with self._lock:
+            return any(e.state in ACTIVE and e.task_type == task_type
+                       and e.table == table and sorted(e.segments) == want
+                       for e in self._tasks.values())
+
+    def lease(self, worker: str,
+              task_types: Optional[List[str]] = None,
+              lease_ttl_s: Optional[float] = None) -> Optional[TaskEntry]:
+        """Grant the oldest leasable PENDING task matching the worker's
+        declared task types. Sweeps expired leases first so a polling
+        worker (not just the cadence loop) recovers crashed peers'
+        work."""
+        now = time.time()
+        self.expire_leases(now)
+        ttl = lease_ttl_s if lease_ttl_s is not None else self.lease_ttl_s
+        with self._lock:
+            candidates = sorted(
+                (e for e in self._tasks.values()
+                 if e.state == PENDING and e.not_before <= now
+                 and (not task_types or e.task_type in task_types)),
+                key=lambda e: (e.created_at, e.task_id))
+            if not candidates:
+                return None
+            e = candidates[0]
+            # chaos hook: delay/fail the grant itself (a raise leaves the
+            # task PENDING — the lease was never handed out)
+            fire("controller.task.assign", task_id=e.task_id,
+                 worker=worker, task_type=e.task_type)
+            e.state = LEASED
+            e.worker = worker
+            e.lease_expiry = now + ttl
+            e.attempts += 1
+            e.progress = ""
+            e.error = None
+            self._touch_locked(e)
+            return e
+
+    def renew(self, task_id: str, worker: str,
+              progress: Optional[str] = None) -> dict:
+        """Heartbeat: extend the lease, record progress, report whether a
+        cancel was requested. An unknown/foreign lease returns ok=False —
+        the worker must abandon the task (someone else owns it now)."""
+        fire("controller.task.lease.renew", task_id=task_id, worker=worker)
+        with self._lock:
+            e = self._tasks.get(task_id)
+            if e is None or e.worker != worker \
+                    or e.state not in (LEASED, RUNNING):
+                return {"ok": False, "cancelled": False}
+            e.state = RUNNING
+            e.lease_expiry = time.time() + self.lease_ttl_s
+            if progress is not None:
+                e.progress = progress
+            self._touch_locked(e)
+            return {"ok": True, "cancelled": e.cancel_requested}
+
+    def complete(self, task_id: str, worker: str,
+                 result: Optional[dict] = None) -> bool:
+        with self._lock:
+            e = self._tasks.get(task_id)
+            if e is None or e.worker != worker \
+                    or e.state not in (LEASED, RUNNING):
+                return False
+            e.state = COMPLETED
+            e.result = result or {}
+            self._touch_locked(e)
+            self._evict_done_locked()
+        self._meter("minion_tasks_completed", e.task_type)
+        return True
+
+    def fail(self, task_id: str, worker: str, error: str = "",
+             cancelled: bool = False) -> bool:
+        with self._lock:
+            e = self._tasks.get(task_id)
+            if e is None or e.worker != worker \
+                    or e.state not in (LEASED, RUNNING):
+                return False
+            self._requeue_or_fail_locked(e, error, cancelled=cancelled)
+        return True
+
+    def cancel(self, task_id: str) -> Optional[str]:
+        """PENDING cancels immediately; LEASED/RUNNING flags the worker
+        through its next heartbeat. Returns the resulting state."""
+        with self._lock:
+            e = self._tasks.get(task_id)
+            if e is None:
+                return None
+            if e.state == PENDING:
+                e.state = CANCELLED
+                self._touch_locked(e)
+            elif e.state in (LEASED, RUNNING):
+                e.cancel_requested = True
+                self._touch_locked(e)
+            return e.state
+
+    def expire_leases(self, now: Optional[float] = None) -> List[str]:
+        """Requeue (or terminally fail) tasks whose lease ran out — the
+        crashed-worker recovery path. Each expiry requeues EXACTLY once:
+        the state transition back to PENDING happens under the lock."""
+        now = now if now is not None else time.time()
+        expired = []
+        with self._lock:
+            for e in self._tasks.values():
+                if e.state in (LEASED, RUNNING) and e.lease_expiry <= now:
+                    self._requeue_or_fail_locked(
+                        e, f"lease expired on worker {e.worker}")
+                    expired.append(e.task_id)
+        return expired
+
+    def _requeue_or_fail_locked(self, e: TaskEntry, error: str,
+                                cancelled: bool = False) -> None:
+        e.error = error
+        e.worker = None
+        e.lease_expiry = 0.0
+        if cancelled or e.cancel_requested:
+            e.state = CANCELLED
+        elif e.attempts >= e.max_attempts:
+            e.state = FAILED
+            self._meter("minion_tasks_failed", e.task_type)
+        else:
+            # capped exponential backoff: attempt N retries after
+            # min(backoff * 2^(N-1), cap)
+            e.state = PENDING
+            e.not_before = time.time() + min(
+                self.backoff_s * (2 ** (e.attempts - 1)),
+                self.backoff_cap_s)
+            self._meter("minion_tasks_retried", e.task_type)
+        self._touch_locked(e)
+        if e.state in TERMINAL:
+            self._evict_done_locked()
+
+    # -- introspection -------------------------------------------------
+    def get(self, task_id: str) -> Optional[TaskEntry]:
+        with self._lock:
+            return self._tasks.get(task_id)
+
+    def list(self, state: Optional[str] = None) -> List[TaskEntry]:
+        with self._lock:
+            out = [e for e in self._tasks.values()
+                   if state is None or e.state == state]
+        return sorted(out, key=lambda e: (e.created_at, e.task_id))
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._tasks)
+
+
+class TaskManager:
+    """Queue + generator cadence + the atomic segment-replace commit."""
+
+    def __init__(self, state: ClusterState, config=None,
+                 journal_path: Optional[str] = None, metrics=None,
+                 on_replace: Optional[Callable] = None):
+        from pinot_tpu.utils.config import PinotConfiguration
+        from pinot_tpu.utils.metrics import get_registry
+        cfg = config or PinotConfiguration()
+        self.state = state
+        self.config = cfg
+        self._metrics = metrics if metrics is not None \
+            else get_registry("controller")
+        self.queue = TaskQueue(
+            journal_path=journal_path,
+            lease_ttl_s=cfg.get_float("pinot.controller.task.lease.seconds"),
+            max_attempts=cfg.get_int("pinot.controller.task.max.attempts"),
+            backoff_s=cfg.get_float(
+                "pinot.controller.task.retry.backoff.seconds"),
+            backoff_cap_s=cfg.get_float(
+                "pinot.controller.task.retry.backoff.cap.seconds"),
+            journal_max_bytes=cfg.get_int(
+                "pinot.controller.task.journal.max.bytes"),
+            metrics=self._metrics)
+        self.generators_enabled = cfg.get_bool(
+            "pinot.controller.task.generators.enabled")
+        #: callback(adds: [SegmentState], removes: [(table, name)]) fired
+        #: AFTER a segment-replace commits — embedded harnesses
+        #: (MiniCluster) push the swap into their servers/routing with it
+        self.on_replace = on_replace
+        #: fast idempotency path for replayed commits, bounded FIFO (the
+        #: state-level swap is idempotent anyway — eviction only costs a
+        #: replayed commit one extra no-op epoch move, never correctness)
+        self._applied: "OrderedDict[str, None]" = OrderedDict()
+        self._applied_max = 1024
+        self._replace_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- scheduler cadence ---------------------------------------------
+    def run_once(self) -> Dict[str, int]:
+        """One cadence tick: sweep expired leases, then feed the queue
+        from the generators (deduped against active tasks)."""
+        expired = self.queue.expire_leases()
+        generated = 0
+        if self.generators_enabled:
+            generated = self.generate_tasks()
+        return {"expired": len(expired), "generated": generated}
+
+    def generate_tasks(self) -> int:
+        """Run the merge-rollup generator over every OFFLINE table whose
+        config opts in via ``taskTypeConfigsMap``-style params
+        (``table.task_configs['MergeRollupTask']`` when present) — the
+        PinotTaskGenerator scan, feeding the durable queue instead of a
+        local pool."""
+        from pinot_tpu.controller.tasks import generate_merge_rollup_tasks
+        n = 0
+        for cfg in list(self.state.tables.values()):
+            task_cfgs = getattr(cfg, "task_configs", None) or {}
+            if "MergeRollupTask" not in task_cfgs:
+                continue
+            physical = f"{cfg.name}_OFFLINE"
+            params = dict(task_cfgs.get("MergeRollupTask") or {})
+            for task in generate_merge_rollup_tasks(
+                    self.state, physical,
+                    max_docs_per_merged=int(
+                        params.get("maxDocsPerMergedSegment", 5_000_000)),
+                    min_segments=int(params.get("minSegments", 2))):
+                task.params.update(params)
+                if self.queue.has_active(task.task_type, task.table,
+                                         task.segments):
+                    continue
+                self.submit(task)
+                n += 1
+        return n
+
+    def start(self, interval_s: Optional[float] = None) -> None:
+        interval = interval_s if interval_s is not None else \
+            self.config.get_float("pinot.controller.task.frequency.seconds")
+
+        def loop():
+            while not self._stop.wait(interval):
+                try:
+                    self.run_once()
+                except Exception:  # noqa: BLE001 — periodic must survive
+                    log.exception("task cadence tick failed")
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="task-manager")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        self.queue.close()
+
+    # -- queue facade (coordination ops call through here) -------------
+    def submit(self, task: TaskConfig) -> TaskEntry:
+        return self.queue.submit(task)
+
+    def lease(self, worker: str,
+              task_types: Optional[List[str]] = None) -> Optional[TaskEntry]:
+        return self.queue.lease(worker, task_types)
+
+    # -- the atomic swap -----------------------------------------------
+    def segment_replace(self, task_id: str, adds: List[dict],
+                        removes: List[Tuple[str, str]]) -> dict:
+        """Commit a task's output: upsert `adds` (SegmentState dicts,
+        dir_path already a durable deep-store URI or loadable path) and
+        drop `removes` [(physical_table, name)] in ONE ClusterState
+        mutation — a single watch notification, a single routing-epoch
+        move. Instance placement: live servers via assign_balanced when
+        any are registered, else the union of the removed segments'
+        holders (embedded harnesses place through on_replace).
+
+        Idempotent by construction: deterministic segment names make the
+        replayed upsert a same-content overwrite and the replayed
+        removes no-ops — plus a fast-path memo on task_id."""
+        fire("controller.segment.replace", task_id=task_id)
+        from pinot_tpu.controller.assignment import assign_balanced
+        add_states = [SegmentState.from_dict(d) for d in adds]
+        with self._replace_lock:
+            if task_id and task_id in self._applied:
+                return {"ok": True, "applied": False}
+            removed_holders: List[str] = []
+            for table, name in removes:
+                st = self.state.segments.get(table, {}).get(name)
+                if st is not None:
+                    removed_holders.extend(st.instances)
+            for st in add_states:
+                if st.instances:
+                    continue
+                cfg = self.state.tables.get(st.table.rsplit("_", 1)[0])
+                replication = cfg.retention.replication if cfg else 1
+                if self.state.live_instances():
+                    st.instances = assign_balanced(
+                        self.state, st.table, st.name,
+                        replication=replication)
+                else:
+                    st.instances = sorted(set(removed_holders))
+            self.state.replace_segments(add_states, list(removes))
+            if task_id:
+                self._applied[task_id] = None
+                while len(self._applied) > self._applied_max:
+                    self._applied.popitem(last=False)
+        if self.on_replace is not None:
+            self.on_replace(add_states, list(removes))
+        return {"ok": True, "applied": True}

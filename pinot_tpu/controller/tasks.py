@@ -15,8 +15,8 @@ PurgeTask: rewrite segments dropping rows matching a predicate.
 """
 from __future__ import annotations
 
+import hashlib
 import os
-import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
@@ -34,6 +34,23 @@ class TaskConfig:
     table: str                      # physical table name
     segments: List[str]
     params: Dict[str, Any] = field(default_factory=dict)
+    #: set by the TaskManager queue; folds into output segment names so a
+    #: re-leased task rebuilds the SAME segments (idempotent commit)
+    task_id: str = ""
+
+
+def task_token(task: TaskConfig) -> str:
+    """Deterministic output-name token for a task: a function of the
+    task's INPUT identity only (never wall-clock or worker identity), so
+    any re-execution — retry, re-lease after a crash — produces
+    identically named segments and the segment-replace commit stays
+    idempotent."""
+    h = hashlib.sha1()
+    for part in (task.task_type, task.table, *sorted(task.segments),
+                 task.task_id):
+        h.update(part.encode())
+        h.update(b"\0")
+    return h.hexdigest()[:10]
 
 
 class TaskExecutor:
@@ -46,8 +63,15 @@ class TaskExecutor:
 
 @dataclass
 class TaskContext:
+    """Local (in-controller) execution context: segment mutations apply
+    straight to ClusterState. Executors go through publish_segment /
+    retire_segment / segment_state, never ctx.state directly — the
+    minion worker substitutes a collecting context (minion/worker.py
+    MinionTaskContext) that runs the SAME executors against a state
+    snapshot and commits through the controller's atomic swap."""
     state: ClusterState
     output_dir: str
+    task_id: str = ""
 
     def table_config(self, physical_table: str) -> TableConfig:
         base = physical_table.rsplit("_", 1)[0]
@@ -57,12 +81,20 @@ class TaskContext:
         base = physical_table.rsplit("_", 1)[0]
         return self.state.schemas[base]
 
+    def segment_state(self, table: str, name: str) -> SegmentState:
+        return self.state.segments.get(table, {})[name]
+
+    def publish_segment(self, st: SegmentState) -> None:
+        self.state.upsert_segment(st)
+
+    def retire_segment(self, table: str, name: str) -> None:
+        self.state.remove_segment(table, name)
+
     def load(self, table: str, name: str) -> ImmutableSegment:
         import os
 
         from pinot_tpu.segment.fs import localize_segment
-        seg_map = self.state.segments.get(table, {})
-        st = seg_map[name]
+        st = self.segment_state(table, name)
         # deep-store URIs download into the task work area first
         local = localize_segment(
             st.dir_path, os.path.join(self.output_dir, "_downloads"))
@@ -100,18 +132,17 @@ class MergeRollupTaskExecutor(TaskExecutor):
         if task.params.get("mergeType", "CONCAT").upper() == "ROLLUP":
             columns = _rollup(columns, schema)
         name = task.params.get(
-            "segmentName",
-            f"{cfg.name}_merged_{int(time.time())}_{task.segments[0][-8:]}")
+            "segmentName", f"{cfg.name}_merged_{task_token(task)}")
         out_dir = os.path.join(ctx.output_dir, name)
         SegmentCreator(cfg, schema).build(columns, out_dir, name)
         merged = load_segment(out_dir)
         meta = merged.metadata
-        ctx.state.upsert_segment(SegmentState(
+        ctx.publish_segment(SegmentState(
             name=name, table=table, instances=[], dir_path=out_dir,
             num_docs=meta.num_docs, start_time=meta.start_time,
-            end_time=meta.end_time))
+            end_time=meta.end_time, crc=meta.crc))
         for old in task.segments:
-            ctx.state.remove_segment(table, old)
+            ctx.retire_segment(table, old)
         return {"mergedSegment": name, "numDocs": meta.num_docs,
                 "replaced": task.segments}
 
@@ -151,17 +182,17 @@ class RealtimeToOfflineTaskExecutor(TaskExecutor):
         schema = ctx.schema_for(rt_table)
         segs = [ctx.load(rt_table, n) for n in task.segments]
         columns = _segments_to_columns(segs, schema)
-        name = f"{base}_r2o_{int(time.time())}_{len(task.segments)}"
+        name = f"{base}_r2o_{task_token(task)}"
         out_dir = os.path.join(ctx.output_dir, name)
         SegmentCreator(cfg, schema).build(columns, out_dir, name)
         merged = load_segment(out_dir)
-        ctx.state.upsert_segment(SegmentState(
+        ctx.publish_segment(SegmentState(
             name=name, table=off_table, instances=[], dir_path=out_dir,
             num_docs=merged.num_docs,
             start_time=merged.metadata.start_time,
-            end_time=merged.metadata.end_time))
+            end_time=merged.metadata.end_time, crc=merged.metadata.crc))
         for old in task.segments:
-            ctx.state.remove_segment(rt_table, old)
+            ctx.retire_segment(rt_table, old)
         return {"offlineSegment": name, "numDocs": merged.num_docs}
 
 
@@ -194,12 +225,12 @@ class PurgeTaskExecutor(TaskExecutor):
             out_dir = os.path.join(ctx.output_dir, name)
             SegmentCreator(cfg, schema).build(columns, out_dir, name)
             m = load_segment(out_dir).metadata
-            old_state = ctx.state.segments[table][seg_name]
-            ctx.state.upsert_segment(SegmentState(
+            old_state = ctx.segment_state(table, seg_name)
+            ctx.publish_segment(SegmentState(
                 name=name, table=table, instances=list(old_state.instances),
                 dir_path=out_dir, num_docs=m.num_docs,
-                start_time=m.start_time, end_time=m.end_time))
-            ctx.state.remove_segment(table, seg_name)
+                start_time=m.start_time, end_time=m.end_time, crc=m.crc))
+            ctx.retire_segment(table, seg_name)
             purged.append(name)
         return {"purgedSegments": purged}
 

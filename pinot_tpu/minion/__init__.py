@@ -1,0 +1,16 @@
+"""Minion role: the distributed background-task worker.
+
+Reference parity: pinot-minion (ServiceRole.MINION, SURVEY.md L7) — the
+fourth runtime role. Workers register with the controller, lease tasks
+matching their declared task types from the controller's durable queue
+(controller/task_manager.py), run the existing TaskExecutors
+(controller/tasks.py) in a sandboxed work dir, stream progress +
+lease-renewal heartbeats over the coordination channel, and commit
+results through the atomic segment-replace protocol: upload outputs to
+the deep store, then one controller-side swap that moves the routing
+epoch (invalidating result caches) and lets servers warm the new segment
+before it serves.
+"""
+from pinot_tpu.minion.worker import MinionTaskContext, MinionWorker
+
+__all__ = ["MinionWorker", "MinionTaskContext"]

@@ -1,0 +1,335 @@
+"""Minion worker: lease -> execute -> upload -> atomic swap -> complete.
+
+The fault-tolerant segment lifecycle, end to end:
+
+1. **Lease.** The worker polls ``task_lease`` with its declared task
+   types. The controller grants the oldest leasable PENDING task and
+   starts the lease TTL clock.
+2. **Heartbeat.** While the task runs, a heartbeat thread renews the
+   lease (``task_renew``) every few seconds, streaming a progress string
+   and learning about cancel requests. A worker that dies simply stops
+   renewing — the controller's expiry sweep requeues the task with
+   capped exponential backoff, and another worker picks it up.
+3. **Execute.** The existing TaskExecutors (controller/tasks.py) run
+   unchanged against a ``MinionTaskContext`` — a collecting context over
+   the controller's state snapshot: ``publish_segment``/``retire_segment``
+   record the intended swap instead of mutating anything.
+4. **Commit (idempotent).** Output segments upload to the deep store
+   under their deterministic names, then a MANIFEST (the commit intent:
+   adds + removes + result) is written at a task-id-keyed store URI, and
+   finally ONE ``segment_replace`` asks the controller for the atomic
+   swap. A task re-leased after a crash anywhere in this sequence
+   converges: before the manifest exists it re-executes (deterministic
+   names make re-upload an overwrite, not a duplicate); after, the
+   worker skips execution entirely and replays the swap, which the
+   controller applies idempotently.
+
+Chaos: the ``minion.task.execute`` failpoint fires as execution starts;
+arming it with a ``SimulatedCrash`` error makes the worker vanish
+mid-task without reporting anything — the lease-expiry recovery path in
+one deterministic test.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import shutil
+import tempfile
+import threading
+import time
+from typing import List, Optional, Tuple
+
+from pinot_tpu.controller.cluster_state import SegmentState
+from pinot_tpu.controller.coordination import CoordinationClient
+from pinot_tpu.controller.tasks import TaskConfig, run_task
+from pinot_tpu.models import Schema, TableConfig
+from pinot_tpu.segment.loader import ImmutableSegment, load_segment
+from pinot_tpu.utils.failpoints import SimulatedCrash, fire
+
+log = logging.getLogger(__name__)
+
+
+class _TaskAborted(RuntimeError):
+    """Raised inside a task run when the controller requested cancel."""
+
+
+class MinionTaskContext:
+    """TaskContext over a cluster-state SNAPSHOT: reads resolve from the
+    controller's state blob; publish/retire COLLECT the swap instead of
+    applying it (the worker commits through segment_replace)."""
+
+    def __init__(self, blob: dict, output_dir: str, task_id: str = ""):
+        self.blob = blob
+        self.output_dir = output_dir
+        self.task_id = task_id
+        self.published: List[SegmentState] = []
+        self.retired: List[Tuple[str, str]] = []
+
+    def table_config(self, physical_table: str) -> TableConfig:
+        base = physical_table.rsplit("_", 1)[0]
+        return TableConfig.from_dict(self.blob["tables"][base])
+
+    def schema_for(self, physical_table: str) -> Schema:
+        base = physical_table.rsplit("_", 1)[0]
+        return Schema.from_dict(self.blob["schemas"][base])
+
+    def segment_state(self, table: str, name: str) -> SegmentState:
+        return SegmentState.from_dict(
+            self.blob["segments"].get(table, {})[name])
+
+    def publish_segment(self, st: SegmentState) -> None:
+        self.published.append(st)
+
+    def retire_segment(self, table: str, name: str) -> None:
+        self.retired.append((table, name))
+
+    def load(self, table: str, name: str) -> ImmutableSegment:
+        from pinot_tpu.segment.fs import localize_segment
+        st = self.segment_state(table, name)
+        local = localize_segment(
+            st.dir_path, os.path.join(self.output_dir, "_downloads"))
+        return load_segment(local)
+
+
+class MinionWorker:
+    """One minion worker instance (ref MinionStarter + TaskFactoryRegistry
+    executor threads; here one task at a time per worker — scale out by
+    running more workers)."""
+
+    def __init__(self, instance_id: str, coordinator: str,
+                 work_dir: Optional[str] = None,
+                 task_types: Optional[List[str]] = None,
+                 config=None, metrics=None):
+        from pinot_tpu.utils.config import PinotConfiguration
+        from pinot_tpu.utils.metrics import get_registry
+        cfg = config or PinotConfiguration()
+        self.instance_id = instance_id
+        self.client = CoordinationClient(coordinator)
+        self.poll_s = cfg.get_float("pinot.minion.poll.seconds")
+        self.heartbeat_s = cfg.get_float("pinot.minion.heartbeat.seconds")
+        types = task_types
+        if types is None:
+            raw = cfg.get_str("pinot.minion.task.types")
+            types = [t.strip() for t in raw.split(",") if t.strip()] or None
+        self.task_types = types  # None = all registered task types
+        self.work_dir = work_dir or cfg.get_str("pinot.minion.work.dir") \
+            or tempfile.mkdtemp(prefix=f"pinot_tpu_minion_{instance_id}_")
+        self._metrics = metrics if metrics is not None \
+            else get_registry("minion")
+        self._labels = {"minion": instance_id}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        #: observability for tests: tasks this worker actually EXECUTED
+        #: vs. commits it merely replayed from a found manifest
+        self.executed = 0
+        self.manifest_resumes = 0
+        self.crashed = False
+
+    # ------------------------------------------------------------------
+    def start(self) -> None:
+        os.makedirs(self.work_dir, exist_ok=True)
+        self.client.register_instance(self.instance_id, "127.0.0.1", 0,
+                                      tags=["minion"])
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"minion-{self.instance_id}")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        self.client.close()
+
+    # ------------------------------------------------------------------
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                r = self.client.request("task_lease",
+                                        worker=self.instance_id,
+                                        task_types=self.task_types)
+                entry = r.get("task")
+            except (ConnectionError, OSError, RuntimeError):
+                entry = None  # controller briefly unreachable: keep polling
+            if entry is None:
+                self._stop.wait(self.poll_s)
+                continue
+            try:
+                self._run_task(entry)
+            except SimulatedCrash:
+                # chaos kill: vanish WITHOUT reporting — recovery must
+                # come from lease expiry, exactly like a dead process
+                self.crashed = True
+                log.warning("minion %s simulated crash on %s",
+                            self.instance_id, entry["task_id"])
+                return
+
+    # ------------------------------------------------------------------
+    def _run_task(self, entry: dict) -> None:
+        task = TaskConfig(entry["task_type"], entry["table"],
+                          list(entry["segments"]), dict(entry["params"]),
+                          task_id=entry["task_id"])
+        task_id = task.task_id
+        sandbox = os.path.join(self.work_dir, task_id)
+        os.makedirs(sandbox, exist_ok=True)
+        cancel = threading.Event()
+        lost = threading.Event()
+        hb_stop = threading.Event()
+        hb = threading.Thread(
+            target=self._heartbeat_loop,
+            args=(task_id, hb_stop, cancel, lost),
+            daemon=True, name=f"minion-hb-{task_id[:18]}")
+        hb.start()
+        t0 = time.perf_counter()
+        try:
+            # chaos site: the canonical place to kill/delay a worker
+            # mid-task (ISSUE 5 acceptance scenario)
+            fire("minion.task.execute", worker=self.instance_id,
+                 task_id=task_id, task_type=task.task_type)
+            blob = self.client.get_state()
+            store = self._store(blob)
+            manifest = self._read_manifest(store, task_id)
+            if manifest is None:
+                adds, removes, result = self._execute(task, blob, sandbox,
+                                                      cancel)
+                self._report_progress(task_id, "uploading")
+                adds = self._upload_outputs(store, adds)
+                manifest = {"taskId": task_id,
+                            "adds": [a.to_dict() for a in adds],
+                            "removes": [list(r) for r in removes],
+                            "result": result}
+                self._write_manifest(store, task_id, manifest)
+            else:
+                # crash-mid-commit recovery: outputs are already durable
+                # — skip execution, just replay the swap
+                self.manifest_resumes += 1
+                self._metrics.add_meter("minion_manifest_resumes",
+                                        labels=self._labels)
+            if cancel.is_set():
+                raise _TaskAborted("cancelled by controller")
+            if lost.is_set():
+                return  # lease lost: someone else owns the task now
+            self._report_progress(task_id, "committing")
+            self.client.request(
+                "segment_replace", task_id=task_id,
+                adds=manifest["adds"], removes=manifest["removes"])
+            self.client.request("task_complete", task_id=task_id,
+                                worker=self.instance_id,
+                                result=manifest["result"])
+            self._metrics.add_timing(
+                "minion_task_duration_ms",
+                (time.perf_counter() - t0) * 1000.0,
+                labels={"taskType": task.task_type})
+            if store is not None:
+                # outputs are durable in the deep store; without one the
+                # sandbox IS the committed segments' home — keep it
+                shutil.rmtree(sandbox, ignore_errors=True)
+        except SimulatedCrash:
+            raise
+        except _TaskAborted as e:
+            self._report_fail(task_id, str(e), cancelled=True)
+        except Exception as e:  # noqa: BLE001 — report and move on
+            log.exception("task %s failed on %s", task_id, self.instance_id)
+            self._report_fail(task_id, f"{type(e).__name__}: {e}")
+        finally:
+            hb_stop.set()
+
+    def _execute(self, task: TaskConfig, blob: dict, sandbox: str,
+                 cancel: threading.Event):
+        self.executed += 1
+        self._report_progress(task.task_id, "executing")
+        ctx = MinionTaskContext(blob, sandbox, task_id=task.task_id)
+        result = run_task(task, ctx)
+        if cancel.is_set():
+            raise _TaskAborted("cancelled by controller")
+        return ctx.published, ctx.retired, result
+
+    # -- commit plumbing ------------------------------------------------
+    @staticmethod
+    def _store(blob: dict):
+        uri = blob.get("deep_store_uri")
+        if not uri:
+            return None
+        from pinot_tpu.segment.fs import SegmentDeepStore
+        return SegmentDeepStore(uri)
+
+    def _upload_outputs(self, store,
+                        adds: List[SegmentState]) -> List[SegmentState]:
+        """Push built segments to the deep store; their SegmentState then
+        carries the durable URI. Without a store the local build dir is
+        registered as-is (single-box deployments) — the sandbox is then
+        the segment's home and must not be cleaned on failure."""
+        if store is None:
+            return adds
+        for st in adds:
+            st.dir_path = store.upload(st.dir_path, st.table, st.name)
+        return adds
+
+    def _manifest_uri(self, store, task_id: str) -> str:
+        return f"{store.base_uri}/_tasks/{task_id}.json"
+
+    def _read_manifest(self, store, task_id: str) -> Optional[dict]:
+        if store is None:
+            return None
+        uri = self._manifest_uri(store, task_id)
+        try:
+            if not store.fs.exists(uri):
+                return None
+            with tempfile.NamedTemporaryFile(suffix=".json",
+                                             delete=False) as tmp:
+                tmp_path = tmp.name
+            try:
+                store.fs.copy_to_local(uri, tmp_path)
+                with open(tmp_path, encoding="utf-8") as f:
+                    return json.load(f)
+            finally:
+                os.remove(tmp_path)
+        except (OSError, ValueError):
+            return None  # torn/unreadable manifest: re-execute from scratch
+
+    def _write_manifest(self, store, task_id: str, manifest: dict) -> None:
+        if store is None:
+            return
+        with tempfile.NamedTemporaryFile("w", suffix=".json", delete=False,
+                                         encoding="utf-8") as tmp:
+            json.dump(manifest, tmp)
+            tmp_path = tmp.name
+        try:
+            store.fs.copy_from_local(tmp_path,
+                                     self._manifest_uri(store, task_id))
+        finally:
+            os.remove(tmp_path)
+
+    # -- heartbeats -----------------------------------------------------
+    def _heartbeat_loop(self, task_id: str, stop: threading.Event,
+                        cancel: threading.Event,
+                        lost: threading.Event) -> None:
+        while not stop.wait(self.heartbeat_s):
+            try:
+                r = self.client.request("task_renew", task_id=task_id,
+                                        worker=self.instance_id)
+            except (ConnectionError, OSError, RuntimeError):
+                continue  # controller hiccup: the lease TTL absorbs it
+            if r.get("cancelled"):
+                cancel.set()
+            if not r.get("ok"):
+                lost.set()
+                return
+
+    def _report_progress(self, task_id: str, progress: str) -> None:
+        try:
+            self.client.request("task_renew", task_id=task_id,
+                                worker=self.instance_id, progress=progress)
+        except (ConnectionError, OSError, RuntimeError):
+            pass
+
+    def _report_fail(self, task_id: str, error: str,
+                     cancelled: bool = False) -> None:
+        try:
+            self.client.request("task_fail", task_id=task_id,
+                                worker=self.instance_id, error=error,
+                                cancelled=cancelled)
+        except (ConnectionError, OSError, RuntimeError):
+            log.warning("could not report failure for %s (lease will "
+                        "expire)", task_id)

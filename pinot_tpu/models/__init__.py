@@ -6,6 +6,8 @@ Schema.java, and config/table/TableConfig.java.
 from pinot_tpu.models.field_spec import DataType, FieldType, FieldSpec
 from pinot_tpu.models.schema import Schema
 from pinot_tpu.models.table_config import (
+    base_table_name,
+    split_physical_table_name,
     TableConfig,
     TableType,
     IndexingConfig,
@@ -35,4 +37,6 @@ __all__ = [
     "RoutingConfig",
     "QueryConfig",
     "RetentionConfig",
+    "base_table_name",
+    "split_physical_table_name",
 ]

@@ -254,6 +254,21 @@ class RetentionConfig:
                    time_column=d.get("timeColumnName"))
 
 
+def split_physical_table_name(table: str):
+    """(logical name, 'OFFLINE' | 'REALTIME' | None) for a possibly
+    type-suffixed table name — the one shared strip so the many callers
+    (routing, quotas, caches, task fabric) can't drift."""
+    for suffix in ("_OFFLINE", "_REALTIME"):
+        if table.endswith(suffix):
+            return table[: -len(suffix)], suffix[1:]
+    return table, None
+
+
+def base_table_name(table: str) -> str:
+    """Logical name with any _OFFLINE/_REALTIME suffix stripped."""
+    return split_physical_table_name(table)[0]
+
+
 @dataclass
 class TableConfig:
     """Ref: spi/config/table/TableConfig.java:38."""

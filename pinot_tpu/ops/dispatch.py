@@ -110,6 +110,22 @@ def _pow2(n: int) -> int:
     return v
 
 
+def split_packed(arr: np.ndarray, n: int) -> List[np.ndarray]:
+    """Zero-copy per-member split of a batched result fetch (ROADMAP
+    item): the N coalesced callers receive VIEWS into the ONE packed
+    device->host array (basic indexing on the leading query axis), not N
+    host-side copies — the fetch pool materializes each launch's bytes
+    exactly once regardless of batch size. Padding members (replicated
+    leader params past `n`) are simply never viewed. The view guarantee
+    is asserted here because a silent regression to copies would
+    multiply fetch-pool memory traffic by the batch size with no
+    functional symptom."""
+    members = [arr[i] for i in range(n)]
+    assert all(m.base is not None and np.shares_memory(m, arr)
+               for m in members), "batched split must return views"
+    return members
+
+
 @functools.lru_cache(maxsize=256)
 def compiled_batched_kernel(plan, B: int):
     """One jit per (plan, batch-size bucket B): vmap of the single-query
@@ -469,8 +485,8 @@ class KernelDispatcher:
         try:
             arr = np.asarray(out)
             if batched:
-                for i, it in enumerate(live):
-                    it.future.set_result(arr[i])
+                for member, it in zip(split_packed(arr, len(live)), live):
+                    it.future.set_result(member)
             else:
                 live[0].future.set_result(arr)
         except BaseException as e:  # noqa: BLE001

@@ -238,6 +238,30 @@ def main(argv=None) -> int:
                      help="instance .properties file (PinotConfiguration)")
     scs.set_defaults(fn=cmd_start_cache_server)
 
+    sm = sub.add_parser("StartMinion", help="background-task worker "
+                                            "joined to a controller")
+    sm.add_argument("--instance-id", required=True)
+    sm.add_argument("--coordinator", required=True, help="host:port")
+    sm.add_argument("--task-types", default=None,
+                    help="csv of task types to lease (default: all)")
+    sm.add_argument("--work-dir", default=None,
+                    help="sandbox dir for task builds (default: tempdir)")
+    sm.add_argument("--config", default=None,
+                    help="instance .properties file (PinotConfiguration)")
+    sm.set_defaults(fn=cmd_start_minion)
+
+    lt = sub.add_parser("ListTasks", help="list the controller task queue")
+    lt.add_argument("--coordinator", required=True)
+    lt.add_argument("--state", default=None,
+                    help="filter: PENDING|LEASED|RUNNING|COMPLETED|"
+                         "FAILED|CANCELLED")
+    lt.set_defaults(fn=cmd_list_tasks)
+
+    ct = sub.add_parser("CancelTask", help="cancel a queued/running task")
+    ct.add_argument("--coordinator", required=True)
+    ct.add_argument("--task-id", required=True)
+    ct.set_defaults(fn=cmd_cancel_task)
+
     sb = sub.add_parser("StartBroker", help="HTTP broker joined to a "
                                             "controller")
     sb.add_argument("--coordinator", required=True, help="host:port")
@@ -308,6 +332,40 @@ def cmd_start_cache_server(args) -> int:
     from pinot_tpu.utils.config import PinotConfiguration
     run_cache_server(port=args.port,
                      config=PinotConfiguration(getattr(args, "config", None)))
+    return 0
+
+
+def cmd_start_minion(args) -> int:
+    from pinot_tpu.cluster.roles import run_minion
+    from pinot_tpu.utils.config import PinotConfiguration
+    task_types = None
+    if getattr(args, "task_types", None):
+        task_types = [t.strip() for t in args.task_types.split(",")
+                      if t.strip()]
+    run_minion(args.instance_id, args.coordinator, task_types=task_types,
+               work_dir=getattr(args, "work_dir", None),
+               config=PinotConfiguration(getattr(args, "config", None)))
+    return 0
+
+
+def cmd_list_tasks(args) -> int:
+    from pinot_tpu.controller.coordination import CoordinationClient
+    client = CoordinationClient(args.coordinator)
+    r = client.request("task_list", state=getattr(args, "state", None))
+    client.close()
+    print(json.dumps(r["tasks"], indent=2, default=str))
+    return 0
+
+
+def cmd_cancel_task(args) -> int:
+    from pinot_tpu.controller.coordination import CoordinationClient
+    client = CoordinationClient(args.coordinator)
+    r = client.request("task_cancel", task_id=args.task_id)
+    client.close()
+    if not r.get("ok"):
+        print(f"no task {args.task_id}")
+        return 1
+    print(f"task {args.task_id}: {r['state']}")
     return 0
 
 

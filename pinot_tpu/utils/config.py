@@ -110,6 +110,22 @@ KEYS: Dict[str, Any] = {
     "pinot.controller.deep.store.uri": "",
     "pinot.controller.retention.frequency.seconds": 60,
     "pinot.coordination.liveness.ttl.seconds": 15.0,
+    # minion task fabric, controller side (controller/task_manager.py):
+    # lease TTL + heartbeat-renewed leases; an expired lease requeues the
+    # task with capped exponential backoff until max.attempts
+    "pinot.controller.task.lease.seconds": 30.0,
+    "pinot.controller.task.max.attempts": 3,
+    "pinot.controller.task.retry.backoff.seconds": 1.0,
+    "pinot.controller.task.retry.backoff.cap.seconds": 30.0,
+    # cadence of the generator scan + lease-expiry sweep
+    "pinot.controller.task.frequency.seconds": 30.0,
+    "pinot.controller.task.generators.enabled": True,
+    "pinot.controller.task.journal.max.bytes": 1 << 20,
+    # minion task fabric, worker side (minion/worker.py)
+    "pinot.minion.poll.seconds": 1.0,
+    "pinot.minion.heartbeat.seconds": 2.0,
+    "pinot.minion.task.types": "",   # csv; "" = all registered executors
+    "pinot.minion.work.dir": "",     # "" = per-worker tempdir sandbox
 }
 
 
@@ -156,6 +172,13 @@ class PinotConfiguration:
 
     def get_str(self, key: str, default: str = "") -> str:
         return str(self.get(key, default))
+
+    def is_set(self, key: str) -> bool:
+        """True when the key was EXPLICITLY configured (constructor
+        override or properties file) rather than falling through to the
+        env/catalog defaults — harnesses use this to layer their own
+        defaults without clobbering operator choices."""
+        return key in self._overrides or key in self._file
 
     def with_overrides(self, extra: Dict[str, Any]) -> "PinotConfiguration":
         """A derived config: same properties-file contents, overrides

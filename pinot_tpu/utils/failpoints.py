@@ -14,9 +14,16 @@ Sites currently compiled in:
   broker.scatter.before    — before the broker fans a plan entry out
   server.execute.before    — server-side, before a query executes
   server.execute.segment   — per segment in the execution loop
+  server.dispatch.before   — kernel dispatch (ring + inline paths)
   netframe.send            — every framed send (coordination, cache, stream)
   connection.request       — broker->server request, response payload hook
   cache.remote.get         — remote cache-tier GET
+  ingest.realtime.consume  — realtime consume loop
+  ingest.tcp.frame         — TCP stream consumer edge
+  controller.task.assign      — task-fabric lease grant
+  controller.task.lease.renew — task-fabric heartbeat renewal
+  controller.segment.replace  — the atomic minion segment swap
+  minion.task.execute         — worker-side, as task execution starts
 
 Policies are armed per site with deterministic, seeded behavior:
 
@@ -47,6 +54,13 @@ class FailpointError(RuntimeError):
 
 class TornPayloadError(ValueError):
     """Raised by consumers that detect a payload truncated by chaos."""
+
+
+class SimulatedCrash(Exception):
+    """Armed as a site's ``error=`` to emulate a hard process kill: the
+    component that catches it must VANISH silently — no failure report,
+    no cleanup handshake — leaving recovery to lease-expiry / liveness
+    sweeps, exactly as if the process had been SIGKILLed."""
 
 
 class Failpoint:

@@ -273,6 +273,48 @@ class TestDispatchChaos:
         assert not rem and res
 
 
+class TestZeroCopySplit:
+    def test_split_packed_returns_views(self):
+        """ROADMAP item: per-member splits of a batched fetch are VIEWS
+        into the one packed array, never host-side copies."""
+        from pinot_tpu.ops import dispatch
+        arr = np.arange(24.0).reshape(4, 6)
+        members = dispatch.split_packed(arr, 3)
+        assert len(members) == 3
+        for i, m in enumerate(members):
+            assert m.base is not None and np.shares_memory(m, arr)
+            assert np.array_equal(m, arr[i])
+
+    def test_batched_fetch_split_is_zero_copy_end_to_end(self, segs):
+        """Through the REAL coalesced path: spy on split_packed and
+        assert every member handed to a caller future shares memory with
+        the packed fetch (and results stay correct)."""
+        from pinot_tpu.ops import dispatch
+        eng = make_engine()
+        ctxs = [QueryContext.from_sql(
+            f"SELECT SUM(m), COUNT(*) FROM t WHERE d < {k}")
+            for k in range(1, 6)]
+        singles = [agg_values(eng.execute(segs, c)[0]) for c in ctxs]
+        calls = []
+        orig = dispatch.split_packed
+
+        def spy(arr, n):
+            members = orig(arr, n)
+            calls.append((arr, members))
+            return members
+
+        dispatch.split_packed = spy
+        try:
+            got = run_concurrent(eng, segs, ctxs)
+        finally:
+            dispatch.split_packed = orig
+        assert [agg_values(r) for r, _rem in got] == singles
+        assert calls, "no batch formed — the spy never fired"
+        for arr, members in calls:
+            for m in members:
+                assert m.base is not None and np.shares_memory(m, arr)
+
+
 class TestPipelineMetrics:
     def test_dispatch_metrics_populated(self, segs):
         eng = make_engine()

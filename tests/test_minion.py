@@ -1,0 +1,549 @@
+"""Distributed minion task fabric (ISSUE 5).
+
+Covers the lease-based scheduler and the fault-tolerant segment
+lifecycle end to end:
+
+  * queue mechanics — lease/renew/complete, lease expiry requeues
+    EXACTLY once, capped exponential retry backoff, cancel semantics,
+    journal reload resuming PENDING/LEASED tasks after a controller
+    restart
+  * MiniCluster(minions=N) integration — a purge task end to end on the
+    tier-1 smoke path; merge-rollup swaps with cache coherence (broker
+    whole-result + server partial caches miss on the new epoch, negative
+    entries dropped, warmup replays logged plans before the swapped
+    segment serves)
+  * chaos — a minion killed mid-task (minion.task.execute failpoint)
+    re-leases to a second worker and completes with the EXACT segment
+    set a no-chaos run produces; same seed replays identically; a crash
+    between upload and swap resumes from the commit manifest without
+    re-executing
+"""
+import json
+import os
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pinot_tpu.cluster.mini import MiniCluster
+from pinot_tpu.controller.cluster_state import ClusterState, SegmentState
+from pinot_tpu.controller.task_manager import (
+    CANCELLED, COMPLETED, FAILED, LEASED, PENDING, RUNNING,
+    TaskManager, TaskQueue)
+from pinot_tpu.controller.tasks import TaskConfig, task_token
+from pinot_tpu.models import (DataType, FieldSpec, FieldType, Schema,
+                              TableConfig)
+from pinot_tpu.segment.creator import SegmentCreator
+from pinot_tpu.segment.loader import load_segment
+from pinot_tpu.utils.config import PinotConfiguration
+from pinot_tpu.utils.failpoints import (FailpointError, FaultSchedule,
+                                        SimulatedCrash, failpoints)
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoints.clear()
+    yield
+    failpoints.clear()
+
+
+def make_schema():
+    return Schema("ct", [
+        FieldSpec("d", DataType.STRING),
+        FieldSpec("ts", DataType.LONG, FieldType.DATE_TIME),
+        FieldSpec("m", DataType.LONG, FieldType.METRIC),
+    ])
+
+
+def make_config():
+    tc = TableConfig("ct")
+    tc.retention.time_column = "ts"
+    return tc
+
+
+def build_seg(tmp, name, n=60, ts_base=0, seed=0):
+    rng = np.random.default_rng(seed)
+    cols = {"d": [f"k{v}" for v in rng.integers(0, 4, n)],
+            "ts": (ts_base + np.arange(n)).astype(np.int64),
+            "m": rng.integers(0, 100, n).astype(np.int64)}
+    out = str(tmp / name)
+    SegmentCreator(make_config(), make_schema()).build(cols, out, name)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# TaskQueue unit mechanics
+# ---------------------------------------------------------------------------
+
+class TestTaskQueue:
+    def test_lease_lifecycle(self):
+        q = TaskQueue(lease_ttl_s=5.0)
+        e = q.submit(TaskConfig("PurgeTask", "ct_OFFLINE", ["s0"]))
+        assert e.state == PENDING
+        got = q.lease("w0", ["PurgeTask"])
+        assert got is not None and got.task_id == e.task_id
+        assert got.state == LEASED and got.worker == "w0"
+        r = q.renew(e.task_id, "w0", progress="executing")
+        assert r == {"ok": True, "cancelled": False}
+        assert q.get(e.task_id).state == RUNNING
+        assert q.get(e.task_id).progress == "executing"
+        assert q.complete(e.task_id, "w0", {"ok": 1})
+        assert q.get(e.task_id).state == COMPLETED
+
+    def test_lease_filters_task_types(self):
+        q = TaskQueue()
+        q.submit(TaskConfig("MergeRollupTask", "ct_OFFLINE", ["s0"]))
+        assert q.lease("w0", ["PurgeTask"]) is None
+        assert q.lease("w0", ["MergeRollupTask"]) is not None
+
+    def test_foreign_worker_cannot_renew_or_complete(self):
+        q = TaskQueue()
+        e = q.submit(TaskConfig("PurgeTask", "ct_OFFLINE", ["s0"]))
+        q.lease("w0")
+        assert q.renew(e.task_id, "w1") == {"ok": False, "cancelled": False}
+        assert not q.complete(e.task_id, "w1")
+        assert q.get(e.task_id).state == LEASED
+
+    def test_lease_expiry_requeues_exactly_once(self):
+        q = TaskQueue(lease_ttl_s=0.01, backoff_s=0.0, max_attempts=5)
+        e = q.submit(TaskConfig("PurgeTask", "ct_OFFLINE", ["s0"]))
+        q.lease("w0")
+        time.sleep(0.02)
+        assert q.expire_leases() == [e.task_id]
+        cur = q.get(e.task_id)
+        assert cur.state == PENDING and cur.attempts == 1
+        # a second sweep must NOT touch the already-requeued task
+        assert q.expire_leases() == []
+        assert q.get(e.task_id).attempts == 1
+
+    def test_retry_backoff_exponential_and_capped(self):
+        q = TaskQueue(lease_ttl_s=60.0, backoff_s=1.0, backoff_cap_s=3.0,
+                      max_attempts=10)
+        e = q.submit(TaskConfig("PurgeTask", "ct_OFFLINE", ["s0"]))
+        gaps = []
+        for _ in range(4):
+            cur = q.get(e.task_id)
+            cur.not_before = 0.0  # make leasable immediately
+            q.lease("w0")
+            t0 = time.time()
+            q.fail(e.task_id, "w0", "boom")
+            gaps.append(q.get(e.task_id).not_before - t0)
+        # 1, 2, 3 (capped), 3 (capped) within timing slack
+        assert 0.9 <= gaps[0] <= 1.1
+        assert 1.9 <= gaps[1] <= 2.1
+        assert 2.9 <= gaps[2] <= 3.1
+        assert 2.9 <= gaps[3] <= 3.1
+
+    def test_attempts_exhausted_fails_terminally(self):
+        q = TaskQueue(backoff_s=0.0, max_attempts=2)
+        e = q.submit(TaskConfig("PurgeTask", "ct_OFFLINE", ["s0"]))
+        for _ in range(2):
+            q.get(e.task_id).not_before = 0.0
+            assert q.lease("w0") is not None
+            q.fail(e.task_id, "w0", "boom")
+        assert q.get(e.task_id).state == FAILED
+        assert q.lease("w0") is None
+
+    def test_cancel_pending_and_running(self):
+        q = TaskQueue()
+        a = q.submit(TaskConfig("PurgeTask", "ct_OFFLINE", ["a"]))
+        assert q.cancel(a.task_id) == CANCELLED
+        b = q.submit(TaskConfig("PurgeTask", "ct_OFFLINE", ["b"]))
+        q.lease("w0")
+        assert q.cancel(b.task_id) in (LEASED, RUNNING)
+        r = q.renew(b.task_id, "w0")
+        assert r["ok"] and r["cancelled"]  # worker told to abort
+        q.fail(b.task_id, "w0", "aborted", cancelled=True)
+        assert q.get(b.task_id).state == CANCELLED
+
+    def test_journal_reload_resumes_pending_and_leased(self, tmp_path):
+        path = str(tmp_path / "tasks.journal")
+        q = TaskQueue(journal_path=path, lease_ttl_s=0.05, backoff_s=0.0)
+        a = q.submit(TaskConfig("PurgeTask", "ct_OFFLINE", ["a"]))
+        b = q.submit(TaskConfig("MergeRollupTask", "ct_OFFLINE",
+                                ["b1", "b2"]))
+        q.lease("w0", ["MergeRollupTask"])  # b now LEASED
+        q.close()
+        # "restart": a fresh queue over the same journal
+        q2 = TaskQueue(journal_path=path, lease_ttl_s=0.05, backoff_s=0.0)
+        assert q2.get(a.task_id).state == PENDING
+        assert q2.get(b.task_id).state == LEASED
+        # the reloaded lease is still wall-clock honored: expiry requeues
+        time.sleep(0.06)
+        q2.expire_leases()
+        assert q2.get(b.task_id).state == PENDING
+        got = {q2.lease("w1").task_id, q2.lease("w1").task_id}
+        assert got == {a.task_id, b.task_id}
+
+    def test_journal_compaction_bounds_size(self, tmp_path):
+        path = str(tmp_path / "tasks.journal")
+        q = TaskQueue(journal_path=path, journal_max_bytes=4096,
+                      max_done=4)
+        for i in range(40):
+            e = q.submit(TaskConfig("PurgeTask", "ct_OFFLINE", [f"s{i}"]))
+            q.lease("w0")
+            q.complete(e.task_id, "w0")
+        assert os.path.getsize(path) <= 4096 * 4  # compacted, not unbounded
+        q2 = TaskQueue(journal_path=path)
+        assert len(q2) >= 1  # reload still parses
+
+
+# ---------------------------------------------------------------------------
+# Generator cadence
+# ---------------------------------------------------------------------------
+
+class TestGeneratorCadence:
+    def test_generator_feeds_queue_with_dedupe(self, tmp_path):
+        state = ClusterState()
+        cfg = make_config()
+        cfg.task_configs = {"MergeRollupTask": {}}
+        state.add_table(cfg, make_schema())
+        for i in range(3):
+            d = build_seg(tmp_path, f"g{i}", n=50, ts_base=i * 100, seed=i)
+            m = load_segment(d).metadata
+            state.upsert_segment(SegmentState(
+                f"g{i}", "ct_OFFLINE", [], dir_path=d, num_docs=50,
+                start_time=m.start_time, end_time=m.end_time))
+        tm = TaskManager(state, config=PinotConfiguration(overrides={
+            "pinot.controller.task.generators.enabled": True}))
+        out = tm.run_once()
+        assert out["generated"] == 1
+        assert len(tm.queue.list(PENDING)) == 1
+        # second tick: the active task dedupes regeneration
+        assert tm.run_once()["generated"] == 0
+
+    def test_table_without_task_config_not_scanned(self, tmp_path):
+        state = ClusterState()
+        state.add_table(make_config(), make_schema())  # no task_configs
+        for i in range(3):
+            state.upsert_segment(SegmentState(
+                f"h{i}", "ct_OFFLINE", [], dir_path="/nope", num_docs=50))
+        tm = TaskManager(state, config=PinotConfiguration(overrides={
+            "pinot.controller.task.generators.enabled": True}))
+        assert tm.run_once()["generated"] == 0
+
+
+# ---------------------------------------------------------------------------
+# MiniCluster integration
+# ---------------------------------------------------------------------------
+
+def _mini_cluster(tmp_path, n_segments=2, minions=1, chaos=None,
+                  result_cache=False, num_servers=2, seg_docs=60,
+                  config=None):
+    c = MiniCluster(num_servers=num_servers, minions=minions, chaos=chaos,
+                    result_cache=result_cache, config=config)
+    c.start()
+    c.add_table("ct", time_column="ts", table_config=make_config(),
+                schema=make_schema())
+    names = []
+    for i in range(n_segments):
+        d = build_seg(tmp_path, f"seg_{i}", n=seg_docs, ts_base=i * 1000,
+                      seed=i)
+        c.add_segment("ct", load_segment(d), server_idx=i % num_servers)
+        names.append(f"seg_{i}")
+    return c, names
+
+
+class TestMiniClusterFabric:
+    def test_purge_task_end_to_end_smoke(self, tmp_path):
+        """Tier-1 smoke path: MiniCluster(minions=1) runs one purge task
+        end to end — lease over real TCP, sandboxed execute, deep-store
+        upload, atomic swap, epoch move."""
+        c, _names = _mini_cluster(tmp_path, n_segments=1, minions=1)
+        try:
+            before = c.query("SELECT COUNT(*) FROM ct")
+            assert before.rows[0][0] == 60
+            epoch0 = c.routing.get_route("ct").epoch()
+            e = c.submit_task(TaskConfig(
+                "PurgeTask", "ct_OFFLINE", ["seg_0"],
+                {"purgePredicate": "ts < 30"}))
+            done = c.wait_task(e["task_id"], timeout_s=30)
+            assert done["state"] == COMPLETED, done
+            assert done["result"]["purgedSegments"] == ["seg_0_purged"]
+            after = c.query("SELECT COUNT(*), MIN(ts) FROM ct")
+            assert after.rows[0] == (30, 30.0)
+            rt = c.routing.get_route("ct")
+            assert sorted(rt.offline.segments) == ["seg_0_purged"]
+            assert rt.epoch() != epoch0  # swap moved the routing epoch
+            # the worker's sandbox is cleaned after the commit (the
+            # COMPLETED transition lands server-side just before the
+            # worker's local cleanup, so poll briefly)
+            sandbox = os.path.join(c.minions[0].work_dir, e["task_id"])
+            deadline = time.time() + 5
+            while os.path.exists(sandbox) and time.time() < deadline:
+                time.sleep(0.02)
+            assert not os.path.exists(sandbox)
+        finally:
+            c.stop()
+
+    def test_merge_rollup_swap_cache_coherence(self, tmp_path):
+        """After a minion merge-rollup swap: broker whole-result cache
+        misses on the new epoch, server partial caches miss for the new
+        segment, negative entries for the table are DROPPED, and warmup
+        replays logged plans before the swapped segment serves."""
+        c, names = _mini_cluster(tmp_path, n_segments=2, minions=1,
+                                 result_cache=True)
+        try:
+            sql = "SELECT COUNT(*), SUM(m) FROM ct"
+            r1 = c.query(sql)
+            r2 = c.query(sql)
+            assert r2.cache_hit is True and r2.rows == r1.rows
+            # seed a negative entry: partition metadata prunes the plan
+            # to zero (EQ on a partition value no segment holds)
+            rt = c.routing.get_route("ct")
+            for info in rt.offline.segments.values():
+                info.partition_column = "ts"
+                info.num_partitions = 4
+                info.partition_id = 0
+            neg = c.broker._negative_cache
+            pruned = "SELECT COUNT(*) FROM ct WHERE ts = 3"  # 3 % 4 != 0
+            c.query(pruned)
+            assert len(neg) == 1
+            # server partial caches + warmup fingerprint log are primed
+            warm0 = [s.executor.warmup.entries_warmed for s in c.servers]
+            e = c.submit_task(TaskConfig("MergeRollupTask", "ct_OFFLINE",
+                                         names))
+            done = c.wait_task(e["task_id"], timeout_s=30)
+            assert done["state"] == COMPLETED, done
+            # negative entries for the table were dropped at the swap
+            assert len(neg) == 0
+            # warmup replayed the logged plan on the NEW segment before
+            # it was routed (both servers held inputs, both warm)
+            warm1 = [s.executor.warmup.entries_warmed for s in c.servers]
+            assert sum(warm1) > sum(warm0)
+            # whole-result cache: the old-epoch entry is unaddressable —
+            # the next query re-executes and STILL matches
+            r3 = c.query(sql)
+            assert r3.cache_hit is False
+            assert r3.rows == r1.rows
+            r4 = c.query(sql)
+            assert r4.cache_hit is True  # new-epoch entry now cached
+        finally:
+            c.stop()
+
+    def test_task_failure_retries_then_fails_terminally(self, tmp_path):
+        c, _names = _mini_cluster(tmp_path, n_segments=1, minions=1)
+        try:
+            # an executor-level error (bad predicate) fails every attempt
+            e = c.submit_task(TaskConfig(
+                "PurgeTask", "ct_OFFLINE", ["seg_0"],
+                {"purgePredicate": "nonexistent_column < 30"}))
+            done = c.wait_task(e["task_id"], timeout_s=30)
+            assert done["state"] == FAILED
+            assert done["attempts"] == done["max_attempts"]
+            # inputs untouched by the failed task
+            assert c.query("SELECT COUNT(*) FROM ct").rows[0][0] == 60
+        finally:
+            c.stop()
+
+    def test_cancel_pending_task_via_queue(self, tmp_path):
+        # the worker only leases merge tasks, so a purge task stays
+        # PENDING until cancelled — exercising declared-task-type
+        # filtering and the cancel path in one setup
+        c, _names = _mini_cluster(
+            tmp_path, n_segments=1, minions=1,
+            config=PinotConfiguration(overrides={
+                "pinot.minion.task.types": "MergeRollupTask"}))
+        try:
+            e = c.submit_task(TaskConfig(
+                "PurgeTask", "ct_OFFLINE", ["seg_0"],
+                {"purgePredicate": "ts < 30"}))
+            time.sleep(0.2)  # give the (filtered) worker poll a chance
+            assert c.task(e["task_id"])["state"] == PENDING
+            assert c.task_manager.queue.cancel(e["task_id"]) == CANCELLED
+            assert c.task(e["task_id"])["state"] == CANCELLED
+        finally:
+            c.stop()
+
+
+# ---------------------------------------------------------------------------
+# Chaos: the fault-tolerant lifecycle under deterministic failures
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+class TestFabricChaos:
+    def _run_merge(self, tmp_path, tag, chaos=None, minions=2):
+        (tmp_path / tag).mkdir(exist_ok=True)
+        c, names = _mini_cluster(tmp_path / tag, n_segments=3,
+                                 minions=minions, chaos=chaos)
+        try:
+            # pinned task id: output names derive from (inputs, task_id),
+            # so runs are comparable segment-for-segment
+            e = c.submit_task(TaskConfig("MergeRollupTask", "ct_OFFLINE",
+                                         names, task_id="Task_merge_acc"))
+            done = c.wait_task(e["task_id"], timeout_s=60)
+            rows = c.query("SELECT COUNT(*), SUM(m) FROM ct").rows
+            rt = c.routing.get_route("ct")
+            segs = sorted(rt.offline.segments)
+            state_segs = sorted(
+                s.name for s in c.cluster_state.table_segments("ct_OFFLINE"))
+            crashed = [w.instance_id for w in c.minions if w.crashed]
+            workers = {w.instance_id: w.executed for w in c.minions}
+            return {"state": done["state"], "rows": rows, "segs": segs,
+                    "state_segs": state_segs, "crashed": crashed,
+                    "workers": workers,
+                    "decisions": (c.chaos.decisions()
+                                  if c.chaos is not None else None)}
+        finally:
+            c.stop()
+
+    def test_worker_killed_mid_task_releases_and_completes(self, tmp_path):
+        """ISSUE 5 acceptance: a seeded-chaos kill of the first worker to
+        lease the task; the lease expires, a second worker re-leases and
+        completes with the EXACT segment set of a no-chaos run — no
+        duplicated, lost, or stale segments — and the same seed replays
+        identically."""
+        tmp_path.mkdir(exist_ok=True)
+        baseline = self._run_merge(tmp_path, "nochaos", chaos=None)
+        assert baseline["state"] == COMPLETED
+
+        def schedule():
+            return FaultSchedule([
+                ("minion.task.execute",
+                 {"error": SimulatedCrash("chaos kill"), "times": 1,
+                  "seed": 7})])
+
+        a = self._run_merge(tmp_path, "chaos_a", chaos=schedule())
+        b = self._run_merge(tmp_path, "chaos_b", chaos=schedule())
+        for run in (a, b):
+            assert run["state"] == COMPLETED
+            assert len(run["crashed"]) == 1  # exactly one worker died
+            # the SURVIVOR executed it (the corpse never reported back)
+            survivor = [w for w in run["workers"]
+                        if w not in run["crashed"]][0]
+            assert run["workers"][survivor] == 1
+            # exact same segment set + answers as the no-chaos run
+            assert run["segs"] == baseline["segs"]
+            assert run["state_segs"] == baseline["state_segs"]
+            assert run["rows"] == baseline["rows"]
+        # deterministic replay: same seed, same decision log
+        assert a["decisions"] == b["decisions"]
+        assert a["segs"] == b["segs"]
+
+    def test_crash_between_upload_and_swap_is_idempotent(self, tmp_path):
+        """The commit manifest makes crash-mid-commit idempotent: the
+        swap request dies once AFTER outputs + manifest are durable; the
+        re-leased attempt detects the manifest, skips re-execution, and
+        replays only the swap."""
+        c, names = _mini_cluster(tmp_path, n_segments=2, minions=1)
+        try:
+            failpoints.arm("controller.segment.replace",
+                           error=FailpointError("controller crash"),
+                           times=1)
+            e = c.submit_task(TaskConfig("MergeRollupTask", "ct_OFFLINE",
+                                         names))
+            done = c.wait_task(e["task_id"], timeout_s=60)
+            assert done["state"] == COMPLETED, done
+            w = c.minions[0]
+            assert w.executed == 1          # never re-executed
+            assert w.manifest_resumes == 1  # resumed from the manifest
+            rt = c.routing.get_route("ct")
+            token = task_token(TaskConfig("MergeRollupTask", "ct_OFFLINE",
+                                          names, task_id=e["task_id"]))
+            assert sorted(rt.offline.segments) == [f"ct_merged_{token}"]
+            assert c.query("SELECT COUNT(*) FROM ct").rows[0][0] == 120
+        finally:
+            c.stop()
+
+    def test_lease_renew_chaos_does_not_lose_tasks(self, tmp_path):
+        """Heartbeat frames dropped by chaos: the worker keeps running
+        (the lease TTL absorbs missed renewals) and the task completes."""
+        sched = FaultSchedule([
+            ("controller.task.lease.renew",
+             {"error": ConnectionError("renew chaos"), "times": 2,
+              "seed": 3})])
+        c, names = _mini_cluster(tmp_path, n_segments=1, minions=1,
+                                 chaos=sched)
+        try:
+            e = c.submit_task(TaskConfig(
+                "PurgeTask", "ct_OFFLINE", ["seg_0"],
+                {"purgePredicate": "ts < 10"}))
+            done = c.wait_task(e["task_id"], timeout_s=30)
+            assert done["state"] == COMPLETED, done
+            assert c.query("SELECT COUNT(*) FROM ct").rows[0][0] == 50
+        finally:
+            c.stop()
+
+
+class TestNoDeepStoreDeployment:
+    def test_sandbox_preserved_when_outputs_live_locally(self, tmp_path):
+        """Single-box deployment (no deep store): the sandbox IS the
+        committed segments' home — the worker must NOT clean it up, and
+        the registered dir_path must stay loadable."""
+        from pinot_tpu.controller.coordination import CoordinationServer
+        from pinot_tpu.minion.worker import MinionWorker
+        state = ClusterState()
+        state.add_table(make_config(), make_schema())
+        d = build_seg(tmp_path, "seg_0", n=40)
+        m = load_segment(d).metadata
+        state.upsert_segment(SegmentState(
+            "seg_0", "ct_OFFLINE", [], dir_path=d, num_docs=40,
+            start_time=m.start_time, end_time=m.end_time))
+        conf = PinotConfiguration(overrides={
+            "pinot.minion.poll.seconds": 0.05,
+            "pinot.minion.heartbeat.seconds": 0.2})
+        tm = TaskManager(state, config=conf)
+        srv = CoordinationServer(state, task_manager=tm)  # NO deep store
+        srv.start()
+        w = MinionWorker("m0", srv.address,
+                         work_dir=str(tmp_path / "w0"), config=conf)
+        w.start()
+        try:
+            e = tm.submit(TaskConfig(
+                "PurgeTask", "ct_OFFLINE", ["seg_0"],
+                {"purgePredicate": "ts < 10"}))
+            deadline = time.time() + 20
+            while time.time() < deadline:
+                if tm.queue.get(e.task_id).state == COMPLETED:
+                    break
+                time.sleep(0.05)
+            assert tm.queue.get(e.task_id).state == COMPLETED
+            (st,) = state.table_segments("ct_OFFLINE")
+            assert st.name == "seg_0_purged"
+            # the local build dir survived the commit and still loads
+            assert os.path.isdir(st.dir_path)
+            assert load_segment(st.dir_path).num_docs == 30
+        finally:
+            w.stop()
+            srv.stop()
+            tm.stop()
+
+
+# ---------------------------------------------------------------------------
+# Controller HTTP surface
+# ---------------------------------------------------------------------------
+
+class TestTaskHttpApi:
+    def test_task_routes(self, tmp_path):
+        from pinot_tpu.controller.http_api import ControllerHttpServer
+        state = ClusterState()
+        state.add_table(make_config(), make_schema())
+        tm = TaskManager(state, config=PinotConfiguration())
+        srv = ControllerHttpServer(state, task_manager=tm)
+        srv.start()
+        base = f"http://{srv.host}:{srv.port}"
+
+        def call(method, path, body=None):
+            data = json.dumps(body).encode() if body is not None else None
+            req = urllib.request.Request(base + path, data=data,
+                                         method=method)
+            with urllib.request.urlopen(req, timeout=10) as r:
+                return json.loads(r.read())
+
+        try:
+            out = call("POST", "/tasks", {
+                "taskType": "PurgeTask", "table": "ct_OFFLINE",
+                "segments": ["s0"], "params": {"purgePredicate": "ts < 1"}})
+            tid = out["task"]["task_id"]
+            assert out["task"]["state"] == PENDING
+            assert [t["task_id"] for t in
+                    call("GET", "/tasks")["tasks"]] == [tid]
+            assert call("GET", "/tasks?state=PENDING")["tasks"]
+            assert call("GET", "/tasks?state=COMPLETED")["tasks"] == []
+            assert call("GET", f"/tasks/{tid}")["task"]["task_id"] == tid
+            assert call("POST", f"/tasks/{tid}/cancel")["state"] \
+                == CANCELLED
+        finally:
+            srv.stop()
+            tm.stop()

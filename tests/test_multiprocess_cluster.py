@@ -168,6 +168,113 @@ def _coord_up(address: str) -> bool:
         c.close()
 
 
+def test_minion_process_runs_merge_task(tmp_path):
+    """The fourth role as a real OS process: a minion leases a
+    merge-rollup task from the controller's queue over the coordination
+    channel, builds the merged segment in its sandbox, uploads it to the
+    deep store, and commits via the atomic segment replace — after which
+    the server reconciles (unloads the inputs, downloads + loads the
+    merged segment) and the broker keeps answering identically."""
+    coord_port = _free_port()
+    http_port = _free_port()
+    coordinator = f"127.0.0.1:{coord_port}"
+    procs = {}
+    try:
+        procs["controller"] = _spawn(
+            ["StartController", "--state-dir", str(tmp_path / "state"),
+             "--port", str(coord_port),
+             "--deep-store", f"file://{tmp_path}/store"])
+        _wait(lambda: _coord_up(coordinator), desc="controller up")
+        procs["server"] = _spawn(
+            ["StartServer", "--instance-id", "s0",
+             "--coordinator", coordinator])
+        procs["broker"] = _spawn(
+            ["StartBroker", "--coordinator", coordinator,
+             "--http-port", str(http_port)])
+        procs["minion"] = _spawn(
+            ["StartMinion", "--instance-id", "m0",
+             "--coordinator", coordinator])
+
+        client = CoordinationClient(coordinator)
+        # the server registers as assignable; the minion registers
+        # tagged and must NOT receive segments
+        _wait(lambda: len(client.get_state()["instances"]) == 2,
+              desc="server + minion registered")
+
+        from pinot_tpu.segment.fs import SegmentDeepStore
+        schema = Schema("mt", [
+            FieldSpec("id", DataType.INT, FieldType.DIMENSION),
+            FieldSpec("v", DataType.INT, FieldType.METRIC)])
+        cfg = TableConfig(name="mt")
+        client.add_table(cfg, schema)
+        store = SegmentDeepStore(str(tmp_path / "store"))
+        total = 0
+        vsum = 0
+        for i in range(2):
+            n = 5000
+            ids = np.arange(n, dtype=np.int64) + i * n
+            vals = (ids * 3).astype(np.int64)
+            total += n
+            vsum += int(vals.sum())
+            out = str(tmp_path / f"seg_{i}")
+            SegmentCreator(cfg, schema).build(
+                {"id": ids, "v": vals}, out, f"mt_{i}")
+            r = client.upload_segment_to_store("mt", out, store)
+            assert r["segment"]["instances"] == ["s0"]
+
+        sql = "SELECT COUNT(*), SUM(v) FROM mt"
+        expect = [total, float(vsum)]
+
+        def answered():
+            resp = _post_query(http_port, sql)
+            rows = (resp.get("resultTable") or {}).get("rows")
+            return bool(rows) and rows[0] == expect and \
+                not resp.get("exceptions")
+        _wait(answered, desc="broker answers before the merge")
+
+        r = client.request("task_submit", task={
+            "taskType": "MergeRollupTask", "table": "mt_OFFLINE",
+            "segments": ["mt_0", "mt_1"]})
+        task_id = r["task"]["task_id"]
+
+        def task_done():
+            t = client.request("task_get", task_id=task_id)["task"]
+            assert t["state"] not in ("FAILED", "CANCELLED"), t
+            return t["state"] == "COMPLETED"
+        _wait(task_done, timeout=60, desc="minion completed the merge")
+
+        segs = client.get_state()["segments"]["mt_OFFLINE"]
+        assert len(segs) == 1
+        (name, st), = segs.items()
+        assert name.startswith("mt_merged_")
+        assert st["num_docs"] == total
+        assert st["dir_path"].startswith("file://")
+
+        # the swap reconciles through the watch machinery: the server
+        # downloads the merged segment, unloads the inputs, and the
+        # broker's rebuilt route answers identically
+        def still_answers():
+            resp = _post_query(http_port, sql)
+            rows = (resp.get("resultTable") or {}).get("rows")
+            return bool(rows) and rows[0] == expect and \
+                not resp.get("exceptions") \
+                and resp.get("numSegmentsProcessed") == 1
+        _wait(still_answers, timeout=60,
+              desc="merged segment serves after the swap")
+    finally:
+        for name, proc in procs.items():
+            if proc.poll() is None:
+                proc.terminate()
+        for name, proc in procs.items():
+            try:
+                out, _ = proc.communicate(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                out, _ = proc.communicate()
+            if out:
+                print(f"--- {name} ---\n{out[-2000:]}")
+
+
 def test_server_restart_recovers_from_deep_store(tmp_path):
     """Segments live in the deep store (PinotFS URI), not a shared build
     dir: a restarted server re-downloads and serves them — killing a
