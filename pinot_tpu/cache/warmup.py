@@ -5,9 +5,11 @@ start cold. Every time the server caches a tier-2 partial it also logs
 (table, plan fingerprint, canonical SQL) into a per-table recency log;
 when a new immutable segment arrives, the warmup pass replays the logged
 plans against JUST that segment — populating the segment cache (and,
-through a tiered backend, the shared remote tier) — BEFORE the segment is
+through a tiered backend, the shared remote tier) AND proactively staging
+the plans' columns into device HBM residency (ops/residency.py, under the
+seeding context so admission favors them) — BEFORE the segment is
 published for queries. The first routed query then hits tier 2 instead of
-scanning.
+scanning, and even a cache-missing literal variant runs device-resident.
 
 The log stores the SQL, not a parsed context: QueryContext is cheap to
 rebuild, and SQL is the only representation that round-trips the plan
@@ -207,16 +209,32 @@ class SegmentWarmup:
                 merge_extra_filter(ctx, extra_filter)
                 if not is_cacheable_shape(ctx):
                     continue
+                engine = self._engine_fn() if self._engine_fn else None
                 if self.segment_cache.get(segment, fingerprint) is not None:
                     # already warm — an L2 hit here ALSO back-filled L1,
-                    # which is exactly the rollout warmup we want
+                    # which is exactly the rollout warmup we want. The
+                    # DEVICE tier still starts cold on a result-cache
+                    # hit, so stage the plan's columns into HBM anyway:
+                    # literals drift, caches expire, and the resident
+                    # columns are what survive both
                     warmed += 1
+                    if engine is not None:
+                        with engine.residency_seeding():
+                            engine.prestage([segment], ctx)
                     continue
-                engine = self._engine_fn() if self._engine_fn else None
                 ex = QueryExecutor([segment], use_tpu=self.use_tpu,
                                    engine=engine,
                                    segment_cache=self.segment_cache)
-                ex.execute_context(ctx)
+                if engine is not None:
+                    # replayed plans ARE the FingerprintLog's evidence of
+                    # per-segment plan traffic: staging done under the
+                    # seeding context admits the columns into HBM
+                    # residency with the frequency seed, so the fresh
+                    # segment's first routed queries run device-resident
+                    with engine.residency_seeding():
+                        ex.execute_context(ctx)
+                else:
+                    ex.execute_context(ctx)
                 if self.segment_cache.get(segment, fingerprint) is not None:
                     warmed += 1
             except Exception:  # noqa: BLE001 — warmup must never block load

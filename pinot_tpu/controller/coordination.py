@@ -219,6 +219,14 @@ class CoordinationServer:
         """Minion task-fabric ops (ref the Helix Task Framework RPCs +
         the controller task REST resources)."""
         from pinot_tpu.controller.tasks import TaskConfig
+        # any worker-attributed RPC proves the worker is alive: a minion
+        # blocked inside a long task never reaches its poll-loop
+        # heartbeat, but its lease renewals land every few seconds —
+        # without this, the liveness sweep disables (and /instances
+        # reports stale) exactly the workers doing the most work
+        worker = req.get("worker")
+        if worker:
+            self._last_seen[worker] = time.time()
         tm = self.task_manager
         if tm is None:
             raise ValueError("no task manager on this controller")
@@ -261,6 +269,15 @@ class CoordinationServer:
     #: instances silent for this long are disabled (heartbeats come every
     #: ~2s from run_server) so new segments stop landing on corpses
     LIVENESS_TTL_S = 15.0
+
+    def heartbeat_ages(self) -> Dict[str, float]:
+        """Seconds since each instance's last heartbeat/registration —
+        the fleet-health sweep the controller REST /instances exposes
+        (live/stale tagging for servers AND minion workers)."""
+        now = time.time()
+        with self._lock:
+            return {iid: now - seen
+                    for iid, seen in self._last_seen.items()}
 
     def _sweep_liveness(self) -> None:
         now = time.time()

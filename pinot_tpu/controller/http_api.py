@@ -13,7 +13,10 @@ is not).
   DELETE /tables/{name}
   GET    /tables/{name}/segments      -> per-physical-table segment states
   POST   /tables/{name}/segments      <- {"segDir": path, "tableType": ...}
-  GET    /instances
+  GET    /instances                   -> per-instance record + liveness
+                                         (lastHeartbeatAgeSeconds,
+                                          live|stale|unknown — servers
+                                          and minion workers alike)
   GET    /tasks[?state=PENDING]       -> task-fabric queue entries
   GET    /tasks/{id}                  -> one task's lifecycle record
   POST   /tasks                       <- {"taskType", "table", "segments",
@@ -107,6 +110,24 @@ class ControllerHttpServer:
                     with api.state._lock:
                         insts = {k: vars(v).copy() for k, v in
                                  api.state.instances.items()}
+                    # fleet-health sweep: every instance that heartbeats
+                    # (servers, brokers, minion workers alike) reports
+                    # its last-heartbeat age and a live/stale tag; an
+                    # instance with no recorded heartbeat (static
+                    # wiring, no coordination) reads "unknown"
+                    ages = (api.coordination.heartbeat_ages()
+                            if api.coordination is not None else {})
+                    ttl = (api.coordination.LIVENESS_TTL_S
+                           if api.coordination is not None else 15.0)
+                    for iid, blob in insts.items():
+                        age = ages.get(iid)
+                        if age is None:
+                            blob["lastHeartbeatAgeSeconds"] = None
+                            blob["liveness"] = "unknown"
+                        else:
+                            blob["lastHeartbeatAgeSeconds"] = round(age, 3)
+                            blob["liveness"] = ("live" if age <= ttl
+                                                else "stale")
                     return self._reply(200, {"instances": insts})
                 m = re.fullmatch(r"/tables/([^/]+)", path)
                 if m:

@@ -144,7 +144,19 @@ class MinionWorker:
 
     # ------------------------------------------------------------------
     def _loop(self) -> None:
+        last_hb = 0.0
         while not self._stop.is_set():
+            # instance-level liveness heartbeat (distinct from per-task
+            # lease renewal): the controller's sweep and the REST
+            # /instances fleet-health view tag this worker live/stale
+            # from it, so even an idle minion keeps reporting
+            if time.monotonic() - last_hb >= self.heartbeat_s:
+                try:
+                    self.client.request("heartbeat",
+                                        instance_id=self.instance_id)
+                    last_hb = time.monotonic()
+                except (ConnectionError, OSError, RuntimeError):
+                    pass
             try:
                 r = self.client.request("task_lease",
                                         worker=self.instance_id,

@@ -67,10 +67,12 @@ _CPU_COLLECTIVE_LOCK = threading.Lock()
 _LAUNCH_THREADS = 8
 _FETCH_THREADS = 4
 _STAGING_THREADS = 4
+_UPLOAD_THREADS = 4
 _pools_lock = threading.Lock()
 _launch_pool: Optional[ThreadPoolExecutor] = None
 _fetch_pool: Optional[ThreadPoolExecutor] = None
 _staging_pool: Optional[ThreadPoolExecutor] = None
+_upload_pool: Optional[ThreadPoolExecutor] = None
 
 
 def launch_pool() -> ThreadPoolExecutor:
@@ -101,6 +103,23 @@ def staging_pool() -> ThreadPoolExecutor:
                 max_workers=_STAGING_THREADS,
                 thread_name_prefix="kernel-staging")
         return _staging_pool
+
+
+def upload_pool() -> ThreadPoolExecutor:
+    """Residency row uploads (host->device device_put) fan out here so a
+    multi-row miss double-buffers: row N+1's copy engines run while row
+    N's transfer is in flight, and — because staging itself runs on the
+    staging pool under execute_async — the whole upload burst overlaps
+    the previous query's device round trip. A DEDICATED pool: staging
+    tasks submit these and wait, so sharing the staging pool would
+    deadlock once its workers are all waiting on their own subtasks."""
+    global _upload_pool
+    with _pools_lock:
+        if _upload_pool is None:
+            _upload_pool = ThreadPoolExecutor(
+                max_workers=_UPLOAD_THREADS,
+                thread_name_prefix="residency-upload")
+        return _upload_pool
 
 
 def _pow2(n: int) -> int:

@@ -90,13 +90,18 @@ class TpuOperatorExecutor:
             if len(self.devices) > 1:
                 from jax.sharding import Mesh
                 self._mesh = Mesh(np.array(self.devices), ("segments",))
-        #: device-resident column blocks, LRU-evicted under a byte budget
-        #: (HBM segment cache, SURVEY.md §7.5); keys carry the segment
-        #: batch identity (id+name pairs guard against id() reuse)
+        #: ASSEMBLED device blocks, LRU-evicted under a byte budget: the
+        #: exact [S, D] arrays kernels consume, keyed by the segment
+        #: batch identity (id+name pairs guard against id() reuse). A
+        #: miss here no longer pays the host link — blocks assemble
+        #: on-device from the per-(segment, column) residency tier below
         from collections import OrderedDict
         self._block_cache: "OrderedDict[tuple, Any]" = OrderedDict()
         self._block_bytes: Dict[tuple, int] = {}
         self._cache_bytes = 0
+        #: live block count per batch identity — O(1) detection of "this
+        #: batch's LAST block just left", which triggers the params purge
+        self._batch_blocks: Dict[tuple, int] = {}
         #: host-side padded rows per (segment, column): rebuilding a new
         #: batch skips segment re-read/decode; LRU-evicted under its own
         #: byte budget (entries pin their segment, so eviction also
@@ -114,6 +119,24 @@ class TpuOperatorExecutor:
         self.cache_budget_bytes = int(_os.environ.get(
             "PINOT_TPU_HBM_CACHE_BYTES",
             _cfg.get_int("pinot.server.hbm.cache.bytes")))
+        #: per-(segment, column) device-resident rows (ops/residency.py):
+        #: the tier that survives batch recomposition — a changed pruned
+        #: subset or a newly sealed segment uploads only rows the device
+        #: has never seen; everything else assembles on-device
+        from pinot_tpu.ops.residency import ResidencyManager
+        resident_bytes = int(_os.environ.get(
+            "PINOT_TPU_HBM_RESIDENT_BYTES",
+            _cfg.get_int("pinot.server.hbm.resident.bytes")))
+        if not _cfg.get_bool("pinot.server.hbm.resident.enabled", True):
+            resident_bytes = 0
+        self._metrics = None  # set after the dispatcher below
+        self._labels = metrics_labels
+        self._residency = ResidencyManager(
+            resident_bytes,
+            admission=_cfg.get_bool("pinot.server.hbm.admission.enabled",
+                                    True),
+            sample_window=_cfg.get_int("pinot.server.hbm.admission.sample"),
+            labels=metrics_labels)
         #: staging lock only: cache mutation (plan/stage/evict) serializes,
         #: but kernel dispatch + result fetch run OUTSIDE it so concurrent
         #: queries overlap their device round trips (the host<->TPU link
@@ -135,6 +158,8 @@ class TpuOperatorExecutor:
         #: stays under the engine lock, launches ride the ring
         self._dispatcher = KernelDispatcher(config=_cfg,
                                             labels=metrics_labels)
+        self._metrics = self._dispatcher._metrics
+        self._residency._metrics = self._metrics
 
     # ------------------------------------------------------------------
     # capability check (structural)
@@ -144,6 +169,11 @@ class TpuOperatorExecutor:
 
     #: LRU capacity of the predicate-parameter cache (entries are tiny)
     PARAMS_CACHE_ENTRIES = 4096
+
+    #: residency miss bursts at/above this many bytes upload in parallel
+    #: on the upload pool (below it, thread handoff costs more than the
+    #: copies themselves)
+    UPLOAD_FANOUT_BYTES = 16 << 20
 
     def supports(self, ctx: QueryContext) -> bool:
         if ctx.distinct:
@@ -942,21 +972,10 @@ class TpuOperatorExecutor:
         if plan.group_compact:
             cols["gkey"], G = self._stage_gkey(segments, S, D, plan)
 
-        # histogram sketch slots: bucket bounds from segment metadata
-        # (missing min/max -> host fallback); computed before the params
-        # cache so cache hits still carry them
-        for j, (op, vidx, _fidx) in enumerate(plan.agg_ops):
-            if not op.startswith("hist:"):
-                continue
-            col = plan.value_irs[vidx][1]
-            lo, span = self._hist_bounds(segments, col)
-            B = int(op.split(":")[1])
-            params[f"slot{j}:hlo"] = self._put(np.full(S, lo, dtype=vdt))
-            params[f"slot{j}:hscale"] = self._put(
-                np.full(S, B / span, dtype=vdt))
-
         # per-leaf predicate parameters (cached: filters are frozen
-        # expression trees, so they key the resolved literals exactly)
+        # expression trees, so they key the resolved literals exactly;
+        # the entry also carries hist slot bounds — they depend only on
+        # (segments, plan), so a repeat query uploads NOTHING)
         pkey = (_batch_id(segments), plan, ctx.filter,
                 tuple(ctx.agg_filters), S)
         cached = self._params_cache.get(pkey)
@@ -966,6 +985,17 @@ class TpuOperatorExecutor:
                 self._params_cache.move_to_end(pkey)  # LRU refresh
                 params.update(cparams)
                 return cols, params, cnum_docs, S_real, D, G
+        # histogram sketch slots: bucket bounds from segment metadata
+        # (missing min/max -> host fallback)
+        for j, (op, vidx, _fidx) in enumerate(plan.agg_ops):
+            if not op.startswith("hist:"):
+                continue
+            col = plan.value_irs[vidx][1]
+            lo, span = self._hist_bounds(segments, col)
+            B = int(op.split(":")[1])
+            params[f"slot{j}:hlo"] = self._put(np.full(S, lo, dtype=vdt))
+            params[f"slot{j}:hscale"] = self._put(
+                np.full(S, B / span, dtype=vdt))
         # leaf expressions in the exact order _plan appended leaves:
         # main filter first, then each distinct agg FILTER tree
         leaf_exprs: List[Function] = []
@@ -1052,7 +1082,8 @@ class TpuOperatorExecutor:
         num_docs = np.zeros(S, dtype=np.int32)
         num_docs[:S_real] = [s.num_docs for s in segments]
         num_docs_dev = self._put(num_docs)
-        leaf_params = {k: v for k, v in params.items() if k.startswith("leaf")}
+        leaf_params = {k: v for k, v in params.items()
+                       if k.startswith(("leaf", "slot"))}
         self._params_cache[pkey] = (tuple(segments), leaf_params, num_docs_dev)
         self._params_cache.move_to_end(pkey)
         while len(self._params_cache) > self.PARAMS_CACHE_ENTRIES:
@@ -1066,12 +1097,7 @@ class TpuOperatorExecutor:
         Returns (device block, G = pow2 pad of the max distinct count).
         Host rows cache (codes, decode table) per (segment, group cols)."""
         sig = ",".join(plan.group_cols)
-        bkey = (_batch_id(segments), "gkey", sig, S, D, "i4")
-        rows, tables = [], []
-        for seg in segments:
-            codes, table = self._segment_gkey(seg, plan)
-            rows.append(codes)
-            tables.append(table)
+        tables = [self._segment_gkey(seg, plan)[1] for seg in segments]
         G = _pow2(max(t.shape[0] for t in tables), floor=8)
         # guard BEFORE any upload: an over-cap key space must not pay a
         # useless HBM transfer (and LRU churn) on every repeat query
@@ -1079,16 +1105,13 @@ class TpuOperatorExecutor:
                 or S * G * len(plan.agg_ops) * 8 > MAX_GROUP_RESULT_BYTES:
             raise _NotStageable()
 
-        entry = self._block_cache.get(bkey)
-        if entry is not None and all(a is b
-                                     for a, b in zip(entry[0], segments)):
-            self._block_cache.move_to_end(bkey)
-            return entry[1], G
-        block = np.zeros((S, D), dtype=np.int32)
-        for s, codes in enumerate(rows):
-            block[s, :len(codes)] = codes
-        dev = self._put(block, block=True)
-        self._insert_block(bkey, (tuple(segments), dev), block.nbytes)
+        def fetch_codes(seg):
+            return self._segment_gkey_locked(seg, plan)[0]
+
+        # host_cache=False: the (codes, table) pair is already host-cached
+        # by _segment_gkey; caching the padded row too would double-store
+        dev = self._block(segments, S, D, sig, "gkey", fetch_codes,
+                          np.int32, host_cache=False)
         return dev, G
 
     def _segment_gkey(self, seg, plan: DevicePlan):
@@ -1148,54 +1171,167 @@ class TpuOperatorExecutor:
         return codes, table
 
     def _stacked(self, segments, S, D, col, kind, fetch, dtype):
-        """Stacked per-segment column block, two-level cached:
+        """Stacked per-segment column block, three-level cached:
 
-        * HOST level, per (segment, column): the padded numpy row — so a
-          changed batch (pruning picked a different subset, a new segment
-          sealed) rebuilds without re-reading/re-decoding segments.
-        * DEVICE level, per (batch, column): the stacked [S, D] block that
-          the kernel consumes — steady state is zero transfers and zero
-          stack ops (a per-query device-side stack measured ~4x slower
-          end-to-end over the host<->TPU link).
+        * HOST level, per (segment, column): the padded numpy row (its
+          own pow2 doc bucket) — rebuilding any batch skips segment
+          re-read/re-decode.
+        * RESIDENT level, per (segment, column): the same row in device
+          HBM (ops/residency.py) — a changed batch (pruning picked a
+          different subset, a new segment sealed) uploads ONLY rows the
+          device has never seen, instead of re-shipping every column
+          over the ~100ms link.
+        * ASSEMBLED level, per (batch, column): the [S, D] block the
+          kernel consumes, built ON-DEVICE from resident rows
+          (kernels.compiled_row_assembler) — steady state is zero
+          transfers and zero assembly.
 
-        Entries hold strong segment references and verify identity on hit,
-        so a refreshed segment (same name, new object) can never serve
-        stale blocks — id() is not recycled while an entry pins the old
-        object, and a new object misses the cache.
+        Entries at every level hold strong segment references and verify
+        identity on hit, so a refreshed segment (same name, new object)
+        can never serve stale data — id() is not recycled while an entry
+        pins the old object, and a new object misses.
         """
-        bkey = (_batch_id(segments), kind, col, S, D, np.dtype(dtype).str)
-        entry = self._block_cache.get(bkey)
-        if entry is not None and all(a is b for a, b in zip(entry[0], segments)):
-            self._block_cache.move_to_end(bkey)  # LRU touch
-            return entry[1]
-        rows = []
-        for seg in segments:
-            rkey = (id(seg), kind, col, D, np.dtype(dtype).str)
-            rentry = self._host_rows.get(rkey)
-            if rentry is not None and rentry[0] is seg:
-                self._host_rows.move_to_end(rkey)
-                rows.append(rentry[1])
-                continue
+
+        def fetch_row(seg):
             if not seg.has_column(col):
                 raise _NotStageable()
-            raw = fetch(seg.data_source(col))
-            arr = np.zeros(D, dtype=dtype)
-            arr[:len(raw)] = raw
+            return fetch(seg.data_source(col))
+
+        return self._block(segments, S, D, col, kind, fetch_row, dtype)
+
+    def _block(self, segments, S, D, col, kind, fetch_row, dtype,
+               host_cache: bool = True):
+        dtype_str = np.dtype(dtype).str
+        bkey = (_batch_id(segments), kind, col, S, D, dtype_str)
+        entry = self._block_cache.get(bkey)
+        if entry is not None and all(a is b
+                                     for a, b in zip(entry[0], segments)):
+            self._block_cache.move_to_end(bkey)  # LRU touch
+            self._meter("hbm_block_hit")
+            return entry[1]
+        self._meter("hbm_block_miss")
+        if self._residency.enabled:
+            dev = self._assemble_resident(segments, S, D, col, kind,
+                                          fetch_row, dtype, host_cache)
+            nbytes = S * D * np.dtype(dtype).itemsize
+        else:
+            # legacy path: host-side stack + one whole-block upload
+            rows = [self._host_row(seg, col, kind, fetch_row, dtype,
+                                   host_cache, pad_to=D)
+                    for seg in segments]
+            block = np.stack(rows) if len(rows) == S else \
+                np.concatenate([np.stack(rows),
+                                np.zeros((S - len(rows), D), dtype=dtype)])
+            dev = self._put(block, block=True)
+            nbytes = block.nbytes
+        self._insert_block(bkey, (tuple(segments), dev), nbytes)
+        return dev
+
+    def _assemble_resident(self, segments, S, D, col, kind, fetch_row,
+                           dtype, host_cache: bool):
+        """[S, D] block from per-segment resident rows: misses build on
+        the host and upload individually (in parallel for multi-row
+        bursts — ops/dispatch.upload_pool), hits cost nothing, and the
+        stack itself runs on-device."""
+        dtype_str = np.dtype(dtype).str
+        dev_rows: List[Any] = []
+        missing: List[int] = []
+        for seg in segments:
+            row = self._residency.get(seg, kind, col, dtype_str)
+            dev_rows.append(row)
+            if row is None:
+                missing.append(len(dev_rows) - 1)
+        if missing:
+            # host rows first: _NotStageable must surface BEFORE any
+            # upload (a doomed plan should not churn the resident tier)
+            host_rows = [self._host_row(segments[i], col, kind, fetch_row,
+                                        dtype, host_cache)
+                         for i in missing]
+            if len(host_rows) > 1 and sum(
+                    a.nbytes for a in host_rows) >= self.UPLOAD_FANOUT_BYTES:
+                # double-buffer big bursts: row N+1's transfer overlaps
+                # row N's (and, under execute_async, the previous
+                # query's kernel). Small rows stay inline — thread
+                # handoff costs more than the copy
+                futs = [dispatch_mod.upload_pool().submit(self._put_row, a)
+                        for a in host_rows]
+                uploaded = [f.result() for f in futs]
+            else:
+                uploaded = [self._put_row(a) for a in host_rows]
+            for i, arr, dev in zip(missing, host_rows, uploaded):
+                self._residency.admit(segments[i], kind, col, dtype_str,
+                                      dev, arr.nbytes)
+                dev_rows[i] = dev
+        assembler = kernels.compiled_row_assembler(
+            S, D, tuple(int(r.shape[0]) for r in dev_rows), dtype_str)
+        return self._reshard_block(assembler(tuple(dev_rows)))
+
+    def _host_row(self, seg, col, kind, fetch_row, dtype,
+                  cache: bool = True, pad_to: Optional[int] = None):
+        """Padded numpy row for one (segment, column): the segment's own
+        pow2 doc bucket (batch-independent, so every batch composition
+        shares it), via the host row cache."""
+        Dr = pad_to if pad_to is not None else _pow2(seg.num_docs)
+        rkey = (id(seg), kind, col, Dr, np.dtype(dtype).str)
+        rentry = self._host_rows.get(rkey)
+        if rentry is not None and rentry[0] is seg:
+            self._host_rows.move_to_end(rkey)
+            self._meter("host_row_hit")
+            return rentry[1]
+        self._meter("host_row_miss")
+        raw = fetch_row(seg)
+        arr = np.zeros(Dr, dtype=dtype)
+        arr[:len(raw)] = raw
+        if cache:
             self._host_rows[rkey] = (seg, arr)
             self._host_bytes += arr.nbytes
             while self._host_bytes > self.host_budget_bytes \
                     and len(self._host_rows) > 1:
                 _k, (_s, _a) = self._host_rows.popitem(last=False)
                 self._host_bytes -= _entry_nbytes(_a)
-            rows.append(arr)
-        block = np.stack(rows) if len(rows) == S else \
-            np.concatenate([np.stack(rows),
-                            np.zeros((S - len(rows), D), dtype=dtype)])
-        dev = self._put(block, block=True)
-        self._insert_block(bkey, (tuple(segments), dev), block.nbytes)
-        return dev
+                self._meter("host_row_evicted")
+            self._refresh_tier_gauges()
+        return arr
+
+    def _put_row(self, arr: np.ndarray):
+        """Upload ONE residency row to the default device (rows are
+        unsharded; the assembled block is resharded over the mesh). Runs
+        on upload-pool threads for multi-row bursts — pure, touches no
+        engine state."""
+        from pinot_tpu.ops import residency as residency_mod
+        residency_mod.note_transfer(arr.nbytes, column=True)
+        self._meter("hbm_transfer_bytes", arr.nbytes)
+        return jnp.asarray(arr)
+
+    def _reshard_block(self, dev):
+        """Move an assembled single-device block onto the mesh sharding
+        kernels expect (device-to-device; never the host link)."""
+        if self._mesh is None:
+            return dev
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        spec = P("segments", "docs") if self._doc_axis > 1 \
+            else P("segments", None)
+        return jax.device_put(dev, NamedSharding(self._mesh, spec))
+
+    def _meter(self, name: str, value: float = 1) -> None:
+        if self._metrics is not None:
+            self._metrics.add_meter(name, value, labels=self._labels)
+
+    def _refresh_tier_gauges(self) -> None:
+        if self._metrics is None:
+            return
+        self._metrics.set_gauge(
+            "hbm_cache_bytes", self._cache_bytes + self._residency.bytes,
+            labels=self._labels)
+        self._metrics.set_gauge("host_row_cache_bytes", self._host_bytes,
+                                labels=self._labels)
 
     def _insert_block(self, key, entry, nbytes: int) -> None:
+        if key not in self._block_cache:
+            self._batch_blocks[key[0]] = \
+                self._batch_blocks.get(key[0], 0) + 1
+        else:
+            self._cache_bytes -= self._block_bytes[key]
         self._block_cache[key] = entry
         self._block_bytes[key] = nbytes
         self._cache_bytes += nbytes
@@ -1205,6 +1341,99 @@ class TpuOperatorExecutor:
             # frees the HBM when the last consumer finishes
             old_key, _entry = self._block_cache.popitem(last=False)
             self._cache_bytes -= self._block_bytes.pop(old_key)
+            self._meter("hbm_evicted")
+            self._drop_batch_block(old_key[0])
+        self._refresh_tier_gauges()
+
+    def _drop_batch_block(self, batch: tuple) -> None:
+        """One block of `batch` left the cache; when it was the LAST,
+        the batch's predicate params can never pair with a live block
+        again — drop them now instead of stranding them until global
+        LRU pressure (params key on (batch, plan, filter)). The
+        refcount keeps the common case O(1); the bounded params scan
+        runs once per batch death, not per eviction."""
+        n = self._batch_blocks.get(batch, 1) - 1
+        if n > 0:
+            self._batch_blocks[batch] = n
+            return
+        self._batch_blocks.pop(batch, None)
+        for pk in [k for k in self._params_cache if k[0] == batch]:
+            del self._params_cache[pk]
+
+    # ------------------------------------------------------------------
+    # residency lifecycle (invalidation, warmup seeding, proactive load)
+    # ------------------------------------------------------------------
+    @property
+    def residency(self):
+        return self._residency
+
+    def residency_seeding(self):
+        """Context manager marking staging as warmup-driven: resident-row
+        admissions bypass the frequency duel and carry the seed boost
+        (cache/warmup.py replay calls this around each plan)."""
+        return self._residency.seeding()
+
+    def invalidate_segment(self, name: str, keep=None) -> None:
+        """Drop every cached artifact for a replaced/removed segment
+        NAME — resident rows, assembled blocks, host rows, predicate
+        params — sparing entries pinned to `keep` (the just-warmed live
+        object). Identity keying already makes stale entries
+        unreachable; this reclaims their HBM/host bytes promptly, on the
+        same epoch-moving events the result caches invalidate on."""
+        with self._engine_lock:
+            def stale(seg) -> bool:
+                return seg.name == name and (keep is None or seg is not keep)
+
+            for k in [k for k, (segs, _d) in self._block_cache.items()
+                      if any(stale(s) for s in segs)]:
+                del self._block_cache[k]
+                self._cache_bytes -= self._block_bytes.pop(k)
+                self._drop_batch_block(k[0])
+            for k in [k for k, v in self._host_rows.items() if stale(v[0])]:
+                _s, payload = self._host_rows.pop(k)
+                self._host_bytes -= _entry_nbytes(payload)
+            for k in [k for k, v in self._params_cache.items()
+                      if any(stale(s) for s in v[0])]:
+                del self._params_cache[k]
+            self._residency.invalidate_segment(name, keep=keep)
+            self._refresh_tier_gauges()
+
+    def drop_caches(self, host: bool = True) -> None:
+        """Bench/test hook: release the device tier (assembled blocks +
+        resident rows + params); host=True also drops host rows — the
+        fully cold replica state."""
+        with self._engine_lock:
+            self._block_cache.clear()
+            self._block_bytes.clear()
+            self._batch_blocks.clear()
+            self._cache_bytes = 0
+            self._params_cache.clear()
+            self._residency.drop_all()
+            if host:
+                self._host_rows.clear()
+                self._host_bytes = 0
+            self._refresh_tier_gauges()
+
+    def prestage(self, segments, ctx: QueryContext) -> bool:
+        """Proactively stage a plan's columns into the device tier
+        WITHOUT launching a kernel — the segment-load warmup path: replay
+        stages the hot plans' columns into HBM before the segment
+        serves, so its first routed query pays compute, not the link."""
+        if not segments or ctx.distinct or not self.supports(ctx):
+            return False
+        with self._engine_lock:
+            if ctx.aggregations:
+                plan_info = self._plan(segments, ctx)
+                plan = plan_info[0] if plan_info is not None else None
+            else:
+                plan = self._plan_topn(segments, ctx)
+            if plan is None:
+                return False
+            try:
+                self._stage(segments, ctx, plan)
+            except _NotStageable:
+                return False
+        return True
 
     @staticmethod
     def _int_ir_bounds(segments, ir) -> Optional[Tuple[int, int]]:
@@ -1287,7 +1516,12 @@ class TpuOperatorExecutor:
 
     def _put(self, arr: np.ndarray, block: bool = False):
         """block=True marks [S, D] column blocks, which also shard over the
-        docs axis on a 2-axis mesh; params/bounds shard over segments only."""
+        docs axis on a 2-axis mesh; params/bounds shard over segments only.
+        Every byte through here feeds the host->device transfer odometer
+        (residency.transfer_bytes) — steady state must keep it flat."""
+        from pinot_tpu.ops import residency as residency_mod
+        residency_mod.note_transfer(arr.nbytes, column=block)
+        self._meter("hbm_transfer_bytes", arr.nbytes)
         if self._mesh is None:
             return jnp.asarray(arr)
         from jax.sharding import NamedSharding, PartitionSpec as P

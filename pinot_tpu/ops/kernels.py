@@ -53,6 +53,31 @@ def _value_dtype() -> jnp.dtype:
     return jnp.float64 if jax.config.read("jax_enable_x64") else jnp.float32
 
 
+@functools.lru_cache(maxsize=1024)
+def compiled_row_assembler(S: int, D: int, row_lens: Tuple[int, ...],
+                           dtype_str: str):
+    """jit'd ON-DEVICE assembly of per-segment resident rows into the
+    kernel-ready [S, D] stacked block (ops/residency.py): each row is a
+    [Dr_i] device array padded to its segment's own pow2 doc bucket, so
+    assembly is a zero-fill plus one dynamic_update_slice per row — HBM
+    traffic only, never the host link. One compile per (S, D, row-length
+    tuple, dtype) shape; row lengths are pow2 buckets, so the cache stays
+    small and steady-state traffic (which hits the assembled-block cache
+    and never re-assembles) compiles nothing."""
+    dtype = jnp.dtype(dtype_str)
+
+    def assemble(rows):
+        note_trace()
+        if len(rows) == S and all(ln == D for ln in row_lens):
+            return jnp.stack(rows)
+        out = jnp.zeros((S, D), dtype=dtype)
+        for i, r in enumerate(rows):
+            out = jax.lax.dynamic_update_slice(out, r[None, :], (i, 0))
+        return out
+
+    return jax.jit(assemble)
+
+
 # ---------------------------------------------------------------------------
 # IR evaluation (runs at trace time)
 # ---------------------------------------------------------------------------

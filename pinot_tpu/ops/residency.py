@@ -1,0 +1,261 @@
+"""HBM segment residency: a per-(segment, column) device-resident tier.
+
+The engine's device tier used to cache only whole stacked blocks keyed by
+the exact segment-batch tuple — a different pruned subset, or one newly
+sealed segment joining the batch, missed the device tier entirely and
+re-shipped EVERY column over the ~100ms host<->TPU link. This module
+holds the unit that actually survives batch recomposition: one padded
+device row per (segment object, column kind), assembled into kernel-ready
+[S, D] blocks ON DEVICE (ops/kernels.compiled_row_assembler), so a new
+batch composition uploads only the rows it has never seen.
+
+Policy (the tier is HBM — it must never grow past its budget, and one
+cold table scan must not flush the hot working set):
+
+  * recency — entries are LRU-ordered; hits refresh.
+  * frequency-based admission (TinyLFU-style) — every access, hit or
+    miss, bumps a per-(segment name, kind, column) counter in a bounded
+    sample window (counters halve when the window fills, so stale
+    popularity decays). When the tier is full, a candidate is admitted
+    only if its frequency exceeds the LRU victim's — a cold scan's
+    once-touched rows lose to the dashboard working set and are simply
+    not retained (the query still ran; retention is what's refused).
+  * warmup seeding — `seeding()` marks accesses made by the segment
+    warmup replay (cache/warmup.py): seeded admissions bypass the
+    frequency duel and carry a seed boost, because the FingerprintLog
+    replaying them IS the evidence of plan traffic.
+  * eviction drops the reference only — in-flight kernels hold evicted
+    rows as inputs and JAX refcounting frees the HBM when the last
+    consumer finishes (same discipline as the block cache).
+
+The module also owns the host->device **transfer odometer**: every byte
+the engine ships through `_put`/row uploads is counted process-wide,
+exposed like `kernels.trace_count()` so tests and the bench can assert a
+repeated-query steady state uploads NOTHING.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, Optional, Tuple
+
+# ---------------------------------------------------------------------------
+# transfer odometer (process-wide, like the kernels.py compile odometer):
+# counts bytes shipped host->device through the engine's upload paths.
+# Steady-state traffic over resident columns must keep this flat — a
+# growing count means the hot path is paying the link again.
+# ---------------------------------------------------------------------------
+_transfer_lock = threading.Lock()
+_transfer_bytes = 0
+_transfer_count = 0
+_column_bytes = 0
+
+
+def note_transfer(nbytes: int, column: bool = False) -> None:
+    """column=True marks COLUMN payloads (resident rows / stacked
+    blocks) as opposed to per-query predicate params — the steady-state
+    guard asserts column bytes specifically, because params are tiny and
+    plan-keyed while columns are the link-saturating payload."""
+    global _transfer_bytes, _transfer_count, _column_bytes
+    with _transfer_lock:
+        _transfer_bytes += int(nbytes)
+        _transfer_count += 1
+        if column:
+            _column_bytes += int(nbytes)
+
+
+def transfer_bytes() -> int:
+    with _transfer_lock:
+        return _transfer_bytes
+
+
+def transfer_count() -> int:
+    with _transfer_lock:
+        return _transfer_count
+
+
+def column_transfer_bytes() -> int:
+    with _transfer_lock:
+        return _column_bytes
+
+
+class ResidencyManager:
+    """Budgeted per-(segment, column) device-row tier with frequency-based
+    admission on top of recency LRU.
+
+    Keys carry (id(segment), segment name) and entries pin the segment
+    object, verified by identity on every hit — a same-name/new-object
+    segment (the PR-5 replace swap, an ingest re-add) can never serve a
+    stale row: id() is not recycled while the entry pins the old object,
+    and the new object misses. Frequency counters key on the NAME (they
+    survive a version swap: the replacement inherits its plan traffic).
+    """
+
+    #: admission credit granted to warmup-seeded rows on top of the
+    #: per-access bump — one replayed plan outweighs a few cold touches
+    SEED_BOOST = 3
+
+    def __init__(self, budget_bytes: int, admission: bool = True,
+                 sample_window: int = 4096, metrics=None,
+                 labels: Optional[Dict[str, str]] = None):
+        self.budget_bytes = max(0, int(budget_bytes))
+        self.enabled = self.budget_bytes > 0
+        self.admission = bool(admission)
+        self.sample_window = max(64, int(sample_window))
+        self._metrics = metrics
+        self._labels = labels
+        self._lock = threading.RLock()
+        #: key -> (segment, device row, nbytes); LRU order
+        self._entries: "OrderedDict[tuple, Tuple[Any, Any, int]]" = \
+            OrderedDict()
+        self._bytes = 0
+        #: (segment name, kind, col) -> access count (TinyLFU sketch —
+        #: a plain dict is exact and bounded by the halving pass)
+        self._freq: Dict[tuple, int] = {}
+        self._obs = 0
+        self._seeding = threading.local()
+        # plain tallies (cheap asserts in tests; the metrics registry
+        # carries the same numbers for ops)
+        self.hits = 0
+        self.misses = 0
+        self.admitted = 0
+        self.rejected = 0
+        self.evicted = 0
+
+    # -- keys -----------------------------------------------------------
+    @staticmethod
+    def _key(seg, kind: str, col: str, dtype_str: str) -> tuple:
+        return (id(seg), seg.name, kind, col, dtype_str)
+
+    @staticmethod
+    def _fkey(seg, kind: str, col: str) -> tuple:
+        return (seg.name, kind, col)
+
+    # -- seeding (warmup replay) ---------------------------------------
+    @contextlib.contextmanager
+    def seeding(self):
+        """Accesses inside this context are warmup-seeded: admissions
+        bypass the frequency duel and carry SEED_BOOST extra credit."""
+        depth = getattr(self._seeding, "depth", 0)
+        self._seeding.depth = depth + 1
+        try:
+            yield
+        finally:
+            self._seeding.depth = depth
+
+    @property
+    def seeding_active(self) -> bool:
+        return getattr(self._seeding, "depth", 0) > 0
+
+    # -- metering -------------------------------------------------------
+    def _meter(self, name: str, value: float = 1) -> None:
+        if self._metrics is not None:
+            self._metrics.add_meter(name, value, labels=self._labels)
+
+    def _touch(self, fkey: tuple, n: int = 1) -> None:
+        self._freq[fkey] = self._freq.get(fkey, 0) + n
+        self._obs += n
+        if self._obs >= self.sample_window:
+            # aging: halve everything so popularity is RECENT popularity
+            # (and the dict stays bounded — zeroed keys drop out)
+            self._freq = {k: v // 2 for k, v in self._freq.items()
+                          if v // 2 > 0}
+            self._obs //= 2
+
+    # -- access ---------------------------------------------------------
+    def get(self, seg, kind: str, col: str, dtype_str: str):
+        """The resident device row for (seg, kind, col), or None on miss.
+        Every call counts toward the column's admission frequency."""
+        if not self.enabled:
+            return None
+        key = self._key(seg, kind, col, dtype_str)
+        with self._lock:
+            boost = self.SEED_BOOST if self.seeding_active else 0
+            self._touch(self._fkey(seg, kind, col), 1 + boost)
+            entry = self._entries.get(key)
+            if entry is not None and entry[0] is seg:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                self._meter("hbm_resident_hit")
+                return entry[1]
+            self.misses += 1
+            self._meter("hbm_resident_miss")
+            return None
+
+    def admit(self, seg, kind: str, col: str, dtype_str: str, dev_row,
+              nbytes: int) -> bool:
+        """Offer an uploaded row for retention. Returns True if resident.
+        Rejection never fails the query — the caller keeps its transient
+        reference; the tier just declines to retain the bytes."""
+        if not self.enabled or nbytes > self.budget_bytes:
+            return False
+        key = self._key(seg, kind, col, dtype_str)
+        fkey = self._fkey(seg, kind, col)
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old[2]
+            seeded = self.seeding_active
+            cand = self._freq.get(fkey, 0)
+            while self._bytes + nbytes > self.budget_bytes and self._entries:
+                vkey = next(iter(self._entries))
+                vfreq = self._freq.get((vkey[1], vkey[2], vkey[3]), 0)
+                if self.admission and not seeded and cand <= vfreq:
+                    # the victim is at least as hot: decline retention —
+                    # this is what stops a cold scan flushing the
+                    # working set
+                    self.rejected += 1
+                    self._meter("hbm_admission_rejected")
+                    return False
+                _vseg, _vdev, vnb = self._entries.pop(vkey)
+                self._bytes -= vnb
+                self.evicted += 1
+                self._meter("hbm_evicted")
+            self._entries[key] = (seg, dev_row, int(nbytes))
+            self._bytes += int(nbytes)
+            self.admitted += 1
+            return True
+
+    # -- invalidation ---------------------------------------------------
+    def invalidate_segment(self, name: str, keep=None) -> int:
+        """Drop resident rows for a replaced/removed segment NAME,
+        sparing entries pinned to `keep` (the just-warmed live object).
+        Identity keying already guarantees a new object misses; this
+        reclaims the old version's HBM promptly. Frequency counters are
+        kept — the replacement inherits its column traffic."""
+        with self._lock:
+            stale = [k for k, e in self._entries.items()
+                     if k[1] == name and (keep is None or e[0] is not keep)]
+            for k in stale:
+                _seg, _dev, nb = self._entries.pop(k)
+                self._bytes -= nb
+                self.evicted += 1
+                self._meter("hbm_evicted")
+            return len(stale)
+
+    def drop_all(self) -> None:
+        """Bench/test hook: release every resident row (references only —
+        in-flight kernels still hold theirs)."""
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0
+
+    # -- introspection --------------------------------------------------
+    @property
+    def bytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def resident_for(self, name: str) -> int:
+        """Resident row count for a segment name (tests)."""
+        with self._lock:
+            return sum(1 for k in self._entries if k[1] == name)
+
+    def frequency(self, name: str, kind: str, col: str) -> int:
+        with self._lock:
+            return self._freq.get((name, kind, col), 0)

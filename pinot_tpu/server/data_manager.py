@@ -111,6 +111,15 @@ class TableDataManager:
             sdm.offload()
             self._notify("remove", name)
 
+    def current_segment(self, name: str) -> Optional[ImmutableSegment]:
+        """The LIVE segment object for a name (or None) — a lock-held
+        peek, no refcount taken: callers use it transiently for identity
+        comparisons (cache invalidation sparing the just-swapped-in
+        version), not for query execution."""
+        with self._lock:
+            sdm = self._segments.get(name)
+            return sdm.segment if sdm is not None else None
+
     def acquire_segments(self, names: Optional[Sequence[str]] = None
                          ) -> List[SegmentDataManager]:
         """Acquire the named segments (or all); caller must release_all.

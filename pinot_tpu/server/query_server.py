@@ -142,19 +142,23 @@ class ServerQueryExecutor:
         remove."""
         if event not in ("replace", "remove"):
             return
-        keep = None
+        keep = keep_obj = None
         if event == "replace":
             from pinot_tpu.cache.segment_cache import segment_version
             tdm = self.data_manager.table(table_name, create=False)
             if tdm is not None:
-                sdms = tdm.acquire_segments([segment_name])
-                try:
-                    if sdms:
-                        keep = segment_version(sdms[0].segment)
-                finally:
-                    type(tdm).release_all(sdms)
+                keep_obj = tdm.current_segment(segment_name)
+                if keep_obj is not None:
+                    keep = segment_version(keep_obj)
         self.segment_cache.invalidate_segment(segment_name,
                                               except_version=keep)
+        # device tier rides the same epoch-moving event: drop the old
+        # version's resident rows / assembled blocks / params promptly
+        # (identity keys already make them unreachable), sparing the
+        # just-warmed live object's entries
+        engine = self._engine
+        if engine is not None:
+            engine.invalidate_segment(segment_name, keep=keep_obj)
 
     def _record_plan(self, table_name: str, ctx, sql_or_ctx,
                      extra_filter) -> None:

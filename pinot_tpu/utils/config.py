@@ -36,7 +36,19 @@ KEYS: Dict[str, Any] = {
     # active), capped at batch.max per launch
     "pinot.server.dispatch.batch.window.ms": 2.0,
     "pinot.server.dispatch.batch.max": 16,
+    # HBM memory tiers (ops/engine.py + ops/residency.py):
+    # .hbm.cache.bytes bounds the ASSEMBLED [S, D] block cache;
+    # .hbm.resident.* bounds the per-(segment, column) resident-row tier
+    # that survives batch recomposition (misses assemble on-device).
+    # Admission is TinyLFU-style: when full, a candidate row must be
+    # more frequent than the LRU victim to be retained (warmup-seeded
+    # rows bypass the duel); .admission.sample is the frequency aging
+    # window (counters halve when it fills).
     "pinot.server.hbm.cache.bytes": 8 << 30,
+    "pinot.server.hbm.resident.enabled": True,
+    "pinot.server.hbm.resident.bytes": 6 << 30,
+    "pinot.server.hbm.admission.enabled": True,
+    "pinot.server.hbm.admission.sample": 4096,
     "pinot.server.host.row.cache.bytes": 16 << 30,
     "pinot.server.segment.cache.enabled": True,   # tier-2 partial cache
     "pinot.server.segment.cache.bytes": 256 << 20,

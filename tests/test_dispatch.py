@@ -202,6 +202,31 @@ class TestCancelAndDeadline:
 
 
 class TestRetraceGuard:
+    def test_steady_state_zero_retraces_and_zero_column_bytes(self, segs):
+        """CI guard (ISSUE 6): a repeated-query steady state — singles
+        AND coalesced batches over warmed shapes — must neither compile
+        (compile odometer) nor ship ONE column byte host->device
+        (transfer odometer): columns are resident, blocks are assembled
+        and cached, params are plan-keyed. Either regression silently
+        re-pays the ~100ms link or a recompile per query in production."""
+        from pinot_tpu.ops import residency
+        eng = make_engine()
+        ctxs = [QueryContext.from_sql(
+            f"SELECT SUM(m), COUNT(*), MIN(m) FROM t WHERE d < {k}")
+            for k in range(1, 9)]
+        for c in ctxs:
+            eng.execute(segs, c)      # warm singles (stage + compile)
+        run_concurrent(eng, segs, ctxs)   # warm the batched bucket
+        t0 = kernels.trace_count()
+        b0 = residency.transfer_bytes()
+        for c in ctxs:
+            eng.execute(segs, c)
+        run_concurrent(eng, segs, ctxs)
+        assert kernels.trace_count() == t0, \
+            "steady-state traffic re-compiled a kernel"
+        assert residency.transfer_bytes() == b0, \
+            "steady-state traffic uploaded host->device bytes"
+
     def test_steady_state_zero_retrace(self, segs):
         """CI guard: warmed (plan, shape, batch-size bucket) traffic must
         not compile ANYTHING — a compile-cache miss here re-traces the

@@ -511,6 +511,65 @@ class TestNoDeepStoreDeployment:
 
 
 # ---------------------------------------------------------------------------
+# Instance sweep liveness (ISSUE 6 satellite)
+# ---------------------------------------------------------------------------
+
+class TestInstanceLiveness:
+    def test_instances_reports_minion_heartbeat_liveness(self, tmp_path):
+        """/instances tags every heartbeating instance — minion workers
+        alongside servers — with last-heartbeat age and live/stale
+        status; statically wired instances read 'unknown'."""
+        from pinot_tpu.controller.cluster_state import InstanceState
+        from pinot_tpu.controller.http_api import ControllerHttpServer
+        c, _names = _mini_cluster(tmp_path, n_segments=1, minions=1)
+        srv = None
+        try:
+            c.cluster_state.register_instance(
+                InstanceState("server_dead", tags=["minion"]))
+            c.coordination._last_seen["server_dead"] = time.time() - 999
+            c.cluster_state.register_instance(InstanceState("server_static"))
+            # the worker's own poll-loop heartbeat (not just its one-time
+            # registration) keeps the age fresh: wait past several
+            # heartbeat intervals, the age must stay below the gap
+            time.sleep(1.0)
+            age = c.coordination.heartbeat_ages().get("minion_0")
+            assert age is not None and age < 0.8, \
+                f"minion heartbeat not refreshing (age={age})"
+            srv = ControllerHttpServer(c.cluster_state,
+                                       coordination=c.coordination)
+            srv.start()
+            url = f"http://{srv.host}:{srv.port}/instances"
+            with urllib.request.urlopen(url, timeout=10) as r:
+                insts = json.loads(r.read())["instances"]
+            minion = insts["minion_0"]
+            assert "minion" in minion["tags"]
+            assert minion["liveness"] == "live"
+            assert 0 <= minion["lastHeartbeatAgeSeconds"] < 15.0
+            assert insts["server_dead"]["liveness"] == "stale"
+            assert insts["server_dead"]["lastHeartbeatAgeSeconds"] > 15.0
+            assert insts["server_static"]["liveness"] == "unknown"
+            assert insts["server_static"]["lastHeartbeatAgeSeconds"] is None
+            # a worker blocked inside a LONG task never reaches its
+            # poll-loop heartbeat — its lease RPCs must prove liveness
+            # instead (any worker-attributed task op bumps last-seen)
+            from pinot_tpu.controller.coordination import CoordinationClient
+            c.coordination._last_seen["minion_0"] = time.time() - 999
+            probe = CoordinationClient(c.coordination.address)
+            try:
+                probe.request("task_renew", task_id="no-such-task",
+                              worker="minion_0")
+            except (RuntimeError, OSError):
+                pass  # the renew itself may fail; the bump precedes it
+            finally:
+                probe.close()
+            assert c.coordination.heartbeat_ages()["minion_0"] < 5.0
+        finally:
+            if srv is not None:
+                srv.stop()
+            c.stop()
+
+
+# ---------------------------------------------------------------------------
 # Controller HTTP surface
 # ---------------------------------------------------------------------------
 
