@@ -234,7 +234,12 @@ class BrokerRequestHandler:
                         return _error_response(
                             429, f"QuotaExceededError: table {t} is over "
                                  f"its QPS quota", start)
-                return self.mse_dispatcher.submit(sql, parsed)
+                # the MSE query enters with the same end-to-end budget
+                # resolution as the single-stage path: OPTION(timeoutMs)
+                # wins inside the dispatcher, this broker's configured
+                # default is the fallback
+                return self.mse_dispatcher.submit(
+                    sql, parsed, default_timeout_ms=self._default_timeout_ms)
             return _error_response(150, f"SQLParsingError: {e}", start)
         if not self._check_quota(ctx.table):
             return _error_response(
@@ -242,7 +247,8 @@ class BrokerRequestHandler:
                      f"QPS quota", start)
         if self.mse_dispatcher is not None and \
                 query.options.get("useMultistageEngine", "").lower() == "true":
-            return self.mse_dispatcher.submit(sql)
+            return self.mse_dispatcher.submit(
+                sql, default_timeout_ms=self._default_timeout_ms)
         route = self.routing.get_route(ctx.table)
         if route is None:
             return _error_response(

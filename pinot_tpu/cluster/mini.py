@@ -33,13 +33,22 @@ class MiniClusterServer:
         # multi-stage worker endpoint (mailbox data plane + stage executor);
         # leaf aggregates route through the single-stage executor and its
         # shared device engine (ref QueryRunner.java:258)
-        from pinot_tpu.mse.dispatcher import make_leaf_query_fn, make_scan_fn
+        from pinot_tpu.mse.dispatcher import (
+            make_leaf_query_fn, make_scan_fn, make_segment_versions_fn)
         from pinot_tpu.mse.runtime import MseWorker
+        from pinot_tpu.mse.stage_cache import StageOutputCache
+        from pinot_tpu.utils.config import PinotConfiguration
+        from pinot_tpu.utils.metrics import get_registry
         engine_fn = self.executor._shared_engine if use_tpu else None
+        stage_cache = StageOutputCache.from_config(
+            config or PinotConfiguration(),
+            metrics=get_registry("server"), labels={"instance": instance_id})
         self.mse_worker = MseWorker(
             instance_id,
             make_scan_fn(self.data_manager, engine_fn=engine_fn),
-            leaf_query_fn=make_leaf_query_fn(self.data_manager, engine_fn))
+            leaf_query_fn=make_leaf_query_fn(self.data_manager, engine_fn),
+            stage_cache=stage_cache,
+            segment_versions_fn=make_segment_versions_fn(self.data_manager))
 
     def start(self) -> None:
         self.transport.start()
@@ -178,7 +187,8 @@ class MiniCluster:
         self.mse = QueryDispatcher(
             workers={s.instance_id: s.mse_worker for s in self.servers},
             catalog_fn=self._catalog,
-            table_workers_fn=self._table_workers)
+            table_workers_fn=self._table_workers,
+            config=self.config)
         # N broker replicas over the SAME routing view and server
         # connections — each with its own (L1) result cache, sharing L2
         # through the cache server when one is running

@@ -84,6 +84,10 @@ class TaskEntry:
     lease_expiry: float = 0.0
     attempts: int = 0
     max_attempts: int = 3
+    #: lease precedence: higher leases first (within a priority tier the
+    #: queue round-robins over tables, then FIFO). Defaults to 0; set
+    #: explicitly or via a ``priority`` task param.
+    priority: int = 0
     #: backoff gate: a requeued task is not leasable before this time
     not_before: float = 0.0
     cancel_requested: bool = False
@@ -131,6 +135,10 @@ class TaskQueue:
         self.max_done = max(1, int(max_done))
         self._tasks: "Dict[str, TaskEntry]" = {}
         self._lock = threading.Lock()
+        #: per-table last-lease stamp for round-robin fairness (0 =
+        #: never served; smaller = longer since last lease)
+        self._table_served: Dict[str, int] = {}
+        self._serve_seq = 0
         self._metrics = metrics
         self.journal_path = journal_path
         self.journal_max_bytes = max(4096, int(journal_max_bytes))
@@ -217,9 +225,15 @@ class TaskQueue:
 
     # -- queue API -----------------------------------------------------
     def submit(self, task: TaskConfig,
-               max_attempts: Optional[int] = None) -> TaskEntry:
+               max_attempts: Optional[int] = None,
+               priority: Optional[int] = None) -> TaskEntry:
         task_id = task.task_id or \
             f"Task_{task.task_type}_{uuid.uuid4().hex[:12]}"
+        if priority is None:
+            try:
+                priority = int(task.params.get("priority", 0))
+            except (TypeError, ValueError):
+                priority = 0
         with self._lock:
             existing = self._tasks.get(task_id)
             if existing is not None:
@@ -228,7 +242,7 @@ class TaskQueue:
                 task_id=task_id, task_type=task.task_type, table=task.table,
                 segments=list(task.segments), params=dict(task.params),
                 max_attempts=max_attempts or self.max_attempts,
-                created_at=time.time())
+                priority=priority, created_at=time.time())
             self._tasks[task_id] = e
             self._touch_locked(e)
             return e
@@ -246,10 +260,14 @@ class TaskQueue:
     def lease(self, worker: str,
               task_types: Optional[List[str]] = None,
               lease_ttl_s: Optional[float] = None) -> Optional[TaskEntry]:
-        """Grant the oldest leasable PENDING task matching the worker's
-        declared task types. Sweeps expired leases first so a polling
-        worker (not just the cadence loop) recovers crashed peers'
-        work."""
+        """Grant one leasable PENDING task matching the worker's declared
+        task types. Lease order is (priority desc, round-robin over
+        tables, FIFO): within the highest waiting priority tier the
+        least-recently-served TABLE goes first, so a flood of one
+        table's tasks cannot starve another table's — and within a table
+        it is oldest-first, as before. Sweeps expired leases first so a
+        polling worker (not just the cadence loop) recovers crashed
+        peers' work."""
         now = time.time()
         self.expire_leases(now)
         ttl = lease_ttl_s if lease_ttl_s is not None else self.lease_ttl_s
@@ -258,10 +276,14 @@ class TaskQueue:
                 (e for e in self._tasks.values()
                  if e.state == PENDING and e.not_before <= now
                  and (not task_types or e.task_type in task_types)),
-                key=lambda e: (e.created_at, e.task_id))
+                key=lambda e: (-e.priority,
+                               self._table_served.get(e.table, 0),
+                               e.created_at, e.task_id))
             if not candidates:
                 return None
             e = candidates[0]
+            self._serve_seq += 1
+            self._table_served[e.table] = self._serve_seq
             # chaos hook: delay/fail the grant itself (a raise leaves the
             # task PENDING — the lease was never handed out)
             fire("controller.task.assign", task_id=e.task_id,

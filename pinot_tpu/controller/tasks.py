@@ -267,6 +267,13 @@ def register_executor(ex: TaskExecutor) -> None:
     _EXECUTORS[ex.task_type] = ex
 
 
+def registered_task_types() -> List[str]:
+    """Task types with a registered executor — a worker that declared no
+    explicit types leases exactly these (and can meter per-type
+    concurrency against the full list)."""
+    return sorted(_EXECUTORS)
+
+
 def run_task(task: TaskConfig, ctx: TaskContext) -> Dict[str, Any]:
     """Ref TaskFactoryRegistry.executeTask."""
     ex = _EXECUTORS.get(task.task_type)

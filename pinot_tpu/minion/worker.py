@@ -94,8 +94,14 @@ class MinionTaskContext:
 
 class MinionWorker:
     """One minion worker instance (ref MinionStarter + TaskFactoryRegistry
-    executor threads; here one task at a time per worker — scale out by
-    running more workers)."""
+    executor threads): an executor POOL runs up to
+    ``pinot.minion.executor.concurrency`` tasks concurrently — each with
+    its own lease-heartbeat thread — with per-type caps layered on via
+    ``pinot.minion.executor.concurrency.<TaskType>`` (a heavyweight type
+    like MergeRollupTask can be capped to 1 while cheap purges fill the
+    remaining slots). The lease request only names types with a free
+    slot, so the controller never hands this worker work it would have
+    to sit on."""
 
     def __init__(self, instance_id: str, coordinator: str,
                  work_dir: Optional[str] = None,
@@ -104,6 +110,7 @@ class MinionWorker:
         from pinot_tpu.utils.config import PinotConfiguration
         from pinot_tpu.utils.metrics import get_registry
         cfg = config or PinotConfiguration()
+        self._config = cfg
         self.instance_id = instance_id
         self.client = CoordinationClient(coordinator)
         self.poll_s = cfg.get_float("pinot.minion.poll.seconds")
@@ -113,6 +120,8 @@ class MinionWorker:
             raw = cfg.get_str("pinot.minion.task.types")
             types = [t.strip() for t in raw.split(",") if t.strip()] or None
         self.task_types = types  # None = all registered task types
+        self.concurrency = max(
+            1, cfg.get_int("pinot.minion.executor.concurrency"))
         self.work_dir = work_dir or cfg.get_str("pinot.minion.work.dir") \
             or tempfile.mkdtemp(prefix=f"pinot_tpu_minion_{instance_id}_")
         self._metrics = metrics if metrics is not None \
@@ -120,6 +129,12 @@ class MinionWorker:
         self._labels = {"minion": instance_id}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        #: running task_id -> (task_type, thread); the pool's ledger
+        self._running: dict = {}
+        self._rlock = threading.Lock()
+        #: set on a SimulatedCrash: the whole worker vanished — no task
+        #: thread may report/commit anything from that point on
+        self._vanished = threading.Event()
         #: observability for tests: tasks this worker actually EXECUTED
         #: vs. commits it merely replayed from a found manifest
         self.executed = 0
@@ -140,9 +155,38 @@ class MinionWorker:
         if self._thread is not None:
             self._thread.join(timeout=10)
             self._thread = None
+        with self._rlock:
+            threads = [t for _type, t in self._running.values()]
+        for t in threads:
+            t.join(timeout=10)
         self.client.close()
 
-    # ------------------------------------------------------------------
+    # -- executor pool --------------------------------------------------
+    def _type_cap(self, task_type: str) -> int:
+        cap = self._config.get_int(
+            f"pinot.minion.executor.concurrency.{task_type}",
+            self.concurrency)
+        return max(1, min(cap, self.concurrency))
+
+    def _leasable_types(self) -> List[str]:
+        """Types this worker can take RIGHT NOW: declared (or all
+        registered) types whose per-type running count is under its cap.
+        Empty when the pool is full."""
+        from pinot_tpu.controller.tasks import registered_task_types
+        with self._rlock:
+            if len(self._running) >= self.concurrency:
+                return []
+            counts: dict = {}
+            for ttype, _t in self._running.values():
+                counts[ttype] = counts.get(ttype, 0) + 1
+        types = self.task_types if self.task_types is not None \
+            else registered_task_types()
+        return [t for t in types if counts.get(t, 0) < self._type_cap(t)]
+
+    def running_tasks(self) -> int:
+        with self._rlock:
+            return len(self._running)
+
     def _loop(self) -> None:
         last_hb = 0.0
         while not self._stop.is_set():
@@ -157,25 +201,49 @@ class MinionWorker:
                     last_hb = time.monotonic()
                 except (ConnectionError, OSError, RuntimeError):
                     pass
+            eligible = self._leasable_types()
+            if not eligible:
+                self._stop.wait(self.poll_s)
+                continue
             try:
                 r = self.client.request("task_lease",
                                         worker=self.instance_id,
-                                        task_types=self.task_types)
+                                        task_types=eligible)
                 entry = r.get("task")
             except (ConnectionError, OSError, RuntimeError):
                 entry = None  # controller briefly unreachable: keep polling
             if entry is None:
                 self._stop.wait(self.poll_s)
                 continue
-            try:
-                self._run_task(entry)
-            except SimulatedCrash:
-                # chaos kill: vanish WITHOUT reporting — recovery must
-                # come from lease expiry, exactly like a dead process
-                self.crashed = True
-                log.warning("minion %s simulated crash on %s",
-                            self.instance_id, entry["task_id"])
-                return
+            t = threading.Thread(
+                target=self._task_thread, args=(entry,), daemon=True,
+                name=f"minion-task-{entry['task_id'][:24]}")
+            with self._rlock:
+                self._running[entry["task_id"]] = (entry["task_type"], t)
+            self._metrics.set_gauge("minion_running_tasks",
+                                    len(self._running),
+                                    labels=self._labels)
+            t.start()
+
+    def _task_thread(self, entry: dict) -> None:
+        try:
+            self._run_task(entry)
+        except SimulatedCrash:
+            # chaos kill: vanish WITHOUT reporting — recovery must
+            # come from lease expiry, exactly like a dead process.
+            # Sibling tasks on this worker die with it (their report
+            # paths are gated on _vanished).
+            self.crashed = True
+            self._vanished.set()
+            self._stop.set()
+            log.warning("minion %s simulated crash on %s",
+                        self.instance_id, entry["task_id"])
+        finally:
+            with self._rlock:
+                self._running.pop(entry["task_id"], None)
+                self._metrics.set_gauge("minion_running_tasks",
+                                        len(self._running),
+                                        labels=self._labels)
 
     # ------------------------------------------------------------------
     def _run_task(self, entry: dict) -> None:
@@ -222,6 +290,8 @@ class MinionWorker:
                 raise _TaskAborted("cancelled by controller")
             if lost.is_set():
                 return  # lease lost: someone else owns the task now
+            if self._vanished.is_set():
+                return  # a sibling crashed the worker: commit nothing
             self._report_progress(task_id, "committing")
             self.client.request(
                 "segment_replace", task_id=task_id,
@@ -318,6 +388,8 @@ class MinionWorker:
                         cancel: threading.Event,
                         lost: threading.Event) -> None:
         while not stop.wait(self.heartbeat_s):
+            if self._vanished.is_set():
+                return  # dead workers don't renew leases
             try:
                 r = self.client.request("task_renew", task_id=task_id,
                                         worker=self.instance_id)
@@ -330,6 +402,8 @@ class MinionWorker:
                 return
 
     def _report_progress(self, task_id: str, progress: str) -> None:
+        if self._vanished.is_set():
+            return
         try:
             self.client.request("task_renew", task_id=task_id,
                                 worker=self.instance_id, progress=progress)
@@ -338,6 +412,8 @@ class MinionWorker:
 
     def _report_fail(self, task_id: str, error: str,
                      cancelled: bool = False) -> None:
+        if self._vanished.is_set():
+            return
         try:
             self.client.request("task_fail", task_id=task_id,
                                 worker=self.instance_id, error=error,

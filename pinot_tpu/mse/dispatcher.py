@@ -18,12 +18,16 @@ import numpy as np
 
 from pinot_tpu.mse.blocks import Block
 from pinot_tpu.mse.logical import Catalog, build_logical
-from pinot_tpu.mse.mailbox import MailboxService
+from pinot_tpu.mse.mailbox import (
+    MailboxAborted, MailboxError, MailboxService, MailboxTimeout)
 from pinot_tpu.mse.planner import QueryPlan, plan_query
 from pinot_tpu.mse.runtime import MseWorker, ScanFn, StageContext, run_stage
 from pinot_tpu.mse.sql import parse_mse_sql
 from pinot_tpu.query.reduce import BrokerResponse, ResultTable
 from pinot_tpu.query.results import ExecutionStats
+from pinot_tpu.utils.accounting import (
+    BrokerTimeoutError, QueryCancelledError)
+from pinot_tpu.utils.failpoints import fire
 
 _QUERY_SEQ = itertools.count(1)
 _SEQ_LOCK = threading.Lock()
@@ -124,39 +128,156 @@ def make_leaf_query_fn(data_manager, engine_fn=None):
     return leaf_query
 
 
+def make_segment_versions_fn(data_manager):
+    """Version-set provider for the leaf-stage output cache: the sorted
+    (name, version) tuple of the instance's local segments for a table,
+    or None when ANY segment is non-cacheable (consuming / live upsert
+    bitmap) — mirroring cache/segment_cache.py's cacheability rule so a
+    mutable tail always re-executes."""
+    from pinot_tpu.cache.segment_cache import (
+        is_cacheable_segment, segment_version)
+
+    def versions(table: str):
+        tdm = _resolve_table(data_manager, table)
+        if tdm is None:
+            return ()
+        sdms = tdm.acquire_segments(None)
+        try:
+            out = []
+            for s in sdms:
+                seg = s.segment
+                if not is_cacheable_segment(seg):
+                    return None
+                out.append((seg.name, segment_version(seg)))
+            return tuple(sorted(out))
+        finally:
+            type(tdm).release_all(sdms)
+
+    return versions
+
+
+class MseQueryTimeout(BrokerTimeoutError):
+    """The multi-stage query missed its end-to-end budget."""
+
+
 class QueryDispatcher:
-    """Multi-stage query entry point on the broker."""
+    """Multi-stage query entry point on the broker.
+
+    Reliability (ISSUE 7): one budget — resolved exactly like the
+    single-stage handler (``OPTION(timeoutMs)`` >
+    ``pinot.broker.mse.timeout.ms`` > ``pinot.broker.timeout.ms``) —
+    enters here, travels in every ``submit_stage``, and is enforced
+    cooperatively in each stage plus as a hard wall on mailbox waits. A
+    miss or a client ``cancel`` fans an out-of-band cancel to every
+    worker (aborting in-flight stages and poisoning their mailboxes) and
+    the broker answers a typed errorCode-250 partial — never a hang.
+    """
 
     def __init__(self,
                  workers: Dict[str, MseWorker],
                  catalog_fn: Callable[[], Catalog],
                  table_workers_fn: Callable[[str], List[str]],
-                 broker_mailbox: Optional[MailboxService] = None):
+                 broker_mailbox: Optional[MailboxService] = None,
+                 config=None, enforce_deadlines: bool = True):
+        from pinot_tpu.utils.metrics import get_registry
         self.workers = workers
         self.catalog_fn = catalog_fn
         self.table_workers_fn = table_workers_fn
         if broker_mailbox is None:
-            broker_mailbox = MailboxService("broker")
+            broker_mailbox = MailboxService(
+                "broker", metrics=get_registry("broker"))
             broker_mailbox.start()
         self.mailbox = broker_mailbox
+        self.config = config
+        #: bench escape hatch: False runs the legacy no-deadline plumbing
+        #: so the A/B can price the checks (bench.py --mse)
+        self.enforce_deadlines = enforce_deadlines
+        self._metrics = get_registry("broker")
+        #: query_id -> cancel fan-out record for in-flight queries
+        self._inflight: Dict[str, threading.Event] = {}
+        self._inflight_lock = threading.Lock()
 
     def stop(self) -> None:
         self.mailbox.stop()
 
     # ------------------------------------------------------------------
+    def _alive_workers(self) -> Dict[str, MseWorker]:
+        return {k: w for k, w in self.workers.items() if w.alive}
+
+    def _timeout_ms(self, options: Dict[str, str],
+                    default_timeout_ms: Optional[float] = None) -> float:
+        """Same precedence as BrokerRequestHandler._timeout_ms:
+        OPTION(timeoutMs) first, then the MSE-specific config knob, then
+        the delegating broker's resolved default (``default_timeout_ms``
+        — it already folded in that broker's own config), then this
+        dispatcher's config, then 60s."""
+        opt = options.get("timeoutMs")
+        if opt:
+            try:
+                return max(1.0, float(opt))
+            except ValueError:
+                pass
+        if self.config is not None:
+            mse_ms = self.config.get("pinot.broker.mse.timeout.ms")
+            if mse_ms not in (None, ""):
+                try:
+                    return max(1.0, float(mse_ms))
+                except (TypeError, ValueError):
+                    pass  # malformed knob: fall through, don't fail queries
+        if default_timeout_ms is not None:
+            return max(1.0, float(default_timeout_ms))
+        if self.config is not None:
+            return max(1.0, float(
+                self.config.get_int("pinot.broker.timeout.ms")))
+        return 60000.0
+
     def plan_sql(self, sql: str, parsed=None) -> QueryPlan:
         q = parsed if parsed is not None else parse_mse_sql(sql)
         if q.limit is None:
             q.limit = 10  # Pinot default applies to the outermost query
         logical = build_logical(q, self.catalog_fn())
-        return plan_query(logical, q.options, self.table_workers_fn,
-                          intermediate_workers=sorted(self.workers))
+        alive = self._alive_workers()
 
-    def submit(self, sql: str, parsed=None) -> BrokerResponse:
+        def alive_table_workers(table: str) -> List[str]:
+            # route leaf stages around chaos-killed workers; a table
+            # whose every host is dead is a routing error, not a hang
+            hosts = [w for w in self.table_workers_fn(table) if w in alive]
+            if not hosts:
+                raise ValueError(
+                    f"no live workers host table {table!r}")
+            return hosts
+
+        return plan_query(logical, q.options, alive_table_workers,
+                          intermediate_workers=sorted(alive))
+
+    def submit(self, sql: str, parsed=None,
+               default_timeout_ms: Optional[float] = None) -> BrokerResponse:
         start = time.time()
+        self._metrics.add_meter("mse_queries")
         try:
             plan = self.plan_sql(sql, parsed)
-            block = self._execute(plan)
+            block = self._execute(plan, default_timeout_ms)
+        except (MseQueryTimeout, BrokerTimeoutError, MailboxTimeout,
+                QueryCancelledError, MailboxError) as e:
+            # typed partial: the budget expired, a worker died
+            # mid-shuffle, a frame tore, or the client cancelled — the
+            # answer is known-incomplete (ref EXECUTION_TIMEOUT 250).
+            # A client cancel surfaces as QueryCancelledError from an op
+            # boundary OR MailboxAborted from a blocked receive — both
+            # meter as cancelled, not as a deadline miss
+            self._metrics.add_meter(
+                "mse_cancelled"
+                if isinstance(e, (QueryCancelledError, MailboxAborted))
+                else "mse_deadline_expired")
+            resp = BrokerResponse(
+                result_table=None,
+                exceptions=[{
+                    "errorCode": BrokerTimeoutError.ERROR_CODE,
+                    "message": f"{type(e).__name__}: {e}"}],
+                stats=ExecutionStats())
+            resp.partial_result = True
+            resp.time_used_ms = (time.time() - start) * 1000.0
+            return resp
         except Exception as e:  # noqa: BLE001 — broker answers, never dies
             resp = BrokerResponse(
                 result_table=None,
@@ -172,15 +293,64 @@ class QueryDispatcher:
         resp = BrokerResponse(result_table=table, exceptions=[],
                               stats=ExecutionStats())
         resp.num_servers_queried = resp.num_servers_responded = \
-            len(self.workers)
+            len(self._alive_workers())
         resp.time_used_ms = (time.time() - start) * 1000.0
         return resp
 
     # ------------------------------------------------------------------
-    def _execute(self, plan: QueryPlan) -> Block:
+    def inflight(self) -> List[str]:
+        with self._inflight_lock:
+            return sorted(self._inflight)
+
+    def cancel(self, query_id: str, reason: str = "cancelled by client") \
+            -> bool:
+        """Client-initiated cancel: aborts the broker-side root stage and
+        fans the cancel out to every worker. Safe to call for unknown or
+        already-finished ids (returns False)."""
+        with self._inflight_lock:
+            ev = self._inflight.get(query_id)
+        if ev is None:
+            return False
+        ev.set()
+        self._fan_out_cancel(query_id, reason)
+        return True
+
+    def _fan_out_cancel(self, query_id: str, reason: str) -> None:
+        """Out-of-band cancel op to every worker + the broker mailbox:
+        in-flight stages abort at their next op boundary, their mailbox
+        queues are poisoned/dropped, and downstream receivers fail fast
+        instead of blocking on a sender that will never speak."""
+        for w in self.workers.values():
+            try:
+                w.cancel(query_id, reason)
+            except Exception:  # noqa: BLE001 — best effort, per worker
+                pass
+        self.mailbox.abort_query(query_id, reason)
+
+    def _stage_progress(self, query_id: str) -> str:
+        """Honest per-stage accounting for a partial answer: which
+        stages were still in flight on each worker when the query died."""
+        pending = {inst: w.active_stages(query_id)
+                   for inst, w in self.workers.items()
+                   if w.alive and w.active_stages(query_id)}
+        dead = sorted(inst for inst, w in self.workers.items()
+                      if not w.alive)
+        parts = []
+        if pending:
+            parts.append("stages in flight: " + ", ".join(
+                f"{inst}:{n}" for inst, n in sorted(pending.items())))
+        if dead:
+            parts.append(f"dead workers: {dead}")
+        return "; ".join(parts) if parts else "all stages drained"
+
+    def _execute(self, plan: QueryPlan,
+                 default_timeout_ms: Optional[float] = None) -> Block:
         with _SEQ_LOCK:
             qid = f"mse_{next(_QUERY_SEQ)}_{int(time.time() * 1000)}"
-        timeout = float(plan.options.get("timeoutMs", 60000)) / 1000.0
+        timeout_ms = self._timeout_ms(plan.options, default_timeout_ms)
+        timeout = timeout_ms / 1000.0
+        start = time.time()
+        deadline = start + timeout if self.enforce_deadlines else None
 
         addresses: Dict[str, str] = {}
         for s in plan.stages:
@@ -189,21 +359,49 @@ class QueryDispatcher:
                     else self.workers[inst].mailbox_address
                 addresses[f"{s.stage_id}:{w}"] = addr
 
+        cancel_event = threading.Event()
+        with self._inflight_lock:
+            self._inflight[qid] = cancel_event
+
         plan_json = {"stages": [s.to_json() for s in plan.stages],
                      "options": plan.options}
-        for s in plan.stages[1:]:
-            sj = s.to_json()
-            for w, inst in enumerate(s.workers):
-                self.workers[inst].submit_stage(
-                    qid, plan_json, sj, w, addresses, timeout=timeout)
+        try:
+            for s in plan.stages[1:]:
+                sj = s.to_json()
+                for w, inst in enumerate(s.workers):
+                    # chaos site: delay/fail the dispatch of one stage
+                    fire("mse.dispatch.stage", instance=inst,
+                         query_id=qid, stage=s.stage_id)
+                    self.workers[inst].submit_stage(
+                        qid, plan_json, sj, w, addresses, timeout=timeout,
+                        deadline=deadline)
 
-        ctx = StageContext(
-            query_id=qid, plan=plan, worker_id="broker", worker_idx=0,
-            mailbox=self.mailbox, addresses=addresses, scan_fn=None,
-            timeout=timeout)
-        block = run_stage(ctx, plan.root)
-        assert block is not None
-        return block
+            ctx = StageContext(
+                query_id=qid, plan=plan, worker_id="broker", worker_idx=0,
+                mailbox=self.mailbox, addresses=addresses, scan_fn=None,
+                timeout=timeout, deadline=deadline,
+                cancel_event=cancel_event)
+            try:
+                block = run_stage(ctx, plan.root)
+            except (BrokerTimeoutError, MailboxTimeout) as e:
+                # broker-side miss: answer typed, with honest per-stage
+                # progress (the BaseException hook below fans out the
+                # cancel so no mailbox queue outlives the query)
+                raise MseQueryTimeout(
+                    f"query {qid} missed its {timeout_ms:.0f}ms budget "
+                    f"({self._stage_progress(qid)})") from e
+            assert block is not None
+            return block
+        except BaseException:
+            # ANY failure — deadline, client cancel, worker death, torn
+            # frame, dispatch chaos, op error — aborts the rest of the
+            # query everywhere: stages still running would otherwise
+            # block on receivers that are never drained
+            self._fan_out_cancel(qid, "query aborted")
+            raise
+        finally:
+            with self._inflight_lock:
+                self._inflight.pop(qid, None)
 
 
 def _infer_type(arr: np.ndarray) -> str:

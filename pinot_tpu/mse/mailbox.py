@@ -12,15 +12,37 @@ Same-instance sends short-circuit the socket (the InMemory mailbox path).
 Mailbox key: "<queryId>|<senderStage>|<receiverStage>|<receiverWorker>".
 Each sender worker sends its partition blocks then one EOS; the receiver
 drains until it counts EOS from every sender worker.
+
+Reliability (ISSUE 7):
+
+* ``receive_all`` takes a hard wall (absolute ``deadline``) and a
+  ``cancel_event`` — a deadline miss raises ``MailboxTimeout`` and a
+  cancel raises ``MailboxAborted``, both typed, never a silent hang.
+* A **sender-death detector**: while blocked, the receiver periodically
+  TCP-probes the pending senders' mailbox addresses; a dead endpoint
+  (worker crashed, listener gone) raises ``MailboxError`` immediately
+  instead of waiting out the full timeout.
+* ``abort_query`` poisons every mailbox of a query id: blocked receivers
+  wake with an ERROR frame, later receivers fail fast, and late frames
+  from in-flight senders are dropped — so a cancelled query leaves zero
+  orphaned queues.
+* ``send`` retries exactly once on a fresh socket (a pooled connection
+  to a restarted peer is stale) before surfacing the failure.
+* Failpoint sites ``mse.mailbox.send`` / ``mse.mailbox.recv`` tear,
+  delay, or fail individual frames deterministically (utils/failpoints).
 """
 from __future__ import annotations
 
-import asyncio
 import queue
 import socket
 import struct
 import threading
-from typing import Dict, Optional, Tuple
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+import asyncio
+
+from pinot_tpu.utils.failpoints import fire
 
 _LEN = struct.Struct("<I")
 _KEYLEN = struct.Struct("<H")
@@ -28,13 +50,22 @@ _KEYLEN = struct.Struct("<H")
 FLAG_EOS = 1
 FLAG_ERROR = 2
 
+#: cadence of the sender-death probe while a receiver is blocked
+_PROBE_INTERVAL_S = 0.25
+#: per-endpoint TCP connect timeout for one probe
+_PROBE_CONNECT_S = 0.2
+
 
 class MailboxError(RuntimeError):
     pass
 
 
 class MailboxTimeout(MailboxError):
-    pass
+    """The receive deadline expired with senders still pending."""
+
+
+class MailboxAborted(MailboxError):
+    """The query was cancelled/aborted out of band (poisoned mailbox)."""
 
 
 def mailbox_key(query_id: str, sender_stage: int, receiver_stage: int,
@@ -42,22 +73,40 @@ def mailbox_key(query_id: str, sender_stage: int, receiver_stage: int,
     return f"{query_id}|{sender_stage}|{receiver_stage}|{receiver_worker}"
 
 
+def _qid_of(key: str) -> str:
+    return key.split("|", 1)[0]
+
+
 class MailboxService:
     """Per-instance mailbox endpoint: TCP listener + local queues."""
 
+    #: aborted-query memo size: late frames for these ids are dropped.
+    #: Sized so eviction needs this many aborts while a frame of the
+    #: evicted query is STILL in flight (an in-flight window of seconds)
+    #: — past it, a straggler frame could recreate a queue nobody drains
+    MAX_ABORTED = 4096
+
     def __init__(self, instance_id: str, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, metrics=None):
+        from pinot_tpu.utils.metrics import get_registry
         self.instance_id = instance_id
         self.host = host
         self.port = port
         self._queues: Dict[str, "queue.Queue[Tuple[int, bytes]]"] = {}
         self._qlock = threading.Lock()
+        #: query_id -> abort reason; frames for these ids are dropped and
+        #: receivers fail fast (bounded FIFO memo)
+        self._aborted: "OrderedDict[str, str]" = OrderedDict()
         self._conns: Dict[str, socket.socket] = {}
         self._conn_lock = threading.Lock()
         self._server: Optional[asyncio.AbstractServer] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._thread: Optional[threading.Thread] = None
         self._started = threading.Event()
+        self._stopped = False
+        self._metrics = metrics if metrics is not None \
+            else get_registry("server")
+        self._labels = {"instance": instance_id}
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
@@ -88,11 +137,19 @@ class MailboxService:
             raise RuntimeError("mailbox service failed to start")
 
     def stop(self) -> None:
-        if self._loop is not None:
+        """Idempotent: a chaos-crashed worker stops its own mailbox, and
+        the cluster teardown stops it again."""
+        if self._stopped:
+            return
+        self._stopped = True
+        if self._loop is not None and not self._loop.is_closed():
             def shutdown():
                 for task in asyncio.all_tasks(self._loop):
                     task.cancel()
-            self._loop.call_soon_threadsafe(shutdown)
+            try:
+                self._loop.call_soon_threadsafe(shutdown)
+            except RuntimeError:
+                pass  # loop already closed
         if self._thread is not None:
             self._thread.join(timeout=5)
         with self._conn_lock:
@@ -119,35 +176,97 @@ class MailboxService:
                 key = frame[2:2 + klen].decode()
                 flags = frame[2 + klen]
                 payload = frame[3 + klen:]
-                self._queue(key).put((flags, payload))
+                self._deliver(key, flags, payload)
         except (asyncio.IncompleteReadError, ConnectionResetError):
             pass
         finally:
             writer.close()
 
-    def _queue(self, key: str) -> "queue.Queue[Tuple[int, bytes]]":
+    def _deliver(self, key: str, flags: int, payload: bytes) -> None:
+        """Route one inbound frame to its queue — unless the query was
+        aborted, in which case the frame is dropped (a poisoned query
+        must not resurrect its queues)."""
         with self._qlock:
+            if _qid_of(key) in self._aborted:
+                return
             q = self._queues.get(key)
             if q is None:
                 q = self._queues[key] = queue.Queue()
-            return q
+        q.put((flags, payload))
 
     def receive_all(self, key: str, num_senders: int,
-                    timeout: float = 60.0):
+                    timeout: float = 60.0,
+                    deadline: Optional[float] = None,
+                    cancel_event: Optional[threading.Event] = None,
+                    sender_addresses: Optional[List[str]] = None):
         """Yield payload bytes until EOS from every sender; raises on an
-        ERROR frame. Removes the queue when drained."""
-        q = self._queue(key)
+        ERROR frame. Removes the queue when drained.
+
+        deadline: absolute wall-clock hard wall (overrides ``timeout``).
+        cancel_event: cooperative out-of-band cancel — raises
+        MailboxAborted at the next wait slice.
+        sender_addresses: mailbox endpoints of the pending senders; while
+        blocked, they are TCP-probed every ~250ms and a dead endpoint
+        raises MailboxError instead of waiting out the timeout."""
+        import time as _time
+        qid = _qid_of(key)
+        # memo check + queue registration are ATOMIC: an abort landing
+        # between them would otherwise poison the popped queues, then
+        # this receiver registers a fresh unpoisoned queue and blocks
+        # while every later frame is dropped by the memo
+        with self._qlock:
+            reason = self._aborted.get(qid)
+            if reason is None:
+                q = self._queues.get(key)
+                if q is None:
+                    q = self._queues[key] = queue.Queue()
+        if reason is not None:
+            raise MailboxAborted(f"mailbox {key}: {reason}")
+        wall = deadline if deadline is not None \
+            else _time.time() + timeout
+        budget = wall - _time.time()
         eos_seen = 0
+        next_probe = _time.time() + _PROBE_INTERVAL_S
         try:
             while eos_seen < num_senders:
-                try:
-                    flags, payload = q.get(timeout=timeout)
-                except queue.Empty:
+                now = _time.time()
+                if cancel_event is not None and cancel_event.is_set():
+                    raise MailboxAborted(f"mailbox {key}: query cancelled")
+                if now >= wall:
                     raise MailboxTimeout(
-                        f"mailbox {key}: timed out after {timeout}s "
-                        f"({eos_seen}/{num_senders} senders done)") from None
+                        f"mailbox {key}: timed out after {budget:.3f}s "
+                        f"({eos_seen}/{num_senders} senders done)")
+                slice_s = min(wall - now, _PROBE_INTERVAL_S)
+                try:
+                    flags, payload = q.get(timeout=slice_s)
+                except queue.Empty:
+                    if sender_addresses and _time.time() >= next_probe \
+                            and wall - _time.time() > _PROBE_CONNECT_S:
+                        dead = self._probe_senders(sender_addresses,
+                                                   stop_at=wall)
+                        if dead:
+                            raise MailboxError(
+                                f"mailbox {key}: sender(s) {dead} are "
+                                f"dead ({eos_seen}/{num_senders} senders "
+                                f"done)") from None
+                        next_probe = _time.time() + _PROBE_INTERVAL_S
+                    continue
+                payload = fire("mse.mailbox.recv", payload=payload,
+                               instance=self.instance_id, key=key)
+                self._metrics.add_meter("mse_mailbox_recv_frames",
+                                        labels=self._labels)
+                self._metrics.add_meter("mse_mailbox_recv_bytes",
+                                        len(payload), labels=self._labels)
                 if flags & FLAG_ERROR:
-                    raise MailboxError(payload.decode(errors="replace"))
+                    msg = payload.decode(errors="replace")
+                    with self._qlock:
+                        aborted = qid in self._aborted
+                    if aborted:
+                        # the poison frame abort_query used to wake this
+                        # receiver — surface it TYPED as an abort, not as
+                        # a generic upstream error
+                        raise MailboxAborted(f"mailbox {key}: {msg}")
+                    raise MailboxError(msg)
                 if payload:
                     yield payload
                 if flags & FLAG_EOS:
@@ -156,31 +275,111 @@ class MailboxService:
             with self._qlock:
                 self._queues.pop(key, None)
 
+    def _probe_senders(self, addresses: List[str],
+                       stop_at: Optional[float] = None) -> List[str]:
+        """TCP-connect to each (unique, remote) sender endpoint; returns
+        the addresses that refused — a closed listener means the sender
+        process/worker is gone and its EOS will never come.
+
+        Frames carry no sender identity, so a sender that died AFTER
+        delivering its EOS is indistinguishable from one that died
+        pending; the probe is deliberately conservative the other way —
+        fail fast with a typed partial (a retry converges) rather than
+        block a completable query on an ambiguous corpse.
+
+        stop_at: hard cap — probing never overruns the receive wall even
+        when many endpoints each eat the full connect timeout."""
+        import time as _time
+        dead = []
+        for addr in sorted(set(addresses)):
+            if addr == self.address:
+                continue  # self is trivially alive
+            if stop_at is not None and _time.time() >= stop_at:
+                break  # the deadline check owns anything past the wall
+            host, port = addr.rsplit(":", 1)
+            try:
+                s = socket.create_connection((host, int(port)),
+                                             timeout=_PROBE_CONNECT_S)
+                s.close()
+            except OSError:
+                dead.append(addr)
+        return dead
+
     def discard(self, key: str) -> None:
         """Drop a queue (undrained partition after an error elsewhere)."""
         with self._qlock:
             self._queues.pop(key, None)
 
+    def abort_query(self, query_id: str, reason: str = "cancelled") -> int:
+        """Poison every mailbox of a query: blocked receivers wake with an
+        ERROR frame (they hold the queue reference, so popping the map
+        first still reaches them), later receivers fail fast on the
+        aborted memo, and in-flight senders' late frames are dropped.
+        Returns the number of queues poisoned."""
+        payload = reason.encode()
+        with self._qlock:
+            self._aborted[query_id] = reason
+            self._aborted.move_to_end(query_id)
+            while len(self._aborted) > self.MAX_ABORTED:
+                self._aborted.popitem(last=False)
+            victims = [self._queues.pop(k)
+                       for k in list(self._queues)
+                       if _qid_of(k) == query_id]
+        for q in victims:
+            q.put((FLAG_ERROR, payload))
+        if victims:
+            self._metrics.add_meter("mse_mailbox_poisoned", len(victims),
+                                    labels=self._labels)
+        return len(victims)
+
+    def queue_count(self, query_id: Optional[str] = None) -> int:
+        """Live queue count (optionally for one query) — the orphan
+        guard tests assert this drains to zero."""
+        with self._qlock:
+            if query_id is None:
+                return len(self._queues)
+            return sum(1 for k in self._queues if _qid_of(k) == query_id)
+
     # -- sending ------------------------------------------------------------
     def send(self, dest_address: str, key: str, payload: bytes,
              flags: int = 0) -> None:
+        # chaos edge: tear (truncate) / delay / fail the payload before
+        # framing — truncating INSIDE a frame would desync the stream,
+        # so the torn payload still frames cleanly and surfaces as a
+        # typed decode error on the receiver
+        payload = fire("mse.mailbox.send", payload=payload,
+                       instance=self.instance_id, key=key,
+                       dest=dest_address)
+        self._metrics.add_meter("mse_mailbox_sent_frames",
+                                labels=self._labels)
+        self._metrics.add_meter("mse_mailbox_sent_bytes", len(payload),
+                                labels=self._labels)
         if dest_address == self.address:
-            self._queue(key).put((flags, payload))
+            self._deliver(key, flags, payload)
             return
         kb = key.encode()
         frame = _KEYLEN.pack(len(kb)) + kb + bytes([flags]) + payload
         msg = _LEN.pack(len(frame)) + frame
         with self._conn_lock:
-            sock = self._conns.get(dest_address)
             try:
+                sock = self._conns.get(dest_address)
                 if sock is None:
                     sock = self._connect(dest_address)
                 sock.sendall(msg)
             except (ConnectionError, OSError):
-                # one reconnect attempt (peer restarted)
+                # one retry on a FRESH socket: the pooled connection (or
+                # the first dial) hit a restarted/flaky peer — a second
+                # dial catches the common stale-socket case without
+                # masking a genuinely dead endpoint
                 self._drop(dest_address)
-                sock = self._connect(dest_address)
-                sock.sendall(msg)
+                self._metrics.add_meter("mse_mailbox_retries",
+                                        labels=self._labels)
+                try:
+                    sock = self._connect(dest_address)
+                    sock.sendall(msg)
+                except (ConnectionError, OSError):
+                    self._drop(dest_address)
+                    raise
 
     def _connect(self, dest_address: str) -> socket.socket:
         host, port = dest_address.rsplit(":", 1)

@@ -10,6 +10,8 @@ per the stage's exchange, and pushes to the receiver workers' mailboxes.
 from __future__ import annotations
 
 import threading
+import time
+from collections import OrderedDict
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
@@ -17,9 +19,12 @@ import numpy as np
 from pinot_tpu.mse import operators as ops
 from pinot_tpu.mse.blocks import Block
 from pinot_tpu.mse.mailbox import (
-    FLAG_EOS, FLAG_ERROR, MailboxService, mailbox_key)
+    FLAG_EOS, FLAG_ERROR, MailboxError, MailboxService, mailbox_key)
 from pinot_tpu.mse.planner import QueryPlan, StagePlan
 from pinot_tpu.mse.serde import expr_from_json, exprs_from_json
+from pinot_tpu.utils.accounting import (
+    BrokerTimeoutError, QueryCancelledError)
+from pinot_tpu.utils.failpoints import SimulatedCrash, fire
 
 #: a scan callback: (table, columns, filter_expr_or_None) -> Block with the
 #: instance's local rows for the table (qualified names applied by caller)
@@ -32,7 +37,10 @@ class StageContext:
     def __init__(self, query_id: str, plan: QueryPlan, worker_id: str,
                  worker_idx: int, mailbox: MailboxService,
                  addresses: Dict[str, str], scan_fn: Optional[ScanFn],
-                 timeout: float = 60.0, leaf_query_fn=None):
+                 timeout: float = 60.0, leaf_query_fn=None,
+                 deadline: Optional[float] = None,
+                 cancel_event: Optional[threading.Event] = None,
+                 stage_cache=None, segment_versions_fn=None):
         self.query_id = query_id
         self.plan = plan
         self.worker_id = worker_id
@@ -46,21 +54,69 @@ class StageContext:
         #: single-stage executor (TPU engine included) — the
         #: LeafStageTransferableBlockOperator bridge; None on the broker
         self.leaf_query_fn = leaf_query_fn
+        #: absolute wall-clock deadline for the whole query; None = no
+        #: budget (legacy callers). Enforced cooperatively at every op
+        #: boundary and as a hard wall on mailbox receives.
+        self.deadline = deadline
+        #: out-of-band cancel (broker deadline miss / client cancel)
+        self.cancel_event = cancel_event or threading.Event()
+        #: set by the worker's crash handler on SIBLING stages of a
+        #: SimulatedCrash: the whole worker is "dead", so this stage
+        #: must die SILENTLY — no error frames, no output sends —
+        #: leaving detection to the receivers' sender-death probe
+        self.worker_crashed = False
+        #: leaf-stage output cache (mse/stage_cache.py), worker-side only
+        self.stage_cache = stage_cache
+        #: table -> sorted ((name, version), ...) of the instance's local
+        #: segments, or None when any is mutable — the cache key source
+        self.segment_versions_fn = segment_versions_fn
+
+    def check(self) -> None:
+        """Cooperative cancel/deadline poll — the same discipline as the
+        single-stage accountant's check_cancelled (utils/accounting)."""
+        if self.cancel_event.is_set():
+            raise QueryCancelledError(
+                f"query {self.query_id} cancelled")
+        if self.deadline is not None and time.time() > self.deadline:
+            raise BrokerTimeoutError(
+                f"query {self.query_id} exceeded its deadline")
+
+    def remaining_s(self) -> float:
+        if self.deadline is None:
+            return self.timeout
+        return max(0.0, self.deadline - time.time())
 
 
 def run_stage(ctx: StageContext, stage: StagePlan) -> Optional[Block]:
     """Execute one stage instance. Root stage (receiver_stage < 0) returns
-    its block; other stages push to their receivers and return None."""
+    its block; other stages push to their receivers and return None.
+
+    A ``SimulatedCrash`` (chaos worker kill) escapes WITHOUT propagating
+    error frames — the worker must vanish silently, leaving detection to
+    the receivers' sender-death probe."""
     try:
         try:
-            block = _run_op(ctx, stage.root)
+            fire("mse.stage.execute", instance=ctx.worker_id,
+                 query_id=ctx.query_id, stage=stage.stage_id)
+            block = _run_leaf_cached(ctx, stage)
+        except SimulatedCrash:
+            raise  # vanish: no error frames, no receiver handshake
         except Exception as e:  # noqa: BLE001 — report receivers, don't hang
+            if ctx.worker_crashed:
+                # sibling of a crashed worker: a dead process can't send
+                # error frames over its live outbound sockets either —
+                # stay silent so receivers exercise the death probe
+                if stage.receiver_stage < 0:
+                    raise
+                return None
             _propagate_error(ctx, stage, f"{type(e).__name__}: {e}")
             if stage.receiver_stage < 0:
                 raise
             return None
         if stage.receiver_stage < 0:
             return block
+        if ctx.worker_crashed:
+            return None  # computed past the crash: output dies with us
         _send_output(ctx, stage, block)
         return None
     finally:
@@ -68,6 +124,25 @@ def run_stage(ctx: StageContext, stage: StagePlan) -> Optional[Block]:
         # join whose OTHER input errored first) — they'd leak otherwise
         for key in _receive_keys(ctx, stage.root):
             ctx.mailbox.discard(key)
+
+
+def _run_leaf_cached(ctx: StageContext, stage: StagePlan) -> Block:
+    """Leaf stages (scan / leaf_agg over immutable local segments) serve
+    from the stage-output cache when the (segment version set, stage-plan
+    fingerprint) key hits; everything else executes directly. Only clean,
+    in-deadline completions are stored — never partials."""
+    cache = ctx.stage_cache
+    key = cache.key_for(stage.root, ctx.segment_versions_fn) \
+        if cache is not None else None
+    if key is not None:
+        hit = cache.get(key)
+        if hit is not None:
+            return hit
+    block = _run_op(ctx, stage.root)
+    if key is not None:
+        ctx.check()  # a deadline-clipped run must not populate the cache
+        cache.put(key, block)
+    return block
 
 
 def _propagate_error(ctx: StageContext, stage: StagePlan, msg: str) -> None:
@@ -111,6 +186,10 @@ def _send_output(ctx: StageContext, stage: StagePlan, block: Block) -> None:
 # ---------------------------------------------------------------------------
 
 def _run_op(ctx: StageContext, op: Dict[str, Any]) -> Block:
+    # cooperative deadline/cancel poll at every op boundary: block ops
+    # are coarse (one vectorized pass each), so this is the same "check
+    # between units of work" discipline as the per-segment loop
+    ctx.check()
     kind = op["op"]
     if kind == "receive":
         return _op_receive(ctx, op)
@@ -182,8 +261,24 @@ def _op_receive(ctx: StageContext, op: Dict[str, Any]) -> Block:
     sender = ctx.plan.stage(op["stage"])
     key = mailbox_key(ctx.query_id, sender.stage_id,
                       sender.receiver_stage, ctx.worker_idx)
-    blocks = [Block.from_bytes(p) for p in ctx.mailbox.receive_all(
-        key, num_senders=len(sender.workers), timeout=ctx.timeout)]
+    # sender endpoints feed the mailbox's death probe: a crashed worker
+    # whose listener is gone raises a typed MailboxError immediately
+    # instead of waiting out the whole deadline
+    sender_addresses = [
+        ctx.addresses[f"{sender.stage_id}:{w}"]
+        for w in range(len(sender.workers))
+        if f"{sender.stage_id}:{w}" in ctx.addresses]
+    blocks = []
+    for p in ctx.mailbox.receive_all(
+            key, num_senders=len(sender.workers), timeout=ctx.timeout,
+            deadline=ctx.deadline, cancel_event=ctx.cancel_event,
+            sender_addresses=sender_addresses):
+        try:
+            blocks.append(Block.from_bytes(p))
+        except Exception as e:  # noqa: BLE001 — torn/corrupt frame
+            raise MailboxError(
+                f"mailbox {key}: undecodable frame "
+                f"({type(e).__name__}: {e})") from e
     blocks = [b for b in blocks if b.num_rows]
     if not blocks:
         return _typed_empty(op["schema"])
@@ -364,12 +459,26 @@ class MseWorker:
     """
 
     def __init__(self, instance_id: str, scan_fn: Optional[ScanFn],
-                 leaf_query_fn=None):
+                 leaf_query_fn=None, stage_cache=None,
+                 segment_versions_fn=None):
         self.instance_id = instance_id
         self.scan_fn = scan_fn
         self.leaf_query_fn = leaf_query_fn
         self.mailbox = MailboxService(instance_id)
         self._lock = threading.Lock()
+        #: leaf-stage output cache + its version-set provider (may be None)
+        self.stage_cache = stage_cache
+        self.segment_versions_fn = segment_versions_fn
+        #: query_id -> in-flight stage contexts (cancel fan-out targets)
+        self._active: Dict[str, List[StageContext]] = {}
+        #: recently-cancelled query ids (bounded FIFO): a submit_stage
+        #: racing in AFTER the cancel fan-out must be rejected, or its
+        #: fresh context (new cancel_event) would run the stage to
+        #: completion on a dead query
+        self._cancelled: "OrderedDict[str, None]" = OrderedDict()
+        #: chaos kill flag: a SimulatedCrash vanished this worker — its
+        #: mailbox is stopped and the dispatcher routes around it
+        self.crashed = False
 
     def start(self) -> None:
         self.mailbox.start()
@@ -378,14 +487,23 @@ class MseWorker:
         self.mailbox.stop()
 
     @property
+    def alive(self) -> bool:
+        return not self.crashed
+
+    @property
     def mailbox_address(self) -> str:
         return self.mailbox.address
 
     def submit_stage(self, query_id: str, plan_json: Dict[str, Any],
                      stage_json: Dict[str, Any], worker_idx: int,
                      addresses: Dict[str, str],
-                     timeout: float = 60.0) -> None:
-        """Async: schedule one stage instance on the pool."""
+                     timeout: float = 60.0,
+                     deadline: Optional[float] = None) -> None:
+        """Async: schedule one stage instance on the pool. ``deadline``
+        is the query's absolute wall-clock budget (travels with the
+        stage; enforced cooperatively and on every mailbox wait)."""
+        if self.crashed:
+            return  # a vanished worker accepts nothing
         plan = QueryPlan(
             stages=[StagePlan.from_json(s) for s in plan_json["stages"]],
             options=plan_json.get("options", {}))
@@ -394,11 +512,78 @@ class MseWorker:
             query_id=query_id, plan=plan, worker_id=self.instance_id,
             worker_idx=worker_idx, mailbox=self.mailbox,
             addresses=addresses, scan_fn=self.scan_fn, timeout=timeout,
-            leaf_query_fn=self.leaf_query_fn)
+            leaf_query_fn=self.leaf_query_fn, deadline=deadline,
+            stage_cache=self.stage_cache,
+            segment_versions_fn=self.segment_versions_fn)
+        # memo check + registration are atomic with cancel(): either the
+        # cancel sees this context in _active, or this check sees the
+        # cancelled memo — a late stage can never slip between them
+        with self._lock:
+            if query_id in self._cancelled:
+                return
+            self._active.setdefault(query_id, []).append(ctx)
+
+        def _run():
+            try:
+                # chaos kill site: SimulatedCrash here (or anywhere in
+                # the stage, incl. a mid-shuffle mailbox send) makes the
+                # whole worker vanish — no error frames, mailbox gone
+                fire("mse.worker.crash", instance=self.instance_id,
+                     query_id=query_id, stage=stage.stage_id)
+                run_stage(ctx, stage)
+            except SimulatedCrash:
+                # the whole worker vanishes, not just this stage: flag
+                # death first (submit_stage starts rejecting), abort
+                # every in-flight stage + local queue, then drop the
+                # listener — sibling stage threads die at their next op
+                # boundary instead of zombie-executing on a dead worker
+                self.crashed = True
+                with self._lock:
+                    doomed = {q: list(v) for q, v in self._active.items()}
+                for q, ctxs in doomed.items():
+                    for c in ctxs:
+                        c.worker_crashed = True  # die SILENTLY
+                        c.cancel_event.set()
+                    self.mailbox.abort_query(q, "worker crashed")
+                self.mailbox.stop()
+            except Exception:  # noqa: BLE001 — run_stage already reported
+                pass
+            finally:
+                with self._lock:
+                    ctxs = self._active.get(query_id)
+                    if ctxs is not None:
+                        try:
+                            ctxs.remove(ctx)
+                        except ValueError:
+                            pass
+                        if not ctxs:
+                            del self._active[query_id]
+
         # one thread per stage instance: receive ops BLOCK on producer
         # stages, so a bounded pool would deadlock once every thread holds
         # a receive-blocked instance (e.g. deep join trees / concurrency)
         threading.Thread(
-            target=run_stage, args=(ctx, stage), daemon=True,
+            target=_run, daemon=True,
             name=f"mse-{self.instance_id}-{query_id}-s{stage.stage_id}",
         ).start()
+
+    def cancel(self, query_id: str, reason: str = "cancelled") -> None:
+        """Out-of-band cancel for one query: flags every in-flight stage
+        context (next op boundary aborts), rejects late submits via a
+        bounded memo, then poisons the mailbox so blocked receivers
+        wake, later receivers fail fast, and stray frames are dropped —
+        no stage ever blocks on a dead sender."""
+        with self._lock:
+            self._cancelled[query_id] = None
+            while len(self._cancelled) > 256:
+                self._cancelled.popitem(last=False)
+            ctxs = list(self._active.get(query_id, ()))
+        for c in ctxs:
+            c.cancel_event.set()
+        self.mailbox.abort_query(query_id, reason)
+
+    def active_stages(self, query_id: Optional[str] = None) -> int:
+        with self._lock:
+            if query_id is not None:
+                return len(self._active.get(query_id, ()))
+            return sum(len(v) for v in self._active.values())

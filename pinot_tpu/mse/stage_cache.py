@@ -1,0 +1,114 @@
+"""Leaf-stage output cache for the multi-stage engine.
+
+Tier-2's discipline (cache/segment_cache.py) lifted one level: instead of
+one segment's partial for one plan fingerprint, the cached unit is one
+WORKER's whole leaf-stage output block (scan or leaf_agg over the
+instance's local segments) for one stage-plan fingerprint.
+
+Keying mirrors the tier-2 partial cache: the key carries the **version
+set** of every immutable segment the stage reads — ``(sorted (name,
+version) tuples per table, stage-plan fingerprint)`` — so a segment
+add/replace/remove addresses a different key and the stale entry ages
+out (epoch invalidation by construction). A table with ANY non-cacheable
+segment (consuming / live upsert bitmap) yields no version set and the
+stage re-executes every time, which is exactly what keeps hybrid tables
+fresh.
+
+Partials are never cached: the runtime only calls ``put`` after a stage
+completed cleanly inside its deadline — an aborted, errored, or
+deadline-clipped run stores nothing.
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Optional, Tuple
+
+from pinot_tpu.cache.core import LruTtlCache
+from pinot_tpu.mse.blocks import Block
+
+
+def stage_fingerprint(stage_root: Dict[str, Any]) -> str:
+    """Deterministic fingerprint of a stage's physical op tree (filter
+    literals, projections, agg nodes and schemas included)."""
+    return json.dumps(stage_root, sort_keys=True, separators=(",", ":"))
+
+
+def collect_scan_tables(op: Dict[str, Any]) -> Tuple[str, ...]:
+    """All tables a stage op tree scans (empty for non-leaf stages)."""
+    out = []
+    if op.get("op") == "scan":
+        out.append(op["table"])
+    for k in ("child", "left", "right"):
+        child = op.get(k)
+        if isinstance(child, dict):
+            out.extend(collect_scan_tables(child))
+    return tuple(out)
+
+
+class StageOutputCache:
+    """Leaf-stage output blocks keyed by
+    ((table, segment version set)..., stage-plan fingerprint)."""
+
+    def __init__(self, max_bytes: int = 64 << 20,
+                 ttl_seconds: float = 300.0, enabled: bool = True,
+                 metrics=None, labels: Optional[dict] = None):
+        self.enabled = enabled
+        self._cache = LruTtlCache(max_bytes, ttl_seconds, metrics=metrics,
+                                  metric_prefix="mse_stage_cache",
+                                  labels=labels)
+
+    @classmethod
+    def from_config(cls, config, metrics=None,
+                    labels: Optional[dict] = None) -> "StageOutputCache":
+        return cls(
+            max_bytes=config.get_int("pinot.server.mse.stage.cache.bytes"),
+            ttl_seconds=config.get_float(
+                "pinot.server.mse.stage.cache.ttl.seconds"),
+            enabled=config.get_bool(
+                "pinot.server.mse.stage.cache.enabled"),
+            metrics=metrics, labels=labels)
+
+    # ------------------------------------------------------------------
+    def key_for(self, stage_root: Dict[str, Any],
+                segment_versions_fn) -> Optional[tuple]:
+        """Cache key for a leaf stage, or None when the stage must not be
+        cached: not a leaf (no scans), no version provider bound, or any
+        scanned table carries a non-cacheable (mutable) segment."""
+        if not self.enabled or segment_versions_fn is None:
+            return None
+        tables = collect_scan_tables(stage_root)
+        if not tables:
+            return None
+        version_sets = []
+        for table in sorted(set(tables)):
+            versions = segment_versions_fn(table)
+            if versions is None:
+                return None  # mutable tail present: never cache
+            version_sets.append((table, versions))
+        return (tuple(version_sets), stage_fingerprint(stage_root))
+
+    def get(self, key: Optional[tuple]) -> Optional[Block]:
+        if key is None:
+            return None
+        payload = self._cache.get(key)
+        if payload is None:
+            return None
+        try:
+            return Block.from_bytes(payload)
+        except Exception:  # noqa: BLE001 — undecodable entry = miss
+            return None
+
+    def put(self, key: Optional[tuple], block: Block) -> bool:
+        if key is None:
+            return False
+        return self._cache.put(key, block.to_bytes())
+
+    def clear(self) -> None:
+        self._cache.clear()
+
+    @property
+    def stats(self):
+        return self._cache.stats
+
+    def __len__(self) -> int:
+        return len(self._cache)

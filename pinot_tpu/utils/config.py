@@ -86,6 +86,17 @@ KEYS: Dict[str, Any] = {
     "pinot.broker.hedge.enabled": False,
     "pinot.broker.hedge.delay.min.ms": 25,
     "pinot.broker.hedge.delay.max.ms": 1000,
+    # multi-stage engine budget: OPTION(timeoutMs=...) > this knob >
+    # pinot.broker.timeout.ms — the budget travels in every stage and is
+    # enforced on every mailbox wait ("" = inherit the broker default)
+    "pinot.broker.mse.timeout.ms": None,
+    # leaf-stage output cache (mse/stage_cache.py): one worker's whole
+    # scan/leaf_agg stage block per (segment version set, stage-plan
+    # fingerprint) — epoch-invalidated like the tier-2 partial cache,
+    # never caches partials, and skips tables with a mutable tail
+    "pinot.server.mse.stage.cache.enabled": True,
+    "pinot.server.mse.stage.cache.bytes": 64 << 20,
+    "pinot.server.mse.stage.cache.ttl.seconds": 300.0,
     # negative cache: memoize pruned-to-zero plans (epoch-keyed) so
     # dashboard misfires skip routing + scatter entirely
     "pinot.broker.negative.cache.enabled": True,
@@ -138,6 +149,10 @@ KEYS: Dict[str, Any] = {
     "pinot.minion.heartbeat.seconds": 2.0,
     "pinot.minion.task.types": "",   # csv; "" = all registered executors
     "pinot.minion.work.dir": "",     # "" = per-worker tempdir sandbox
+    # worker-side executor pool: a minion runs up to this many tasks
+    # concurrently (each with its own lease heartbeat); per-type caps
+    # layer on top via pinot.minion.executor.concurrency.<TaskType>
+    "pinot.minion.executor.concurrency": 2,
 }
 
 

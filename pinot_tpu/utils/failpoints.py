@@ -24,6 +24,13 @@ Sites currently compiled in:
   controller.task.lease.renew — task-fabric heartbeat renewal
   controller.segment.replace  — the atomic minion segment swap
   minion.task.execute         — worker-side, as task execution starts
+  mse.dispatch.stage          — broker-side, before one stage dispatches
+  mse.mailbox.send            — every mailbox frame send (torn=, delay=)
+  mse.mailbox.recv            — every mailbox frame receive
+  mse.stage.execute           — worker-side, as a stage instance starts
+  mse.worker.crash            — MSE worker kill point: SimulatedCrash
+                                vanishes the worker (mailbox gone, no
+                                error frames — receivers must detect)
 
 Policies are armed per site with deterministic, seeded behavior:
 

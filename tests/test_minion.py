@@ -606,3 +606,145 @@ class TestTaskHttpApi:
         finally:
             srv.stop()
             tm.stop()
+
+
+# ---------------------------------------------------------------------------
+# Lease priorities + per-table fairness (ISSUE 7 satellite)
+# ---------------------------------------------------------------------------
+
+class TestLeasePriorityAndFairness:
+    def test_table_flood_cannot_starve_other_table(self):
+        """Regression: 20 queued table-A tasks vs 2 table-B tasks — the
+        lease rotation serves B by the second grant and again within the
+        next turn, instead of draining A's FIFO backlog first."""
+        q = TaskQueue()
+        for i in range(20):
+            q.submit(TaskConfig("PurgeTask", "tableA_OFFLINE", [f"a{i}"]))
+        q.submit(TaskConfig("PurgeTask", "tableB_OFFLINE", ["b0"]))
+        q.submit(TaskConfig("PurgeTask", "tableB_OFFLINE", ["b1"]))
+        order = [q.lease(f"w{i}").table for i in range(6)]
+        assert order[:4] == ["tableA_OFFLINE", "tableB_OFFLINE",
+                             "tableA_OFFLINE", "tableB_OFFLINE"]
+        # B exhausted: the rotation degrades to FIFO over A alone
+        assert order[4:] == ["tableA_OFFLINE", "tableA_OFFLINE"]
+
+    def test_fifo_within_one_table_unchanged(self):
+        q = TaskQueue()
+        ids = [q.submit(TaskConfig("PurgeTask", "t_OFFLINE",
+                                   [f"s{i}"])).task_id for i in range(3)]
+        assert [q.lease("w0").task_id for _ in range(3)] == ids
+
+    def test_priority_beats_fifo_and_fairness(self):
+        q = TaskQueue()
+        q.submit(TaskConfig("PurgeTask", "t1_OFFLINE", ["x0"]))
+        q.submit(TaskConfig("PurgeTask", "t1_OFFLINE", ["x1"]))
+        hi = q.submit(TaskConfig("PurgeTask", "t2_OFFLINE", ["y0"],
+                                 {"priority": 5}))
+        # the priority-5 task leases first even though t1 is older AND
+        # t2 would lose the FIFO tie-break
+        assert q.lease("w0").task_id == hi.task_id
+
+    def test_explicit_priority_param_on_submit(self):
+        q = TaskQueue()
+        q.submit(TaskConfig("PurgeTask", "t_OFFLINE", ["a"]))
+        b = q.submit(TaskConfig("PurgeTask", "t_OFFLINE", ["b"]),
+                     priority=3)
+        assert q.lease("w0").task_id == b.task_id
+
+    def test_priority_survives_journal_reload(self, tmp_path):
+        path = str(tmp_path / "prio.journal")
+        q = TaskQueue(journal_path=path)
+        e = q.submit(TaskConfig("PurgeTask", "t_OFFLINE", ["a"],
+                                {"priority": 7}))
+        q2 = TaskQueue(journal_path=path)
+        assert q2.get(e.task_id).priority == 7
+
+
+# ---------------------------------------------------------------------------
+# Worker-side executor pool (ISSUE 7 satellite, carried over from PR 5)
+# ---------------------------------------------------------------------------
+
+class _GateExecutor:
+    """Test-only executor: blocks on a gate so concurrency is observable."""
+    task_type = "GateTask"
+
+    def __init__(self, gate, started):
+        self.gate = gate
+        self.started = started
+
+    def execute(self, task, ctx):
+        self.started.append(task.task_id)
+        assert self.gate.wait(30), "gate never opened"
+        return {"ok": True}
+
+
+class TestExecutorPool:
+    def _harness(self, tmp_path, overrides):
+        from pinot_tpu.controller.coordination import CoordinationServer
+        from pinot_tpu.minion.worker import MinionWorker
+        state = ClusterState()
+        conf = PinotConfiguration(overrides={
+            "pinot.minion.poll.seconds": 0.02,
+            "pinot.minion.heartbeat.seconds": 0.2,
+            **overrides})
+        tm = TaskManager(state, config=conf)
+        srv = CoordinationServer(state, task_manager=tm)
+        srv.start()
+        w = MinionWorker("m0", srv.address,
+                         work_dir=str(tmp_path / "pool_w0"),
+                         task_types=["GateTask"], config=conf)
+        w.start()
+        return tm, srv, w
+
+    def _run_gated(self, tmp_path, overrides, n_tasks, expect_parallel):
+        import threading as _threading
+        from pinot_tpu.controller.tasks import (_EXECUTORS,
+                                                register_executor)
+        gate = _threading.Event()
+        started = []
+        register_executor(_GateExecutor(gate, started))
+        tm, srv, w = self._harness(tmp_path, overrides)
+        try:
+            entries = [tm.submit(TaskConfig("GateTask", "t_OFFLINE",
+                                            [f"s{i}"]))
+                       for i in range(n_tasks)]
+            deadline = time.time() + 10
+            while len(started) < expect_parallel and \
+                    time.time() < deadline:
+                time.sleep(0.02)
+            assert len(started) == expect_parallel
+            time.sleep(0.4)  # grace: no extra task may start past the cap
+            assert len(started) == expect_parallel, \
+                f"cap violated: {len(started)} tasks running"
+            assert w.running_tasks() == expect_parallel
+            gate.set()
+            deadline = time.time() + 20
+            while time.time() < deadline:
+                states = {tm.queue.get(e.task_id).state for e in entries}
+                if states == {COMPLETED}:
+                    break
+                time.sleep(0.05)
+            assert {tm.queue.get(e.task_id).state
+                    for e in entries} == {COMPLETED}
+        finally:
+            gate.set()
+            w.stop()
+            srv.stop()
+            tm.stop()
+            _EXECUTORS.pop("GateTask", None)
+
+    def test_pool_runs_tasks_concurrently(self, tmp_path):
+        """concurrency=2: two of three tasks run in parallel (each with
+        its own lease heartbeat), the third waits for a slot, and all
+        three complete once the gate opens."""
+        self._run_gated(
+            tmp_path, {"pinot.minion.executor.concurrency": 2},
+            n_tasks=3, expect_parallel=2)
+
+    def test_per_type_cap_below_pool_size(self, tmp_path):
+        """pinot.minion.executor.concurrency.GateTask=1 holds the type
+        to one in-flight task even though the pool has two slots."""
+        self._run_gated(
+            tmp_path, {"pinot.minion.executor.concurrency": 2,
+                       "pinot.minion.executor.concurrency.GateTask": 1},
+            n_tasks=2, expect_parallel=1)

@@ -1,0 +1,524 @@
+"""MSE reliability under seeded chaos (ISSUE 7).
+
+The multi-stage engine at the single-stage bar: end-to-end deadlines with
+out-of-band cancel fan-out, worker-kill detection mid-shuffle, torn
+mailbox frames as typed errors, leaf-stage output caching, and per-seed
+exact replay of chaos schedules — mirroring tests/test_reliability.py
+for the scatter path.
+"""
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pinot_tpu.mse.blocks import Block
+from pinot_tpu.mse.dispatcher import QueryDispatcher
+from pinot_tpu.mse.mailbox import (
+    FLAG_EOS, MailboxAborted, MailboxError, MailboxService, MailboxTimeout)
+from pinot_tpu.mse.operators import filter_block
+from pinot_tpu.mse.runtime import MseWorker
+from pinot_tpu.utils.failpoints import (
+    FailpointError, FaultSchedule, SimulatedCrash, failpoints)
+
+#: slack on top of a query budget for scheduler noise + cancel fan-out;
+#: the armed chaos delays are always far above budget + EPS so a pass
+#: proves the deadline fired, not that the chaos finished
+EPS_S = 1.5
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    yield
+    failpoints.clear()
+
+
+# ---------------------------------------------------------------------------
+# mailbox primitives: hard wall, abort/poison, sender-death probe
+# ---------------------------------------------------------------------------
+
+class TestMailboxPrimitives:
+    @pytest.fixture()
+    def svc(self):
+        s = MailboxService("inst_a")
+        s.start()
+        yield s
+        s.stop()
+
+    def test_deadline_wall_is_absolute(self, svc):
+        t0 = time.time()
+        with pytest.raises(MailboxTimeout):
+            list(svc.receive_all("q1|1|0|0", num_senders=1,
+                                 deadline=time.time() + 0.3))
+        assert time.time() - t0 < 0.3 + EPS_S
+        assert svc.queue_count() == 0
+
+    def test_abort_wakes_blocked_receiver_and_leaves_no_queues(self, svc):
+        got = []
+
+        def rx():
+            try:
+                list(svc.receive_all("q2|1|0|0", num_senders=1,
+                                     timeout=30.0))
+            except MailboxError as e:
+                got.append(e)
+
+        t = threading.Thread(target=rx, daemon=True)
+        t.start()
+        deadline = time.time() + 5
+        while svc.queue_count("q2") == 0 and time.time() < deadline:
+            time.sleep(0.01)
+        svc.abort_query("q2", "cancelled by test")
+        t.join(timeout=5)
+        assert got and "cancelled by test" in str(got[0])
+        # late frames from a still-running sender are dropped, later
+        # receivers fail fast, and the queue map stays empty
+        svc.send(svc.address, "q2|1|0|0", b"late", FLAG_EOS)
+        assert svc.queue_count() == 0
+        with pytest.raises(MailboxAborted):
+            list(svc.receive_all("q2|1|0|0", num_senders=1, timeout=5.0))
+
+    def test_dead_sender_detected_before_timeout(self, svc):
+        # a listener that came up and went away: the probe sees a refused
+        # connect and raises typed, long before the 30s budget
+        peer = MailboxService("inst_b")
+        peer.start()
+        dead_addr = peer.address
+        peer.stop()
+        t0 = time.time()
+        with pytest.raises(MailboxError, match="dead"):
+            list(svc.receive_all("q3|1|0|0", num_senders=1, timeout=30.0,
+                                 sender_addresses=[dead_addr]))
+        assert time.time() - t0 < 5.0
+
+    def test_send_retries_once_on_fresh_socket(self, svc):
+        # plant a dead pooled socket for a live destination: the send
+        # must transparently redial instead of failing the stage
+        peer = MailboxService("inst_c")
+        peer.start()
+        try:
+            svc.send(peer.address, "q4|1|0|0", b"x")  # pools a socket
+            with svc._conn_lock:
+                svc._conns[peer.address].close()  # stale pooled socket
+            before = svc._metrics.meter("mse_mailbox_retries",
+                                        labels={"instance": "inst_a"})
+            for _ in range(3):  # close() may only surface on later sends
+                svc.send(peer.address, "q4|1|0|0", b"y", FLAG_EOS)
+            got = list(peer.receive_all("q4|1|0|0", num_senders=1,
+                                        timeout=5.0))
+            assert got and got[-1] == b"y"
+            after = svc._metrics.meter("mse_mailbox_retries",
+                                       labels={"instance": "inst_a"})
+            assert after >= before
+        finally:
+            peer.stop()
+
+
+# ---------------------------------------------------------------------------
+# in-process engine harness (fresh per test: chaos kills workers)
+# ---------------------------------------------------------------------------
+
+def _tables(n=1200):
+    rng = np.random.default_rng(5)
+    return {
+        "fact": {"k": rng.integers(0, 8, n).astype(np.int64),
+                 "v": rng.integers(1, 100, n).astype(np.int64)},
+        "dim": {"k": np.arange(8, dtype=np.int64),
+                "name": np.array([f"g{i}" for i in range(8)], object)},
+    }
+
+
+JOIN_SQL = ("SELECT d.name, SUM(f.v) AS s FROM fact f "
+            "JOIN dim d ON f.k = d.k GROUP BY d.name "
+            "ORDER BY d.name LIMIT 100")
+
+
+def _expected_join(tables):
+    want = {}
+    for k, v in zip(tables["fact"]["k"], tables["fact"]["v"]):
+        name = str(tables["dim"]["name"][int(k)])
+        want[name] = want.get(name, 0) + int(v)
+    return sorted(want.items())
+
+
+def _make_engine(tables, hosting):
+    """Two MseWorkers with shard scans derived from each table's host
+    list (a table hosted on one worker is scanned whole there)."""
+    insts = ["server_0", "server_1"]
+
+    def make_scan(inst):
+        def scan(table, columns, filt):
+            hosts = hosting[table]
+            if inst not in hosts:
+                return Block(columns,
+                             [np.empty(0, object) for _ in columns])
+            shard, nshards = hosts.index(inst), len(hosts)
+            t = tables[table]
+            n = len(next(iter(t.values())))
+            idx = np.arange(n) % nshards == shard
+            b = Block(list(t), [t[c][idx] for c in t])
+            if filt is not None:
+                b = filter_block(b, filt)
+            return b.select(columns)
+        return scan
+
+    workers = {}
+    for i in insts:
+        w = MseWorker(i, make_scan(i))
+        w.start()
+        workers[i] = w
+    catalog = {k: list(v) for k, v in tables.items()}
+    disp = QueryDispatcher(workers, lambda: catalog,
+                           lambda t: list(hosting[t]))
+    return disp, workers
+
+
+def _stop_engine(disp, workers):
+    for w in workers.values():
+        w.stop()
+    disp.stop()
+
+
+def _queues_drain(services, timeout_s=6.0) -> bool:
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if all(s.queue_count() == 0 for s in services):
+            return True
+        time.sleep(0.05)
+    return False
+
+
+@pytest.mark.chaos
+class TestWorkerKillMidShuffle:
+    def _run_workload(self, seed):
+        """One seeded run: kill server_1's join-stage instance (stage 2)
+        on the first query, then retry twice. Returns (outcomes,
+        decision journal)."""
+        tables = _tables()
+        # fact/dim live on server_0 only, so a dead server_1 loses no
+        # data — the retry can route around it and converge exactly
+        hosting = {"fact": ["server_0"], "dim": ["server_0"]}
+        sched = FaultSchedule([
+            ("mse.worker.crash",
+             {"error": SimulatedCrash("chaos kill"), "times": 1,
+              "seed": seed,
+              "where": {"instance": "server_1", "stage": 2}}),
+        ])
+        sched.arm()
+        disp, workers = _make_engine(tables, hosting)
+        try:
+            outcomes = []
+            for _ in range(3):
+                resp = disp.submit(JOIN_SQL)
+                outcomes.append((tuple(e["errorCode"]
+                                       for e in resp.exceptions),
+                                 resp.partial_result,
+                                 [tuple(r) for r in resp.rows]))
+            decisions = json.dumps(sched.decisions()[0][:1])
+            mailboxes = [w.mailbox for w in workers.values()
+                         if w.alive] + [disp.mailbox]
+            assert _queues_drain(mailboxes), "orphaned mailbox queues"
+            return outcomes, decisions, resp
+        finally:
+            _stop_engine(disp, workers)
+            sched.disarm()
+
+    def test_kill_converges_and_replays(self):
+        t0 = time.time()
+        out_a, dec_a, _ = self._run_workload(seed=77)
+        # query 1 died with the worker: typed errorCode-250 partial,
+        # returned quickly (death detected, not waited out)
+        assert out_a[0][0] == (250,) and out_a[0][1] is True
+        # queries 2+3 (the retry): dead worker routed around, exact rows
+        want = [(n, s) for n, s in _expected_join(_tables())]
+        assert out_a[1][0] == ()
+        assert [(str(a), int(b)) for a, b in out_a[1][2]] == want
+        assert out_a[1] == out_a[2]
+        assert time.time() - t0 < 30.0
+        # same seed, fresh cluster: identical outcomes and an identical
+        # (byte-identical) decision journal
+        out_b, dec_b, _ = self._run_workload(seed=77)
+        assert out_a == out_b
+        assert dec_a == dec_b
+
+
+@pytest.mark.chaos
+class TestDeadlineAndCancel:
+    def test_deadline_miss_typed_250_within_budget(self):
+        tables = _tables()
+        disp, workers = _make_engine(
+            tables, {"fact": ["server_0", "server_1"],
+                     "dim": ["server_0", "server_1"]})
+        try:
+            with failpoints.armed("mse.stage.execute", delay=8.0,
+                                  where={"instance": "server_0"}):
+                t0 = time.time()
+                resp = disp.submit(
+                    JOIN_SQL[:-len(" LIMIT 100")]
+                    + " LIMIT 100 OPTION(timeoutMs=400)")
+                elapsed = time.time() - t0
+            assert resp.exceptions, "deadline miss must surface"
+            assert resp.exceptions[0]["errorCode"] == 250
+            assert resp.partial_result is True
+            # honest per-stage accounting rides in the message
+            assert "budget" in resp.exceptions[0]["message"]
+            assert elapsed < 0.4 + EPS_S, \
+                f"took {elapsed:.2f}s for a 400ms budget"
+            mailboxes = [w.mailbox for w in workers.values()] + \
+                [disp.mailbox]
+            assert _queues_drain(mailboxes, timeout_s=12.0), \
+                "orphaned mailbox queues after a deadline miss"
+        finally:
+            _stop_engine(disp, workers)
+
+    def test_client_cancel_fans_out(self):
+        tables = _tables()
+        disp, workers = _make_engine(
+            tables, {"fact": ["server_0", "server_1"],
+                     "dim": ["server_0", "server_1"]})
+        try:
+            done = []
+            with failpoints.armed("mse.stage.execute", delay=8.0,
+                                  where={"instance": "server_0"}):
+                t = threading.Thread(
+                    target=lambda: done.append(disp.submit(JOIN_SQL)),
+                    daemon=True)
+                t0 = time.time()
+                t.start()
+                deadline = time.time() + 5
+                while not disp.inflight() and time.time() < deadline:
+                    time.sleep(0.01)
+                qids = disp.inflight()
+                assert qids, "query never registered in flight"
+                assert disp.cancel(qids[0]) is True
+                t.join(timeout=10)
+            assert done, "cancelled query never answered"
+            resp = done[0]
+            assert resp.exceptions and \
+                resp.exceptions[0]["errorCode"] == 250
+            assert resp.partial_result is True
+            assert time.time() - t0 < 8.0, "cancel waited out the chaos"
+            # an unknown id is a no-op, not an error
+            assert disp.cancel("mse_nope_1") is False
+        finally:
+            _stop_engine(disp, workers)
+
+
+# ---------------------------------------------------------------------------
+# torn frames
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+class TestTornFrame:
+    def test_torn_mailbox_frame_is_typed_error_not_hang(self):
+        tables = _tables()
+        disp, workers = _make_engine(
+            tables, {"fact": ["server_0", "server_1"],
+                     "dim": ["server_0", "server_1"]})
+        try:
+            with failpoints.armed("mse.mailbox.send", torn=True,
+                                  where={"instance": "server_0"}):
+                t0 = time.time()
+                resp = disp.submit(JOIN_SQL)
+                elapsed = time.time() - t0
+            assert resp.exceptions, "torn frame must surface"
+            assert resp.exceptions[0]["errorCode"] == 250
+            assert elapsed < 10.0, "torn frame degenerated into a wait"
+            # typed all the way: the message names the decode failure
+            # or the poisoned mailbox, never a bare timeout
+            msg = resp.exceptions[0]["message"]
+            assert "Mailbox" in msg or "undecodable" in msg or \
+                "aborted" in msg
+        finally:
+            _stop_engine(disp, workers)
+
+
+# ---------------------------------------------------------------------------
+# per-seed exact replay on the broker dispatch edge
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+class TestSeededReplay:
+    def _run(self, seed):
+        tables = _tables()
+        sched = FaultSchedule([
+            ("mse.dispatch.stage",
+             {"error": FailpointError("chaos"), "probability": 0.15,
+              "seed": seed, "where": {"instance": "server_1"}}),
+        ])
+        sched.arm()
+        disp, workers = _make_engine(
+            tables, {"fact": ["server_0", "server_1"],
+                     "dim": ["server_0", "server_1"]})
+        try:
+            outcomes = []
+            for _ in range(8):
+                resp = disp.submit(JOIN_SQL)
+                outcomes.append(bool(resp.exceptions))
+            return outcomes, json.dumps(sched.decisions())
+        finally:
+            _stop_engine(disp, workers)
+            sched.disarm()
+
+    def test_same_seed_byte_identical_journal(self):
+        out_a, dec_a = self._run(seed=4242)
+        out_b, dec_b = self._run(seed=4242)
+        assert dec_a == dec_b, "same seed must replay byte-identical"
+        assert out_a == out_b
+        assert any(out_a) and not all(out_a)
+        out_c, dec_c = self._run(seed=9)
+        assert dec_c != dec_a
+
+
+# ---------------------------------------------------------------------------
+# MiniCluster: tier-1 smoke under one seeded mailbox delay + stage cache
+# ---------------------------------------------------------------------------
+
+def _build_cluster(tmp_path, chaos=None, num_servers=2):
+    from pinot_tpu.cluster.mini import MiniCluster
+    from pinot_tpu.models.schema import Schema
+    from pinot_tpu.models.table_config import TableConfig
+    from pinot_tpu.segment.creator import SegmentCreator
+    from pinot_tpu.segment.loader import load_segment
+
+    rng = np.random.default_rng(17)
+    n = 600
+    fact = {"k": rng.integers(0, 6, n).astype(np.int64),
+            "v": rng.integers(1, 50, n).astype(np.int64)}
+    dim = {"k": np.arange(6).astype(np.int64),
+           "name": [f"n{i}" for i in range(6)]}
+
+    fact_schema = Schema.from_dict({
+        "schemaName": "fact",
+        "dimensionFieldSpecs": [{"name": "k", "dataType": "LONG"}],
+        "metricFieldSpecs": [{"name": "v", "dataType": "LONG"}]})
+    dim_schema = Schema.from_dict({
+        "schemaName": "dim",
+        "dimensionFieldSpecs": [{"name": "k", "dataType": "LONG"},
+                                {"name": "name", "dataType": "STRING"}]})
+    c = MiniCluster(num_servers=num_servers, chaos=chaos)
+    c.start()
+    c.add_table("fact")
+    c.add_table("dim")
+    fc = SegmentCreator(
+        TableConfig.from_dict({"tableName": "fact",
+                               "tableType": "OFFLINE"}), fact_schema)
+    dc = SegmentCreator(
+        TableConfig.from_dict({"tableName": "dim",
+                               "tableType": "OFFLINE"}), dim_schema)
+    for i in range(2):
+        idx = np.arange(n) % 2 == i
+        d = str(tmp_path / f"fact_{i}")
+        fc.build({k: np.asarray(v)[idx] for k, v in fact.items()},
+                 d, f"fact_{i}")
+        c.add_segment("fact", load_segment(d), server_idx=i % num_servers)
+    d = str(tmp_path / "dim_0")
+    dc.build({k: np.asarray(v) for k, v in dim.items()}, d, "dim_0")
+    c.add_segment("dim", load_segment(d), server_idx=0)
+    return c, fact, dim
+
+
+CLUSTER_JOIN = ("SELECT d.name, SUM(f.v) AS s FROM fact f "
+                "JOIN dim d ON f.k = d.k GROUP BY d.name "
+                "ORDER BY d.name LIMIT 100")
+
+
+def _cluster_expected(fact, dim):
+    want = {}
+    for k, v in zip(fact["k"], fact["v"]):
+        want[dim["name"][int(k)]] = want.get(dim["name"][int(k)], 0) + int(v)
+    return [(n, s) for n, s in sorted(want.items())]
+
+
+@pytest.mark.chaos
+class TestClusterChaosSmoke:
+    def test_join_survives_seeded_mailbox_delay(self, tmp_path):
+        """Tier-1 guard that the MSE chaos wiring itself can't rot: a
+        MiniCluster join under one seeded mailbox delay still answers
+        exactly, and the schedule records its decisions."""
+        sched = FaultSchedule([
+            ("mse.mailbox.send", {"delay": 0.05, "times": 2, "seed": 11}),
+        ])
+        c, fact, dim = _build_cluster(tmp_path, chaos=sched)
+        try:
+            resp = c.query(CLUSTER_JOIN)
+            assert not resp.exceptions, resp.exceptions
+            got = [(str(a), int(b)) for a, b in resp.result_table.rows]
+            assert got == _cluster_expected(fact, dim)
+            assert sched.failpoints[0].fired == 2
+            assert sched.decisions()[0][:2] == [(True, 0.05), (True, 0.05)]
+        finally:
+            c.stop()
+
+
+class TestStageOutputCache:
+    def test_warm_hit_epoch_invalidation_no_partials(self, tmp_path):
+        from pinot_tpu.segment.creator import SegmentCreator
+        from pinot_tpu.segment.loader import load_segment
+        from pinot_tpu.models.schema import Schema
+        from pinot_tpu.models.table_config import TableConfig
+
+        c, fact, dim = _build_cluster(tmp_path)
+        try:
+            caches = [s.mse_worker.stage_cache for s in c.servers]
+            r1 = c.query(CLUSTER_JOIN)
+            assert not r1.exceptions, r1.exceptions
+            assert sum(len(x) for x in caches) > 0, \
+                "leaf-stage outputs must populate the cache"
+            hits0 = sum(x.stats.hits for x in caches)
+            r2 = c.query(CLUSTER_JOIN)
+            assert not r2.exceptions
+            assert r2.result_table.rows == r1.result_table.rows
+            assert sum(x.stats.hits for x in caches) > hits0, \
+                "second run must serve leaf stages from cache"
+
+            # epoch invalidation by construction: a new fact segment
+            # changes the version set, so the key stops hitting and the
+            # answer reflects the new rows
+            schema = Schema.from_dict({
+                "schemaName": "fact",
+                "dimensionFieldSpecs": [{"name": "k", "dataType": "LONG"}],
+                "metricFieldSpecs": [{"name": "v", "dataType": "LONG"}]})
+            creator = SegmentCreator(
+                TableConfig.from_dict({"tableName": "fact",
+                                       "tableType": "OFFLINE"}), schema)
+            d = str(tmp_path / "fact_new")
+            creator.build({"k": np.array([0], np.int64),
+                           "v": np.array([10_000], np.int64)},
+                          d, "fact_new")
+            c.add_segment("fact", load_segment(d), server_idx=0)
+            r3 = c.query(CLUSTER_JOIN)
+            assert not r3.exceptions
+            base = dict((str(a), int(b)) for a, b in r1.result_table.rows)
+            got = dict((str(a), int(b)) for a, b in r3.result_table.rows)
+            assert got["n0"] == base["n0"] + 10_000, \
+                "post-swap answer must reflect the new segment"
+
+            # never cache partials: a deadline-clipped run stores nothing
+            sizes = [len(x) for x in caches]
+            with failpoints.armed("mse.stage.execute", delay=5.0):
+                miss = c.query(CLUSTER_JOIN + " OPTION(timeoutMs=250)")
+            assert miss.exceptions and \
+                miss.exceptions[0]["errorCode"] == 250
+            assert [len(x) for x in caches] == sizes, \
+                "a deadline-clipped stage must not populate the cache"
+        finally:
+            c.stop()
+
+    def test_cancelled_query_leaves_zero_orphaned_queues(self, tmp_path):
+        """Non-slow orphan guard: after a cancelled (deadline-missed)
+        MSE query, every worker's and the broker's mailbox queue map
+        drains to empty."""
+        c, _fact, _dim = _build_cluster(tmp_path)
+        try:
+            with failpoints.armed("mse.stage.execute", delay=2.0):
+                resp = c.query(CLUSTER_JOIN + " OPTION(timeoutMs=300)")
+            assert resp.exceptions and \
+                resp.exceptions[0]["errorCode"] == 250
+            services = [s.mse_worker.mailbox for s in c.servers] + \
+                [c.mse.mailbox]
+            assert _queues_drain(services, timeout_s=8.0), \
+                "cancelled query left orphaned mailbox queues"
+        finally:
+            c.stop()
