@@ -923,6 +923,218 @@ def mse_main(smoke: bool = False):
             f"leaf-stage cache speedup {speedup:.2f}x < 1.5x warm/cold"
 
 
+def _groups_build_cluster(tmp: str, num_segments: int, docs: int):
+    """4 servers in 2 replica groups (group 0 = servers 0/1, group 1 =
+    servers 2/3), every segment fully copied in both groups — the
+    fault-domain acceptance topology."""
+    import numpy as np
+
+    from pinot_tpu.cluster.mini import MiniCluster
+    from pinot_tpu.models.schema import Schema
+    from pinot_tpu.models.table_config import TableConfig
+    from pinot_tpu.segment.creator import SegmentCreator
+    from pinot_tpu.segment.loader import load_segment
+
+    schema = Schema.from_dict({
+        "schemaName": "rg",
+        "dimensionFieldSpecs": [{"name": "k", "dataType": "LONG"}],
+        "metricFieldSpecs": [{"name": "v", "dataType": "LONG"}]})
+    creator = SegmentCreator(TableConfig.from_dict(
+        {"tableName": "rg", "tableType": "OFFLINE"}), schema)
+    cluster = MiniCluster(num_servers=4)
+    cluster.start()
+    cluster.add_table("rg", num_replica_groups=2, tenant="bench")
+    total = 0
+    for i in range(num_segments):
+        rng = np.random.default_rng(100 + i)
+        d = os.path.join(tmp, f"rg_{i}")
+        creator.build({"k": rng.integers(0, 64, docs).astype(np.int64),
+                       "v": rng.integers(0, 1000, docs).astype(np.int64)},
+                      d, f"rg_{i}")
+        cluster.add_segment("rg", load_segment(d), server_idx=i % 2,
+                            replicas=[2 + i % 2])
+        total += docs
+    return cluster, total
+
+
+def _groups_chaos_journal(tmp: str, seed: int, n_queries: int):
+    """One sequential chaos run against the `broker.group.scatter` site:
+    a seeded coin kills scatters to group 0 (SIGKILL-equivalent: the
+    request raises before the wire) until the failure detector demotes
+    the group. Returns (per-query outcomes, per-site decision journal) —
+    two same-seed runs must match EXACTLY."""
+    from pinot_tpu.utils.failpoints import FaultSchedule
+
+    sched = FaultSchedule([
+        ("broker.group.scatter",
+         {"error": ConnectionError("chaos: replica group 0 killed"),
+          "probability": 0.5, "seed": seed, "where": {"group": 0}})])
+    cluster, _total = None, None
+    try:
+        import shutil
+        run_dir = os.path.join(tmp, f"journal_{seed}")
+        os.makedirs(run_dir, exist_ok=True)
+        cluster, _total = _groups_build_cluster(run_dir, num_segments=4,
+                                                docs=500)
+        # pin demotion: once the chaos kills one member, group 0 stays
+        # out of routing for the whole run — replay must not depend on
+        # when a wall-clock backoff happens to expire
+        for b in cluster.brokers:
+            b.failure_detector.base_backoff_s = 3600.0
+            b.failure_detector.max_backoff_s = 3600.0
+        sched.arm()
+        outcomes = []
+        for i in range(n_queries):
+            resp = cluster.query(
+                f"SELECT COUNT(*), SUM(v) FROM rg WHERE v >= {i % 7}")
+            outcomes.append((len(resp.exceptions),
+                             resp.rows[0][0] if resp.rows else None))
+        decisions = sched.decisions()
+        shutil.rmtree(run_dir, ignore_errors=True)
+        return outcomes, decisions
+    finally:
+        sched.disarm()
+        if cluster is not None:
+            cluster.stop()
+
+
+def groups_main(smoke: bool = False, out_path: str = None):
+    """--groups [--smoke]: replica-group fault-domain acceptance (ISSUE
+    8). 2 replica groups x 2 servers, 8-client closed loop:
+
+    1. **all-alive phase** — baseline aggregate QPS.
+    2. **group-kill phase** — every member of replica group 0 is killed
+       (SIGKILL-equivalent transport death) while the loop runs; the
+       loop keeps going. Asserts **zero failed queries** across the
+       whole run (the mid-scatter failures fail over: the whole group
+       demotes, unanswered segments re-scatter onto group 1) and
+       reports the convergent one-group QPS + p99.
+    3. **seeded chaos journal** — a sequential run with a seeded coin
+       killing `broker.group.scatter` hits on group 0 is executed
+       TWICE; outcomes + failpoint decision journals must be identical
+       (the per-seed replay contract), digest recorded.
+
+    Writes BENCH_groups.json. --smoke shrinks data + durations and
+    skips the throughput-ratio assert (timings are noise at smoke
+    scale); zero-failures and replay-identical are asserted always."""
+    import hashlib
+    import tempfile
+    import threading
+
+    num_segments = 4 if smoke else 12
+    docs = 800 if smoke else 20_000
+    duration_s = 1.2 if smoke else 5.0
+    clients = 8
+
+    tmp = tempfile.mkdtemp(prefix="bench_groups_")
+    cluster, total_rows = _groups_build_cluster(tmp, num_segments, docs)
+
+    lock = threading.Lock()
+
+    def closed_loop(duration: float):
+        """8-client closed loop; returns (latencies_s, failures)."""
+        stop_at = time.perf_counter() + duration
+        lat, failures = [], []
+
+        def client(cid: int):
+            i = cid
+            while time.perf_counter() < stop_at:
+                t0 = time.perf_counter()
+                resp = cluster.query(
+                    f"SELECT COUNT(*), SUM(v) FROM rg WHERE v >= {i % 7}")
+                dt = time.perf_counter() - t0
+                with lock:
+                    lat.append(dt)
+                    if resp.exceptions:
+                        failures.append(resp.exceptions)
+                i += clients
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return lat, failures
+
+    def p(q, vals):
+        if not vals:
+            return 0.0
+        return sorted(vals)[min(len(vals) - 1,
+                                max(0, round(q * len(vals)) - 1))]
+
+    # warm code paths (parse/plan/serde jit noise off the measurement)
+    for i in range(4):
+        resp = cluster.query(f"SELECT COUNT(*), SUM(v) FROM rg "
+                             f"WHERE v >= {i}")
+        assert not resp.exceptions, resp.exceptions
+
+    lat_all, fail_all = closed_loop(duration_s)
+    qps_all = len(lat_all) / duration_s
+
+    # -- the kill: every member of group 0, while the loop runs --------
+    killer = threading.Timer(duration_s * 0.25,
+                             cluster.kill_replica_group, args=("rg", 0))
+    killer.start()
+    lat_kill, fail_kill = closed_loop(duration_s)
+    killer.join()
+    qps_kill = len(lat_kill) / duration_s
+
+    # -- steady state on the surviving group ---------------------------
+    lat_one, fail_one = closed_loop(duration_s)
+    qps_one = len(lat_one) / duration_s
+    cluster.stop()
+
+    # -- seeded chaos journal: replay must be byte-identical -----------
+    seed = 20260803
+    run_a = _groups_chaos_journal(tmp, seed, n_queries=12 if smoke else 40)
+    run_b = _groups_chaos_journal(tmp, seed, n_queries=12 if smoke else 40)
+    replay_identical = run_a == run_b
+    journal_digest = hashlib.sha1(repr(run_a).encode()).hexdigest()[:16]
+    chaos_failed = sum(1 for exc_count, _rows in run_a[0] if exc_count)
+
+    failed = len(fail_all) + len(fail_kill) + len(fail_one)
+    out = {
+        "metric": "group_kill_failed_queries",
+        "value": failed,
+        "unit": "queries",
+        "qps_all_alive": round(qps_all, 1),
+        "qps_during_kill": round(qps_kill, 1),
+        "qps_one_group": round(qps_one, 1),
+        "p50_all_alive_ms": round(p(0.50, lat_all) * 1e3, 2),
+        "p99_all_alive_ms": round(p(0.99, lat_all) * 1e3, 2),
+        "p99_during_kill_ms": round(p(0.99, lat_kill) * 1e3, 2),
+        "p99_one_group_ms": round(p(0.99, lat_one) * 1e3, 2),
+        "queries_total": len(lat_all) + len(lat_kill) + len(lat_one),
+        "chaos_journal_digest": journal_digest,
+        "chaos_replay_identical": replay_identical,
+        "chaos_run_failed_queries": chaos_failed,
+        "num_segments": num_segments,
+        "docs_per_segment": docs,
+        "total_rows": total_rows,
+        "clients": clients,
+        "smoke": smoke,
+        "asserted": {"failed_queries": 0, "replay_identical": True,
+                     "chaos_failed_queries": 0,
+                     "min_one_group_qps_frac": None if smoke else 0.25},
+    }
+    if out_path is None:
+        out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "BENCH_groups.json")
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps(out))
+    assert failed == 0, \
+        f"{failed} queries failed across the group-kill run: " \
+        f"{(fail_all + fail_kill + fail_one)[:3]}"
+    assert chaos_failed == 0, \
+        f"{chaos_failed} chaos-journal queries failed: {run_a[0][:5]}"
+    assert replay_identical, "same-seed chaos journal diverged"
+    if not smoke:
+        assert qps_one >= 0.25 * qps_all, \
+            f"one-group throughput collapsed: {qps_one:.0f} vs " \
+            f"{qps_all:.0f} all-alive QPS"
+
+
 def main():
     os.makedirs(DATA_DIR, exist_ok=True)
     build_data()
@@ -1000,5 +1212,7 @@ if __name__ == "__main__":
         residency_main(smoke="--smoke" in sys.argv)
     elif "--mse" in sys.argv:
         mse_main(smoke="--smoke" in sys.argv)
+    elif "--groups" in sys.argv:
+        groups_main(smoke="--smoke" in sys.argv)
     else:
         main()

@@ -27,17 +27,23 @@ from pinot_tpu.utils.failpoints import fire
 
 class _ScatterUnit:
     """One plan entry's lifecycle through scatter/gather: a primary
-    attempt, at most one hedge (speculative duplicate on another
-    replica), and — on hard failure — a one-shot retry that spawns fresh
-    units. `done` flips exactly once, when the FIRST clean response for
-    this (table, segment set) merges; every later duplicate is discarded,
-    so hedged partials can never double-count."""
+    attempt, at most one hedge — whole-set on a single replica when one
+    holds everything, else SPLIT into per-replica child units covering
+    disjoint segment subsets (partially-replicated layouts) — and, on
+    hard failure, a one-shot retry that spawns fresh units covering only
+    the still-unanswered segments. Dedup is per SEGMENT: a response
+    merges iff none of its segments has already been answered by a clean
+    twin (`answered` tracks the names), so overlapping partials can
+    never double-count; `done` flips exactly once, when the whole set is
+    answered or abandoned."""
 
     __slots__ = ("server", "table", "names", "extra", "retried",
-                 "done", "hedge_tried", "hedged", "live", "fallback")
+                 "done", "hedge_tried", "hedged", "live", "fallback",
+                 "answered", "parent", "children")
 
     def __init__(self, server: str, table: str, names: List[str],
-                 extra: Optional[str], retried: bool = False):
+                 extra: Optional[str], retried: bool = False,
+                 parent: Optional["_ScatterUnit"] = None):
         self.server = server          # primary replica (hedges exclude it)
         self.table = table
         self.names = names
@@ -50,6 +56,25 @@ class _ScatterUnit:
         #: an ERRORED payload received while a twin was still racing —
         #: held back so a clean twin can win, merged only if none does
         self.fallback = None
+        #: segment names a clean response already covered (split hedges:
+        #: first clean answer per segment wins, overlap discards)
+        self.answered: set = set()
+        #: set on split-hedge children; dedup/retry run on the parent
+        self.parent = parent
+        self.children: List["_ScatterUnit"] = []
+
+    @property
+    def logical(self) -> "_ScatterUnit":
+        """The unit dedup/retry accounting lives on (self, or the parent
+        for split-hedge children)."""
+        return self.parent if self.parent is not None else self
+
+    def pending_names(self) -> List[str]:
+        return [n for n in self.names if n not in self.answered]
+
+    def family_live(self) -> int:
+        """In-flight attempts across the primary and every child."""
+        return self.live + sum(c.live for c in self.children)
 
 
 class BrokerRequestHandler:
@@ -104,6 +129,10 @@ class BrokerRequestHandler:
         self._broker_nonce = uuid.uuid4().hex[:6]
         #: per-table QPS limits (ref queryquota/; None = no quotas)
         self.quota_manager = quota_manager
+        #: logical table -> tenant tag (TableConfig tenants.server):
+        #: shipped with every server request so the scheduler charges
+        #: the right weighted-fair group (cluster wiring populates it)
+        self.tenants: Dict[str, str] = {}
         #: adaptive selector stats feed (routing.selector, may be None)
         self._selector = getattr(routing, "selector", None)
         #: multi-stage dispatcher (mse/dispatcher.py); when set, queries the
@@ -147,15 +176,27 @@ class BrokerRequestHandler:
                 "pinot.broker.result.cache.hybrid.offline", True)
         return True
 
-    def _check_quota(self, table: str) -> bool:
+    def _check_quota(self, table: str) -> Optional[str]:
         """QPS quota on the LOGICAL name — quotas register unsuffixed, so
         a _OFFLINE/_REALTIME-suffixed query must hit the same bucket
         (ref HelixExternalViewBasedQueryQuotaManager: over-quota queries
-        are rejected, not queued)."""
+        are rejected, not queued). Returns the rejection reason (naming
+        the over-budget scope — table or tenant) or None when admitted."""
         if self.quota_manager is None:
-            return True
+            return None
         from pinot_tpu.models import base_table_name
-        return self.quota_manager.try_acquire(base_table_name(table))
+        return self.quota_manager.check(base_table_name(table))
+
+    def _tenant_of(self, table: str) -> Optional[str]:
+        """The tenant tag shipped with every server request (weighted-
+        fair scheduling group server-side); from the handler's own map
+        first, the quota manager's table->tenant map as fallback."""
+        from pinot_tpu.models import base_table_name
+        base = base_table_name(table)
+        tenant = self.tenants.get(base)
+        if tenant is None and self.quota_manager is not None:
+            tenant = self.quota_manager.tenant_of(base)
+        return tenant
 
     def _timeout_ms(self, ctx: QueryContext) -> float:
         """End-to-end budget for one query, highest precedence first:
@@ -188,27 +229,36 @@ class BrokerRequestHandler:
 
     def _timed_request(self, conn, server, physical_table, sql,
                        segment_names, request_id, extra_filter,
-                       deadline=None, query_id=None):
+                       deadline=None, query_id=None, tenant=None,
+                       group=None):
         """conn.request wrapped with adaptive-selector stats (latency +
         in-flight, ref adaptiveserverselector's ServerRoutingStats).
         The remaining budget is computed HERE, on the pool thread at
         send time — computing it at submit time would inflate the
         shipped budget by however long the task sat in the fan-out
-        queue."""
+        queue. group: the replica-group index this scatter targets —
+        the `broker.group.scatter` chaos site fires with it, so a
+        schedule can kill exactly one fault domain (`where={"group": 0}`)
+        and the failure rides the normal connection-error path."""
         fire("broker.scatter.before", server=server, table=physical_table)
+        if group is not None:
+            fire("broker.group.scatter", server=server,
+                 table=physical_table, group=group)
         timeout_ms = (max(1.0, (deadline - time.time()) * 1000.0)
                       if deadline is not None else None)
         sel = self._selector
         if sel is None:
             return conn.request(physical_table, sql, segment_names,
                                 request_id, extra_filter,
-                                timeout_ms=timeout_ms, query_id=query_id)
+                                timeout_ms=timeout_ms, query_id=query_id,
+                                tenant=tenant)
         sel.record_start(server)
         t0 = time.time()
         try:
             return conn.request(physical_table, sql, segment_names,
                                 request_id, extra_filter,
-                                timeout_ms=timeout_ms, query_id=query_id)
+                                timeout_ms=timeout_ms, query_id=query_id,
+                                tenant=tenant)
         finally:
             sel.record_end(server, time.time() - t0)
 
@@ -229,11 +279,16 @@ class BrokerRequestHandler:
                         150, f"SQLParsingError: {e}", start)
                 # MSE queries are NOT a quota bypass: meter EVERY table
                 # the tree reads (set operands + subquery roots included)
-                for t in _mse_tables(parsed):
-                    if not self._check_quota(t):
+                # in ONE all-or-nothing acquisition — a rejection must
+                # not drain any table's (or the shared tenant's) budget,
+                # and one N-table query is one query per tenant ceiling
+                if self.quota_manager is not None:
+                    from pinot_tpu.models import base_table_name
+                    reason = self.quota_manager.check_many(
+                        [base_table_name(t) for t in _mse_tables(parsed)])
+                    if reason:
                         return _error_response(
-                            429, f"QuotaExceededError: table {t} is over "
-                                 f"its QPS quota", start)
+                            429, f"QuotaExceededError: {reason}", start)
                 # the MSE query enters with the same end-to-end budget
                 # resolution as the single-stage path: OPTION(timeoutMs)
                 # wins inside the dispatcher, this broker's configured
@@ -241,10 +296,10 @@ class BrokerRequestHandler:
                 return self.mse_dispatcher.submit(
                     sql, parsed, default_timeout_ms=self._default_timeout_ms)
             return _error_response(150, f"SQLParsingError: {e}", start)
-        if not self._check_quota(ctx.table):
+        quota_reason = self._check_quota(ctx.table)
+        if quota_reason:
             return _error_response(
-                429, f"QuotaExceededError: table {ctx.table} is over its "
-                     f"QPS quota", start)
+                429, f"QuotaExceededError: {quota_reason}", start)
         if self.mse_dispatcher is not None and \
                 query.options.get("useMultistageEngine", "").lower() == "true":
             return self.mse_dispatcher.submit(
@@ -362,6 +417,29 @@ class BrokerRequestHandler:
         units: List[_ScatterUnit] = []
         fut_map: Dict = {}  # live future -> (unit, server, is_hedge, aid)
         attempt_seq = [0]
+        tenant = self._tenant_of(ctx.table)
+
+        #: per-query memo for (table, server) -> group index: the
+        #: derivation scans every segment's replica list, which is too
+        #: expensive to repeat per scatter ATTEMPT on large tables
+        #: (non-grouped tables short-circuit to None without scanning)
+        group_idx_memo: Dict[tuple, Optional[int]] = {}
+
+        def group_of(table: str, server: str) -> Optional[int]:
+            key = (table, server)
+            if key not in group_idx_memo:
+                group_idx_memo[key] = route.group_index_of(table, server)
+            return group_idx_memo[key]
+
+        def group_exclude(table: str, servers) -> set:
+            """Whole-group demotion: for replica-group tables the fault
+            domain of every failed server is excluded, so a retry/hedge
+            re-scatters onto a SURVIVING group instead of splitting the
+            query across a half-dead one."""
+            out: set = set()
+            for s in servers:
+                out |= route.group_peers(table, s)
+            return out
 
         def launch(unit: _ScatterUnit, server: str,
                    is_hedge: bool = False) -> bool:
@@ -395,7 +473,8 @@ class BrokerRequestHandler:
             # (_timed_request derives it from the deadline at send time).
             fut = self._pool.submit(
                 self._timed_request, conn, server, unit.table, sql,
-                unit.names, request_id, unit.extra, deadline, aid)
+                unit.names, request_id, unit.extra, deadline, aid,
+                tenant, group_of(unit.table, server))
             fut_map[fut] = (unit, server, is_hedge, aid)
             unit.live += 1
             return True
@@ -405,12 +484,13 @@ class BrokerRequestHandler:
             if conn is not None:
                 self._cancel_pool.submit(conn.cancel, aid)
 
-        def cancel_duplicates(unit: _ScatterUnit) -> None:
-            """The race resolved: stop the losing attempt server-side so
-            abandoned work frees its scheduler thread. Attempt-scoped, so
-            nothing else of this query is touched."""
+        def cancel_family(unit: _ScatterUnit) -> None:
+            """The race resolved: stop every losing attempt of this
+            logical unit (primary, whole-set hedge, split-hedge children)
+            server-side so abandoned work frees its scheduler thread.
+            Attempt-scoped, so nothing else of this query is touched."""
             for _f, (u, server, _h, aid) in list(fut_map.items()):
-                if u is unit:
+                if u is unit or u.parent is unit:
                     cancel_attempt(server, aid)
 
         def merge(unit: _ScatterUnit, server_results, server_exc,
@@ -429,9 +509,62 @@ class BrokerRequestHandler:
                 server_stats.append(stats_extra)
             responded += 1
 
+        def resolve_failed(L: _ScatterUnit, error) -> None:
+            """Every attempt of logical unit L is dead: salvage held-back
+            errored payloads for still-unanswered segment sets, then
+            retry ONLY the unanswered remainder on surviving replicas —
+            sharing, not resetting, the original deadline budget. For
+            grouped tables the exclusion demotes each failed server's
+            whole group, so the re-scatter lands on a surviving group."""
+            L.done = True
+            for c in L.children:
+                c.done = True
+            if L.table.endswith("_OFFLINE"):
+                offline_failed[0] = True
+            for cand in (L, *L.children):
+                if cand.fallback is not None \
+                        and not (set(cand.names) & L.answered):
+                    # a server DID answer (with errors) and no clean twin
+                    # covered these segments: better its partial than
+                    # re-failing
+                    merge(cand, *cand.fallback)
+                    L.answered.update(cand.names)
+            pending = L.pending_names()
+            if not pending:
+                return
+            if L.retried:
+                exceptions.append({"errorCode": 427,
+                                   "message": f"ServerError: {error}"})
+                return
+            # exclude everything known-bad: this round's failures, the
+            # detector's unhealthy set, AND every failed server's whole
+            # replica group — or the single retry can land on another
+            # dead server (or split across a half-dead fault domain)
+            # while a healthy group exists
+            exclude = failed_servers | \
+                self.failure_detector.unhealthy_servers() | \
+                group_exclude(L.table, failed_servers)
+            rerouted, unplaced = route.reroute_segments(
+                L.table, pending, exclude=exclude,
+                extra_filter=L.extra)
+            if unplaced:
+                # segments with no surviving replica: surface the
+                # loss instead of a clean-looking partial answer
+                exceptions.append({
+                    "errorCode": 427,
+                    "message": (f"ServerError: {error} "
+                                f"(segments lost: {unplaced})")})
+            for rserver, rtable, rnames, rextra in rerouted:
+                child = _ScatterUnit(rserver, rtable, rnames, rextra,
+                                     retried=True)
+                units.append(child)
+                if not launch(child, rserver):
+                    child.done = True
+
         def process(fut) -> None:
             unit, server, is_hedge, _aid = fut_map.pop(fut)
             unit.live -= 1
+            L = unit.logical
             try:
                 payload = fut.result()
                 server_results, server_exc, stats_extra = \
@@ -439,94 +572,115 @@ class BrokerRequestHandler:
             except Exception as e:  # noqa: BLE001 — partial results
                 # connection-level failure: mark unhealthy (routing skips
                 # it until the backoff expires, ref
-                # ConnectionFailureDetector) and retry the segments on
-                # surviving replicas ONCE — sharing, not resetting, the
-                # original deadline budget
+                # ConnectionFailureDetector — and for grouped tables the
+                # selector stops picking the whole group next query)
                 self.failure_detector.mark_failure(server)
                 failed_servers.add(server)
-                if unit.done or unit.live > 0:
-                    # a hedge twin already merged (or is still racing):
-                    # this failure loses/defers — it must NOT poison the
+                if unit.parent is not None:
+                    unit.done = True
+                if L.done or L.family_live() > 0:
+                    # a twin already merged (or is still racing): this
+                    # failure loses/defers — it must NOT poison the
                     # offline-partial cache, the data is (or may yet be)
-                    # complete from the twin
+                    # complete from the twin(s)
                     return
-                unit.done = True
-                if unit.table.endswith("_OFFLINE"):
-                    offline_failed[0] = True
-                if unit.fallback is not None:
-                    # the twin already delivered an (errored) payload we
-                    # held back hoping for a clean one: a server DID
-                    # answer, so merge it rather than retry/re-fail
-                    merge(unit, *unit.fallback)
-                    return
-                if unit.retried:
-                    exceptions.append({"errorCode": 427,
-                                       "message": f"ServerError: {e}"})
-                    return
-                # exclude everything known-bad: this round's failures
-                # AND the detector's unhealthy set, or the single
-                # retry can land on another dead server while a
-                # healthy replica exists
-                exclude = failed_servers | \
-                    self.failure_detector.unhealthy_servers()
-                rerouted, unplaced = route.reroute_segments(
-                    unit.table, unit.names, exclude=exclude,
-                    extra_filter=unit.extra)
-                if unplaced:
-                    # segments with no surviving replica: surface the
-                    # loss instead of a clean-looking partial answer
-                    exceptions.append({
-                        "errorCode": 427,
-                        "message": (f"ServerError: {e} "
-                                    f"(segments lost: {unplaced})")})
-                for rserver, rtable, rnames, rextra in rerouted:
-                    child = _ScatterUnit(rserver, rtable, rnames, rextra,
-                                         retried=True)
-                    units.append(child)
-                    if not launch(child, rserver):
-                        child.done = True
+                resolve_failed(L, e)
                 return
             self.failure_detector.mark_success(server)
-            if unit.done:
+            if L.done:
                 return  # hedge race loser — drop, never double-merge
-            if server_exc and unit.live > 0:
-                # an ERRORED payload while a twin still races: hold it
-                # back — first CLEAN response wins; this merges only if
-                # no twin delivers a clean answer
+            if unit.parent is None:
+                # primary / whole-set hedge attempt: covers ALL of L's
+                # segments, so it can merge only while NO child answered
+                # (a merged overlap would double-count those segments)
+                if L.answered:
+                    if L.family_live() == 0:
+                        # children died after partially answering and
+                        # this full payload can't be split: re-scatter
+                        # the unanswered remainder
+                        resolve_failed(L, "overlapping partial discarded")
+                    return
+                if server_exc and L.family_live() > 0:
+                    # an ERRORED payload while a twin still races: hold
+                    # it back — first CLEAN response wins; this merges
+                    # only if no twin delivers a clean answer
+                    unit.fallback = (server_results, server_exc,
+                                     stats_extra)
+                    return
+                L.done = True
+                for c in L.children:
+                    c.done = True
+                if L.hedged:
+                    self._metrics.add_meter(
+                        "hedge_won" if is_hedge else "hedge_wasted")
+                    cancel_family(L)
+                merge(unit, server_results, server_exc, stats_extra)
+                return
+            # split-hedge child: per-segment dedup — merge iff none of
+            # its (disjoint-by-construction) segments was answered yet
+            if set(unit.names) & L.answered:
+                return
+            if server_exc and (unit.live > 0 or L.live > 0):
                 unit.fallback = (server_results, server_exc, stats_extra)
                 return
             unit.done = True
-            if unit.hedged:
-                self._metrics.add_meter(
-                    "hedge_won" if is_hedge else "hedge_wasted")
-                cancel_duplicates(unit)
             merge(unit, server_results, server_exc, stats_extra)
+            L.answered.update(unit.names)
+            if not L.pending_names():
+                # the child set covered everything: the split hedge won
+                L.done = True
+                for c in L.children:
+                    c.done = True
+                self._metrics.add_meter("hedge_won")
+                cancel_family(L)
 
         def maybe_hedge() -> None:
             """Past the adaptive delay, duplicate each still-pending
-            primary onto a different healthy replica ("The Tail at
-            Scale"): first clean response wins, the loser is cancelled.
-            One hedge per unit, whole-entry only — a hedge split across
-            servers couldn't dedupe against its primary's segment set."""
+            primary onto different healthy replica(s) ("The Tail at
+            Scale"): first clean response wins per segment, losers are
+            cancelled. One hedge round per unit — whole-set on a single
+            replica when one holds everything, else SPLIT into disjoint
+            child units (partially-replicated layouts, where replica
+            groups make partial overlap the norm)."""
             if hedge_at is None or time.time() < hedge_at:
                 return
             for unit in list(units):
                 if unit.done or unit.live == 0 or unit.hedge_tried \
-                        or unit.retried:
+                        or unit.retried or unit.parent is not None:
                     continue
                 unit.hedge_tried = True
                 exclude = ({unit.server} | failed_servers
-                           | self.failure_detector.unhealthy_servers())
+                           | self.failure_detector.unhealthy_servers()
+                           | group_exclude(unit.table, [unit.server]))
                 entries, unplaced = route.reroute_segments(
                     unit.table, unit.names, exclude=exclude,
                     extra_filter=unit.extra)
-                if unplaced or len(entries) != 1:
-                    continue  # no single healthy replica holds the set
+                if unplaced or not entries:
+                    continue  # some segment has no other healthy replica
                 if (deadline - time.time()) * 1000.0 < 1.0:
                     continue  # no budget left to hedge into
-                if launch(unit, entries[0][0], is_hedge=True):
+                if len(entries) == 1:
+                    if launch(unit, entries[0][0], is_hedge=True):
+                        unit.hedged = True
+                        self._metrics.add_meter("hedge_issued")
+                    continue
+                # split hedge: one child per replica, disjoint segment
+                # subsets that together cover the whole pending set
+                launched = False
+                for hserver, htable, hnames, hextra in entries:
+                    child = _ScatterUnit(hserver, htable, hnames, hextra,
+                                         parent=unit)
+                    child.hedge_tried = True
+                    if launch(child, hserver, is_hedge=True):
+                        unit.children.append(child)
+                        units.append(child)
+                        launched = True
+                    else:
+                        child.done = True
+                if launched:
                     unit.hedged = True
                     self._metrics.add_meter("hedge_issued")
+                    self._metrics.add_meter("hedge_split")
 
         for server, physical_table, segment_names, extra_filter in plan:
             unit = _ScatterUnit(server, physical_table, segment_names,
@@ -567,11 +721,15 @@ class BrokerRequestHandler:
             # next queries prefer other replicas
             for unit, servers in abandoned.values():
                 unit.done = True
-                if unit.fallback is not None:
+                if unit.fallback is not None \
+                        and not (set(unit.names) & unit.logical.answered):
                     # better an errored answer a server actually gave
-                    # than nothing — the 250 below still records that
-                    # the clean twin never arrived
+                    # than nothing (overlap-guarded: segments a clean
+                    # split-hedge twin already answered must not merge
+                    # twice) — the 250 below still records that the
+                    # clean twin never arrived
                     merge(unit, *unit.fallback)
+                    unit.logical.answered.update(unit.names)
                 if unit.table.endswith("_OFFLINE"):
                     offline_failed[0] = True
                 for server in servers:
@@ -676,10 +834,10 @@ class StreamingMixin:
                 or ctx.options.get("useMultistageEngine",
                                    "").lower() == "true":
             return self.handle(sql)
-        if not self._check_quota(ctx.table):
+        quota_reason = self._check_quota(ctx.table)
+        if quota_reason:
             return _error_response(
-                429, f"QuotaExceededError: table {ctx.table} is over its "
-                     f"QPS quota", start)
+                429, f"QuotaExceededError: {quota_reason}", start)
         route = self.routing.get_route(ctx.table)
         if route is None:
             return _error_response(

@@ -12,6 +12,7 @@ import hashlib
 import itertools
 import random
 import threading
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
@@ -109,6 +110,11 @@ class TableRoute:
     table_name: str
     segments: Dict[str, SegmentInfo] = field(default_factory=dict)
     time_column: Optional[str] = None
+    #: >= 2 makes this a replica-group fault domain: each segment's
+    #: `servers` list is GROUP-ORDERED (element g = the group-g replica,
+    #: the assignment contract), and the broker scatters each query to
+    #: ONE group instead of round-robin across replicas
+    num_replica_groups: int = 0
     #: bumped by _ObservedSegments on every segment-dict mutation; the
     #: epoch memo keys on it (counter read/compare is GIL-atomic)
     mutation_version: int = 0
@@ -119,13 +125,127 @@ class TableRoute:
             self.segments = _ObservedSegments(self, self.segments)
 
 
+class ReplicaGroupInstanceSelector:
+    """Pick ONE replica group per query (ref
+    routing/instanceselector/ReplicaGroupInstanceSelector.java): every
+    segment of the query scatters to the same group, so a query touches
+    one fault domain — and a whole-group loss is survivable by
+    re-scattering onto another group, which balanced routing cannot
+    express.
+
+    Choice discipline, in order:
+
+      1. health — only groups with NO unhealthy member are candidates
+         (one dead member would fail part of the scatter; the caller
+         falls back to per-segment balanced selection when every group
+         is degraded).
+      2. stickiness — a query fingerprint maps to the group that served
+         it before (bounded LRU): per-segment partial caches and HBM
+         residency live on the servers that executed the plan, so
+         repeats must land on the same machines to hit them.
+      3. adaptive latency — for new fingerprints, the group whose
+         WORST member scores best (the scatter waits for its slowest
+         member) via the shared AdaptiveServerSelector.
+      4. residency — on ties, the group whose members advertise the
+         most HBM-resident bytes for the query's table (instance-sweep
+         heartbeat hints, `update_residency`).
+      5. round-robin over remaining ties.
+    """
+
+    def __init__(self, adaptive=None, sticky_max: int = 4096):
+        self.adaptive = adaptive
+        self.sticky_max = int(sticky_max)
+        #: (physical table, query fingerprint) -> group index
+        self._sticky: "OrderedDict[tuple, int]" = OrderedDict()
+        #: server -> {physical table: HBM-resident bytes}
+        self._residency: Dict[str, Dict[str, int]] = {}
+        self._rr = 0
+        self._lock = threading.Lock()
+
+    # -- instance-sweep feeds ------------------------------------------
+    def update_residency(self, server: str,
+                         table_bytes: Dict[str, int]) -> None:
+        """Heartbeat payload: per-table resident bytes one server
+        advertises (cluster/roles.py plumbs this from the coordinator's
+        instance sweep)."""
+        with self._lock:
+            self._residency[server] = dict(table_bytes or {})
+
+    def residency_bytes(self, members: Sequence[str], table: str) -> int:
+        with self._lock:
+            return sum(self._residency.get(m, {}).get(table, 0)
+                       for m in members)
+
+    # -- selection ------------------------------------------------------
+    def pick_group(self, physical_table: str,
+                   groups: Sequence[Sequence[str]],
+                   unhealthy: Set[str],
+                   fingerprint: Optional[str] = None) -> Optional[int]:
+        """Index of the group this query scatters to, or None when no
+        group is fully healthy (caller degrades to per-segment
+        selection). Sticky entries are dropped the moment their group
+        stops being healthy — demotion, not just avoidance, so the next
+        repeat re-evaluates instead of bouncing off the dead group."""
+        healthy = [g for g, members in enumerate(groups)
+                   if members and not (set(members) & unhealthy)]
+        if not healthy:
+            return None
+        key = None
+        if fingerprint is not None:
+            key = (physical_table, fingerprint)
+            with self._lock:
+                g = self._sticky.get(key)
+                if g is not None:
+                    if g in healthy:
+                        self._sticky.move_to_end(key)
+                        return g
+                    del self._sticky[key]  # demoted group: unstick
+        if len(healthy) == 1:
+            g = healthy[0]
+        else:
+            scored = []
+            for g in healthy:
+                # the scatter completes when the SLOWEST member answers,
+                # so a group is as good as its worst server
+                worst = (max(self.adaptive.score(s) for s in groups[g])
+                         if self.adaptive is not None else 0.0)
+                res = self.residency_bytes(groups[g], physical_table)
+                scored.append((worst, -res, g))
+            scored.sort()
+            ties = [g for w, r, g in scored
+                    if (w, r) == (scored[0][0], scored[0][1])]
+            with self._lock:
+                g = ties[self._rr % len(ties)]
+                self._rr += 1
+        if key is not None:
+            with self._lock:
+                self._sticky[key] = g
+                self._sticky.move_to_end(key)
+                while len(self._sticky) > self.sticky_max:
+                    self._sticky.popitem(last=False)
+        return g
+
+
+def _derive_groups(segments: Sequence[SegmentInfo],
+                   num_groups: int) -> List[List[str]]:
+    """Group membership recovered from the assignment contract: a
+    segment's server list is group-ordered, so column g over all
+    segments IS group g. No separate group map can drift from the
+    placements actually in effect."""
+    groups: List[set] = [set() for _ in range(num_groups)]
+    for seg in segments:
+        for g in range(min(num_groups, len(seg.servers))):
+            groups[g].add(seg.servers[g])
+    return [sorted(g) for g in groups]
+
+
 class RoutingTable:
     """segment->servers map + instance selection for one logical table."""
 
     def __init__(self, offline: Optional[TableRoute] = None,
                  realtime: Optional[TableRoute] = None,
                  time_boundary: Optional[int] = None,
-                 selector=None):
+                 selector=None, group_selector=None):
         self.offline = offline
         self.realtime = realtime
         #: hybrid split: offline serves time <= boundary, realtime the rest
@@ -135,6 +255,10 @@ class RoutingTable:
         #: set, replica choice prefers low-latency/low-in-flight servers
         #: (ref routing/adaptiveserverselector/); None = round-robin
         self.selector = selector
+        #: ReplicaGroupInstanceSelector used for sides with
+        #: num_replica_groups >= 2 (one group per query); None falls
+        #: back to per-segment selection even for grouped tables
+        self.group_selector = group_selector
         self._rr = 0
         self._lock = threading.Lock()
         #: memoized epochs: validity-token tuple -> epoch string. One
@@ -287,6 +411,15 @@ class RoutingTable:
                         extra_filter: Optional[str], unhealthy: Set[str]):
         selected = [s for s in route.segments.values()
                     if not _prunable(s, ctx)]
+        if route.num_replica_groups >= 2 and self.group_selector is not None \
+                and selected:
+            entries = self._route_one_group(route, ctx, selected,
+                                            extra_filter, unhealthy)
+            if entries is not None:
+                return entries
+            # no fully-healthy group: degrade to per-segment selection
+            # below — known-dead servers are skipped segment by segment,
+            # which beats scattering part of the query at a corpse
         per_server: Dict[str, List[str]] = {}
         with self._lock:
             for seg in selected:
@@ -305,18 +438,88 @@ class RoutingTable:
         return [(server, route.table_name, names, extra_filter)
                 for server, names in per_server.items()]
 
+    def _route_one_group(self, route: TableRoute, ctx: QueryContext,
+                         selected: List[SegmentInfo],
+                         extra_filter: Optional[str],
+                         unhealthy: Set[str]):
+        """Scatter the WHOLE query to one replica group (the fault-domain
+        contract). None when no group is fully healthy."""
+        groups = _derive_groups(selected, route.num_replica_groups)
+        g = self.group_selector.pick_group(
+            route.table_name, groups, unhealthy,
+            fingerprint=ctx.fingerprint())
+        if g is None:
+            return None
+        per_server: Dict[str, List[str]] = {}
+        with self._lock:
+            for seg in selected:
+                if g < len(seg.servers):
+                    server = seg.servers[g]
+                    if server in unhealthy:
+                        # stale group view (segment set mutated since
+                        # health check): place on any healthy replica
+                        server = _pick_replica(seg.servers, self._rr,
+                                               unhealthy)
+                else:
+                    # partially-replicated segment (fewer copies than
+                    # groups): fall back per segment rather than drop it
+                    server = _pick_replica(seg.servers, self._rr, unhealthy)
+                if server is None:
+                    continue
+                per_server.setdefault(server, []).append(seg.name)
+            self._rr += 1
+        return [(server, route.table_name, names, extra_filter)
+                for server, names in per_server.items()]
+
+    # -- fault-domain introspection ------------------------------------
+    def _route_named(self, physical_table: str) -> Optional[TableRoute]:
+        for r in (self.offline, self.realtime):
+            if r is not None and r.table_name == physical_table:
+                return r
+        return None
+
+    def group_peers(self, physical_table: str, server: str) -> Set[str]:
+        """Every server sharing a replica-group index with `server`
+        (itself included) — the demotion set when one member fails
+        mid-query: the retry must avoid the WHOLE group, because sending
+        the re-scatter to the dead member's healthy peers splits the
+        query across fault domains and a second loss in either would
+        fail it. Empty for non-grouped tables."""
+        route = self._route_named(physical_table)
+        if route is None or route.num_replica_groups < 2:
+            return set()
+        positions = {i for seg in route.segments.values()
+                     for i, s in enumerate(seg.servers) if s == server}
+        if not positions:
+            return set()
+        return {seg.servers[i] for seg in route.segments.values()
+                for i in positions if i < len(seg.servers)}
+
+    def group_index_of(self, physical_table: str,
+                       server: str) -> Optional[int]:
+        """The replica-group index `server` serves for this table (its
+        lowest position across segment replica lists) — failpoint/test
+        observability for group-scoped chaos. None when ungrouped or
+        unknown."""
+        route = self._route_named(physical_table)
+        if route is None or route.num_replica_groups < 2:
+            return None
+        positions = [i for seg in route.segments.values()
+                     for i, s in enumerate(seg.servers) if s == server]
+        return min(positions) if positions else None
+
     def reroute_segments(self, physical_table: str, segment_names: List[str],
                          exclude: Set[str], extra_filter: Optional[str]):
         """Re-place segments on surviving replicas after a server failed
         mid-query (ref QueryRouter retry on unhealthy server). Returns
         (entries, unplaced_segment_names) — unplaced segments have NO
         surviving replica and must surface as an error, never silently
-        vanish from the answer."""
-        route = None
-        for r in (self.offline, self.realtime):
-            if r is not None and r.table_name == physical_table:
-                route = r
-                break
+        vanish from the answer. For replica-group tables the shared rr
+        index makes the re-placement CONVERGE: excluding the demoted
+        group leaves every segment's surviving replicas in the same
+        group order, so one rr value lands all of them on one surviving
+        group."""
+        route = self._route_named(physical_table)
         if route is None:
             return [], list(segment_names)
         per_server: Dict[str, List[str]] = {}
@@ -355,35 +558,45 @@ def _pick_replica(servers: List[str], rr: int, skip: Set[str],
 
 
 def _prunable(seg: SegmentInfo, ctx: QueryContext) -> bool:
-    """Partition pruning (ref broker/routing/segmentpruner/): a segment can
-    be skipped when an EQ filter on the partition column hashes to a
-    different partition."""
+    """Partition pruning (ref broker/routing/segmentpruner/): a segment
+    can be skipped when an EQ/IN filter on the partition column proves
+    EVERY matching row hashes to a different partition."""
     if ctx.filter is None or seg.partition_column is None or not seg.num_partitions:
         return False
-    value = _eq_value(ctx.filter, seg.partition_column)
-    if value is None:
+    values = _partition_values(ctx.filter, seg.partition_column)
+    if not values:
         return False
-    p = _modulo_partition(value, seg.num_partitions)
-    if p is None:  # non-numeric value: cannot prove mismatch, keep segment
-        return False
-    return p != seg.partition_id
+    for value in values:
+        p = _modulo_partition(value, seg.num_partitions)
+        if p is None:  # non-numeric value: cannot prove mismatch, keep
+            return False
+        if p == seg.partition_id:
+            return False
+    return True
 
 
-def _eq_value(expr: Expression, column: str):
-    """Value of a top-level (AND-reachable) EQ predicate on `column`."""
+def _partition_values(expr: Expression, column: str) -> Optional[list]:
+    """Literal values a top-level (AND-reachable) EQ or IN predicate on
+    `column` restricts rows to — the partition-pruning surface. None
+    when no such predicate constrains the column (or an IN carries a
+    non-literal operand, which makes the value set unprovable)."""
     if not isinstance(expr, Function):
         return None
     if expr.name == "and":
         for a in expr.args:
-            v = _eq_value(a, column)
+            v = _partition_values(a, column)
             if v is not None:
                 return v
         return None
-    if expr.name == "equals" and expr.args \
-            and isinstance(expr.args[0], Identifier) \
-            and expr.args[0].name == column \
+    if not expr.args or not isinstance(expr.args[0], Identifier) \
+            or expr.args[0].name != column:
+        return None
+    if expr.name == "equals" and len(expr.args) == 2 \
             and isinstance(expr.args[1], Literal):
-        return expr.args[1].value
+        return [expr.args[1].value]
+    if expr.name == "in" and len(expr.args) >= 2:
+        if all(isinstance(a, Literal) for a in expr.args[1:]):
+            return [a.value for a in expr.args[1:]]
     return None
 
 
@@ -403,7 +616,7 @@ class BrokerRoutingManager:
     Rebuilt from cluster state on assignment changes (the ExternalView
     watch analog is a callback from the controller-lite)."""
 
-    def __init__(self, selector=None):
+    def __init__(self, selector=None, group_selector=None):
         self._tables: Dict[str, RoutingTable] = {}
         #: memoized single-side views for suffix-addressed queries
         #: ('tbl_OFFLINE'): a fresh wrapper per get_route would carry an
@@ -412,11 +625,17 @@ class BrokerRoutingManager:
         self._suffix_views: Dict[str, RoutingTable] = {}
         #: shared AdaptiveServerSelector attached to every route
         self.selector = selector
+        #: shared ReplicaGroupInstanceSelector: one per broker, so
+        #: fingerprint stickiness and residency hints span all tables
+        self.group_selector = (ReplicaGroupInstanceSelector(adaptive=selector)
+                               if group_selector is None else group_selector)
         self._lock = threading.Lock()
 
     def set_route(self, logical_table: str, routing: RoutingTable) -> None:
         if routing.selector is None:
             routing.selector = self.selector
+        if routing.group_selector is None:
+            routing.group_selector = self.group_selector
         with self._lock:
             self._tables[logical_table] = routing
             for suffix in ("_OFFLINE", "_REALTIME"):
@@ -439,6 +658,7 @@ class BrokerRoutingManager:
                         if table.endswith("_OFFLINE")
                         else RoutingTable(realtime=rt.realtime))
                 view.selector = rt.selector
+                view.group_selector = rt.group_selector
                 self._suffix_views[table] = view
             return view
 

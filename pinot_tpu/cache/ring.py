@@ -1,0 +1,224 @@
+"""Consistent-hash cache ring: the remote L2 tier over N cache servers.
+
+A single cache-server role is a cache-fabric SPOF: one process death
+cold-starts every replica's L2 at once, and one box bounds the shared
+tier's capacity. This module shards the remote key space CLIENT-side
+over N cache-server addresses with a consistent-hash ring:
+
+  * virtual nodes — each address hashes to `vnodes` points on the ring,
+    so key ranges spread evenly and removing one node redistributes only
+    ~1/N of the space (no rehash storm: the other nodes' key ranges are
+    untouched, their warm entries stay addressable).
+  * per-node circuit breakers — each address is a full
+    `RemoteCacheBackend` (pool, timeouts, breaker, metrics labeled with
+    `cache_node`). A dead node's key range degrades to L1-only (gets
+    miss, puts drop) while every other range keeps serving; keys are
+    deliberately NOT re-mapped to surviving nodes on failure — a brief
+    network blip would otherwise bounce a range between nodes and serve
+    stale entries after writes landed elsewhere.
+  * membership from config + health — the address list comes from the
+    `...remote.address` knob (comma-separated); `add_node`/`remove_node`
+    support operational resize, and health is the breaker's business.
+
+The `cache.ring.node` failpoint fires on every key->node resolution with
+the chosen node, so chaos schedules can kill exactly one node's range
+(`where={"node": addr}`) deterministically.
+"""
+from __future__ import annotations
+
+import bisect
+import hashlib
+import threading
+from typing import Dict, List, Optional, Sequence
+
+from pinot_tpu.cache.remote import RemoteCacheBackend
+from pinot_tpu.utils.failpoints import FailpointError, fire
+
+
+def _point(s: str) -> int:
+    """Stable 64-bit ring position (process-independent, unlike hash())."""
+    return int.from_bytes(hashlib.sha1(s.encode()).digest()[:8], "big")
+
+
+class ConsistentHashRing:
+    """Key -> node mapping with virtual nodes. Thread-safe; mutation
+    (add/remove) rebuilds the sorted point list atomically."""
+
+    def __init__(self, nodes: Sequence[str] = (), vnodes: int = 64):
+        self.vnodes = max(1, int(vnodes))
+        self._nodes: List[str] = []
+        self._points: List[int] = []
+        self._owners: List[str] = []
+        self._lock = threading.Lock()
+        for n in nodes:
+            self.add_node(n)
+
+    def _rebuild_locked(self) -> None:
+        pts = []
+        for node in self._nodes:
+            for i in range(self.vnodes):
+                pts.append((_point(f"{node}#{i}"), node))
+        pts.sort()
+        self._points = [p for p, _n in pts]
+        self._owners = [n for _p, n in pts]
+
+    def add_node(self, node: str) -> None:
+        with self._lock:
+            if node not in self._nodes:
+                self._nodes.append(node)
+                self._rebuild_locked()
+
+    def remove_node(self, node: str) -> None:
+        with self._lock:
+            if node in self._nodes:
+                self._nodes.remove(node)
+                self._rebuild_locked()
+
+    @property
+    def nodes(self) -> List[str]:
+        with self._lock:
+            return list(self._nodes)
+
+    def node_for(self, key: str) -> Optional[str]:
+        """The node owning `key`'s range (clockwise successor point);
+        None on an empty ring."""
+        with self._lock:
+            if not self._points:
+                return None
+            idx = bisect.bisect(self._points, _point(key))
+            return self._owners[idx % len(self._owners)]
+
+
+class RingRemoteCacheBackend:
+    """Drop-in for `RemoteCacheBackend` (same total-function surface:
+    get/get_with_ttl/put/delete/stats/clear/ping/close never raise into
+    a query) that routes each key to its ring node. `TieredCache` mounts
+    it unchanged, so the `...remote.address` knob growing a comma is the
+    whole migration."""
+
+    def __init__(self, addresses: Sequence[str], vnodes: int = 64,
+                 timeout_seconds: float = 2.0, pool_size: int = 2,
+                 failure_threshold: int = 3, reset_seconds: float = 5.0,
+                 metrics=None, labels: Optional[dict] = None,
+                 compress_threshold: int = 0):
+        addresses = [a.strip() for a in addresses if a and a.strip()]
+        if not addresses:
+            raise ValueError("cache ring needs at least one address")
+        self.ring = ConsistentHashRing(addresses, vnodes=vnodes)
+        self.backends: Dict[str, RemoteCacheBackend] = {}
+        self._metrics = metrics
+        self._labels = labels
+        self._backend_kwargs = dict(
+            timeout_seconds=timeout_seconds, pool_size=pool_size,
+            failure_threshold=failure_threshold,
+            reset_seconds=reset_seconds,
+            compress_threshold=compress_threshold)
+        for addr in addresses:
+            self._add_backend(addr)
+
+    def _add_backend(self, addr: str) -> None:
+        labels = dict(self._labels or {})
+        labels["cache_node"] = addr
+        self.backends[addr] = RemoteCacheBackend(
+            addr, metrics=self._metrics, labels=labels,
+            **self._backend_kwargs)
+
+    # -- membership ----------------------------------------------------
+    def add_node(self, addr: str) -> None:
+        """Operational resize: only ~1/N of the key space re-maps (those
+        ranges cold-start; everything else stays warm)."""
+        if addr not in self.backends:
+            self._add_backend(addr)
+        self.ring.add_node(addr)
+
+    def remove_node(self, addr: str) -> None:
+        self.ring.remove_node(addr)
+        b = self.backends.pop(addr, None)
+        if b is not None:
+            b.close()
+
+    # -- key routing ---------------------------------------------------
+    def _backend_for(self, key: str) -> Optional[RemoteCacheBackend]:
+        addr = self.ring.node_for(key)
+        if addr is None:
+            return None
+        backend = self.backends.get(addr)
+        if backend is None:
+            return None
+        try:
+            # chaos site: one node's key range misbehaving — the per-node
+            # breaker and the miss-degradation below absorb all of it
+            fire("cache.ring.node", node=addr, key=key)
+        except (ConnectionError, FailpointError):
+            backend.errors += 1
+            backend.breaker.record_failure()
+            if self._metrics is not None:
+                self._metrics.add_meter("remote_cache_errors",
+                                        labels={**(self._labels or {}),
+                                                "cache_node": addr})
+            return None
+        return backend
+
+    # -- RemoteCacheBackend surface ------------------------------------
+    def get(self, key: str) -> Optional[bytes]:
+        hit = self.get_with_ttl(key)
+        return None if hit is None else hit[0]
+
+    def get_with_ttl(self, key: str) -> Optional[tuple]:
+        backend = self._backend_for(key)
+        if backend is None:
+            return None
+        return backend.get_with_ttl(key)
+
+    def put(self, key: str, payload: bytes,
+            ttl_seconds: Optional[float] = None) -> bool:
+        backend = self._backend_for(key)
+        if backend is None:
+            return False
+        return backend.put(key, payload, ttl_seconds=ttl_seconds)
+
+    def delete(self, key: str) -> bool:
+        backend = self._backend_for(key)
+        if backend is None:
+            return False
+        return backend.delete(key)
+
+    def stats(self) -> Optional[dict]:
+        """Per-node server stats keyed by address (None for unreachable
+        nodes) — the fleet view, not a single box's."""
+        return {addr: b.stats() for addr, b in self.backends.items()}
+
+    def clear(self) -> bool:
+        ok = True
+        for b in self.backends.values():
+            ok = b.clear() and ok
+        return ok
+
+    def ping(self) -> bool:
+        """True when EVERY member answers (fleet health; per-node health
+        is the breakers' gauge)."""
+        ok = True
+        for b in self.backends.values():
+            ok = b.ping() and ok
+        return ok
+
+    def close(self) -> None:
+        for b in self.backends.values():
+            b.close()
+
+    # -- aggregated client tallies (test/bench parity) ------------------
+    @property
+    def hits(self) -> int:
+        return sum(b.hits for b in self.backends.values())
+
+    @property
+    def misses(self) -> int:
+        return sum(b.misses for b in self.backends.values())
+
+    @property
+    def errors(self) -> int:
+        return sum(b.errors for b in self.backends.values())
+
+    def breaker_of(self, addr: str):
+        b = self.backends.get(addr)
+        return None if b is None else b.breaker

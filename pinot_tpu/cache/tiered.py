@@ -136,13 +136,18 @@ def tiered_backend_from_config(config, tier_prefix: str, metric_prefix: str,
 
     tier_prefix: the tier's key family (e.g. 'pinot.broker.result.cache'
     — supplies `.bytes`, `.ttl.seconds`, `.remote.address`); the client
-    knobs under 'pinot.cache.remote.*' are shared by every mount."""
+    knobs under 'pinot.cache.remote.*' are shared by every mount.
+
+    `.remote.address` may be a comma-separated list: with >= 2 addresses
+    the L2 mount becomes a client-side consistent-hash ring
+    (cache/ring.py) — per-node breakers, a dead node degrades only its
+    key range to L1-only — so cache capacity scales horizontally with
+    the fleet and one box is no longer a fabric SPOF."""
     l1 = LruTtlCache(config.get_int(f"{tier_prefix}.bytes"),
                      config.get_float(f"{tier_prefix}.ttl.seconds"),
                      metrics=metrics, metric_prefix=metric_prefix,
                      labels=labels)
-    l2 = RemoteCacheBackend(
-        config.get_str(f"{tier_prefix}.remote.address"),
+    client_kwargs = dict(
         timeout_seconds=config.get_float(
             "pinot.cache.remote.timeout.seconds"),
         pool_size=config.get_int("pinot.cache.remote.pool.size"),
@@ -153,4 +158,13 @@ def tiered_backend_from_config(config, tier_prefix: str, metric_prefix: str,
         metrics=metrics, labels=labels,
         compress_threshold=config.get_int(
             "pinot.cache.server.compress.threshold.bytes"))
+    address = config.get_str(f"{tier_prefix}.remote.address")
+    if "," in address:
+        from pinot_tpu.cache.ring import RingRemoteCacheBackend
+        l2 = RingRemoteCacheBackend(
+            address.split(","),
+            vnodes=config.get_int("pinot.cache.remote.ring.vnodes"),
+            **client_kwargs)
+    else:
+        l2 = RemoteCacheBackend(address, **client_kwargs)
     return TieredCache(l1, l2, remote_key_fn)

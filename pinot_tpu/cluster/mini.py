@@ -85,12 +85,15 @@ class MiniCluster:
     def __init__(self, num_servers: int = 2, use_tpu: bool = False,
                  result_cache: bool = False, num_brokers: int = 1,
                  cache_server: bool = False, config=None, chaos=None,
-                 minions: int = 0):
+                 minions: int = 0, cache_servers: int = 0):
         """cache_server: start an in-process CacheServer (the remote L2
         role) and point every tier at it — brokers' result caches and
         servers' segment caches become `tiered` automatically, so
-        replicas warm each other (cache/remote.py). config: a base
-        PinotConfiguration; cache_server=True layers the fabric knobs on
+        replicas warm each other (cache/remote.py). cache_servers: start
+        N >= 2 cache-server roles instead and mount them as a client-side
+        consistent-hash ring (cache/ring.py) — one node's death degrades
+        only its key range to L1-only. config: a base
+        PinotConfiguration; cache_server(s) layer the fabric knobs on
         top of it. chaos: a utils.failpoints.FaultSchedule (or a plain
         [(site, policy-kwargs), ...] list) armed at start() and disarmed
         at stop() — deterministic fault injection for the whole cluster's
@@ -107,6 +110,7 @@ class MiniCluster:
             self.chaos = (chaos if isinstance(chaos, FaultSchedule)
                           else FaultSchedule(list(chaos)))
         self.cache_server = None
+        self.cache_servers: List = []
         self._num_minions = max(0, int(minions))
         if self._num_minions:
             cfg = config or PinotConfiguration()
@@ -115,19 +119,22 @@ class MiniCluster:
                 k: v for k, v in self.MINION_DEFAULTS.items()
                 if not cfg.is_set(k)})
         overrides = {}
-        if cache_server:
+        n_cache = max(int(cache_servers), 1 if cache_server else 0)
+        if n_cache:
             from pinot_tpu.cache.remote import CacheServer
             from pinot_tpu.utils.metrics import get_registry
-            self.cache_server = CacheServer(
-                metrics=get_registry("cache_server"))
-            self.cache_server.start()
+            for _ in range(n_cache):
+                cs = CacheServer(metrics=get_registry("cache_server"))
+                cs.start()
+                self.cache_servers.append(cs)
+            #: back-compat alias: the single-server fabric's handle
+            self.cache_server = self.cache_servers[0]
+            address = ",".join(cs.address for cs in self.cache_servers)
             overrides = {
                 "pinot.server.segment.cache.backend": "tiered",
-                "pinot.server.segment.cache.remote.address":
-                    self.cache_server.address,
+                "pinot.server.segment.cache.remote.address": address,
                 "pinot.broker.result.cache.backend": "tiered",
-                "pinot.broker.result.cache.remote.address":
-                    self.cache_server.address,
+                "pinot.broker.result.cache.remote.address": address,
             }
         if overrides:
             config = (config or PinotConfiguration()).with_overrides(overrides)
@@ -142,6 +149,11 @@ class MiniCluster:
         self._num_brokers = max(1, int(num_brokers))
         self.http: Optional[BrokerHttpServer] = None
         self._routes: Dict[str, RoutingTable] = {}
+        #: per-table partition-pruning metadata (add_table stamps it on
+        #: every later add_segment's SegmentInfo)
+        self._table_meta: Dict[str, dict] = {}
+        #: logical table -> tenant tag, replayed onto brokers at start()
+        self._tenants: Dict[str, str] = {}
         #: opt-in tier-1 broker result cache (cache/broker_cache.py)
         self._result_cache_enabled = result_cache
         # -- minion task fabric (ISSUE 5) ------------------------------
@@ -199,6 +211,13 @@ class MiniCluster:
                                  config=self.config)
             for _ in range(self._num_brokers)]
         self.broker = self.brokers[0]
+        # tenant tags for tables registered before start(): brokers did
+        # not exist yet, replay the map onto the fresh handlers
+        for table, tenant in self._tenants.items():
+            for b in self.brokers:
+                b.tenants[table] = tenant
+                if b.quota_manager is not None:
+                    b.quota_manager.set_table_tenant(table, tenant)
         if with_http:
             self.http = BrokerHttpServer(self.broker)
             self.http.start()
@@ -240,8 +259,10 @@ class MiniCluster:
                 b.result_cache.close()
         for s in self.servers:
             s.stop()
-        if self.cache_server is not None:
-            self.cache_server.stop()
+        for cs in self.cache_servers:
+            cs.stop()
+        self.cache_servers = []
+        self.cache_server = None
         if self.chaos is not None:
             self.chaos.disarm()
         if self._minion_tmp is not None:
@@ -284,15 +305,36 @@ class MiniCluster:
     def add_table(self, table_name: str, table_type: str = "OFFLINE",
                   time_column: Optional[str] = None,
                   time_boundary: Optional[int] = None,
-                  table_config=None, schema=None) -> None:
+                  table_config=None, schema=None,
+                  num_replica_groups: int = 0,
+                  partition_column: Optional[str] = None,
+                  num_partitions: int = 0,
+                  tenant: Optional[str] = None,
+                  tenant_weight: Optional[float] = None) -> None:
         """table_config/schema: required for minion tasks over the table
         (executors rebuild segments from the schema); mirrored into the
-        fabric's ClusterState when the cluster runs minions."""
+        fabric's ClusterState when the cluster runs minions.
+        num_replica_groups >= 2 makes the table replica-group routed
+        (each add_segment's [server_idx, *replicas] order IS the group
+        order); partition_column/num_partitions stamp partition-pruning
+        metadata on subsequent add_segment calls; tenant/tenant_weight
+        tag the table for quota + weighted-fair scheduling (defaults
+        from table_config when one is given)."""
+        if table_config is not None:
+            num_replica_groups = (num_replica_groups
+                                  or table_config.routing.num_replica_groups)
+            partition_column = (partition_column
+                                or table_config.routing.partition_column)
+            tenant = tenant or table_config.tenants.server
+            if tenant_weight is None:
+                tenant_weight = table_config.tenants.weight
         rt = self._routes.get(table_name)
         if rt is None:
             rt = RoutingTable()
             self._routes[table_name] = rt
-        route = TableRoute(f"{table_name}_{table_type}", time_column=time_column)
+        route = TableRoute(f"{table_name}_{table_type}",
+                           time_column=time_column,
+                           num_replica_groups=num_replica_groups)
         if table_type == "OFFLINE":
             rt.offline = route
         else:
@@ -300,14 +342,32 @@ class MiniCluster:
         if time_boundary is not None:
             rt.time_boundary = time_boundary
         self.routing.set_route(table_name, rt)
+        self._table_meta[table_name] = {
+            "partition_column": partition_column,
+            "num_partitions": int(num_partitions or 0),
+        }
+        if tenant:
+            for b in self.brokers:
+                b.tenants[table_name] = tenant
+                if b.quota_manager is not None:
+                    b.quota_manager.set_table_tenant(table_name, tenant)
+            self._tenants[table_name] = tenant
+            if tenant_weight is not None:
+                for s in self.servers:
+                    sched = s.transport.scheduler
+                    if hasattr(sched, "set_tenant_weight"):
+                        sched.set_tenant_weight(tenant, tenant_weight)
         if self.cluster_state is not None and table_config is not None \
                 and schema is not None:
             self.cluster_state.add_table(table_config, schema)
 
     def add_segment(self, table_name: str, segment: ImmutableSegment,
                     server_idx: int, table_type: str = "OFFLINE",
-                    replicas: Sequence[int] = ()) -> None:
-        """Load the segment on server_idx (+replicas) and register routing."""
+                    replicas: Sequence[int] = (),
+                    partition_id: Optional[int] = None) -> None:
+        """Load the segment on server_idx (+replicas) and register
+        routing. For replica-group tables the [server_idx, *replicas]
+        ORDER is the group order (element g lives in group g)."""
         physical = f"{table_name}_{table_type}"
         targets = [server_idx, *replicas]
         for idx in targets:
@@ -315,9 +375,15 @@ class MiniCluster:
         rt = self._routes[table_name]
         route = rt.offline if table_type == "OFFLINE" else rt.realtime
         meta = segment.metadata
+        tmeta = self._table_meta.get(table_name, {})
         route.segments[segment.name] = SegmentInfo(
             name=segment.name,
             servers=[self.servers[i].instance_id for i in targets],
+            partition_id=partition_id,
+            partition_column=(tmeta.get("partition_column")
+                              if partition_id is not None else None),
+            num_partitions=(tmeta.get("num_partitions", 0)
+                            if partition_id is not None else 0),
             start_time=meta.start_time, end_time=meta.end_time,
             version=meta.crc)
         if self.cluster_state is not None:
@@ -329,7 +395,7 @@ class MiniCluster:
                 instances=[self.servers[i].instance_id for i in targets],
                 dir_path=segment.dir.path, num_docs=segment.num_docs,
                 start_time=meta.start_time, end_time=meta.end_time,
-                crc=meta.crc))
+                partition_id=partition_id, crc=meta.crc))
 
     def remove_segment(self, table_name: str, segment_name: str,
                        table_type: str = "OFFLINE") -> None:
@@ -348,6 +414,31 @@ class MiniCluster:
         if self.cluster_state is not None:
             self.cluster_state.remove_segment(
                 f"{table_name}_{table_type}", segment_name)
+
+    def kill_server(self, idx: int) -> None:
+        """SIGKILL-equivalent for one embedded server: the query
+        transport (and MSE worker) die mid-whatever with no goodbye —
+        established broker channels sever, new dials are refused — while
+        the data manager's memory is simply abandoned, exactly the state
+        a killed process leaves. Brokers discover it the hard way
+        (connection error -> failure detector -> group demotion).
+        Idempotent; `query_server.QueryServer.stop` tolerates repeats."""
+        s = self.servers[idx]
+        s.mse_worker.stop()
+        s.transport.stop()
+
+    def kill_replica_group(self, table_name: str, group: int,
+                           table_type: str = "OFFLINE") -> List[str]:
+        """Kill EVERY member of one replica group (the whole-rack chaos
+        scenario). Returns the instance ids killed."""
+        rt = self._routes[table_name]
+        route = rt.offline if table_type == "OFFLINE" else rt.realtime
+        members = {seg.servers[group] for seg in route.segments.values()
+                   if group < len(seg.servers)}
+        by_id = {s.instance_id: i for i, s in enumerate(self.servers)}
+        for m in sorted(members):
+            self.kill_server(by_id[m])
+        return sorted(members)
 
     def query(self, sql: str):
         assert self.broker is not None, "cluster not started"

@@ -158,7 +158,7 @@ class ServerRole:
                  query_port: int = 0, host: str = "127.0.0.1",
                  use_tpu: bool = False,
                  download_dir: Optional[str] = None,
-                 config=None):
+                 config=None, tenant: Optional[str] = None):
         import tempfile
 
         from pinot_tpu.server.data_manager import InstanceDataManager
@@ -192,6 +192,9 @@ class ServerRole:
         #: (ref KafkaStreamMetadataProvider.fetchPartitionCount re-polls)
         self._rt_partitions: Dict[str, tuple] = {}
         self._stopping = False
+        #: tenant pool this server joins (tenant:<name> instance tag);
+        #: None = the DefaultTenant pool
+        self.tenant = tenant
         self._reconcile_lock = threading.Lock()
 
     #: partition-discovery refresh interval
@@ -200,7 +203,8 @@ class ServerRole:
     def start(self) -> None:
         self.transport.start()
         self.client.register_instance(
-            self.instance_id, self.transport.host, self.transport.port)
+            self.instance_id, self.transport.host, self.transport.port,
+            tags=[f"tenant:{self.tenant}"] if self.tenant else None)
         self.reconcile()
         self.client.watch(lambda _v: self.reconcile())
 
@@ -229,6 +233,16 @@ class ServerRole:
             except (ConnectionError, OSError, RuntimeError):
                 log.warning("coordinator unreachable; keeping local state")
                 return
+            # tenant scheduling weights ride the table configs: push
+            # them into the query scheduler so weighted-fair groups are
+            # shaped before the tenant's first query arrives
+            sched = self.transport.scheduler
+            if hasattr(sched, "set_tenant_weight"):
+                for cfg_d in blob.get("tables", {}).values():
+                    tn = cfg_d.get("tenants") or {}
+                    if tn.get("server"):
+                        sched.set_tenant_weight(
+                            tn["server"], float(tn.get("weight", 1.0)))
             wanted: Set[tuple] = set()
             for table, segs in blob.get("segments", {}).items():
                 for name, st in segs.items():
@@ -406,9 +420,10 @@ class ServerRole:
 def run_server(instance_id: str, coordinator: str, query_port: int = 0,
                use_tpu: bool = False, config=None,
                ready_event: Optional[threading.Event] = None,
-               stop_event: Optional[threading.Event] = None) -> None:
+               stop_event: Optional[threading.Event] = None,
+               tenant: Optional[str] = None) -> None:
     role = ServerRole(instance_id, coordinator, query_port=query_port,
-                      use_tpu=use_tpu, config=config)
+                      use_tpu=use_tpu, config=config, tenant=tenant)
     role.start()
     print(f"server {instance_id} listening on "
           f"{role.transport.host}:{role.transport.port}", flush=True)
@@ -418,7 +433,12 @@ def run_server(instance_id: str, coordinator: str, query_port: int = 0,
     try:
         while not stop.wait(2.0):
             try:
-                role.client.request("heartbeat", instance_id=instance_id)
+                # the instance-sweep payload: per-table HBM-resident
+                # bytes ride every heartbeat so brokers can prefer the
+                # replica whose device memory already holds the columns
+                role.client.request(
+                    "heartbeat", instance_id=instance_id,
+                    residency=role.executor.residency_report())
             except (ConnectionError, OSError, RuntimeError):
                 pass
     finally:
@@ -439,6 +459,7 @@ class BrokerRole:
         from pinot_tpu.utils.config import PinotConfiguration
 
         cfg = config or PinotConfiguration()
+        self._config = cfg
         self.client = CoordinationClient(coordinator)
         self.routing = BrokerRoutingManager(
             selector=AdaptiveServerSelector(
@@ -477,7 +498,13 @@ class BrokerRole:
             except (ConnectionError, OSError, RuntimeError):
                 log.warning("coordinator unreachable; keeping routes")
                 return
+            group_selector = getattr(self.routing, "group_selector", None)
             for iid, inst in blob.get("instances", {}).items():
+                if group_selector is not None:
+                    # instance-sweep residency hints -> replica-choice
+                    # tiebreak (heartbeat payload, cluster_state)
+                    group_selector.update_residency(
+                        iid, inst.get("residency") or {})
                 if not inst.get("port"):
                     continue
                 cur = self.connections.get(iid)
@@ -494,16 +521,37 @@ class BrokerRole:
                 cfg = TableConfig.from_dict(cfg_d)
                 self.quotas.set_quota(
                     logical, cfg.query.max_queries_per_second)
+                tenant = cfg.tenants.server
+                self.quotas.set_table_tenant(logical, tenant)
+                self.handler.tenants[logical] = tenant
+                # per-tenant QPS ceiling: an operator knob, not a table
+                # config (one tenant spans many tables). Applied
+                # unconditionally so REMOVING the knob lifts the limit
+                # on the next reconcile, symmetric with setting it
+                tenant_qps = self._config.get(
+                    f"pinot.broker.tenant.quota.qps.{tenant}")
+                self.quotas.set_tenant_quota(
+                    tenant,
+                    float(tenant_qps) if tenant_qps is not None else None)
                 physical = cfg.table_name_with_type
-                route = TableRoute(physical,
-                                   time_column=cfg.retention.time_column)
+                route = TableRoute(
+                    physical, time_column=cfg.retention.time_column,
+                    num_replica_groups=cfg.routing.num_replica_groups)
+                pcol = cfg.routing.partition_column
+                nparts = 0
+                if pcol and cfg.partition_config.get(pcol):
+                    nparts = int(cfg.partition_config[pcol]
+                                 .get("numPartitions", 0) or 0)
                 for name, st in blob.get("segments", {}) \
                                      .get(physical, {}).items():
                     if st.get("status") == "OFFLINE":
                         continue
+                    pid = st.get("partition_id")
                     route.segments[name] = SegmentInfo(
                         name=name, servers=list(st.get("instances", ())),
-                        partition_id=st.get("partition_id"),
+                        partition_id=pid,
+                        partition_column=pcol if pid is not None else None,
+                        num_partitions=nparts if pid is not None else 0,
                         start_time=st.get("start_time"),
                         end_time=st.get("end_time"),
                         version=st.get("crc", 0) or 0)

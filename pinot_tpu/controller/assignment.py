@@ -5,6 +5,13 @@ BalancedNumSegmentAssignment (least-loaded instances),
 ReplicaGroupSegmentAssignment (replica groups get full copies;
 partition-aware placement inside a group). Returns instance lists per
 segment; the controller commits them to ClusterState (IdealState update).
+
+Replica-group invariant: the ORDER of a segment's instance list is its
+group membership — `instances[g]` is the group-g replica for every
+segment of the table, which is how the broker's
+ReplicaGroupInstanceSelector addresses one whole group without a
+separate group map. Tenant tags (`tenant:<name>` on InstanceState)
+restrict every strategy's candidate pool to the table's tenant.
 """
 from __future__ import annotations
 
@@ -14,12 +21,73 @@ from typing import Dict, List, Optional, Sequence
 from pinot_tpu.controller.cluster_state import ClusterState, SegmentState
 
 
+class ReplicaGroupConfigError(ValueError):
+    """The instance pool cannot realize the configured replica groups.
+
+    Raised instead of silently degenerating: with
+    `len(instances) % num_replica_groups != 0` the old floor-division
+    split dropped the trailing instances from EVERY group — servers that
+    were registered, healthy, and paid for would simply never receive a
+    segment, and nobody would know."""
+
+
+def _pool(state: ClusterState, tenant: Optional[str]) -> List[str]:
+    """The replica-group tiling pool: REGISTERED tenant servers, not the
+    momentary live set — a server in a heartbeat blip keeps its group
+    slot (it reconciles when it returns; the other groups still serve)
+    instead of collapsing the group math and failing every upload."""
+    return sorted(i.instance_id
+                  for i in state.server_instances(tenant=tenant))
+
+
+def _split_groups(instances: Sequence[str],
+                  num_replica_groups: int) -> List[List[str]]:
+    """Partition the (sorted) pool into equal replica groups; refuses
+    pools the config cannot tile (ReplicaGroupConfigError)."""
+    if num_replica_groups < 1:
+        raise ReplicaGroupConfigError(
+            f"num_replica_groups must be >= 1, got {num_replica_groups}")
+    if len(instances) < num_replica_groups:
+        raise ReplicaGroupConfigError(
+            f"{len(instances)} instances < {num_replica_groups} "
+            f"replica groups")
+    if len(instances) % num_replica_groups:
+        raise ReplicaGroupConfigError(
+            f"{len(instances)} instances do not tile into "
+            f"{num_replica_groups} replica groups: the trailing "
+            f"{len(instances) % num_replica_groups} instance(s) would be "
+            f"silently excluded from every group")
+    group_size = len(instances) // num_replica_groups
+    return [list(instances[g * group_size:(g + 1) * group_size])
+            for g in range(num_replica_groups)]
+
+
+def assign_for_table(state: ClusterState, cfg, physical: str,
+                     segment: str,
+                     partition_id: Optional[int] = None) -> List[str]:
+    """Strategy dispatch from a TableConfig: replica-group placement when
+    `routing.num_replica_groups >= 2`, else balanced — always inside the
+    table's tenant pool. The single entry point the upload paths share so
+    a table's strategy/tenant can't silently diverge between them."""
+    tenant = getattr(getattr(cfg, "tenants", None), "server", None)
+    nrg = getattr(getattr(cfg, "routing", None), "num_replica_groups", 0)
+    if nrg and nrg >= 2:
+        return assign_replica_groups(state, physical, segment, nrg,
+                                     partition_id=partition_id,
+                                     tenant=tenant)
+    return assign_balanced(state, physical, segment,
+                           replication=cfg.retention.replication,
+                           tenant=tenant)
+
+
 def assign_balanced(state: ClusterState, table: str, segment: str,
-                    replication: int = 1) -> List[str]:
+                    replication: int = 1,
+                    tenant: Optional[str] = None) -> List[str]:
     """Least-loaded placement (ref BalancedNumSegmentAssignment)."""
-    instances = [i.instance_id for i in state.live_instances()]
+    instances = [i.instance_id for i in state.live_instances(tenant=tenant)]
     if not instances:
-        raise RuntimeError("no live server instances to assign to")
+        raise RuntimeError("no live server instances to assign to"
+                           + (f" in tenant {tenant!r}" if tenant else ""))
     load: Dict[str, int] = defaultdict(int)
     for seg in state.table_segments(table):
         for inst in seg.instances:
@@ -30,18 +98,14 @@ def assign_balanced(state: ClusterState, table: str, segment: str,
 
 def assign_replica_groups(state: ClusterState, table: str, segment: str,
                           num_replica_groups: int,
-                          partition_id: Optional[int] = None) -> List[str]:
+                          partition_id: Optional[int] = None,
+                          tenant: Optional[str] = None) -> List[str]:
     """Replica-group placement (ref ReplicaGroupSegmentAssignment): servers
     are split into N groups; each group holds a full copy; inside a group
     the segment goes to partition_id % group_size (partition-aware) or the
-    least-loaded member."""
-    instances = sorted(i.instance_id for i in state.live_instances())
-    if len(instances) < num_replica_groups:
-        raise RuntimeError(
-            f"{len(instances)} instances < {num_replica_groups} replica groups")
-    group_size = len(instances) // num_replica_groups
-    groups = [instances[g * group_size:(g + 1) * group_size]
-              for g in range(num_replica_groups)]
+    least-loaded member. The returned list is GROUP-ORDERED: element g is
+    the group-g replica (the broker selector's addressing contract)."""
+    groups = _split_groups(_pool(state, tenant), num_replica_groups)
     load: Dict[str, int] = defaultdict(int)
     for seg in state.table_segments(table):
         for inst in seg.instances:
@@ -57,19 +121,18 @@ def assign_replica_groups(state: ClusterState, table: str, segment: str,
 
 def target_assignment(state: ClusterState, table: str,
                       replication: int = 1,
-                      num_replica_groups: Optional[int] = None
+                      num_replica_groups: Optional[int] = None,
+                      tenant: Optional[str] = None
                       ) -> Dict[str, List[str]]:
     """Full-table target map used by the rebalancer: round-robin spread in
     segment-name order (deterministic), honoring the strategy."""
     segments = sorted(state.table_segments(table), key=lambda s: s.name)
-    instances = sorted(i.instance_id for i in state.live_instances())
+    instances = _pool(state, tenant)
     if not instances:
         return {}
     out: Dict[str, List[str]] = {}
     if num_replica_groups:
-        group_size = len(instances) // num_replica_groups
-        groups = [instances[g * group_size:(g + 1) * group_size]
-                  for g in range(num_replica_groups)]
+        groups = _split_groups(instances, num_replica_groups)
         for idx, seg in enumerate(segments):
             pick = seg.partition_id if seg.partition_id is not None else idx
             out[seg.name] = [g[pick % len(g)] for g in groups]

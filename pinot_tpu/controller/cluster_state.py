@@ -44,6 +44,14 @@ class SegmentState:
         return cls(**d)
 
 
+#: the untagged server pool every table belongs to unless configured
+#: otherwise (ref Helix's DefaultTenant broker/server tag)
+DEFAULT_TENANT = "DefaultTenant"
+
+#: instance tag prefix that assigns a server to a tenant pool
+TENANT_TAG_PREFIX = "tenant:"
+
+
 @dataclass
 class InstanceState:
     instance_id: str
@@ -51,6 +59,18 @@ class InstanceState:
     port: int = 0
     enabled: bool = True
     tags: List[str] = field(default_factory=list)
+    #: physical table -> HBM-resident bytes this server advertises
+    #: (heartbeat payload; feeds residency-aware broker replica choice)
+    residency: Dict[str, int] = field(default_factory=dict)
+
+    @property
+    def tenant(self) -> str:
+        """The tenant pool this instance serves (first `tenant:<name>`
+        tag; untagged servers form the DefaultTenant pool)."""
+        for t in self.tags:
+            if t.startswith(TENANT_TAG_PREFIX):
+                return t[len(TENANT_TAG_PREFIX):]
+        return DEFAULT_TENANT
 
 
 class ClusterState:
@@ -96,13 +116,36 @@ class ClusterState:
             self.instances[inst.instance_id] = inst
         self._persist()
 
-    def live_instances(self) -> List[InstanceState]:
+    def live_instances(self, tenant: Optional[str] = None
+                       ) -> List[InstanceState]:
         """Enabled SERVER instances — role-tagged instances (minion
         workers register with tags=['minion']) never receive segment
-        assignments (ref Helix instance tags gating assignment)."""
+        assignments (ref Helix instance tags gating assignment).
+        tenant: restrict to one tenant pool (`tenant:<name>` tags;
+        untagged servers are the DefaultTenant pool) so a table's
+        segments land only on its tenant's servers."""
         with self._lock:
-            return [i for i in self.instances.values()
-                    if i.enabled and "minion" not in i.tags]
+            out = [i for i in self.instances.values()
+                   if i.enabled and "minion" not in i.tags]
+        if tenant is not None:
+            out = [i for i in out if i.tenant == tenant]
+        return out
+
+    def server_instances(self, tenant: Optional[str] = None
+                         ) -> List[InstanceState]:
+        """REGISTERED server instances regardless of liveness — the
+        replica-group tiling pool. Group math must be a function of the
+        provisioned fleet, not the momentary live set: a server missing
+        heartbeats (disabled by the liveness sweep) still owns its group
+        slot, exactly as a Helix IdealState keeps a dead participant's
+        assignments; shrinking the pool instead would hard-fail every
+        upload over a transient blip."""
+        with self._lock:
+            out = [i for i in self.instances.values()
+                   if "minion" not in i.tags]
+        if tenant is not None:
+            out = [i for i in out if i.tenant == tenant]
+        return out
 
     def minion_instances(self) -> List[InstanceState]:
         with self._lock:

@@ -15,7 +15,7 @@ import time
 from typing import Callable, Dict, List, Optional
 
 from pinot_tpu.controller import maintenance
-from pinot_tpu.controller.assignment import assign_balanced
+from pinot_tpu.controller.assignment import assign_for_table
 from pinot_tpu.controller.cluster_state import (
     ClusterState, InstanceState, SegmentState)
 from pinot_tpu.models import Schema, TableConfig
@@ -53,8 +53,9 @@ class Controller:
         physical = f"{logical_table}_{table_type}"
         seg = load_segment(seg_dir)
         meta = seg.metadata
-        instances = assign_balanced(self.state, physical, meta.segment_name,
-                                    replication=cfg.retention.replication)
+        instances = assign_for_table(self.state, cfg, physical,
+                                     meta.segment_name,
+                                     partition_id=partition_id)
         st = SegmentState(
             name=meta.segment_name, table=physical, instances=instances,
             dir_path=seg_dir, num_docs=meta.num_docs,
@@ -119,7 +120,8 @@ class Controller:
                   for s in self.state.table_segments(physical)}
         moves = maintenance.rebalance_table(
             self.state, physical, replication=cfg.retention.replication,
-            dry_run=dry_run)
+            num_replica_groups=cfg.routing.num_replica_groups or None,
+            tenant=cfg.tenants.server, dry_run=dry_run)
         if dry_run:
             return moves
         # apply to servers: load on new instances, then unload from old

@@ -28,7 +28,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 log = logging.getLogger(__name__)
 
-from pinot_tpu.controller.assignment import assign_balanced
+from pinot_tpu.controller.assignment import assign_for_table
 from pinot_tpu.controller.cluster_state import (
     ClusterState, InstanceState, SegmentState)
 from pinot_tpu.controller.completion import SegmentCompletionManager
@@ -174,9 +174,17 @@ class CoordinationServer:
             iid = req["instance_id"]
             self._last_seen[iid] = time.time()
             inst = self.state.instances.get(iid)
-            if inst is not None and not inst.enabled:
-                inst.enabled = True  # recovered: rejoin assignment pool
-                self._notify()
+            if inst is not None:
+                # instance-sweep payload: per-table HBM-resident bytes
+                # ride the heartbeat so brokers can prefer replicas whose
+                # device memory already holds a table's columns
+                res = req.get("residency")
+                if isinstance(res, dict):
+                    inst.residency = {str(k): int(v)
+                                      for k, v in res.items()}
+                if not inst.enabled:
+                    inst.enabled = True  # recovered: rejoin pool
+                    self._notify()
             return {"ok": True}
         if op == "upload_segment":
             self._sweep_liveness()
@@ -311,9 +319,9 @@ class CoordinationServer:
             with open(os.path.join(req["seg_dir"], "metadata.json")) as f:
                 meta = SegmentMetadata.from_dict(json.load(f))
             dir_path = req["seg_dir"]
-        instances = assign_balanced(
-            self.state, physical, meta.segment_name,
-            replication=cfg.retention.replication)
+        instances = assign_for_table(
+            self.state, cfg, physical, meta.segment_name,
+            partition_id=req.get("partition_id"))
         st = SegmentState(
             name=meta.segment_name, table=physical, instances=instances,
             dir_path=dir_path, num_docs=meta.num_docs,

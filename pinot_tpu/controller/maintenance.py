@@ -46,10 +46,13 @@ def run_retention(state: ClusterState,
 
 def rebalance_table(state: ClusterState, table: str, replication: int = 1,
                     num_replica_groups: Optional[int] = None,
+                    tenant: Optional[str] = None,
                     dry_run: bool = False) -> Dict[str, dict]:
     """Move the table to its target assignment (ref TableRebalancer).
-    Returns {segment: {'from': [...], 'to': [...]}} for segments that move."""
-    target = target_assignment(state, table, replication, num_replica_groups)
+    Returns {segment: {'from': [...], 'to': [...]}} for segments that move.
+    tenant: restrict the candidate pool to the table's tenant servers."""
+    target = target_assignment(state, table, replication, num_replica_groups,
+                               tenant=tenant)
     moves: Dict[str, dict] = {}
     current = {s.name: s.instances for s in state.table_segments(table)}
     for name, to in target.items():

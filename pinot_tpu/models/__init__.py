@@ -17,6 +17,7 @@ from pinot_tpu.models.table_config import (
     UpsertConfig,
     DedupConfig,
     RoutingConfig,
+    TenantConfig,
     QueryConfig,
     RetentionConfig,
 )
@@ -35,6 +36,7 @@ __all__ = [
     "UpsertConfig",
     "DedupConfig",
     "RoutingConfig",
+    "TenantConfig",
     "QueryConfig",
     "RetentionConfig",
     "base_table_name",

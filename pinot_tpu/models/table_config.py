@@ -195,19 +195,48 @@ class DedupConfig:
 
 @dataclass
 class RoutingConfig:
-    """Ref: spi/config/table/RoutingConfig.java — segment pruner + selector types."""
+    """Ref: spi/config/table/RoutingConfig.java — segment pruner + selector
+    types, plus the replica-group strategy knobs the reference carries in
+    ReplicaGroupStrategyConfig (partitionColumn, numReplicaGroups)."""
 
     segment_pruner_types: List[str] = field(default_factory=lambda: ["time", "partition"])
     instance_selector_type: str = "balanced"  # balanced | replicaGroup | adaptive
+    #: >= 2 makes the table replica-group routed: assignment places one
+    #: full copy per group, the broker scatters each query to ONE group
+    num_replica_groups: int = 0
+    #: column whose EQ/IN literals prune segments before scatter
+    partition_column: Optional[str] = None
 
     def to_dict(self) -> dict:
         return {"segmentPrunerTypes": self.segment_pruner_types,
-                "instanceSelectorType": self.instance_selector_type}
+                "instanceSelectorType": self.instance_selector_type,
+                "numReplicaGroups": self.num_replica_groups,
+                "partitionColumn": self.partition_column}
 
     @classmethod
     def from_dict(cls, d: dict) -> "RoutingConfig":
         return cls(segment_pruner_types=d.get("segmentPrunerTypes", ["time", "partition"]),
-                   instance_selector_type=d.get("instanceSelectorType", "balanced"))
+                   instance_selector_type=d.get("instanceSelectorType", "balanced"),
+                   num_replica_groups=d.get("numReplicaGroups", 0) or 0,
+                   partition_column=d.get("partitionColumn"))
+
+
+@dataclass
+class TenantConfig:
+    """Ref: spi/config/table/TenantConfig.java — which tagged server pool
+    serves this table, plus the scheduler weight its queries carry in the
+    per-tenant weighted-fair queue (server/scheduler.py)."""
+
+    server: str = "DefaultTenant"
+    weight: float = 1.0
+
+    def to_dict(self) -> dict:
+        return {"server": self.server, "weight": self.weight}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TenantConfig":
+        return cls(server=d.get("server", "DefaultTenant") or "DefaultTenant",
+                   weight=float(d.get("weight", 1.0)))
 
 
 @dataclass
@@ -278,6 +307,7 @@ class TableConfig:
     indexing: IndexingConfig = field(default_factory=IndexingConfig)
     ingestion: IngestionConfig = field(default_factory=IngestionConfig)
     routing: RoutingConfig = field(default_factory=RoutingConfig)
+    tenants: TenantConfig = field(default_factory=TenantConfig)
     query: QueryConfig = field(default_factory=QueryConfig)
     retention: RetentionConfig = field(default_factory=RetentionConfig)
     upsert: Optional[UpsertConfig] = None
@@ -313,6 +343,7 @@ class TableConfig:
             "tableIndexConfig": self.indexing.to_dict(),
             "ingestionConfig": self.ingestion.to_dict(),
             "routing": self.routing.to_dict(),
+            "tenants": self.tenants.to_dict(),
             "query": self.query.to_dict(),
             "segmentPartitionConfig": self.partition_config,
             "tierConfigs": self.tier_configs,
@@ -332,6 +363,7 @@ class TableConfig:
             indexing=IndexingConfig.from_dict(d.get("tableIndexConfig", {})),
             ingestion=IngestionConfig.from_dict(d.get("ingestionConfig", {})),
             routing=RoutingConfig.from_dict(d.get("routing", {})),
+            tenants=TenantConfig.from_dict(d.get("tenants", {})),
             query=QueryConfig.from_dict(d.get("query", {})),
             retention=RetentionConfig.from_dict(d.get("segmentsConfig", {})),
             upsert=UpsertConfig.from_dict(d["upsertConfig"]) if d.get("upsertConfig") else None,

@@ -256,6 +256,17 @@ class ResidencyManager:
         with self._lock:
             return sum(1 for k in self._entries if k[1] == name)
 
+    def resident_bytes_by_segment(self) -> Dict[str, int]:
+        """Resident bytes keyed by segment NAME — the instance-sweep
+        residency payload's raw material (the server maps names to
+        tables; brokers then prefer replicas already holding a table's
+        columns in HBM)."""
+        with self._lock:
+            out: Dict[str, int] = {}
+            for k, e in self._entries.items():
+                out[k[1]] = out.get(k[1], 0) + e[2]
+            return out
+
     def frequency(self, name: str, kind: str, col: str) -> int:
         with self._lock:
             return self._freq.get((name, kind, col), 0)

@@ -191,6 +191,30 @@ class ServerQueryExecutor:
                         "instance": self.data_manager.instance_id})
             return self._engine
 
+    def residency_report(self) -> dict:
+        """Per-physical-table HBM-resident bytes this server can
+        advertise in its heartbeat (the instance-sweep residency
+        payload): brokers break replica-choice ties toward servers whose
+        device memory already holds the table's columns. Empty when no
+        device engine/resident tier exists — the hint is best-effort."""
+        engine = self._engine
+        res = getattr(engine, "_residency", None) \
+            if engine is not None else None
+        if res is None or not getattr(res, "enabled", False):
+            return {}
+        by_seg = res.resident_bytes_by_segment()
+        if not by_seg:
+            return {}
+        out: dict = {}
+        for table in self.data_manager.table_names:
+            tdm = self.data_manager.table(table, create=False)
+            if tdm is None:
+                continue
+            total = sum(by_seg.get(n, 0) for n in tdm.segment_names)
+            if total:
+                out[table] = total
+        return out
+
     def cancel(self, query_id) -> bool:
         """Broker-initiated cancel (rides ResourceAccountant.cancel): the
         next cooperative check in the query's segment loop raises and the
@@ -374,7 +398,8 @@ class QueryServer:
                             lambda g=gen: next(g, None),
                             table=req.get("tableName", ""),
                             workload=req.get("workload", "primary"),
-                            deadline=deadline)
+                            deadline=deadline,
+                            tenant=req.get("tenant"))
                         try:
                             frame = await asyncio.wrap_future(fut)
                         except (QueryCancelledError, BrokerTimeoutError) as e:
@@ -396,7 +421,8 @@ class QueryServer:
                         timeout_ms=r.get("timeoutMs"), deadline=d),
                     table=req.get("tableName", ""),
                     workload=req.get("workload", "primary"),
-                    deadline=deadline)
+                    deadline=deadline,
+                    tenant=req.get("tenant"))
                 try:
                     resp = await asyncio.wrap_future(fut)
                 except (QueryCancelledError, BrokerTimeoutError) as e:
@@ -476,14 +502,16 @@ class ServerConnection:
                 request_id: int = 0,
                 extra_filter: Optional[str] = None,
                 timeout_ms: Optional[float] = None,
-                query_id=None) -> bytes:
+                query_id=None, tenant: Optional[str] = None) -> bytes:
         """timeout_ms: remaining query budget, shipped to the server AND
         used as this channel's read timeout (+grace) so a dead server
-        can't pin a broker fan-out thread past the deadline."""
+        can't pin a broker fan-out thread past the deadline. tenant:
+        the weighted-fair scheduling group the server charges this
+        query's wall time to (from TableConfig tenant tags)."""
         payload = json.dumps({
             "requestId": request_id, "tableName": table_name, "sql": sql,
             "segments": segments, "extraFilter": extra_filter,
-            "timeoutMs": timeout_ms,
+            "timeoutMs": timeout_ms, "tenant": tenant,
             "queryId": query_id}).encode()
         with self._lock:
             try:

@@ -63,7 +63,12 @@ class QueryScheduler:
 
     def submit(self, fn: Callable[[], bytes], table: str = "",
                workload: str = "primary",
-               deadline: Optional[float] = None) -> Future:
+               deadline: Optional[float] = None,
+               tenant: Optional[str] = None) -> Future:
+        """tenant: the weighted-fair accounting group the query's wall
+        time is charged to (shipped by the broker from TableConfig
+        tenant tags; None folds into the default tenant). Schedulers
+        without tenant awareness accept and ignore it."""
         raise NotImplementedError
 
     @staticmethod
@@ -98,11 +103,16 @@ class FCFSQueryScheduler(QueryScheduler):
                                         thread_name_prefix="query-fcfs")
 
     def submit(self, fn, table: str = "", workload: str = "primary",
-               deadline: Optional[float] = None) -> Future:
+               deadline: Optional[float] = None,
+               tenant: Optional[str] = None) -> Future:
         return self._track(self._pool.submit(self._guard(fn, deadline)))
 
     def stop(self) -> None:
         self._pool.shutdown(wait=False)
+
+
+#: tenant label queries fold into when the broker ships none
+DEFAULT_TENANT = "DefaultTenant"
 
 
 class _Group:
@@ -114,10 +124,33 @@ class _Group:
         self.last_refill = time.monotonic()
 
 
+class _TenantGroup(_Group):
+    """One tenant's bucket + its per-table sub-groups. The tenant bucket
+    gates WHICH tenant runs next (weighted-fair: refill and cap scale
+    with the tenant's weight); the table buckets preserve the original
+    per-table fairness INSIDE the tenant, so a tenant flooding through
+    one table still can't starve its own other tables."""
+
+    __slots__ = ("weight", "tables")
+
+    def __init__(self, tokens: float, weight: float = 1.0):
+        super().__init__(tokens * weight)
+        self.weight = max(1e-6, float(weight))
+        self.tables: Dict[str, _Group] = {}
+
+    @property
+    def pending_count(self) -> int:
+        return sum(len(g.pending) for g in self.tables.values())
+
+
 class TokenPriorityScheduler(QueryScheduler):
-    """Ref PriorityScheduler + TokenSchedulerGroup: per-table groups hold
-    token buckets; workers always serve the non-empty group with the most
-    tokens, and a query's wall time is charged against its group."""
+    """Ref PriorityScheduler + TokenSchedulerGroup, extended to two
+    levels: per-TENANT weighted token buckets over per-table buckets.
+    Workers serve the non-empty tenant with the most tokens, then that
+    tenant's richest table group; a query's wall time is charged against
+    BOTH its table and its tenant, so a flooding table cannot starve a
+    light one and a flooding tenant degrades only itself (its refill is
+    weight-bounded while other tenants' buckets stay full)."""
 
     def __init__(self, num_threads: int = 8,
                  tokens_per_interval: float = 100.0,
@@ -125,10 +158,24 @@ class TokenPriorityScheduler(QueryScheduler):
         self.num_threads = num_threads
         self.tokens_per_interval = tokens_per_interval
         self.interval_s = interval_s
-        self._groups: Dict[str, _Group] = {}
+        self._tenants: Dict[str, _TenantGroup] = {}
+        self._weights: Dict[str, float] = {}
         self._lock = threading.Condition()
         self._stopped = False
         self._threads = []
+
+    def set_tenant_weight(self, tenant: str, weight: float) -> None:
+        """Fed from TableConfig tenant weights (broker/controller push):
+        a tenant with weight w refills (and caps) at w x the per-interval
+        budget. Takes effect on the live bucket immediately."""
+        with self._lock:
+            self._weights[tenant] = float(weight)
+            tg = self._tenants.get(tenant)
+            if tg is not None:
+                tg.weight = max(1e-6, float(weight))
+                tg.tokens = min(tg.tokens,
+                                self.tokens_per_interval * tg.weight)
+            self._lock.notify_all()
 
     def start(self) -> None:
         for i in range(self.num_threads):
@@ -143,38 +190,58 @@ class TokenPriorityScheduler(QueryScheduler):
             self._lock.notify_all()
 
     def submit(self, fn, table: str = "", workload: str = "primary",
-               deadline: Optional[float] = None) -> Future:
+               deadline: Optional[float] = None,
+               tenant: Optional[str] = None) -> Future:
         fut: Future = Future()
+        tenant = tenant or DEFAULT_TENANT
         with self._lock:
-            g = self._groups.get(table)
+            tg = self._tenants.get(tenant)
+            if tg is None:
+                tg = self._tenants[tenant] = _TenantGroup(
+                    self.tokens_per_interval,
+                    self._weights.get(tenant, 1.0))
+            g = tg.tables.get(table)
             if g is None:
-                g = self._groups[table] = _Group(self.tokens_per_interval)
+                g = tg.tables[table] = _Group(self.tokens_per_interval)
             g.pending.append((fut, self._guard(fn, deadline)))
             self._lock.notify()
         return self._track(fut)
 
     # ------------------------------------------------------------------
     def _refill_locked(self, now: float) -> None:
-        for g in self._groups.values():
-            intervals = (now - g.last_refill) / self.interval_s
+        for tg in self._tenants.values():
+            cap = self.tokens_per_interval * tg.weight
+            intervals = (now - tg.last_refill) / self.interval_s
             if intervals >= 1.0:
                 # decayed refill toward the per-interval budget
                 # (ref TokenSchedulerGroup incrementTokens)
-                g.tokens = min(self.tokens_per_interval,
-                               g.tokens + intervals * self.tokens_per_interval)
-                g.last_refill = now
+                tg.tokens = min(cap, tg.tokens + intervals * cap)
+                tg.last_refill = now
+            for g in tg.tables.values():
+                intervals = (now - g.last_refill) / self.interval_s
+                if intervals >= 1.0:
+                    g.tokens = min(
+                        self.tokens_per_interval,
+                        g.tokens + intervals * self.tokens_per_interval)
+                    g.last_refill = now
 
     def _pick_locked(self) -> Optional[tuple]:
-        best_key, best = None, None
-        for key, g in self._groups.items():
+        best_tenant = None
+        for tg in self._tenants.values():
+            if tg.pending_count == 0:
+                continue
+            if best_tenant is None or tg.tokens > best_tenant.tokens:
+                best_tenant = tg
+        if best_tenant is None:
+            return None
+        best = None
+        for g in best_tenant.tables.values():
             if not g.pending:
                 continue
             if best is None or g.tokens > best.tokens:
-                best_key, best = key, g
-        if best is None:
-            return None
+                best = g
         fut, fn = best.pending.popleft()
-        return best, fut, fn
+        return best_tenant, best, fut, fn
 
     def _worker(self) -> None:
         while True:
@@ -187,7 +254,7 @@ class TokenPriorityScheduler(QueryScheduler):
                     if picked is not None:
                         break
                     self._lock.wait(timeout=0.1)
-            group, fut, fn = picked
+            tenant_group, group, fut, fn = picked
             if not fut.set_running_or_notify_cancel():
                 continue
             t0 = time.monotonic()
@@ -200,6 +267,7 @@ class TokenPriorityScheduler(QueryScheduler):
                     * self.tokens_per_interval
                 with self._lock:
                     group.tokens -= spent
+                    tenant_group.tokens -= spent
                     self._lock.notify()
 
 
@@ -216,7 +284,8 @@ class BinaryWorkloadScheduler(QueryScheduler):
             thread_name_prefix="query-secondary")
 
     def submit(self, fn, table: str = "", workload: str = "primary",
-               deadline: Optional[float] = None) -> Future:
+               deadline: Optional[float] = None,
+               tenant: Optional[str] = None) -> Future:
         pool = self._primary if workload != "secondary" else self._secondary
         return self._track(pool.submit(self._guard(fn, deadline)))
 

@@ -225,6 +225,10 @@ def main(argv=None) -> int:
     ss.add_argument("--coordinator", required=True, help="host:port")
     ss.add_argument("--query-port", type=int, default=0)
     ss.add_argument("--tpu", action="store_true")
+    ss.add_argument("--tenant", default=None,
+                    help="tenant pool this server serves (registers the "
+                         "tenant:<name> instance tag; tables tagged with "
+                         "the same tenant assign only here)")
     ss.add_argument("--plugins-dir", default=None,
                     help="directory of plugin modules to load at startup")
     ss.add_argument("--config", default=None,
@@ -323,7 +327,8 @@ def cmd_start_server(args) -> int:
         print(f"loaded plugins: {loaded}", flush=True)
     cfg = PinotConfiguration(getattr(args, "config", None))
     run_server(args.instance_id, args.coordinator,
-               query_port=args.query_port, use_tpu=args.tpu, config=cfg)
+               query_port=args.query_port, use_tpu=args.tpu, config=cfg,
+               tenant=getattr(args, "tenant", None))
     return 0
 
 

@@ -129,6 +129,10 @@ KEYS: Dict[str, Any] = {
     "pinot.cache.remote.pool.size": 2,
     "pinot.cache.remote.breaker.failures": 3,
     "pinot.cache.remote.breaker.reset.seconds": 5.0,
+    # cache ring: `...remote.address` with >= 2 comma-separated addresses
+    # consistent-hashes the key space client-side (cache/ring.py);
+    # virtual-node count trades placement evenness for ring-build cost
+    "pinot.cache.remote.ring.vnodes": 64,
     "pinot.controller.port": 9000,
     "pinot.controller.deep.store.uri": "",
     "pinot.controller.retention.frequency.seconds": 60,

@@ -12,6 +12,12 @@ per-row loops).
 Sites currently compiled in:
 
   broker.scatter.before    — before the broker fans a plan entry out
+  broker.group.scatter     — before a scatter to a replica-group member
+                             (ctx: server, table, group index — arm with
+                             where={"group": 0} to kill one fault domain)
+  cache.ring.node          — every cache-ring key->node resolution (ctx:
+                             node, key — arm with where={"node": addr}
+                             to fail one node's key range)
   server.execute.before    — server-side, before a query executes
   server.execute.segment   — per segment in the execution loop
   server.dispatch.before   — kernel dispatch (ring + inline paths)

@@ -1,0 +1,225 @@
+"""Consistent-hash cache ring (cache/ring.py): key stability under node
+add/remove, per-node breaker isolation, and one-node-death degrading only
+its key range to L1-only with zero failed queries (ISSUE 8)."""
+import os
+
+import numpy as np
+import pytest
+
+from pinot_tpu.cache.remote import CIRCUIT_CLOSED, CIRCUIT_OPEN, CacheServer
+from pinot_tpu.cache.ring import ConsistentHashRing, RingRemoteCacheBackend
+from pinot_tpu.utils.failpoints import failpoints
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoints.clear()
+    yield
+    failpoints.clear()
+
+
+KEYS = [f"tbl:{i}:fp{i * 7}" for i in range(400)]
+
+
+class TestRing:
+    def test_deterministic_and_total(self):
+        ring = ConsistentHashRing(["a:1", "b:1", "c:1"])
+        m1 = {k: ring.node_for(k) for k in KEYS}
+        m2 = {k: ring.node_for(k) for k in KEYS}
+        assert m1 == m2
+        assert set(m1.values()) == {"a:1", "b:1", "c:1"}
+
+    def test_spread_is_roughly_even(self):
+        ring = ConsistentHashRing(["a:1", "b:1", "c:1"], vnodes=64)
+        counts = {}
+        for k in KEYS:
+            counts[ring.node_for(k)] = counts.get(ring.node_for(k), 0) + 1
+        # virtual nodes keep every node within a loose band of fair share
+        for node, n in counts.items():
+            assert 40 <= n <= 260, counts
+
+    def test_remove_node_moves_only_its_range(self):
+        """The no-rehash-storm property: removing one node re-maps ONLY
+        the keys it owned; every other key keeps its node (its warm
+        remote entries stay addressable)."""
+        ring = ConsistentHashRing(["a:1", "b:1", "c:1"])
+        before = {k: ring.node_for(k) for k in KEYS}
+        ring.remove_node("b:1")
+        after = {k: ring.node_for(k) for k in KEYS}
+        for k in KEYS:
+            if before[k] != "b:1":
+                assert after[k] == before[k], k
+            else:
+                assert after[k] in ("a:1", "c:1")
+
+    def test_add_node_steals_bounded_share(self):
+        ring = ConsistentHashRing(["a:1", "b:1"])
+        before = {k: ring.node_for(k) for k in KEYS}
+        ring.add_node("c:1")
+        moved = sum(1 for k in KEYS if ring.node_for(k) != before[k])
+        stolen = sum(1 for k in KEYS if ring.node_for(k) == "c:1")
+        assert moved == stolen  # only moves TO the new node
+        assert 0 < moved < len(KEYS) * 0.6, moved
+
+    def test_empty_ring(self):
+        assert ConsistentHashRing([]).node_for("x") is None
+
+
+@pytest.fixture()
+def two_servers():
+    servers = [CacheServer(ttl_seconds=60.0) for _ in range(2)]
+    for s in servers:
+        s.start()
+    yield servers
+    for s in servers:
+        s.stop()
+
+
+def _ring_client(servers, **kwargs):
+    return RingRemoteCacheBackend([s.address for s in servers],
+                                  timeout_seconds=0.5,
+                                  failure_threshold=1,
+                                  reset_seconds=60.0, **kwargs)
+
+
+class TestRingBackend:
+    def test_round_trip_spreads_over_nodes(self, two_servers):
+        client = _ring_client(two_servers)
+        try:
+            for i in range(60):
+                assert client.put(f"k{i}", f"v{i}".encode())
+            for i in range(60):
+                assert client.get(f"k{i}") == f"v{i}".encode()
+            sizes = [len(s.cache) for s in two_servers]
+            assert all(n > 0 for n in sizes), sizes
+            assert sum(sizes) == 60
+        finally:
+            client.close()
+
+    def test_dead_node_degrades_only_its_range(self, two_servers):
+        """Kill one cache server: its key range misses (L1-only
+        semantics for the mount) while the other node's range keeps
+        serving — and nothing raises into the caller."""
+        client = _ring_client(two_servers)
+        try:
+            for i in range(60):
+                assert client.put(f"k{i}", f"v{i}".encode())
+            dead = two_servers[0]
+            dead_addr = dead.address
+            dead.stop()
+            served = missed = 0
+            for i in range(60):
+                key = f"k{i}"
+                got = client.get(key)  # must never raise
+                if client.ring.node_for(key) == dead_addr:
+                    assert got is None
+                    missed += 1
+                else:
+                    assert got == f"v{i}".encode()
+                    served += 1
+            assert served > 0 and missed > 0
+            # per-node breakers: the dead node's circuit opened, the
+            # survivor's stayed closed
+            assert client.breaker_of(dead_addr).state == CIRCUIT_OPEN
+            live_addr = two_servers[1].address
+            assert client.breaker_of(live_addr).state == CIRCUIT_CLOSED
+        finally:
+            client.close()
+
+    def test_ring_failpoint_fails_one_node_only(self, two_servers):
+        client = _ring_client(two_servers)
+        target = two_servers[0].address
+        try:
+            for i in range(40):
+                client.put(f"k{i}", b"x")
+            with failpoints.armed("cache.ring.node", drop=True,
+                                  where={"node": target}):
+                for i in range(40):
+                    got = client.get(f"k{i}")
+                    if client.ring.node_for(f"k{i}") == target:
+                        assert got is None
+                    else:
+                        assert got == b"x"
+        finally:
+            client.close()
+
+    def test_membership_resize(self, two_servers):
+        extra = CacheServer(ttl_seconds=60.0)
+        extra.start()
+        client = _ring_client(two_servers)
+        try:
+            for i in range(40):
+                client.put(f"k{i}", b"y")
+            before = {f"k{i}": client.ring.node_for(f"k{i}")
+                      for i in range(40)}
+            client.add_node(extra.address)
+            surviving = [k for k, n in before.items()
+                         if client.ring.node_for(k) == n]
+            # unmoved ranges still hit their warm node
+            for k in surviving:
+                assert client.get(k) == b"y"
+            # the new node actually serves its stolen range
+            moved = [k for k in before if k not in surviving]
+            for k in moved:
+                client.put(k, b"z")
+                assert client.get(k) == b"z"
+            client.remove_node(extra.address)
+            assert extra.address not in client.ring.nodes
+        finally:
+            client.close()
+            extra.stop()
+
+
+class TestClusterRingFabric:
+    def test_cluster_ring_node_kill_zero_failed_queries(self, tmp_path):
+        """MiniCluster with a 2-node cache ring: queries warm BOTH
+        nodes' ranges; killing one node leaves every query answering
+        (the dead range recomputes / serves L1) with zero exceptions."""
+        from pinot_tpu.cache.ring import RingRemoteCacheBackend as Ring
+        from pinot_tpu.cluster.mini import MiniCluster
+        from pinot_tpu.models.schema import Schema
+        from pinot_tpu.models.table_config import TableConfig
+        from pinot_tpu.segment.creator import SegmentCreator
+        from pinot_tpu.segment.loader import load_segment
+
+        schema = Schema.from_dict({
+            "schemaName": "cr",
+            "dimensionFieldSpecs": [{"name": "k", "dataType": "LONG"}],
+            "metricFieldSpecs": [{"name": "v", "dataType": "LONG"}]})
+        creator = SegmentCreator(TableConfig.from_dict(
+            {"tableName": "cr", "tableType": "OFFLINE"}), schema)
+        cluster = MiniCluster(num_servers=2, result_cache=True,
+                              cache_servers=2)
+        cluster.start()
+        try:
+            assert len(cluster.cache_servers) == 2
+            # the broker's L2 mount is a ring over both nodes
+            l2 = cluster.broker.result_cache._cache.l2
+            assert isinstance(l2, Ring)
+            cluster.add_table("cr")
+            for i in range(3):
+                rng = np.random.default_rng(i)
+                d = os.path.join(str(tmp_path), f"cr_{i}")
+                creator.build(
+                    {"k": rng.integers(0, 9, 200).astype(np.int64),
+                     "v": rng.integers(0, 50, 200).astype(np.int64)},
+                    d, f"cr_{i}")
+                cluster.add_segment("cr", load_segment(d),
+                                    server_idx=i % 2)
+            queries = [f"SELECT COUNT(*), SUM(v) FROM cr WHERE k < {i}"
+                       for i in range(2, 9)]
+            truth = {}
+            for q in queries:
+                resp = cluster.query(q)
+                assert not resp.exceptions
+                truth[q] = resp.rows
+            # entries landed on both ring nodes
+            sizes = [len(cs.cache) for cs in cluster.cache_servers]
+            assert all(n > 0 for n in sizes), sizes
+            cluster.cache_servers[0].stop()
+            for q in queries:
+                resp = cluster.query(q)  # zero failed queries
+                assert not resp.exceptions, resp.exceptions
+                assert resp.rows == truth[q]
+        finally:
+            cluster.stop()

@@ -1,0 +1,594 @@
+"""Replica-group fault domains: scatter-to-one-group routing, whole-group
+kill survival, tenant isolation, split hedges, and the seeded group-kill
+chaos journal (ISSUE 8).
+
+The MiniCluster topology throughout: 4 servers, 2 replica groups —
+group 0 = servers 0/1, group 1 = servers 2/3 — with every segment's
+replica list in GROUP ORDER ([g0 member, g1 member]), which is the
+assignment contract the broker's ReplicaGroupInstanceSelector addresses
+groups through.
+"""
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pinot_tpu.broker.routing import (
+    ReplicaGroupInstanceSelector, RoutingTable, SegmentInfo, TableRoute,
+    _derive_groups)
+from pinot_tpu.cluster.mini import MiniCluster
+from pinot_tpu.controller.assignment import (
+    ReplicaGroupConfigError, assign_replica_groups, target_assignment)
+from pinot_tpu.controller.cluster_state import ClusterState, InstanceState
+from pinot_tpu.models.schema import Schema
+from pinot_tpu.models.table_config import TableConfig
+from pinot_tpu.query.context import QueryContext
+from pinot_tpu.segment.creator import SegmentCreator
+from pinot_tpu.segment.loader import load_segment
+from pinot_tpu.utils.failpoints import FaultSchedule, failpoints
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoints.clear()
+    yield
+    failpoints.clear()
+
+
+def _state(n, tenants=None):
+    st = ClusterState()
+    for i in range(n):
+        tags = []
+        if tenants and tenants[i]:
+            tags = [f"tenant:{tenants[i]}"]
+        st.register_instance(InstanceState(f"server_{i}", tags=tags))
+    return st
+
+
+# ---------------------------------------------------------------------------
+# controller: typed config errors + tenant-aware pools
+# ---------------------------------------------------------------------------
+
+class TestAssignmentConfig:
+    def test_non_multiple_pool_raises_typed_error(self):
+        # 5 instances / 2 groups used to SILENTLY drop server_4 from
+        # every group — now it is a typed config error
+        st = _state(5)
+        with pytest.raises(ReplicaGroupConfigError, match="do not tile"):
+            assign_replica_groups(st, "t_OFFLINE", "s0",
+                                  num_replica_groups=2)
+        with pytest.raises(ReplicaGroupConfigError):
+            target_assignment(st, "t_OFFLINE", num_replica_groups=2)
+
+    def test_too_few_instances_raises(self):
+        st = _state(1)
+        with pytest.raises(ReplicaGroupConfigError):
+            assign_replica_groups(st, "t_OFFLINE", "s0",
+                                  num_replica_groups=2)
+
+    def test_tenant_pool_restricts_placement(self):
+        st = _state(6, tenants=["a", "a", "a", "a", "b", "b"])
+        out = assign_replica_groups(st, "t_OFFLINE", "s0", 2, tenant="a")
+        assert all(s in ("server_0", "server_1", "server_2", "server_3")
+                   for s in out)
+        out_b = assign_replica_groups(st, "t_OFFLINE", "s0", 2, tenant="b")
+        assert set(out_b) == {"server_4", "server_5"}
+
+    def test_group_order_is_stable(self):
+        st = _state(4)
+        from pinot_tpu.controller.cluster_state import SegmentState
+        for i in range(6):
+            inst = assign_replica_groups(st, "t_OFFLINE", f"s{i}", 2,
+                                         partition_id=i)
+            st.upsert_segment(SegmentState(f"s{i}", "t_OFFLINE",
+                                           instances=inst))
+        for seg in st.table_segments("t_OFFLINE"):
+            assert seg.instances[0] in ("server_0", "server_1")
+            assert seg.instances[1] in ("server_2", "server_3")
+
+
+# ---------------------------------------------------------------------------
+# broker: group selection unit behavior
+# ---------------------------------------------------------------------------
+
+def _grouped_route(n_segs=4):
+    route = TableRoute("t_OFFLINE", num_replica_groups=2)
+    for i in range(n_segs):
+        route.segments[f"s{i}"] = SegmentInfo(
+            f"s{i}", servers=[f"server_{i % 2}", f"server_{2 + i % 2}"])
+    return route
+
+
+class TestGroupSelector:
+    def test_whole_query_lands_on_one_group(self):
+        route = _grouped_route()
+        rt = RoutingTable(offline=route,
+                          group_selector=ReplicaGroupInstanceSelector())
+        ctx = QueryContext.from_sql("SELECT COUNT(*) FROM t")
+        plan = rt.route(ctx)
+        servers = {e[0] for e in plan}
+        assert servers <= {"server_0", "server_1"} \
+            or servers <= {"server_2", "server_3"}, servers
+        # every segment covered exactly once
+        names = [n for e in plan for n in e[2]]
+        assert sorted(names) == ["s0", "s1", "s2", "s3"]
+
+    def test_sticky_per_fingerprint(self):
+        sel = ReplicaGroupInstanceSelector()
+        groups = [["a", "b"], ["c", "d"]]
+        first = sel.pick_group("t", groups, set(), fingerprint="fp1")
+        for _ in range(8):
+            assert sel.pick_group("t", groups, set(),
+                                  fingerprint="fp1") == first
+
+    def test_unhealthy_member_demotes_whole_group(self):
+        sel = ReplicaGroupInstanceSelector()
+        groups = [["a", "b"], ["c", "d"]]
+        g = sel.pick_group("t", groups, set(), fingerprint="fp")
+        dead = groups[g][0]
+        g2 = sel.pick_group("t", groups, {dead}, fingerprint="fp")
+        assert g2 is not None and g2 != g  # stickiness demoted too
+
+    def test_all_groups_degraded_returns_none(self):
+        sel = ReplicaGroupInstanceSelector()
+        assert sel.pick_group("t", [["a"], ["b"]], {"a", "b"}) is None
+
+    def test_residency_breaks_ties(self):
+        sel = ReplicaGroupInstanceSelector()
+        sel.update_residency("c", {"t_OFFLINE": 1 << 20})
+        groups = [["a", "b"], ["c", "d"]]
+        for fp in ("x", "y", "z"):
+            assert sel.pick_group("t_OFFLINE", groups, set(),
+                                  fingerprint=fp) == 1
+
+    def test_derive_groups_from_server_order(self):
+        route = _grouped_route()
+        groups = _derive_groups(list(route.segments.values()), 2)
+        assert groups == [["server_0", "server_1"],
+                          ["server_2", "server_3"]]
+
+    def test_group_peers_and_index(self):
+        route = _grouped_route()
+        rt = RoutingTable(offline=route)
+        assert rt.group_peers("t_OFFLINE", "server_0") == \
+            {"server_0", "server_1"}
+        assert rt.group_peers("t_OFFLINE", "server_3") == \
+            {"server_2", "server_3"}
+        assert rt.group_index_of("t_OFFLINE", "server_1") == 0
+        assert rt.group_index_of("t_OFFLINE", "server_2") == 1
+        # ungrouped tables: no fault-domain coupling
+        plain = TableRoute("t_OFFLINE")
+        plain.segments["s"] = SegmentInfo("s", servers=["a", "b"])
+        assert RoutingTable(offline=plain).group_peers("t_OFFLINE",
+                                                       "a") == set()
+
+
+class TestPartitionPruning:
+    def _route(self):
+        route = TableRoute("t_OFFLINE")
+        for part in range(4):
+            route.segments[f"p{part}"] = SegmentInfo(
+                f"p{part}", servers=["server_0"], partition_id=part,
+                partition_column="k", num_partitions=4)
+        return RoutingTable(offline=route)
+
+    def test_eq_literal_prunes_to_one_partition(self):
+        rt = self._route()
+        ctx = QueryContext.from_sql("SELECT COUNT(*) FROM t WHERE k = 6")
+        plan = rt.route(ctx)
+        names = [n for e in plan for n in e[2]]
+        assert names == ["p2"]  # 6 % 4
+
+    def test_in_literals_prune_to_member_partitions(self):
+        rt = self._route()
+        ctx = QueryContext.from_sql(
+            "SELECT COUNT(*) FROM t WHERE k IN (1, 5, 2)")
+        plan = rt.route(ctx)
+        names = sorted(n for e in plan for n in e[2])
+        assert names == ["p1", "p2"]  # 1%4, 5%4 -> p1; 2%4 -> p2
+
+    def test_non_literal_in_keeps_everything(self):
+        rt = self._route()
+        ctx = QueryContext.from_sql(
+            "SELECT COUNT(*) FROM t WHERE k IN (1, 2) OR k = 3")
+        plan = rt.route(ctx)  # OR-reachable only — not provable
+        names = sorted(n for e in plan for n in e[2])
+        assert names == ["p0", "p1", "p2", "p3"]
+
+
+# ---------------------------------------------------------------------------
+# cluster: whole-group kill under load, zero failed queries
+# ---------------------------------------------------------------------------
+
+def _build_cluster(tmp, num_segments=4, docs=400, **table_kwargs):
+    schema = Schema.from_dict({
+        "schemaName": "rg",
+        "dimensionFieldSpecs": [{"name": "k", "dataType": "LONG"}],
+        "metricFieldSpecs": [{"name": "v", "dataType": "LONG"}]})
+    creator = SegmentCreator(TableConfig.from_dict(
+        {"tableName": "rg", "tableType": "OFFLINE"}), schema)
+    cluster = MiniCluster(num_servers=4)
+    cluster.start()
+    cluster.add_table("rg", num_replica_groups=2, **table_kwargs)
+    for i in range(num_segments):
+        rng = np.random.default_rng(i)
+        d = os.path.join(str(tmp), f"rg_{i}")
+        creator.build({"k": rng.integers(0, 16, docs).astype(np.int64),
+                       "v": rng.integers(0, 100, docs).astype(np.int64)},
+                      d, f"rg_{i}")
+        cluster.add_segment("rg", load_segment(d), server_idx=i % 2,
+                            replicas=[2 + i % 2])
+    return cluster
+
+
+class TestGroupKillSurvival:
+    def test_whole_group_kill_zero_failed_queries(self, tmp_path):
+        cluster = _build_cluster(tmp_path)
+        try:
+            truth = cluster.query("SELECT COUNT(*), SUM(v) FROM rg")
+            assert not truth.exceptions
+            cluster.kill_replica_group("rg", 0)
+            # the FIRST post-kill query pays the failover (mid-scatter
+            # connection failure -> whole-group demotion -> re-scatter
+            # of the unanswered segments onto group 1) and still answers
+            # cleanly and completely
+            for i in range(6):
+                resp = cluster.query("SELECT COUNT(*), SUM(v) FROM rg")
+                assert not resp.exceptions, resp.exceptions
+                assert resp.rows == truth.rows
+        finally:
+            cluster.stop()
+
+    def test_kill_under_concurrent_load(self, tmp_path):
+        cluster = _build_cluster(tmp_path)
+        failures, lock = [], threading.Lock()
+        stop_at = time.perf_counter() + 1.5
+
+        def client(cid):
+            i = cid
+            while time.perf_counter() < stop_at:
+                resp = cluster.query(
+                    f"SELECT COUNT(*) FROM rg WHERE v >= {i % 5}")
+                if resp.exceptions:
+                    with lock:
+                        failures.append(resp.exceptions)
+                i += 4
+        try:
+            threads = [threading.Thread(target=client, args=(c,))
+                       for c in range(4)]
+            for t in threads:
+                t.start()
+            time.sleep(0.3)
+            cluster.kill_replica_group("rg", 0)
+            for t in threads:
+                t.join()
+            assert not failures, failures[:3]
+        finally:
+            cluster.stop()
+
+    def test_seeded_group_chaos_replays_identically(self, tmp_path):
+        """The same-seed replay contract for the group-kill journal:
+        outcomes AND per-site failpoint decisions match exactly."""
+        def run(seed):
+            sched = FaultSchedule([
+                ("broker.group.scatter",
+                 {"error": ConnectionError("chaos: group 0 down"),
+                  "probability": 0.5, "seed": seed,
+                  "where": {"group": 0}})])
+            cluster = _build_cluster(tmp_path / f"run{run.n}")
+            run.n += 1
+            for b in cluster.brokers:
+                # pin demotion: replay must not depend on when a
+                # wall-clock backoff expires
+                b.failure_detector.base_backoff_s = 3600.0
+                b.failure_detector.max_backoff_s = 3600.0
+            sched.arm()
+            try:
+                outcomes = []
+                for i in range(10):
+                    resp = cluster.query(
+                        f"SELECT COUNT(*), SUM(v) FROM rg "
+                        f"WHERE v >= {i % 3}")
+                    outcomes.append((len(resp.exceptions), resp.rows))
+                return outcomes, sched.decisions()
+            finally:
+                sched.disarm()
+                cluster.stop()
+        run.n = 0
+        a = run(7)
+        b = run(7)
+        assert a == b
+        assert all(exc == 0 for exc, _rows in a[0]), a[0]
+        # the chaos actually fired at least once (not a vacuous pass)
+        assert any(fired for site in a[1] for fired, _d in site)
+
+
+# ---------------------------------------------------------------------------
+# tenant isolation: quotas + weighted-fair scheduling
+# ---------------------------------------------------------------------------
+
+class TestTenantIsolation:
+    def test_tenant_quota_rejects_named_tenant(self, tmp_path):
+        cluster = _build_cluster(tmp_path, num_segments=2, docs=50,
+                                 tenant="acme")
+        try:
+            from pinot_tpu.broker.quota import QueryQuotaManager
+            qm = QueryQuotaManager()
+            qm.set_table_tenant("rg", "acme")
+            qm.set_tenant_quota("acme", 2.0)
+            cluster.broker.quota_manager = qm
+            cluster.broker.tenants["rg"] = "acme"
+            seen = []
+            for _ in range(6):
+                resp = cluster.query("SELECT COUNT(*) FROM rg")
+                seen.append(resp.exceptions)
+            rejected = [e for e in seen if e]
+            assert rejected, "tenant quota never enforced"
+            assert "tenant acme" in rejected[0][0]["message"]
+        finally:
+            cluster.stop()
+
+    def test_quota_acquire_is_all_or_nothing(self):
+        from pinot_tpu.broker.quota import QueryQuotaManager
+        qm = QueryQuotaManager()
+        qm.set_quota("t1", 1000.0)
+        qm.set_table_tenant("t1", "a")
+        qm.set_tenant_quota("a", 1.0)  # tenant cap is the tight one
+        assert qm.check("t1") is None
+        reason = qm.check("t1")
+        assert reason is not None and "tenant a" in reason
+        # the rejected attempts must NOT have drained t1's table bucket
+        b = qm._buckets["t1"]
+        assert b.tokens >= b.cap - 1.5
+
+    def test_multi_table_check_charges_tenant_once(self):
+        """An MSE query reading N tables of one tenant is ONE query
+        against the tenant ceiling, and a rejection (any table over
+        budget) drains no scope at all."""
+        from pinot_tpu.broker.quota import QueryQuotaManager
+        qm = QueryQuotaManager()
+        for t in ("a", "b"):
+            qm.set_table_tenant(t, "acme")
+        qm.set_tenant_quota("acme", 4.0)
+        qm.set_quota("b", 1000.0)
+        # 2-table query: one tenant token, not two
+        assert qm.check_many(["a", "b"]) is None
+        assert qm._tenant_buckets["acme"].tokens >= 3.0
+        # make b reject; neither a's tenant tokens nor b's table tokens
+        # may drain on the refused attempts
+        qm.set_quota("b", 0.001)
+        qm._buckets["b"].tokens = 0.0
+        tenant_before = qm._tenant_buckets["acme"].tokens
+        for _ in range(3):
+            reason = qm.check_many(["a", "b"])
+            assert reason is not None and "table b" in reason
+        assert qm._tenant_buckets["acme"].tokens >= tenant_before
+
+    def test_tenant_starvation_bounded(self):
+        """Tenant A floods one worker through its own table; tenant B's
+        queries keep a bounded wait (weighted-fair: B's bucket stays
+        full while A's drains)."""
+        from pinot_tpu.server.scheduler import TokenPriorityScheduler
+        s = TokenPriorityScheduler(num_threads=1,
+                                   tokens_per_interval=10.0,
+                                   interval_s=0.1)
+        s.set_tenant_weight("A", 1.0)
+        s.set_tenant_weight("B", 1.0)
+        s.start()
+        try:
+            done = []
+
+            def slow(tag):
+                def run():
+                    time.sleep(0.02)
+                    done.append(tag)
+                    return b""
+                return run
+
+            futs = [s.submit(slow(("A", i)), table="ta", tenant="A")
+                    for i in range(25)]
+            time.sleep(0.06)  # A starts burning its bucket
+            futs += [s.submit(slow(("B", i)), table="tb", tenant="B")
+                     for i in range(3)]
+            for f in futs:
+                f.result(20)
+            b_last = max(i for i, t in enumerate(done) if t[0] == "B")
+            a_last = max(i for i, t in enumerate(done) if t[0] == "A")
+            assert b_last < a_last, done
+            assert b_last < len(done) - 8, done
+        finally:
+            s.stop()
+
+    def test_tenant_weight_shapes_service(self):
+        """Two flooding tenants, weight 4 vs 1: the heavy-weight tenant
+        gets served distinctly more often early on."""
+        from pinot_tpu.server.scheduler import TokenPriorityScheduler
+        s = TokenPriorityScheduler(num_threads=1,
+                                   tokens_per_interval=10.0,
+                                   interval_s=0.1)
+        s.set_tenant_weight("big", 4.0)
+        s.set_tenant_weight("small", 1.0)
+        s.start()
+        try:
+            done = []
+
+            def job(tag):
+                def run():
+                    time.sleep(0.01)
+                    done.append(tag)
+                    return b""
+                return run
+
+            futs = []
+            for i in range(20):
+                futs.append(s.submit(job("big"), table="tb", tenant="big"))
+                futs.append(s.submit(job("small"), table="ts",
+                                     tenant="small"))
+            for f in futs:
+                f.result(20)
+            first_half = done[:20]
+            big = sum(1 for t in first_half if t == "big")
+            assert big > 10, f"weight ignored: {big}/20 early slots"
+        finally:
+            s.stop()
+
+    def test_tenant_rides_the_wire(self, tmp_path):
+        """The broker ships the table's tenant tag; the server scheduler
+        sees it (observed via a recording scheduler shim)."""
+        cluster = _build_cluster(tmp_path, num_segments=2, docs=50,
+                                 tenant="acme")
+        try:
+            seen = []
+            for srv in cluster.servers:
+                sched = srv.transport.scheduler
+                orig = sched.submit
+
+                def spy(fn, table="", workload="primary", deadline=None,
+                        tenant=None, _orig=orig):
+                    seen.append(tenant)
+                    return _orig(fn, table=table, workload=workload,
+                                 deadline=deadline, tenant=tenant)
+                sched.submit = spy
+            resp = cluster.query("SELECT COUNT(*) FROM rg")
+            assert not resp.exceptions
+            assert "acme" in seen, seen
+        finally:
+            cluster.stop()
+
+
+# ---------------------------------------------------------------------------
+# split hedges (partially-replicated layouts)
+# ---------------------------------------------------------------------------
+
+class TestSplitHedges:
+    def _partial_cluster(self, tmp_path):
+        """3 servers; segments alternate replica pairs (0,1) / (0,2), so
+        after excluding server_0 NO single server holds everything —
+        the shape that forces a SPLIT hedge."""
+        schema = Schema.from_dict({
+            "schemaName": "ph",
+            "dimensionFieldSpecs": [{"name": "k", "dataType": "LONG"}],
+            "metricFieldSpecs": [{"name": "v", "dataType": "LONG"}]})
+        creator = SegmentCreator(TableConfig.from_dict(
+            {"tableName": "ph", "tableType": "OFFLINE"}), schema)
+        from pinot_tpu.utils.config import PinotConfiguration
+        cfg = PinotConfiguration(overrides={
+            "pinot.broker.hedge.enabled": True,
+            "pinot.broker.hedge.delay.min.ms": 40,
+            "pinot.broker.hedge.delay.max.ms": 60,
+        })
+        cluster = MiniCluster(num_servers=3, config=cfg)
+        cluster.start()
+        cluster.add_table("ph")
+        for i in range(4):
+            rng = np.random.default_rng(i)
+            d = os.path.join(str(tmp_path), f"ph_{i}")
+            creator.build(
+                {"k": rng.integers(0, 8, 200).astype(np.int64),
+                 "v": rng.integers(0, 50, 200).astype(np.int64)},
+                d, f"ph_{i}")
+            # ALL primaries on server_0; replicas split across 1 and 2
+            cluster.add_segment("ph", load_segment(d), server_idx=0,
+                                replicas=[1 + i % 2])
+        return cluster
+
+    def test_split_hedge_covers_set_exactly_once(self, tmp_path):
+        """server_0 (the only full-copy holder) is made slow; the hedge
+        must SPLIT across servers 1 and 2 and the merged answer must
+        equal the unhedged truth — per-segment dedup, no double count."""
+        cluster = self._partial_cluster(tmp_path)
+        try:
+            truth = cluster.query("SELECT COUNT(*), SUM(v) FROM ph")
+            assert not truth.exceptions
+            # pin the balanced round-robin so the chaos query's whole
+            # scatter lands on server_0 (the full-copy holder)
+            cluster.routing.get_route("ph")._rr = 0
+            hedged = [0]
+            orig = cluster.broker._metrics.add_meter
+
+            def meter_spy(name, value=1, labels=None):
+                if name == "hedge_split":
+                    hedged[0] += 1
+                return orig(name, value, labels=labels)
+            cluster.broker._metrics.add_meter = meter_spy
+            with failpoints.armed("server.execute.before", delay=0.35,
+                                  where={"instance": "server_0"}):
+                resp = cluster.query("SELECT COUNT(*), SUM(v) FROM ph")
+            assert not resp.exceptions, resp.exceptions
+            assert resp.rows == truth.rows
+            assert hedged[0] >= 1, "hedge never split"
+        finally:
+            cluster.stop()
+
+    def test_overlapping_primary_discarded_after_child_win(self, tmp_path):
+        """The per-segment dedup core: a fast hedge child answers its
+        subset FIRST, then the slow primary's full-set answer arrives —
+        it overlaps the answered segments and cannot be split, so it
+        must be discarded whole; the slow second child completes the
+        set. The merged answer equals the truth exactly (any double
+        count would inflate COUNT/SUM)."""
+        cluster = self._partial_cluster(tmp_path)
+        try:
+            truth = cluster.query("SELECT COUNT(*), SUM(v) FROM ph")
+            cluster.routing.get_route("ph")._rr = 0  # primary = server_0
+            # primary mid-speed, child s1 fast, child s2 slowest:
+            # arrival order = child1 (merge), primary (overlap discard),
+            # child2 (complete)
+            with failpoints.armed("server.execute.before", delay=0.15,
+                                  where={"instance": "server_0"}):
+                with failpoints.armed("server.execute.before", delay=0.35,
+                                      where={"instance": "server_2"}):
+                    resp = cluster.query(
+                        "SELECT COUNT(*), SUM(v) FROM ph")
+            assert not resp.exceptions, resp.exceptions
+            assert resp.rows == truth.rows
+        finally:
+            cluster.stop()
+
+    def test_primary_death_after_split_retries_unanswered_only(
+            self, tmp_path):
+        """Primary dies mid-query: the retry path re-scatters only the
+        segments no hedge child answered, and the result is complete."""
+        cluster = self._partial_cluster(tmp_path)
+        try:
+            truth = cluster.query("SELECT COUNT(*), SUM(v) FROM ph")
+            cluster.routing.get_route("ph")._rr = 0  # primary = server_0
+            # broker-side transport death (the site a SIGKILLed server
+            # hits): server.execute.before would be caught server-side
+            # and come back as a typed error payload instead
+            with failpoints.armed(
+                    "broker.scatter.before",
+                    error=ConnectionError("chaos: primary died"),
+                    where={"server": "server_0"}):
+                resp = cluster.query("SELECT COUNT(*), SUM(v) FROM ph")
+            assert not resp.exceptions, resp.exceptions
+            assert resp.rows == truth.rows
+        finally:
+            cluster.stop()
+
+
+# ---------------------------------------------------------------------------
+# tier-1 smoke of the acceptance driver
+# ---------------------------------------------------------------------------
+
+class TestGroupsBenchSmoke:
+    def test_groups_bench_smoke(self, tmp_path):
+        """The --groups acceptance scenario at smoke scale: 2 groups,
+        8-client closed loop, whole-group kill, zero failed queries,
+        same-seed chaos journal replay — wired into tier-1. Writes its
+        report to a temp path so the committed full-run
+        BENCH_groups.json artifact is never clobbered by CI."""
+        import importlib
+        import json
+        import sys
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        bench = importlib.import_module("bench")
+        out = str(tmp_path / "BENCH_groups_smoke.json")
+        bench.groups_main(smoke=True, out_path=out)
+        with open(out) as f:
+            report = json.load(f)
+        assert report["value"] == 0
+        assert report["chaos_replay_identical"] is True
