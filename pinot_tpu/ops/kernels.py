@@ -14,8 +14,10 @@ the engine pads inputs to bucketed sizes to bound recompiles
 from __future__ import annotations
 
 import functools
+import hashlib
 import threading
-from typing import Any, Dict, Optional, Tuple
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -33,20 +35,59 @@ _ONEHOT_CHUNK = 4096
 # dispatch ring meters the delta as `kernel_retrace` — steady-state traffic
 # over warmed shape buckets must keep this flat (a growing count means a
 # shape/bucket leak re-compiling the hot path).
+#
+# Each trace also lands in a bounded log (`trace_log()`) carrying the
+# kernel kind, the plan fingerprint, and the shape bucket — so a retrace
+# storm is attributable from metrics alone: the per-plan-fingerprint
+# `kernel_retrace{plan=...}` label says WHICH plan is churning, and the
+# log says WHICH shape buckets it churned through.
 # ---------------------------------------------------------------------------
 _trace_lock = threading.Lock()
 _trace_count = 0
+_TRACE_LOG_MAX = 256
+_trace_log: "deque" = deque(maxlen=_TRACE_LOG_MAX)
+_trace_by_plan: Dict[str, int] = {}
 
 
-def note_trace() -> None:
+def note_trace(kind: str = "kernel", plan_fp: str = "",
+               bucket: tuple = ()) -> None:
     global _trace_count
     with _trace_lock:
         _trace_count += 1
+        if plan_fp:
+            _trace_by_plan[plan_fp] = _trace_by_plan.get(plan_fp, 0) + 1
+        _trace_log.append({"seq": _trace_count, "kind": kind,
+                           "plan": plan_fp, "bucket": tuple(bucket)})
 
 
 def trace_count() -> int:
     with _trace_lock:
         return _trace_count
+
+
+def trace_count_by_plan() -> Dict[str, int]:
+    """Compile count per plan fingerprint (snapshot)."""
+    with _trace_lock:
+        return dict(_trace_by_plan)
+
+
+def trace_log(n: Optional[int] = None) -> List[dict]:
+    """The last `n` (default: all retained) compiles, oldest first:
+    {seq, kind, plan, bucket} — kind names the kernel variant
+    ('agg'/'topn'/'sharded'/'batched'/'batched_stacked'/...), plan is
+    plan_fingerprint(), bucket is the traced shape key (B, S, D, G as
+    applicable). Feeds retrace-storm forensics without a debugger."""
+    with _trace_lock:
+        entries = list(_trace_log)
+    return entries[-n:] if n is not None else entries
+
+
+@functools.lru_cache(maxsize=4096)
+def plan_fingerprint(plan: DevicePlan) -> str:
+    """Short stable id of a plan STRUCTURE (not its literals): the label
+    kernels compile under, and the `plan` label on the kernel_retrace
+    meter. repr() of the frozen dataclass is deterministic and total."""
+    return hashlib.sha1(repr(plan).encode()).hexdigest()[:12]
 
 
 def _value_dtype() -> jnp.dtype:
@@ -67,7 +108,7 @@ def compiled_row_assembler(S: int, D: int, row_lens: Tuple[int, ...],
     dtype = jnp.dtype(dtype_str)
 
     def assemble(rows):
-        note_trace()
+        note_trace("assembler", bucket=(S, D))
         if len(rows) == S and all(ln == D for ln in row_lens):
             return jnp.stack(rows)
         out = jnp.zeros((S, D), dtype=dtype)
@@ -475,7 +516,7 @@ def _compute_slots(plan: DevicePlan, cols, params, valid, G: int = 0):
     return slots, matched
 
 
-def make_kernel(plan: DevicePlan):
+def make_kernel(plan: DevicePlan, kind: str = "agg", extra: tuple = ()):
     """Build the traced kernel fn(cols, params, num_docs, D) -> packed array.
 
     cols:    dict of 'ids:<col>' int32 [S, D] / 'val:<col>' float [S, D]
@@ -489,10 +530,15 @@ def make_kernel(plan: DevicePlan):
                                       slot host-side)
     Counts ride in the value dtype; exact while D < 2^24 (engine caps
     doc padding below that).
+
+    kind/extra label this build's trace-log entries (the batched
+    factories pass their own kind and batch bucket through).
     """
+    fp = plan_fingerprint(plan)
 
     def kernel(cols, params, num_docs, D, G=0):
-        note_trace()  # body runs at trace time: counts compiles
+        # body runs at trace time: counts compiles
+        note_trace(kind, fp, (*extra, int(num_docs.shape[-1]), D, G))
         valid = jnp.arange(D, dtype=jnp.int32)[None, :] < num_docs[:, None]
         slots, matched = _compute_slots(plan, cols, params, valid, G)
         if plan.num_groups or G:
@@ -521,9 +567,11 @@ def make_topn_kernel(plan: DevicePlan):
     indices (-1 = no more matches). The host projects ONLY the winning
     docs — a large filtered SELECT never materializes losing rows.
     """
+    fp = plan_fingerprint(plan)
 
     def kernel(cols, params, num_docs, D):
-        note_trace()  # body runs at trace time: counts compiles
+        # body runs at trace time: counts compiles
+        note_trace("topn", fp, (int(num_docs.shape[-1]), D))
         valid = jnp.arange(D, dtype=jnp.int32)[None, :] < num_docs[:, None]
         if plan.filter_ir is not None:
             mask = _eval_filter(plan.filter_ir, plan, cols, params) & valid
@@ -588,6 +636,46 @@ def _doc_combine(op: str) -> str:
     return _DOC_COMBINE[op.split(":")[0]]
 
 
+def _shard_one(plan: DevicePlan, doc_pos, G: int):
+    """Per-shard compute for ONE query: the local [S_loc, D_loc] slot
+    partials BEFORE any mesh collective. Shared by the single-query and
+    the batched (vmap-inside-shard_map) sharded kernels so the slot
+    semantics live in exactly one place. Returns the slot arrays in
+    plan.agg_ops order, with the matched count appended for non-grouped
+    plans (a pytree vmap can carry)."""
+    def one(cols, params, num_docs):
+        valid = doc_pos < num_docs[:, None]
+        slots, matched = _compute_slots(plan, cols, params, valid, G)
+        arrs = tuple(s for _, s in slots)
+        return arrs if (plan.num_groups or G) else arrs + (matched,)
+    return one
+
+
+def _shard_combine_pack(plan: DevicePlan, outs, G: int):
+    """psum/pmin/pmax each slot over the mesh `docs` axis, then pack
+    into the kernel's output layout. Rank-agnostic: reductions and the
+    pack only touch the trailing axes, so the batched kernels' leading
+    query axis rides along untouched ([S, ...] and [B, S, ...] both
+    work) — reductions commute with the batch stack."""
+    combined = []
+    for (op, _v, _f), s in zip(plan.agg_ops, outs):
+        kind = _doc_combine(op)
+        if kind == "psum":
+            s = jax.lax.psum(s, "docs")
+        elif kind == "pmin":
+            s = jax.lax.pmin(s, "docs")
+        else:
+            s = jax.lax.pmax(s, "docs")
+        combined.append(s)
+    if plan.num_groups or G:
+        return jnp.stack(combined, axis=-1)   # [..., S, G, n_slots]
+    matched = jax.lax.psum(outs[-1], "docs")
+    parts = [matched[..., None]]
+    for s in combined:
+        parts.append(s[..., None] if s.ndim == matched.ndim else s)
+    return jnp.concatenate(parts, axis=-1)    # [..., S, 1 + sum(w)]
+
+
 def make_sharded_kernel(plan: DevicePlan, mesh):
     """ANY DevicePlan over a (segments x docs) mesh with explicit ICI
     collectives (SURVEY §2.6 rows 6-7): column blocks shard over both axes,
@@ -608,28 +696,16 @@ def make_sharded_kernel(plan: DevicePlan, mesh):
         from jax.experimental.shard_map import shard_map  # type: ignore
 
     doc_shards = dict(zip(mesh.axis_names, mesh.devices.shape)).get("docs", 1)
+    fp = plan_fingerprint(plan)
 
     def local(cols, params, num_docs, D, G=0):
-        note_trace()  # body runs at trace time: counts compiles
+        # body runs at trace time: counts compiles
+        note_trace("sharded", fp, (int(num_docs.shape[-1]), D, G))
         d_local = D // doc_shards
         doc_pos = (jax.lax.axis_index("docs") * d_local
                    + jnp.arange(d_local, dtype=jnp.int32))[None, :]
-        valid = doc_pos < num_docs[:, None]
-        slots, matched = _compute_slots(plan, cols, params, valid, G)
-        combined = []
-        for op, s in slots:
-            kind = _doc_combine(op)
-            if kind == "psum":
-                s = jax.lax.psum(s, "docs")
-            elif kind == "pmin":
-                s = jax.lax.pmin(s, "docs")
-            else:
-                s = jax.lax.pmax(s, "docs")
-            combined.append((op, s))
-        if plan.num_groups or G:
-            return jnp.stack([s for _, s in combined], axis=-1)
-        matched = jax.lax.psum(matched, "docs")
-        return _pack_flat(matched, combined)
+        outs = _shard_one(plan, doc_pos, G)(cols, params, num_docs)
+        return _shard_combine_pack(plan, outs, G)
 
     def col_spec(name):
         return P("segments", "docs")  # every staged block is [S, D]
@@ -658,3 +734,122 @@ def make_sharded_kernel(plan: DevicePlan, mesh):
 @functools.lru_cache(maxsize=256)
 def compiled_sharded_kernel(plan: DevicePlan, mesh):
     return make_sharded_kernel(plan, mesh)
+
+
+# ---------------------------------------------------------------------------
+# batched kernel factory: ONE launch for B fingerprint-equal queries
+# ---------------------------------------------------------------------------
+#
+# The coalesce key is (plan fingerprint, shape bucket) — (plan, S, D, G,
+# per-array shape signature) — never a concrete segment batch, so
+# same-shape queries batch ACROSS tables and partitions. Two variants:
+#
+#   broadcast (stacked=False): every member shares the SAME staged column
+#     blocks (same segment batch — the dashboard-fleet case); only the
+#     per-query predicate params carry a leading batch axis, so B queries
+#     share one pass over one copy of the data.
+#   stacked (stacked=True): members stage DIFFERENT tables/partitions
+#     whose blocks pad into the same (S, D) bucket; each member's blocks
+#     stack along a new leading axis (the rows come from the residency
+#     tier — device-to-device, never a re-upload) and the kernel vmaps
+#     over all three of (cols, params, num_docs).
+#
+# Stacking happens INSIDE the jit so GSPMD owns the resulting sharding on
+# mesh engines. Dispatchers pad partial batches to the pow2 bucket B with
+# replicated leader inputs, so jit's shape cache only ever sees bucketed
+# batch sizes — steady state is zero retraces.
+
+def make_batched_kernel(plan: DevicePlan, B: int, stacked: bool = False):
+    kind = "batched_stacked" if stacked else "batched"
+    base = make_kernel(plan, kind=kind, extra=(B,))
+
+    if stacked:
+        def fn(clist, plist, ndlist, D, G=0):
+            cs = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *clist)
+            ps = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *plist)
+            ns = jnp.stack(ndlist)
+            return jax.vmap(
+                lambda c, p, nd: base(c, p, nd, D=D, G=G))(cs, ps, ns)
+    else:
+        def fn(cols, plist, num_docs, D, G=0):
+            ps = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *plist)
+            return jax.vmap(
+                lambda p: base(cols, p, num_docs, D=D, G=G))(ps)
+
+    return jax.jit(fn, static_argnames=("D", "G"))
+
+
+@functools.lru_cache(maxsize=256)
+def compiled_batched_kernel(plan: DevicePlan, B: int, stacked: bool = False):
+    """One jit per (plan, batch-size bucket B, stacked?) — see the
+    factory note above. fn(cols|clist, plist, num_docs|ndlist, D, G)."""
+    return make_batched_kernel(plan, B, stacked)
+
+
+def make_batched_sharded_kernel(plan: DevicePlan, mesh, B: int,
+                                stacked: bool = False):
+    """The batched kernel for doc-sharded mesh engines: vmap INSIDE
+    shard_map — mesh axes outermost, batch axis innermost — so
+    multi-device engines ride the same coalesce path instead of falling
+    off it (`vmap` OVER `shard_map` is unsupported; this nests the other
+    way). Each device computes its local [*, S_loc, D_loc] shard for all
+    B queries, then the whole batch pays ONE set of psum/pmin/pmax
+    collectives over the stacked partials (reductions commute with the
+    batch stack) instead of B per-query rendezvous — which also means
+    host platforms hold the CPU-collective lock once per BATCH.
+    """
+    from jax.sharding import PartitionSpec as P
+    try:
+        from jax import shard_map  # jax >= 0.8
+    except ImportError:  # pragma: no cover — older jax
+        from jax.experimental.shard_map import shard_map  # type: ignore
+
+    doc_shards = dict(zip(mesh.axis_names, mesh.devices.shape)).get("docs", 1)
+    fp = plan_fingerprint(plan)
+    kind = "sharded_batched_stacked" if stacked else "sharded_batched"
+
+    def local(cols, params, num_docs, D, G=0):
+        note_trace(kind, fp, (B, int(num_docs.shape[-1]), D, G))
+        d_local = D // doc_shards
+        doc_pos = (jax.lax.axis_index("docs") * d_local
+                   + jnp.arange(d_local, dtype=jnp.int32))[None, :]
+        # batch axis INNERMOST: vmap the shared per-shard compute over
+        # the leading query axis, then pay ONE set of collectives on the
+        # stacked partials (the combine/pack is rank-agnostic)
+        in_axes = (0 if stacked else None, 0, 0 if stacked else None)
+        outs = jax.vmap(_shard_one(plan, doc_pos, G),
+                        in_axes=in_axes)(cols, params, num_docs)
+        return _shard_combine_pack(plan, outs, G)
+
+    def fn(cols, plist, num_docs, D, G=0):
+        ps = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *plist)
+        if stacked:
+            cs = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *cols)
+            ns = jnp.stack(num_docs)
+            col_spec = P(None, "segments", "docs")
+            nd_spec = P(None, "segments")
+        else:
+            cs, ns = cols, num_docs
+            col_spec = P("segments", "docs")
+            nd_spec = P("segments")
+        in_specs = (
+            {k: col_spec for k in cs},
+            {k: P(None, "segments", *([None] * (v.ndim - 2)))
+             for k, v in ps.items()},
+            nd_spec,
+        )
+        ndim_out = 4 if (plan.num_groups or G) else 3
+        sm = shard_map(
+            functools.partial(local, D=D, G=G), mesh=mesh,
+            in_specs=in_specs,
+            out_specs=P(None, "segments", *([None] * (ndim_out - 2))),
+        )
+        return sm(cs, ps, ns)
+
+    return jax.jit(fn, static_argnames=("D", "G"))
+
+
+@functools.lru_cache(maxsize=256)
+def compiled_batched_sharded_kernel(plan: DevicePlan, mesh, B: int,
+                                    stacked: bool = False):
+    return make_batched_sharded_kernel(plan, mesh, B, stacked)

@@ -36,6 +36,17 @@ KEYS: Dict[str, Any] = {
     # active), capped at batch.max per launch
     "pinot.server.dispatch.batch.window.ms": 2.0,
     "pinot.server.dispatch.batch.max": 16,
+    # cross-table shape-bucketed batching (the unified kernel factory,
+    # ops/kernels.py): queries coalesce on (plan fingerprint, shape
+    # bucket) — padded S/D pow2 buckets + staged-array shape signature —
+    # so same-plan queries over DIFFERENT tables/partitions share one
+    # launch (column blocks stack along a leading batch axis). Off =
+    # PR-4 behavior (identical segment batch only). doc.bucket.max caps
+    # the doc bucket eligible for cross-table stacking: above it, a
+    # stacked [B, S, D] copy would dominate HBM, so such launches keep
+    # the same-batch (broadcast-only) key.
+    "pinot.server.dispatch.batch.cross.table": True,
+    "pinot.server.dispatch.doc.bucket.max": 1 << 20,
     # HBM memory tiers (ops/engine.py + ops/residency.py):
     # .hbm.cache.bytes bounds the ASSEMBLED [S, D] block cache;
     # .hbm.resident.* bounds the per-(segment, column) resident-row tier

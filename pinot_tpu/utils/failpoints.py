@@ -21,6 +21,10 @@ Sites currently compiled in:
   server.execute.before    — server-side, before a query executes
   server.execute.segment   — per segment in the execution loop
   server.dispatch.before   — kernel dispatch (ring + inline paths)
+  server.dispatch.batch    — per MEMBER inside the coalesced-batch path
+                             (ctx: table, mode, batch_size) — an erroring
+                             member fails only its own future; peers
+                             stay batched and complete
   netframe.send            — every framed send (coordination, cache, stream)
   connection.request       — broker->server request, response payload hook
   cache.remote.get         — remote cache-tier GET

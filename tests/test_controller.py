@@ -193,6 +193,22 @@ class TestMinionTasks:
         seg = load_segment(st.table_segments("ct_OFFLINE")[0].dir_path)
         assert seg.num_docs == 50
 
+    def test_purge_no_match_still_converges(self, tmp_path):
+        """A segment with NO rows matching the predicate still rewrites
+        to its _purged name (same data): the suffix is the generator's
+        convergence marker, so skipping it would rescan the segment on
+        every cadence tick forever."""
+        st, ctx = self._ctx(tmp_path)
+        d = build_seg(tmp_path, "pn", n=100)
+        st.upsert_segment(SegmentState("pn", "ct_OFFLINE", [], dir_path=d,
+                                       num_docs=100))
+        out = run_task(TaskConfig("PurgeTask", "ct_OFFLINE", ["pn"],
+                                  {"purgePredicate": "ts > 100000"}), ctx)
+        assert out["purgedSegments"] == ["pn_purged"]
+        (state,) = st.table_segments("ct_OFFLINE")
+        assert state.name == "pn_purged"
+        assert load_segment(state.dir_path).num_docs == 100  # no row lost
+
 
 class TestControllerFacade:
     def test_upload_assign_load_delete(self, tmp_path):

@@ -1,0 +1,392 @@
+"""Unified kernel factory: cross-table shape-bucketed batching +
+vmapped sharded kernels (ISSUE 9).
+
+Pins the tentpole properties deterministically:
+
+  * cross-table coalescing — fingerprint-equal queries over DIFFERENT
+    tables whose segment/doc counts pad into the same (S, D) bucket
+    share ONE launch (column blocks stacked along a leading batch axis),
+    BIT-IDENTICAL to per-query execution (property-tested over random
+    literal sets and random member->table assignments)
+  * doc-sharded mesh batching — multi-device engines no longer fall off
+    the batching path: the factory vmaps INSIDE shard_map (batch axis
+    innermost, mesh axes outermost, one set of psum collectives per
+    batch), same bit-identity bar, same-table AND cross-table
+  * batch-member fault isolation — the `server.dispatch.batch`
+    failpoint fires per member inside the coalesced path; an erroring
+    member fails only its own future while peers complete, and the
+    seeded decision journal replays byte-identical
+  * compile observability — `kernels.trace_log()` attributes every
+    compile to (kind, plan fingerprint, shape bucket) and the
+    `kernel_retrace` meter carries a per-plan label
+  * steady state — warmed cross-table traffic compiles NOTHING
+
+Determinism trick (same as test_dispatch.py): a one-shot delay
+failpoint on server.dispatch.before holds the ring on the first pop
+while the remaining threads enqueue, so batch composition is exact.
+"""
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+import jax
+
+from pinot_tpu.models import (DataType, FieldSpec, FieldType, Schema,
+                              TableConfig, TableType)
+from pinot_tpu.ops import kernels
+from pinot_tpu.ops.engine import TpuOperatorExecutor
+from pinot_tpu.parallel.mesh import make_mesh
+from pinot_tpu.query.context import QueryContext
+from pinot_tpu.segment.creator import SegmentCreator
+from pinot_tpu.segment.loader import load_segment
+from pinot_tpu.utils.config import PinotConfiguration
+from pinot_tpu.utils.failpoints import FailpointError, failpoints
+
+HOLD_S = 0.3
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoints.clear()
+    yield
+    failpoints.clear()
+
+
+def build_table(tmp_path, name, num_segments, docs, seed):
+    """One table's segment batch: same schema SHAPE as every other
+    table here (so plans fingerprint-equal), its own data and doc
+    count (so buckets must do the matching)."""
+    schema = Schema(name, [
+        FieldSpec("d", DataType.INT, FieldType.DIMENSION),
+        FieldSpec("m", DataType.INT, FieldType.METRIC)])
+    tc = TableConfig(name, TableType.OFFLINE)
+    tc.indexing.no_dictionary_columns = ["m"]
+    creator = SegmentCreator(tc, schema)
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(num_segments):
+        cols = {"d": rng.integers(0, 10, docs).astype(np.int32),
+                "m": rng.integers(0, 100, docs).astype(np.int32)}
+        p = str(tmp_path / f"{name}_{i}")
+        creator.build(cols, p, f"{name}_{i}")
+        out.append(load_segment(p))
+    return out
+
+
+@pytest.fixture(scope="module")
+def tables(tmp_path_factory):
+    """Three tables, mixed doc counts in the SAME pow2 doc bucket
+    (4096) and segment counts that pad into one S bucket — the
+    mixed-table dashboard fleet."""
+    tmp = tmp_path_factory.mktemp("xtab")
+    return {
+        "t1": build_table(tmp, "t1", 3, 3000, 1),
+        "t2": build_table(tmp, "t2", 4, 2500, 2),
+        "t3": build_table(tmp, "t3", 3, 3900, 3),
+    }
+
+
+def make_engine(**overrides):
+    return TpuOperatorExecutor(config=PinotConfiguration(overrides=overrides))
+
+
+def agg_values(results):
+    out = []
+    for r in results:
+        if hasattr(r, "groups"):
+            out.append(tuple(sorted(
+                (k, tuple(float(v) for v in inters))
+                for k, inters in r.groups.items())))
+        else:
+            out.append(tuple(float(v) for v in r.intermediates))
+    return tuple(out)
+
+
+def run_concurrent(eng, jobs, hold=HOLD_S):
+    """jobs: [(segments, ctx), ...] executed concurrently with the ring
+    held on the first pop so batch composition is deterministic."""
+    failpoints.arm("server.dispatch.before", delay=hold, times=2)
+    try:
+        with ThreadPoolExecutor(len(jobs)) as pool:
+            futs = [pool.submit(eng.execute, s, c) for s, c in jobs]
+            return [f.result() for f in futs]
+    finally:
+        failpoints.disarm("server.dispatch.before")
+
+
+class TestCrossTableBatching:
+    def test_cross_table_coalesce_bit_identical(self, tables):
+        eng = make_engine()
+        jobs = []
+        for i, tn in enumerate(["t1", "t2", "t3", "t1", "t2", "t3"]):
+            jobs.append((tables[tn], QueryContext.from_sql(
+                f"SELECT SUM(m), COUNT(*), MIN(m) FROM {tn} "
+                f"WHERE d < {i + 2}")))
+        singles = [agg_values(eng.execute(s, c)[0]) for s, c in jobs]
+        got = run_concurrent(eng, jobs)
+        assert all(not rem for _r, rem in got)
+        assert [agg_values(r) for r, _rem in got] == singles
+        # a STACKED (cross-table) batch actually formed — not six
+        # serialized singles, not a same-batch broadcast
+        reg = eng._dispatcher._metrics
+        assert reg.meter("dispatch_batch_cross_table") > 0
+
+    def test_bit_identical_property_random_tables_and_literals(self, tables):
+        """Property: ANY member->table assignment with ANY literal set,
+        coalesced in ANY composition, equals per-query execution."""
+        eng = make_engine()
+        rng = np.random.default_rng(31)
+        names = list(tables)
+        for _trial in range(3):
+            k = int(rng.integers(3, 8))
+            picks = [names[j] for j in rng.integers(0, len(names), k)]
+            bounds = rng.integers(0, 100, size=(k, 2))
+            jobs = [(tables[tn], QueryContext.from_sql(
+                "SELECT SUM(m), COUNT(*), MAX(m) FROM x "
+                f"WHERE m BETWEEN {min(a, b)} AND {max(a, b)} AND d < 8"))
+                for tn, (a, b) in zip(picks, bounds)]
+            singles = [agg_values(eng.execute(s, c)[0]) for s, c in jobs]
+            got = run_concurrent(eng, jobs)
+            assert [agg_values(r) for r, _rem in got] == singles
+
+    def test_group_by_cross_table_bit_identical(self, tables):
+        eng = make_engine()
+        jobs = [(tables[tn], QueryContext.from_sql(
+            f"SELECT d, SUM(m) FROM x WHERE m BETWEEN {a} AND {a + 40} "
+            "GROUP BY d"))
+            for tn, a in (("t1", 0), ("t3", 10), ("t1", 20), ("t3", 30))]
+        singles = [agg_values(eng.execute(s, c)[0]) for s, c in jobs]
+        got = run_concurrent(eng, jobs)
+        assert [agg_values(r) for r, _rem in got] == singles
+
+    def test_cross_table_disabled_keeps_same_batch_key(self, tables):
+        """The escape hatch: cross.table=false restores PR-4 semantics —
+        different tables never share a launch (no stacked batches), but
+        results are still correct."""
+        eng = make_engine(**{
+            "pinot.server.dispatch.batch.cross.table": False})
+        jobs = [(tables[tn], QueryContext.from_sql(
+            f"SELECT SUM(m), COUNT(*) FROM x WHERE d < {i + 2}"))
+            for i, tn in enumerate(["t1", "t2", "t1", "t2"])]
+        singles = [agg_values(eng.execute(s, c)[0]) for s, c in jobs]
+        # the registry is process-global: assert the DELTA stays zero
+        m0 = eng._dispatcher._metrics.meter("dispatch_batch_cross_table")
+        got = run_concurrent(eng, jobs)
+        assert [agg_values(r) for r, _rem in got] == singles
+        assert eng._dispatcher._metrics.meter(
+            "dispatch_batch_cross_table") == m0
+
+    def test_steady_state_cross_table_zero_retrace(self, tables):
+        """Warmed mixed-table traffic (singles + stacked batches over
+        warmed shape buckets) compiles NOTHING — the acceptance bar the
+        bench asserts under load, pinned here deterministically."""
+        eng = make_engine()
+
+        def round_of(base):
+            jobs = [(tables[tn], QueryContext.from_sql(
+                "SELECT SUM(m), COUNT(*) FROM x "
+                f"WHERE d < {base + i}"))
+                for i, tn in enumerate(
+                    ["t1", "t2", "t3", "t1", "t2", "t3", "t1", "t2"])]
+            got = run_concurrent(eng, jobs)
+            assert all(not rem for _r, rem in got)
+
+        for tn in tables:  # warm singles (stage + compile per table)
+            eng.execute(tables[tn], QueryContext.from_sql(
+                "SELECT SUM(m), COUNT(*) FROM x WHERE d < 1"))
+        round_of(0)   # warm the batched bucket shapes
+        round_of(1)   # a second composition (partial-pad variants)
+        before = kernels.trace_count()
+        round_of(2)
+        round_of(3)
+        for tn in tables:
+            eng.execute(tables[tn], QueryContext.from_sql(
+                "SELECT SUM(m), COUNT(*) FROM x WHERE d < 5"))
+        assert kernels.trace_count() == before, \
+            "steady-state cross-table traffic re-compiled a kernel"
+
+
+@pytest.fixture(scope="module")
+def mesh_engine():
+    """A (segments x docs) mesh over 2+2 devices: the doc-sharded path
+    that PR 4 excluded from batching entirely."""
+    mesh = make_mesh(jax.devices()[:4], doc_axis=2)
+    return TpuOperatorExecutor(mesh=mesh, config=PinotConfiguration())
+
+
+class TestMeshBatching:
+    def test_doc_sharded_same_table_batches_bit_identical(
+            self, tables, mesh_engine):
+        eng = mesh_engine
+        jobs = [(tables["t1"], QueryContext.from_sql(
+            f"SELECT SUM(m), COUNT(*), MIN(m) FROM t1 WHERE d < {k}"))
+            for k in range(1, 7)]
+        singles = [agg_values(eng.execute(s, c)[0]) for s, c in jobs]
+        reg = eng._dispatcher._metrics
+        max0 = reg.timer("dispatch_batch_size").max_ms
+        got = run_concurrent(eng, jobs)
+        assert all(not rem for _r, rem in got)
+        assert [agg_values(r) for r, _rem in got] == singles
+        # the sharded path actually batched (vmap inside shard_map)
+        assert reg.timer("dispatch_batch_size").max_ms >= max(max0, 2)
+
+    def test_doc_sharded_cross_table_batches_bit_identical(
+            self, tables, mesh_engine):
+        eng = mesh_engine
+        jobs = [(tables[tn], QueryContext.from_sql(
+            f"SELECT SUM(m), COUNT(*) FROM x WHERE d < {i + 2}"))
+            for i, tn in enumerate(["t1", "t3", "t1", "t3"])]
+        singles = [agg_values(eng.execute(s, c)[0]) for s, c in jobs]
+        got = run_concurrent(eng, jobs)
+        assert [agg_values(r) for r, _rem in got] == singles
+
+    def test_doc_sharded_steady_state_zero_retrace(self, tables,
+                                                   mesh_engine):
+        eng = mesh_engine
+
+        def round_of(base):
+            jobs = [(tables["t1"], QueryContext.from_sql(
+                f"SELECT SUM(m), COUNT(*) FROM t1 WHERE d < {base + k}"))
+                for k in range(6)]
+            got = run_concurrent(eng, jobs)
+            assert all(not rem for _r, rem in got)
+
+        eng.execute(tables["t1"], QueryContext.from_sql(
+            "SELECT SUM(m), COUNT(*) FROM t1 WHERE d < 1"))
+        round_of(0)
+        round_of(1)
+        before = kernels.trace_count()
+        round_of(2)
+        round_of(3)
+        assert kernels.trace_count() == before, \
+            "steady-state mesh traffic re-compiled a kernel"
+
+
+class TestBatchChaos:
+    def test_one_erroring_member_fails_only_its_future(self, tables):
+        """server.dispatch.batch fires per member inside the coalesced
+        path: with a one-shot error armed, exactly one of four batched
+        queries fails and the three peers complete bit-identically."""
+        eng = make_engine()
+        jobs = [(tables[tn], QueryContext.from_sql(
+            f"SELECT SUM(m), COUNT(*) FROM x WHERE d < {i + 2}"))
+            for i, tn in enumerate(["t1", "t2", "t1", "t2"])]
+        singles = [agg_values(eng.execute(s, c)[0]) for s, c in jobs]
+        failpoints.arm("server.dispatch.before", delay=HOLD_S, times=2)
+        failpoints.arm("server.dispatch.batch",
+                       error=FailpointError("member chaos"), times=1)
+        try:
+            with ThreadPoolExecutor(len(jobs)) as pool:
+                futs = [pool.submit(eng.execute, s, c) for s, c in jobs]
+                outcomes = []
+                for i, f in enumerate(futs):
+                    try:
+                        res, rem = f.result()
+                        assert not rem
+                        assert agg_values(res) == singles[i]
+                        outcomes.append("ok")
+                    except FailpointError:
+                        outcomes.append("chaos")
+        finally:
+            failpoints.disarm("server.dispatch.before")
+            failpoints.disarm("server.dispatch.batch")
+        assert outcomes.count("chaos") == 1, outcomes
+        assert outcomes.count("ok") == len(jobs) - 1
+        # the ring is fully recovered: peers re-execute cleanly
+        for (s, c), want in zip(jobs, singles):
+            assert agg_values(eng.execute(s, c)[0]) == want
+
+    def test_seeded_batch_chaos_replays_exactly(self, tables):
+        """Same seed -> byte-identical decision journal across rounds,
+        with surviving members always bit-identical to per-query."""
+        eng = make_engine()
+        jobs = [(tables[tn], QueryContext.from_sql(
+            f"SELECT SUM(m), COUNT(*) FROM x WHERE d < {i + 2}"))
+            for i, tn in enumerate(["t1", "t2", "t3", "t1"])]
+        singles = [agg_values(eng.execute(s, c)[0]) for s, c in jobs]
+
+        def run_round():
+            fp = failpoints.arm("server.dispatch.batch",
+                                error=FailpointError("batch chaos"),
+                                probability=0.5, seed=4242)
+            failed = 0
+            try:
+                for _ in range(3):
+                    failpoints.arm("server.dispatch.before",
+                                   delay=HOLD_S, times=2)
+                    try:
+                        with ThreadPoolExecutor(len(jobs)) as pool:
+                            futs = [pool.submit(eng.execute, s, c)
+                                    for s, c in jobs]
+                            for i, f in enumerate(futs):
+                                try:
+                                    res, _rem = f.result()
+                                    assert agg_values(res) == singles[i]
+                                except FailpointError:
+                                    failed += 1
+                    finally:
+                        failpoints.disarm("server.dispatch.before")
+            finally:
+                failpoints.disarm("server.dispatch.batch")
+            return failed, list(fp.decisions)
+
+        f1, d1 = run_round()
+        f2, d2 = run_round()
+        assert d1 == d2, "same-seed batch chaos journals diverged"
+        assert f1 == f2
+        assert f1 > 0, "chaos never fired"
+
+
+class TestCompileObservability:
+    def test_trace_log_attributes_compiles(self, tables):
+        eng = make_engine()
+        ctx = QueryContext.from_sql(
+            "SELECT SUM(m), COUNT(*), MAX(m) FROM t2 WHERE d < 3 AND m < 7")
+        seq0 = kernels.trace_count()
+        eng.execute(tables["t2"], ctx)
+        entries = [e for e in kernels.trace_log() if e["seq"] > seq0]
+        assert entries, "compile left no trace-log entry"
+        prep = eng._prepare_agg(tables["t2"], ctx)
+        fp = kernels.plan_fingerprint(prep[0])
+        mine = [e for e in entries if e["plan"] == fp]
+        assert mine, f"no entry for plan {fp}: {entries}"
+        # bucket carries the shape key: (..., S, D, G)
+        assert mine[-1]["bucket"][-2:] == (4096, 0)
+        assert mine[-1]["kind"] in (
+            "agg", "sharded", "batched", "batched_stacked")
+
+    def test_kernel_retrace_meter_has_plan_label(self, tables):
+        eng = make_engine()
+        ctx = QueryContext.from_sql(
+            "SELECT SUM(m), MIN(m), MAX(m) FROM t3 WHERE m < 42 AND d < 9")
+        eng.execute(tables["t3"], ctx)
+        prep = eng._prepare_agg(tables["t3"], ctx)
+        fp = kernels.plan_fingerprint(prep[0])
+        reg = eng._dispatcher._metrics
+        # attribution is a SEPARATE series so the aggregate stays summable
+        assert reg.meter("kernel_retrace_by_plan", labels={"plan": fp}) > 0
+        assert reg.meter("kernel_retrace") > 0  # unlabelled total intact
+        assert kernels.trace_count_by_plan().get(fp, 0) > 0
+
+
+# tier-1 smoke of the acceptance driver
+class TestBatchingBenchSmoke:
+    def test_batching_bench_smoke(self, tmp_path):
+        """The --batching acceptance scenario at smoke scale: mixed
+        tables + a doc-sharded mesh engine, unified factory vs
+        serialized mode, zero steady-state retraces asserted inside."""
+        import importlib
+        import json
+        import os
+        import sys
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        bench = importlib.import_module("bench")
+        out = str(tmp_path / "BENCH_batching_smoke.json")
+        bench.batching_main(smoke=True, out_path=out)
+        with open(out) as f:
+            data = json.load(f)
+        assert data["mixed_table"]["unified"]["retraces_steady"] == 0
+        assert data["doc_sharded"]["unified"]["retraces_steady"] == 0

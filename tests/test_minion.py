@@ -213,6 +213,99 @@ class TestGeneratorCadence:
         # second tick: the active task dedupes regeneration
         assert tm.run_once()["generated"] == 0
 
+    def test_realtime_to_offline_generator(self, tmp_path):
+        """Sealed (ONLINE) realtime segments batch into one
+        RealtimeToOfflineSegmentsTask; CONSUMING segments never move;
+        the active task dedupes regeneration."""
+        state = ClusterState()
+        cfg = make_config()
+        cfg.task_configs = {"RealtimeToOfflineSegmentsTask": {}}
+        state.add_table(cfg, make_schema())
+        for i in range(3):
+            d = build_seg(tmp_path, f"rt{i}", n=40, ts_base=i * 100, seed=i)
+            m = load_segment(d).metadata
+            state.upsert_segment(SegmentState(
+                f"rt{i}", "ct_REALTIME", [], dir_path=d, num_docs=40,
+                start_time=m.start_time, end_time=m.end_time))
+        state.upsert_segment(SegmentState(
+            "rt_consuming", "ct_REALTIME", [], dir_path="/nope",
+            num_docs=0, status="CONSUMING"))
+        tm = TaskManager(state, config=PinotConfiguration(overrides={
+            "pinot.controller.task.generators.enabled": True}))
+        assert tm.run_once()["generated"] == 1
+        (entry,) = tm.queue.list(PENDING)
+        assert entry.task_type == "RealtimeToOfflineSegmentsTask"
+        assert entry.table == "ct_REALTIME"
+        assert sorted(entry.segments) == ["rt0", "rt1", "rt2"]
+        assert "rt_consuming" not in entry.segments
+        # second tick: the active task dedupes regeneration
+        assert tm.run_once()["generated"] == 0
+        # a segment sealing MID-FLIGHT must not spawn a superset task —
+        # overlap (not just exact-set) dedupe, or the same realtime rows
+        # would migrate into the OFFLINE table twice
+        tm.queue.lease("w0")
+        d3 = build_seg(tmp_path, "rt3", n=40, ts_base=300, seed=3)
+        m3 = load_segment(d3).metadata
+        state.upsert_segment(SegmentState(
+            "rt3", "ct_REALTIME", [], dir_path=d3, num_docs=40,
+            start_time=m3.start_time, end_time=m3.end_time))
+        assert tm.run_once()["generated"] == 0
+
+    def test_purge_generator(self, tmp_path):
+        """PurgeTask generator scans ONLINE offline segments, carries
+        the table's purgePredicate into task params, skips already
+        rewritten (_purged) outputs, and requires a predicate at all."""
+        state = ClusterState()
+        cfg = make_config()
+        cfg.task_configs = {"PurgeTask": {"purgePredicate": "m > 90"}}
+        state.add_table(cfg, make_schema())
+        for name in ("p0", "p1", "p0_purged"):
+            d = build_seg(tmp_path, name, n=30, seed=3)
+            state.upsert_segment(SegmentState(
+                name, "ct_OFFLINE", [], dir_path=d, num_docs=30))
+        tm = TaskManager(state, config=PinotConfiguration(overrides={
+            "pinot.controller.task.generators.enabled": True}))
+        assert tm.run_once()["generated"] == 1
+        (entry,) = tm.queue.list(PENDING)
+        assert entry.task_type == "PurgeTask"
+        assert entry.table == "ct_OFFLINE"
+        assert sorted(entry.segments) == ["p0", "p1"]  # _purged skipped
+        assert entry.params["purgePredicate"] == "m > 90"
+        assert tm.run_once()["generated"] == 0  # active-task dedupe
+        # a PurgeTask opt-in WITHOUT a predicate generates nothing
+        state2 = ClusterState()
+        cfg2 = make_config()
+        cfg2.task_configs = {"PurgeTask": {}}
+        state2.add_table(cfg2, make_schema())
+        state2.upsert_segment(SegmentState(
+            "q0", "ct_OFFLINE", [], dir_path="/nope", num_docs=10))
+        tm2 = TaskManager(state2, config=PinotConfiguration(overrides={
+            "pinot.controller.task.generators.enabled": True}))
+        assert tm2.run_once()["generated"] == 0
+
+    def test_cross_type_overlap_dedupes(self, tmp_path):
+        """A table opting into BOTH merge-rollup and purge must not get
+        two concurrent tasks over the same segments: every executor
+        consumes-and-retires its inputs, so a race would republish the
+        rows twice. One tick emits one task; the other type waits."""
+        state = ClusterState()
+        cfg = make_config()
+        cfg.task_configs = {"MergeRollupTask": {},
+                            "PurgeTask": {"purgePredicate": "m > 90"}}
+        state.add_table(cfg, make_schema())
+        for i in range(3):
+            d = build_seg(tmp_path, f"x{i}", n=50, ts_base=i * 100, seed=i)
+            m = load_segment(d).metadata
+            state.upsert_segment(SegmentState(
+                f"x{i}", "ct_OFFLINE", [], dir_path=d, num_docs=50,
+                start_time=m.start_time, end_time=m.end_time))
+        tm = TaskManager(state, config=PinotConfiguration(overrides={
+            "pinot.controller.task.generators.enabled": True}))
+        assert tm.run_once()["generated"] == 1
+        (entry,) = tm.queue.list(PENDING)
+        assert sorted(entry.segments) == ["x0", "x1", "x2"]
+        assert tm.run_once()["generated"] == 0  # second type still waits
+
     def test_table_without_task_config_not_scanned(self, tmp_path):
         state = ClusterState()
         state.add_table(make_config(), make_schema())  # no task_configs
