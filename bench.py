@@ -724,7 +724,342 @@ def residency_main(smoke: bool = False):
             f"({cold_paired_delta_ms:.2f}ms paired)"
 
 
-def mse_main(smoke: bool = False):
+def _mse_throughput_leg(smoke: bool = False) -> dict:
+    """Factory-batched vs serialized leaf dispatch for fingerprint-equal
+    MSE traffic (ISSUE 10 acceptance leg). Two measurements:
+
+    1. **Leaf-dispatch closed loop** (`leaf_qps_*` — the acceptance
+       number): 8 clients drive the EXACT MSE leaf-stage execution path
+       (the `leaf_query_fn` bridge: QueryExecutor over the instance's
+       segments with the leaf_agg pushdown context, device engine
+       included) with per-query literals; under the pipelined dispatcher
+       the concurrent fingerprint-equal leaf stages COALESCE into one
+       `jit(vmap)` launch, the serialized arm pays one XLA launch (+
+       collective-lock hold on GSPMD hosts) per stage per query. This is
+       the layer the tentpole refactors, so its ratio carries the
+       structural floor: >= 1.5x on the CPU stand-in, >= 2x on real
+       accelerators (each serialized launch additionally pays the ~100ms
+       host<->device link there).
+    2. **End-to-end MSE join closed loop** (`e2e_*`, context): the same
+       leaf shape wrapped in a full broker->stages->mailbox join through
+       two MiniClusters with ORDER-ALTERNATING windows + paired
+       sequential single-query p50. On the few-core GIL-bound CPU
+       stand-in the end-to-end loop is HOST-bound (SQL parse, planning,
+       stage submit, mailbox serde dominate at ~9 core-ms/query), so the
+       e2e ratio is asserted only on real accelerators; the CPU stand-in
+       asserts no e2e regression, paired p50 within noise, and ZERO
+       steady-state retraces on the measured windows.
+
+    Both loops warm to a STEADY state first (closed windows repeat until
+    throughput stops moving): a cold process's first windows run several
+    times slower — thread pools, jit caches, OS scheduling — and would
+    poison whichever arm they landed on."""
+    import gc
+    import shutil
+    import statistics as stats
+    import tempfile
+    import threading
+
+    import jax
+    import numpy as np
+
+    from pinot_tpu.cluster.mini import MiniCluster
+    from pinot_tpu.models.schema import Schema
+    from pinot_tpu.models.table_config import TableConfig
+    from pinot_tpu.ops import kernels as _kernels
+    from pinot_tpu.segment.creator import SegmentCreator
+    from pinot_tpu.segment.loader import load_segment
+    from pinot_tpu.utils.config import PinotConfiguration
+
+    # CPU hosts force the 8-virtual-device mesh CI runs under (same as
+    # --batching): every staged kernel is then GSPMD-partitioned, so
+    # SERIALIZED leaf dispatch holds the process-global collective lock
+    # across launch + sync for every stage of every query — the exact
+    # per-launch fixed cost the factory amortizes to once per batch
+    try:
+        jax.config.update("jax_num_cpu_devices", 8)
+    except AttributeError:  # older jax: flag path
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8")
+    except RuntimeError:
+        pass  # backend already initialized (pytest: conftest forced 8)
+
+    num_segments = 4 if smoke else 8
+    docs = 2_000
+    clients = 8
+    window_s = 0.5 if smoke else 2.0
+    warm_windows = 1 if smoke else 3
+    rounds = 2 if smoke else 4
+
+    fact_schema = Schema.from_dict({
+        "schemaName": "bf",
+        "dimensionFieldSpecs": [{"name": "k", "dataType": "LONG"}],
+        "metricFieldSpecs": [{"name": "v", "dataType": "LONG"}]})
+    dim_schema = Schema.from_dict({
+        "schemaName": "bd",
+        "dimensionFieldSpecs": [{"name": "k", "dataType": "LONG"},
+                                {"name": "name", "dataType": "STRING"}]})
+    fc = SegmentCreator(TableConfig.from_dict(
+        {"tableName": "bf", "tableType": "OFFLINE"}), fact_schema)
+    dc = SegmentCreator(TableConfig.from_dict(
+        {"tableName": "bd", "tableType": "OFFLINE"}), dim_schema)
+    tmp = tempfile.mkdtemp(prefix="bench_mse_tp_")
+    seg_dirs = []
+    for i in range(num_segments):
+        rng = np.random.default_rng(100 + i)
+        d = os.path.join(tmp, f"bf_{i}")
+        fc.build({"k": rng.integers(0, 8, docs).astype(np.int64),
+                  "v": rng.integers(0, 1000, docs).astype(np.int64)},
+                 d, f"bf_{i}")
+        seg_dirs.append(d)
+    dim_dir = os.path.join(tmp, "bd_0")
+    dc.build({"k": np.arange(8, dtype=np.int64),
+              "name": [f"g{i}" for i in range(8)]}, dim_dir, "bd_0")
+
+    def make_cluster(mode):
+        overrides = {"pinot.server.dispatch.mode": mode}
+        if mode == "pipelined":
+            # the adaptive window (this PR's satellite) sizes the
+            # coalesce wait from observed arrivals — the serving shape
+            overrides["pinot.server.dispatch.batch.window.ms"] = "auto"
+        c = MiniCluster(num_servers=1, use_tpu=True,
+                        config=PinotConfiguration(overrides=overrides))
+        c.start()
+        c.add_table("bf")
+        c.add_table("bd")
+        for d in seg_dirs:
+            c.add_segment("bf", load_segment(d), server_idx=0)
+        c.add_segment("bd", load_segment(dim_dir), server_idx=0)
+        return c
+
+    # fingerprint-equal MSE joins: the aggregate subquery's literal
+    # varies per query (no cache tier can absorb the leaf) while the
+    # plan shape is constant, so concurrent leaf stages coalesce on the
+    # factory key. The leaf is the scan-heavy global aggregate (the
+    # shape whose per-launch fixed cost dominates — exactly what the
+    # factory amortizes); the residual join + sort stay tiny.
+    def sql_for(j):
+        a = (j * 13) % 400
+        return ("SELECT d.name, t.s FROM "
+                f"(SELECT SUM(f.v) AS s, COUNT(*) AS c FROM bf f "
+                f"WHERE f.v BETWEEN {a} AND {a + 500}) t "
+                "JOIN bd d ON d.k < t.c ORDER BY d.name LIMIT 20")
+
+    def closed_window(cluster, seq0):
+        counts = [0] * clients
+        errors = []
+        stop_at = time.perf_counter() + window_s
+
+        def client(ci):
+            j = seq0 + ci * 1009
+            while time.perf_counter() < stop_at:
+                resp = cluster.query(sql_for(j))
+                if resp.exceptions:
+                    errors.append(resp.exceptions)
+                    return
+                counts[ci] += 1
+                j += 1
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # surfaced AFTER join: an assert inside a worker thread dies
+        # silently, and a failing arm would otherwise just under-count
+        # and corrupt the measured ratio
+        assert not errors, errors[0]
+        return sum(counts) / (time.perf_counter() - t0)
+
+    def single_p50(cluster, seq0, iters):
+        lat = []
+        for j in range(iters):
+            t0 = time.perf_counter()
+            resp = cluster.query(sql_for(seq0 + j))
+            assert not resp.exceptions, resp.exceptions
+            lat.append((time.perf_counter() - t0) * 1e3)
+        return stats.median(lat)
+
+    serial = make_cluster("serialized")
+    pipe = make_cluster("pipelined")
+
+    # -- sub-leg 1: the leaf-dispatch layer ----------------------------
+    # the exact context _leaf_agg_pushdown builds for this subquery, run
+    # through the exact bridge MSE workers use (QueryExecutor + shared
+    # engine) — the MSE leaf path minus broker/mailbox, i.e. the layer
+    # the factory refactors
+    from pinot_tpu.query.context import QueryContext
+    from pinot_tpu.query.executor import QueryExecutor
+    from pinot_tpu.query.expressions import Function, Identifier, Literal
+
+    leaf_segs = [load_segment(d) for d in seg_dirs]
+
+    def leaf_ctx(j):
+        a = (j * 13) % 400
+        v = Identifier("v")
+        sel = [Function("sum", (v,)),
+               Function("count", (Identifier("*"),))]
+        q = QueryContext(
+            table="bf", select=sel, aliases=[None] * 2, distinct=False,
+            filter=Function("between", (v, Literal(a), Literal(a + 500))),
+            group_by=[], having=None, order_by=[], limit=1 << 31,
+            offset=0, options={"numGroupsLimit": str(1 << 31)})
+        q._extract_aggregations()
+        return q
+
+    def leaf_loop(engine, seq0):
+        counts = [0] * clients
+        errors = []
+        stop_at = time.perf_counter() + window_s
+
+        def client(ci):
+            j = seq0 + ci * 1009
+            try:
+                while time.perf_counter() < stop_at:
+                    ex = QueryExecutor(leaf_segs, use_tpu=True,
+                                       engine=engine)
+                    results, _stats = ex.execute_context(leaf_ctx(j))
+                    assert results
+                    counts[ci] += 1
+                    j += 1
+            except BaseException as e:  # noqa: BLE001 — surface at join
+                errors.append(e)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors[0]  # a dead arm must fail the run
+        return sum(counts) / (time.perf_counter() - t0)
+
+    def steady_warm(run_window, max_w=3 if smoke else 10):
+        """Repeat untimed windows until throughput stops moving (<10%
+        window-over-window) — the box takes several seconds of load to
+        reach its steady state."""
+        prev = run_window(0)
+        for w in range(1, max_w):
+            cur = run_window(w)
+            if abs(cur - prev) <= 0.10 * prev:
+                return
+            prev = cur
+
+    leaf_eng = {
+        "serialized": serial.servers[0].executor._shared_engine(),
+        "pipelined": pipe.servers[0].executor._shared_engine(),
+    }
+    gc.disable()
+    try:
+        for eng in leaf_eng.values():  # compile + stage once
+            QueryExecutor(leaf_segs, use_tpu=True,
+                          engine=eng).execute_context(leaf_ctx(0))
+        steady_warm(lambda w: leaf_loop(leaf_eng["serialized"],
+                                        3000 + w * 61))
+        steady_warm(lambda w: leaf_loop(leaf_eng["pipelined"],
+                                        3000 + w * 61))
+        leaf_ratios, leaf_s_all, leaf_p_all = [], [], []
+        leaf_retrace0 = _kernels.trace_count()
+        for r in range(rounds):
+            order = ["serialized", "pipelined"] if r % 2 == 0 \
+                else ["pipelined", "serialized"]
+            qps = {}
+            for m in order:
+                qps[m] = leaf_loop(leaf_eng[m], 4000 + r * 37)
+            leaf_ratios.append(qps["pipelined"] / qps["serialized"])
+            leaf_s_all.append(qps["serialized"])
+            leaf_p_all.append(qps["pipelined"])
+        leaf_retraces = _kernels.trace_count() - leaf_retrace0
+
+        # -- sub-leg 2: end-to-end MSE join through the clusters -------
+        for c in (serial, pipe):
+            for j in range(3):
+                resp = c.query(sql_for(j))
+                assert not resp.exceptions, resp.exceptions
+        steady_warm(lambda w: closed_window(serial, 5000 + w * 61))
+        steady_warm(lambda w: closed_window(pipe, 5000 + w * 61))
+
+        ratios, qps_s_all, qps_p_all, p50_deltas = [], [], [], []
+        retrace0 = _kernels.trace_count()
+        for r in range(rounds):
+            if r % 2 == 0:
+                qps_s = closed_window(serial, 10_000 + r * 37)
+                qps_p = closed_window(pipe, 10_000 + r * 37)
+            else:
+                qps_p = closed_window(pipe, 10_000 + r * 37)
+                qps_s = closed_window(serial, 10_000 + r * 37)
+            ratios.append(qps_p / qps_s)
+            qps_s_all.append(qps_s)
+            qps_p_all.append(qps_p)
+            iters = 4 if smoke else 10
+            p50_s = single_p50(serial, 20_000 + r * 53, iters)
+            p50_p = single_p50(pipe, 20_000 + r * 53, iters)
+            p50_deltas.append(p50_p - p50_s)
+        retraces = _kernels.trace_count() - retrace0
+    finally:
+        gc.enable()
+        serial.stop()
+        pipe.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    platform = jax.devices()[0].platform
+    leaf_speedup = stats.median(leaf_ratios)
+    e2e_speedup = stats.median(ratios)
+    min_leaf = 2.0 if platform != "cpu" else 1.5
+    leg = {
+        "clients": clients,
+        "window_s": window_s,
+        "rounds": rounds,
+        "num_segments": num_segments,
+        "docs_per_segment": docs,
+        "platform": platform,
+        "leaf_qps_serialized": round(stats.median(leaf_s_all), 1),
+        "leaf_qps_factory_batched": round(stats.median(leaf_p_all), 1),
+        "leaf_speedup": round(leaf_speedup, 2),
+        "leaf_round_ratios": [round(x, 2) for x in leaf_ratios],
+        "leaf_retraces_steady": leaf_retraces,
+        "e2e_qps_serialized": round(stats.median(qps_s_all), 1),
+        "e2e_qps_factory_batched": round(stats.median(qps_p_all), 1),
+        "e2e_speedup": round(e2e_speedup, 2),
+        "e2e_round_ratios": [round(x, 2) for x in ratios],
+        "e2e_p50_single_paired_delta_ms": round(
+            stats.median(p50_deltas), 3),
+        "e2e_retraces_steady": retraces,
+        "asserted": {
+            "min_leaf_qps_speedup": min_leaf,
+            "min_e2e_qps_speedup": (2.0 if platform != "cpu"
+                                    else "report-only (host-bound "
+                                         "stand-in; no-regression "
+                                         "asserted)"),
+            "max_steady_retraces": 0,
+            "qps_bar_note": ("leaf layer: 2.0 on accelerators, 1.5 "
+                             "structural floor on the CPU stand-in; "
+                             "e2e gated on accelerators only — the "
+                             "GIL-bound stand-in is host-bound at ~9 "
+                             "core-ms/query (see docstring)"),
+            "full_mode_only": smoke},
+    }
+    if not smoke:
+        assert leaf_speedup >= min_leaf, \
+            f"factory-batched MSE leaf dispatch {leaf_speedup:.2f}x < " \
+            f"{min_leaf}x over serialized"
+        if platform != "cpu":
+            assert e2e_speedup >= 2.0, \
+                f"end-to-end MSE join speedup {e2e_speedup:.2f}x < 2x"
+        else:
+            assert e2e_speedup >= 0.9, \
+                f"end-to-end MSE join REGRESSED {e2e_speedup:.2f}x"
+        assert leaf_retraces == 0 and retraces == 0, \
+            f"steady-state retraces on the MSE leaf path " \
+            f"(leaf={leaf_retraces}, e2e={retraces})"
+    return leg
+
+
+def mse_main(smoke: bool = False, out_path: str = None):
     """--mse [--smoke]: MSE reliability + stage-cache A/B (ISSUE 7).
 
     Chaos-off join/window workload through a real MiniCluster (TCP
@@ -744,6 +1079,12 @@ def mse_main(smoke: bool = False):
        stage-plan fingerprint) key removes nearly the whole leaf cost.
        Cold clears the stage caches each iteration. Asserts >=1.5x
        warm-over-cold in full mode.
+    3. **Factory-batched leaf throughput** (ISSUE 10, `throughput` key)
+       — 8-client closed loop of fingerprint-equal MSE joins, pipelined
+       (leaf stages coalesce through the unified kernel factory) vs
+       serialized leaf dispatch, order-alternating windows with
+       median-of-paired-ratios + paired single-query p50 + a zero
+       steady-state retrace guard; see _mse_throughput_leg.
 
     Writes BENCH_mse.json. --smoke shrinks data + iterations and skips
     the ratio asserts (timings are noise at smoke scale)."""
@@ -890,6 +1231,9 @@ def mse_main(smoke: bool = False):
         gc.enable()
         cluster.stop()
 
+    # -- 4. factory-batched vs serialized leaf dispatch (ISSUE 10) ------
+    throughput = _mse_throughput_leg(smoke=smoke)
+
     out = {
         "metric": "mse_deadline_overhead_pct",
         "value": round(overhead_pct, 3),
@@ -906,11 +1250,14 @@ def mse_main(smoke: bool = False):
         "num_segments": num_segments,
         "docs_per_segment": docs,
         "smoke": smoke,
+        "throughput": throughput,
         "asserted": {"max_overhead_pct": 2.0, "min_cache_speedup": 1.5,
                      "full_mode_only": smoke},
     }
-    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                           "BENCH_mse.json"), "w") as f:
+    if out_path is None:
+        out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "BENCH_mse.json")
+    with open(out_path, "w") as f:
         json.dump(out, f, indent=2)
     print(json.dumps(out))
     if not smoke:
@@ -1275,6 +1622,7 @@ def batching_main(smoke: bool = False, out_path: str = None):
         guard = dispatch_mod._CPU_COLLECTIVE_LOCK if lead.collective \
             else contextlib.nullcontext()
         b = 2
+        n_uniq = len({ln.cols_key for ln in launches})
         while b <= max(2, dispatch_mod._pow2(clients)):
             variants = [False] + ([True] if len(launches) > 1 else [])
             for stacked in variants:
@@ -1293,6 +1641,24 @@ def batching_main(smoke: bool = False, out_path: str = None):
                         jax.block_until_ready(kern(
                             lead.cols, (lead.params,) * b, lead.num_docs,
                             D=lead.D, G=lead.G))
+            # same-cols member-grouped (dedup) variants: a stacked batch
+            # with duplicate tables dedups its stack, keyed (plan, B, U)
+            # — warm every U bucket a b-member batch over these tables
+            # can produce so the measured window compiles nothing
+            if lead.dedup_factory is not None and len(launches) > 1:
+                u = 1
+                while u <= dispatch_mod._pow2(min(b, n_uniq)):
+                    kern = lead.dedup_factory(b, u)
+                    uniqs = [launches[i % len(launches)]
+                             for i in range(u)]
+                    idx = np.zeros(b, np.int32)
+                    with guard:
+                        jax.block_until_ready(kern(
+                            tuple(m.cols for m in uniqs),
+                            (lead.params,) * b,
+                            tuple(m.num_docs for m in uniqs),
+                            idx, D=lead.D, G=lead.G))
+                    u *= 2
             b *= 2
 
     def closed_window(jobs, window_s):

@@ -48,7 +48,8 @@ class MiniClusterServer:
             make_scan_fn(self.data_manager, engine_fn=engine_fn),
             leaf_query_fn=make_leaf_query_fn(self.data_manager, engine_fn),
             stage_cache=stage_cache,
-            segment_versions_fn=make_segment_versions_fn(self.data_manager))
+            segment_versions_fn=make_segment_versions_fn(self.data_manager),
+            config=config)
 
     def start(self) -> None:
         self.transport.start()
@@ -56,6 +57,8 @@ class MiniClusterServer:
 
     def stop(self) -> None:
         self.mse_worker.stop()
+        if self.mse_worker.stage_cache is not None:
+            self.mse_worker.stage_cache.close()
         self.transport.stop()
         self.data_manager.shutdown()
         self.executor.segment_cache.close()
@@ -135,6 +138,8 @@ class MiniCluster:
                 "pinot.server.segment.cache.remote.address": address,
                 "pinot.broker.result.cache.backend": "tiered",
                 "pinot.broker.result.cache.remote.address": address,
+                "pinot.server.mse.stage.cache.backend": "tiered",
+                "pinot.server.mse.stage.cache.remote.address": address,
             }
         if overrides:
             config = (config or PinotConfiguration()).with_overrides(overrides)
@@ -154,6 +159,9 @@ class MiniCluster:
         self._table_meta: Dict[str, dict] = {}
         #: logical table -> tenant tag, replayed onto brokers at start()
         self._tenants: Dict[str, str] = {}
+        #: table -> (segment-version token, (workers, peers)) memo for
+        #: the MSE placement walk (see _mse_placement)
+        self._mse_placement_memo: Dict[str, tuple] = {}
         #: opt-in tier-1 broker result cache (cache/broker_cache.py)
         self._result_cache_enabled = result_cache
         # -- minion task fabric (ISSUE 5) ------------------------------
@@ -200,7 +208,8 @@ class MiniCluster:
             workers={s.instance_id: s.mse_worker for s in self.servers},
             catalog_fn=self._catalog,
             table_workers_fn=self._table_workers,
-            config=self.config)
+            config=self.config,
+            hedge_peers_fn=self._mse_hedge_peers)
         # N broker replicas over the SAME routing view and server
         # connections — each with its own (L1) result cache, sharing L2
         # through the cache server when one is running
@@ -288,18 +297,89 @@ class MiniCluster:
                     type(tdm).release_all(sdms)
         return cat
 
-    def _table_workers(self, table: str):
-        """Servers hosting at least one segment of the (logical) table."""
-        out = []
+    def _mse_placement(self, table: str):
+        """(leaf workers, peers) for a logical table: servers with an
+        IDENTICAL local segment view collapse to one leaf worker (each
+        MSE leaf instance scans its WHOLE local view, so routing two
+        full replicas would double every row) and the collapsed twins
+        become that worker's hedge peers — re-issuing the stage there
+        is row-identical by construction.
+
+        Memoized on the hosting tables' segment-set VERSIONS (bumped by
+        every add/remove), so the per-query dispatch path pays a few
+        integer reads, not a full-cluster segment walk; a host whose
+        table is registered but EMPTY still counts as a worker (its
+        leaf scans nothing — an empty result, not a routing error)."""
         wanted = (table, table + "_OFFLINE", table + "_REALTIME")
+        token = []
         for s in self.servers:
             for phys in s.data_manager.table_names:
                 if phys in wanted:
-                    out.append(s.instance_id)
-                    break
-        if not out:
+                    tdm = s.data_manager.table(phys, create=False)
+                    token.append((s.instance_id, phys, tdm.version))
+        token = tuple(token)
+        alive_by_id = {s.instance_id: s.mse_worker.alive
+                       for s in self.servers}
+        memo = self._mse_placement_memo.get(table)
+        if memo is not None and memo[0] == token \
+                and all(alive_by_id.get(w) for w in memo[1][0]):
+            return memo[1]
+        views = []
+        alive = alive_by_id
+        for s in self.servers:
+            names = set()
+            hosts = False
+            for phys in s.data_manager.table_names:
+                if phys not in wanted:
+                    continue
+                hosts = True
+                tdm = s.data_manager.table(phys, create=False)
+                sdms = tdm.acquire_segments(None)
+                try:
+                    names |= {f"{phys}:{x.segment.name}" for x in sdms}
+                finally:
+                    type(tdm).release_all(sdms)
+            if hosts:
+                views.append((s.instance_id, frozenset(names)))
+        # one representative per distinct view, ALIVE members first: a
+        # chaos-killed representative must not strand its alive twins
+        # behind it (the query routes to a surviving copy; the dead one
+        # simply can't be a hedge target either)
+        by_view: Dict[frozenset, List[str]] = {}
+        for inst, view in views:
+            by_view.setdefault(view, []).append(inst)
+        workers: List[str] = []
+        peers: Dict[str, List[str]] = {}
+        for inst, view in views:  # preserve server order of groups
+            group = by_view[view]
+            if group[0] != inst:
+                continue  # not the group's first member: handled once
+            rep = next((m for m in group if alive[m]), group[0])
+            workers.append(rep)
+            peers[rep] = [m for m in group if m != rep]
+        # aliveness feeds the choice but NOT the memo token (it can
+        # flip without a segment mutation) — so only memoize when every
+        # hosting member is alive; degraded states recompute
+        result = (workers, peers)
+        if all(alive[inst] for inst, _v in views):
+            self._mse_placement_memo[table] = (token, result)
+        else:
+            self._mse_placement_memo.pop(table, None)
+        return result
+
+    def _table_workers(self, table: str):
+        """Servers hosting at least one segment of the (logical) table,
+        full-replica twins collapsed (see _mse_placement)."""
+        workers, _peers = self._mse_placement(table)
+        if not workers:
             raise ValueError(f"no servers host table {table!r}")
-        return out
+        return workers
+
+    def _mse_hedge_peers(self, table: str, instance: str) -> List[str]:
+        """Alternate instances whose local segment view for the table is
+        identical to `instance`'s — the legal stage-hedge targets."""
+        _workers, peers = self._mse_placement(table)
+        return peers.get(instance, [])
 
     # ------------------------------------------------------------------
     def add_table(self, table_name: str, table_type: str = "OFFLINE",

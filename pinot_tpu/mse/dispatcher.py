@@ -160,6 +160,92 @@ class MseQueryTimeout(BrokerTimeoutError):
     """The multi-stage query missed its end-to-end budget."""
 
 
+def _is_leaf_op(op: Dict[str, object]) -> bool:
+    """True when the stage's op tree reads only LOCAL data (no receive
+    anywhere) — the only stages a hedge may re-issue: an intermediate's
+    mailbox frames were addressed to the primary and cannot be replayed."""
+    if op.get("op") == "receive":
+        return False
+    for k in ("child", "left", "right"):
+        child = op.get(k)
+        if isinstance(child, dict) and not _is_leaf_op(child):
+            return False
+    return True
+
+
+class _HedgeBook:
+    """Per-query hedge accounting: which attempts of each (stage,
+    worker-slot) are in flight, and which attempt CLAIMED the output.
+
+    The claim is the dedup: `run_stage` asks before sending, exactly one
+    attempt per slot is granted, so the receiving mailbox sees exactly
+    one EOS per sender slot no matter how many attempts ran. A clean
+    finish claims immediately; an errored attempt is granted only when
+    every other attempt has already errored or finished — a straggling
+    twin might still deliver the rows."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        #: (sid, widx) -> {attempt: instance} still in flight
+        self.pending: Dict[tuple, Dict[int, str]] = {}
+        #: (sid, widx) -> attempt granted the output
+        self.claimed: Dict[tuple, int] = {}
+        #: (sid, widx) -> attempts that reached their error claim
+        self.errored: Dict[tuple, set] = {}
+        #: (sid, widx) -> True once any attempt finished ok
+        self.completed: Dict[tuple, bool] = {}
+        #: keys with a hedge attempt issued
+        self.hedged: set = set()
+
+    def start(self, key: tuple, attempt: int, instance: str) -> None:
+        with self.lock:
+            self.pending.setdefault(key, {})[attempt] = instance
+            if attempt > 0:
+                self.hedged.add(key)
+
+    def finish(self, key: tuple, attempt: int, ok: bool) -> bool:
+        """Returns True when this finish leaves the slot DEAD: every
+        attempt is gone, none completed clean, and no attempt ever
+        claimed the output (so neither rows nor an error frame went
+        out) — e.g. the primary's error claim was denied while the
+        hedge was alive, then the hedge died crash-silent. The caller
+        must abort the query, or the receiver blocks to the deadline."""
+        with self.lock:
+            tracked = key in self.pending  # claim-gated (leaf) slots only
+            self.pending.get(key, {}).pop(attempt, None)
+            if ok:
+                self.completed[key] = True
+            return (tracked and not ok and key not in self.claimed
+                    and not self.pending.get(key)
+                    and not self.completed.get(key))
+
+    def should_hedge(self, key: tuple) -> bool:
+        with self.lock:
+            return (not self.completed.get(key)
+                    and key not in self.claimed
+                    and key not in self.hedged)
+
+    def claim(self, key: tuple, attempt: int, clean: bool):
+        """Returns (granted, loser) — loser is the (attempt, instance)
+        of a still-pending twin the caller should cancel."""
+        with self.lock:
+            got = self.claimed.get(key)
+            if got is not None:
+                return got == attempt, None
+            if not clean:
+                errs = self.errored.setdefault(key, set())
+                errs.add(attempt)
+                others = {a: i for a, i in self.pending.get(key, {}).items()
+                          if a != attempt and a not in errs}
+                if others:
+                    return False, None  # a live twin may still win
+            self.claimed[key] = attempt
+            loser = next(
+                ((a, i) for a, i in self.pending.get(key, {}).items()
+                 if a != attempt), None)
+            return True, loser
+
+
 class QueryDispatcher:
     """Multi-stage query entry point on the broker.
 
@@ -178,7 +264,11 @@ class QueryDispatcher:
                  catalog_fn: Callable[[], Catalog],
                  table_workers_fn: Callable[[str], List[str]],
                  broker_mailbox: Optional[MailboxService] = None,
-                 config=None, enforce_deadlines: bool = True):
+                 config=None, enforce_deadlines: bool = True,
+                 hedge_peers_fn: Optional[
+                     Callable[[str, str], List[str]]] = None):
+        from pinot_tpu.broker.adaptive import AdaptiveServerSelector
+        from pinot_tpu.utils.config import PinotConfiguration
         from pinot_tpu.utils.metrics import get_registry
         self.workers = workers
         self.catalog_fn = catalog_fn
@@ -196,6 +286,28 @@ class QueryDispatcher:
         #: query_id -> cancel fan-out record for in-flight queries
         self._inflight: Dict[str, threading.Event] = {}
         self._inflight_lock = threading.Lock()
+        # -- stage hedging (ISSUE 10) ----------------------------------
+        cfg = config or PinotConfiguration()
+        self.hedge_enabled = cfg.get_bool("pinot.broker.mse.hedge.enabled")
+        self._hedge_delay_min_s = cfg.get_float(
+            "pinot.broker.mse.hedge.delay.min.ms") / 1e3
+        self._hedge_delay_max_s = cfg.get_float(
+            "pinot.broker.mse.hedge.delay.max.ms") / 1e3
+        self._hedge_q = cfg.get_float("pinot.broker.mse.hedge.quantile")
+        #: (table, primary instance) -> alternate instances holding an
+        #: IDENTICAL local segment view — the only legal hedge targets
+        #: for a leaf stage (a different shard would change the rows)
+        self.hedge_peers_fn = hedge_peers_fn
+        #: per-worker STAGE-latency reservoirs (the same
+        #: AdaptiveServerSelector.latency_quantile plumbing the
+        #: single-stage hedged scatter uses): every stage completion
+        #: feeds them, and the hedge delay is the fleet's q-quantile
+        self.stage_latency = AdaptiveServerSelector()
+
+    def _hedge_delay_s(self) -> float:
+        base = self.stage_latency.latency_quantile(self._hedge_q)
+        return min(self._hedge_delay_max_s,
+                   max(self._hedge_delay_min_s, base))
 
     def stop(self) -> None:
         self.mailbox.stop()
@@ -365,16 +477,76 @@ class QueryDispatcher:
 
         plan_json = {"stages": [s.to_json() for s in plan.stages],
                      "options": plan.options}
+        # hedging needs BOTH the knob and a peers resolver: without
+        # hedge_peers_fn no hedge can ever be issued, so the book, the
+        # claim wrapping, and the per-query monitor thread would be
+        # pure overhead
+        book = _HedgeBook() if (
+            self.hedge_enabled and self.hedge_peers_fn is not None) \
+            else None
+        done_event = threading.Event()
+        leaf_sids = {s.stage_id for s in plan.stages[1:]
+                     if _is_leaf_op(s.root)}
+
+        def on_done(inst, sid, widx, attempt, ok, elapsed_s):
+            # per-worker stage-latency reservoirs feed the adaptive
+            # hedge delay whether or not hedging is on (they must be
+            # warm the moment the knob flips). ONLY leaf (hedgeable)
+            # stages feed them: an intermediate's elapsed time is
+            # mostly receive-blocked waiting on its children, i.e.
+            # whole-query latency — pooling it would pin the delay at
+            # the clamp ceiling and fire every hedge far too late
+            if sid in leaf_sids:
+                self.stage_latency.record_end(inst, elapsed_s)
+            if book is not None and book.finish((sid, widx), attempt, ok):
+                # DEAD slot: every attempt of a claim-gated stage died
+                # without sending rows OR an error frame (e.g. denied
+                # error claim + crash-silent twin) — abort the query
+                # now so the receiver fails typed instead of blocking
+                # out the whole deadline
+                self._fan_out_cancel(
+                    qid, f"stage {sid} lost every attempt")
+
+        def make_claim(key, attempt):
+            def claim(clean: bool) -> bool:
+                granted, loser = book.claim(key, attempt, clean)
+                if granted and key in book.hedged:
+                    self._metrics.add_meter(
+                        "mse_stage_hedge_won" if attempt > 0
+                        else "mse_stage_hedge_wasted")
+                if granted and loser is not None:
+                    l_attempt, l_inst = loser
+                    w = self.workers.get(l_inst)
+                    if w is not None:
+                        try:
+                            w.cancel_stage(qid, key[0], attempt=l_attempt)
+                        except Exception:  # noqa: BLE001 — best effort
+                            pass
+                return granted
+            return claim
+
         try:
             for s in plan.stages[1:]:
                 sj = s.to_json()
+                leaf = _is_leaf_op(s.root)
                 for w, inst in enumerate(s.workers):
                     # chaos site: delay/fail the dispatch of one stage
                     fire("mse.dispatch.stage", instance=inst,
                          query_id=qid, stage=s.stage_id)
+                    claim_fn = None
+                    if book is not None and leaf:
+                        book.start((s.stage_id, w), 0, inst)
+                        claim_fn = make_claim((s.stage_id, w), 0)
                     self.workers[inst].submit_stage(
                         qid, plan_json, sj, w, addresses, timeout=timeout,
-                        deadline=deadline)
+                        deadline=deadline, claim_fn=claim_fn,
+                        on_done=on_done)
+            if book is not None:
+                threading.Thread(
+                    target=self._hedge_monitor,
+                    args=(qid, plan, plan_json, addresses, timeout,
+                          deadline, book, done_event, on_done, make_claim),
+                    daemon=True, name=f"mse-hedge-{qid}").start()
 
             ctx = StageContext(
                 query_id=qid, plan=plan, worker_id="broker", worker_idx=0,
@@ -390,6 +562,17 @@ class QueryDispatcher:
                 raise MseQueryTimeout(
                     f"query {qid} missed its {timeout_ms:.0f}ms budget "
                     f"({self._stage_progress(qid)})") from e
+            except MailboxError as e:
+                if deadline is not None and time.time() >= deadline:
+                    # a WORKER's deadline trip propagated as an error
+                    # frame and beat the broker's own wall (a race the
+                    # pipelined chunk cadence retimes): the budget DID
+                    # expire, so answer with the same honest accounting
+                    # as a broker-side miss
+                    raise MseQueryTimeout(
+                        f"query {qid} missed its {timeout_ms:.0f}ms "
+                        f"budget ({self._stage_progress(qid)})") from e
+                raise
             assert block is not None
             return block
         except BaseException:
@@ -400,8 +583,68 @@ class QueryDispatcher:
             self._fan_out_cancel(qid, "query aborted")
             raise
         finally:
+            done_event.set()
             with self._inflight_lock:
                 self._inflight.pop(qid, None)
+
+    def _hedge_monitor(self, qid, plan, plan_json, addresses, timeout,
+                       deadline, book: _HedgeBook, done_event, on_done,
+                       make_claim) -> None:
+        """After the adaptive delay, re-issue every still-straggling LEAF
+        stage instance on an alive peer with an identical local segment
+        view; first clean attempt claims the output, the loser is
+        cancelled through the per-stage cancel (PR 7 fan-out machinery,
+        stage-granular). Best-effort by design: any failure here leaves
+        the primary running untouched."""
+        from pinot_tpu.mse.stage_cache import collect_scan_tables
+        if done_event.wait(self._hedge_delay_s()):
+            return  # query already finished: nothing worth hedging
+        if deadline is not None and time.time() >= deadline:
+            return
+        alive = self._alive_workers()
+        #: (table, instance) -> peers, resolved once per monitor pass —
+        #: hedge_peers_fn may walk cluster placement, so a straggling
+        #: multi-table stage must not re-derive it per slot
+        peer_memo: Dict[tuple, set] = {}
+        for s in plan.stages[1:]:
+            if not _is_leaf_op(s.root):
+                continue
+            tables = collect_scan_tables(s.root)
+            sj = None
+            for w, inst in enumerate(s.workers):
+                key = (s.stage_id, w)
+                if not book.should_hedge(key):
+                    continue
+                peers: Optional[set] = None
+                if self.hedge_peers_fn is not None:
+                    for t in tables:
+                        p = peer_memo.get((t, inst))
+                        if p is None:
+                            p = set(self.hedge_peers_fn(t, inst))
+                            peer_memo[(t, inst)] = p
+                        peers = p if peers is None else peers & p
+                peers = (peers or set()) & set(alive)
+                peers -= set(s.workers)
+                if not peers:
+                    continue
+                target = sorted(peers)[0]
+                try:
+                    # chaos site: the seeded journal decides/records
+                    # whether this hedge fires (same-seed replay is
+                    # byte-identical); an armed error policy aborts
+                    # JUST this hedge — the primary is untouched
+                    fire("mse.stage.hedge", instance=inst,
+                         target=target, query_id=qid, stage=s.stage_id)
+                    book.start(key, 1, target)
+                    self._metrics.add_meter("mse_stage_hedge_issued")
+                    if sj is None:
+                        sj = s.to_json()
+                    alive[target].submit_stage(
+                        qid, plan_json, sj, w, addresses,
+                        timeout=timeout, deadline=deadline, attempt=1,
+                        claim_fn=make_claim(key, 1), on_done=on_done)
+                except Exception:  # noqa: BLE001 — hedge is best effort
+                    book.finish(key, 1, False)
 
 
 def _infer_type(arr: np.ndarray) -> str:

@@ -14,7 +14,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
-from pinot_tpu.mse.blocks import Block
+from pinot_tpu.mse.blocks import Block, _py
 from pinot_tpu.query import transform
 from pinot_tpu.query.aggregation import get_aggregation
 from pinot_tpu.query.expressions import Expression, Function, Identifier
@@ -374,6 +374,169 @@ def final_merge_block(block: Block, num_group_cols: int,
                 merged[g] if merged[g] is not None else fn.identity())
         out.append(finals)
     return Block(schema, out)
+
+
+# ---------------------------------------------------------------------------
+# pipelined (chunk-at-a-time) folds — the incremental twins of
+# aggregate_block / final_merge_block: intermediate stages consume mailbox
+# frames AS THEY ARRIVE (runtime.py chunks sender output and bounds the
+# receive buffer by a watermark), so upstream compute overlaps downstream
+# merge and fan-in no longer serializes on the slowest sender. Correctness
+# rides the same partial/merge contract the two-phase leaf split already
+# uses: per-chunk grouped intermediates merge associatively, and the
+# output re-sorts groups into the barrier path's factorize order so frame
+# ARRIVAL order never leaks into the result row order.
+# ---------------------------------------------------------------------------
+
+def _agg_fns(agg_nodes: Sequence[Function]):
+    fns = []
+    for node in agg_nodes:
+        inner = node.args[0] if node.name == "filter_agg" else node
+        fns.append(get_aggregation(inner.name, inner.args))
+    return fns
+
+
+def _key_obj_columns(keys: List[tuple], nk: int) -> List[np.ndarray]:
+    cols = []
+    for i in range(nk):
+        col = np.empty(len(keys), object)
+        for r, k in enumerate(keys):
+            col[r] = k[i]
+        cols.append(col)
+    return cols
+
+
+def _restore_dtype(col: np.ndarray) -> np.ndarray:
+    """The fold's key columns accumulate as object arrays; restore the
+    numeric dtype the barrier path would have carried (kc[first] keeps
+    eval_expr's dtype) — downstream sorts/joins compare numerically,
+    and a silent object column would string-order 11 before 2."""
+    try:
+        arr = np.asarray(col.tolist())
+        return arr if arr.dtype.kind in "iufb" else col
+    except (ValueError, TypeError):
+        return col
+
+
+def _sorted_group_order(key_cols: List[np.ndarray]) -> np.ndarray:
+    """Row order matching the barrier path's factorize group order
+    (np.unique sorts codes): frame ARRIVAL order must not leak into the
+    output row order, or same-seed replays stop being byte-identical."""
+    codes, _ng, _first = factorize(key_cols)
+    return np.argsort(codes, kind="stable")
+
+
+def _finalize_fold(state: "dict[tuple, list]", fns, nk: int,
+                   schema: List[str]) -> Block:
+    """Shared fold tail: key columns (original dtypes restored) +
+    extract_final per (group, agg), rows in the barrier path's sorted
+    group order."""
+    if not state:
+        return Block.empty(schema)
+    keys = list(state)
+    out = [_restore_dtype(c) for c in _key_obj_columns(keys, nk)]
+    for i, fn in enumerate(fns):
+        col = np.empty(len(keys), object)
+        for r, kt in enumerate(keys):
+            col[r] = fn.extract_final(state[kt][i])
+        out.append(col)
+    order = _sorted_group_order(out[:nk])
+    return Block(schema, [c[order] for c in out])
+
+
+def fold_aggregate_chunks(chunks, group_exprs: Sequence[Expression],
+                          agg_nodes: Sequence[Function],
+                          schema: List[str]) -> Block:
+    """Incremental final aggregation over an iterator of Blocks —
+    result-equivalent to ``aggregate_block(Block.concat(chunks))``."""
+    fns0 = _agg_fns(agg_nodes)
+
+    if not group_exprs:
+        merged = [fn.identity() for fn in fns0]
+        for block in chunks:
+            n = block.num_rows
+            if not n:
+                continue
+            fns, arg_vals, filt_masks = _prepare_aggs(block, agg_nodes)
+            base = np.ones(n, bool)
+            for i, (fn, arg, fmask) in enumerate(
+                    zip(fns, arg_vals, filt_masks)):
+                mask = base if fmask is None else fmask
+                if fn.mv_input and arg is not None:
+                    flat, counts = arg
+                    mask = np.repeat(mask, counts)
+                    arg = flat
+                merged[i] = fn.merge(merged[i], fn.aggregate(arg, mask))
+        vals = [fn.extract_final(m) for fn, m in zip(fns0, merged)]
+        return Block(schema, [np.array([v], object) for v in vals])
+
+    state: "dict[tuple, list]" = {}
+    for block in chunks:
+        n = block.num_rows
+        if not n:
+            continue
+        key_cols = [eval_expr(e, block) for e in group_exprs]
+        codes, num_groups, first = factorize(key_cols)
+        fns, arg_vals, filt_masks = _prepare_aggs(block, agg_nodes)
+        base = np.ones(n, bool)
+        per = []
+        for fn, arg, fmask in zip(fns, arg_vals, filt_masks):
+            mask = base if fmask is None else fmask
+            keys = codes
+            if fn.mv_input and arg is not None:
+                flat, counts = arg
+                mask = np.repeat(mask, counts)
+                keys = np.repeat(codes, counts)
+                arg = flat
+            per.append(fn.aggregate_grouped(arg, keys, num_groups, mask))
+        for g in range(num_groups):
+            kt = tuple(_py(kc[first[g]]) for kc in key_cols)
+            cur = state.get(kt)
+            if cur is None:
+                state[kt] = [per[i][g] for i in range(len(fns))]
+            else:
+                for i, fn in enumerate(fns):
+                    cur[i] = fn.merge(cur[i], per[i][g])
+    return _finalize_fold(state, fns0, len(group_exprs), schema)
+
+
+def fold_final_merge_chunks(chunks, num_group_cols: int,
+                            agg_nodes: Sequence[Function],
+                            schema: List[str]) -> Block:
+    """Incremental merge of serialized leaf_agg intermediates —
+    result-equivalent to ``final_merge_block(Block.concat(chunks))``.
+    The per-cell deserialize+merge loop (the dominant intermediate-stage
+    cost on wide fan-in) now runs while later senders still compute."""
+    from pinot_tpu.server.datatable import deserialize_value
+    fns = _agg_fns(agg_nodes)
+
+    if num_group_cols == 0:
+        merged = [fn.identity() for fn in fns]
+        for block in chunks:
+            for i, fn in enumerate(fns):
+                col = block.arrays[i]
+                for r in range(block.num_rows):
+                    merged[i] = fn.merge(merged[i],
+                                         deserialize_value(col[r]))
+        return Block(schema, [np.array([fn.extract_final(m)], object)
+                              for fn, m in zip(fns, merged)])
+
+    state: "dict[tuple, list]" = {}
+    for block in chunks:
+        n = block.num_rows
+        if not n:
+            continue
+        kcols = block.arrays[:num_group_cols]
+        acols = block.arrays[num_group_cols:num_group_cols + len(fns)]
+        for r in range(n):
+            kt = tuple(_py(kc[r]) for kc in kcols)
+            cur = state.get(kt)
+            if cur is None:
+                state[kt] = [deserialize_value(ac[r]) for ac in acols]
+            else:
+                for i, fn in enumerate(fns):
+                    cur[i] = fn.merge(cur[i], deserialize_value(acols[i][r]))
+    return _finalize_fold(state, fns, num_group_cols, schema)
 
 
 # ---------------------------------------------------------------------------

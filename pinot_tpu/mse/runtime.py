@@ -12,6 +12,7 @@ from __future__ import annotations
 import threading
 import time
 from collections import OrderedDict
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
@@ -40,7 +41,10 @@ class StageContext:
                  timeout: float = 60.0, leaf_query_fn=None,
                  deadline: Optional[float] = None,
                  cancel_event: Optional[threading.Event] = None,
-                 stage_cache=None, segment_versions_fn=None):
+                 stage_cache=None, segment_versions_fn=None,
+                 stage_id: int = -1, attempt: int = 0, claim_fn=None,
+                 pipeline: bool = True, chunk_rows: int = 8192,
+                 watermark_rows: int = 8192):
         self.query_id = query_id
         self.plan = plan
         self.worker_id = worker_id
@@ -50,6 +54,23 @@ class StageContext:
         self.addresses = addresses
         self.scan_fn = scan_fn
         self.timeout = timeout
+        #: which stage instance this context runs (hedge cancel targets
+        #: one (query, stage, attempt), never the whole query)
+        self.stage_id = stage_id
+        #: 0 = primary, >0 = hedge re-issue of the same stage instance
+        self.attempt = attempt
+        #: hedge output claim: claim_fn(clean) -> bool decides whether
+        #: THIS attempt may send its output (exactly one attempt per
+        #: (query, stage, worker-slot) is granted — mailbox-level dedup
+        #: by construction). None = unhedged, always send.
+        self.claim_fn = claim_fn
+        #: pipelined intermediate stages (ISSUE 10): senders chunk
+        #: output into <= chunk_rows frames; fold-capable receivers
+        #: merge frames as they arrive, buffering at most
+        #: watermark_rows decoded rows between folds
+        self.pipeline = pipeline
+        self.chunk_rows = max(1, int(chunk_rows))
+        self.watermark_rows = max(1, int(watermark_rows))
         #: (table, QueryContext) -> per-segment SegmentResults via the
         #: single-stage executor (TPU engine included) — the
         #: LeafStageTransferableBlockOperator bridge; None on the broker
@@ -109,6 +130,11 @@ def run_stage(ctx: StageContext, stage: StagePlan) -> Optional[Block]:
                 if stage.receiver_stage < 0:
                     raise
                 return None
+            if ctx.claim_fn is not None and not ctx.claim_fn(False):
+                # hedged attempt failed while its twin is still running:
+                # die silently — the twin owns the output slot (or will
+                # claim the error itself if it is the last one standing)
+                return None
             _propagate_error(ctx, stage, f"{type(e).__name__}: {e}")
             if stage.receiver_stage < 0:
                 raise
@@ -117,6 +143,10 @@ def run_stage(ctx: StageContext, stage: StagePlan) -> Optional[Block]:
             return block
         if ctx.worker_crashed:
             return None  # computed past the crash: output dies with us
+        if ctx.claim_fn is not None and not ctx.claim_fn(True):
+            # the twin attempt already claimed this (query, stage, slot)
+            # and sent; sending too would double the receiver's rows
+            return None
         _send_output(ctx, stage, block)
         return None
     finally:
@@ -176,9 +206,21 @@ def _send_output(ctx: StageContext, stage: StagePlan, block: Block) -> None:
                           stage.receiver_stage, w)
         addr = ctx.addresses[f"{stage.receiver_stage}:{w}"]
         part = parts[w]
-        payload = part.to_bytes() if part is not None and part.num_rows \
-            else b""
-        ctx.mailbox.send(addr, key, payload, FLAG_EOS)
+        if part is None or not part.num_rows:
+            ctx.mailbox.send(addr, key, b"", FLAG_EOS)
+            continue
+        # pipelined sends: a large partition ships as <= chunk_rows
+        # frames (EOS rides the last) so a fold-capable receiver merges
+        # the head of this output while the tail is still serializing —
+        # and while SLOWER sibling senders are still computing
+        chunk = ctx.chunk_rows if ctx.pipeline else part.num_rows
+        n = part.num_rows
+        starts = list(range(0, n, chunk))
+        for i, s in enumerate(starts):
+            piece = part if len(starts) == 1 else \
+                part.take(np.arange(s, min(s + chunk, n)))
+            flags = FLAG_EOS if i == len(starts) - 1 else 0
+            ctx.mailbox.send(addr, key, piece.to_bytes(), flags)
 
 
 # ---------------------------------------------------------------------------
@@ -213,19 +255,32 @@ def _run_op(ctx: StageContext, op: Dict[str, Any]) -> Block:
             exprs_from_json(op["leftKeys"]), exprs_from_json(op["rightKeys"]),
             expr_from_json(op["residual"]), op["schema"])
     if kind == "aggregate":
-        child = _run_op(ctx, op["child"])
         from pinot_tpu.query.expressions import Function
-        aggs = [a for a in exprs_from_json(op["aggNodes"])]
-        return ops.aggregate_block(
-            child, exprs_from_json(op["groupExprs"]),
-            [a for a in aggs if isinstance(a, Function)], op["schema"])
+        aggs = [a for a in exprs_from_json(op["aggNodes"])
+                if isinstance(a, Function)]
+        groups = exprs_from_json(op["groupExprs"])
+        if ctx.pipeline and op["child"]["op"] == "receive":
+            # pipelined fan-in: fold shuffled frames as they arrive
+            # instead of barriering on receive_all — the merge of early
+            # senders' rows overlaps the slowest sender's compute
+            return ops.fold_aggregate_chunks(
+                _watermarked(ctx, _receive_chunks(ctx, op["child"])),
+                groups, aggs, op["schema"])
+        child = _run_op(ctx, op["child"])
+        return ops.aggregate_block(child, groups, aggs, op["schema"])
     if kind == "leaf_agg":
         return _op_leaf_agg(ctx, op)
     if kind == "final_agg":
+        aggs = exprs_from_json(op["aggNodes"])
+        if ctx.pipeline and op["child"]["op"] == "receive":
+            # the per-cell deserialize+merge loop dominates wide fan-in;
+            # folding it per arriving frame overlaps upstream leaf_agg
+            return ops.fold_final_merge_chunks(
+                _watermarked(ctx, _receive_chunks(ctx, op["child"])),
+                op["numGroups"], aggs, op["schema"])
         child = _run_op(ctx, op["child"])
         return ops.final_merge_block(
-            child, op["numGroups"], exprs_from_json(op["aggNodes"]),
-            op["schema"])
+            child, op["numGroups"], aggs, op["schema"])
     if kind == "sort":
         child = _run_op(ctx, op["child"])
         return ops.sort_block(child, exprs_from_json(op["keys"]),
@@ -257,7 +312,10 @@ def _receive_keys(ctx: StageContext, op: Dict[str, Any]) -> List[str]:
     return out
 
 
-def _op_receive(ctx: StageContext, op: Dict[str, Any]) -> Block:
+def _receive_chunks(ctx: StageContext, op: Dict[str, Any]):
+    """Yield decoded Blocks for a receive op IN ARRIVAL ORDER — the
+    pipelined consumption primitive (fold-capable parents merge each
+    chunk while remaining senders still compute)."""
     sender = ctx.plan.stage(op["stage"])
     key = mailbox_key(ctx.query_id, sender.stage_id,
                       sender.receiver_stage, ctx.worker_idx)
@@ -268,18 +326,41 @@ def _op_receive(ctx: StageContext, op: Dict[str, Any]) -> Block:
         ctx.addresses[f"{sender.stage_id}:{w}"]
         for w in range(len(sender.workers))
         if f"{sender.stage_id}:{w}" in ctx.addresses]
-    blocks = []
     for p in ctx.mailbox.receive_all(
             key, num_senders=len(sender.workers), timeout=ctx.timeout,
             deadline=ctx.deadline, cancel_event=ctx.cancel_event,
             sender_addresses=sender_addresses):
         try:
-            blocks.append(Block.from_bytes(p))
+            b = Block.from_bytes(p)
         except Exception as e:  # noqa: BLE001 — torn/corrupt frame
             raise MailboxError(
                 f"mailbox {key}: undecodable frame "
                 f"({type(e).__name__}: {e})") from e
-    blocks = [b for b in blocks if b.num_rows]
+        if b.num_rows:
+            yield b
+
+
+def _watermarked(ctx: StageContext, chunks):
+    """Re-chunk an arriving Block stream at the pipeline watermark: at
+    most ``watermark_rows`` decoded rows sit buffered between folds (the
+    fold's working-set bound), while tiny frames batch up so the
+    per-fold fixed cost amortizes. Polls the deadline/cancel between
+    chunks — a long stream can't outlive its budget unnoticed."""
+    buf: List[Block] = []
+    buffered = 0
+    for b in chunks:
+        ctx.check()
+        buf.append(b)
+        buffered += b.num_rows
+        if buffered >= ctx.watermark_rows:
+            yield Block.concat(buf)
+            buf, buffered = [], 0
+    if buf:
+        yield Block.concat(buf)
+
+
+def _op_receive(ctx: StageContext, op: Dict[str, Any]) -> Block:
+    blocks = list(_receive_chunks(ctx, op))
     if not blocks:
         return _typed_empty(op["schema"])
     return Block.concat(blocks)
@@ -342,15 +423,9 @@ def _leaf_chain_map(op: Dict[str, Any]):
     return None
 
 
-def _key_columns(keys: List[tuple], nk: int) -> List[np.ndarray]:
-    """Transpose group-key tuples into per-column object arrays."""
-    cols = []
-    for i in range(nk):
-        col = np.empty(len(keys), object)
-        for r_i, k in enumerate(keys):
-            col[r_i] = k[i]
-        cols.append(col)
-    return cols
+#: group-key tuples -> per-column object arrays (shared with the
+#: pipelined folds — one transpose implementation, not two)
+_key_columns = ops._key_obj_columns
 
 
 def _substitute(e, m):
@@ -460,12 +535,33 @@ class MseWorker:
 
     def __init__(self, instance_id: str, scan_fn: Optional[ScanFn],
                  leaf_query_fn=None, stage_cache=None,
-                 segment_versions_fn=None):
+                 segment_versions_fn=None, config=None):
+        from pinot_tpu.utils.config import PinotConfiguration
+        cfg = config or PinotConfiguration()
         self.instance_id = instance_id
         self.scan_fn = scan_fn
         self.leaf_query_fn = leaf_query_fn
         self.mailbox = MailboxService(instance_id)
         self._lock = threading.Lock()
+        #: pipelined intermediate stages (chunked sends + incremental
+        #: folds); see pinot.server.mse.pipeline.* in utils/config.py
+        self.pipeline = cfg.get_bool("pinot.server.mse.pipeline.enabled")
+        self.chunk_rows = cfg.get_int("pinot.server.mse.pipeline.chunk.rows")
+        self.watermark_rows = cfg.get_int(
+            "pinot.server.mse.pipeline.watermark.rows")
+        #: per-query parsed-plan memo: a query's N stage submits share
+        #: ONE QueryPlan parse instead of re-deserializing every stage
+        #: of the plan N times (a measurable slice of MSE host cost on
+        #: multi-stage plans); bounded FIFO keyed by query id
+        self._plan_memo: "OrderedDict[str, QueryPlan]" = OrderedDict()
+        #: stage execution pool: stages REUSE idle threads instead of
+        #: paying a fresh thread spawn per stage instance. The cap is
+        #: deliberately enormous — receive ops BLOCK on producer stages,
+        #: so a tight pool would deadlock once every worker holds a
+        #: receive-blocked instance; 512 is "unbounded" for any real
+        #: stage tree while still recycling threads in the steady state
+        self._stage_pool = ThreadPoolExecutor(
+            max_workers=512, thread_name_prefix=f"mse-{instance_id}")
         #: leaf-stage output cache + its version-set provider (may be None)
         self.stage_cache = stage_cache
         self.segment_versions_fn = segment_versions_fn
@@ -485,6 +581,7 @@ class MseWorker:
 
     def stop(self) -> None:
         self.mailbox.stop()
+        self._stage_pool.shutdown(wait=False)
 
     @property
     def alive(self) -> bool:
@@ -498,15 +595,42 @@ class MseWorker:
                      stage_json: Dict[str, Any], worker_idx: int,
                      addresses: Dict[str, str],
                      timeout: float = 60.0,
-                     deadline: Optional[float] = None) -> None:
+                     deadline: Optional[float] = None,
+                     attempt: int = 0, claim_fn=None,
+                     on_done=None) -> None:
         """Async: schedule one stage instance on the pool. ``deadline``
         is the query's absolute wall-clock budget (travels with the
-        stage; enforced cooperatively and on every mailbox wait)."""
+        stage; enforced cooperatively and on every mailbox wait).
+        ``attempt``/``claim_fn``: hedge re-issues of a stage instance
+        carry attempt > 0 and an output claim (runtime.run_stage sends
+        only when the claim grants). ``on_done(instance, stage_id,
+        worker_idx, attempt, ok, elapsed_s)`` fires when the stage
+        finishes OR is rejected/doomed — it is the dispatcher-side
+        control-plane observer, so even a crashed worker's attempts
+        report (data-plane silence — no frames — is unaffected): a
+        leaked 'pending' attempt would make the hedge book hold a
+        twin's error claim forever and turn a fast failure into a
+        full-deadline hang."""
+        def _reject():
+            if on_done is not None:
+                try:
+                    on_done(self.instance_id, stage_json["stageId"],
+                            worker_idx, attempt, False, 0.0)
+                except Exception:  # noqa: BLE001 — observer only
+                    pass
+
         if self.crashed:
-            return  # a vanished worker accepts nothing
-        plan = QueryPlan(
-            stages=[StagePlan.from_json(s) for s in plan_json["stages"]],
-            options=plan_json.get("options", {}))
+            return _reject()  # a vanished worker accepts nothing
+        with self._lock:
+            plan = self._plan_memo.get(query_id)
+            if plan is None:
+                plan = QueryPlan(
+                    stages=[StagePlan.from_json(s)
+                            for s in plan_json["stages"]],
+                    options=plan_json.get("options", {}))
+                self._plan_memo[query_id] = plan
+                while len(self._plan_memo) > 256:
+                    self._plan_memo.popitem(last=False)
         stage = StagePlan.from_json(stage_json)
         ctx = StageContext(
             query_id=query_id, plan=plan, worker_id=self.instance_id,
@@ -514,7 +638,10 @@ class MseWorker:
             addresses=addresses, scan_fn=self.scan_fn, timeout=timeout,
             leaf_query_fn=self.leaf_query_fn, deadline=deadline,
             stage_cache=self.stage_cache,
-            segment_versions_fn=self.segment_versions_fn)
+            segment_versions_fn=self.segment_versions_fn,
+            stage_id=stage.stage_id, attempt=attempt, claim_fn=claim_fn,
+            pipeline=self.pipeline, chunk_rows=self.chunk_rows,
+            watermark_rows=self.watermark_rows)
         # memo check + registration are atomic with cancel(): either the
         # cancel sees this context in _active, or this check sees the
         # cancelled memo — a late stage can never slip between them
@@ -524,6 +651,8 @@ class MseWorker:
             self._active.setdefault(query_id, []).append(ctx)
 
         def _run():
+            t0 = time.time()
+            ok = False
             try:
                 # chaos kill site: SimulatedCrash here (or anywhere in
                 # the stage, incl. a mid-shuffle mailbox send) makes the
@@ -531,6 +660,7 @@ class MseWorker:
                 fire("mse.worker.crash", instance=self.instance_id,
                      query_id=query_id, stage=stage.stage_id)
                 run_stage(ctx, stage)
+                ok = True
             except SimulatedCrash:
                 # the whole worker vanishes, not just this stage: flag
                 # death first (submit_stage starts rejecting), abort
@@ -558,14 +688,35 @@ class MseWorker:
                             pass
                         if not ctxs:
                             del self._active[query_id]
+                # reported even on a chaos crash: the observer is
+                # control-plane (the worker's DATA-plane silence — no
+                # error frames — is what the crash semantics require)
+                if on_done is not None:
+                    try:
+                        on_done(self.instance_id, stage.stage_id,
+                                worker_idx, attempt,
+                                ok and not self.crashed,
+                                time.time() - t0)
+                    except Exception:  # noqa: BLE001 — observer only
+                        pass
 
-        # one thread per stage instance: receive ops BLOCK on producer
-        # stages, so a bounded pool would deadlock once every thread holds
-        # a receive-blocked instance (e.g. deep join trees / concurrency)
-        threading.Thread(
-            target=_run, daemon=True,
-            name=f"mse-{self.instance_id}-{query_id}-s{stage.stage_id}",
-        ).start()
+        # one pool slot per stage instance: receive ops BLOCK on
+        # producer stages, so the pool's cap is effectively unbounded
+        # (512 — see __init__); the win over raw Thread() is REUSE:
+        # steady-state stages skip the per-spawn thread start cost
+        try:
+            self._stage_pool.submit(_run)
+        except RuntimeError:  # stopped worker: accepts nothing
+            with self._lock:
+                ctxs = self._active.get(query_id)
+                if ctxs is not None:
+                    try:
+                        ctxs.remove(ctx)
+                    except ValueError:
+                        pass
+                    if not ctxs:
+                        del self._active[query_id]
+            _reject()
 
     def cancel(self, query_id: str, reason: str = "cancelled") -> None:
         """Out-of-band cancel for one query: flags every in-flight stage
@@ -581,6 +732,21 @@ class MseWorker:
         for c in ctxs:
             c.cancel_event.set()
         self.mailbox.abort_query(query_id, reason)
+
+    def cancel_stage(self, query_id: str, stage_id: int,
+                     attempt: Optional[int] = None) -> int:
+        """Stage-granular cancel (the hedge loser path): flags ONLY the
+        matching in-flight stage contexts — no mailbox poisoning, no
+        cancelled-memo, so the query's OTHER stages on this worker keep
+        running and the winner's frames still flow. Returns the number
+        of contexts flagged."""
+        with self._lock:
+            ctxs = [c for c in self._active.get(query_id, ())
+                    if c.stage_id == stage_id
+                    and (attempt is None or c.attempt == attempt)]
+        for c in ctxs:
+            c.cancel_event.set()
+        return len(ctxs)
 
     def active_stages(self, query_id: Optional[str] = None) -> int:
         with self._lock:

@@ -17,9 +17,19 @@ fresh.
 Partials are never cached: the runtime only calls ``put`` after a stage
 completed cleanly inside its deadline — an aborted, errored, or
 deadline-clipped run stores nothing.
+
+L2 sharing (ISSUE 10): ``backend=tiered`` mounts the SAME
+``TieredCache``/ring fabric the result and segment tiers use, so one
+replica's warm leaf output serves the whole fleet — a rolling restart's
+cold replica answers its first leaf stage from the cache server instead
+of rescanning. The key is shareable by construction: segment versions
+here are content CRCs (``segment_version`` of immutable segments), never
+the per-process generation stamps that must stay local, and the payload
+is the typed Block wire serde — never pickle.
 """
 from __future__ import annotations
 
+import hashlib
 import json
 from typing import Any, Dict, Optional, Tuple
 
@@ -45,28 +55,52 @@ def collect_scan_tables(op: Dict[str, Any]) -> Tuple[str, ...]:
     return tuple(out)
 
 
+def remote_stage_key(key: tuple) -> Optional[str]:
+    """Stable wire string for a stage-cache key: the nested version-set
+    tuple + fingerprint hash identically on every replica (names,
+    content CRC versions, canonical-JSON plan), so replicas sharing the
+    same segment view address the same L2 entry."""
+    version_sets, fingerprint = key
+    blob = json.dumps(
+        [[t, [[n, str(v)] for n, v in vs]] for t, vs in version_sets],
+        sort_keys=True, separators=(",", ":")) + "|" + fingerprint
+    return "mse_stage:" + hashlib.sha256(blob.encode()).hexdigest()
+
+
 class StageOutputCache:
     """Leaf-stage output blocks keyed by
     ((table, segment version set)..., stage-plan fingerprint)."""
 
     def __init__(self, max_bytes: int = 64 << 20,
                  ttl_seconds: float = 300.0, enabled: bool = True,
-                 metrics=None, labels: Optional[dict] = None):
+                 metrics=None, labels: Optional[dict] = None,
+                 backend=None):
+        """backend: a pre-assembled byte-payload cache (TieredCache for
+        the L2-shared mount); None = process-local LruTtlCache."""
         self.enabled = enabled
-        self._cache = LruTtlCache(max_bytes, ttl_seconds, metrics=metrics,
-                                  metric_prefix="mse_stage_cache",
-                                  labels=labels)
+        self._cache = backend if backend is not None else LruTtlCache(
+            max_bytes, ttl_seconds, metrics=metrics,
+            metric_prefix="mse_stage_cache", labels=labels)
+        self._metrics = metrics
+        self._labels = labels
 
     @classmethod
     def from_config(cls, config, metrics=None,
                     labels: Optional[dict] = None) -> "StageOutputCache":
+        backend = None
+        if config.get_str(
+                "pinot.server.mse.stage.cache.backend") == "tiered":
+            from pinot_tpu.cache.tiered import tiered_backend_from_config
+            backend = tiered_backend_from_config(
+                config, "pinot.server.mse.stage.cache", "mse_stage_cache",
+                remote_stage_key, metrics=metrics, labels=labels)
         return cls(
             max_bytes=config.get_int("pinot.server.mse.stage.cache.bytes"),
             ttl_seconds=config.get_float(
                 "pinot.server.mse.stage.cache.ttl.seconds"),
             enabled=config.get_bool(
                 "pinot.server.mse.stage.cache.enabled"),
-            metrics=metrics, labels=labels)
+            metrics=metrics, labels=labels, backend=backend)
 
     # ------------------------------------------------------------------
     def key_for(self, stage_root: Dict[str, Any],
@@ -88,23 +122,42 @@ class StageOutputCache:
         return (tuple(version_sets), stage_fingerprint(stage_root))
 
     def get(self, key: Optional[tuple]) -> Optional[Block]:
-        if key is None:
-            return None
-        payload = self._cache.get(key)
-        if payload is None:
-            return None
-        try:
-            return Block.from_bytes(payload)
-        except Exception:  # noqa: BLE001 — undecodable entry = miss
-            return None
+        block, tier = self.get_with_tier(key)
+        if tier == "L2" and self._metrics is not None:
+            # a COLD replica just served another replica's warm leaf
+            # output — the cross-replica sharing signal
+            self._metrics.add_meter("mse_stage_cache_remote_hits",
+                                    labels=self._labels)
+        return block
 
     def put(self, key: Optional[tuple], block: Block) -> bool:
         if key is None:
             return False
         return self._cache.put(key, block.to_bytes())
 
+    def get_with_tier(self, key: Optional[tuple]):
+        """(block, tier) — tier is 'L1' / 'L2' on tiered mounts, 'L1'
+        on local mounts, None on miss (cross-replica hit assertions)."""
+        if key is None:
+            return None, None
+        if hasattr(self._cache, "get_with_tier"):
+            payload, tier = self._cache.get_with_tier(key)
+        else:
+            payload, tier = self._cache.get(key), "L1"
+        if payload is None:
+            return None, None
+        try:
+            return Block.from_bytes(payload), tier
+        except Exception:  # noqa: BLE001 — undecodable entry = miss
+            return None, None
+
     def clear(self) -> None:
         self._cache.clear()
+
+    def close(self) -> None:
+        close = getattr(self._cache, "close", None)
+        if close is not None:
+            close()
 
     @property
     def stats(self):

@@ -182,14 +182,15 @@ class Launch:
     raised error (the ResourceAccountant deadline/cancel checker)."""
 
     __slots__ = ("call", "plan", "cols", "params", "num_docs", "D", "G",
-                 "batch_key", "cols_key", "factory", "collective",
-                 "cancel_check", "site_ctx", "future")
+                 "batch_key", "cols_key", "factory", "dedup_factory",
+                 "collective", "cancel_check", "site_ctx", "future")
 
     def __init__(self, call: Callable[[], Any], plan=None, cols=None,
                  params=None, num_docs=None, D: int = 0, G: int = 0,
                  batch_key: Optional[tuple] = None,
                  cols_key: Optional[tuple] = None,
                  factory: Optional[Callable[[int, bool], Any]] = None,
+                 dedup_factory: Optional[Callable[[int, int], Any]] = None,
                  collective: bool = False,
                  cancel_check: Optional[Callable[[], None]] = None,
                  site_ctx: Optional[Dict[str, Any]] = None):
@@ -203,6 +204,10 @@ class Launch:
         self.batch_key = batch_key
         self.cols_key = cols_key
         self.factory = factory
+        #: optional (B, U) -> kernel for SAME-COLS MEMBER GROUPING in a
+        #: stacked batch: members with identity-equal staged blocks
+        #: share one stack entry (engines that can't dedup leave it None)
+        self.dedup_factory = dedup_factory
         self.collective = collective
         self.cancel_check = cancel_check
         self.site_ctx = site_ctx or {}
@@ -225,8 +230,25 @@ class KernelDispatcher:
         self.mode = cfg.get_str("pinot.server.dispatch.mode") or "pipelined"
         self.ring_size = max(1, cfg.get_int("pinot.server.dispatch.ring.size"))
         self.batch_max = max(1, cfg.get_int("pinot.server.dispatch.batch.max"))
-        self.window_s = max(
-            0.0, cfg.get_float("pinot.server.dispatch.batch.window.ms") / 1e3)
+        # window.ms=auto sizes the coalesce wait from an EWMA of observed
+        # caller inter-arrival times, clamped to [0.5x, 4x] of the static
+        # catalog default: a bursty fleet waits about one inter-arrival
+        # (just long enough for its peers to land), a lone tight-loop
+        # caller converges to the floor — and lone IDLE callers never
+        # consult the window at all (inline fast path)
+        from pinot_tpu.utils.config import KEYS
+        raw_window = cfg.get("pinot.server.dispatch.batch.window.ms")
+        static_s = max(0.0, float(
+            KEYS["pinot.server.dispatch.batch.window.ms"]) / 1e3)
+        self.window_auto = str(raw_window).strip().lower() == "auto"
+        if self.window_auto:
+            self.window_s = static_s
+        else:
+            self.window_s = max(0.0, float(raw_window) / 1e3)
+        self._window_floor_s = 0.5 * static_s
+        self._window_ceil_s = 4.0 * static_s
+        self._arrival_ewma_s: Optional[float] = None
+        self._last_arrival: Optional[float] = None
         self._metrics = metrics if metrics is not None \
             else get_registry("server")
         self._labels = labels
@@ -327,6 +349,29 @@ class KernelDispatcher:
             self._metrics.add_meter("kernel_retrace_by_plan", d,
                                     labels=labels)
 
+    # -- adaptive batching window --------------------------------------
+    def _note_arrival_locked(self) -> None:
+        """EWMA of submit inter-arrival gaps (auto window mode). Gaps
+        past the clamp ceiling are recorded AT the ceiling: idle pauses
+        must not take many queries to forget, only to remember."""
+        if not self.window_auto:
+            return
+        now = time.monotonic()
+        if self._last_arrival is not None:
+            gap = min(now - self._last_arrival, self._window_ceil_s)
+            cur = self._arrival_ewma_s
+            self._arrival_ewma_s = gap if cur is None \
+                else 0.8 * cur + 0.2 * gap
+        self._last_arrival = now
+
+    def current_window_s(self) -> float:
+        """The coalesce wait in effect: static knob, or the clamped
+        inter-arrival EWMA under window.ms=auto."""
+        if not self.window_auto or self._arrival_ewma_s is None:
+            return self.window_s
+        return min(self._window_ceil_s,
+                   max(self._window_floor_s, self._arrival_ewma_s))
+
     # -- submission ----------------------------------------------------
     def submit(self, launch: Launch) -> Future:
         """Enqueue a staged launch; returns its future (an np.ndarray of
@@ -335,6 +380,7 @@ class KernelDispatcher:
         if self.mode == "serialized":
             return self._submit_serialized(launch)
         with self._cv:
+            self._note_arrival_locked()
             idle = (self._active <= 1 and not self._pending
                     and self._inflight == 0)
         if idle:
@@ -474,12 +520,45 @@ class KernelDispatcher:
             # shape bucket (blocks stack along a new leading axis —
             # device-resident rows, never a re-upload)
             stacked = any(it.cols_key != lead.cols_key for it in live)
-            if lead.factory is not None:
+            # same-cols member grouping: members whose staged blocks are
+            # identity-equal (same table/segments, different literals)
+            # share ONE stack entry — a mixed batch of 8 queries over 3
+            # tables stacks 3 column sets, not 8
+            uniq_pos: Dict[tuple, int] = {}
+            for it in live:
+                uniq_pos.setdefault(it.cols_key, len(uniq_pos))
+            dedup = (stacked and lead.dedup_factory is not None
+                     and len(uniq_pos) < len(live))
+            if dedup:
+                kern = lead.dedup_factory(bucket, _pow2(len(uniq_pos)))
+            elif lead.factory is not None:
                 kern = lead.factory(bucket, stacked)
             else:
                 kern = kernels.compiled_batched_kernel(
                     lead.plan, bucket, stacked)
-            if stacked:
+            if dedup:
+                self._metrics.add_meter("dispatch_batch_cross_table",
+                                        len(live), labels=self._labels)
+                self._metrics.add_meter(
+                    "dispatch_batch_dedup", len(live) - len(uniq_pos),
+                    labels=self._labels)
+                by_pos = [None] * len(uniq_pos)
+                for it in live:
+                    p = uniq_pos[it.cols_key]
+                    if by_pos[p] is None:
+                        by_pos[p] = it
+                ubucket = _pow2(len(uniq_pos))
+                upad = ubucket - len(uniq_pos)
+                clist = tuple(it.cols for it in by_pos) \
+                    + (lead.cols,) * upad
+                ndlist = tuple(it.num_docs for it in by_pos) \
+                    + (lead.num_docs,) * upad
+                idx = np.asarray(
+                    [uniq_pos[it.cols_key] for it in live]
+                    + [uniq_pos[lead.cols_key]] * pad, np.int32)
+                call = lambda: kern(clist, plist, ndlist,  # noqa: E731
+                                    idx, D=lead.D, G=lead.G)
+            elif stacked:
                 self._metrics.add_meter("dispatch_batch_cross_table",
                                         len(live), labels=self._labels)
                 clist = tuple(it.cols for it in live) + (lead.cols,) * pad
@@ -520,7 +599,7 @@ class KernelDispatcher:
         batch = [leader]
         if leader.batch_key is None or self.batch_max <= 1:
             return batch
-        deadline = time.monotonic() + self.window_s
+        deadline = time.monotonic() + self.current_window_s()
         with self._cv:
             while True:
                 i = 0
@@ -554,9 +633,23 @@ class KernelDispatcher:
 
     def _finish(self, live: List[Launch], out, batched: bool) -> None:
         """Fetch (device->host) + split per caller; runs OFF the ring.
-        The busy interval (opened at launch) closes when the fetch lands."""
+        The busy interval (opened at launch) closes when the fetch lands
+        — and BEFORE the futures resolve: a caller woken by its result
+        must observe an idle dispatcher, or its next lone submit would
+        race the busy bookkeeping and needlessly take the ring path
+        (the inline fast path is what keeps lone p50 at the floor)."""
         try:
             arr = np.asarray(out)
+        except BaseException as e:  # noqa: BLE001
+            self._busy_end()
+            self._meter_traces()
+            for it in live:
+                if not it.future.done():
+                    it.future.set_exception(e)
+            return
+        self._busy_end()
+        self._meter_traces()
+        try:
             if batched:
                 for member, it in zip(split_packed(arr, len(live)), live):
                     it.future.set_result(member)
@@ -566,6 +659,3 @@ class KernelDispatcher:
             for it in live:
                 if not it.future.done():
                     it.future.set_exception(e)
-        finally:
-            self._busy_end()
-            self._meter_traces()

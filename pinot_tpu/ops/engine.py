@@ -328,11 +328,15 @@ class TpuOperatorExecutor:
                 factory = (lambda B, stacked, _p=plan, _m=self._mesh:
                            kernels.compiled_batched_sharded_kernel(
                                _p, _m, B, stacked))
+                dedup_factory = None  # sharded in_specs are per-member
             else:
                 kernel = kernels.compiled_kernel(plan)
                 batchable = isinstance(kernel, jax.stages.Wrapped)
                 factory = (lambda B, stacked, _p=plan:
                            kernels.compiled_batched_kernel(_p, B, stacked))
+                dedup_factory = (lambda B, U, _p=plan:
+                                 kernels.compiled_batched_dedup_kernel(
+                                     _p, B, U))
             try:
                 cols, params, num_docs, S_real, D, G = self._stage(
                     segments, ctx, plan, batchable=batchable)
@@ -359,7 +363,8 @@ class TpuOperatorExecutor:
             call=lambda: kernel(cols, params, num_docs, D=D, G=G),
             plan=plan, cols=cols, params=params, num_docs=num_docs,
             D=D, G=G, batch_key=batch_key, cols_key=_batch_id(segments),
-            factory=factory, collective=self._needs_cpu_ordering(kernel),
+            factory=factory, dedup_factory=dedup_factory,
+            collective=self._needs_cpu_ordering(kernel),
             cancel_check=cancel_check,
             site_ctx={"table": ctx.table, "mode": "agg"})
         return plan, slots_of_fn, S_real, launch
@@ -457,25 +462,54 @@ class TpuOperatorExecutor:
         return out, remaining
 
     # ------------------------------------------------------------------
-    def _execute_topn(self, segments, ctx: QueryContext, cancel_check=None):
-        if self._doc_axis > 1:
-            return [], segments  # top-K across doc shards: host path
+    def _prepare_topn(self, segments, ctx: QueryContext, cancel_check,
+                      mode: str):
+        """Plan + stage a top-N / doc-id-scan launch THROUGH the kernel
+        factory: the launch carries the same (plan fingerprint, shape
+        bucket) coalesce key as agg launches, so fingerprint-equal MSE
+        leaf SCAN stages (and single-stage selection traffic sharing the
+        plan + bucket) batch into one `jit(vmap)` topn kernel instead of
+        paying one XLA launch per stage per query. Caller must hold no
+        engine state; returns (S_real, Launch) or None -> host path.
+        Must be called with doc_axis == 1 (sharded top-K stays host)."""
         with self._engine_lock:
             plan = self._plan_topn(segments, ctx)
             if plan is None:
-                return [], segments
+                return None
+            kernel = kernels.compiled_topn_kernel(plan)
+            batchable = isinstance(kernel, jax.stages.Wrapped)
             try:
                 cols, params, num_docs, S_real, D, _G = self._stage(
-                    segments, ctx, plan, batchable=False)
+                    segments, ctx, plan, batchable=batchable)
             except _NotStageable:
-                return [], segments
-            kernel = kernels.compiled_topn_kernel(plan)
+                return None
+        batch_key = None
+        if batchable and self._dispatcher.batch_max > 1:
+            if self._cross_table and D <= self._doc_bucket_max:
+                S = int(num_docs.shape[0])
+                batch_key = (plan, S, D, 0, _shape_sig(cols, params))
+            else:
+                batch_key = (plan, _batch_id(segments), D, 0)
+        launch = Launch(
+            call=lambda: kernel(cols, params, num_docs, D=D),
+            plan=plan, cols=cols, params=params, num_docs=num_docs,
+            D=D, G=0, batch_key=batch_key, cols_key=_batch_id(segments),
+            factory=(lambda B, stacked, _p=plan:
+                     kernels.compiled_batched_topn_kernel(_p, B, stacked)),
+            collective=self._needs_cpu_ordering(kernel),
+            cancel_check=cancel_check,
+            site_ctx={"table": ctx.table, "mode": mode})
+        return S_real, launch
+
+    def _execute_topn(self, segments, ctx: QueryContext, cancel_check=None):
+        if self._doc_axis > 1:
+            return [], segments  # top-K across doc shards: host path
+        prep = self._prepare_topn(segments, ctx, cancel_check, "topn")
+        if prep is None:
+            return [], segments
+        S_real, launch = prep
         with self._dispatcher.active():
-            packed = self._dispatcher.submit(Launch(
-                call=lambda: kernel(cols, params, num_docs, D=D),
-                collective=self._needs_cpu_ordering(kernel),
-                cancel_check=cancel_check,
-                site_ctx={"table": ctx.table, "mode": "topn"})).result()
+            packed = self._dispatcher.submit(launch).result()
         return self._assemble_topn(segments, ctx, packed, S_real), []
 
     # ------------------------------------------------------------------
@@ -749,21 +783,16 @@ class TpuOperatorExecutor:
             table="", select=[], aliases=[], distinct=False,
             filter=filter_expr, group_by=[], having=None, order_by=[],
             limit=self.TOPN_MAX_K, offset=0, options={})
-        with self._engine_lock:
-            plan = self._plan_topn(segments, ctx)
-            if plan is None:
-                return nothing
-            try:
-                cols, params, num_docs, S_real, D, _G = self._stage(
-                    segments, ctx, plan, batchable=False)
-            except _NotStageable:
-                return nothing
-            kernel = kernels.compiled_topn_kernel(plan)
+        # the launch rides the kernel factory (batch_key + batched topn
+        # variants), so fingerprint-equal MSE leaf scans from concurrent
+        # queries coalesce into ONE stacked/broadcast topn launch
+        prep = self._prepare_topn(segments, ctx, None, "doc_ids")
+        if prep is None:
+            return nothing
+        S_real, launch = prep
+        plan = launch.plan
         with self._dispatcher.active():
-            packed = self._dispatcher.submit(Launch(
-                call=lambda: kernel(cols, params, num_docs, D=D),
-                collective=self._needs_cpu_ordering(kernel),
-                site_ctx={"mode": "doc_ids"})).result()
+            packed = self._dispatcher.submit(launch).result()
         out = []
         for s, seg in enumerate(segments[:S_real]):
             matched = int(packed[s, 0])
@@ -1489,16 +1518,22 @@ class TpuOperatorExecutor:
             if ctx.aggregations:
                 plan_info = self._plan(segments, ctx)
                 plan = plan_info[0] if plan_info is not None else None
+                kern = None if plan is None \
+                    else (kernels.compiled_sharded_kernel(plan, self._mesh)
+                          if self._doc_axis > 1
+                          else kernels.compiled_kernel(plan))
             else:
                 plan = self._plan_topn(segments, ctx)
+                kern = None if plan is None \
+                    else kernels.compiled_topn_kernel(plan)
             if plan is None:
                 return False
             try:
-                # mirror the serving path's S bucket (agg launches
-                # batch; top-N never does) so warmed blocks are the
-                # EXACT blocks the first routed query will consume
+                # mirror the serving path's S bucket (agg AND top-N
+                # launches ride the factory now) so warmed blocks are
+                # the EXACT blocks the first routed query will consume
                 self._stage(segments, ctx, plan,
-                            batchable=bool(ctx.aggregations))
+                            batchable=isinstance(kern, jax.stages.Wrapped))
             except _NotStageable:
                 return False
         return True

@@ -557,7 +557,8 @@ def _pack_flat(matched, slots):
     return jnp.concatenate(parts, axis=1)
 
 
-def make_topn_kernel(plan: DevicePlan):
+def make_topn_kernel(plan: DevicePlan, kind: str = "topn",
+                     extra: tuple = ()):
     """Selection / selection-order-by kernel (ref
     operator/query/SelectionOrderByOperator + the min/max-based combine):
     per segment, the top-K doc indices by the order value (value_irs[0];
@@ -566,12 +567,15 @@ def make_topn_kernel(plan: DevicePlan):
     Output [S, 1 + K] int32: col 0 = matched doc count, cols 1.. = doc
     indices (-1 = no more matches). The host projects ONLY the winning
     docs — a large filtered SELECT never materializes losing rows.
+
+    kind/extra label this build's trace-log entries (the batched topn
+    factory passes its own kind and batch bucket through).
     """
     fp = plan_fingerprint(plan)
 
     def kernel(cols, params, num_docs, D):
         # body runs at trace time: counts compiles
-        note_trace("topn", fp, (int(num_docs.shape[-1]), D))
+        note_trace(kind, fp, (*extra, int(num_docs.shape[-1]), D))
         valid = jnp.arange(D, dtype=jnp.int32)[None, :] < num_docs[:, None]
         if plan.filter_ir is not None:
             mask = _eval_filter(plan.filter_ir, plan, cols, params) & valid
@@ -784,6 +788,75 @@ def compiled_batched_kernel(plan: DevicePlan, B: int, stacked: bool = False):
     """One jit per (plan, batch-size bucket B, stacked?) — see the
     factory note above. fn(cols|clist, plist, num_docs|ndlist, D, G)."""
     return make_batched_kernel(plan, B, stacked)
+
+
+def make_batched_dedup_kernel(plan: DevicePlan, B: int, U: int):
+    """Stacked-batch variant with SAME-COLS MEMBER GROUPING: members
+    whose staged column blocks are identity-equal (same table/segments,
+    different predicate literals — e.g. two dashboard queries of one
+    fleet landing in the same stacked batch as a third table's) share
+    ONE stack entry instead of re-stacking duplicate [S, D] blocks.
+
+    clist/ndlist carry the U UNIQUE column sets (padded to the pow2 U
+    bucket with the leader's); plist carries all B member params; idx is
+    an int32 [B] member->unique-slot map, a TRACED argument so changing
+    member composition never retraces — jit's cache keys only the
+    (B, U) buckets. Each vmapped member gathers its slot from the
+    stacked uniques (dynamic_index on the leading axis), so device
+    memory holds U copies of the data, not B."""
+    base = make_kernel(plan, kind="batched_dedup", extra=(B, U))
+
+    def fn(clist, plist, ndlist, idx, D, G=0):
+        cs = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *clist)
+        ps = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *plist)
+        ns = jnp.stack(ndlist)
+        return jax.vmap(
+            lambda p, i: base(
+                jax.tree_util.tree_map(lambda c: c[i], cs), p, ns[i],
+                D=D, G=G))(ps, idx)
+
+    return jax.jit(fn, static_argnames=("D", "G"))
+
+
+@functools.lru_cache(maxsize=256)
+def compiled_batched_dedup_kernel(plan: DevicePlan, B: int, U: int):
+    """One jit per (plan, B bucket, U bucket) —
+    fn(clist[U], plist[B], ndlist[U], idx[B], D, G)."""
+    return make_batched_dedup_kernel(plan, B, U)
+
+
+def make_batched_topn_kernel(plan: DevicePlan, B: int,
+                             stacked: bool = False):
+    """The batched factory for top-N / doc-id-scan plans (mode='topn'):
+    MSE leaf SCAN stages resolve their filtered doc ids through this
+    kernel, so fingerprint-equal leaf stages from concurrent MSE queries
+    (and single-stage selection traffic sharing the plan + shape bucket)
+    coalesce into ONE launch exactly like the agg factory — broadcast
+    when every member staged the same column blocks, stacked across
+    tables otherwise. Output [B, S, 1 + K]."""
+    kind = "topn_batched_stacked" if stacked else "topn_batched"
+    base = make_topn_kernel(plan, kind=kind, extra=(B,))
+
+    if stacked:
+        def fn(clist, plist, ndlist, D, G=0):
+            cs = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *clist)
+            ps = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *plist)
+            ns = jnp.stack(ndlist)
+            return jax.vmap(
+                lambda c, p, nd: base(c, p, nd, D=D))(cs, ps, ns)
+    else:
+        def fn(cols, plist, num_docs, D, G=0):
+            ps = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *plist)
+            return jax.vmap(
+                lambda p: base(cols, p, num_docs, D=D))(ps)
+
+    return jax.jit(fn, static_argnames=("D", "G"))
+
+
+@functools.lru_cache(maxsize=256)
+def compiled_batched_topn_kernel(plan: DevicePlan, B: int,
+                                 stacked: bool = False):
+    return make_batched_topn_kernel(plan, B, stacked)
 
 
 def make_batched_sharded_kernel(plan: DevicePlan, mesh, B: int,

@@ -33,7 +33,10 @@ KEYS: Dict[str, Any] = {
     "pinot.server.dispatch.ring.size": 64,      # bounded launch queue
     # micro-batch coalescing: fingerprint-equal concurrent queries merge
     # into one launch within this window (only waited when >1 caller is
-    # active), capped at batch.max per launch
+    # active), capped at batch.max per launch. 'auto' sizes the window
+    # from an EWMA of observed caller inter-arrival times, clamped to
+    # [0.5x, 4x] of the static default below — bursty fleets wait just
+    # long enough for their peers, lone callers converge to the floor
     "pinot.server.dispatch.batch.window.ms": 2.0,
     "pinot.server.dispatch.batch.max": 16,
     # cross-table shape-bucketed batching (the unified kernel factory,
@@ -101,13 +104,42 @@ KEYS: Dict[str, Any] = {
     # pinot.broker.timeout.ms — the budget travels in every stage and is
     # enforced on every mailbox wait ("" = inherit the broker default)
     "pinot.broker.mse.timeout.ms": None,
+    # MSE stage hedging ("The Tail at Scale", MSE edition): after an
+    # adaptive delay — a quantile of the dispatcher's pooled per-worker
+    # STAGE-latency reservoirs, clamped to [delay.min, delay.max] — a
+    # still-running leaf stage instance is re-issued on another alive
+    # worker holding the same local segment view; the first attempt to
+    # finish CLEAN claims the (query, stage, worker-slot) output and
+    # sends, the loser is cancelled and sends nothing (exactly one EOS
+    # per sender slot — no double-merge by construction). Off by
+    # default: it doubles worst-case leaf fan-out.
+    "pinot.broker.mse.hedge.enabled": False,
+    "pinot.broker.mse.hedge.delay.min.ms": 25,
+    "pinot.broker.mse.hedge.delay.max.ms": 1000,
+    "pinot.broker.mse.hedge.quantile": 0.95,
+    # pipelined intermediate stages: senders chunk stage output into
+    # <= chunk.rows frames and fold-capable receivers (aggregate /
+    # final_agg over a receive) merge frames AS THEY ARRIVE instead of
+    # barriering on receive_all — upstream compute overlaps downstream
+    # merge, and fan-in no longer serializes on the slowest sender.
+    # watermark.rows bounds the decoded-but-unfolded buffer (the fold
+    # granularity); enabled=False restores the full-barrier receive.
+    "pinot.server.mse.pipeline.enabled": True,
+    "pinot.server.mse.pipeline.chunk.rows": 8192,
+    "pinot.server.mse.pipeline.watermark.rows": 8192,
     # leaf-stage output cache (mse/stage_cache.py): one worker's whole
     # scan/leaf_agg stage block per (segment version set, stage-plan
     # fingerprint) — epoch-invalidated like the tier-2 partial cache,
-    # never caches partials, and skips tables with a mutable tail
+    # never caches partials, and skips tables with a mutable tail.
+    # backend 'tiered' mounts the shared remote L2 (cache-server role /
+    # ring) under the local tier so ONE replica's warm leaf output
+    # serves the fleet: keys carry content CRC versions (never the
+    # per-process generation stamps), payloads are typed Block serde
     "pinot.server.mse.stage.cache.enabled": True,
     "pinot.server.mse.stage.cache.bytes": 64 << 20,
     "pinot.server.mse.stage.cache.ttl.seconds": 300.0,
+    "pinot.server.mse.stage.cache.backend": "local",
+    "pinot.server.mse.stage.cache.remote.address": "127.0.0.1:9600",
     # negative cache: memoize pruned-to-zero plans (epoch-keyed) so
     # dashboard misfires skip routing + scatter entirely
     "pinot.broker.negative.cache.enabled": True,

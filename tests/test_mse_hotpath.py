@@ -1,0 +1,715 @@
+"""MSE hot-path parity (ISSUE 10): leaf stages through the unified
+kernel factory, pipelined intermediate stages, stage hedging, shared L2
+stage cache.
+
+Pins the tentpole properties deterministically:
+
+  * leaf SCAN batching — `filtered_doc_ids` (the MSE join-input path)
+    rides the kernel factory: fingerprint-equal doc-id scans coalesce
+    into one batched topn launch, bit-identical to per-query execution,
+    with zero steady-state retraces (tier-1 guard); single-stage
+    selection traffic shares the same key space
+  * same-cols member grouping — a stacked batch with duplicate tables
+    stacks one entry per UNIQUE column set (`dispatch_batch_dedup`),
+    bit-identical to per-query execution
+  * adaptive batch-window sizing — window.ms=auto converges to the
+    floor under tight-loop arrivals, the ceiling under sparse ones, and
+    lone callers stay on the inline path (no added p50)
+  * pipelined intermediate stages — chunked frames + incremental folds
+    produce the same rows as the full-barrier receive
+  * stage hedging — a seeded straggling leaf stage is re-issued on a
+    replica peer, the hedge wins within budget, rows are bit-identical
+    to a no-hedge run, and the same-seed decision journal replays
+    byte-identical (`mse.stage.hedge` failpoint site)
+  * L2-shared stage cache — one replica's warm leaf output serves a
+    COLD replica's first leaf stage through the cache server
+"""
+import json
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+import jax
+
+from pinot_tpu.models import (DataType, FieldSpec, FieldType, Schema,
+                              TableConfig, TableType)
+from pinot_tpu.ops import kernels
+from pinot_tpu.ops.dispatch import KernelDispatcher, Launch
+from pinot_tpu.ops.engine import TpuOperatorExecutor
+from pinot_tpu.query.context import QueryContext
+from pinot_tpu.segment.creator import SegmentCreator
+from pinot_tpu.segment.loader import load_segment
+from pinot_tpu.utils.config import PinotConfiguration
+from pinot_tpu.utils.failpoints import FaultSchedule, failpoints
+from pinot_tpu.utils.metrics import get_registry
+
+HOLD_S = 0.3
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoints.clear()
+    yield
+    failpoints.clear()
+
+
+def build_table(tmp_path, name, num_segments, docs, seed):
+    schema = Schema(name, [
+        FieldSpec("d", DataType.INT, FieldType.DIMENSION),
+        FieldSpec("m", DataType.INT, FieldType.METRIC)])
+    tc = TableConfig(name, TableType.OFFLINE)
+    tc.indexing.no_dictionary_columns = ["m"]
+    creator = SegmentCreator(tc, schema)
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(num_segments):
+        cols = {"d": rng.integers(0, 10, docs).astype(np.int32),
+                "m": rng.integers(0, 100, docs).astype(np.int32)}
+        p = str(tmp_path / f"{name}_{i}")
+        creator.build(cols, p, f"{name}_{i}")
+        out.append(load_segment(p))
+    return out
+
+
+@pytest.fixture(scope="module")
+def tables(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("mse_hot")
+    return {
+        "t1": build_table(tmp, "t1", 3, 3000, 1),
+        "t2": build_table(tmp, "t2", 4, 2500, 2),
+        "t3": build_table(tmp, "t3", 3, 3900, 3),
+    }
+
+
+def make_engine(**overrides):
+    return TpuOperatorExecutor(config=PinotConfiguration(overrides=overrides))
+
+
+def _filter(sql_where):
+    return QueryContext.from_sql(
+        f"SELECT COUNT(*) FROM x WHERE {sql_where}").filter
+
+
+def run_concurrent(fn_futs, hold=HOLD_S):
+    """Run thunks concurrently with the dispatch ring held on the first
+    pop so batch composition is deterministic (test_dispatch.py trick)."""
+    failpoints.arm("server.dispatch.before", delay=hold, times=2)
+    try:
+        with ThreadPoolExecutor(len(fn_futs)) as pool:
+            futs = [pool.submit(f) for f in fn_futs]
+            return [f.result() for f in futs]
+    finally:
+        failpoints.disarm("server.dispatch.before")
+
+
+def ids_equal(a, b):
+    assert len(a) == len(b)
+    for x, y in zip(a, b):
+        if x is None or y is None:
+            assert x is None and y is None
+        else:
+            assert np.array_equal(x, y)
+
+
+# ---------------------------------------------------------------------------
+# leaf scans (filtered_doc_ids) through the kernel factory
+# ---------------------------------------------------------------------------
+
+class TestLeafScanFactory:
+    def test_doc_ids_coalesce_bit_identical(self, tables):
+        """Fingerprint-equal doc-id scans over DIFFERENT tables share a
+        stacked topn launch, bit-identical to per-query execution."""
+        eng = make_engine()
+        jobs = [(tables[tn], _filter(f"d < {i + 2} AND m < 90"))
+                for i, tn in enumerate(
+                    ["t1", "t2", "t3", "t1", "t2", "t3"])]
+        singles = [eng.filtered_doc_ids(s, f) for s, f in jobs]
+        reg = eng._dispatcher._metrics
+        m0 = reg.meter("dispatch_batch_cross_table")
+        got = run_concurrent(
+            [lambda s=s, f=f: eng.filtered_doc_ids(s, f)
+             for s, f in jobs])
+        for g, w in zip(got, singles):
+            ids_equal(g, w)
+        assert reg.meter("dispatch_batch_cross_table") > m0, \
+            "leaf doc-id scans never formed a stacked batch"
+
+    def test_doc_ids_property_random_literals(self, tables):
+        """Property: ANY member->table assignment with ANY literal set,
+        coalesced in ANY composition, equals per-query doc ids."""
+        eng = make_engine()
+        rng = np.random.default_rng(7)
+        names = list(tables)
+        for _trial in range(3):
+            k = int(rng.integers(3, 8))
+            picks = [names[j] for j in rng.integers(0, len(names), k)]
+            bounds = rng.integers(0, 100, size=(k, 2))
+            jobs = [(tables[tn],
+                     _filter(f"m BETWEEN {min(a, b)} AND {max(a, b)} "
+                             f"AND d < 8"))
+                    for tn, (a, b) in zip(picks, bounds)]
+            singles = [eng.filtered_doc_ids(s, f) for s, f in jobs]
+            got = run_concurrent(
+                [lambda s=s, f=f: eng.filtered_doc_ids(s, f)
+                 for s, f in jobs])
+            for g, w in zip(got, singles):
+                ids_equal(g, w)
+
+    def test_selection_topn_shares_factory(self, tables):
+        """Single-stage selection traffic batches through the same topn
+        factory (one launch for fingerprint-equal ORDER BY queries)."""
+        eng = make_engine()
+        jobs = [(tables["t1"], QueryContext.from_sql(
+            f"SELECT d, m FROM t1 WHERE m > {i} ORDER BY m DESC LIMIT 5"))
+            for i in range(4)]
+
+        def rows_of(results):
+            return [tuple(map(tuple, r.rows)) for r in results]
+
+        singles = [rows_of(eng.execute(s, c)[0]) for s, c in jobs]
+        got = run_concurrent(
+            [lambda s=s, c=c: eng.execute(s, c) for s, c in jobs])
+        assert all(not rem for _r, rem in got)
+        assert [rows_of(r) for r, _rem in got] == singles
+
+    def test_steady_state_zero_retrace_leaf_scans(self, tables):
+        """Tier-1 guard: warmed MSE leaf doc-id traffic (singles +
+        coalesced batches) compiles NOTHING."""
+        eng = make_engine()
+
+        def round_of(base):
+            jobs = [(tables[tn], _filter(f"d < {base + i}"))
+                    for i, tn in enumerate(
+                        ["t1", "t2", "t3", "t1", "t2", "t3"])]
+            run_concurrent(
+                [lambda s=s, f=f: eng.filtered_doc_ids(s, f)
+                 for s, f in jobs])
+
+        for tn in tables:  # warm singles (stage + compile per table)
+            eng.filtered_doc_ids(tables[tn], _filter("d < 1"))
+        round_of(1)
+        round_of(2)
+        before = kernels.trace_count()
+        round_of(3)
+        round_of(4)
+        for tn in tables:
+            eng.filtered_doc_ids(tables[tn], _filter("d < 5"))
+        assert kernels.trace_count() == before, \
+            "steady-state leaf doc-id scans re-compiled a kernel"
+
+
+def _leaf_agg_ctx(table, where, group=True):
+    """The exact QueryContext shape _leaf_agg_pushdown builds: huge
+    limit + numGroupsLimit, select = groups + aggs."""
+    base = QueryContext.from_sql(
+        f"SELECT {'d, ' if group else ''}SUM(m), COUNT(*) FROM {table} "
+        f"WHERE {where}" + (" GROUP BY d" if group else ""))
+    q = QueryContext(
+        table=table, select=base.select, aliases=[None] * len(base.select),
+        distinct=False, filter=base.filter, group_by=base.group_by,
+        having=None, order_by=[], limit=1 << 31, offset=0,
+        options={"numGroupsLimit": str(1 << 31)})
+    q._extract_aggregations()
+    return q
+
+
+def _agg_values(results):
+    out = []
+    for r in results:
+        if hasattr(r, "groups"):
+            out.append(tuple(sorted(
+                (k, tuple(float(v) for v in inters))
+                for k, inters in r.groups.items())))
+        else:
+            out.append(tuple(float(v) for v in r.intermediates))
+    return tuple(out)
+
+
+class TestMeshLeafProperty:
+    """The doc-sharded mesh leg: MSE leaf_agg pushdown contexts on a
+    (segments x docs) mesh engine batch through vmap-inside-shard_map,
+    bit-identical to per-query execution."""
+
+    @pytest.fixture(scope="class")
+    def mesh_engine(self):
+        from pinot_tpu.parallel.mesh import make_mesh
+        mesh = make_mesh(jax.devices()[:4], doc_axis=2)
+        return TpuOperatorExecutor(mesh=mesh,
+                                   config=PinotConfiguration())
+
+    def test_mse_leaf_property_random_literals_and_tables(
+            self, tables, mesh_engine):
+        eng = mesh_engine
+        rng = np.random.default_rng(17)
+        names = list(tables)
+        for _trial in range(2):
+            k = int(rng.integers(3, 7))
+            picks = [names[j] for j in rng.integers(0, len(names), k)]
+            bounds = rng.integers(0, 100, size=(k, 2))
+            jobs = [(tables[tn], _leaf_agg_ctx(
+                tn, f"m BETWEEN {min(a, b)} AND {max(a, b)}",
+                group=False))
+                for tn, (a, b) in zip(picks, bounds)]
+            singles = [_agg_values(eng.execute(s, c)[0]) for s, c in jobs]
+            got = run_concurrent(
+                [lambda s=s, c=c: eng.execute(s, c) for s, c in jobs])
+            assert all(not rem for _r, rem in got)
+            assert [_agg_values(r) for r, _rem in got] == singles
+
+    def test_single_device_leaf_agg_group_by_property(self, tables):
+        """Same bar for the grouped leaf_agg pushdown shape on the
+        default engine (the MiniCluster serving path)."""
+        eng = make_engine()
+        rng = np.random.default_rng(23)
+        names = list(tables)
+        jobs = [(tables[names[int(rng.integers(0, 3))]], _leaf_agg_ctx(
+            "x", f"m BETWEEN {a} AND {a + 50}")) for a in
+            rng.integers(0, 60, 5)]
+        singles = [_agg_values(eng.execute(s, c)[0]) for s, c in jobs]
+        got = run_concurrent(
+            [lambda s=s, c=c: eng.execute(s, c) for s, c in jobs])
+        assert [_agg_values(r) for r, _rem in got] == singles
+
+
+# ---------------------------------------------------------------------------
+# same-cols member grouping (stacked-batch dedup)
+# ---------------------------------------------------------------------------
+
+class TestMemberDedup:
+    def test_duplicate_tables_share_stack_entry_bit_identical(self, tables):
+        """A stacked batch holding duplicate tables dedups the stack to
+        one entry per unique column set — results bit-identical, and the
+        dispatch_batch_dedup meter counts the spared stack entries."""
+        eng = make_engine()
+
+        def agg_values(results):
+            return [tuple(float(v) for v in r.intermediates)
+                    for r in results]
+
+        jobs = [(tables[tn], QueryContext.from_sql(
+            f"SELECT SUM(m), COUNT(*), MIN(m) FROM x WHERE m < {60 + i}"))
+            for i, tn in enumerate(["t1", "t1", "t2", "t2", "t3", "t3"])]
+        singles = [agg_values(eng.execute(s, c)[0]) for s, c in jobs]
+        reg = eng._dispatcher._metrics
+        d0 = reg.meter("dispatch_batch_dedup")
+        got = run_concurrent(
+            [lambda s=s, c=c: eng.execute(s, c) for s, c in jobs])
+        assert all(not rem for _r, rem in got)
+        assert [agg_values(r) for r, _rem in got] == singles
+        assert reg.meter("dispatch_batch_dedup") > d0, \
+            "duplicate-table stacked batch never deduped its stack"
+
+
+# ---------------------------------------------------------------------------
+# adaptive batch-window sizing (window.ms=auto)
+# ---------------------------------------------------------------------------
+
+class TestAdaptiveWindow:
+    def _auto(self):
+        return KernelDispatcher(config=PinotConfiguration(overrides={
+            "pinot.server.dispatch.batch.window.ms": "auto"}))
+
+    def test_static_default_unchanged(self):
+        d = KernelDispatcher(config=PinotConfiguration())
+        assert not d.window_auto
+        assert d.current_window_s() == pytest.approx(0.002)
+
+    def test_tight_loop_converges_to_floor(self):
+        d = self._auto()
+        with d._cv:
+            for _ in range(64):
+                d._note_arrival_locked()
+        assert d.current_window_s() == pytest.approx(0.5 * 0.002)
+
+    def test_sparse_arrivals_clamp_to_ceiling(self):
+        d = self._auto()
+        with d._cv:
+            for _ in range(8):
+                d._note_arrival_locked()
+                d._last_arrival -= 10.0  # pretend 10s since last submit
+            d._note_arrival_locked()
+        assert d.current_window_s() == pytest.approx(4.0 * 0.002)
+
+    def test_lone_caller_steady_state_inline_no_added_p50(self):
+        """A lone caller in auto mode stays on the inline fast path:
+        every submit resolves synchronously (no window wait, no ring
+        thread), so steady-state p50 gains nothing."""
+        d = self._auto()
+        for i in range(16):
+            fut = d.submit(Launch(call=lambda: np.full(3, 1.0),
+                                  batch_key=("plan", 1)))
+            assert fut.done(), "lone submit left the inline fast path"
+            assert np.array_equal(fut.result(), np.full(3, 1.0))
+        assert d._thread is None or not d._thread.is_alive()
+        # and the learned window sits at the floor (tight loop)
+        assert d.current_window_s() == pytest.approx(0.5 * 0.002)
+
+
+# ---------------------------------------------------------------------------
+# pipelined intermediate stages
+# ---------------------------------------------------------------------------
+
+def _mse_tables(n=1200):
+    rng = np.random.default_rng(5)
+    return {
+        "fact": {"k": rng.integers(0, 8, n).astype(np.int64),
+                 "v": rng.integers(1, 100, n).astype(np.int64)},
+        "dim": {"k": np.arange(8, dtype=np.int64),
+                "name": np.array([f"g{i}" for i in range(8)], object)},
+    }
+
+
+JOIN_SQL = ("SELECT d.name, SUM(f.v) AS s FROM fact f "
+            "JOIN dim d ON f.k = d.k GROUP BY d.name "
+            "ORDER BY d.name LIMIT 100")
+
+
+def _expected_join(tables):
+    want = {}
+    for k, v in zip(tables["fact"]["k"], tables["fact"]["v"]):
+        name = str(tables["dim"]["name"][int(k)])
+        want[name] = want.get(name, 0) + int(v)
+    return sorted(want.items())
+
+
+def _make_engine(tables, hosting, worker_config=None,
+                 replica_tables=(), **disp_kwargs):
+    """Two MseWorkers with shard scans (test_mse_chaos harness) plus
+    optional worker config / dispatcher kwargs. Tables named in
+    `replica_tables` scan as FULL identical copies on every worker (the
+    hedge-peer precondition) — routing still sends the leaf to
+    `hosting[table]` only, so rows never double-count."""
+    from pinot_tpu.mse.blocks import Block
+    from pinot_tpu.mse.dispatcher import QueryDispatcher
+    from pinot_tpu.mse.operators import filter_block
+    from pinot_tpu.mse.runtime import MseWorker
+
+    insts = ["server_0", "server_1"]
+
+    def make_scan(inst):
+        def scan(table, columns, filt):
+            t = tables[table]
+            n = len(next(iter(t.values())))
+            if table in replica_tables:
+                idx = np.ones(n, bool)
+            else:
+                hosts = hosting[table]
+                if inst not in hosts:
+                    return Block(columns,
+                                 [np.empty(0, object) for _ in columns])
+                shard, nshards = hosts.index(inst), len(hosts)
+                idx = np.arange(n) % nshards == shard
+            b = Block(list(t), [t[c][idx] for c in t])
+            if filt is not None:
+                b = filter_block(b, filt)
+            return b.select(columns)
+        return scan
+
+    workers = {}
+    for i in insts:
+        w = MseWorker(i, make_scan(i), config=worker_config)
+        w.start()
+        workers[i] = w
+    catalog = {k: list(v) for k, v in tables.items()}
+    disp = QueryDispatcher(workers, lambda: catalog,
+                           lambda t: list(hosting[t]), **disp_kwargs)
+    return disp, workers
+
+
+def _stop_engine(disp, workers):
+    for w in workers.values():
+        w.stop()
+    disp.stop()
+
+
+class TestPipelinedIntermediate:
+    def _run(self, worker_config):
+        tables = _mse_tables()
+        hosting = {"fact": ["server_0", "server_1"],
+                   "dim": ["server_0"]}
+        disp, workers = _make_engine(tables, hosting,
+                                     worker_config=worker_config)
+        try:
+            resp = disp.submit(JOIN_SQL)
+            assert not resp.exceptions, resp.exceptions
+            return [(str(a), int(b)) for a, b in resp.rows], tables
+        finally:
+            _stop_engine(disp, workers)
+
+    def test_chunked_fold_equals_barrier(self):
+        """Tiny chunk + watermark (dozens of frames per exchange) must
+        produce exactly the barrier path's rows."""
+        chunked = PinotConfiguration(overrides={
+            "pinot.server.mse.pipeline.chunk.rows": 64,
+            "pinot.server.mse.pipeline.watermark.rows": 150})
+        barrier = PinotConfiguration(overrides={
+            "pinot.server.mse.pipeline.enabled": False})
+        rows_c, tables = self._run(chunked)
+        rows_b, _ = self._run(barrier)
+        assert rows_c == rows_b == _expected_join(tables)
+
+    def test_watermark_bounds_fold_buffer(self):
+        """_watermarked never buffers more than watermark_rows before a
+        fold (plus the frame that crossed it)."""
+        from pinot_tpu.mse.blocks import Block
+        from pinot_tpu.mse.runtime import StageContext, _watermarked
+        ctx = StageContext(
+            query_id="q", plan=None, worker_id="w", worker_idx=0,
+            mailbox=None, addresses={}, scan_fn=None,
+            watermark_rows=120)
+        chunks = [Block(["a"], [np.arange(50)]) for _ in range(7)]
+        folds = list(_watermarked(ctx, iter(chunks)))
+        assert sum(f.num_rows for f in folds) == 350
+        assert len(folds) > 1, "watermark never triggered a fold"
+        assert all(f.num_rows <= 120 + 50 for f in folds)
+
+    def test_fold_operator_parity(self):
+        """fold_* chunked results == their barrier twins on random data
+        (incl. sketch and filtered aggs)."""
+        from pinot_tpu.mse.blocks import Block
+        from pinot_tpu.mse.operators import (
+            aggregate_block, final_merge_block, fold_aggregate_chunks,
+            fold_final_merge_chunks, partial_aggregate_block)
+        from pinot_tpu.query.expressions import func, ident, lit
+        rng = np.random.default_rng(3)
+        n = 600
+        block = Block(["a", "m"], [
+            rng.integers(0, 7, n).astype(np.int64),
+            rng.integers(1, 100, n).astype(np.int64)])
+        aggs = [func("sum", ident("m")), func("count", ident("*")),
+                func("min", ident("m")), func("avg", ident("m")),
+                func("distinctcounthll", ident("a")),
+                func("percentileest", ident("m"), lit(90))]
+        groups = [ident("a")]
+        schema = ["a"] + [f"x{i}" for i in range(len(aggs))]
+        parts = [block.take(np.arange(i, n, 5)) for i in range(5)]
+
+        def cells_equal(want, got):
+            assert want.names == got.names
+            for w, g in zip(want.arrays, got.arrays):
+                assert len(w) == len(g)
+                for x, y in zip(w, g):
+                    # sketch merges (hll/percentile digests) are
+                    # approx-stable under chunking, exact ints exact
+                    assert float(x) == pytest.approx(float(y), rel=1e-9)
+
+        want = aggregate_block(Block.concat(parts), groups, aggs, schema)
+        got = fold_aggregate_chunks(iter(parts), groups, aggs, schema)
+        cells_equal(want, got)
+
+        partials = [partial_aggregate_block(p, groups, aggs, schema)
+                    for p in parts]
+        want = final_merge_block(Block.concat(partials), 1, aggs, schema)
+        got = fold_final_merge_chunks(iter(partials), 1, aggs, schema)
+        cells_equal(want, got)
+
+
+# ---------------------------------------------------------------------------
+# stage hedging
+# ---------------------------------------------------------------------------
+
+class TestHedgeBook:
+    def test_clean_claim_wins_once(self):
+        from pinot_tpu.mse.dispatcher import _HedgeBook
+        b = _HedgeBook()
+        b.start((2, 0), 0, "s0")
+        b.start((2, 0), 1, "s1")
+        granted, loser = b.claim((2, 0), 1, clean=True)
+        assert granted and loser == (0, "s0")
+        granted, loser = b.claim((2, 0), 0, clean=True)
+        assert not granted
+
+    def test_error_waits_for_live_twin(self):
+        from pinot_tpu.mse.dispatcher import _HedgeBook
+        b = _HedgeBook()
+        b.start((2, 0), 0, "s0")
+        b.start((2, 0), 1, "s1")
+        # primary errors while the hedge is still running: denied
+        granted, _ = b.claim((2, 0), 0, clean=False)
+        assert not granted
+        # hedge errors too: it is the last one standing — granted
+        granted, _ = b.claim((2, 0), 1, clean=False)
+        assert granted
+
+    def test_unhedged_key_claims_trivially(self):
+        from pinot_tpu.mse.dispatcher import _HedgeBook
+        b = _HedgeBook()
+        b.start((3, 1), 0, "s0")
+        granted, loser = b.claim((3, 1), 0, clean=True)
+        assert granted and loser is None
+
+
+@pytest.mark.chaos
+class TestStageHedging:
+    SQL = ("SELECT f.k, SUM(f.v) AS s FROM fact f GROUP BY f.k "
+           "ORDER BY f.k LIMIT 100")
+
+    def _hedged_engine(self, tables):
+        """Both workers scan identical full fact copies: server_0 is the
+        one leaf worker, server_1 its hedge peer."""
+        cfg = PinotConfiguration(overrides={
+            "pinot.broker.mse.hedge.enabled": True,
+            "pinot.broker.mse.hedge.delay.min.ms": 40,
+            "pinot.broker.mse.hedge.delay.max.ms": 200})
+        return _make_engine(
+            tables, {"fact": ["server_0"], "dim": ["server_0"]},
+            replica_tables=("fact",),
+            config=cfg,
+            hedge_peers_fn=lambda table, inst:
+                ["server_1"] if inst == "server_0" else [])
+
+    def _run_seeded(self, seed):
+        tables = _mse_tables()
+        sched = FaultSchedule([
+            ("mse.stage.execute",
+             {"delay": 2.0, "times": 1, "seed": seed,
+              "where": {"instance": "server_0", "stage": 2}}),
+            ("mse.stage.hedge", {"delay": 0.0, "seed": seed}),
+        ])
+        sched.arm()
+        disp, workers = self._hedged_engine(tables)
+        try:
+            t0 = time.time()
+            resp = disp.submit(self.SQL)
+            elapsed = time.time() - t0
+            rows = [(int(a), int(b)) for a, b in resp.rows]
+            return (rows, tuple(e["errorCode"] for e in resp.exceptions),
+                    elapsed, json.dumps(sched.decisions()),
+                    get_registry("broker"))
+        finally:
+            _stop_engine(disp, workers)
+            sched.disarm()
+
+    def test_hedge_wins_within_budget_and_replays(self):
+        tables = _mse_tables()
+        # the no-chaos, no-hedge reference rows
+        disp, workers = _make_engine(
+            tables, {"fact": ["server_0"], "dim": ["server_0"]})
+        try:
+            ref = disp.submit(self.SQL)
+            assert not ref.exceptions
+            ref_rows = [(int(a), int(b)) for a, b in ref.rows]
+        finally:
+            _stop_engine(disp, workers)
+
+        reg = get_registry("broker")
+        issued0 = reg.meter("mse_stage_hedge_issued")
+        won0 = reg.meter("mse_stage_hedge_won")
+        rows_a, exc_a, elapsed_a, dec_a, _ = self._run_seeded(seed=11)
+        # zero failed queries; the hedge answered well before the 2s
+        # straggler finished
+        assert exc_a == ()
+        assert rows_a == ref_rows, "hedged rows differ from no-hedge run"
+        assert elapsed_a < 1.8, \
+            f"hedge did not win (query took {elapsed_a:.2f}s)"
+        assert reg.meter("mse_stage_hedge_issued") > issued0
+        assert reg.meter("mse_stage_hedge_won") > won0
+        # same seed, fresh cluster: identical rows + byte-identical
+        # decision journal
+        rows_b, exc_b, _elapsed_b, dec_b, _ = self._run_seeded(seed=11)
+        assert (rows_b, exc_b) == (rows_a, exc_a)
+        assert dec_a == dec_b
+
+    def test_hedge_loser_leaves_no_orphaned_queues(self):
+        tables = _mse_tables()
+        with failpoints.armed("mse.stage.execute", delay=1.2, times=1,
+                              where={"instance": "server_0", "stage": 2}):
+            disp, workers = self._hedged_engine(tables)
+            try:
+                resp = disp.submit(self.SQL)
+                assert not resp.exceptions, resp.exceptions
+                # the delayed primary eventually wakes, is cancelled,
+                # and must not leave a queue behind
+                deadline = time.time() + 5.0
+                services = [w.mailbox for w in workers.values()] \
+                    + [disp.mailbox]
+                while time.time() < deadline:
+                    if all(s.queue_count() == 0 for s in services):
+                        break
+                    time.sleep(0.05)
+                assert all(s.queue_count() == 0 for s in services), \
+                    "hedge loser left orphaned mailbox queues"
+            finally:
+                _stop_engine(disp, workers)
+
+
+# ---------------------------------------------------------------------------
+# L2-shared stage cache: a cold replica serves another replica's warm leaf
+# ---------------------------------------------------------------------------
+
+class TestStageCacheL2Sharing:
+    def test_remote_key_stable_across_processes(self):
+        from pinot_tpu.mse.stage_cache import remote_stage_key
+        key = ((("t", (("seg_0", 123), ("seg_1", 456))),),
+               '{"op":"scan"}')
+        k1 = remote_stage_key(key)
+        k2 = remote_stage_key(
+            ((("t", (("seg_0", 123), ("seg_1", 456))),), '{"op":"scan"}'))
+        assert k1 == k2 and k1.startswith("mse_stage:")
+        assert remote_stage_key(
+            ((("t", (("seg_0", 124), ("seg_1", 456))),),
+             '{"op":"scan"}')) != k1
+
+    def test_cold_replica_served_from_l2(self, tmp_path):
+        """Warm the leaf on server_0, move the segment view to server_1
+        (the rolling-restart cold replica): its first leaf stage answers
+        from the shared L2 — asserted via the cross-replica hit meter —
+        with identical rows."""
+        from pinot_tpu.cluster.mini import MiniCluster
+
+        rng = np.random.default_rng(9)
+        n = 4000
+        cols = {"d": rng.integers(0, 9, n).astype(np.int64),
+                "v": rng.integers(1, 100, n).astype(np.int64)}
+        schema = Schema.from_dict({
+            "schemaName": "t",
+            "dimensionFieldSpecs": [{"name": "d", "dataType": "LONG"}],
+            "metricFieldSpecs": [{"name": "v", "dataType": "LONG"}]})
+        tc = TableConfig.from_dict(
+            {"tableName": "t", "tableType": "OFFLINE"})
+        creator = SegmentCreator(tc, schema)
+        d = str(tmp_path / "seg")
+        creator.build(cols, d, "t_0")
+        seg = load_segment(d)
+
+        c = MiniCluster(num_servers=2, cache_server=True)
+        c.start()
+        try:
+            c.add_table("t")
+            c.add_segment("t", seg, server_idx=0)
+            sql = ("SELECT t.d, SUM(t.v) AS s FROM t GROUP BY t.d "
+                   "ORDER BY t.d LIMIT 100")
+            warm = c.mse.submit(sql)
+            assert not warm.exceptions, warm.exceptions
+            want = [(int(a), int(b)) for a, b in warm.rows]
+            # roll the table to the cold replica: same segment (same
+            # content CRC version), fresh process-local caches
+            c.servers[1].data_manager.table("t_OFFLINE").add_segment(seg)
+            c.servers[0].data_manager.table(
+                "t_OFFLINE", create=False).remove_segment("t_0")
+            reg = get_registry("server")
+            labels = {"instance": "server_1"}
+            h0 = reg.meter("mse_stage_cache_remote_hits", labels=labels)
+            cold = c.mse.submit(sql)
+            assert not cold.exceptions, cold.exceptions
+            assert [(int(a), int(b)) for a, b in cold.rows] == want
+            assert reg.meter("mse_stage_cache_remote_hits",
+                             labels=labels) > h0, \
+                "cold replica's leaf stage did not hit the shared L2"
+        finally:
+            c.stop()
+
+
+# ---------------------------------------------------------------------------
+# bench smoke (tier-1): the --mse driver incl. the throughput leg runs
+# ---------------------------------------------------------------------------
+
+class TestMseBenchSmoke:
+    def test_mse_bench_smoke(self, tmp_path):
+        import bench
+        # tmp out_path: the smoke run must not clobber the committed
+        # full-mode BENCH_mse.json
+        bench.mse_main(smoke=True, out_path=str(tmp_path / "mse.json"))
+        assert (tmp_path / "mse.json").exists()
