@@ -180,6 +180,10 @@ class SegmentWarmup:
         #: local tallies (cheap asserts in tests)
         self.segments_warmed = 0
         self.entries_warmed = 0
+        #: plans whose columns were prestaged into HBM residency for a
+        #: NON-cacheable (upsert) segment — the seal pipeline's
+        #: warm-before-swap evidence for tables the result cache skips
+        self.segments_prestaged = 0
 
     def warm(self, table: str, segment: Any) -> int:
         """Replay logged plans against `segment`; returns entries warmed.
@@ -189,12 +193,17 @@ class SegmentWarmup:
         from pinot_tpu.query.context import QueryContext
         from pinot_tpu.query.executor import QueryExecutor
 
-        if self.segment_cache is None or not self.segment_cache.enabled \
-                or not is_cacheable_segment(segment):
-            return 0
         plans = self.log.plans(table)
         if not plans:
             return 0
+        # result-cache warmup needs the cache; residency PRESTAGING does
+        # not — a cache-disabled deployment still wants sealed segments'
+        # columns in HBM before they publish (the zero-gap pipeline)
+        cache_on = (self.segment_cache is not None
+                    and self.segment_cache.enabled)
+        cacheable = cache_on and is_cacheable_segment(segment)
+        if not cache_on and self._engine_fn is None:
+            return 0  # nothing to warm with
         warmed = 0
         # most recent plans first — when the budget cuts, keep the mix
         # dashboards are refreshing NOW
@@ -210,6 +219,17 @@ class SegmentWarmup:
                 if not is_cacheable_shape(ctx):
                     continue
                 engine = self._engine_fn() if self._engine_fn else None
+                if not cacheable:
+                    # upsert segments never enter the result cache (their
+                    # validity bitmap mutates in place), but their column
+                    # + mask blocks still belong in HBM before the seal
+                    # swap publishes them — the zero-gap pipeline's
+                    # residency half applies regardless of cacheability
+                    if engine is not None:
+                        with engine.residency_seeding():
+                            if engine.prestage([segment], ctx):
+                                self.segments_prestaged += 1
+                    continue
                 if self.segment_cache.get(segment, fingerprint) is not None:
                     # already warm — an L2 hit here ALSO back-filled L1,
                     # which is exactly the rollout warmup we want. The

@@ -186,6 +186,13 @@ class ServerRole:
         self._loaded: Set[tuple] = set()  # (physical_table, segment_name)
         #: (physical_table, partition_id) -> RealtimeSegmentDataManager
         self._rt_managers: Dict[tuple, object] = {}
+        #: per-TABLE ingestion lag trackers, metrics-wired: gauges
+        #: `ingestion_delay_ms{table=,partition=}` feed dashboards, and
+        #: the backpressure controller reads them for the lag ceiling.
+        #: Per table, not per server — partition ids collide across
+        #: tables, and one table's consumer stopping must not zero
+        #: another's lag
+        self._delay_trackers: Dict[str, object] = {}
         #: physical_table -> (partition ids, discovered-at) — cached so a
         #: watch storm doesn't re-dial the stream broker per notification,
         #: refreshed periodically so added partitions start consuming
@@ -212,8 +219,21 @@ class ServerRole:
         with self._reconcile_lock:  # no reconcile mid-shutdown
             self._stopping = True
             managers = list(self._rt_managers.values())
+        # graceful drain, two-phase so shutdown does not scale with the
+        # partition count: request every seal FIRST (the force flags make
+        # each consumer thread seal concurrently, builds overlapping on
+        # their own pools), then drain+join each — the per-manager waits
+        # mostly find the work already done
         for mgr in managers:
-            mgr.stop()
+            try:
+                mgr.force_commit(wait_s=0.0)
+            except Exception:  # noqa: BLE001 — drain is best-effort
+                pass
+        for mgr in managers:
+            # force-commit the non-empty mutable (through the completion
+            # FSM) and persist the final checkpoint, so a rolling restart
+            # loses zero rows
+            mgr.stop(timeout=5.0, drain=True)
         self.client.close()
         self.transport.stop()
         self.data_manager.shutdown()
@@ -344,6 +364,7 @@ class ServerRole:
                 start_offset, start_seq = self._rt_checkpoint(
                     blob, physical, pid)
                 holder: Dict[str, object] = {}
+                from pinot_tpu.utils.metrics import get_registry
                 mgr = RealtimeSegmentDataManager(
                     cfg, schema, stream_cfg, pid, tdm, seg_store,
                     start_offset=start_offset,
@@ -352,12 +373,63 @@ class ServerRole:
                     deep_store=store,
                     on_commit=self._rt_committed(physical, pid, holder),
                     on_open=self._rt_opened(physical, pid),
-                    start_seq=start_seq)
+                    start_seq=start_seq,
+                    ingestion_delay_tracker=self.delay_tracker_for(
+                        physical),
+                    config=self.config, metrics=get_registry("server"),
+                    recover_segments=self._rt_recover_segments(
+                        blob, physical, pid))
                 holder["mgr"] = mgr
                 mgr.start()
                 self._rt_managers[key] = mgr
                 log.info("consuming %s partition %d from %s (seq %d)",
                          physical, pid, start_offset, start_seq)
+
+    def delay_tracker_for(self, physical: str):
+        """The (lazily created) lag tracker for one realtime table."""
+        from pinot_tpu.ingest.realtime_manager import IngestionDelayTracker
+        from pinot_tpu.utils.metrics import get_registry
+        tracker = self._delay_trackers.get(physical)
+        if tracker is None:
+            tracker = IngestionDelayTracker(
+                metrics=get_registry("server"),
+                labels={"instance": self.instance_id, "table": physical})
+            self._delay_trackers[physical] = tracker
+        return tracker
+
+    def _rt_recover_segments(self, blob: dict, physical: str,
+                             pid: int) -> list:
+        """Restart recovery for upsert/dedup tables: the partition's
+        already-loaded committed segments, in seq order, so the new
+        manager re-registers their rows into the metadata map (upsert
+        via the persisted validDocIds snapshots) before consuming —
+        resumed consumption then neither replays committed rows as fresh
+        duplicates nor forgets which rows already lost their upsert
+        battle. Append-only tables skip the scan entirely."""
+        from pinot_tpu.models.table_config import base_table_name
+        cfg_d = blob.get("tables", {}).get(base_table_name(physical), {}) or {}
+        if not cfg_d.get("upsertConfig") and not cfg_d.get("dedupConfig"):
+            return []
+        tdm = self.data_manager.table(physical, create=False)
+        if tdm is None:
+            return []
+        local = set(tdm.segment_names)
+        entries = []
+        for name, st in blob.get("segments", {}).get(physical, {}).items():
+            if st.get("partition_id") != pid or name not in local:
+                continue
+            parts = name.split("__")
+            try:
+                seq = int(parts[2]) if len(parts) >= 3 else 0
+            except ValueError:
+                seq = 0
+            entries.append((seq, name))
+        out = []
+        for _seq, name in sorted(entries):
+            seg = tdm.current_segment(name)
+            if seg is not None:
+                out.append(seg)
+        return out
 
     @staticmethod
     def _rt_checkpoint(blob: dict, physical: str, pid: int):

@@ -122,26 +122,41 @@ def run_ingestion_job(spec: IngestionJobSpec) -> List[str]:
     out_dirs: List[str] = []
     seq = 0
     skipped = 0
+    CHUNK = 4096
     for path in files:
         buf: List[Dict[str, Any]] = []
+        chunk: List[Dict[str, Any]] = []
+
+        def drain(chunk_rows):
+            # columnar batch transform: one expression pass per chunk;
+            # poison rows come back as per-row exceptions — skipped +
+            # logged, never failing the job (the realtime consumer's
+            # per-record guard, mirrored)
+            nonlocal skipped, seq, buf
+            for out in pipeline.transform_batch(chunk_rows):
+                if isinstance(out, Exception):
+                    skipped += 1
+                    if skipped <= 10:
+                        import logging
+                        logging.getLogger(__name__).warning(
+                            "skipping untransformable record in %s: %r",
+                            path, out)
+                    continue
+                if out is not None:
+                    buf.append(out)
+                if spec.rows_per_segment and \
+                        len(buf) >= spec.rows_per_segment:
+                    out_dirs.append(_flush(creator, spec, prefix, seq, buf))
+                    seq += 1
+                    buf = []
+
         for rec in read_records(path, spec.input_format):
-            try:
-                out = pipeline.transform(rec)
-            except Exception:  # noqa: BLE001 — one poison row must not
-                # kill the whole job (the realtime consumer's per-record
-                # guard, mirrored; ref: reference skips + meters bad rows)
-                skipped += 1
-                if skipped <= 10:
-                    import logging
-                    logging.getLogger(__name__).exception(
-                        "skipping untransformable record in %s", path)
-                continue
-            if out is not None:
-                buf.append(out)
-            if spec.rows_per_segment and len(buf) >= spec.rows_per_segment:
-                out_dirs.append(_flush(creator, spec, prefix, seq, buf))
-                seq += 1
-                buf = []
+            chunk.append(rec)
+            if len(chunk) >= CHUNK:
+                drain(chunk)
+                chunk = []
+        if chunk:
+            drain(chunk)
         if buf:
             out_dirs.append(_flush(creator, spec, prefix, seq, buf))
             seq += 1

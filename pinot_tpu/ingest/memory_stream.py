@@ -45,13 +45,17 @@ class InMemoryStream:
             cls._topics.pop(topic, None)
 
     def publish(self, record: Dict[str, Any], partition: Optional[int] = None,
-                key: Optional[str] = None) -> LongMsgOffset:
+                key: Optional[str] = None,
+                ts_ms: Optional[int] = None) -> LongMsgOffset:
+        """ts_ms: event timestamp (feeds IngestionDelayTracker lag and
+        the --ingest bench's freshness measurement)."""
         if partition is None:
             partition = (hash(key) if key is not None else 0) % self.num_partitions
         with self._lock:
             part = self._partitions[partition]
             off = LongMsgOffset(len(part))
-            part.append(StreamMessage(value=record, offset=off, key=key))
+            part.append(StreamMessage(value=record, offset=off, key=key,
+                                      timestamp_ms=ts_ms))
             return off
 
     def fetch(self, partition: int, start: LongMsgOffset,
@@ -73,8 +77,10 @@ class _InMemoryConsumer(PartitionGroupConsumer):
         self.partition_id = partition_id
 
     def fetch_messages(self, start_offset: LongMsgOffset,
-                       timeout_ms: int) -> MessageBatch:
-        return InMemoryStream.get(self.topic).fetch(self.partition_id, start_offset)
+                       timeout_ms: int,
+                       max_messages: int = 10_000) -> MessageBatch:
+        return InMemoryStream.get(self.topic).fetch(
+            self.partition_id, start_offset, max_messages=max_messages)
 
 
 class _InMemoryMetadataProvider(StreamMetadataProvider):

@@ -42,6 +42,13 @@ class _MutableColumn:
             self._values: List[List[Any]] = []
         self._null_docs: List[int] = []
         self.distinct: set = set()
+        #: running estimate of indexed bytes (feeds the server-wide
+        #: mutable-bytes ingestion budget — cheap incremental accounting,
+        #: not exact heap usage)
+        self.nbytes_est = 0
+
+    #: per-value overhead estimate for variable-size (object) storage
+    _OBJ_OVERHEAD = 56
 
     def append(self, doc_id: int, value: Any) -> None:
         spec = self.spec
@@ -55,11 +62,17 @@ class _MutableColumn:
                     self._buf = np.concatenate(
                         [self._buf, np.empty(len(self._buf), dtype=self._np_dtype)])
                 self._buf[doc_id] = value
+                self.nbytes_est += self._np_dtype.itemsize
             else:
                 self._buf.append(value)
+                self.nbytes_est += self._OBJ_OVERHEAD + (
+                    len(value) if isinstance(value, (str, bytes)) else 8)
             self.distinct.add(value)
         else:
             self._values.append(list(value))
+            for v in value:
+                self.nbytes_est += self._OBJ_OVERHEAD + (
+                    len(v) if isinstance(v, (str, bytes)) else 8)
             self.distinct.update(value)
 
     def values_snapshot(self, n: int):
@@ -166,6 +179,15 @@ class MutableSegment:
         return self._num_docs
 
     @property
+    def size_bytes(self) -> int:
+        """Estimated indexed bytes across columns — the unit the
+        ingestion backpressure budget (`pinot.server.ingest.memory.bytes`)
+        meters against. An estimate, not a heap audit: fixed columns
+        count itemsize per doc, variable values count length plus object
+        overhead."""
+        return sum(c.nbytes_est for c in self._cols.values())
+
+    @property
     def column_names(self) -> List[str]:
         return list(self._cols.keys())
 
@@ -193,19 +215,34 @@ class MutableSegment:
             cardinality=len(col.distinct), total_entries=n)
 
     def data_source(self, column: str) -> _MutableDataSource:
+        return self.data_source_at(column, self._num_docs)
+
+    def data_source_at(self, column: str, n: int) -> _MutableDataSource:
+        """Data source bound to an EXPLICIT doc count — the snapshot()
+        view pins one n for a whole query, so every column it reads has
+        the same length even while the consumer appends."""
         col = self._cols.get(column)
         if col is None:
             raise KeyError(f"column {column!r} not in segment {self.segment_name}")
-        n = self._num_docs  # snapshot
         return _MutableDataSource(col, n, self._col_meta(column, col, n))
+
+    def snapshot(self) -> "_MutableSegmentSnapshot":
+        """Consistent whole-query view: per-column data_source() calls
+        each snapshot num_docs at CALL time, so a query reading several
+        columns of a growing segment would see different lengths. The
+        host executors take one snapshot per (segment, query) instead
+        (ref: reference queries read up to one indexed row count)."""
+        return _MutableSegmentSnapshot(self, self._num_docs)
 
     def destroy(self) -> None:
         self._cols.clear()
 
     # -- sealing ------------------------------------------------------------
     def to_columns(self) -> Dict[str, Any]:
+        return self._to_columns(self._num_docs)
+
+    def _to_columns(self, n: int) -> Dict[str, Any]:
         """Materialize all columns for immutable segment build."""
-        n = self._num_docs
         out: Dict[str, Any] = {}
         for name, col in self._cols.items():
             vals = col.values_snapshot(n)
@@ -216,3 +253,53 @@ class MutableSegment:
                     vals[d] = None
             out[name] = vals
         return out
+
+
+class _MutableSegmentSnapshot:
+    """Frozen-doc-count view of a consuming segment (IndexSegment duck
+    type): every read resolves against ONE num_docs, so the host
+    executors see length-consistent columns while the consumer appends.
+    The validity bitmap is read live (upsert snapshot-per-query
+    semantics) — the executor truncates/pads it to this view's n."""
+
+    def __init__(self, seg: "MutableSegment", n: int):
+        self._seg = seg
+        self._n = n
+
+    @property
+    def name(self) -> str:
+        return self._seg.segment_name
+
+    @property
+    def segment_name(self) -> str:
+        return self._seg.segment_name
+
+    @property
+    def num_docs(self) -> int:
+        return self._n
+
+    @property
+    def column_names(self) -> List[str]:
+        return self._seg.column_names
+
+    def has_column(self, column: str) -> bool:
+        return self._seg.has_column(column)
+
+    @property
+    def metadata(self) -> SegmentMetadata:
+        n = self._n
+        seg = self._seg
+        cols = {name: seg._col_meta(name, col, n)
+                for name, col in seg._cols.items()}
+        return SegmentMetadata(
+            segment_name=seg.segment_name,
+            table_name=seg.table_config.table_name_with_type,
+            num_docs=n, columns=cols,
+            time_column=seg.table_config.retention.time_column)
+
+    def data_source(self, column: str) -> _MutableDataSource:
+        return self._seg.data_source_at(column, self._n)
+
+    @property
+    def valid_doc_ids(self):
+        return getattr(self._seg, "valid_doc_ids", None)

@@ -9,6 +9,35 @@ stream offset as the replay checkpoint, open the next CONSUMING segment
 (SURVEY.md §3.3). The controller-side completion FSM is collapsed into the
 local commit callback until multi-instance coordination lands
 (controller-lite owns it then).
+
+Production hardening (the ingestion PR):
+
+* **Zero-gap seal pipeline** (local-commit path): the seal-lock is held
+  only for the SNAPSHOT — the mutable rotates immediately and the
+  consumer keeps consuming into the next CONSUMING segment while
+  `_build_immutable` runs on a per-partition build executor. The sealed
+  mutable keeps serving queries until its immutable replacement has been
+  built AND warmed (`TableDataManager.add_segment` runs the warmup
+  replay + residency seeding BEFORE publishing), so a seal is never
+  query-visible. Commits checkpoint strictly in seal order (a later
+  segment's offset never persists past an earlier segment that has not
+  committed — a crash between them must re-consume, not lose rows).
+* **Backpressure**: a mutable-bytes budget (`pinot.server.ingest.
+  memory.bytes`, covering the mutable AND sealed-pending-build bytes)
+  shrinks fetch batches adaptively as it fills and pauses the consumer
+  at the ceiling; a lag ceiling (`pinot.server.ingest.lag.pause.ms`)
+  bounds how far a paused partition may fall behind by force-sealing
+  into the build pipeline instead of pausing indefinitely. Pause state
+  is surfaced per partition (`paused`, `pause()`/`resume()` ops hooks,
+  `ingest_paused` gauge).
+* **Chaos sites** (deterministic seeded failpoints, byte-identical
+  decision-journal replay): `ingest.seal.build`, `ingest.seal.swap`,
+  `ingest.checkpoint` (payload hook — a torn policy degrades to
+  re-consume-not-corrupt), `ingest.upsert.apply`, plus the pre-existing
+  `ingest.realtime.consume`. A `SimulatedCrash` raised into the consume
+  loop VANISHES the consumer mid-batch — no checkpoint, no cleanup —
+  exactly as if the process had been SIGKILLed; recovery is a new
+  manager resuming from the committed offset + validDocIds snapshots.
 """
 from __future__ import annotations
 
@@ -16,7 +45,8 @@ import logging
 import os
 import threading
 import time
-from typing import Callable, Dict, List, Optional
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Dict, List, Optional, Tuple
 
 from pinot_tpu.controller.completion import COMMIT_SUCCESS
 from pinot_tpu.ingest.mutable_segment import MutableSegment
@@ -27,13 +57,16 @@ from pinot_tpu.models import Schema, TableConfig
 from pinot_tpu.segment.creator import SegmentCreator
 from pinot_tpu.segment.loader import load_segment
 from pinot_tpu.server.data_manager import TableDataManager
-from pinot_tpu.utils.failpoints import fire
+from pinot_tpu.utils.failpoints import SimulatedCrash, fire
 
 log = logging.getLogger(__name__)
 
 
 class RealtimeSegmentDataManager:
     """One stream partition's consumer + segment rotation."""
+
+    #: backoff before a failed seal build / torn checkpoint retries
+    SEAL_RETRY_S = 0.25
 
     def __init__(self, table_config: TableConfig, schema: Schema,
                  stream_config: StreamConfig, partition_id: int,
@@ -44,7 +77,8 @@ class RealtimeSegmentDataManager:
                  completion_manager=None, instance_id: str = "server_0",
                  deep_store=None,
                  on_open: Optional[Callable[[str], None]] = None,
-                 start_seq: int = 0):
+                 start_seq: int = 0, config=None, metrics=None,
+                 recover_segments: Optional[List] = None):
         """completion_manager: a controller SegmentCompletionManager for
         multi-replica coordination (exactly one replica commits per
         segment, ref BlockingSegmentCompletionFSM); None = single-replica
@@ -53,7 +87,15 @@ class RealtimeSegmentDataManager:
         upload there and the completion protocol advertises the STORE URI
         as the download path, so a replica (or restarted server) recovers
         the committed copy without a shared build directory (ref
-        SplitSegmentCommitter uploading via PinotFS)."""
+        SplitSegmentCommitter uploading via PinotFS).
+        config: a PinotConfiguration for the backpressure knobs.
+        metrics: a MetricsRegistry for the ingestion meters/gauges.
+        recover_segments: already-loaded committed segments of THIS
+        partition (restart path) — their rows re-register into the
+        upsert/dedup metadata (upsert via the persisted validDocIds
+        snapshots, making restart O(valid) not O(total)) so a resumed
+        consumer neither replays committed rows as duplicates nor loses
+        the upsert battle history."""
         self.table_config = table_config
         self.schema = schema
         self.stream_config = stream_config
@@ -82,6 +124,18 @@ class RealtimeSegmentDataManager:
         self._restart_fetch = False
         self.pipeline = TransformPipeline(table_config, schema)
         self.delay_tracker = ingestion_delay_tracker
+
+        from pinot_tpu.utils.config import PinotConfiguration
+        cfg = config or PinotConfiguration()
+        self.memory_budget_bytes = cfg.get_int(
+            "pinot.server.ingest.memory.bytes")
+        self.lag_pause_ms = cfg.get_float("pinot.server.ingest.lag.pause.ms")
+        self.fetch_max_rows = max(
+            1, cfg.get_int("pinot.server.ingest.fetch.max.rows"))
+        self._metrics = metrics
+        self._labels = {"table": table_config.name,
+                        "partition": str(partition_id)}
+
         # upsert/dedup metadata (ref RealtimeTableDataManager wiring)
         self.upsert_manager = None
         self.dedup_manager = None
@@ -95,6 +149,18 @@ class RealtimeSegmentDataManager:
             from pinot_tpu.segment.upsert import PartitionDedupMetadataManager
             self.dedup_manager = PartitionDedupMetadataManager(
                 schema.primary_key_columns)
+        # restart recovery: committed segments re-enter the metadata in
+        # seq order so cross-segment last-wins replays deterministically
+        for seg in recover_segments or []:
+            try:
+                if self.upsert_manager is not None:
+                    self.upsert_manager.add_segment(seg)
+                elif self.dedup_manager is not None:
+                    self.dedup_manager.add_segment(seg)
+            except Exception:  # noqa: BLE001 — recovery is best-effort;
+                # a bad segment costs accuracy, never the consumer
+                log.exception("upsert/dedup recovery failed for %s",
+                              getattr(seg, "name", "?"))
 
         factory = get_stream_factory(stream_config)
         self.consumer = factory.create_partition_consumer(stream_config, partition_id)
@@ -104,18 +170,56 @@ class RealtimeSegmentDataManager:
                                              stream_config.offset_criteria)
         self.current_offset = start_offset
         self.error_count = 0
+        self.rows_indexed = 0
         #: start_seq: sequence of the next CONSUMING segment — a restarted
         #: server resumes AFTER its committed segments (ref LLCSegmentName
         #: sequencing), never replaying seq 0
         self._seq = start_seq
         #: index/seal mutual exclusion: a commit snapshots + swaps the
         #: mutable segment; rows must not land in it concurrently or they
-        #: are lost while the checkpoint advances past them
+        #: are lost while the checkpoint advances past them. The lock is
+        #: held for SNAPSHOTS only — never across an immutable build
         self._seal_lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.mutable: Optional[MutableSegment] = None
+        # -- zero-gap seal pipeline state --------------------------------
+        self._build_pool: Optional[ThreadPoolExecutor] = None
+        #: sealed mutables whose immutable build has not committed yet —
+        #: they still serve queries AND still count against the memory
+        #: budget (the real OOM risk under an overdriven producer)
+        self._pending_sealed: List[MutableSegment] = []
+        #: guards the retry queues below — separate from the seal lock so
+        #: a retry can be enqueued while the seal lock is held (the sync
+        #: FSM commit paths run under it)
+        self._retry_lock = threading.Lock()
+        #: (not-before, sealed, offset, seq) of failed builds to retry
+        self._retry_seals: List[tuple] = []
+        #: (not-before, seq, name, offset, uri, docs) of torn checkpoints
+        #: to retry — a checkpoint retries WITHOUT rebuilding the segment
+        self._retry_checkpoints: List[tuple] = []
+        #: ordered-commit gate: seal seq -> (name, offset, uri, docs)
+        #: ready to checkpoint; flushed strictly in seq order under
+        #: _commit_lock (EVERY commit path — async build, FSM COMMIT/
+        #: KEEP/DISCARD — enqueues its pre-bump seal seq here)
+        self._commit_lock = threading.Lock()
+        self._ready_commits: Dict[int, tuple] = {}
+        self._next_commit_seq = start_seq
+        # -- backpressure / ops state ------------------------------------
+        self._force_requested = False
+        self._manual_pause = False
+        self._bp_paused = False
+        self._crashed = False
         self._open_new_consuming()
+
+    # ------------------------------------------------------------------
+    def _meter(self, name: str, value: float = 1) -> None:
+        if self._metrics is not None:
+            self._metrics.add_meter(name, value, labels=self._labels)
+
+    def _gauge(self, name: str, value: float) -> None:
+        if self._metrics is not None:
+            self._metrics.set_gauge(name, value, labels=self._labels)
 
     # ------------------------------------------------------------------
     def _segment_name(self) -> str:
@@ -130,6 +234,7 @@ class RealtimeSegmentDataManager:
     def _open_new_consuming(self) -> None:
         self.mutable = MutableSegment(self._segment_name(), self.table_config,
                                       self.schema)
+        self._force_requested = False
         self.tdm.add_segment(self.mutable)  # immediately queryable
         if self.on_open is not None:
             try:
@@ -144,14 +249,135 @@ class RealtimeSegmentDataManager:
             name=f"consumer-{self.table_config.name}-{self.partition_id}")
         self._thread.start()
 
-    def stop(self, timeout: float = 10.0) -> None:
+    def stop(self, timeout: float = 10.0, drain: bool = False) -> None:
+        """drain=True force-commits a non-empty mutable (through the
+        completion FSM when present) and waits for in-flight builds +
+        checkpoints BEFORE joining the thread — a rolling restart then
+        loses zero rows and persists its final checkpoint (the old
+        stop() abandoned the mutable's rows)."""
+        if drain and not self._crashed:
+            self.drain(timeout)
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout)
+        if self._build_pool is not None:
+            self._build_pool.shutdown(wait=True)
         self.consumer.close()
+        if self.delay_tracker is not None:
+            self.delay_tracker.remove_partition(self.partition_id)
 
+    def drain(self, timeout: float = 10.0) -> bool:
+        """Flush everything consumable to durable form: force-commit the
+        mutable, then wait for pending builds, retries, and checkpoints.
+        Returns True when fully drained within the timeout."""
+        deadline = time.time() + timeout
+        if self.mutable is not None and self.mutable.num_docs > 0:
+            self.force_commit(wait_s=max(0.0, deadline - time.time()))
+        while time.time() < deadline:
+            with self._seal_lock:
+                idle = not self._pending_sealed
+            with self._retry_lock:
+                idle = idle and not self._retry_seals \
+                    and not self._retry_checkpoints
+            with self._commit_lock:
+                idle = idle and not self._ready_commits
+            if idle:
+                return True
+            # no consumer thread: drive retries inline
+            if self._thread is None or not self._thread.is_alive():
+                self._drain_seal_retries()
+            time.sleep(0.02)
+        return False
+
+    # -- pause / resume (ops surface) -----------------------------------
+    def pause(self) -> None:
+        """Ops hook: stop fetching (indexed rows keep serving)."""
+        self._manual_pause = True
+        self._gauge("ingest_paused", 1.0)
+
+    def resume(self) -> None:
+        self._manual_pause = False
+        self._gauge("ingest_paused", 1.0 if self.paused else 0.0)
+
+    @property
+    def paused(self) -> bool:
+        """True while the consumer is not fetching — manual pause or
+        memory backpressure."""
+        return self._manual_pause or self._bp_paused
+
+    def _set_bp_paused(self, flag: bool) -> None:
+        if flag != self._bp_paused:
+            self._bp_paused = flag
+            self._gauge("ingest_paused", 1.0 if self.paused else 0.0)
+            if flag:
+                self._meter("ingest_backpressure_pauses")
+
+    # -- backpressure ----------------------------------------------------
+    def ingest_bytes(self) -> int:
+        """Bytes this partition holds in non-durable form: the consuming
+        mutable plus every sealed mutable whose build has not committed."""
+        with self._seal_lock:
+            total = self.mutable.size_bytes if self.mutable is not None else 0
+            total += sum(s.size_bytes for s in self._pending_sealed)
+        return total
+
+    def _fetch_budget(self) -> int:
+        """Rows the next fetch may carry; 0 = pause this tick. Fetch
+        size shrinks linearly as the memory budget fills (adaptive fetch
+        -> pause -> resume), so the consumer decelerates into the wall
+        instead of slamming it."""
+        if self._manual_pause:
+            return 0
+        used = self.ingest_bytes()
+        # the gauge reports regardless of budget: the UNbudgeted default
+        # is exactly where operators need to watch mutable growth
+        self._gauge("ingest_mutable_bytes", float(used))
+        budget = self.memory_budget_bytes
+        if budget <= 0:
+            return self.fetch_max_rows
+        if used >= budget:
+            # over budget: pause — unless the pause has pushed lag past
+            # the ceiling, in which case shed memory by force-sealing
+            # the mutable into the build pipeline (bounded lag AND
+            # bounded bytes beats silently falling behind or OOMing)
+            if self.lag_pause_ms > 0 and self.delay_tracker is not None:
+                d = self.delay_tracker.delay_ms(self.partition_id)
+                if d is not None and d > self.lag_pause_ms \
+                        and self.mutable.num_docs > 0:
+                    self._meter("ingest_lag_shed_seals")
+                    self._try_commit()
+            return 0
+        frac = 1.0 - used / budget
+        return max(1, min(self.fetch_max_rows,
+                          int(self.fetch_max_rows * frac)))
+
+    # ------------------------------------------------------------------
     def _consume_loop(self) -> None:
+        try:
+            self._consume_loop_inner()
+        except SimulatedCrash:
+            # chaos kill: VANISH mid-batch — no checkpoint, no cleanup
+            # handshake, exactly as if the process had been SIGKILLed.
+            # Recovery is a NEW manager resuming from the last committed
+            # offset + persisted validDocIds snapshots (exactly-once
+            # convergence asserted by the --ingest chaos leg).
+            self._crashed = True
+
+    def _consume_loop_inner(self) -> None:
         while not self._stop.is_set():
+            self._drain_seal_retries()
+            fetch_rows = self._fetch_budget()
+            if fetch_rows <= 0:
+                self._set_bp_paused(not self._manual_pause)
+                if self._force_requested and self.mutable.num_docs > 0:
+                    # a force/drain must not starve behind a pause: seal
+                    # what we hold (it also sheds memory into the build
+                    # pipeline, which is how a paused consumer un-wedges)
+                    self._try_commit()
+                if self._stop.wait(0.02):
+                    break
+                continue
+            self._set_bp_paused(False)
             try:
                 # chaos site: a slow/failing upstream fetch — the
                 # consumer must back off and resume, never die (seeded
@@ -159,62 +385,304 @@ class RealtimeSegmentDataManager:
                 fire("ingest.realtime.consume",
                      table=self.table_config.name,
                      partition=self.partition_id)
-                batch = self.consumer.fetch_messages(self.current_offset, 100)
+                batch = self.consumer.fetch_messages(
+                    self.current_offset, 100, max_messages=fetch_rows)
+            except SimulatedCrash:
+                raise
             except Exception:  # noqa: BLE001
                 log.exception("fetch failed; backing off")
                 time.sleep(1.0)
                 continue
-            for msg in batch.messages:
-                try:
-                    with self._seal_lock:
-                        rec = self.pipeline.transform(msg.value)
-                        if rec is not None and (
-                                self.dedup_manager is None
-                                or self.dedup_manager.check_and_add(rec)):
-                            doc_id = self.mutable.num_docs
-                            self.mutable.index(rec)
-                            if self.upsert_manager is not None:
-                                self.upsert_manager.add_row(
-                                    self.mutable, doc_id, rec)
-                        self.current_offset = msg.offset.next()
-                except Exception:  # noqa: BLE001 — one bad row must not
-                    # kill the partition consumer (ref: reference skips
-                    # untransformable rows and meters them)
-                    self.error_count += 1
-                    self.current_offset = msg.offset.next()  # skip poison row
-                    if self.error_count <= 10 or self.error_count % 1000 == 0:
-                        log.exception("skipping bad record at offset %s",
-                                      msg.offset)
-                if self.delay_tracker is not None and msg.timestamp_ms:
-                    self.delay_tracker.record(self.partition_id, msg.timestamp_ms)
-                if self._end_criteria_reached():
-                    self._try_commit()
-                    if self._restart_fetch:
-                        break
+            self._index_batch(batch)
             if self._restart_fetch:
                 self._restart_fetch = False
                 continue  # refetch from the rewound offset
-            if batch.next_offset is not None:
+            if batch.next_offset is not None and len(batch):
                 self.current_offset = batch.next_offset
             if self._end_criteria_reached():
                 self._try_commit()
                 self._restart_fetch = False
             if len(batch) == 0:
+                if self._force_requested and self.mutable.num_docs > 0:
+                    self._try_commit()
                 if self._stop.wait(0.05):
                     break
+
+    def _index_batch(self, batch) -> None:
+        """Columnar fast path: transform the WHOLE fetched batch in one
+        pipeline pass (ingest/transforms.transform_batch — poison rows
+        come back as per-row exceptions, never failing their batch), then
+        index under the seal lock in flush-threshold-sized chunks so the
+        end-criteria seal still fires at exactly the configured row
+        count mid-batch."""
+        msgs = batch.messages
+        if not msgs:
+            return
+        outs = self.pipeline.transform_batch([m.value for m in msgs])
+        i = 0
+        n = len(msgs)
+        while i < n and not self._restart_fetch:
+            with self._seal_lock:
+                room = max(1, self.stream_config.flush_threshold_rows
+                           - self.mutable.num_docs)
+                end = min(n, i + room)
+                indexed = skipped = 0
+                for msg, rec in zip(msgs[i:end], outs[i:end]):
+                    if self._index_one(msg, rec):
+                        indexed += 1
+                    else:
+                        skipped += 1
+                chunk = msgs[i:end]
+                i = end
+            # metering + lag OUTSIDE the seal lock, once per chunk: the
+            # per-row loop must stay free of registry/gauge work (the
+            # same discipline that moved transforms to the batch path)
+            if indexed:
+                self._meter("ingest_rows_indexed", indexed)
+            if skipped:
+                self._meter("ingest_rows_skipped", skipped)
+            if self.delay_tracker is not None:
+                for msg in reversed(chunk):
+                    if msg.timestamp_ms:
+                        # the newest timestamped message carries the
+                        # chunk's lag (offsets are monotone)
+                        self.delay_tracker.record(self.partition_id,
+                                                  msg.timestamp_ms)
+                        break
+            if self._end_criteria_reached():
+                self._try_commit()
+
+    def _index_one(self, msg, rec) -> bool:
+        """Apply one transformed row (called under the seal lock). `rec`
+        is a dict (index), None (filtered), or the Exception its
+        transform raised (poison: skip, offset still advances). Returns
+        True when the row was indexed (the chunk loop meters in bulk)."""
+        try:
+            if isinstance(rec, Exception):
+                raise rec
+            if rec is not None and (self.dedup_manager is None
+                                    or self.dedup_manager.check_and_add(rec)):
+                doc_id = self.mutable.num_docs
+                if self.upsert_manager is not None:
+                    # chaos site BEFORE any state lands: an armed error
+                    # skips the row whole, never half-applied (per-row so
+                    # a seeded SimulatedCrash can kill truly MID-batch;
+                    # unarmed it costs one dict lookup)
+                    fire("ingest.upsert.apply",
+                         table=self.table_config.name,
+                         partition=self.partition_id, doc=doc_id)
+                self.mutable.index(rec)
+                if self.upsert_manager is not None:
+                    self.upsert_manager.add_row(self.mutable, doc_id, rec)
+                self.rows_indexed += 1
+                self.current_offset = msg.offset.next()
+                return True
+            self.current_offset = msg.offset.next()
+            return False
+        except SimulatedCrash:
+            raise
+        except Exception:  # noqa: BLE001 — one bad row must not kill the
+            # partition consumer (ref: reference skips untransformable
+            # rows and meters them)
+            self.error_count += 1
+            self.current_offset = msg.offset.next()  # skip poison row
+            if self.error_count <= 10 or self.error_count % 1000 == 0:
+                log.exception("skipping bad record at offset %s",
+                              msg.offset)
+            return False
 
     def _try_commit(self) -> None:
         try:
             if self.completion is not None:
                 self._try_commit_protocol()
                 return
-            with self._seal_lock:
-                self._commit()
+            self._seal_async()
+        except SimulatedCrash:
+            raise
         except Exception:  # noqa: BLE001 — seal failure must not kill the
             # consumer; the segment keeps consuming and the next criteria
             # check retries the build
             log.exception("segment commit failed; will retry")
 
+    # ------------------------------------------------------------------
+    # zero-gap seal pipeline (local-commit path)
+    # ------------------------------------------------------------------
+    def _seal_async(self) -> None:
+        """Seal = snapshot + rotate under the lock, build OFF-thread:
+        the consumer keeps consuming into the next CONSUMING segment
+        while the immutable builds; the sealed mutable keeps serving
+        queries until the warmed replacement swaps in."""
+        with self._seal_lock:
+            if self.mutable.num_docs <= 0:
+                self._force_requested = False
+                return
+            sealed = self.mutable
+            seal_offset = self.current_offset
+            seal_seq = self._seq
+            self._pending_sealed.append(sealed)
+            self._seq += 1
+            self._open_new_consuming()
+        # the holder tracks which segment object currently OWNS the
+        # upsert map entries/bitmap across build retries: an attempt that
+        # ran replace_segment and then failed (e.g. at the swap chaos
+        # site) has already redirected the entries, so the retry must
+        # replace from THAT object, not the original sealed mutable
+        self._submit_build(sealed, seal_offset, seal_seq,
+                           {"upsert_owner": sealed})
+
+    def _submit_build(self, sealed, seal_offset, seal_seq: int,
+                      holder: dict) -> None:
+        if self._build_pool is None:
+            # one worker: builds (and their commits) stay in seal order
+            self._build_pool = ThreadPoolExecutor(
+                max_workers=1,
+                thread_name_prefix=(f"seg-build-{self.table_config.name}"
+                                    f"-{self.partition_id}"))
+        self._build_pool.submit(self._build_and_swap, sealed, seal_offset,
+                                seal_seq, holder)
+
+    def _build_and_swap(self, sealed, seal_offset, seal_seq: int,
+                        holder: dict) -> None:
+        name = sealed.segment_name
+        try:
+            out_dir = self._build_immutable(sealed)
+            uri = out_dir
+            if self.deep_store is not None:
+                # single-replica durability: upload before checkpointing
+                # so the advertised location outlives this server
+                uri = self.deep_store.upload(
+                    out_dir, self.table_config.table_name_with_type, name)
+            immutable = load_segment(out_dir)
+            if self.upsert_manager is not None:
+                # transfer validity: the immutable is a row-for-row
+                # rebuild of the mutable, so it SHARES the valid bitmap
+                # and takes over the map entries in place — no recompute,
+                # so concurrent queries never observe cleared bits on
+                # either copy. Replace from the CURRENT owner (a failed
+                # earlier attempt already moved the entries off `sealed`)
+                self.upsert_manager.replace_segment(
+                    holder["upsert_owner"], immutable)
+                holder["upsert_owner"] = immutable
+                from pinot_tpu.segment.upsert import persist_valid_doc_ids
+                persist_valid_doc_ids(immutable)
+            # chaos site: the swap itself — an armed error retries the
+            # whole build; the sealed mutable keeps serving meanwhile
+            fire("ingest.seal.swap", table=self.table_config.name,
+                 segment=name, partition=self.partition_id)
+            # swap AFTER warmup: add_segment replays logged plans +
+            # residency seeding BEFORE publishing, and replaces the
+            # sealed mutable by name atomically — the seal is never
+            # query-visible (no cold window, no missing-rows window)
+            self.tdm.add_segment(immutable)
+            with self._seal_lock:
+                try:
+                    self._pending_sealed.remove(sealed)
+                except ValueError:
+                    pass
+            self._meter("ingest_segments_sealed")
+            self._enqueue_commit(seal_seq, name, seal_offset, uri,
+                                 immutable.num_docs)
+        except Exception:  # noqa: BLE001 — the consumer must survive any
+            # build failure; the sealed mutable keeps serving and the
+            # build retries with backoff
+            log.exception("seal build failed for %s; will retry", name)
+            self._meter("ingest_seal_build_failures")
+            with self._retry_lock:
+                self._retry_seals.append(
+                    (time.time() + self.SEAL_RETRY_S, sealed, seal_offset,
+                     seal_seq, holder))
+
+    def _drain_seal_retries(self) -> None:
+        """Re-submit failed builds / torn checkpoints whose backoff
+        expired (called from the consume loop, and inline by drain())."""
+        now = time.time()
+        with self._retry_lock:
+            due = [r for r in self._retry_seals if r[0] <= now]
+            self._retry_seals = [r for r in self._retry_seals if r[0] > now]
+            cdue = [r for r in self._retry_checkpoints if r[0] <= now]
+            self._retry_checkpoints = [r for r in self._retry_checkpoints
+                                       if r[0] > now]
+        for _nb, sealed, seal_offset, seal_seq, holder in due:
+            self._submit_build(sealed, seal_offset, seal_seq, holder)
+        for _nb, seal_seq, name, offset, uri, docs in cdue:
+            self._enqueue_commit(seal_seq, name, offset, uri, docs)
+
+    def _enqueue_commit(self, seal_seq: int, name: str, offset,
+                        uri: Optional[str], docs: int) -> None:
+        """Ordered-commit gate: checkpoints fire strictly in seal order
+        (under _commit_lock, so a build-pool flush and a consumer-thread
+        retry can never interleave out of order) — a later segment's
+        offset can never persist while an earlier segment is still
+        unbuilt/uncommitted, so a crash in that window re-consumes the
+        earlier rows instead of losing them. uri/docs travel WITH the
+        commit: last_commit_uri/docs are assigned just before on_commit
+        fires, so a retried out-of-order build can never leave a later
+        segment's callback reading an earlier segment's location."""
+        retry = None
+        with self._commit_lock:
+            self._ready_commits[seal_seq] = (name, offset, uri, docs)
+            while self._next_commit_seq in self._ready_commits:
+                seq = self._next_commit_seq
+                cname, coffset, curi, cdocs = self._ready_commits[seq]
+                if self._checkpoint(cname, coffset, curi, cdocs):
+                    del self._ready_commits[seq]
+                    self._next_commit_seq += 1
+                else:
+                    # torn checkpoint: the gate stays closed at this seq
+                    # (later commits queue behind it in _ready_commits)
+                    # and the checkpoint retries WITHOUT rebuilding
+                    retry = (time.time() + self.SEAL_RETRY_S, seq, cname,
+                             coffset, curi, cdocs)
+                    break
+        if retry is not None:
+            with self._retry_lock:
+                self._retry_checkpoints.append(retry)
+
+    def _checkpoint(self, name: str, offset, uri: Optional[str] = None,
+                    docs: Optional[int] = None) -> bool:
+        """Persist the replay checkpoint through the chaos payload hook:
+        a torn payload (or an armed error) means the write did NOT land —
+        persist nothing, so a restart resumes from the previous durable
+        offset and re-consumes (never adopts a corrupt checkpoint)."""
+        payload = str(offset).encode()
+        try:
+            out = fire("ingest.checkpoint", payload=payload,
+                       table=self.table_config.name, segment=name,
+                       partition=self.partition_id)
+        except SimulatedCrash:
+            raise
+        except Exception:  # noqa: BLE001 — chaos error = write lost
+            log.warning("checkpoint write failed for %s; will retry", name)
+            self._meter("ingest_checkpoint_torn")
+            return False
+        if out != payload:
+            log.warning("torn checkpoint write for %s; will retry", name)
+            self._meter("ingest_checkpoint_torn")
+            return False
+        if uri is not None:
+            self.last_commit_uri = uri
+        if docs is not None:
+            self.last_commit_docs = docs
+        try:
+            if self.on_commit is not None:
+                self.on_commit(name, offset)
+        except SimulatedCrash:
+            raise
+        except Exception:  # noqa: BLE001 — a transient callback failure
+            # (coordinator unreachable) retries the CHECKPOINT, never the
+            # build: escaping here would re-enter _build_and_swap's
+            # except and rebuild the whole segment in a loop
+            log.warning("commit callback failed for %s; will retry", name,
+                        exc_info=True)
+            self._meter("ingest_checkpoint_torn")
+            return False
+        return True
+
+    # ------------------------------------------------------------------
+    # completion-FSM (multi-replica) path — synchronous on the consumer
+    # thread: the FSM round-trip dominates and KEEP/DISCARD semantics
+    # need the un-rotated mutable
+    # ------------------------------------------------------------------
     def _try_commit_protocol(self) -> None:
         """One FSM interaction per end-criteria check (the consume loop
         re-polls, so HOLD/CATCHUP never block the consumer thread)."""
@@ -242,7 +710,7 @@ class RealtimeSegmentDataManager:
             try:
                 with self._seal_lock:
                     sealed = self.mutable
-                    out_dir = self._build_immutable()
+                    out_dir = self._build_immutable(sealed)
                 # deep-store upload BEFORE declaring success: the
                 # advertised download path must be durable (ref
                 # SplitSegmentCommitter's upload-then-commitEnd ordering)
@@ -264,9 +732,10 @@ class RealtimeSegmentDataManager:
                 download_path=advertised)
             if status == COMMIT_SUCCESS:
                 with self._seal_lock:
-                    # a force_commit may have rotated self.mutable during
-                    # the unlocked controller round-trip — finalize only
-                    # the segment this build actually sealed
+                    # the mutable cannot rotate during the unlocked
+                    # controller round-trip anymore (force_commit routes
+                    # through this same consumer thread now), but keep
+                    # the identity check as defense in depth
                     if self.mutable is sealed:
                         self.last_commit_uri = advertised
                         self._finalize_commit(out_dir)
@@ -277,9 +746,6 @@ class RealtimeSegmentDataManager:
                 # KEEP/DISCARD against the actual committer's copy
                 with self._seal_lock:
                     if self.mutable is sealed:
-                        # (if a force_commit rotated the mutable meanwhile,
-                        # out_dir now backs a live registered segment —
-                        # leave it alone)
                         import shutil
                         shutil.rmtree(out_dir, ignore_errors=True)
             return
@@ -313,33 +779,40 @@ class RealtimeSegmentDataManager:
                 path = download_segment(
                     path, os.path.join(self.store_dir, "_downloads"))
             with self._seal_lock:
-                self.last_commit_uri = resp.download_path
                 immutable = load_segment(path)
-                self.last_commit_docs = immutable.num_docs
                 self.tdm.add_segment(immutable)
                 self.current_offset = LongMsgOffset(resp.offset)
                 self._restart_fetch = True
-                if self.on_commit is not None:
-                    self.on_commit(immutable.name, self.current_offset)
+                # through the ordered gate: a torn checkpoint write
+                # retries (the consume loop drains it) instead of being
+                # dropped with a "will retry" log that never retried
+                self._enqueue_commit(self._seq, immutable.name,
+                                     self.current_offset,
+                                     resp.download_path,
+                                     immutable.num_docs)
                 self._seq += 1
                 self._open_new_consuming()
             return
         raise ValueError(f"unknown completion action {resp.action!r}")
 
     def _end_criteria_reached(self) -> bool:
+        if self.mutable.num_docs <= 0:
+            return False
+        if self._force_requested:
+            return True
         if self.mutable.num_docs >= self.stream_config.flush_threshold_rows:
             return True
         age_ms = (time.time() - self.mutable.start_consumption_time) * 1000
-        return (self.mutable.num_docs > 0
-                and age_ms >= self.stream_config.flush_threshold_time_ms)
+        return age_ms >= self.stream_config.flush_threshold_time_ms
 
     # ------------------------------------------------------------------
     def _commit(self) -> str:
-        """Seal: mutable -> immutable on disk -> swap -> checkpoint
+        """Synchronous seal (completion-protocol KEEP/DISCARD paths):
+        mutable -> immutable on disk -> swap -> checkpoint
         (ref commitSegment, RealtimeSegmentDataManager.java:856,1164).
         Returns the built segment directory (the completion protocol
         advertises it as the peer-download location)."""
-        out_dir = self._build_immutable()
+        out_dir = self._build_immutable(self.mutable)
         self.last_commit_uri = out_dir
         if self.deep_store is not None and self.completion is None:
             # single-replica durability (the protocol path uploads before
@@ -350,11 +823,14 @@ class RealtimeSegmentDataManager:
         self._finalize_commit(out_dir)
         return out_dir
 
-    def _build_immutable(self) -> str:
+    def _build_immutable(self, sealed) -> str:
         """Build the immutable copy on disk WITHOUT sealing/advancing —
         under the completion protocol the seal only happens after the
         controller accepts the commit (COMMIT_SUCCESS)."""
-        sealed = self.mutable
+        # chaos site: the expensive build leg — an armed error/delay
+        # exercises the retry path while the mutable keeps serving
+        fire("ingest.seal.build", table=self.table_config.name,
+             segment=sealed.segment_name, partition=self.partition_id)
         out_dir = os.path.join(self.store_dir, sealed.segment_name)
         creator = SegmentCreator(self.table_config, self.schema)
         creator.build(sealed.to_columns(), out_dir, sealed.segment_name)
@@ -385,31 +861,80 @@ class RealtimeSegmentDataManager:
             # resumes upsert state without replaying (ref upsert/ snapshot)
             from pinot_tpu.segment.upsert import persist_valid_doc_ids
             persist_valid_doc_ids(immutable)
+        fire("ingest.seal.swap", table=self.table_config.name,
+             segment=sealed.segment_name, partition=self.partition_id)
         # swap BEFORE removing: add_segment replaces by name atomically
         self.tdm.add_segment(immutable)
-        if self.on_commit is not None:
-            self.on_commit(sealed.segment_name, self.current_offset)
+        # through the ordered gate, like the async path: a torn
+        # checkpoint retries from the consume loop, never drops silently
+        self._enqueue_commit(self._seq, sealed.segment_name,
+                             self.current_offset, self.last_commit_uri,
+                             immutable.num_docs)
         self._seq += 1
         self._open_new_consuming()
 
-    def force_commit(self) -> None:
-        """Ops hook (ref forceCommit REST): seal now regardless of criteria."""
+    def force_commit(self, wait_s: float = 10.0) -> bool:
+        """Ops hook (ref forceCommit REST): seal now regardless of
+        criteria — THROUGH the completion FSM when one is present. The
+        old implementation called _commit() directly even on FSM-managed
+        tables, which force-sealed ONE replica outside the election and
+        split the replica set; the request is now served by the consumer
+        thread (the only FSM driver), falling back to an inline drive
+        only when no consumer thread is running. Returns True once the
+        targeted mutable has rotated (its build may still be in flight —
+        drain() waits for full durability)."""
         with self._seal_lock:
-            if self.mutable.num_docs > 0:
-                self._commit()
+            if self.mutable.num_docs <= 0:
+                return True
+            target = self.mutable
+        self._force_requested = True
+        alive = self._thread is not None and self._thread.is_alive()
+        deadline = time.time() + wait_s
+        while time.time() < deadline and not self._crashed:
+            if self.mutable is not target:
+                return True
+            if not alive:
+                # no consumer thread: drive the seal (and, for FSM
+                # tables, the protocol state machine) inline
+                self._try_commit()
+                time.sleep(0.02)
+            else:
+                time.sleep(0.01)
+        return self.mutable is not target
 
 
 class IngestionDelayTracker:
     """Ref core/data/manager/realtime/IngestionDelayTracker.java — per
-    partition end-to-end ingestion lag."""
+    partition end-to-end ingestion lag, metrics-wired.
 
-    def __init__(self):
+    The `ingestion_delay_ms{partition=...}` gauge refreshes on every
+    record(); `remove_partition` (wired to consumer stop) drops state and
+    zeroes the gauge so a reassigned/stopped partition never reports
+    stale lag forever; record() clamps event timestamps against clock
+    skew — an event stamped in the future would otherwise surface as
+    negative lag."""
+
+    def __init__(self, metrics=None, labels: Optional[Dict[str, str]] = None):
         self._latest: Dict[int, int] = {}
         self._lock = threading.Lock()
+        self._metrics = metrics
+        self._labels = dict(labels or {})
+
+    def _gauge(self, partition_id: int, value: float) -> None:
+        if self._metrics is not None:
+            self._metrics.set_gauge(
+                "ingestion_delay_ms", value,
+                labels={**self._labels, "partition": str(partition_id)})
 
     def record(self, partition_id: int, event_ts_ms: int) -> None:
+        now_ms = time.time() * 1000
+        # clock-skew clamp: a producer ahead of this server's clock must
+        # not register as negative lag (which would mask real lag until
+        # the skew drains)
+        event_ts_ms = min(int(event_ts_ms), int(now_ms))
         with self._lock:
             self._latest[partition_id] = event_ts_ms
+        self._gauge(partition_id, max(0.0, now_ms - event_ts_ms))
 
     def delay_ms(self, partition_id: int) -> Optional[float]:
         with self._lock:
@@ -417,3 +942,20 @@ class IngestionDelayTracker:
         if ts is None:
             return None
         return max(0.0, time.time() * 1000 - ts)
+
+    def remove_partition(self, partition_id: int) -> None:
+        """Wired to consumer stop: a reassigned partition's lag must not
+        linger (the gauge zeroes; delay_ms returns None)."""
+        with self._lock:
+            self._latest.pop(partition_id, None)
+        self._gauge(partition_id, 0.0)
+
+    def partitions(self) -> List[int]:
+        with self._lock:
+            return sorted(self._latest)
+
+    def max_delay_ms(self) -> Optional[float]:
+        """Worst lag across live partitions (the server-level signal)."""
+        delays = [self.delay_ms(p) for p in self.partitions()]
+        delays = [d for d in delays if d is not None]
+        return max(delays) if delays else None

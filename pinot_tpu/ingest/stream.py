@@ -64,11 +64,17 @@ class StreamConfig:
 
 
 class PartitionGroupConsumer(abc.ABC):
-    """Ref PartitionGroupConsumer — one stream partition's consumer."""
+    """Ref PartitionGroupConsumer — one stream partition's consumer.
+
+    ``max_messages`` is the backpressure lever: the realtime manager's
+    adaptive fetch sizing shrinks it as the mutable-bytes budget fills
+    (ref Kafka max.poll.records). Implementations may treat it as a
+    hint; the default preserves pre-existing batch sizes."""
 
     @abc.abstractmethod
     def fetch_messages(self, start_offset: LongMsgOffset,
-                       timeout_ms: int) -> MessageBatch: ...
+                       timeout_ms: int,
+                       max_messages: int = 10_000) -> MessageBatch: ...
 
     def close(self) -> None:
         pass

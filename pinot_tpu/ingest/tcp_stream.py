@@ -162,7 +162,8 @@ class TcpPartitionConsumer(PartitionGroupConsumer):
         self.partition_id = partition_id
 
     def fetch_messages(self, start_offset: LongMsgOffset,
-                       timeout_ms: int) -> MessageBatch:
+                       timeout_ms: int,
+                       max_messages: int = 500) -> MessageBatch:
         # chaos site: delay/fail/drop a fetch frame on the wire edge —
         # errors surface to the realtime manager's backoff path exactly
         # like a dead stream broker would
@@ -171,7 +172,8 @@ class TcpPartitionConsumer(PartitionGroupConsumer):
              start=int(start_offset.offset))
         r = self._ch.request({"op": "fetch", "topic": self.topic,
                               "partition": self.partition_id,
-                              "start": start_offset.offset, "max": 500})
+                              "start": start_offset.offset,
+                              "max": min(max_messages, 500)})
         msgs = [StreamMessage(value=m["value"],
                               offset=LongMsgOffset(m["offset"]),
                               key=m.get("key"),

@@ -46,6 +46,39 @@ class _ScalarProvider:
         return 1
 
 
+class _BatchProvider:
+    """ColumnProvider over a LIST of records (columnar batch evaluation:
+    one expression pass over the whole batch instead of one per row)."""
+
+    def __init__(self, records: List[Dict[str, Any]]):
+        self._records = records
+
+    def column(self, name: str):
+        vals = [r.get(name) for r in self._records]
+        # native dtype ONLY for type-homogeneous batches: np.array over a
+        # mixed [5, "x"] batch silently unifies to strings, and '5' == 5
+        # is elementwise-False with no exception — the per-row path would
+        # have compared 5 == 5 per row. Mixed batches stay object arrays,
+        # where comparisons/arithmetic run Python semantics per element
+        # (matching _ScalarProvider's one-row arrays) and genuine type
+        # errors raise into the demote-to-per-row guard.
+        t0 = type(vals[0])
+        if all(type(v) is t0 for v in vals):
+            try:
+                arr = np.array(vals)
+                if arr.ndim == 1:
+                    return arr
+            except (ValueError, TypeError):
+                pass
+        out = np.empty(len(vals), dtype=object)
+        out[:] = vals
+        return out
+
+    @property
+    def num_docs(self) -> int:
+        return len(self._records)
+
+
 class TransformPipeline:
     """record dict -> transformed record dict (or None when filtered)."""
 
@@ -60,6 +93,16 @@ class TransformPipeline:
             self._transforms.append(
                 (cfg["columnName"], parse_expression(cfg["transformFunction"])))
         self._enrichers: List[Callable[[Dict[str, Any]], None]] = []
+        #: columns the filter + transform expressions read — the batch
+        #: fast path applies only to rows where every one of these is a
+        #: non-null scalar (null-propagation and MV special cases keep
+        #: the exact per-row semantics via the slow path)
+        refs: set = set()
+        if self._filter_expr is not None:
+            _collect_identifiers(self._filter_expr, refs)
+        for _col, expr in self._transforms:
+            _collect_identifiers(expr, refs)
+        self._expr_refs = sorted(refs)
 
     def add_enricher(self, fn: Callable[[Dict[str, Any]], None]) -> None:
         """Ref recordtransformer/enricher/ (e.g. CLPEncodingEnricher)."""
@@ -71,13 +114,11 @@ class TransformPipeline:
         {"coalesce", "case", "is_null", "is_not_null",
          "json_extract_scalar"})
 
-    def transform(self, record: Dict[str, Any]) -> Optional[Dict[str, Any]]:
-        from pinot_tpu.query import transform as texpr
-
-        # 0. best-effort numeric coercion for schema fields arriving as
-        # strings (CSV readers deliver text): filters and transforms must
-        # compare numbers, not strings. Unparseable values stay as-is and
-        # surface through the per-record guards.
+    def _coerce(self, record: Dict[str, Any]) -> Dict[str, Any]:
+        """Step 0: best-effort numeric coercion for schema fields arriving
+        as strings (CSV readers deliver text): filters and transforms must
+        compare numbers, not strings. Unparseable values stay as-is and
+        surface through the per-record guards."""
         coerced = None
         for spec in self.schema.fields:
             v = record.get(spec.name)
@@ -90,6 +131,32 @@ class TransformPipeline:
                 if coerced is None:
                     coerced = record = dict(record)
                 record[spec.name] = conv
+        return record
+
+    def _finalize(self, record: Dict[str, Any]) -> Dict[str, Any]:
+        """Step 4: schema conversion + null handling (ref
+        DataTypeTransformer / NullValueTransformer): coerce to stored
+        type, defaults for nulls."""
+        out_rec: Dict[str, Any] = {}
+        for spec in self.schema.fields:
+            if spec.virtual:
+                continue
+            v = record.get(spec.name)
+            if spec.single_value:
+                out_rec[spec.name] = (spec.data_type.convert(v)
+                                      if v is not None else None)
+            else:
+                if v is None:
+                    v = []
+                elif not isinstance(v, (list, tuple)):
+                    v = [v]
+                out_rec[spec.name] = [spec.data_type.convert(x) for x in v]
+        return out_rec
+
+    def transform(self, record: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        from pinot_tpu.query import transform as texpr
+
+        record = self._coerce(record)
 
         # 1. filter (ref FilterTransformer): truthy filter result -> DROP.
         # SQL three-valued logic: a SIMPLE predicate over NULL is not
@@ -130,29 +197,131 @@ class TransformPipeline:
         # 3. enrichers
         for fn in self._enrichers:
             fn(record)
-        # 4. schema conversion + null handling (ref DataTypeTransformer /
-        #    NullValueTransformer): coerce to stored type, defaults for nulls
-        out_rec: Dict[str, Any] = {}
-        for spec in self.schema.fields:
-            if spec.virtual:
+        # 4. schema conversion + null handling
+        return self._finalize(record)
+
+    # ------------------------------------------------------------------
+    # columnar batch path (the realtime consume loop's hot path)
+    # ------------------------------------------------------------------
+    def transform_batch(self, records: List[Dict[str, Any]]) -> List[Any]:
+        """Vectorized transform: ONE evaluation per filter/transform
+        expression over the whole batch (the per-row path re-walks the
+        expression tree per record — parser/evaluator overhead dominates
+        ingestion CPU at stream rates). Returns a list aligned with
+        `records`; each element is one of:
+
+          dict       — the transformed record (index it)
+          None       — filtered out (skip it, advance the offset)
+          Exception  — this row poisoned (skip + meter it); poison rows
+                       are isolated PER ROW, the batch always survives
+
+        Exactness: rows whose expression-referenced columns are null or
+        multi-valued take the per-row path (SQL three-valued logic and MV
+        semantics live there); a batch-evaluation failure demotes the
+        whole fast set to per-row so one poison value can't take down its
+        neighbours. transform_batch(rs)[i] == transform(rs[i]) for every
+        non-poison row by construction (property-tested)."""
+        from pinot_tpu.query import transform as texpr
+
+        n = len(records)
+        if n == 0:
+            return []
+        out: List[Any] = [None] * n
+        recs: List[Optional[Dict[str, Any]]] = [None] * n
+        fast_idx: List[int] = []
+        slow_idx: List[int] = []
+        for i, r in enumerate(records):
+            try:
+                rr = self._coerce(r)
+            except Exception as e:  # noqa: BLE001 — isolate the row
+                out[i] = e
                 continue
-            v = record.get(spec.name)
-            if spec.single_value:
-                out_rec[spec.name] = (spec.data_type.convert(v)
-                                      if v is not None else None)
-            else:
-                if v is None:
-                    v = []
-                elif not isinstance(v, (list, tuple)):
-                    v = [v]
-                out_rec[spec.name] = [spec.data_type.convert(x) for x in v]
-        return out_rec
+            recs[i] = rr
+            ok = True
+            for c in self._expr_refs:
+                v = rr.get(c)
+                if v is None or isinstance(v, (list, tuple)):
+                    ok = False
+                    break
+            (fast_idx if ok else slow_idx).append(i)
+
+        if fast_idx and (self._filter_expr is not None or self._transforms):
+            batch = [recs[i] for i in fast_idx]
+            provider = _BatchProvider(batch)
+            try:
+                keep = np.ones(len(batch), dtype=bool)
+                if self._filter_expr is not None:
+                    drop = np.asarray(
+                        texpr.evaluate(self._filter_expr, provider))
+                    keep = ~np.broadcast_to(
+                        drop.astype(bool).reshape(-1)
+                        if drop.ndim else drop.astype(bool),
+                        (len(batch),))
+                for col, expr in self._transforms:
+                    apply_rows = [j for j, r in enumerate(batch)
+                                  if keep[j] and r.get(col) is None]
+                    if not apply_rows:
+                        continue
+                    vals = np.asarray(texpr.evaluate(expr, provider))
+                    if vals.ndim == 0:
+                        vals = np.broadcast_to(vals, (len(batch),))
+                    for j in apply_rows:
+                        if batch[j] is records[fast_idx[j]] \
+                                or batch[j] is recs[fast_idx[j]]:
+                            batch[j] = dict(batch[j])
+                        batch[j][col] = _scalar(vals[j])
+                for j, i in enumerate(fast_idx):
+                    if not keep[j]:
+                        out[i] = None
+                        continue
+                    rec = batch[j]
+                    try:
+                        for fn in self._enrichers:
+                            if rec is records[i] or rec is recs[i]:
+                                rec = dict(rec)
+                            fn(rec)
+                        out[i] = self._finalize(rec)
+                    except Exception as e:  # noqa: BLE001 — per-row
+                        out[i] = e
+            except Exception:  # noqa: BLE001 — a poison value broke the
+                # BATCH evaluation: demote every fast row to the per-row
+                # path, where each row's own guard isolates it
+                slow_idx.extend(fast_idx)
+        else:
+            # no expressions (or no eligible rows): finalize directly
+            for i in fast_idx:
+                try:
+                    rec = recs[i]
+                    for fn in self._enrichers:
+                        if rec is records[i] or rec is recs[i]:
+                            rec = dict(rec)
+                        fn(rec)
+                    out[i] = self._finalize(rec)
+                except Exception as e:  # noqa: BLE001
+                    out[i] = e
+
+        for i in slow_idx:
+            try:
+                out[i] = self.transform(records[i])
+            except Exception as e:  # noqa: BLE001 — poison row isolated
+                out[i] = e
+        return out
 
 
 def _scalar(v: Any) -> Any:
     arr = np.asarray(v).reshape(-1)
     x = arr[0]
     return x.item() if isinstance(x, np.generic) else x
+
+
+def _collect_identifiers(expr, out: set) -> None:
+    """Column names an expression reads (batch fast-path eligibility)."""
+    from pinot_tpu.query.expressions import Function, Identifier
+    if isinstance(expr, Identifier):
+        out.add(expr.name)
+    elif isinstance(expr, Function):
+        for a in expr.args:
+            _collect_identifiers(a, out)
 
 
 def _references_null(expr, record) -> bool:

@@ -64,10 +64,11 @@ def make_scan_fn(data_manager, engine_fn=None) -> ScanFn:
             device_ids: dict = {}
             engine = engine_fn() if engine_fn is not None else None
             if engine is not None and filt is not None:
+                # upsert segments included: the device top-K kernel ANDs
+                # their validDocIds mask into doc validity, so superseded
+                # rows never appear in the returned indices
                 candidates = [
-                    s for s in segs
-                    if isinstance(s, ImmutableSegment)
-                    and getattr(s, "valid_doc_ids", None) is None]
+                    s for s in segs if isinstance(s, ImmutableSegment)]
                 if candidates:
                     ids = engine.filtered_doc_ids(candidates, filt)
                     device_ids = {id(s): ix
@@ -75,6 +76,9 @@ def make_scan_fn(data_manager, engine_fn=None) -> ScanFn:
                                   if ix is not None}
             blocks = []
             for seg in segs:
+                snap = getattr(seg, "snapshot", None)
+                if snap is not None:
+                    seg = snap()  # one consistent doc count per query
                 provider = SegmentColumnProvider(seg)
                 idx = device_ids.get(id(seg))
                 if idx is None:

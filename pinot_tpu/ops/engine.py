@@ -362,7 +362,8 @@ class TpuOperatorExecutor:
         launch = Launch(
             call=lambda: kernel(cols, params, num_docs, D=D, G=G),
             plan=plan, cols=cols, params=params, num_docs=num_docs,
-            D=D, G=G, batch_key=batch_key, cols_key=_batch_id(segments),
+            D=D, G=G, batch_key=batch_key,
+            cols_key=self._cols_key(segments, plan),
             factory=factory, dedup_factory=dedup_factory,
             collective=self._needs_cpu_ordering(kernel),
             cancel_check=cancel_check,
@@ -493,7 +494,8 @@ class TpuOperatorExecutor:
         launch = Launch(
             call=lambda: kernel(cols, params, num_docs, D=D),
             plan=plan, cols=cols, params=params, num_docs=num_docs,
-            D=D, G=0, batch_key=batch_key, cols_key=_batch_id(segments),
+            D=D, G=0, batch_key=batch_key,
+            cols_key=self._cols_key(segments, plan),
             factory=(lambda B, stacked, _p=plan:
                      kernels.compiled_batched_topn_kernel(_p, B, stacked)),
             collective=self._needs_cpu_ordering(kernel),
@@ -765,6 +767,7 @@ class TpuOperatorExecutor:
             dict_cols=tuple(sorted(dict_cols)),
             raw_cols=tuple(sorted(raw_cols - raw64)),
             raw64_cols=tuple(sorted(raw64)),
+            valid_mask=self._needs_valid_mask(segments),
         )
         return plan, slots_of_fn
 
@@ -858,7 +861,8 @@ class TpuOperatorExecutor:
             dict_cols=tuple(sorted(dict_cols)),
             raw_cols=tuple(sorted(raw_cols - raw64)),
             raw64_cols=tuple(sorted(raw64)),
-            mode="topn", topn_k=k, topn_asc=bool(topn_asc))
+            mode="topn", topn_k=k, topn_asc=bool(topn_asc),
+            valid_mask=self._needs_valid_mask(segments))
 
     def _assemble_topn(self, segments, ctx: QueryContext,
                        packed: np.ndarray, S_real: int) -> List[Any]:
@@ -1061,6 +1065,9 @@ class TpuOperatorExecutor:
             cols["val:" + col] = self._stacked(
                 segments, S, D, col, "val", fetch_values, vdt)
 
+        if plan.valid_mask:
+            cols["vmask"] = self._stage_vmask(segments, S, D)
+
         G = 0
         if plan.group_compact:
             cols["gkey"], G = self._stage_gkey(segments, S, D, plan)
@@ -1182,6 +1189,122 @@ class TpuOperatorExecutor:
         while len(self._params_cache) > self.PARAMS_CACHE_ENTRIES:
             self._params_cache.popitem(last=False)  # evict coldest only
         return cols, params, num_docs_dev, S_real, D, G
+
+    # ------------------------------------------------------------------
+    # upsert validity masks (device-path upsert, SURVEY §2.3)
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _mask_stamp(seg) -> int:
+        """Version stamp of a segment's validDocIds bitmap (-1 = no
+        bitmap: the row is a constant all-ones and never goes stale)."""
+        valid = getattr(seg, "valid_doc_ids", None)
+        return -1 if valid is None else valid.version
+
+    def _needs_valid_mask(self, segments) -> bool:
+        return any(getattr(s, "valid_doc_ids", None) is not None
+                   for s in segments)
+
+    def _cols_key(self, segments, plan: DevicePlan) -> tuple:
+        """Staged-column identity for batch dedup/broadcast decisions:
+        for valid-mask plans the mask version stamps join the key, so
+        two coalesced members whose upsert bitmaps moved between their
+        stagings stack separately instead of silently sharing one
+        member's snapshot through the broadcast variant."""
+        base = _batch_id(segments)
+        if plan.valid_mask:
+            return (base, tuple(self._mask_stamp(s) for s in segments))
+        return base
+
+    def _stage_vmask(self, segments, S, D):
+        """Staged bool [S, D] validity block for a batch carrying upsert
+        segments — the `(segment, "__valid__")` pseudo-column. Rows ride
+        the same host-row / residency / assembled tiers as column data,
+        but every key carries the bitmap's mutation counter
+        ('vmask:<version>'): upsert bitmaps mutate IN PLACE without the
+        segment object changing, so an in-place clear() must address
+        fresh keys — the staged mask can never go stale, and the cost of
+        an upsert is re-staging one bool row, not a correctness hole.
+        Append-only segments in a mixed batch stage all-ones rows (stamp
+        -1, never mutated). Bitmap reads are snapshots: a concurrent
+        upsert lands in the NEXT staging, the same discipline as the
+        host executor's per-query to_mask()."""
+        stamps = tuple(self._mask_stamp(s) for s in segments)
+        batch = _batch_id(segments)
+        bkey = (batch, "vmask", "__valid__", S, D, stamps)
+        entry = self._block_cache.get(bkey)
+        if entry is not None and all(a is b
+                                     for a, b in zip(entry[0], segments)):
+            self._block_cache.move_to_end(bkey)
+            self._meter("hbm_block_hit")
+            return entry[1]
+        self._meter("hbm_block_miss")
+        # purge blocks staged under superseded mask versions of THIS
+        # batch: every future lookup carries the new stamps, so the old
+        # block is unreachable and would squat in the HBM budget
+        for k in [k for k in self._block_cache
+                  if k[0] == batch and k[1] == "vmask" and k != bkey]:
+            del self._block_cache[k]
+            self._cache_bytes -= self._block_bytes.pop(k)
+            self._drop_batch_block(k[0])
+
+        def fetch_row(seg):
+            valid = getattr(seg, "valid_doc_ids", None)
+            if valid is None:
+                return np.ones(seg.num_docs, dtype=bool)
+            m = valid.to_mask()
+            if len(m) < seg.num_docs:
+                # defensive (engine batches are immutable, sizes fixed):
+                # docs beyond the bitmap are not yet upsert-accounted
+                m = np.concatenate(
+                    [m, np.zeros(seg.num_docs - len(m), dtype=bool)])
+            return m[:seg.num_docs]
+
+        dtype_str = np.dtype(bool).str
+        if self._residency.enabled:
+            dev_rows: List[Any] = []
+            missing: List[int] = []
+            for seg, stamp in zip(segments, stamps):
+                row = self._residency.get(seg, f"vmask:{stamp}",
+                                          "__valid__", dtype_str)
+                dev_rows.append(row)
+                if row is None:
+                    missing.append(len(dev_rows) - 1)
+            for i in missing:
+                seg = segments[i]
+                # a miss means this stamp was never staged: purge the
+                # superseded stamps' rows (host + resident) — they are
+                # unreachable and would squat in both budgets
+                self._residency.invalidate_superseded_kind(
+                    seg, "vmask:", f"vmask:{stamps[i]}", "__valid__")
+                for hk in [k for k, v in self._host_rows.items()
+                           if k[0] == id(seg) and v[0] is seg
+                           and isinstance(k[1], str)
+                           and k[1].startswith("vmask:")
+                           and k[1] != f"vmask:{stamps[i]}"]:
+                    _s, payload = self._host_rows.pop(hk)
+                    self._host_bytes -= _entry_nbytes(payload)
+                arr = self._host_row(seg, "__valid__",
+                                     f"vmask:{stamps[i]}", fetch_row, bool)
+                dev = self._put_row(arr)
+                self._residency.admit(seg, f"vmask:{stamps[i]}",
+                                      "__valid__", dtype_str, dev,
+                                      arr.nbytes)
+                dev_rows[i] = dev
+            assembler = kernels.compiled_row_assembler(
+                S, D, tuple(int(r.shape[0]) for r in dev_rows), dtype_str)
+            dev = self._reshard_block(assembler(tuple(dev_rows)))
+            nbytes = S * D
+        else:
+            rows = [self._host_row(seg, "__valid__", f"vmask:{st}",
+                                   fetch_row, bool, pad_to=D)
+                    for seg, st in zip(segments, stamps)]
+            block = np.stack(rows) if len(rows) == S else \
+                np.concatenate([np.stack(rows),
+                                np.zeros((S - len(rows), D), dtype=bool)])
+            dev = self._put(block, block=True)
+            nbytes = block.nbytes
+        self._insert_block(bkey, (tuple(segments), dev), nbytes)
+        return dev
 
     def _stage_gkey(self, segments, S, D, plan: DevicePlan):
         """Compacted combined group keys: one int32 [S, D] code block,

@@ -540,6 +540,11 @@ def make_kernel(plan: DevicePlan, kind: str = "agg", extra: tuple = ()):
         # body runs at trace time: counts compiles
         note_trace(kind, fp, (*extra, int(num_docs.shape[-1]), D, G))
         valid = jnp.arange(D, dtype=jnp.int32)[None, :] < num_docs[:, None]
+        if plan.valid_mask:
+            # upsert validDocIds ride as a staged bool block: superseded
+            # rows drop out of every slot AND the matched count, exactly
+            # mirroring the host executor's `mask &= valid.to_mask()`
+            valid = valid & cols["vmask"]
         slots, matched = _compute_slots(plan, cols, params, valid, G)
         if plan.num_groups or G:
             return jnp.stack([s for _, s in slots], axis=-1)
@@ -577,6 +582,8 @@ def make_topn_kernel(plan: DevicePlan, kind: str = "topn",
         # body runs at trace time: counts compiles
         note_trace(kind, fp, (*extra, int(num_docs.shape[-1]), D))
         valid = jnp.arange(D, dtype=jnp.int32)[None, :] < num_docs[:, None]
+        if plan.valid_mask:
+            valid = valid & cols["vmask"]
         if plan.filter_ir is not None:
             mask = _eval_filter(plan.filter_ir, plan, cols, params) & valid
         else:
@@ -649,6 +656,8 @@ def _shard_one(plan: DevicePlan, doc_pos, G: int):
     plans (a pytree vmap can carry)."""
     def one(cols, params, num_docs):
         valid = doc_pos < num_docs[:, None]
+        if plan.valid_mask:
+            valid = valid & cols["vmask"]  # shard-local [S_loc, D_loc]
         slots, matched = _compute_slots(plan, cols, params, valid, G)
         arrs = tuple(s for _, s in slots)
         return arrs if (plan.num_groups or G) else arrs + (matched,)
@@ -777,8 +786,11 @@ def make_batched_kernel(plan: DevicePlan, B: int, stacked: bool = False):
     else:
         def fn(cols, plist, num_docs, D, G=0):
             ps = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *plist)
+            # the index array keeps vmap fed when a filterless plan has
+            # EMPTY per-query params (vmap rejects an all-empty pytree)
+            idx = jnp.arange(len(plist), dtype=jnp.int32)
             return jax.vmap(
-                lambda p: base(cols, p, num_docs, D=D, G=G))(ps)
+                lambda p, _i: base(cols, p, num_docs, D=D, G=G))(ps, idx)
 
     return jax.jit(fn, static_argnames=("D", "G"))
 
@@ -847,8 +859,9 @@ def make_batched_topn_kernel(plan: DevicePlan, B: int,
     else:
         def fn(cols, plist, num_docs, D, G=0):
             ps = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *plist)
+            idx = jnp.arange(len(plist), dtype=jnp.int32)  # empty-params guard
             return jax.vmap(
-                lambda p: base(cols, p, num_docs, D=D))(ps)
+                lambda p, _i: base(cols, p, num_docs, D=D))(ps, idx)
 
     return jax.jit(fn, static_argnames=("D", "G"))
 
@@ -888,10 +901,14 @@ def make_batched_sharded_kernel(plan: DevicePlan, mesh, B: int,
                    + jnp.arange(d_local, dtype=jnp.int32))[None, :]
         # batch axis INNERMOST: vmap the shared per-shard compute over
         # the leading query axis, then pay ONE set of collectives on the
-        # stacked partials (the combine/pack is rank-agnostic)
-        in_axes = (0 if stacked else None, 0, 0 if stacked else None)
-        outs = jax.vmap(_shard_one(plan, doc_pos, G),
-                        in_axes=in_axes)(cols, params, num_docs)
+        # stacked partials (the combine/pack is rank-agnostic). The
+        # trailing index arg keeps vmap fed when a filterless plan's
+        # params pytree is empty
+        one = _shard_one(plan, doc_pos, G)
+        idx = jnp.arange(B, dtype=jnp.int32)
+        in_axes = (0 if stacked else None, 0, 0 if stacked else None, 0)
+        outs = jax.vmap(lambda c, p, nd, _i: one(c, p, nd),
+                        in_axes=in_axes)(cols, params, num_docs, idx)
         return _shard_combine_pack(plan, outs, G)
 
     def fn(cols, plist, num_docs, D, G=0):

@@ -73,3 +73,10 @@ class DevicePlan:
     mode: str = "agg"
     topn_k: int = 0
     topn_asc: bool = True
+    #: True: the batch carries at least one upsert/dedup segment with a
+    #: live validDocIds bitmap — the engine stages a bool [S, D] mask
+    #: block ('vmask', version-stamped by the bitmap mutation counter)
+    #: and kernels AND it into the padding-validity mask, so superseded
+    #: rows are invisible to every slot exactly as the host executor's
+    #: `mask &= valid.to_mask()` makes them (SURVEY §2.3)
+    valid_mask: bool = False

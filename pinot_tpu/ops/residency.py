@@ -234,6 +234,25 @@ class ResidencyManager:
                 self._meter("hbm_evicted")
             return len(stale)
 
+    def invalidate_superseded_kind(self, seg, kind_prefix: str,
+                                   keep_kind: str, col: str) -> int:
+        """Drop this segment's resident rows whose kind starts with
+        `kind_prefix` but is not `keep_kind` — the version-stamped vmask
+        rows: every bitmap mutation admits a fresh 'vmask:<stamp>' row,
+        and without this purge the unreachable old-stamp rows would
+        squat in the HBM budget until LRU pressure evicts live columns
+        (the assembled-block cache gets the same purge engine-side)."""
+        with self._lock:
+            stale = [k for k, e in self._entries.items()
+                     if e[0] is seg and k[3] == col
+                     and k[2].startswith(kind_prefix) and k[2] != keep_kind]
+            for k in stale:
+                _seg, _dev, nb = self._entries.pop(k)
+                self._bytes -= nb
+                self.evicted += 1
+                self._meter("hbm_evicted")
+            return len(stale)
+
     def drop_all(self) -> None:
         """Bench/test hook: release every resident row (references only —
         in-flight kernels still hold theirs)."""

@@ -98,11 +98,13 @@ class QueryExecutor:
             tracing.annotate(cacheHit=cache_hits > 0)
 
         # consuming (mutable) segments always run host-side: their columns
-        # are unsorted-dict/append buffers, not stageable blocks
+        # are unsorted-dict/append buffers, not stageable blocks. Upsert
+        # segments with live validDocIds DO ride the device path: the
+        # engine stages the bitmap as a version-stamped mask block and
+        # ANDs it in-kernel (plan.valid_mask), so upsert/dedup tables
+        # share the same jit(vmap) coalesced launches as append-only ones
         device_candidates = [
-            s for s in to_run
-            if isinstance(s, ImmutableSegment)
-            and getattr(s, "valid_doc_ids", None) is None]
+            s for s in to_run if isinstance(s, ImmutableSegment)]
         dc = set(id(s) for s in device_candidates)
         host_only = [s for s in to_run if id(s) not in dc]
         remaining = device_candidates

@@ -33,6 +33,13 @@ DEFAULT_NUM_GROUPS_LIMIT = 100_000
 def execute_segment(seg: ImmutableSegment, ctx: QueryContext):
     """Run one segment, returning the shape-appropriate SegmentResult."""
     from pinot_tpu.utils import tracing
+    snap = getattr(seg, "snapshot", None)
+    if snap is not None:
+        # consuming segment: pin ONE doc count for the whole query —
+        # per-column snapshots drift while the consumer appends, and a
+        # filter mask built at count N must never index a column read
+        # at count N+k
+        seg = snap()
     if tracing.active():
         with tracing.Scope("SegmentExecutor", segment=seg.name) as scope:
             result = _execute_segment(seg, ctx)
@@ -44,11 +51,15 @@ def execute_segment(seg: ImmutableSegment, ctx: QueryContext):
 def _execute_segment(seg: ImmutableSegment, ctx: QueryContext):
     # star-tree fast path (ref AggregationOperator._useStarTree): answer
     # from pre-aggregated records when a tree fits the query shape.
-    # Skipped when upsert validDocIds exist: pre-agg records bake in
-    # superseded rows and cannot honor the validity mask (ADVICE r1).
+    # Mask-aware gating: pre-agg records bake in superseded rows, so an
+    # upsert validDocIds bitmap disqualifies the tree ONLY while it has
+    # cleared bits — an all-set bitmap is a no-op mask and the tree's
+    # totals are exact (ADVICE r1 hardened into a predicate, not a
+    # blanket exclusion).
+    _vd = getattr(seg, "valid_doc_ids", None)
     if ctx.aggregations and getattr(seg, "metadata", None) is not None \
             and getattr(seg.metadata, "star_tree", None) \
-            and getattr(seg, "valid_doc_ids", None) is None:
+            and (_vd is None or _vd.is_full()):
         from pinot_tpu.query.startree_exec import execute_star_tree
         result = execute_star_tree(seg, ctx)
         if result is not None:

@@ -18,12 +18,24 @@ _POPCOUNT8 = np.array([bin(i).count("1") for i in range(256)], dtype=np.int64)
 
 
 class Bitmap:
-    """Fixed-size dense bitset over [0, num_docs)."""
+    """Fixed-size dense bitset over [0, num_docs).
 
-    __slots__ = ("num_docs", "_bytes")
+    ``version`` is a mutation counter bumped AFTER every in-place change
+    (set/clear/resize): upsert validDocIds mutate in place without the
+    owning segment object changing, so any cache staging this bitmap's
+    contents (the device-resident mask tier, ops/engine.py) keys on the
+    version — a mutation addresses a fresh key and the stale staged copy
+    becomes unreachable. Bump-after-mutate means a racing reader that
+    snapshots (version, mask) can only ever pair an OLD stamp with
+    equal-or-newer contents — never serve contents older than its stamp.
+    """
+
+    __slots__ = ("num_docs", "_bytes", "version", "_full_memo")
 
     def __init__(self, num_docs: int, buf: Optional[np.ndarray] = None):
         self.num_docs = num_docs
+        self.version = 0
+        self._full_memo: Optional[tuple] = None
         nbytes = (num_docs + 7) // 8
         if buf is None:
             self._bytes = np.zeros(nbytes, dtype=np.uint8)
@@ -109,11 +121,24 @@ class Bitmap:
     def is_empty(self) -> bool:
         return not self._bytes.any()
 
+    def is_full(self) -> bool:
+        """True when every doc in [0, num_docs) is set — a no-op mask.
+        Memoized per mutation version: the star-tree gate asks this per
+        aggregation query, and an O(num_docs/8) popcount per query would
+        put bitmap scans back on the hot path."""
+        memo = self._full_memo
+        if memo is not None and memo[0] == self.version:
+            return memo[1]
+        full = self.cardinality() == self.num_docs
+        self._full_memo = (self.version, full)
+        return full
+
     def contains(self, doc_id: int) -> bool:
         return bool((self._bytes[doc_id >> 3] >> (7 - (doc_id & 7))) & 1)
 
     def clear(self, doc_id: int) -> None:
         self._bytes[doc_id >> 3] &= np.uint8(0xFF ^ (0x80 >> (doc_id & 7)))
+        self.version += 1
 
     def resize(self, num_docs: int) -> None:
         """Grow in place (mutable/realtime usage; bits init to 0)."""
@@ -122,9 +147,11 @@ class Bitmap:
             self._bytes = np.concatenate(
                 [self._bytes, np.zeros(nbytes - len(self._bytes), np.uint8)])
         self.num_docs = num_docs
+        self.version += 1
 
     def set(self, doc_id: int) -> None:
         self._bytes[doc_id >> 3] |= np.uint8(1 << (7 - (doc_id & 7)))
+        self.version += 1
 
     def to_mask(self) -> np.ndarray:
         """Dense bool mask of length num_docs (device-kernel input)."""

@@ -151,6 +151,17 @@ class PartitionDedupMetadataManager:
             self._seen.add(pk)
             return True
 
+    def add_segment(self, segment) -> None:
+        """Restart recovery: re-register a committed segment's primary
+        keys so a resumed consumer drops duplicates of rows it already
+        persisted (ref dedup metadata rebuild on server restart)."""
+        pk_cols = [np.asarray(segment.data_source(c).values())
+                   for c in self.pk_columns]
+        n = segment.num_docs
+        with self._lock:
+            for i in range(n):
+                self._seen.add(tuple(_py(c[i]) for c in pk_cols))
+
     @property
     def num_primary_keys(self) -> int:
         with self._lock:

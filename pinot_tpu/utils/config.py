@@ -83,6 +83,18 @@ KEYS: Dict[str, Any] = {
     # server-side grace added to the broker-shipped remaining budget
     # before the local deadline trips (absorbs clock skew + queue jitter)
     "pinot.server.query.deadline.grace.ms": 50,
+    # realtime ingestion backpressure (ingest/realtime_manager.py):
+    # .memory.bytes bounds one partition consumer's mutable bytes plus
+    # sealed-segments-awaiting-build bytes — approaching the budget
+    # shrinks fetch batches adaptively, reaching it PAUSES the consumer
+    # (0 = unbounded, the pre-backpressure behavior). .lag.pause.ms
+    # bounds how far a paused partition may fall behind: past it, the
+    # manager sheds memory by force-sealing the mutable into the build
+    # pipeline instead of pausing indefinitely (0 = no ceiling).
+    # .fetch.max.rows caps one fetch's messages (the adaptive ceiling).
+    "pinot.server.ingest.memory.bytes": 0,
+    "pinot.server.ingest.lag.pause.ms": 0.0,
+    "pinot.server.ingest.fetch.max.rows": 10_000,
     "pinot.broker.http.port": 8099,
     "pinot.broker.fanout.threads": 16,
     "pinot.broker.adaptive.selector": "hybrid",  # latency|inflight|hybrid

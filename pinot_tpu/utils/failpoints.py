@@ -28,8 +28,24 @@ Sites currently compiled in:
   netframe.send            — every framed send (coordination, cache, stream)
   connection.request       — broker->server request, response payload hook
   cache.remote.get         — remote cache-tier GET
-  ingest.realtime.consume  — realtime consume loop
+  ingest.realtime.consume  — realtime consume loop (a SimulatedCrash
+                             here VANISHES the consumer mid-batch — the
+                             SIGKILL stand-in; recovery = new manager
+                             from the committed offset + snapshots)
   ingest.tcp.frame         — TCP stream consumer edge
+  ingest.seal.build        — immutable-segment build start (both the
+                             async build-pool leg and the FSM path);
+                             errors retry with backoff, the sealed
+                             mutable keeps serving meanwhile
+  ingest.seal.swap         — before the warmed immutable swaps in over
+                             the sealed mutable (tdm.add_segment)
+  ingest.checkpoint        — replay-checkpoint persistence, payload hook
+                             (torn= truncates the offset payload: the
+                             manager persists NOTHING and retries —
+                             restart re-consumes, never corrupts)
+  ingest.upsert.apply      — per-row upsert metadata application,
+                             BEFORE any state lands (an armed error
+                             skips the row whole, never half-applied)
   controller.task.assign      — task-fabric lease grant
   controller.task.lease.renew — task-fabric heartbeat renewal
   controller.segment.replace  — the atomic minion segment swap

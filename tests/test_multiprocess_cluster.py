@@ -351,6 +351,124 @@ def test_server_restart_recovers_from_deep_store(tmp_path):
                 print(f"--- {name} ---\n{out[-2000:]}")
 
 
+def test_multiprocess_upsert_restart_recovers_snapshot(tmp_path):
+    """Server restart mid-stream on an UPSERT table (ISSUE 11 satellite):
+    the restarted process resumes from the persisted offset + the
+    validDocIds snapshots inside the deep-store tars — committed rows are
+    NOT replayed (the committed segment set is unchanged across the
+    restart) and upsert last-wins visibility converges exactly."""
+    from pinot_tpu.ingest.tcp_stream import StreamProducer, StreamServer
+    from pinot_tpu.models.table_config import (IngestionConfig,
+                                               StreamIngestionConfig,
+                                               UpsertConfig)
+    from pinot_tpu.models import TableType
+
+    coord_port = _free_port()
+    http_port = _free_port()
+    coordinator = f"127.0.0.1:{coord_port}"
+    stream = StreamServer()
+    stream.start()
+    procs = {}
+    try:
+        procs["controller"] = _spawn(
+            ["StartController", "--state-dir", str(tmp_path / "state"),
+             "--port", str(coord_port),
+             "--deep-store", f"file://{tmp_path}/store"])
+        _wait(lambda: _coord_up(coordinator), desc="controller up")
+        procs["server"] = _spawn(
+            ["StartServer", "--instance-id", "us0",
+             "--coordinator", coordinator])
+        procs["broker"] = _spawn(
+            ["StartBroker", "--coordinator", coordinator,
+             "--http-port", str(http_port)])
+
+        client = CoordinationClient(coordinator)
+        _wait(lambda: len(client.get_state()["instances"]) == 1,
+              desc="server registered")
+
+        prod = StreamProducer(stream.address)
+        prod.create_topic("upserts")
+        schema = Schema("ups", [
+            FieldSpec("pk", DataType.INT, FieldType.DIMENSION),
+            FieldSpec("ver", DataType.INT, FieldType.DIMENSION),
+            FieldSpec("v", DataType.INT, FieldType.METRIC)])
+        schema.primary_key_columns = ["pk"]
+        cfg = TableConfig(name="ups", table_type=TableType.REALTIME)
+        cfg.upsert = UpsertConfig(mode="FULL", comparison_column="ver")
+        cfg.ingestion = IngestionConfig(stream=StreamIngestionConfig(
+            stream_type="tcp", topic="upserts",
+            properties={"bootstrap": stream.address,
+                        "flushThresholdRows": "60",
+                        "flushThresholdTimeMs": "3600000"}))
+        client.add_table(cfg, schema)
+
+        # 120 events over 40 pks (ver 1..3): two sealed segments of 60
+        # docs; visible = 40 rows at the LAST version
+        for ver in (1, 2, 3):
+            for pk in range(40):
+                prod.publish("upserts", {"pk": pk, "ver": ver,
+                                         "v": pk * 10 + ver})
+        sql = "SELECT COUNT(*), SUM(v) FROM ups"
+        expect1 = [40, float(sum(pk * 10 + 3 for pk in range(40)))]
+
+        def caught_up():
+            resp = _post_query(http_port, sql)
+            rows = (resp.get("resultTable") or {}).get("rows")
+            return bool(rows) and rows[0] == expect1 and \
+                not resp.get("exceptions")
+        _wait(caught_up, timeout=60, desc="upsert rows via broker")
+
+        def committed_segments():
+            segs = client.get_state()["segments"].get("ups_REALTIME", {})
+            return {n for n, s in segs.items() if s["status"] == "ONLINE"}
+        _wait(lambda: len(committed_segments()) >= 2, timeout=30,
+              desc="two sealed upsert segments")
+        sealed_before = committed_segments()
+
+        # kill mid-stream, publish a newer version for half the pks
+        victim = procs.pop("server")
+        os.kill(victim.pid, signal.SIGKILL)
+        victim.wait(timeout=10)
+        for pk in range(20):
+            prod.publish("upserts", {"pk": pk, "ver": 4, "v": pk * 10 + 4})
+
+        # restart with the SAME instance id: reconcile loads the sealed
+        # tars (validDocIds snapshots inside), the realtime manager
+        # re-registers them into the upsert metadata, and consumption
+        # resumes from the persisted end_offset
+        procs["server_b"] = _spawn(
+            ["StartServer", "--instance-id", "us0",
+             "--coordinator", coordinator])
+        expect2 = [40, float(sum(pk * 10 + 4 for pk in range(20))
+                             + sum(pk * 10 + 3 for pk in range(20, 40)))]
+
+        def recovered():
+            resp = _post_query(http_port, sql)
+            rows = (resp.get("resultTable") or {}).get("rows")
+            return bool(rows) and rows[0] == expect2 and \
+                not resp.get("exceptions")
+        _wait(recovered, timeout=60,
+              desc="restarted server converged last-wins")
+
+        # no replay of committed rows: every pre-kill sealed segment is
+        # still there UNchanged (re-consumption would have re-sealed
+        # duplicate seqs / new names over the same offsets)
+        assert sealed_before <= committed_segments()
+    finally:
+        stream.stop()
+        for name, proc in procs.items():
+            if proc.poll() is None:
+                proc.terminate()
+        for name, proc in procs.items():
+            try:
+                out, _ = proc.communicate(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                out, _ = proc.communicate()
+            if out:
+                print(f"--- {name} ---\n{out[-2000:]}")
+
+
 def test_multiprocess_realtime_replicas_over_tcp_stream(tmp_path):
     """Two server PROCESSES consume the same TCP stream partition; the
     controller's completion FSM elects exactly one committer per segment;

@@ -1,0 +1,289 @@
+"""Device-path upsert: validDocIds as device mask tensors (SURVEY §2.3).
+
+Acceptance (ISSUE 11): an upsert table's query plans through the unified
+kernel factory with ZERO host-fallback segments, is bit-identical to the
+host result after interleaved upserts, and the steady state shows zero
+retraces with mask tensors resident. The mask stages as a
+(segment, "__valid__") pseudo-column through the residency tier,
+version-stamped by the bitmap mutation counter, so an in-place clear()
+invalidates the staged copy — never serves stale validity.
+"""
+import numpy as np
+import pytest
+
+from pinot_tpu.models import (DataType, FieldSpec, FieldType, Schema,
+                              TableConfig, TableType)
+from pinot_tpu.ops import kernels, residency
+from pinot_tpu.ops.engine import TpuOperatorExecutor
+from pinot_tpu.query import executor_cpu
+from pinot_tpu.query.executor import QueryExecutor
+from pinot_tpu.segment.bitmap import Bitmap
+from pinot_tpu.segment.creator import SegmentCreator
+from pinot_tpu.segment.loader import load_segment
+from tests.queries.harness import assert_responses_equal
+
+SQLS = [
+    "SELECT COUNT(*), SUM(m), MIN(m), MAX(m) FROM t WHERE d < 12 LIMIT 10",
+    "SELECT COUNT(*) FROM t LIMIT 10",
+    "SELECT s, COUNT(*), SUM(m) FROM t GROUP BY s ORDER BY s LIMIT 20",
+    "SELECT d, m FROM t WHERE m > 5000 ORDER BY m DESC LIMIT 25",
+    "SELECT DISTINCT s FROM t LIMIT 20",
+]
+
+
+@pytest.fixture()
+def segs(tmp_path):
+    schema = Schema("t", [
+        FieldSpec("d", DataType.INT, FieldType.DIMENSION),
+        FieldSpec("s", DataType.STRING, FieldType.DIMENSION),
+        FieldSpec("m", DataType.INT, FieldType.METRIC),
+    ])
+    tc = TableConfig("t", TableType.OFFLINE)
+    tc.indexing.no_dictionary_columns = ["m"]
+    creator = SegmentCreator(tc, schema)
+    rng = np.random.default_rng(11)
+    out = []
+    for i in range(3):
+        n = 3000
+        cols = {
+            "d": rng.integers(0, 20, n).astype(np.int32),
+            "s": np.array([f"v{x}" for x in rng.integers(0, 6, n)], object),
+            "m": rng.integers(0, 10000, n).astype(np.int32),
+        }
+        d = str(tmp_path / f"seg_{i}")
+        creator.build(cols, d, f"t_{i}")
+        out.append(load_segment(d))
+    # segments 0 and 1 carry live validDocIds (mixed batch: segment 2
+    # stays append-only — its mask row is a constant all-ones)
+    for s in out[:2]:
+        bm = Bitmap.all_set(s.num_docs)
+        for doc in range(0, s.num_docs, 3):
+            bm.clear(doc)
+        s.valid_doc_ids = bm
+    return out
+
+
+@pytest.fixture()
+def host_spy(monkeypatch):
+    """Counts host-executor segment runs — the zero-host-fallback probe."""
+    calls = []
+    orig = executor_cpu.execute_segment
+
+    def spy(seg, ctx):
+        calls.append(getattr(seg, "name", "?"))
+        return orig(seg, ctx)
+
+    monkeypatch.setattr(executor_cpu, "execute_segment", spy)
+    monkeypatch.setattr(
+        "pinot_tpu.query.executor.executor_cpu.execute_segment", spy,
+        raising=False)
+    return calls
+
+
+class TestDeviceUpsert:
+    def test_zero_host_fallback_bit_identical(self, segs, host_spy):
+        """The acceptance triple: plans through the kernel factory (zero
+        host-fallback segments), bit-identical to the host result after
+        interleaved upserts, zero steady-state retraces with masks
+        resident."""
+        eng = TpuOperatorExecutor()
+        cpu = QueryExecutor(segs, use_tpu=False)
+        tpu = QueryExecutor(segs, use_tpu=True, engine=eng)
+        for sql in SQLS:
+            a = cpu.execute(sql)
+            host_spy.clear()
+            b = tpu.execute(sql)
+            assert not a.exceptions and not b.exceptions, \
+                (sql, a.exceptions, b.exceptions)
+            assert_responses_equal(a, b, sql)
+            assert host_spy == [], \
+                f"host fallback for {sql!r}: {host_spy}"
+
+        # interleaved upserts: clear more bits (a consuming-segment row
+        # superseding sealed rows mutates the bitmap in place)
+        v = segs[0].valid_doc_ids
+        for doc in [d for d in range(segs[0].num_docs)
+                    if v.contains(d)][:200]:
+            v.clear(doc)
+        for sql in SQLS:
+            a = cpu.execute(sql)
+            host_spy.clear()
+            b = tpu.execute(sql)
+            assert_responses_equal(a, b, sql)
+            assert host_spy == []
+
+        # steady state: repeat every shape — nothing compiles, nothing
+        # ships over the link (masks + columns resident)
+        t0 = kernels.trace_count()
+        b0 = residency.column_transfer_bytes()
+        for sql in SQLS:
+            tpu.execute(sql)
+        assert kernels.trace_count() - t0 == 0, kernels.trace_log(8)
+        assert residency.column_transfer_bytes() - b0 == 0
+
+    def test_mask_mutation_invalidates_staged_copy(self, segs):
+        """An in-place clear() between queries must be visible on the
+        device path: the version-stamped key makes the stale block
+        unreachable. No retrace — only the one mask row re-ships."""
+        eng = TpuOperatorExecutor()
+        tpu = QueryExecutor(segs, use_tpu=True, engine=eng)
+        sql = "SELECT COUNT(*) FROM t LIMIT 10"
+        r1 = tpu.execute(sql).rows[0][0]
+        v = segs[1].valid_doc_ids
+        live = [d for d in range(segs[1].num_docs) if v.contains(d)][:10]
+        for d in live:
+            v.clear(d)
+        t0 = kernels.trace_count()
+        r2 = tpu.execute(sql).rows[0][0]
+        assert r2 == r1 - len(live)
+        assert kernels.trace_count() - t0 == 0
+
+    def test_fully_masked_segment(self, segs):
+        """Every doc superseded: the segment contributes nothing, and
+        matched counts honor it (num_segments_matched drops)."""
+        v = segs[0].valid_doc_ids
+        for d in range(segs[0].num_docs):
+            if v.contains(d):
+                v.clear(d)
+        eng = TpuOperatorExecutor()
+        cpu = QueryExecutor(segs, use_tpu=False)
+        tpu = QueryExecutor(segs, use_tpu=True, engine=eng)
+        for sql in SQLS:
+            assert_responses_equal(cpu.execute(sql), tpu.execute(sql), sql)
+
+    def test_mse_scan_doc_ids_honor_mask(self, segs):
+        """filtered_doc_ids (the MSE leaf-scan join input) rides the topn
+        kernel: superseded docs never appear in the returned indices."""
+        from pinot_tpu.query.context import QueryContext
+        eng = TpuOperatorExecutor()
+        ctx = QueryContext.from_sql("SELECT d FROM t WHERE d < 50 LIMIT 10")
+        ids = eng.filtered_doc_ids(segs, ctx.filter)
+        assert ids[0] is not None and ids[1] is not None
+        v0 = segs[0].valid_doc_ids
+        assert all(v0.contains(int(d)) for d in ids[0])
+        # append-only member of the batch returns the full match set
+        assert len(ids[2]) == segs[2].num_docs
+
+    def test_cache_ineligibility_unchanged(self, segs):
+        """Upsert segments stay OUT of the tier-2 partial cache (the
+        bitmap mutates without a version change) — the ISSUE keeps
+        cache/segment_cache.py rules as-is."""
+        from pinot_tpu.cache.segment_cache import is_cacheable_segment
+        assert not is_cacheable_segment(segs[0])
+        assert is_cacheable_segment(segs[2])
+
+    def test_batched_coalesce_with_masks(self, segs):
+        """Fingerprint-equal concurrent queries over an upsert batch
+        coalesce into one jit(vmap) launch and stay bit-identical to
+        per-query execution (the kernel-factory bar, now with masks)."""
+        import threading
+        from pinot_tpu.utils.config import PinotConfiguration
+        cfg = PinotConfiguration(
+            overrides={"pinot.server.dispatch.batch.window.ms": 20.0})
+        eng = TpuOperatorExecutor(config=cfg)
+        ex = QueryExecutor(segs, use_tpu=True, engine=eng)
+        cpu = QueryExecutor(segs, use_tpu=False)
+        sqls = [f"SELECT COUNT(*), SUM(m) FROM t WHERE d < {k} LIMIT 5"
+                for k in (6, 9, 13, 17)]
+        for sql in sqls:  # warm shapes
+            ex.execute(sql)
+        outs = [None] * len(sqls)
+
+        def run(i):
+            outs[i] = ex.execute(sqls[i])
+
+        ts = [threading.Thread(target=run, args=(i,))
+              for i in range(len(sqls))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        for sql, out in zip(sqls, outs):
+            assert_responses_equal(cpu.execute(sql), out, sql)
+
+
+class TestUpsertWarmup:
+    def test_seal_warmup_prestages_upsert_columns(self, segs):
+        """Warm-before-swap for upsert tables: the result cache rightly
+        skips them (mutating bitmap), but the warmup replay still
+        prestages their column + mask blocks into HBM residency — the
+        zero-gap pipeline's residency half. The first routed query then
+        ships zero column bytes."""
+        from pinot_tpu.cache.segment_cache import SegmentResultCache
+        from pinot_tpu.cache.warmup import FingerprintLog, SegmentWarmup
+        from pinot_tpu.query.context import QueryContext
+        eng = TpuOperatorExecutor()
+        log = FingerprintLog()
+        sql = "SELECT COUNT(*), SUM(m) FROM t WHERE d < 12 LIMIT 10"
+        ctx = QueryContext.from_sql(sql)
+        log.record("t", ctx.fingerprint(), sql)
+        warm = SegmentWarmup(log, SegmentResultCache(), use_tpu=True,
+                             engine_fn=lambda: eng)
+        seg = segs[0]  # upsert segment: live valid_doc_ids
+        warm.warm("t", seg)
+        assert warm.segments_prestaged == 1
+        assert eng.residency.resident_for(seg.name) > 0
+        # the first routed query pays compute, not the link
+        b0 = residency.column_transfer_bytes()
+        ex = QueryExecutor([seg], use_tpu=True, engine=eng)
+        r = ex.execute(sql)
+        assert not r.exceptions
+        assert residency.column_transfer_bytes() - b0 == 0
+
+
+class TestMeshUpsert:
+    def test_doc_sharded_mask_bit_identical(self, segs):
+        """The vmask block shards over (segments, docs) like every other
+        column block: a 2x2 mesh engine's psum-combined result stays
+        bit-identical to the host path with masks live."""
+        import jax
+        if len(jax.devices()) < 4:
+            pytest.skip("needs 4 devices (conftest sets the device count)")
+        from pinot_tpu.parallel.mesh import make_mesh
+        mesh = make_mesh(jax.devices()[:4], doc_axis=2)
+        eng = TpuOperatorExecutor(mesh=mesh)
+        cpu = QueryExecutor(segs, use_tpu=False)
+        tpu = QueryExecutor(segs, use_tpu=True, engine=eng)
+        for sql in [SQLS[0], SQLS[2]]:
+            a, b = cpu.execute(sql), tpu.execute(sql)
+            assert not b.exceptions, b.exceptions
+            assert_responses_equal(a, b, sql)
+
+
+class TestStarTreeMaskAware:
+    def test_full_bitmap_keeps_star_tree_partial_disables(self, tmp_path):
+        """Mask-aware star-tree gating: an all-set bitmap is a no-op mask
+        (tree still serves, totals exact); one cleared bit disqualifies
+        the pre-aggregated path."""
+        schema = Schema("st", [
+            FieldSpec("d", DataType.INT, FieldType.DIMENSION),
+            FieldSpec("m", DataType.INT, FieldType.METRIC),
+        ])
+        tc = TableConfig("st", TableType.OFFLINE)
+        from pinot_tpu.models.table_config import StarTreeIndexConfig
+        tc.indexing.star_tree_configs = [StarTreeIndexConfig(
+            dimensions_split_order=["d"],
+            function_column_pairs=["SUM__m", "COUNT__*"])]
+        creator = SegmentCreator(tc, schema)
+        n = 2000
+        rng = np.random.default_rng(5)
+        cols = {"d": rng.integers(0, 8, n).astype(np.int32),
+                "m": rng.integers(0, 100, n).astype(np.int32)}
+        d = str(tmp_path / "seg")
+        creator.build(cols, d, "st_0")
+        seg = load_segment(d)
+        sql = "SELECT SUM(m), COUNT(*) FROM st WHERE d < 4 LIMIT 5"
+        base = QueryExecutor([seg], use_tpu=False).execute(sql)
+
+        seg.valid_doc_ids = Bitmap.all_set(n)
+        full = QueryExecutor([seg], use_tpu=False).execute(sql)
+        assert_responses_equal(base, full, sql)
+
+        # clear a matching doc: the mask now bites and the result drops
+        dcol = np.asarray(seg.data_source("d").values())
+        mcol = np.asarray(seg.data_source("m").values())
+        victim = int(np.flatnonzero(dcol < 4)[0])
+        seg.valid_doc_ids.clear(victim)
+        masked = QueryExecutor([seg], use_tpu=False).execute(sql)
+        assert masked.rows[0][0] == base.rows[0][0] - int(mcol[victim])
+        assert masked.rows[0][1] == base.rows[0][1] - 1
