@@ -26,12 +26,23 @@ class BrokerHttpServer:
                 pass
 
             def do_GET(self):
-                if self.path == "/health":
+                path = self.path.partition("?")[0].rstrip("/") or "/"
+                if path == "/health":
                     body = b"OK"
-                elif self.path == "/metrics":
+                elif path == "/metrics":
                     from pinot_tpu.utils.metrics import get_registry
                     body = get_registry("broker").prometheus_text().encode() \
                         + get_registry("server").prometheus_text().encode()
+                elif path.startswith("/debug/"):
+                    # /debug/traces[/<id>] + /debug/queries: the broker's
+                    # trace store + in-flight registry (trace_store.py)
+                    from pinot_tpu.utils.trace_store import debug_payload
+                    payload = debug_payload("broker", path)
+                    if payload is None:
+                        self.send_response(404)
+                        self.end_headers()
+                        return
+                    body = json.dumps(payload, default=str).encode()
                 else:
                     self.send_response(404)
                     self.end_headers()

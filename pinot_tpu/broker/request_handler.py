@@ -21,6 +21,7 @@ from pinot_tpu.query.reduce import BrokerResponse, reduce_results
 from pinot_tpu.server import datatable
 from pinot_tpu.server.query_server import ServerConnection
 from pinot_tpu.broker.routing import BrokerRoutingManager
+from pinot_tpu.utils import tracing, trace_store
 from pinot_tpu.utils.accounting import BrokerTimeoutError
 from pinot_tpu.utils.failpoints import fire
 
@@ -118,12 +119,21 @@ class BrokerRequestHandler:
                 "pinot.broker.hedge.delay.max.ms") / 1000.0
             self._default_timeout_ms = float(
                 config.get_int("pinot.broker.timeout.ms"))
+            self._trace_enabled = config.get_bool(
+                "pinot.trace.enabled", True)
+            self._slow_threshold_ms = config.get_float(
+                "pinot.broker.slow.query.threshold.ms")
+            self._trace_capacity = config.get_int(
+                "pinot.trace.store.capacity")
         else:
             self._negative_cache = NegativeResultCache(
                 metrics=self._metrics, labels=neg_labels)
             self._hedge_enabled = False
             self._hedge_min_s, self._hedge_max_s = 0.025, 1.0
             self._default_timeout_ms = 60000.0
+            self._trace_enabled = True
+            self._slow_threshold_ms = 10000.0
+            self._trace_capacity = None
         #: query ids must be unique ACROSS brokers — two brokers' counters
         #: both start at 1, and the server's accountant keys cancels by id
         self._broker_nonce = uuid.uuid4().hex[:6]
@@ -227,10 +237,58 @@ class BrokerRequestHandler:
                 if self._selector is not None else 0.0)
         return min(max(base, self._hedge_min_s), self._hedge_max_s)
 
+    @staticmethod
+    def _phase(phase: str, detail: str = "") -> None:
+        """Update the in-flight registry for the CURRENT query's trace
+        (no-op when tracing is off) — /debug/queries reads it."""
+        req = tracing.current_request()
+        if req is not None:
+            trace_store.get_inflight("broker").phase(
+                req.trace_id, phase, detail)
+
+    def handle(self, sql: str) -> BrokerResponse:
+        """Traced entry point: every query runs under a shadow span tree
+        (tracing.RequestTrace). trace=true queries return the stitched
+        cross-process tree as traceInfo; queries at/over
+        pinot.broker.slow.query.threshold.ms retain their tree in the
+        broker trace store (tail-based capture) and emit a structured
+        slow-query log line even with trace=false. With
+        pinot.trace.enabled=false none of this machinery exists."""
+        if not self._trace_enabled:
+            return self._handle_inner(sql)
+        rt = tracing.RequestTrace(sampled=False)
+        inflight = trace_store.get_inflight("broker")
+        inflight.begin(rt.trace_id, sql=sql, trace_id=rt.trace_id)
+        try:
+            with rt:
+                resp = self._handle_inner(sql)
+        finally:
+            inflight.end(rt.trace_id)
+        dur = rt.root.duration_ms
+        self._metrics.add_timing("broker_query_ms", dur,
+                                 exemplar=rt.trace_id)
+        slow = (self._slow_threshold_ms > 0
+                and dur >= self._slow_threshold_ms)
+        if rt.sampled:
+            resp.trace = rt.to_dict()
+        if rt.sampled or slow:
+            trace_store.get_store("broker", self._trace_capacity).record(
+                rt.trace_id, rt.to_dict(), sql=sql, duration_ms=dur,
+                slow=slow,
+                extra={"partialResult": bool(resp.partial_result)})
+            if slow:
+                trace_store.log_slow_query(
+                    "broker", rt.trace_id, sql, dur,
+                    self._slow_threshold_ms,
+                    partialResult=bool(resp.partial_result),
+                    exceptions=len(resp.exceptions or []))
+                self._metrics.add_meter("slow_queries")
+        return resp
+
     def _timed_request(self, conn, server, physical_table, sql,
                        segment_names, request_id, extra_filter,
                        deadline=None, query_id=None, tenant=None,
-                       group=None):
+                       group=None, trace_wire=None):
         """conn.request wrapped with adaptive-selector stats (latency +
         in-flight, ref adaptiveserverselector's ServerRoutingStats).
         The remaining budget is computed HERE, on the pool thread at
@@ -251,19 +309,22 @@ class BrokerRequestHandler:
             return conn.request(physical_table, sql, segment_names,
                                 request_id, extra_filter,
                                 timeout_ms=timeout_ms, query_id=query_id,
-                                tenant=tenant)
+                                tenant=tenant, trace_ctx=trace_wire)
         sel.record_start(server)
         t0 = time.time()
         try:
             return conn.request(physical_table, sql, segment_names,
                                 request_id, extra_filter,
                                 timeout_ms=timeout_ms, query_id=query_id,
-                                tenant=tenant)
+                                tenant=tenant, trace_ctx=trace_wire)
         finally:
             sel.record_end(server, time.time() - t0)
 
-    def handle(self, sql: str) -> BrokerResponse:
+    def _handle_inner(self, sql: str) -> BrokerResponse:
         start = time.time()
+        req_trace = tracing.current_request()
+        root_h = tracing.capture()
+        self._phase("parse")
         try:
             query = parse_sql(sql)
             ctx = QueryContext.from_query(query)
@@ -296,6 +357,12 @@ class BrokerRequestHandler:
                 return self.mse_dispatcher.submit(
                     sql, parsed, default_timeout_ms=self._default_timeout_ms)
             return _error_response(150, f"SQLParsingError: {e}", start)
+        if req_trace is not None:
+            # the client's trace=true upgrades the shadow trace to a
+            # sampled one: the stitched tree returns as traceInfo
+            if ctx.options.get("trace", "").lower() == "true":
+                req_trace.sampled = True
+            root_h.set(table=ctx.table)
         quota_reason = self._check_quota(ctx.table)
         if quota_reason:
             return _error_response(
@@ -304,6 +371,7 @@ class BrokerRequestHandler:
                 query.options.get("useMultistageEngine", "").lower() == "true":
             return self.mse_dispatcher.submit(
                 sql, default_timeout_ms=self._default_timeout_ms)
+        self._phase("route", ctx.table)
         route = self.routing.get_route(ctx.table)
         if route is None:
             return _error_response(
@@ -415,7 +483,8 @@ class BrokerRequestHandler:
                         offline_key = key
 
         units: List[_ScatterUnit] = []
-        fut_map: Dict = {}  # live future -> (unit, server, is_hedge, aid)
+        #: live future -> (unit, server, is_hedge, attempt id, span)
+        fut_map: Dict = {}
         attempt_seq = [0]
         tenant = self._tenant_of(ctx.table)
 
@@ -465,6 +534,18 @@ class BrokerRequestHandler:
             # retry of this query that lands on the same server
             attempt_seq[0] += 1
             aid = f"{query_id}.{attempt_seq[0]}"
+            # one span per scatter ATTEMPT: hedge/retry attempts appear
+            # as siblings; the server's own tree grafts under it when
+            # the response lands (process). The wire context carries a
+            # fresh parent span id per attempt.
+            sp = trace_wire = None
+            if root_h is not None:
+                sp = root_h.child(
+                    "ServerScatter", server=server, table=unit.table,
+                    segments=len(unit.names or ()), attempt=aid,
+                    **({"hedge": True} if is_hedge else {}),
+                    **({"retry": True} if unit.retried else {}))
+                trace_wire = req_trace.wire_context()
             # the time-boundary predicate travels as a separate field,
             # ANDed into the filter TREE server-side — splicing SQL
             # text is unsound (keywords inside identifiers/literals).
@@ -474,8 +555,8 @@ class BrokerRequestHandler:
             fut = self._pool.submit(
                 self._timed_request, conn, server, unit.table, sql,
                 unit.names, request_id, unit.extra, deadline, aid,
-                tenant, group_of(unit.table, server))
-            fut_map[fut] = (unit, server, is_hedge, aid)
+                tenant, group_of(unit.table, server), trace_wire)
+            fut_map[fut] = (unit, server, is_hedge, aid, sp)
             unit.live += 1
             return True
 
@@ -489,7 +570,7 @@ class BrokerRequestHandler:
             logical unit (primary, whole-set hedge, split-hedge children)
             server-side so abandoned work frees its scheduler thread.
             Attempt-scoped, so nothing else of this query is touched."""
-            for _f, (u, server, _h, aid) in list(fut_map.items()):
+            for _f, (u, server, _h, aid, _sp) in list(fut_map.items()):
                 if u is unit or u.parent is unit:
                     cancel_attempt(server, aid)
 
@@ -562,14 +643,17 @@ class BrokerRequestHandler:
                     child.done = True
 
         def process(fut) -> None:
-            unit, server, is_hedge, _aid = fut_map.pop(fut)
+            unit, server, is_hedge, _aid, sp = fut_map.pop(fut)
             unit.live -= 1
             L = unit.logical
             try:
                 payload = fut.result()
-                server_results, server_exc, stats_extra = \
-                    datatable.deserialize_results(payload)
+                server_results, server_exc, stats_extra, server_trace = \
+                    datatable.deserialize_results_ex(payload)
             except Exception as e:  # noqa: BLE001 — partial results
+                if sp is not None:
+                    sp.end(error=f"{type(e).__name__}: {e}",
+                           outcome="failed")
                 # connection-level failure: mark unhealthy (routing skips
                 # it until the backoff expires, ref
                 # ConnectionFailureDetector — and for grouped tables the
@@ -587,8 +671,16 @@ class BrokerRequestHandler:
                 resolve_failed(L, e)
                 return
             self.failure_detector.mark_success(server)
+            if sp is not None:
+                # the server's own span tree stitches under this
+                # attempt's scatter span — ONE cross-process tree
+                sp.graft(server_trace)
+                sp.end()
             if L.done:
-                return  # hedge race loser — drop, never double-merge
+                # hedge race loser — drop, never double-merge
+                if sp is not None:
+                    sp.set(outcome="loser")
+                return
             if unit.parent is None:
                 # primary / whole-set hedge attempt: covers ALL of L's
                 # segments, so it can merge only while NO child answered
@@ -614,16 +706,22 @@ class BrokerRequestHandler:
                     self._metrics.add_meter(
                         "hedge_won" if is_hedge else "hedge_wasted")
                     cancel_family(L)
+                    if sp is not None:
+                        sp.set(outcome="winner")
                 merge(unit, server_results, server_exc, stats_extra)
                 return
             # split-hedge child: per-segment dedup — merge iff none of
             # its (disjoint-by-construction) segments was answered yet
             if set(unit.names) & L.answered:
+                if sp is not None:
+                    sp.set(outcome="loser")
                 return
             if server_exc and (unit.live > 0 or L.live > 0):
                 unit.fallback = (server_results, server_exc, stats_extra)
                 return
             unit.done = True
+            if sp is not None and is_hedge:
+                sp.set(outcome="winner")
             merge(unit, server_results, server_exc, stats_extra)
             L.answered.update(unit.names)
             if not L.pending_names():
@@ -682,6 +780,7 @@ class BrokerRequestHandler:
                     self._metrics.add_meter("hedge_issued")
                     self._metrics.add_meter("hedge_split")
 
+        self._phase("scatter", ctx.table)
         for server, physical_table, segment_names, extra_filter in plan:
             unit = _ScatterUnit(server, physical_table, segment_names,
                                 extra_filter)
@@ -689,6 +788,7 @@ class BrokerRequestHandler:
             if not launch(unit, server):
                 unit.done = True
 
+        self._phase("gather", ctx.table)
         # -- gather: deadline-derived waits, no per-future magic numbers.
         # Exit as soon as every UNIT resolved — a hedge race's losing
         # future may stay in flight long after its unit completed, and
@@ -710,10 +810,18 @@ class BrokerRequestHandler:
             maybe_hedge()
 
         abandoned: Dict[int, Tuple[_ScatterUnit, List[str]]] = {}
-        for fut, (unit, server, _h, aid) in fut_map.items():
+        for fut, (unit, server, _h, aid, sp) in fut_map.items():
             if not unit.done:
                 abandoned.setdefault(id(unit), (unit, []))[1].append(server)
                 cancel_attempt(server, aid)
+                if sp is not None:
+                    sp.end(outcome="abandoned")
+            elif sp is not None:
+                # hedge-race loser whose future is still in flight when
+                # the gather exits (process() will never run for it):
+                # close its span honestly — duration = time until the
+                # race resolved against it, no server tree
+                sp.end(outcome="loser")
         if abandoned:
             # deadline expired with work outstanding: surface a typed
             # 250 partial per abandoned unit, cancel the server-side
@@ -760,7 +868,9 @@ class BrokerRequestHandler:
                                                   offline_results,
                                                   stats=merged_stats)
 
-        resp = reduce_results(ctx, results)
+        self._phase("reduce", ctx.table)
+        with tracing.Scope("BrokerReduce", servers=responded):
+            resp = reduce_results(ctx, results)
         for extra in server_stats:
             resp.stats.merge(extra)
         resp.exceptions = exceptions

@@ -38,6 +38,7 @@ from typing import Dict, Optional
 
 from pinot_tpu.cache.core import LruTtlCache
 from pinot_tpu.segment import codec
+from pinot_tpu.utils import tracing
 from pinot_tpu.utils.failpoints import FailpointError, fire
 from pinot_tpu.utils.netframe import (MAX_FRAME, recv_frame, recv_raw_frame,
                                       send_frame, send_raw_frame)
@@ -405,6 +406,20 @@ class RemoteCacheBackend:
     def get_with_ttl(self, key: str
                      ) -> Optional[tuple]:
         """(payload, remaining server-side TTL seconds or None)."""
+        if not tracing.active():
+            return self._get_with_ttl(key)
+        # traced hop: the span times the RTT client-side, and the trace
+        # id rides the request header so cache-server logs/stats can
+        # correlate an op back to the query that issued it
+        with tracing.Scope("RemoteCacheGet",
+                           node=f"{self.host}:{self.port}") as sc:
+            out = self._get_with_ttl(key, tracing.current_trace_id())
+            sc.set(hit=out is not None,
+                   bytes=len(out[0]) if out is not None else 0)
+            return out
+
+    def _get_with_ttl(self, key: str,
+                      trace_id: Optional[str] = None) -> Optional[tuple]:
         try:
             # chaos site: a slow/dead/lying remote tier — the breaker and
             # the total-function contract below must absorb all of it
@@ -414,7 +429,10 @@ class RemoteCacheBackend:
             self._meter("errors")
             self.breaker.record_failure()
             return None
-        out = self._request({"op": "get", "key": key})
+        header: Dict[str, object] = {"op": "get", "key": key}
+        if trace_id:
+            header["trace"] = trace_id
+        out = self._request(header)
         if out is None:
             return None
         resp, body = out
@@ -447,8 +465,17 @@ class RemoteCacheBackend:
         header: Dict[str, object] = {"op": "set", "key": key}
         if ttl_seconds is not None:
             header["ttl"] = float(ttl_seconds)
-        out = self._request(header, payload)
-        return bool(out is not None and out[0].get("ok"))
+        if not tracing.active():
+            out = self._request(header, payload)
+            return bool(out is not None and out[0].get("ok"))
+        tid = tracing.current_trace_id()
+        if tid:
+            header["trace"] = tid
+        with tracing.Scope("RemoteCachePut",
+                           node=f"{self.host}:{self.port}",
+                           bytes=len(payload)):
+            out = self._request(header, payload)
+            return bool(out is not None and out[0].get("ok"))
 
     def delete(self, key: str) -> bool:
         out = self._request({"op": "delete", "key": key})

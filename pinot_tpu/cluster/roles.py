@@ -28,6 +28,29 @@ from pinot_tpu.controller.coordination import CoordinationClient
 log = logging.getLogger(__name__)
 
 
+def _start_admin(cfg, key: str, roles) -> Optional[object]:
+    """Per-role /metrics + /debug surface (trace_store.DebugHttpServer)
+    for roles without an HTTP edge. Knob semantics: 0 = ephemeral port,
+    >0 = fixed, <0 = disabled."""
+    try:
+        port = int(cfg.get(key, 0) or 0)
+    except (TypeError, ValueError):
+        port = 0
+    if port < 0:
+        return None
+    from pinot_tpu.utils.trace_store import DebugHttpServer
+    try:
+        srv = DebugHttpServer(roles, port=port)
+        srv.start()
+    except OSError as e:
+        # a debug-only surface must never take the data-serving role
+        # down with it (port already owned, bind denied, ...)
+        log.warning("admin http (%s=%s) failed to bind: %s — "
+                    "continuing without it", key, port, e)
+        return None
+    return srv
+
+
 def run_controller(state_dir: str, port: int = 0, host: str = "127.0.0.1",
                    deep_store_uri: Optional[str] = None,
                    http_port: Optional[int] = None, config=None,
@@ -109,6 +132,11 @@ def run_cache_server(port: int = 0, host: str = "127.0.0.1", config=None,
         ttl_seconds=cfg.get_float("pinot.cache.server.ttl.seconds"),
         metrics=get_registry("cache_server"))
     server.start()
+    admin = _start_admin(cfg, "pinot.cache.server.admin.port",
+                         ["cache_server"])
+    if admin is not None:
+        print(f"cache server admin http on {admin.host}:{admin.port}",
+              flush=True)
     print(f"cache server listening on {server.address}", flush=True)
     if ready_event is not None:
         ready_event.set()
@@ -117,6 +145,8 @@ def run_cache_server(port: int = 0, host: str = "127.0.0.1", config=None,
         while not stop.wait(2.0):
             pass
     finally:
+        if admin is not None:
+            admin.stop()
         server.stop()
 
 
@@ -137,6 +167,10 @@ def run_minion(instance_id: str, coordinator: str,
     worker = MinionWorker(instance_id, coordinator, work_dir=work_dir,
                           task_types=task_types, config=cfg)
     worker.start()
+    admin = _start_admin(cfg, "pinot.minion.admin.port", ["minion"])
+    if admin is not None:
+        print(f"minion admin http on {admin.host}:{admin.port}",
+              flush=True)
     print(f"minion {instance_id} polling {coordinator}", flush=True)
     if ready_event is not None:
         ready_event.set()
@@ -148,6 +182,8 @@ def run_minion(instance_id: str, coordinator: str,
             except (ConnectionError, OSError, RuntimeError):
                 pass
     finally:
+        if admin is not None:
+            admin.stop()
         worker.stop()
 
 
@@ -203,12 +239,19 @@ class ServerRole:
         #: None = the DefaultTenant pool
         self.tenant = tenant
         self._reconcile_lock = threading.Lock()
+        #: per-role ops surface: /metrics + /debug/traces + /debug/queries
+        self.admin_http = None
 
     #: partition-discovery refresh interval
     RT_PARTITION_TTL_S = 30.0
 
     def start(self) -> None:
         self.transport.start()
+        self.admin_http = _start_admin(
+            self.config, "pinot.server.admin.port", ["server"])
+        if self.admin_http is not None:
+            log.info("server %s admin http on %s:%s", self.instance_id,
+                     self.admin_http.host, self.admin_http.port)
         self.client.register_instance(
             self.instance_id, self.transport.host, self.transport.port,
             tags=[f"tenant:{self.tenant}"] if self.tenant else None)
@@ -216,6 +259,9 @@ class ServerRole:
         self.client.watch(lambda _v: self.reconcile())
 
     def stop(self) -> None:
+        if self.admin_http is not None:
+            self.admin_http.stop()
+            self.admin_http = None
         with self._reconcile_lock:  # no reconcile mid-shutdown
             self._stopping = True
             managers = list(self._rt_managers.values())

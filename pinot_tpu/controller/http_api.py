@@ -86,6 +86,23 @@ class ControllerHttpServer:
                 path = path.rstrip("/")
                 if method == "GET" and path == "/health":
                     return self._reply(200, {"status": "OK"})
+                if method == "GET" and path == "/metrics":
+                    from pinot_tpu.utils.metrics import get_registry
+                    body = get_registry(
+                        "controller").prometheus_text().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                if method == "GET" and path.startswith("/debug/"):
+                    from pinot_tpu.utils.trace_store import debug_payload
+                    payload = debug_payload("controller", path)
+                    if payload is None:
+                        return self._reply(404,
+                                           {"error": f"no route {path}"})
+                    return self._reply(200, payload)
                 if path == "/tasks" or path.startswith("/tasks/"):
                     return self._route_tasks(method, path, query)
                 if path == "/tables" and method == "GET":

@@ -561,6 +561,14 @@ class TaskManager:
 
     # -- queue facade (coordination ops call through here) -------------
     def submit(self, task: TaskConfig) -> TaskEntry:
+        # cross-process trace propagation: a task submitted from a
+        # traced context (admin op, future query-driven builds) carries
+        # the TraceContext in its params; the leasing minion joins the
+        # trace and ships its span tree back on completion
+        from pinot_tpu.utils import tracing
+        req = tracing.current_request()
+        if req is not None and "traceContext" not in task.params:
+            task.params["traceContext"] = req.wire_context()
         return self.queue.submit(task)
 
     def lease(self, worker: str,

@@ -122,6 +122,13 @@ class MinionWorker:
         self.task_types = types  # None = all registered task types
         self.concurrency = max(
             1, cfg.get_int("pinot.minion.executor.concurrency"))
+        #: distributed tracing: every task runs under a span tree (the
+        #: submitter's TraceContext from params when shipped, else a
+        #: fresh trace id); the tree returns in task_complete's result
+        self.trace_enabled = cfg.get_bool("pinot.trace.enabled", True)
+        self._slow_task_ms = cfg.get_float(
+            "pinot.minion.slow.task.threshold.ms")
+        self._trace_capacity = cfg.get_int("pinot.trace.store.capacity")
         self.work_dir = work_dir or cfg.get_str("pinot.minion.work.dir") \
             or tempfile.mkdtemp(prefix=f"pinot_tpu_minion_{instance_id}_")
         self._metrics = metrics if metrics is not None \
@@ -225,9 +232,49 @@ class MinionWorker:
                                     labels=self._labels)
             t.start()
 
+    def _run_traced(self, entry: dict) -> None:
+        """Run one task under a span tree: the submitter's TraceContext
+        (params["traceContext"]) joins the submitting query's trace when
+        shipped; otherwise the task gets its own trace id. The tree
+        ships back in task_complete's result (retrievable via
+        /tasks/{id}) and tail-captures into the minion trace store when
+        the task runs over pinot.minion.slow.task.threshold.ms."""
+        if not self.trace_enabled:
+            return self._run_task(entry)
+        from pinot_tpu.utils import tracing, trace_store
+        tc = tracing.TraceContext.from_wire(
+            (entry.get("params") or {}).get("traceContext"))
+        rt = tracing.RequestTrace(
+            request_id=entry["task_id"], operator="MinionTask",
+            trace_id=tc.trace_id if tc is not None else None,
+            sampled=bool(tc is not None and tc.sampled),
+            minion=self.instance_id, taskType=entry["task_type"],
+            table=entry["table"])
+        created = entry.get("created_at") or 0.0
+        if created:
+            rt.handle().set(queueWaitMs=round(
+                max(0.0, time.time() - created) * 1000.0, 3))
+        try:
+            with rt:
+                self._run_task(entry)
+        finally:
+            dur = rt.root.duration_ms
+            slow = self._slow_task_ms > 0 and dur >= self._slow_task_ms
+            if rt.sampled or slow:
+                trace_store.get_store("minion", self._trace_capacity).record(
+                    rt.trace_id, rt.to_dict(),
+                    sql=f"task:{entry['task_type']}", duration_ms=dur,
+                    slow=slow, extra={"taskId": entry["task_id"],
+                                      "minion": self.instance_id})
+                if slow:
+                    trace_store.log_slow_query(
+                        "minion", rt.trace_id,
+                        f"task:{entry['task_type']}", dur,
+                        self._slow_task_ms, taskId=entry["task_id"])
+
     def _task_thread(self, entry: dict) -> None:
         try:
-            self._run_task(entry)
+            self._run_traced(entry)
         except SimulatedCrash:
             # chaos kill: vanish WITHOUT reporting — recovery must
             # come from lease expiry, exactly like a dead process.
@@ -271,10 +318,14 @@ class MinionWorker:
             store = self._store(blob)
             manifest = self._read_manifest(store, task_id)
             if manifest is None:
-                adds, removes, result = self._execute(task, blob, sandbox,
-                                                      cancel)
+                from pinot_tpu.utils import tracing
+                with tracing.Scope("TaskExecute",
+                                   taskType=task.task_type):
+                    adds, removes, result = self._execute(
+                        task, blob, sandbox, cancel)
                 self._report_progress(task_id, "uploading")
-                adds = self._upload_outputs(store, adds)
+                with tracing.Scope("TaskUpload", outputs=len(adds)):
+                    adds = self._upload_outputs(store, adds)
                 manifest = {"taskId": task_id,
                             "adds": [a.to_dict() for a in adds],
                             "removes": [list(r) for r in removes],
@@ -293,16 +344,38 @@ class MinionWorker:
             if self._vanished.is_set():
                 return  # a sibling crashed the worker: commit nothing
             self._report_progress(task_id, "committing")
-            self.client.request(
-                "segment_replace", task_id=task_id,
-                adds=manifest["adds"], removes=manifest["removes"])
+            from pinot_tpu.utils import tracing
+            with tracing.Scope("TaskCommit",
+                               adds=len(manifest["adds"]),
+                               removes=len(manifest["removes"])):
+                # the COMMIT is the swap; task_complete below is the
+                # reporting call that carries the finished tree
+                self.client.request(
+                    "segment_replace", task_id=task_id,
+                    adds=manifest["adds"], removes=manifest["removes"])
+            result = manifest["result"]
+            req = tracing.current_request()
+            if req is not None:
+                # the task's span tree rides the completion record: the
+                # controller stores it on the TaskEntry, so /tasks/{id}
+                # shows WHERE a slow task spent its time. The enclosing
+                # RequestTrace is still open — stamp the root duration
+                # with the elapsed-so-far so the shipped tree's total is
+                # honest, not 0.0
+                req.root.duration_ms = \
+                    time.perf_counter() * 1000.0 - req.root.start_ms
+                result = dict(result) if isinstance(result, dict) else \
+                    {"value": result}
+                result["traceId"] = req.trace_id
+                result["trace"] = req.to_dict()
             self.client.request("task_complete", task_id=task_id,
                                 worker=self.instance_id,
-                                result=manifest["result"])
+                                result=result)
             self._metrics.add_timing(
                 "minion_task_duration_ms",
                 (time.perf_counter() - t0) * 1000.0,
-                labels={"taskType": task.task_type})
+                labels={"taskType": task.task_type},
+                exemplar=tracing.current_trace_id())
             if store is not None:
                 # outputs are durable in the deep store; without one the
                 # sandbox IS the committed segments' home — keep it

@@ -25,6 +25,7 @@ from pinot_tpu.mse.runtime import MseWorker, ScanFn, StageContext, run_stage
 from pinot_tpu.mse.sql import parse_mse_sql
 from pinot_tpu.query.reduce import BrokerResponse, ResultTable
 from pinot_tpu.query.results import ExecutionStats
+from pinot_tpu.utils import tracing
 from pinot_tpu.utils.accounting import (
     BrokerTimeoutError, QueryCancelledError)
 from pinot_tpu.utils.failpoints import fire
@@ -468,6 +469,47 @@ class QueryDispatcher:
         start = time.time()
         deadline = start + timeout if self.enforce_deadlines else None
 
+        # -- distributed tracing (ISSUE 12) ----------------------------
+        # the MSE rides the enclosing BrokerRequest trace: every stage
+        # dispatch ships a TraceContext, workers return per-attempt span
+        # trees over the control plane, and they stitch under one
+        # MseQuery span here. trace=true parses MSE-side, so the
+        # upgrade to sampled happens here too.
+        req_trace = tracing.current_request()
+        if req_trace is not None and \
+                plan.options.get("trace", "").lower() == "true":
+            req_trace.sampled = True
+        root_h = tracing.capture()
+        mse_h = None
+        trace_wire = None
+        stage_trees: List[dict] = []
+        trees_cond = threading.Condition()
+        #: stage attempts dispatched with a sink: the stitch barrier
+        #: below waits (briefly) until each has reported its tree — a
+        #: worker's trace_sink fires just AFTER its final EOS send, so
+        #: the broker's root stage can finish first
+        trees_expected = [0]
+        trace_sink = None
+        if root_h is not None and req_trace is not None:
+            mse_h = root_h.child("MseQuery", queryId=qid,
+                                 stages=len(plan.stages))
+            trace_wire = req_trace.wire_context()
+
+            def trace_sink(_inst, _sid, _widx, _attempt, tree):
+                with trees_cond:
+                    stage_trees.append(tree)
+                    trees_cond.notify_all()
+
+        def note_dispatched():
+            # called AFTER submit_stage returns: every dispatched
+            # attempt now reports through trace_sink exactly once
+            # (tree, rejection stub, or untraced stub), so the barrier
+            # count is exact; a sink firing before the increment only
+            # overshoots len(), which releases the wait early — safe
+            if trace_sink is not None:
+                with trees_cond:
+                    trees_expected[0] += 1
+
         addresses: Dict[str, str] = {}
         for s in plan.stages:
             for w, inst in enumerate(s.workers):
@@ -544,12 +586,15 @@ class QueryDispatcher:
                     self.workers[inst].submit_stage(
                         qid, plan_json, sj, w, addresses, timeout=timeout,
                         deadline=deadline, claim_fn=claim_fn,
-                        on_done=on_done)
+                        on_done=on_done, trace_ctx=trace_wire,
+                        trace_sink=trace_sink)
+                    note_dispatched()
             if book is not None:
                 threading.Thread(
                     target=self._hedge_monitor,
                     args=(qid, plan, plan_json, addresses, timeout,
-                          deadline, book, done_event, on_done, make_claim),
+                          deadline, book, done_event, on_done, make_claim,
+                          trace_wire, trace_sink, note_dispatched),
                     daemon=True, name=f"mse-hedge-{qid}").start()
 
             ctx = StageContext(
@@ -558,7 +603,13 @@ class QueryDispatcher:
                 timeout=timeout, deadline=deadline,
                 cancel_event=cancel_event)
             try:
-                block = run_stage(ctx, plan.root)
+                if mse_h is not None:
+                    # the broker-side root stage's op scopes land under
+                    # the MseQuery span, beside the stitched stage trees
+                    with mse_h.activate():
+                        block = run_stage(ctx, plan.root)
+                else:
+                    block = run_stage(ctx, plan.root)
             except (BrokerTimeoutError, MailboxTimeout) as e:
                 # broker-side miss: answer typed, with honest per-stage
                 # progress (the BaseException hook below fans out the
@@ -590,10 +641,41 @@ class QueryDispatcher:
             done_event.set()
             with self._inflight_lock:
                 self._inflight.pop(qid, None)
+            if mse_h is not None:
+                # stitch: every stage attempt's shipped tree grafts under
+                # the MseQuery span; hedged slots tag winner/loser from
+                # the claim book (the claimed attempt sent the output).
+                # BARRIER: a worker's trace_sink fires just after its
+                # final EOS send, so the broker can get here first —
+                # wait (bounded; normally sub-ms) for the dispatched
+                # attempts' trees on the success path. Failure paths
+                # skip the wait: a cancelled query's workers may never
+                # report, and stitching a partial tree is fine there.
+                import sys as _sys
+                with trees_cond:
+                    if _sys.exc_info()[0] is None:
+                        wall = time.time() + 0.25
+                        while len(stage_trees) < trees_expected[0] \
+                                and time.time() < wall:
+                            trees_cond.wait(0.02)
+                    got = list(stage_trees)
+                for tree in got:
+                    if book is not None:
+                        key = (tree.get("stage"), tree.get("workerIdx"))
+                        with book.lock:
+                            hedged = key in book.hedged
+                            won = book.claimed.get(key) == \
+                                tree.get("attempt")
+                        if hedged:
+                            tree["outcome"] = \
+                                "winner" if won else "loser"
+                    mse_h.graft(tree)
+                mse_h.end()
 
     def _hedge_monitor(self, qid, plan, plan_json, addresses, timeout,
                        deadline, book: _HedgeBook, done_event, on_done,
-                       make_claim) -> None:
+                       make_claim, trace_wire=None, trace_sink=None,
+                       note_dispatched=None) -> None:
         """After the adaptive delay, re-issue every still-straggling LEAF
         stage instance on an alive peer with an identical local segment
         view; first clean attempt claims the output, the loser is
@@ -646,7 +728,14 @@ class QueryDispatcher:
                     alive[target].submit_stage(
                         qid, plan_json, sj, w, addresses,
                         timeout=timeout, deadline=deadline, attempt=1,
-                        claim_fn=make_claim(key, 1), on_done=on_done)
+                        claim_fn=make_claim(key, 1), on_done=on_done,
+                        trace_ctx=trace_wire, trace_sink=trace_sink)
+                    if note_dispatched is not None:
+                        # hedge attempts count toward the stitch
+                        # barrier too — an uncounted hedge tree would
+                        # release the len()-based wait while a primary
+                        # tree is still in flight
+                        note_dispatched()
                 except Exception:  # noqa: BLE001 — hedge is best effort
                     book.finish(key, 1, False)
 

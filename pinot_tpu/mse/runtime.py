@@ -23,6 +23,7 @@ from pinot_tpu.mse.mailbox import (
     FLAG_EOS, FLAG_ERROR, MailboxError, MailboxService, mailbox_key)
 from pinot_tpu.mse.planner import QueryPlan, StagePlan
 from pinot_tpu.mse.serde import expr_from_json, exprs_from_json
+from pinot_tpu.utils import tracing
 from pinot_tpu.utils.accounting import (
     BrokerTimeoutError, QueryCancelledError)
 from pinot_tpu.utils.failpoints import SimulatedCrash, fire
@@ -192,35 +193,42 @@ def _propagate_error(ctx: StageContext, stage: StagePlan, msg: str) -> None:
 
 
 def _send_output(ctx: StageContext, stage: StagePlan, block: Block) -> None:
-    receivers = ctx.plan.stage(stage.receiver_stage)
-    nw = len(receivers.workers)
-    if stage.out_kind == "hash" and nw > 1:
-        keys = exprs_from_json(stage.out_keys)
-        parts = ops.hash_partition(block, keys, nw)
-    elif stage.out_kind == "broadcast":
-        parts = [block] * nw
-    else:  # singleton
-        parts = [block] + [None] * (nw - 1)
-    for w in range(nw):
-        key = mailbox_key(ctx.query_id, stage.stage_id,
-                          stage.receiver_stage, w)
-        addr = ctx.addresses[f"{stage.receiver_stage}:{w}"]
-        part = parts[w]
-        if part is None or not part.num_rows:
-            ctx.mailbox.send(addr, key, b"", FLAG_EOS)
-            continue
-        # pipelined sends: a large partition ships as <= chunk_rows
-        # frames (EOS rides the last) so a fold-capable receiver merges
-        # the head of this output while the tail is still serializing —
-        # and while SLOWER sibling senders are still computing
-        chunk = ctx.chunk_rows if ctx.pipeline else part.num_rows
-        n = part.num_rows
-        starts = list(range(0, n, chunk))
-        for i, s in enumerate(starts):
-            piece = part if len(starts) == 1 else \
-                part.take(np.arange(s, min(s + chunk, n)))
-            flags = FLAG_EOS if i == len(starts) - 1 else 0
-            ctx.mailbox.send(addr, key, piece.to_bytes(), flags)
+    with tracing.Scope("mse:send", kind=stage.out_kind) as sc:
+        receivers = ctx.plan.stage(stage.receiver_stage)
+        nw = len(receivers.workers)
+        if stage.out_kind == "hash" and nw > 1:
+            keys = exprs_from_json(stage.out_keys)
+            parts = ops.hash_partition(block, keys, nw)
+        elif stage.out_kind == "broadcast":
+            parts = [block] * nw
+        else:  # singleton
+            parts = [block] + [None] * (nw - 1)
+        frames = sent_bytes = 0
+        for w in range(nw):
+            key = mailbox_key(ctx.query_id, stage.stage_id,
+                              stage.receiver_stage, w)
+            addr = ctx.addresses[f"{stage.receiver_stage}:{w}"]
+            part = parts[w]
+            if part is None or not part.num_rows:
+                ctx.mailbox.send(addr, key, b"", FLAG_EOS)
+                frames += 1
+                continue
+            # pipelined sends: a large partition ships as <= chunk_rows
+            # frames (EOS rides the last) so a fold-capable receiver merges
+            # the head of this output while the tail is still serializing —
+            # and while SLOWER sibling senders are still computing
+            chunk = ctx.chunk_rows if ctx.pipeline else part.num_rows
+            n = part.num_rows
+            starts = list(range(0, n, chunk))
+            for i, s in enumerate(starts):
+                piece = part if len(starts) == 1 else \
+                    part.take(np.arange(s, min(s + chunk, n)))
+                flags = FLAG_EOS if i == len(starts) - 1 else 0
+                payload = piece.to_bytes()
+                ctx.mailbox.send(addr, key, payload, flags)
+                frames += 1
+                sent_bytes += len(payload)
+        sc.set(frames=frames, bytes=sent_bytes, receivers=nw)
 
 
 # ---------------------------------------------------------------------------
@@ -233,6 +241,19 @@ def _run_op(ctx: StageContext, op: Dict[str, Any]) -> Block:
     # between units of work" discipline as the per-segment loop
     ctx.check()
     kind = op["op"]
+    if not tracing.active():
+        return _run_op_inner(ctx, op, kind)
+    # one span per op — the InvocationScope-around-nextBlock parity for
+    # the multi-stage engine (stage threads run under the attempt's
+    # RequestTrace, so these nest into the shipped tree)
+    with tracing.Scope("mse:" + kind) as sc:
+        block = _run_op_inner(ctx, op, kind)
+        sc.set(rows=block.num_rows)
+        return block
+
+
+def _run_op_inner(ctx: StageContext, op: Dict[str, Any],
+                  kind: str) -> Block:
     if kind == "receive":
         return _op_receive(ctx, op)
     if kind == "scan":
@@ -326,18 +347,30 @@ def _receive_chunks(ctx: StageContext, op: Dict[str, Any]):
         ctx.addresses[f"{sender.stage_id}:{w}"]
         for w in range(len(sender.workers))
         if f"{sender.stage_id}:{w}" in ctx.addresses]
-    for p in ctx.mailbox.receive_all(
-            key, num_senders=len(sender.workers), timeout=ctx.timeout,
-            deadline=ctx.deadline, cancel_event=ctx.cancel_event,
-            sender_addresses=sender_addresses):
-        try:
-            b = Block.from_bytes(p)
-        except Exception as e:  # noqa: BLE001 — torn/corrupt frame
-            raise MailboxError(
-                f"mailbox {key}: undecodable frame "
-                f"({type(e).__name__}: {e})") from e
-        if b.num_rows:
-            yield b
+    frames = rbytes = 0
+    t0 = time.perf_counter()
+    try:
+        for p in ctx.mailbox.receive_all(
+                key, num_senders=len(sender.workers), timeout=ctx.timeout,
+                deadline=ctx.deadline, cancel_event=ctx.cancel_event,
+                sender_addresses=sender_addresses):
+            frames += 1
+            rbytes += len(p)
+            try:
+                b = Block.from_bytes(p)
+            except Exception as e:  # noqa: BLE001 — torn/corrupt frame
+                raise MailboxError(
+                    f"mailbox {key}: undecodable frame "
+                    f"({type(e).__name__}: {e})") from e
+            if b.num_rows:
+                yield b
+    finally:
+        # receive-side shuffle accounting on the enclosing op span
+        # (mse:receive, or the folding aggregate) — frames/bytes plus
+        # how long this instance sat consuming the mailbox
+        tracing.annotate(
+            recvFrames=frames, recvBytes=rbytes,
+            recvMs=round((time.perf_counter() - t0) * 1e3, 3))
 
 
 def _watermarked(ctx: StageContext, chunks):
@@ -549,6 +582,9 @@ class MseWorker:
         self.chunk_rows = cfg.get_int("pinot.server.mse.pipeline.chunk.rows")
         self.watermark_rows = cfg.get_int(
             "pinot.server.mse.pipeline.watermark.rows")
+        #: distributed tracing: stages run under a per-attempt span tree
+        #: when the dispatcher ships a TraceContext (utils/tracing.py)
+        self.trace_enabled = cfg.get_bool("pinot.trace.enabled", True)
         #: per-query parsed-plan memo: a query's N stage submits share
         #: ONE QueryPlan parse instead of re-deserializing every stage
         #: of the plan N times (a measurable slice of MSE host cost on
@@ -597,7 +633,8 @@ class MseWorker:
                      timeout: float = 60.0,
                      deadline: Optional[float] = None,
                      attempt: int = 0, claim_fn=None,
-                     on_done=None) -> None:
+                     on_done=None, trace_ctx: Optional[dict] = None,
+                     trace_sink=None) -> None:
         """Async: schedule one stage instance on the pool. ``deadline``
         is the query's absolute wall-clock budget (travels with the
         stage; enforced cooperatively and on every mailbox wait).
@@ -610,8 +647,26 @@ class MseWorker:
         report (data-plane silence — no frames — is unaffected): a
         leaked 'pending' attempt would make the hedge book hold a
         twin's error claim forever and turn a fast failure into a
-        full-deadline hang."""
+        full-deadline hang. ``trace_ctx``/``trace_sink``: the shipped
+        TraceContext wire dict and the control-plane callback
+        ``trace_sink(instance, stage_id, worker_idx, attempt, tree)``
+        this attempt's finished span tree reports through (the
+        response-metadata analog for the in-process control plane)."""
         def _reject():
+            # BOTH control-plane observers fire on rejection: a counted
+            # dispatch whose sink never reports would stall the
+            # dispatcher's stitch barrier for its full timeout
+            if trace_sink is not None:
+                try:
+                    trace_sink(self.instance_id, stage_json["stageId"],
+                               worker_idx, attempt,
+                               {"operator": "MseStage", "durationMs": 0.0,
+                                "instance": self.instance_id,
+                                "stage": stage_json["stageId"],
+                                "workerIdx": worker_idx,
+                                "attempt": attempt, "rejected": True})
+                except Exception:  # noqa: BLE001 — observer only
+                    pass
             if on_done is not None:
                 try:
                     on_done(self.instance_id, stage_json["stageId"],
@@ -650,16 +705,33 @@ class MseWorker:
                 return
             self._active.setdefault(query_id, []).append(ctx)
 
+        tc = tracing.TraceContext.from_wire(trace_ctx) \
+            if self.trace_enabled else None
+
         def _run():
             t0 = time.time()
             ok = False
+            rt = None
+            if tc is not None:
+                # per-ATTEMPT span tree: the stage runs under it so op
+                # scopes (and the leaf executor's instrumentation) nest
+                # into the tree the dispatcher stitches
+                rt = tracing.RequestTrace(
+                    request_id=query_id, operator="MseStage",
+                    trace_id=tc.trace_id, sampled=tc.sampled,
+                    instance=self.instance_id, stage=stage.stage_id,
+                    workerIdx=worker_idx, attempt=attempt)
             try:
                 # chaos kill site: SimulatedCrash here (or anywhere in
                 # the stage, incl. a mid-shuffle mailbox send) makes the
                 # whole worker vanish — no error frames, mailbox gone
                 fire("mse.worker.crash", instance=self.instance_id,
                      query_id=query_id, stage=stage.stage_id)
-                run_stage(ctx, stage)
+                if rt is not None:
+                    with rt:
+                        run_stage(ctx, stage)
+                else:
+                    run_stage(ctx, stage)
                 ok = True
             except SimulatedCrash:
                 # the whole worker vanishes, not just this stage: flag
@@ -691,6 +763,22 @@ class MseWorker:
                 # reported even on a chaos crash: the observer is
                 # control-plane (the worker's DATA-plane silence — no
                 # error frames — is what the crash semantics require)
+                if trace_sink is not None:
+                    # even a trace-disabled worker reports a stub: the
+                    # dispatcher counted this attempt at dispatch and
+                    # its stitch barrier waits for every count
+                    try:
+                        trace_sink(
+                            self.instance_id, stage.stage_id,
+                            worker_idx, attempt,
+                            rt.to_dict() if rt is not None else
+                            {"operator": "MseStage", "durationMs": 0.0,
+                             "instance": self.instance_id,
+                             "stage": stage.stage_id,
+                             "workerIdx": worker_idx, "attempt": attempt,
+                             "untraced": True})
+                    except Exception:  # noqa: BLE001 — observer only
+                        pass
                 if on_done is not None:
                     try:
                         on_done(self.instance_id, stage.stage_id,

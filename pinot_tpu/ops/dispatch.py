@@ -183,7 +183,8 @@ class Launch:
 
     __slots__ = ("call", "plan", "cols", "params", "num_docs", "D", "G",
                  "batch_key", "cols_key", "factory", "dedup_factory",
-                 "collective", "cancel_check", "site_ctx", "future")
+                 "collective", "cancel_check", "site_ctx", "future",
+                 "span", "enq_ts")
 
     def __init__(self, call: Callable[[], Any], plan=None, cols=None,
                  params=None, num_docs=None, D: int = 0, G: int = 0,
@@ -193,7 +194,8 @@ class Launch:
                  dedup_factory: Optional[Callable[[int, int], Any]] = None,
                  collective: bool = False,
                  cancel_check: Optional[Callable[[], None]] = None,
-                 site_ctx: Optional[Dict[str, Any]] = None):
+                 site_ctx: Optional[Dict[str, Any]] = None,
+                 span=None):
         self.call = call
         self.plan = plan
         self.cols = cols
@@ -212,6 +214,11 @@ class Launch:
         self.cancel_check = cancel_check
         self.site_ctx = site_ctx or {}
         self.future: Future = Future()
+        #: tracing.SpanHandle captured on the CALLER thread (contextvars
+        #: don't flow into the ring/launch/fetch pools) — the dispatcher
+        #: attaches queue-wait / batch / kernel / fetch attrs through it
+        self.span = span
+        self.enq_ts = 0.0
 
 
 class KernelDispatcher:
@@ -377,6 +384,7 @@ class KernelDispatcher:
         """Enqueue a staged launch; returns its future (an np.ndarray of
         the packed kernel output, or the launch's error). Blocks for ring
         space (backpressure), polling the launch's cancel check."""
+        launch.enq_ts = time.monotonic()
         if self.mode == "serialized":
             return self._submit_serialized(launch)
         with self._cv:
@@ -423,12 +431,22 @@ class KernelDispatcher:
             guard = _CPU_COLLECTIVE_LOCK if launch.collective \
                 else contextlib.nullcontext()
             self._busy_begin()
+            t0 = time.monotonic()
             try:
                 with guard:
                     packed = np.asarray(launch.call())
             finally:
                 self._busy_end()
                 self._meter_traces()
+            if launch.span is not None:
+                # inline path: kernel + fetch are one sync round trip
+                launch.span.set(
+                    queueWaitMs=round(
+                        (t0 - launch.enq_ts) * 1e3, 3)
+                    if launch.enq_ts else 0.0,
+                    batchSize=1, variant="inline",
+                    kernelMs=round((time.monotonic() - t0) * 1e3, 3),
+                    fetchMs=0.0)
             launch.future.set_result(packed)
         except BaseException as e:  # noqa: BLE001 — future carries it
             launch.future.set_exception(e)
@@ -506,6 +524,15 @@ class KernelDispatcher:
         if not live:
             return
         self.observe("dispatch_batch_size", float(len(live)))
+        now = time.monotonic()
+        for it in live:
+            if it.span is not None:
+                # each coalesced member reports into its OWN trace: the
+                # shared launch's facts land on N distinct span trees
+                it.span.set(
+                    queueWaitMs=round((now - it.enq_ts) * 1e3, 3)
+                    if it.enq_ts else 0.0,
+                    batchSize=len(live))
         batched = len(live) > 1
         if batched:
             # pad to the batch-size bucket with replicated leader inputs
@@ -569,14 +596,22 @@ class KernelDispatcher:
             else:
                 call = lambda: kern(lead.cols, plist,  # noqa: E731
                                     lead.num_docs, D=lead.D, G=lead.G)
+            variant = ("dedup" if dedup else
+                       "stacked" if stacked else "broadcast")
+            for it in live:
+                if it.span is not None:
+                    it.span.set(variant=variant)
         else:
             call = live[0].call
+            if live[0].span is not None:
+                live[0].span.set(variant="single")
         if live[0].collective:
             # CPU-collective ordering: ONE partitioned program in flight
             # process-wide; block on the ring (compute completion), then
             # hand the ready buffers to the fetch pool so the NEXT
             # launch overlaps this result's host assembly
             self._busy_begin()
+            t0 = time.monotonic()
             try:
                 with _CPU_COLLECTIVE_LOCK:
                     out = call()
@@ -586,7 +621,8 @@ class KernelDispatcher:
                 for it in live:
                     it.future.set_exception(e)
                 return
-            fetch_pool().submit(self._finish, live, out, batched)
+            fetch_pool().submit(self._finish, live, out, batched,
+                                (time.monotonic() - t0) * 1e3)
         else:
             # fully concurrent submission (real accelerators order their
             # own queue; non-partitioned host programs don't rendezvous)
@@ -621,6 +657,8 @@ class KernelDispatcher:
 
     def _run_and_finish(self, live: List[Launch], call, batched: bool) -> None:
         self._busy_begin()
+        t0 = time.monotonic()
+        traces0 = kernels.trace_count()
         try:
             out = call()
         except BaseException as e:  # noqa: BLE001
@@ -629,15 +667,26 @@ class KernelDispatcher:
                 it.future.set_exception(e)
             self._meter_traces()
             return
-        self._finish(live, out, batched)
+        kernel_ms = (time.monotonic() - t0) * 1e3
+        # best-effort retrace attribution: a concurrent launch's trace
+        # could land in this window, but a retrace on the steady path is
+        # a bug worth a loud mark either way
+        retraces = kernels.trace_count() - traces0
+        if retraces > 0:
+            for it in live:
+                if it.span is not None:
+                    it.span.set(retraceEvents=retraces)
+        self._finish(live, out, batched, kernel_ms)
 
-    def _finish(self, live: List[Launch], out, batched: bool) -> None:
+    def _finish(self, live: List[Launch], out, batched: bool,
+                kernel_ms: Optional[float] = None) -> None:
         """Fetch (device->host) + split per caller; runs OFF the ring.
         The busy interval (opened at launch) closes when the fetch lands
         — and BEFORE the futures resolve: a caller woken by its result
         must observe an idle dispatcher, or its next lone submit would
         race the busy bookkeeping and needlessly take the ring path
         (the inline fast path is what keeps lone p50 at the floor)."""
+        t0 = time.monotonic()
         try:
             arr = np.asarray(out)
         except BaseException as e:  # noqa: BLE001
@@ -649,6 +698,12 @@ class KernelDispatcher:
             return
         self._busy_end()
         self._meter_traces()
+        fetch_ms = (time.monotonic() - t0) * 1e3
+        for it in live:
+            if it.span is not None:
+                it.span.set(fetchMs=round(fetch_ms, 3),
+                            **({"kernelMs": round(kernel_ms, 3)}
+                               if kernel_ms is not None else {}))
         try:
             if batched:
                 for member, it in zip(split_packed(arr, len(live)), live):

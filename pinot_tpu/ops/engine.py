@@ -20,6 +20,7 @@ Responsibilities:
 from __future__ import annotations
 
 import threading
+import time
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -39,6 +40,7 @@ from pinot_tpu.query.filter import resolve_predicate
 from pinot_tpu.query.results import (
     AggregationResult, ExecutionStats, GroupByResult)
 from pinot_tpu.segment.loader import DataSource, ImmutableSegment
+from pinot_tpu.utils import tracing
 
 MAX_DEVICE_GROUPS = 1 << 20
 #: cap on the [S, G, slots] group-by result buffer (f32/f64 accumulators)
@@ -304,17 +306,36 @@ class TpuOperatorExecutor:
             and isinstance(kernel, jax.stages.Wrapped)
 
     def _prepare_agg(self, segments: List[ImmutableSegment],
-                     ctx: QueryContext, cancel_check=None):
+                     ctx: QueryContext, cancel_check=None,
+                     parent_span=None):
         """Plan + stage under the engine lock (they mutate the block
         caches), then wrap the launch for the dispatch ring. Returns
         (plan, slots_of_fn, S_real, Launch), or None -> host fallback.
         The staging_overlap_ms histogram records how much of this staging
         ran while another query's kernel occupied the device — the
-        pipeline's third leg (staging/compute overlap)."""
+        pipeline's third leg (staging/compute overlap).
+
+        parent_span: explicit tracing.SpanHandle for callers off the
+        request thread (execute_async stages on the staging pool, where
+        the trace contextvar doesn't flow); sync callers inherit the
+        contextvar. The DeviceDispatch child span carries staging ms,
+        residency hit/miss counts, and host->device transfer bytes —
+        exact per query because staging holds the engine lock."""
+        if parent_span is None:
+            parent_span = tracing.capture()
+        dsp = None
+        if parent_span is not None:
+            dsp = parent_span.child("DeviceDispatch", table=ctx.table,
+                                    mode="agg")
         busy0 = self._dispatcher.busy_ms()
         with self._engine_lock:
+            # snapshot INSIDE the lock: the diff must cover exactly this
+            # query's staging, not a concurrent stager's
+            stage_info = self._staging_snapshot(dsp)
             plan_info = self._plan(segments, ctx)
             if plan_info is None:
+                if dsp is not None:
+                    dsp.end(outcome="hostFallback")
                 return None
             plan, slots_of_fn = plan_info
             # resolve the kernel BEFORE staging: non-batchable launches
@@ -341,7 +362,11 @@ class TpuOperatorExecutor:
                 cols, params, num_docs, S_real, D, G = self._stage(
                     segments, ctx, plan, batchable=batchable)
             except _NotStageable:
+                if dsp is not None:
+                    dsp.end(outcome="hostFallback")
                 return None
+            self._staging_attrs(dsp, stage_info, S=int(num_docs.shape[0]),
+                                D=D, G=G)
         overlap = self._dispatcher.busy_ms() - busy0
         if overlap > 0:
             self._dispatcher.observe("staging_overlap_ms", overlap)
@@ -367,8 +392,40 @@ class TpuOperatorExecutor:
             factory=factory, dedup_factory=dedup_factory,
             collective=self._needs_cpu_ordering(kernel),
             cancel_check=cancel_check,
-            site_ctx={"table": ctx.table, "mode": "agg"})
+            site_ctx={"table": ctx.table, "mode": "agg"}, span=dsp)
         return plan, slots_of_fn, S_real, launch
+
+    # -- staging trace attrs -------------------------------------------
+    def _staging_snapshot(self, dsp):
+        """Counters to diff across a traced staging pass (None span ->
+        no snapshot cost). Exact per query: _stage runs under the engine
+        lock, so no other query's staging interleaves."""
+        if dsp is None:
+            return None
+        from pinot_tpu.ops import residency as residency_mod
+        hits = misses = 0.0
+        if self._metrics is not None:
+            hits = self._metrics.meter("hbm_block_hit", labels=self._labels)
+            misses = self._metrics.meter("hbm_block_miss",
+                                         labels=self._labels)
+        return (time.perf_counter(), residency_mod.transfer_bytes(),
+                hits, misses)
+
+    def _staging_attrs(self, dsp, snap, **dims) -> None:
+        if dsp is None or snap is None:
+            return
+        from pinot_tpu.ops import residency as residency_mod
+        t0, xfer0, hits0, misses0 = snap
+        attrs = dict(
+            stagingMs=round((time.perf_counter() - t0) * 1e3, 3),
+            transferBytes=int(residency_mod.transfer_bytes() - xfer0),
+            **dims)
+        if self._metrics is not None:
+            attrs["hbmBlockHits"] = int(self._metrics.meter(
+                "hbm_block_hit", labels=self._labels) - hits0)
+            attrs["hbmBlockMisses"] = int(self._metrics.meter(
+                "hbm_block_miss", labels=self._labels) - misses0)
+        dsp.set(**attrs)
 
     def execute(self, segments: List[ImmutableSegment], ctx: QueryContext,
                 cancel_check=None
@@ -392,7 +449,11 @@ class TpuOperatorExecutor:
             if prep is None:
                 return [], segments
             plan, slots_of_fn, S_real, launch = prep
-            packed = self._dispatcher.submit(launch).result()
+            try:
+                packed = self._dispatcher.submit(launch).result()
+            finally:
+                if launch.span is not None:
+                    launch.span.end()
         results = self._assemble(segments, ctx, plan, packed, S_real, slots_of_fn)
         return results, []
 
@@ -416,10 +477,14 @@ class TpuOperatorExecutor:
         out: "_Future" = _Future()
         self._dispatcher.enter_active()
         out.add_done_callback(lambda _f: self._dispatcher.exit_active())
+        # capture on the CALLER thread: staging runs on the staging pool
+        # where the trace contextvar doesn't flow
+        parent_span = tracing.capture()
 
         def stage_and_enqueue():
             try:
-                prep = self._prepare_agg(segments, ctx, cancel_check)
+                prep = self._prepare_agg(segments, ctx, cancel_check,
+                                         parent_span=parent_span)
                 if prep is None:
                     out.set_result(([], segments))
                     return
@@ -434,6 +499,9 @@ class TpuOperatorExecutor:
                             slots_of_fn), []))
                     except BaseException as e:  # noqa: BLE001
                         out.set_exception(e)
+                    finally:
+                        if launch.span is not None:
+                            launch.span.end()
 
                 lfut.add_done_callback(finish)
             except BaseException as e:  # noqa: BLE001
@@ -473,9 +541,17 @@ class TpuOperatorExecutor:
         paying one XLA launch per stage per query. Caller must hold no
         engine state; returns (S_real, Launch) or None -> host path.
         Must be called with doc_axis == 1 (sharded top-K stays host)."""
+        dsp = None
+        parent_span = tracing.capture()
+        if parent_span is not None:
+            dsp = parent_span.child("DeviceDispatch", table=ctx.table,
+                                    mode=mode)
         with self._engine_lock:
+            stage_info = self._staging_snapshot(dsp)
             plan = self._plan_topn(segments, ctx)
             if plan is None:
+                if dsp is not None:
+                    dsp.end(outcome="hostFallback")
                 return None
             kernel = kernels.compiled_topn_kernel(plan)
             batchable = isinstance(kernel, jax.stages.Wrapped)
@@ -483,7 +559,11 @@ class TpuOperatorExecutor:
                 cols, params, num_docs, S_real, D, _G = self._stage(
                     segments, ctx, plan, batchable=batchable)
             except _NotStageable:
+                if dsp is not None:
+                    dsp.end(outcome="hostFallback")
                 return None
+            self._staging_attrs(dsp, stage_info, S=int(num_docs.shape[0]),
+                                D=D)
         batch_key = None
         if batchable and self._dispatcher.batch_max > 1:
             if self._cross_table and D <= self._doc_bucket_max:
@@ -500,7 +580,7 @@ class TpuOperatorExecutor:
                      kernels.compiled_batched_topn_kernel(_p, B, stacked)),
             collective=self._needs_cpu_ordering(kernel),
             cancel_check=cancel_check,
-            site_ctx={"table": ctx.table, "mode": mode})
+            site_ctx={"table": ctx.table, "mode": mode}, span=dsp)
         return S_real, launch
 
     def _execute_topn(self, segments, ctx: QueryContext, cancel_check=None):
@@ -511,7 +591,11 @@ class TpuOperatorExecutor:
             return [], segments
         S_real, launch = prep
         with self._dispatcher.active():
-            packed = self._dispatcher.submit(launch).result()
+            try:
+                packed = self._dispatcher.submit(launch).result()
+            finally:
+                if launch.span is not None:
+                    launch.span.end()
         return self._assemble_topn(segments, ctx, packed, S_real), []
 
     # ------------------------------------------------------------------
@@ -795,7 +879,11 @@ class TpuOperatorExecutor:
         S_real, launch = prep
         plan = launch.plan
         with self._dispatcher.active():
-            packed = self._dispatcher.submit(launch).result()
+            try:
+                packed = self._dispatcher.submit(launch).result()
+            finally:
+                if launch.span is not None:
+                    launch.span.end()
         out = []
         for s, seg in enumerate(segments[:S_real]):
             matched = int(packed[s, 0])

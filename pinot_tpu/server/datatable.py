@@ -248,7 +248,12 @@ def serialize_results(results: List[Any], exceptions: List[dict] = (),
                       extra_stats: Optional[ExecutionStats] = None) -> bytes:
     """Server response: list of shape-tagged SegmentResults + exceptions +
     server-level stats (pruning counts survive even with zero results —
-    the reference carries these in DataTable metadata)."""
+    the reference carries these in DataTable metadata).
+
+    Layout note: a server-side span tree may be APPENDED to the returned
+    bytes as one extra tagged value (ServerQueryExecutor.execute does
+    `payload + serialize_value(tree)`); readers that stop at the result
+    count skip it, `deserialize_results_ex` picks it up."""
     w = _Writer()
     w.raw(MAGIC)
     w.value([_exc_tuple(e) for e in exceptions])
@@ -281,6 +286,14 @@ def serialize_results(results: List[Any], exceptions: List[dict] = (),
 
 def deserialize_results(buf: bytes
                         ) -> Tuple[List[Any], List[dict], Optional[ExecutionStats]]:
+    results, exceptions, extra_stats, _trace = deserialize_results_ex(buf)
+    return results, exceptions, extra_stats
+
+
+def deserialize_results_ex(buf: bytes) -> Tuple[
+        List[Any], List[dict], Optional[ExecutionStats], Optional[dict]]:
+    """deserialize_results + the optional trailing trace tree (None when
+    the payload carries none — e.g. tracing disabled on the server)."""
     if buf[:4] != MAGIC:
         raise ValueError("bad DataTable magic")
     r = _Reader(buf, 4)
@@ -312,7 +325,12 @@ def deserialize_results(buf: bytes
             out.append(DistinctResult(rows, _stats_from(r.value())))
         else:
             raise ValueError(f"bad result tag {tag!r}")
-    return out, exceptions, extra_stats
+    trace = None
+    if r.pos < len(r.buf):
+        t = r.value()
+        if isinstance(t, dict):
+            trace = t
+    return out, exceptions, extra_stats, trace
 
 
 def _exc_tuple(e: dict) -> tuple:

@@ -68,6 +68,19 @@ class ServerQueryExecutor:
         self.deadline_grace_s = (
             config.get_int("pinot.server.query.deadline.grace.ms") / 1000.0
             if config is not None else 0.05)
+        #: distributed tracing: open a server-side span tree per traced
+        #: request and ship it back in the response (utils/tracing.py)
+        if config is not None:
+            self._trace_enabled = config.get_bool(
+                "pinot.trace.enabled", True)
+            self._slow_threshold_ms = config.get_float(
+                "pinot.server.slow.query.threshold.ms")
+            self._trace_capacity = config.get_int(
+                "pinot.trace.store.capacity")
+        else:
+            self._trace_enabled = True
+            self._slow_threshold_ms = 0.0
+            self._trace_capacity = None
         if config is not None:
             # the catalog default applies whenever a config is present
             # (the class attribute only backs config-less construction)
@@ -227,7 +240,77 @@ class ServerQueryExecutor:
                 segments: Optional[List[str]] = None,
                 extra_filter: Optional[str] = None,
                 query_id=None, timeout_ms: Optional[float] = None,
-                deadline: Optional[float] = None):
+                deadline: Optional[float] = None,
+                trace_ctx: Optional[dict] = None,
+                arrival_s: Optional[float] = None):
+        """Returns serialized DataTable bytes (see _execute_inner for the
+        execution semantics). trace_ctx: the broker-shipped TraceContext
+        wire dict — when present (and tracing is enabled) this server
+        opens its OWN span tree rooted at ServerRequest, records
+        scheduler queue wait (arrival_s = transport read time), runs the
+        query under it so engine/cache instrumentation lands in it, and
+        appends the tree to the response bytes so the broker stitches
+        one cross-process trace. Slow requests (and sampled ones) are
+        retained in the server's trace store."""
+        from pinot_tpu.utils import tracing
+        from pinot_tpu.utils import trace_store
+        tc = tracing.TraceContext.from_wire(trace_ctx)
+        if tc is None or not self._trace_enabled:
+            return self._execute_inner(table_name, sql_or_ctx, segments,
+                                       extra_filter, query_id, timeout_ms,
+                                       deadline)
+        rt = tracing.RequestTrace(
+            request_id=str(query_id or ""), operator="ServerRequest",
+            trace_id=tc.trace_id, sampled=tc.sampled,
+            instance=self.data_manager.instance_id, table=table_name)
+        if arrival_s is not None:
+            rt.handle().set(queueWaitMs=round(
+                max(0.0, time.time() - arrival_s) * 1000.0, 3))
+        inflight = trace_store.get_inflight("server")
+        key = f"{tc.trace_id}:{query_id}"
+        sql_text = sql_or_ctx if isinstance(sql_or_ctx, str) else ""
+        inflight.begin(key, sql=sql_text, trace_id=tc.trace_id,
+                       detail=table_name)
+        inflight.phase(key, "execute", table_name)
+        try:
+            with rt:
+                payload = self._execute_inner(
+                    table_name, sql_or_ctx, segments, extra_filter,
+                    query_id, timeout_ms, deadline)
+        finally:
+            inflight.end(key)
+        dur = rt.root.duration_ms
+        tree = rt.to_dict()
+        slow = (self._slow_threshold_ms > 0
+                and dur >= self._slow_threshold_ms)
+        if tc.sampled or slow:
+            # key carries the instance: two embedded servers sharing a
+            # process (and therefore the role store) both record the
+            # same trace id for one scattered query — they must not
+            # overwrite each other (TraceStore.get scans by traceId)
+            trace_store.get_store(
+                "server", self._trace_capacity).record(
+                f"{tc.trace_id}@{self.data_manager.instance_id}",
+                tree, sql=sql_text, duration_ms=dur, slow=slow,
+                extra={"traceId": tc.trace_id,
+                       "instance": self.data_manager.instance_id})
+            if slow:
+                trace_store.log_slow_query(
+                    "server", tc.trace_id, sql_text, dur,
+                    self._slow_threshold_ms, table=table_name,
+                    instance=self.data_manager.instance_id)
+        from pinot_tpu.utils.metrics import get_registry
+        get_registry("server").set_exemplar(
+            "query_execution", {"table": table_name}, tc.trace_id)
+        # the tree rides AFTER the result payload — append-compatible
+        # with every reader (deserialize_results_ex picks it up)
+        return payload + datatable.serialize_value(tree)
+
+    def _execute_inner(self, table_name: str, sql_or_ctx,
+                       segments: Optional[List[str]] = None,
+                       extra_filter: Optional[str] = None,
+                       query_id=None, timeout_ms: Optional[float] = None,
+                       deadline: Optional[float] = None):
         """Returns serialized DataTable bytes. extra_filter (an expression
         string, e.g. the hybrid time-boundary predicate) is ANDed into the
         filter tree — the reference rewrites the BrokerRequest the same way.
@@ -413,12 +496,15 @@ class QueryServer:
                     writer.write(_LEN.pack(0))  # EOS
                     await writer.drain()
                     continue
+                arrival = time.time()
                 fut = self.scheduler.submit(
-                    lambda r=req, d=deadline: self.executor.execute(
+                    lambda r=req, d=deadline, a=arrival:
+                    self.executor.execute(
                         r["tableName"], r["sql"], r.get("segments"),
                         r.get("extraFilter"),
                         query_id=r.get("queryId") or r.get("requestId"),
-                        timeout_ms=r.get("timeoutMs"), deadline=d),
+                        timeout_ms=r.get("timeoutMs"), deadline=d,
+                        trace_ctx=r.get("traceContext"), arrival_s=a),
                     table=req.get("tableName", ""),
                     workload=req.get("workload", "primary"),
                     deadline=deadline,
@@ -502,17 +588,20 @@ class ServerConnection:
                 request_id: int = 0,
                 extra_filter: Optional[str] = None,
                 timeout_ms: Optional[float] = None,
-                query_id=None, tenant: Optional[str] = None) -> bytes:
+                query_id=None, tenant: Optional[str] = None,
+                trace_ctx: Optional[dict] = None) -> bytes:
         """timeout_ms: remaining query budget, shipped to the server AND
         used as this channel's read timeout (+grace) so a dead server
         can't pin a broker fan-out thread past the deadline. tenant:
         the weighted-fair scheduling group the server charges this
-        query's wall time to (from TableConfig tenant tags)."""
+        query's wall time to (from TableConfig tenant tags). trace_ctx:
+        the TraceContext wire dict — the server joins the trace and
+        ships its span tree back in the response metadata."""
         payload = json.dumps({
             "requestId": request_id, "tableName": table_name, "sql": sql,
             "segments": segments, "extraFilter": extra_filter,
             "timeoutMs": timeout_ms, "tenant": tenant,
-            "queryId": query_id}).encode()
+            "queryId": query_id, "traceContext": trace_ctx}).encode()
         with self._lock:
             try:
                 sock = self._connect()

@@ -212,6 +212,29 @@ KEYS: Dict[str, Any] = {
     # concurrently (each with its own lease heartbeat); per-type caps
     # layer on top via pinot.minion.executor.concurrency.<TaskType>
     "pinot.minion.executor.concurrency": 2,
+    # -- distributed tracing (utils/tracing.py + utils/trace_store.py) --
+    # master switch: off = NO trace machinery at all (no RequestTrace,
+    # no wire context, no tail capture) — the bench.py --trace-overhead
+    # A-side. On = shadow span collection per query (stitched trees kept
+    # only for trace=true responses and slow-query tail capture).
+    "pinot.trace.enabled": True,
+    # bounded per-role in-memory trace retention behind /debug/traces
+    "pinot.trace.store.capacity": 256,
+    # tail-based slow-query capture: queries at/over the threshold keep
+    # their full stitched trace in the broker store and emit a
+    # structured slow-query log line EVEN when trace=false (0 = off)
+    "pinot.broker.slow.query.threshold.ms": 10000.0,
+    # server-local tail capture over the server's own span tree (0=off;
+    # sampled traces are stored in the server store regardless)
+    "pinot.server.slow.query.threshold.ms": 0.0,
+    "pinot.minion.slow.task.threshold.ms": 0.0,
+    # per-role debug/metrics HTTP surface (utils/trace_store.py
+    # DebugHttpServer): /metrics + /debug/traces + /debug/queries for
+    # roles without an HTTP edge. 0 = ephemeral port (printed at
+    # startup), >0 = fixed port, <0 = disabled.
+    "pinot.server.admin.port": 0,
+    "pinot.minion.admin.port": 0,
+    "pinot.cache.server.admin.port": 0,
 }
 
 

@@ -53,6 +53,20 @@ class Timer:
             if j < self.RESERVOIR_SIZE:
                 self._reservoir[j] = ms
 
+    def snapshot(self) -> "Timer":
+        """A detached consistent copy (counters + reservoir). Callers
+        must take it under whatever lock serializes update() — the
+        registry does (MetricsRegistry.timer); standalone Timers (the
+        adaptive selector's reservoirs) snapshot under their owner's
+        lock."""
+        t = Timer.__new__(Timer)
+        t.count = self.count
+        t.total_ms = self.total_ms
+        t.max_ms = self.max_ms
+        t._reservoir = list(self._reservoir)
+        t._rng = random.Random(0x5EED)
+        return t
+
     def quantile(self, q: float) -> float:
         """Empirical quantile estimate from the reservoir (0 when no
         observations yet)."""
@@ -79,6 +93,9 @@ class MetricsRegistry:
         self._meters: Dict[_Key, float] = defaultdict(float)
         self._gauges: Dict[_Key, float] = {}
         self._timers: Dict[_Key, Timer] = defaultdict(Timer)
+        #: per-timer last trace id (exemplar): links a /metrics tail to
+        #: the stored trace at /debug/traces/<id>
+        self._exemplars: Dict[_Key, str] = {}
         self._lock = threading.Lock()
 
     # -- write side ---------------------------------------------------------
@@ -93,9 +110,16 @@ class MetricsRegistry:
             self._gauges[_key(name, labels)] = value
 
     def add_timing(self, name: str, ms: float,
-                   labels: Optional[Dict[str, str]] = None) -> None:
+                   labels: Optional[Dict[str, str]] = None,
+                   exemplar: Optional[str] = None) -> None:
+        """exemplar: the trace id of the request this observation came
+        from — the timer remembers the LAST one, so a tail spike on
+        /metrics names a concrete stored trace to pull."""
         with self._lock:
-            self._timers[_key(name, labels)].update(ms)
+            k = _key(name, labels)
+            self._timers[k].update(ms)
+            if exemplar:
+                self._exemplars[k] = exemplar
 
     class _TimeCtx:
         def __init__(self, reg, name, labels):
@@ -123,8 +147,32 @@ class MetricsRegistry:
             return self._gauges.get(_key(name, labels))
 
     def timer(self, name: str, labels: Optional[Dict[str, str]] = None) -> Timer:
+        """A consistent SNAPSHOT of the timer (empty on miss). Taken
+        under the registry lock: the previous implementation handed out
+        the live Timer, whose reservoir list a concurrent update()
+        mutates while quantile()/samples iterate it — and a detached
+        EMPTY Timer on miss, silently dropping updates made through it.
+        A snapshot is race-free either way; writes go through
+        add_timing()."""
         with self._lock:
-            return self._timers.get(_key(name, labels), Timer())
+            t = self._timers.get(_key(name, labels))
+            return t.snapshot() if t is not None else Timer()
+
+    def set_exemplar(self, name: str,
+                     labels: Optional[Dict[str, str]] = None,
+                     trace_id: str = "") -> None:
+        """Stamp a timer's exemplar out of band (wrappers that own the
+        trace id but not the timing call)."""
+        if not trace_id:
+            return
+        with self._lock:
+            self._exemplars[_key(name, labels)] = trace_id
+
+    def exemplar(self, name: str,
+                 labels: Optional[Dict[str, str]] = None) -> Optional[str]:
+        """Last trace id recorded against the timer (None when never)."""
+        with self._lock:
+            return self._exemplars.get(_key(name, labels))
 
     def prometheus_text(self) -> str:
         """Prometheus exposition format (the JMX-reporter analog).
@@ -157,6 +205,13 @@ class MetricsRegistry:
                 out.append(f"{base}_count{_fmt(labels)} {t.count}")
                 out.append(f"{base}_sum_ms{_fmt(labels)} {t.total_ms:g}")
                 out.append(f"{base}_max_ms{_fmt(labels)} {t.max_ms:g}")
+                ex = self._exemplars.get((name, labels))
+                if ex:
+                    # exemplar as a comment line: Prometheus text parsers
+                    # skip non-HELP/TYPE comments, humans and tooling get
+                    # the /metrics-tail -> /debug/traces/<id> link
+                    out.append(f"# EXEMPLAR {base}{_fmt(labels)} "
+                               f'trace_id="{_escape(ex)}"')
         return "\n".join(out) + "\n"
 
 

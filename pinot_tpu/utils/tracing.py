@@ -1,20 +1,78 @@
-"""Per-query operator tracing.
+"""Per-query distributed tracing: span trees + cross-process propagation.
 
 Reference parity: pinot-spi trace/Tracing.java:45 — a registry holding one
 Tracer; every operator wraps nextBlock() in an InvocationScope
 (core/operator/BaseOperator.java:47) recording operator class + rows/docs;
 enabled per query via the trace=true query option and returned in the
-broker response. Here a contextvar-scoped trace tree with the same shape.
+broker response. The reference stops at process edges; here the tree
+crosses them:
+
+* ``TraceContext`` (traceId, parent spanId, sampled) travels on every
+  wire hop — broker→server requests, MSE ``submit_stage``, cache-fabric
+  ops, minion task params — and each remote side opens its OWN span tree
+  (``RequestTrace`` with the inherited trace id), shipping it back in
+  response metadata so the broker stitches ONE cross-process tree
+  (``SpanHandle.graft``).
+* ``SpanHandle`` is the explicit thread-safe span API for code that runs
+  OFF the request thread (the dispatch ring's launch/fetch pools, the
+  broker's scatter fan-out): capture a handle where the contextvar is
+  live (``capture()``), attach children/attrs from any thread later.
+  Contextvar-scoped ``Scope``/``annotate`` stay for same-thread code.
+
+All tree mutation goes through one module lock: span operations are rare
+(tens per query) relative to the work they time, so a coarse lock is
+cheaper than per-node locks and makes cross-thread appends race-free.
 """
 from __future__ import annotations
 
+import contextlib
 import contextvars
+import threading
 import time
+import uuid
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
 _current: contextvars.ContextVar[Optional["TraceNode"]] = \
     contextvars.ContextVar("pinot_tpu_trace", default=None)
+_request: contextvars.ContextVar[Optional["RequestTrace"]] = \
+    contextvars.ContextVar("pinot_tpu_trace_req", default=None)
+
+#: one lock for ALL tree mutation (child appends, attr updates): handles
+#: attach spans from pool threads while the request thread keeps building
+_tree_lock = threading.Lock()
+
+
+def new_trace_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+def new_span_id() -> str:
+    return uuid.uuid4().hex[:8]
+
+
+@dataclass
+class TraceContext:
+    """What crosses a wire hop: enough for the remote side to join the
+    trace (trace id), parent its tree (span id), and know whether the
+    client asked for the trace back (sampled) — tail capture collects
+    either way; sampled only controls the client-visible traceInfo."""
+
+    trace_id: str
+    span_id: str = ""
+    sampled: bool = False
+
+    def to_wire(self) -> dict:
+        return {"traceId": self.trace_id, "spanId": self.span_id,
+                "sampled": self.sampled}
+
+    @classmethod
+    def from_wire(cls, d: Optional[dict]) -> Optional["TraceContext"]:
+        if not d or not d.get("traceId"):
+            return None
+        return cls(trace_id=str(d["traceId"]),
+                   span_id=str(d.get("spanId", "")),
+                   sampled=bool(d.get("sampled")))
 
 
 @dataclass
@@ -26,11 +84,96 @@ class TraceNode:
     children: List["TraceNode"] = field(default_factory=list)
 
     def to_dict(self) -> dict:
+        with _tree_lock:
+            return self._to_dict_locked()
+
+    def _to_dict_locked(self) -> dict:
         return {"operator": self.operator,
                 "durationMs": round(self.duration_ms, 3),
                 **self.attrs,
-                **({"children": [c.to_dict() for c in self.children]}
+                **({"children": [c._to_dict_locked()
+                                 for c in self.children]}
                    if self.children else {})}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TraceNode":
+        """Inverse of to_dict — rebuilds a remote side's shipped tree so
+        the broker can graft it into its own."""
+        attrs = {k: v for k, v in d.items()
+                 if k not in ("operator", "durationMs", "children")}
+        node = cls(operator=str(d.get("operator", "?")),
+                   duration_ms=float(d.get("durationMs", 0.0) or 0.0),
+                   attrs=attrs)
+        node.children = [cls.from_dict(c) for c in d.get("children", ())]
+        return node
+
+
+class SpanHandle:
+    """Explicit thread-safe handle on one span: the capture-and-attach
+    API for code paths where contextvars don't flow (the dispatch ring's
+    pools, broker fan-out threads, MSE stage threads)."""
+
+    __slots__ = ("node",)
+
+    def __init__(self, node: TraceNode):
+        self.node = node
+
+    def child(self, operator: str, **attrs) -> "SpanHandle":
+        """Open a child span (timing starts now); end it with .end()."""
+        n = TraceNode(operator, attrs=dict(attrs))
+        n.start_ms = time.perf_counter() * 1000.0
+        with _tree_lock:
+            self.node.children.append(n)
+        return SpanHandle(n)
+
+    def end(self, **attrs) -> None:
+        with _tree_lock:
+            if attrs:
+                self.node.attrs.update(attrs)
+            if self.node.duration_ms == 0.0 and self.node.start_ms:
+                self.node.duration_ms = \
+                    time.perf_counter() * 1000.0 - self.node.start_ms
+
+    def set(self, **attrs) -> None:
+        with _tree_lock:
+            self.node.attrs.update(attrs)
+
+    def get(self, name: str, default: Any = None) -> Any:
+        with _tree_lock:
+            return self.node.attrs.get(name, default)
+
+    @contextlib.contextmanager
+    def scope(self, operator: str, **attrs):
+        """Context-manager child span on THIS handle (no contextvar):
+        thread-safe timing for worker-thread code."""
+        h = self.child(operator, **attrs)
+        try:
+            yield h
+        finally:
+            h.end()
+
+    def graft(self, tree: Optional[dict]) -> None:
+        """Attach a remote side's shipped span tree (to_dict form) as a
+        child — the stitch point for cross-process traces."""
+        if not tree:
+            return
+        try:
+            node = TraceNode.from_dict(tree)
+        except Exception:  # noqa: BLE001 — a torn tree must not fail a query
+            return
+        with _tree_lock:
+            self.node.children.append(node)
+
+    @contextlib.contextmanager
+    def activate(self):
+        """Make this span the contextvar-current node for the calling
+        thread, so same-thread Scope/annotate instrumentation (cache
+        tiers, segment executors) lands under it."""
+        token = _current.set(self.node)
+        try:
+            yield self
+        finally:
+            _current.reset(token)
 
 
 class Scope:
@@ -44,7 +187,8 @@ class Scope:
     def __enter__(self) -> "Scope":
         parent = _current.get()
         if parent is not None:
-            parent.children.append(self.node)
+            with _tree_lock:
+                parent.children.append(self.node)
             self._token = _current.set(self.node)
             self._active = True
             self.node.start_ms = time.perf_counter() * 1000.0
@@ -52,7 +196,8 @@ class Scope:
 
     def set(self, **attrs) -> None:
         if self._active:
-            self.node.attrs.update(attrs)
+            with _tree_lock:
+                self.node.attrs.update(attrs)
 
     def __exit__(self, *exc):
         if self._active:
@@ -62,21 +207,42 @@ class Scope:
 
 
 class RequestTrace:
-    """Root scope for one query; activates tracing for the request."""
+    """Root span for one request (broker query, server request, MSE
+    stage, minion task); activates contextvar tracing for the opening
+    thread and carries the trace identity."""
 
-    def __init__(self, request_id: int = 0):
-        self.root = TraceNode("BrokerRequest", attrs={"requestId": request_id})
+    def __init__(self, request_id: Any = 0, operator: str = "BrokerRequest",
+                 trace_id: Optional[str] = None, sampled: bool = True,
+                 **attrs):
+        self.trace_id = trace_id or new_trace_id()
+        #: did the CLIENT ask for the trace back (trace=true)? Tail
+        #: capture stores the tree either way; this gates traceInfo.
+        self.sampled = sampled
+        self.root = TraceNode(operator,
+                              attrs={"requestId": request_id,
+                                     "traceId": self.trace_id, **attrs})
         self._token = None
+        self._req_token = None
 
     def __enter__(self) -> "RequestTrace":
         self.root.start_ms = time.perf_counter() * 1000.0
         self._token = _current.set(self.root)
+        self._req_token = _request.set(self)
         return self
 
     def __exit__(self, *exc):
         self.root.duration_ms = \
             time.perf_counter() * 1000.0 - self.root.start_ms
         _current.reset(self._token)
+        _request.reset(self._req_token)
+
+    def handle(self) -> SpanHandle:
+        return SpanHandle(self.root)
+
+    def wire_context(self) -> dict:
+        """The TraceContext dict shipped on outgoing hops."""
+        return TraceContext(self.trace_id, new_span_id(),
+                            self.sampled).to_wire()
 
     def to_dict(self) -> dict:
         return self.root.to_dict()
@@ -86,12 +252,37 @@ def active() -> bool:
     return _current.get() is not None
 
 
+def capture() -> Optional[SpanHandle]:
+    """Thread-safe handle on the CURRENT span (None when tracing is off)
+    — capture on the request thread, attach from any thread later."""
+    node = _current.get()
+    return None if node is None else SpanHandle(node)
+
+
+def current_trace_id() -> Optional[str]:
+    """Trace id of the enclosing RequestTrace (None when untraced) —
+    side channels (cache-op headers, task params) stamp it on requests
+    so remote logs correlate back to the query."""
+    req = _request.get()
+    return None if req is None else req.trace_id
+
+
+def current_request() -> Optional["RequestTrace"]:
+    """The enclosing RequestTrace, if the calling thread runs under one
+    — lets deep layers (the MSE dispatcher parsing its own options) flip
+    `sampled` on the request they ride."""
+    return _request.get()
+
+
 def get_attr(name: str, default: Any = None) -> Any:
     """Read an attr off the CURRENT trace node (default when tracing is
     off or the attr is unset) — lets cross-cutting annotators implement
     set-if-absent / dominance rules."""
     node = _current.get()
-    return default if node is None else node.attrs.get(name, default)
+    if node is None:
+        return default
+    with _tree_lock:
+        return node.attrs.get(name, default)
 
 
 def annotate(**attrs) -> None:
@@ -100,4 +291,5 @@ def annotate(**attrs) -> None:
     operator is running, not to a new child scope."""
     node = _current.get()
     if node is not None:
-        node.attrs.update(attrs)
+        with _tree_lock:
+            node.attrs.update(attrs)

@@ -32,3 +32,32 @@ def pytest_configure(config):
         "(utils/failpoints.py) — seeded and reproducible, so they run in "
         "tier-1; the marker exists to select/deselect them explicitly "
         "(e.g. -m chaos / -m 'not chaos')")
+
+
+_exit_status = [None]
+
+
+def pytest_sessionfinish(session, exitstatus):
+    _exit_status[0] = int(exitstatus)
+
+
+def pytest_unconfigure(config):
+    """Skip interpreter finalization after the verdict is in.
+
+    A full-suite run occasionally dies with ``terminate called without
+    an active exception`` (SIGABRT, exit 134) DURING CPython teardown,
+    AFTER pytest has printed its summary — an XLA/TSL C++ worker thread
+    being finalized mid-flight, not a test failure. Exiting hard with
+    pytest's own status (recorded in sessionfinish; unconfigure runs
+    after the terminal summary prints) preserves the real verdict and
+    sidesteps the native teardown entirely (the standard JAX-suite
+    workaround). Set PINOT_TPU_SOFT_EXIT=1 to restore normal
+    finalization (e.g. for coverage/profiling runs that need atexit
+    hooks)."""
+    if os.environ.get("PINOT_TPU_SOFT_EXIT") == "1" \
+            or _exit_status[0] is None:
+        return
+    import sys
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(_exit_status[0])

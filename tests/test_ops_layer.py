@@ -92,7 +92,13 @@ class TestAccounting:
     def test_usage_tracking(self):
         acc = ResourceAccountant()
         acc.setup_worker("q1")
-        _ = sum(i * i for i in range(100_000))
+        # enough CPU work to straddle a thread-CPU clock tick even on
+        # coarse-jiffy VMs (a 100k-iteration loop occasionally fit
+        # inside one tick under load -> measured delta 0, flaky assert)
+        t0 = time.thread_time_ns()
+        n = 100_000
+        while time.thread_time_ns() - t0 < 30_000_000:  # >=30ms CPU
+            _ = sum(i * i for i in range(n))
         acc.record_allocation(1024)
         acc.clear_worker()
         u = acc.usage("q1")
