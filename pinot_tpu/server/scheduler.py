@@ -37,13 +37,24 @@ class QueryScheduler:
     _labels = None
 
     def attach_metrics(self, metrics, labels=None) -> "QueryScheduler":
-        self._metrics = metrics
-        self._labels = labels
-        self._inflight = 0
-        self._mlock = threading.Lock()
+        """Idempotent + re-attach-safe: the counter and its lock are
+        created exactly once per instance. The old version rebuilt BOTH
+        on every call — a re-attach while queries were in flight (role
+        rebuild, tests) reset the unguarded counter AND swapped the
+        lock object out from under concurrent done-callbacks, leaving
+        the scheduler_inflight gauge negative forever (lock-discipline
+        race found by the `locks` static analyzer)."""
+        if not hasattr(self, "_mlock"):
+            self._mlock = threading.Lock()
+            # lint: unlocked(first-attach init on the constructing thread before the scheduler is shared; re-attaches skip)
+            self._inflight = 0
+        with self._mlock:
+            self._metrics = metrics
+            self._labels = labels
         return self
 
     def _track(self, fut: Future) -> Future:
+        # lint: unlocked(reference snapshot; attach_metrics publishes the pair under the lock and never unsets it)
         m = self._metrics
         if m is None:
             return fut

@@ -107,3 +107,57 @@ class TestBinaryWorkload:
             assert max(peak) > 1  # primary parallelism intact
         finally:
             s.stop()
+
+
+class TestMetricsAttach:
+    """Regression: the lock-discipline analyzer caught attach_metrics
+    rebuilding the inflight counter AND its lock on every call — a
+    re-attach while queries were in flight (role rebuild, tests) reset
+    the unguarded counter and swapped the lock out from under the
+    concurrent done-callbacks, skewing scheduler_inflight forever."""
+
+    def test_reattach_mid_flight_keeps_counter_and_lock(self):
+        from concurrent.futures import Future
+        from pinot_tpu.utils.metrics import MetricsRegistry
+
+        s = FCFSQueryScheduler(num_threads=1)
+        s.attach_metrics(MetricsRegistry())
+        lock0 = s._mlock
+        fut = Future()
+        s._track(fut)              # one query in flight
+        assert s._inflight == 1
+
+        m2 = MetricsRegistry()
+        s.attach_metrics(m2)       # re-attach MID-FLIGHT (role rebuild)
+        assert s._mlock is lock0   # done-callbacks still hold this lock
+        assert s._inflight == 1    # counter not reset
+
+        fut.set_result(b"")        # in-flight query completes
+        assert s._inflight == 0    # gauge returns to zero, not -1
+
+    def test_concurrent_track_vs_reattach_never_skews(self):
+        from pinot_tpu.utils.metrics import MetricsRegistry
+
+        s = FCFSQueryScheduler(num_threads=4)
+        m = MetricsRegistry()
+        s.attach_metrics(m)
+        stop = threading.Event()
+
+        def reattacher():
+            while not stop.is_set():
+                s.attach_metrics(m)
+
+        t = threading.Thread(target=reattacher, daemon=True)
+        t.start()
+        try:
+            for _ in range(50):
+                futs = [s.submit(lambda: b"") for _ in range(8)]
+                for f in futs:
+                    f.result(5)
+        finally:
+            stop.set()
+            t.join(5)
+            s.stop()
+        # every submit's done-callback found the ONE lock/counter pair
+        assert s._inflight == 0
+        assert m.gauge("scheduler_inflight") == 0
