@@ -599,7 +599,15 @@ class BrokerRole:
     def stop(self) -> None:
         self.client.close()
         self.http.stop()
-        for c in self.connections.values():
+        # snapshot under the rebuild lock: the coordinator-watch thread's
+        # rebuild() swaps entries into self.connections under this lock,
+        # and iterating the live dict here raced it — a watch firing
+        # mid-shutdown grew the dict under the loop (RuntimeError: dict
+        # changed size during iteration) and leaked the unclosed swapped-
+        # in channels (lock-discipline race found by the static analyzer)
+        with self._rebuild_lock:
+            conns = list(self.connections.values())
+        for c in conns:
             c.close()
 
     # ------------------------------------------------------------------

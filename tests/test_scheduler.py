@@ -161,3 +161,57 @@ class TestMetricsAttach:
         # every submit's done-callback found the ONE lock/counter pair
         assert s._inflight == 0
         assert m.gauge("scheduler_inflight") == 0
+
+
+class TestBrokerStopVsRebuild:
+    """Regression: BrokerRole.stop iterated the LIVE connections dict
+    while the coordinator-watch thread's rebuild() swapped entries into
+    it under _rebuild_lock — a watch firing mid-shutdown raised
+    'dictionary changed size during iteration' and leaked the unclosed
+    swapped-in channels (found by the lock-discipline analyzer)."""
+
+    def _bare_broker(self):
+        from pinot_tpu.cluster.roles import BrokerRole
+
+        class _Noop:
+            def close(self):
+                pass
+
+            def stop(self):
+                pass
+
+        b = object.__new__(BrokerRole)
+        b.client = _Noop()
+        b.http = _Noop()
+        b.connections = {}
+        b._rebuild_lock = threading.Lock()
+        return b
+
+    def test_stop_survives_concurrent_rebuild_mutation(self):
+        class _Conn:
+            closed = 0
+
+            def close(self):
+                _Conn.closed += 1
+
+        b = self._bare_broker()
+        stop = threading.Event()
+
+        def churner():
+            """The watch thread: swaps connection entries under the
+            rebuild lock, exactly as rebuild() does."""
+            i = 0
+            while not stop.is_set():
+                with b._rebuild_lock:
+                    b.connections[f"server-{i % 7}"] = _Conn()
+                    i += 1
+
+        t = threading.Thread(target=churner, daemon=True)
+        t.start()
+        try:
+            for _ in range(200):
+                b.stop()           # raced the churner pre-fix
+        finally:
+            stop.set()
+            t.join(5)
+        assert _Conn.closed > 0
