@@ -240,6 +240,14 @@ class ClusterState:
     def _persist(self) -> None:
         if not self.persist_dir:
             return
+        # the write + rename stay UNDER the lock: two concurrent
+        # persists (two servers registering at once) shared the one tmp
+        # path outside it — the loser's os.replace raised
+        # FileNotFoundError after the winner renamed the file away, and
+        # a write landing between the winner's open and rename could
+        # ship a torn state.json. Serializing also orders the renames,
+        # so the newest snapshot is always the one that survives.
+        # Persist is control-plane-rare; file IO under the lock is fine.
         with self._lock:
             blob = {
                 "tables": {k: v.to_dict() for k, v in self.tables.items()},
@@ -247,10 +255,10 @@ class ClusterState:
                 "segments": {t: {n: s.to_dict() for n, s in m.items()}
                              for t, m in self.segments.items()},
             }
-        tmp = os.path.join(self.persist_dir, "state.json.tmp")
-        with open(tmp, "w") as f:
-            json.dump(blob, f)
-        os.replace(tmp, os.path.join(self.persist_dir, "state.json"))
+            tmp = os.path.join(self.persist_dir, "state.json.tmp")
+            with open(tmp, "w") as f:
+                json.dump(blob, f)
+            os.replace(tmp, os.path.join(self.persist_dir, "state.json"))
 
     def _load(self) -> None:
         path = os.path.join(self.persist_dir, "state.json")

@@ -249,3 +249,45 @@ class TestControllerFacade:
         assert "ct" in st2.tables
         segs = st2.table_segments("ct_OFFLINE")
         assert len(segs) == 1 and segs[0].num_docs == 7
+
+
+class TestConcurrentPersist:
+    """Regression: _persist snapshotted under the lock but wrote the
+    SHARED state.json.tmp outside it — two concurrent persists (two
+    servers registering at once, the multiprocess-cluster boot pattern)
+    raced the write + os.replace: the loser raised FileNotFoundError
+    after the winner renamed the tmp away, and a write landing between
+    the winner's open and rename could ship a torn state.json."""
+
+    def test_concurrent_mutations_persist_cleanly(self, tmp_path):
+        import json as _json
+        import threading
+
+        st = ClusterState(persist_dir=str(tmp_path / "state"))
+        errors = []
+
+        def register(n):
+            try:
+                for i in range(40):
+                    st.register_instance(InstanceState(
+                        instance_id=f"server_{n}_{i}", host="h",
+                        port=1000 + i))
+                    st.upsert_segment(SegmentState(
+                        name=f"seg_{n}_{i}", table="t_OFFLINE",
+                        instances=[f"server_{n}_{i}"], dir_path=""))
+            except Exception as e:  # noqa: BLE001 — the regression
+                errors.append(e)
+
+        threads = [threading.Thread(target=register, args=(n,))
+                   for n in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        assert not errors, errors
+
+        # the surviving file is whole, parseable, and reloadable
+        blob = _json.loads((tmp_path / "state" / "state.json").read_text())
+        assert len(blob["segments"]["t_OFFLINE"]) == 4 * 40
+        st2 = ClusterState(persist_dir=str(tmp_path / "state"))
+        assert len(st2.table_segments("t_OFFLINE")) == 4 * 40
