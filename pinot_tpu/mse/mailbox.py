@@ -364,31 +364,31 @@ class MailboxService:
             try:
                 sock = self._conns.get(dest_address)
                 if sock is None:
-                    sock = self._connect(dest_address)
+                    sock = self._connect_locked(dest_address)
                 sock.sendall(msg)
             except (ConnectionError, OSError):
                 # one retry on a FRESH socket: the pooled connection (or
                 # the first dial) hit a restarted/flaky peer — a second
                 # dial catches the common stale-socket case without
                 # masking a genuinely dead endpoint
-                self._drop(dest_address)
+                self._drop_locked(dest_address)
                 self._metrics.add_meter("mse_mailbox_retries",
                                         labels=self._labels)
                 try:
-                    sock = self._connect(dest_address)
+                    sock = self._connect_locked(dest_address)
                     sock.sendall(msg)
                 except (ConnectionError, OSError):
-                    self._drop(dest_address)
+                    self._drop_locked(dest_address)
                     raise
 
-    def _connect(self, dest_address: str) -> socket.socket:
+    def _connect_locked(self, dest_address: str) -> socket.socket:
         host, port = dest_address.rsplit(":", 1)
         sock = socket.create_connection((host, int(port)), timeout=30)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._conns[dest_address] = sock
         return sock
 
-    def _drop(self, dest_address: str) -> None:
+    def _drop_locked(self, dest_address: str) -> None:
         sock = self._conns.pop(dest_address, None)
         if sock is not None:
             try:
