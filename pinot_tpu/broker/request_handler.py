@@ -647,7 +647,13 @@ class BrokerRequestHandler:
             unit.live -= 1
             L = unit.logical
             try:
-                payload = fut.result()
+                # process() only sees completed futures today (the
+                # gather loop waits FIRST_COMPLETED), but the wait is
+                # bounded by the query's remaining budget anyway so a
+                # future that lies about being done can never park the
+                # broker thread past the deadline
+                payload = fut.result(
+                    timeout=max(0.0, deadline - time.time()) + 1.0)
                 server_results, server_exc, stats_extra, server_trace = \
                     datatable.deserialize_results_ex(payload)
             except Exception as e:  # noqa: BLE001 — partial results

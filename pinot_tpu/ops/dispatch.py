@@ -55,6 +55,7 @@ import contextlib
 import threading
 import time
 from concurrent.futures import Future, ThreadPoolExecutor
+from concurrent.futures import TimeoutError as _FutureTimeout
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
@@ -139,6 +140,58 @@ def _pow2(n: int) -> int:
     while v < n:
         v *= 2
     return v
+
+
+#: chunk size for bounded future waits: long enough to stay off the
+#: hot path's profile, short enough that a deadline trips promptly
+_RESULT_POLL_S = 0.25
+
+#: default hard backstop on any wait_result call. Callers with a query
+#: attached pass a cancel_check that trips the deadline far sooner;
+#: this exists so a caller with NO budget (warmup/prestage, a query
+#:  submitted without an id) still cannot park a thread forever on a
+#: wedged device link. An explicit max_wait_s=None opts out.
+DEFAULT_WAIT_CAP_S = 600.0
+
+
+def wait_result(future: Future, cancel_check=None,
+                max_wait_s: Optional[float] = DEFAULT_WAIT_CAP_S,
+                poll_s: float = _RESULT_POLL_S):
+    """Deadline-bounded ``future.result()``: the unbounded-wait fix the
+    hang-risk lint demands on every dispatcher wait.
+
+    The ring promises to complete every popped launch's future, but
+    that invariant lives a module away from the caller blocked in
+    ``.result()`` — a producer bug (or a launch stuck on a wedged
+    device) must surface as the QUERY's own deadline error, not as a
+    server thread parked forever. So the wait is chunked: each poll
+    runs ``cancel_check`` (the ResourceAccountant checker carrying the
+    query's remaining PR-3 deadline budget — it raises
+    BrokerTimeoutError/QueryCancelledError past the wall), and
+    ``max_wait_s`` (DEFAULT_WAIT_CAP_S unless overridden) is the hard
+    backstop for budget-less callers — prestage/warmup paths, or a
+    query submitted without an id, where cancel_check is None.
+    """
+    deadline = None if max_wait_s is None else time.monotonic() + max_wait_s
+    while True:
+        try:
+            return future.result(timeout=poll_s)
+        except (_FutureTimeout, TimeoutError):
+            if future.done():
+                # either the WORK raised a timeout error, or the future
+                # completed inside the poll-expiry race window (result()
+                # timed out, the dispatcher thread landed the value
+                # before this check) — a zero-timeout result()
+                # disambiguates: the landed value if there is one, the
+                # work's own exception otherwise. Never re-raise the
+                # poll's timeout for a future that is done.
+                return future.result(timeout=0)
+            if cancel_check is not None:
+                cancel_check()
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"device launch incomplete after {max_wait_s}s "
+                    f"(dispatcher wedged?)") from None
 
 
 def split_packed(arr: np.ndarray, n: int) -> List[np.ndarray]:

@@ -191,6 +191,14 @@ class TpuOperatorExecutor:
     #: copies themselves)
     UPLOAD_FANOUT_BYTES = 16 << 20
 
+    #: hard backstop on any single dispatcher/upload future wait
+    #: (dispatch_mod.wait_result): queries are bounded by their own
+    #: deadline checker well before this — the cap exists for
+    #: budget-less internal callers (warmup/prestage) so a wedged
+    #: device link surfaces as an error instead of a parked thread.
+    #: Aliased, not duplicated: ONE policy constant owns the backstop.
+    LAUNCH_WAIT_CAP_S = dispatch_mod.DEFAULT_WAIT_CAP_S
+
     def supports(self, ctx: QueryContext) -> bool:
         if ctx.distinct:
             return self._supports_distinct(ctx)
@@ -450,7 +458,11 @@ class TpuOperatorExecutor:
                 return [], segments
             plan, slots_of_fn, S_real, launch = prep
             try:
-                packed = self._dispatcher.submit(launch).result()
+                # deadline-bounded: the checker carries the query's
+                # remaining budget; the cap backstops budget-less callers
+                packed = dispatch_mod.wait_result(
+                    self._dispatcher.submit(launch), launch.cancel_check,
+                    max_wait_s=self.LAUNCH_WAIT_CAP_S)
             finally:
                 if launch.span is not None:
                     launch.span.end()
@@ -493,6 +505,7 @@ class TpuOperatorExecutor:
 
                 def finish(f):
                     try:
+                        # lint: hang(done-callback: f is already resolved)
                         packed = f.result()
                         out.set_result((self._assemble(
                             segments, ctx, plan, packed, S_real,
@@ -592,7 +605,9 @@ class TpuOperatorExecutor:
         S_real, launch = prep
         with self._dispatcher.active():
             try:
-                packed = self._dispatcher.submit(launch).result()
+                packed = dispatch_mod.wait_result(
+                    self._dispatcher.submit(launch), launch.cancel_check,
+                    max_wait_s=self.LAUNCH_WAIT_CAP_S)
             finally:
                 if launch.span is not None:
                     launch.span.end()
@@ -880,7 +895,9 @@ class TpuOperatorExecutor:
         plan = launch.plan
         with self._dispatcher.active():
             try:
-                packed = self._dispatcher.submit(launch).result()
+                packed = dispatch_mod.wait_result(
+                    self._dispatcher.submit(launch), launch.cancel_check,
+                    max_wait_s=self.LAUNCH_WAIT_CAP_S)
             finally:
                 if launch.span is not None:
                     launch.span.end()
@@ -1410,6 +1427,7 @@ class TpuOperatorExecutor:
             raise _NotStageable()
 
         def fetch_codes(seg):
+            # lint: unlocked(runs synchronously inside _block on the staging thread, which holds the engine RLock)
             return self._segment_gkey_locked(seg, plan)[0]
 
         # host_cache=False: the (codes, table) pair is already host-cached
@@ -1559,7 +1577,11 @@ class TpuOperatorExecutor:
                 # handoff costs more than the copy
                 futs = [dispatch_mod.upload_pool().submit(self._put_row, a)
                         for a in host_rows]
-                uploaded = [f.result() for f in futs]
+                # pool-executed device_puts always complete; the cap
+                # bounds a wedged-device-link hang (no query deadline
+                # here — staging also runs under warmup/prestage)
+                uploaded = [dispatch_mod.wait_result(
+                    f, max_wait_s=self.LAUNCH_WAIT_CAP_S) for f in futs]
             else:
                 uploaded = [self._put_row(a) for a in host_rows]
             for i, arr, dev in zip(missing, host_rows, uploaded):

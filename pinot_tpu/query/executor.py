@@ -16,6 +16,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Any, List, Optional, Sequence
 
 from pinot_tpu.query import executor_cpu
+from pinot_tpu.ops import dispatch as dispatch_mod
 from pinot_tpu.cache.core import cache_bypassed
 from pinot_tpu.cache.segment_cache import is_cacheable_shape
 from pinot_tpu.utils import tracing
@@ -160,7 +161,12 @@ class QueryExecutor:
         # host-only segments overlap the in-flight device future
         host_results = run_host(host_only)
         if device_fut is not None:
-            device_results_now, remaining = device_fut.result()
+            # bounded by the query's deadline/cancel checker when one is
+            # attached; callers without one (no query id, MSE leaf path,
+            # warmup replay) fall back to wait_result's default hard cap
+            # so a stranded engine future can never park this thread
+            device_results_now, remaining = dispatch_mod.wait_result(
+                device_fut, self._cancel_check)
         if device_results_now is not None:
             results.extend(device_results_now)
             # engine results are positional per candidate when nothing

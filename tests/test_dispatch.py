@@ -27,7 +27,7 @@ import pytest
 
 from pinot_tpu.models import (DataType, FieldSpec, FieldType, Schema,
                               TableConfig, TableType)
-from pinot_tpu.ops import kernels
+from pinot_tpu.ops import dispatch, kernels
 from pinot_tpu.ops.engine import TpuOperatorExecutor
 from pinot_tpu.query.context import QueryContext
 from pinot_tpu.segment.creator import SegmentCreator
@@ -374,3 +374,69 @@ class TestPipelineMetrics:
             failpoints.disarm("server.dispatch.before")
         assert submitted_in < 0.15, "execute_async blocked the caller"
         assert not rem and agg_values(res) == want
+
+
+class TestWaitResult:
+    """Deadline-bounded future waits (dispatch.wait_result) — the fix
+    idiom the hang-risk lint demands at every dispatcher wait."""
+
+    def test_returns_value(self):
+        from concurrent.futures import Future
+        f = Future()
+        f.set_result(41)
+        assert dispatch.wait_result(f) == 41
+
+    def test_completion_in_poll_expiry_race_window_returns_value(self):
+        """Regression: a future that completes AFTER the 0.25s poll's
+        result() raised but BEFORE the done() check must yield its
+        value, not a spurious TimeoutError. The original code re-raised
+        the poll's own timeout whenever done() was True — under
+        sustained load (4 polls/sec per in-flight launch) that window
+        failed healthy queries with 'timeout' while the packed result
+        sat in the future."""
+        from concurrent.futures import Future
+
+        class RacyFuture(Future):
+            """Simulates the race: the first result(timeout=) call
+            raises the poll timeout, then the value lands."""
+            def __init__(self):
+                super().__init__()
+                self._polled = False
+
+            def result(self, timeout=None):
+                if not self._polled:
+                    self._polled = True
+                    self.set_result(17)     # lands DURING the poll
+                    raise TimeoutError()    # ...which already expired
+                return super().result(timeout)
+
+        assert dispatch.wait_result(RacyFuture(), poll_s=0.01) == 17
+
+    def test_work_raised_timeout_propagates(self):
+        """A TimeoutError raised BY the work is the query's own deadline
+        tripping — it must propagate as-is, not spin the poll loop."""
+        from concurrent.futures import Future
+        f = Future()
+        f.set_exception(TimeoutError("work deadline"))
+        with pytest.raises(TimeoutError, match="work deadline"):
+            dispatch.wait_result(f, poll_s=0.01)
+
+    def test_cancel_check_runs_each_poll(self):
+        from concurrent.futures import Future
+        calls = []
+
+        def checker():
+            calls.append(1)
+            if len(calls) >= 3:
+                raise RuntimeError("query cancelled")
+
+        with pytest.raises(RuntimeError, match="query cancelled"):
+            dispatch.wait_result(Future(), cancel_check=checker, poll_s=0.005)
+        assert len(calls) == 3
+
+    def test_hard_cap_bounds_budgetless_wait(self):
+        from concurrent.futures import Future
+        t0 = time.perf_counter()
+        with pytest.raises(TimeoutError, match="dispatcher wedged"):
+            dispatch.wait_result(Future(), max_wait_s=0.05, poll_s=0.01)
+        assert time.perf_counter() - t0 < 2.0
