@@ -1,0 +1,40 @@
+"""Repo-native static analysis: AST checkers run as tier-1 tests.
+
+The system is held together by conventions no interpreter enforces:
+attributes guarded by one of ~60 locks, blocking waits that must carry
+the PR-3 deadline budget, seeded ``fire("site")`` chaos sites that must
+stay documented + test-armed, ~100 ``pinot.*`` knobs that must exist in
+the catalog and the README, and kernel-factory functions handed to
+``jit``/``vmap``/``shard_map`` that must stay tracer-pure. PR 12's
+exposition lint proved a tiny AST pass catches real bugs at test time
+instead of under chaos load; this package generalizes it:
+
+  * :mod:`pinot_tpu.analysis.core` — module indexer (one parsed AST +
+    inline-suppression map per file), ``Finding``/``Suppression`` model,
+    checker registry, committed-baseline workflow.
+  * :mod:`pinot_tpu.analysis.checkers` — the repo-specific checkers
+    (lock discipline, hang risk, failpoint sites, config knobs, kernel
+    purity, metric exposition).
+  * ``python -m pinot_tpu.analysis`` — the CLI gate: exits non-zero on
+    any unsuppressed finding (``--json`` for machines, ``--baseline``
+    for the committed accepted-findings file).
+
+Suppression syntax (same line or the line directly above)::
+
+    self._hits += 1          # lint: unlocked(meter only; torn reads ok)
+
+Every checker has a short code (``unlocked``, ``hang``, ``failpoint``,
+``knob``, ``impure``, ``exposition``); a suppression must carry a
+non-empty reason or it does not count. Accepted pre-existing findings
+live in ``ANALYSIS_BASELINE.json`` at the repo root — each entry keyed
+by a line-number-independent fingerprint and a written reason, so the
+gate stays green across unrelated edits but any NEW violation fails.
+"""
+from pinot_tpu.analysis.core import (  # noqa: F401
+    Finding, ModuleIndex, Checker, CHECKERS, register,
+    load_baseline, write_baseline, run_analysis, AnalysisReport,
+    repo_root, default_baseline_path,
+)
+
+# importing the checkers package populates the registry
+from pinot_tpu.analysis import checkers as _checkers  # noqa: F401,E402

@@ -1,0 +1,87 @@
+"""CLI gate: ``python -m pinot_tpu.analysis``.
+
+Exit status 0 = no unsuppressed findings; 1 = violations (or parse
+errors); 2 = usage errors. Tier-1 runs this via
+tests/test_static_analysis.py; CI can run it directly.
+
+  python -m pinot_tpu.analysis                    # human output
+  python -m pinot_tpu.analysis --json             # machine output
+  python -m pinot_tpu.analysis --checker locks    # one checker
+  python -m pinot_tpu.analysis --baseline B.json  # explicit baseline
+  python -m pinot_tpu.analysis --write-baseline B.json   # bootstrap
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from pinot_tpu.analysis.core import (
+    CHECKERS, ModuleIndex, default_baseline_path, load_baseline,
+    run_analysis, write_baseline,
+)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m pinot_tpu.analysis",
+        description="repo-native static analysis gate")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline file (default: ANALYSIS_BASELINE.json "
+                         "at the repo root when present)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore any baseline (raw findings)")
+    ap.add_argument("--checker", action="append", default=None,
+                    choices=sorted(CHECKERS),
+                    help="run only this checker (repeatable)")
+    ap.add_argument("--root", default=None,
+                    help="repo root to analyze (default: this checkout)")
+    ap.add_argument("--write-baseline", metavar="PATH", default=None,
+                    help="write current unsuppressed findings as a "
+                         "baseline skeleton to PATH and exit 0")
+    args = ap.parse_args(argv)
+
+    baseline = {}
+    if not args.no_baseline:
+        path = args.baseline or default_baseline_path()
+        if args.baseline and not os.path.exists(path):
+            print(f"baseline not found: {path}", file=sys.stderr)
+            return 2
+        if os.path.exists(path):
+            baseline = load_baseline(path)
+
+    index = ModuleIndex(root=args.root)
+    report = run_analysis(index, checkers=args.checker, baseline=baseline)
+
+    if args.write_baseline:
+        write_baseline(args.write_baseline, report.unsuppressed)
+        print(f"wrote {len(report.unsuppressed)} entries to "
+              f"{args.write_baseline} (reasons are TODOs — justify or "
+              f"fix each one)")
+        return 0
+
+    if args.json:
+        json.dump(report.to_json(), sys.stdout, indent=1)
+        sys.stdout.write("\n")
+    else:
+        for f in report.unsuppressed:
+            print(f.render())
+        if report.stale_baseline:
+            print(f"note: {len(report.stale_baseline)} stale baseline "
+                  f"entr{'y' if len(report.stale_baseline) == 1 else 'ies'} "
+                  f"(matched no finding — fixed? remove them):",
+                  file=sys.stderr)
+            for k in report.stale_baseline:
+                print(f"  {k[0]} {k[1]} {k[2]}", file=sys.stderr)
+        print(f"{len(report.unsuppressed)} unsuppressed, "
+              f"{len(report.inline_suppressed)} inline-suppressed, "
+              f"{len(report.baselined)} baselined "
+              f"({len(report.findings)} total)")
+    return 1 if report.unsuppressed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

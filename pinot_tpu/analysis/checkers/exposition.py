@@ -1,0 +1,72 @@
+"""Metric-exposition lint (the PR-12 checker, framework edition).
+
+A metric name emitted as two different kinds (counter in one file,
+gauge in another) produces two ``# TYPE`` families for one name —
+invalid exposition that Prometheus scrapers reject WHOLESALE, taking
+every other metric on the page down with it. This scans every literal
+metric emission in the package; dynamically composed names (f-strings
+with prefixes) are out of scope — they are namespaced by construction
+(``metric_prefix`` / ``remote_cache_``).
+
+Suppression code: ``exposition`` (on the first emission site).
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Set, Tuple
+
+from pinot_tpu.analysis.core import (
+    Checker, Finding, ModuleIndex, register,
+)
+
+KINDS = {
+    "add_meter": "counter", "_meter": "counter",
+    "set_gauge": "gauge",
+    "add_timing": "timer", "time": "timer", "observe": "timer",
+}
+#: \s* spans newlines, so emissions whose name literal wraps to the
+#: line after the open paren are linted too — the scan runs over the
+#: whole source, never line-by-line
+PATTERN = re.compile(
+    r'\.(add_meter|set_gauge|add_timing|observe|_meter|time)\('
+    r'\s*"([A-Za-z_][A-Za-z0-9_]*)"')
+
+
+@register
+class ExpositionChecker(Checker):
+    name = "exposition"
+    code = "exposition"
+
+    def run(self, index: ModuleIndex) -> List[Finding]:
+        uses: Dict[str, Set[str]] = {}
+        # name -> [(sf, line, call)]
+        sites: Dict[str, List[Tuple]] = {}
+        for sf in index.files("pinot_tpu/"):
+            for m in PATTERN.finditer(sf.source):
+                call, name = m.groups()
+                line = sf.source.count("\n", 0, m.start()) + 1
+                uses.setdefault(name, set()).add(KINDS[call])
+                sites.setdefault(name, []).append((sf, line, call))
+        out: List[Finding] = []
+        if not uses:
+            # regex rot guard: an exposition lint that scans nothing is
+            # itself a finding, not a green check
+            files = index.files("pinot_tpu/")
+            if files:
+                out.append(self.finding(
+                    files[0], 1, key="scan:empty",
+                    message="exposition lint matched zero metric "
+                            "emissions — pattern rot?"))
+            return out
+        for name, kinds in sorted(uses.items()):
+            if len(kinds) <= 1:
+                continue
+            sf, line, _call = sites[name][0]
+            where = ", ".join(f"{s.relpath}:{ln} ({c})"
+                              for s, ln, c in sites[name])
+            out.append(self.finding(
+                sf, line, key=f"dup-kind:{name}",
+                message=(f"metric name '{name}' emitted as multiple "
+                         f"kinds {sorted(kinds)} — invalid exposition "
+                         f"(scrapers reject the whole page): {where}")))
+        return out

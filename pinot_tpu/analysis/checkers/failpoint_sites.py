@@ -1,0 +1,127 @@
+"""Failpoint-site registry checker.
+
+``pinot_tpu/utils/failpoints.py`` carries the canonical ``SITES`` table
+— site name -> one-line description. This checker keeps three promises:
+
+  * every ``fire("<site>")`` literal compiled into production code is a
+    documented SITES entry (no drive-by chaos hooks that nobody can
+    discover or arm);
+  * every SITES entry is fired somewhere (no phantom documentation for
+    sites that were refactored away);
+  * every SITES entry is ARMED by at least one test — the site's string
+    literal appears under ``tests/`` (an ``arm(...)``/``armed(...)``
+    call or a FaultSchedule entry). A chaos hook no test ever pulls is
+    dead weight pretending to be coverage.
+
+The table is parsed statically from the module AST (the analysis never
+imports production code), so a site added to SITES with a typo fails
+the fired-somewhere leg immediately.
+
+Suppression code: ``failpoint``.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from pinot_tpu.analysis.core import (
+    Checker, Finding, ModuleIndex, call_name, register, str_const,
+)
+
+_FP_MODULE = "pinot_tpu/utils/failpoints.py"
+_FIRE_NAMES = {"fire", "failpoints.hit"}
+
+
+def parse_sites(index: ModuleIndex) -> Optional[Dict[str, str]]:
+    sf = index.get(_FP_MODULE)
+    if sf is None:
+        return None
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == "SITES":
+            dct = node.value
+        elif isinstance(node, ast.AnnAssign) \
+                and isinstance(node.target, ast.Name) \
+                and node.target.id == "SITES":
+            dct = node.value
+        else:
+            continue
+        if not isinstance(dct, ast.Dict):
+            continue
+        out: Dict[str, str] = {}
+        for k, v in zip(dct.keys, dct.values):
+            ks, vs = str_const(k), str_const(v)
+            if ks is not None:
+                out[ks] = vs or ""
+        return out
+    return None
+
+
+def fired_sites(index: ModuleIndex) -> Dict[str, List]:
+    """site -> [(SourceFile, lineno), ...] across production code."""
+    out: Dict[str, List] = {}
+    for sf in index.files("pinot_tpu/"):
+        if sf.relpath == _FP_MODULE:
+            continue
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Call) \
+                    and call_name(node) in _FIRE_NAMES and node.args:
+                site = str_const(node.args[0])
+                if site is not None:
+                    out.setdefault(site, []).append((sf, node.lineno))
+    return out
+
+
+def test_literals(index: ModuleIndex) -> Set[str]:
+    out: Set[str] = set()
+    for sf in index.files("tests/"):
+        for node in ast.walk(sf.tree):
+            s = str_const(node)
+            if s is not None:
+                out.add(s)
+    return out
+
+
+@register
+class FailpointSiteChecker(Checker):
+    name = "failpoints"
+    code = "failpoint"
+
+    def run(self, index: ModuleIndex) -> List[Finding]:
+        fp_sf = index.get(_FP_MODULE)
+        if fp_sf is None:
+            return []
+        sites = parse_sites(index)
+        if sites is None:
+            return [self.finding(
+                fp_sf, 1, key="SITES:missing",
+                message="utils/failpoints.py has no SITES dict — the "
+                        "canonical site registry is gone")]
+        fired = fired_sites(index)
+        armed = test_literals(index)
+        out: List[Finding] = []
+        for site, locs in sorted(fired.items()):
+            if site not in sites:
+                sf, line = locs[0]
+                out.append(self.finding(
+                    sf, line, key=f"undocumented:{site}",
+                    message=(f'fire("{site}") is not in the canonical '
+                             f"SITES table in utils/failpoints.py — "
+                             f"document it (and arm it in a test)")))
+        for site in sorted(sites):
+            if site not in fired:
+                out.append(self.finding(
+                    fp_sf, 1, key=f"dead:{site}",
+                    message=(f'SITES documents "{site}" but no '
+                             f'fire("{site}") exists in production '
+                             f"code — stale registry entry")))
+            elif site not in armed:
+                sf, line = fired[site][0]
+                out.append(self.finding(
+                    sf, line, key=f"unarmed:{site}",
+                    message=(f'failpoint site "{site}" is never armed '
+                             f"by any test (its literal appears "
+                             f"nowhere under tests/) — chaos coverage "
+                             f"gap")))
+        return out

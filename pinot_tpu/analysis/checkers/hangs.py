@@ -1,0 +1,108 @@
+"""Hang-risk lint: unbounded blocking waits on query-serving paths.
+
+A query must die by its deadline, never hang: the PR-3 reliability work
+made every broker/server wait deadline-derived, and this checker keeps
+it that way. On the query-serving modules (broker, query, mse, ops,
+server, client, netframe) it flags:
+
+  * ``fut.result()`` with neither a positional nor ``timeout=``
+    argument — a future whose producer dies strands the caller forever
+    (the dispatch ring promises to complete every popped future, but
+    that invariant lives a module away; the wait must be bounded
+    locally by the query's remaining budget);
+  * ``ev.wait()`` / ``cv.wait()`` with no timeout;
+  * ``q.get()`` with no timeout on a queue-like receiver (name matches
+    queue/mailbox/inbox) unless called non-blocking;
+  * ``sock.recv()/recvfrom()`` in a module with no visible socket
+    timeout discipline (no ``settimeout`` call and no
+    ``create_connection(..., timeout=...)``).
+
+Suppression code: ``hang`` —
+``packed = fut.result()  # lint: hang(producer completes every future)``
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List
+
+from pinot_tpu.analysis.core import (
+    Checker, Finding, ModuleIndex, dotted, kwarg_names, register,
+)
+
+_SCOPES = ("pinot_tpu/broker/", "pinot_tpu/query/", "pinot_tpu/mse/",
+           "pinot_tpu/ops/", "pinot_tpu/server/", "pinot_tpu/client/",
+           "pinot_tpu/utils/netframe.py")
+_QUEUEISH = re.compile(r"(queue|mailbox|inbox)", re.IGNORECASE)
+
+
+def _has_timeout(call: ast.Call) -> bool:
+    return bool(call.args) or "timeout" in kwarg_names(call)
+
+
+def _module_has_socket_timeout(tree: ast.AST) -> bool:
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted(node.func)
+        if name.endswith("settimeout"):
+            return True
+        if name.endswith("create_connection") and (
+                len(node.args) >= 2 or "timeout" in kwarg_names(node)):
+            return True
+    return False
+
+
+@register
+class HangRiskChecker(Checker):
+    name = "hangs"
+    code = "hang"
+
+    def run(self, index: ModuleIndex) -> List[Finding]:
+        out: List[Finding] = []
+        for sf in index.files("pinot_tpu/"):
+            if not any(sf.relpath.startswith(s) or sf.relpath == s
+                       for s in _SCOPES):
+                continue
+            sock_ok = _module_has_socket_timeout(sf.tree)
+            # enclosing-function names for stable keys
+            func_of: Dict[int, str] = {}
+            for fn in [n for n in ast.walk(sf.tree)
+                       if isinstance(n, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))]:
+                for sub in ast.walk(fn):
+                    if hasattr(sub, "lineno"):
+                        func_of.setdefault(id(sub), fn.name)
+            dup: Dict[str, int] = {}
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Call) or \
+                        not isinstance(node.func, ast.Attribute):
+                    continue
+                attr = node.func.attr
+                recv = dotted(node.func.value) or \
+                    type(node.func.value).__name__
+                msg = None
+                if attr == "result" and not _has_timeout(node):
+                    msg = (f"unbounded {recv}.result() — bound the wait "
+                           f"with the query's remaining deadline budget")
+                elif attr == "wait" and not _has_timeout(node):
+                    msg = (f"unbounded {recv}.wait() — pass a timeout "
+                           f"derived from the deadline")
+                elif attr == "get" and _QUEUEISH.search(recv) \
+                        and not _has_timeout(node) \
+                        and not any(k in ("block",)
+                                    for k in kwarg_names(node)):
+                    msg = (f"unbounded {recv}.get() on a queue — pass "
+                           f"timeout= or block=False")
+                elif attr in ("recv", "recvfrom") and not sock_ok:
+                    msg = (f"{recv}.{attr}() in a module with no "
+                           f"settimeout/timeout= socket discipline")
+                if msg is None:
+                    continue
+                fn = func_of.get(id(node), "<module>")
+                base = f"{fn}:{recv}.{attr}"
+                n = dup.get(base, 0)
+                dup[base] = n + 1
+                key = base if n == 0 else f"{base}#{n + 1}"
+                out.append(self.finding(sf, node, key=key, message=msg))
+        return out

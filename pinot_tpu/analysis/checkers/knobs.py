@@ -1,0 +1,117 @@
+"""Config-knob checker: the ``pinot.*`` catalog stays typo-proof.
+
+Three legs, catching dead knobs in both directions:
+
+  * every literal key passed to a config getter (``cfg.get*("pinot.…")``
+    / ``cfg.is_set``) in production or bench code must exist in the
+    ``KEYS`` catalog in ``utils/config.py`` — a typo'd read silently
+    returns the getter default and the knob does nothing;
+  * every catalog key must be READ somewhere in production/bench code
+    (its literal appears outside config.py) — a knob nothing reads is
+    documentation of behavior that does not exist;
+  * every catalog key must appear in a README knob table — operators
+    discover knobs there, not by reading the catalog source.
+
+Dynamically composed keys (``"pinot.broker.timeout.ms." + table``,
+f-strings) are out of scope by construction — only literal first
+arguments are checked, and the composed families' base keys are
+catalog entries already.
+
+Suppression code: ``knob``.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set
+
+from pinot_tpu.analysis.core import (
+    Checker, Finding, ModuleIndex, register, str_const,
+)
+
+_CFG_MODULE = "pinot_tpu/utils/config.py"
+_GETTERS = {"get", "get_int", "get_float", "get_bool", "get_str",
+            "is_set"}
+
+
+def parse_catalog(index: ModuleIndex) -> Optional[Dict[str, int]]:
+    """KEYS knob -> line number, parsed statically."""
+    sf = index.get(_CFG_MODULE)
+    if sf is None:
+        return None
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.AnnAssign) \
+                and isinstance(node.target, ast.Name) \
+                and node.target.id == "KEYS" \
+                and isinstance(node.value, ast.Dict):
+            dct = node.value
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == "KEYS" \
+                and isinstance(node.value, ast.Dict):
+            dct = node.value
+        else:
+            continue
+        out: Dict[str, int] = {}
+        for k in dct.keys:
+            ks = str_const(k)
+            if ks is not None:
+                out[ks] = k.lineno
+        return out
+    return None
+
+
+@register
+class ConfigKnobChecker(Checker):
+    name = "knobs"
+    code = "knob"
+
+    def run(self, index: ModuleIndex) -> List[Finding]:
+        catalog = parse_catalog(index)
+        cfg_sf = index.get(_CFG_MODULE)
+        if catalog is None or cfg_sf is None:
+            return []
+        scoped = [sf for sf in index.files()
+                  if (sf.relpath.startswith("pinot_tpu/")
+                      or sf.relpath.startswith("bench"))]
+        out: List[Finding] = []
+        read_literals: Set[str] = set()
+        for sf in scoped:
+            if sf.relpath == _CFG_MODULE:
+                continue
+            for node in ast.walk(sf.tree):
+                s = str_const(node)
+                if s is not None and s.startswith("pinot."):
+                    read_literals.add(s)
+                if isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in _GETTERS and node.args:
+                    key = str_const(node.args[0])
+                    if key is None or not key.startswith("pinot."):
+                        continue
+                    if key not in catalog:
+                        out.append(self.finding(
+                            sf, node, key=f"unknown:{key}",
+                            message=(f'config read of "{key}" which is '
+                                     f"not in the utils/config.py KEYS "
+                                     f"catalog — typo'd knob reads "
+                                     f"fall through to the getter "
+                                     f"default silently")))
+        readme = os.path.join(index.root, "README.md")
+        readme_text = ""
+        if os.path.exists(readme):
+            with open(readme, encoding="utf-8") as f:
+                readme_text = f.read()
+        for key, line in sorted(catalog.items()):
+            if key not in read_literals:
+                out.append(self.finding(
+                    cfg_sf, line, key=f"dead:{key}",
+                    message=(f'catalog knob "{key}" is read nowhere in '
+                             f"production or bench code — dead knob")))
+            if readme_text and key not in readme_text:
+                out.append(self.finding(
+                    cfg_sf, line, key=f"undocumented:{key}",
+                    message=(f'catalog knob "{key}" appears in no '
+                             f"README knob table — operators cannot "
+                             f"discover it")))
+        return out

@@ -1,0 +1,266 @@
+"""Lock-discipline race detector.
+
+Per class, infer the set of attributes the class treats as lock-guarded
+— any ``self.X`` WRITTEN at least once inside a lock scope outside
+``__init__`` — then flag every read or write of those attributes
+outside any lock scope. Two things count as a lock scope:
+
+  * the body of ``with self.<lock>:`` where ``<lock>`` is a lock-like
+    attribute (assigned from ``threading.Lock/RLock/Condition`` in any
+    method, or name-matching ``lock|cv|cond|mutex``), including
+    multi-item withs;
+  * the body of a method whose name ends in ``_locked`` — the repo-wide
+    convention for "caller must hold the lock" helpers.
+
+The inference deliberately keys on WRITES under lock: an attribute only
+ever read under a lock (config captured in ``__init__``, say) is not
+shared mutable state, and flagging it would drown the signal. A nested
+NAMED function defined inside a lock scope gets depth 0 — closures run
+later, on other threads, when the lock is long released (that is
+precisely the race class this checker exists for) — while lambdas
+inherit the enclosing depth (``sorted(key=...)`` / default-arg lambdas
+run synchronously under the lock that encloses them).
+
+Calls to ``self.<name>_locked()`` from outside a lock scope are flagged
+too: the suffix is a contract, and an unlocked call site breaks it.
+
+Suppression code: ``unlocked`` —
+``self._hits += 1  # lint: unlocked(monotonic meter; torn read benign)``
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from pinot_tpu.analysis.core import (
+    Checker, Finding, ModuleIndex, SourceFile, dotted, register,
+)
+
+_LOCK_NAME_HINTS = ("lock", "_cv", "cond", "mutex")
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "threading.Lock",
+               "threading.RLock", "threading.Condition"}
+_CTOR_METHODS = {"__init__", "__new__", "__post_init__", "__set_name__"}
+#: attribute calls treated as writes to the receiver (mutating a
+#: guarded container is a write to the guarded state)
+_MUTATORS = {"append", "extend", "add", "update", "remove", "discard",
+             "pop", "popitem", "clear", "insert", "setdefault",
+             "appendleft", "popleft", "sort"}
+
+
+def _is_lock_attr(name: str, ctor_assigned: Set[str]) -> bool:
+    if name in ctor_assigned:
+        return True
+    low = name.lower()
+    return any(h in low for h in _LOCK_NAME_HINTS)
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """'X' when node is ``self.X``, else None."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+class _Access:
+    __slots__ = ("attr", "kind", "line", "depth", "method")
+
+    def __init__(self, attr: str, kind: str, line: int, depth: int,
+                 method: str):
+        self.attr = attr
+        self.kind = kind          # 'read' | 'write'
+        self.line = line
+        self.depth = depth        # lock-nesting depth at the access
+        self.method = method
+
+
+class _MethodScanner(ast.NodeVisitor):
+    """Walk ONE method body tracking lock depth; collect accesses and
+    unlocked ``*_locked()`` helper calls."""
+
+    def __init__(self, method: str, lock_attrs: Set[str], base_depth: int):
+        self.method = method
+        self.lock_attrs = lock_attrs
+        self.depth = base_depth
+        self.accesses: List[_Access] = []
+        self.locked_calls: List[Tuple[str, int, int]] = []  # name, line, depth
+
+    # -- scopes --------------------------------------------------------
+    def visit_With(self, node: ast.With) -> None:
+        takes = 0
+        for item in node.items:
+            for sub in ast.walk(item.context_expr):
+                a = _self_attr(sub)
+                if a is not None and a in self.lock_attrs:
+                    takes = 1
+            # the header expression itself evaluates OUTSIDE the lock
+            self.visit(item.context_expr)
+        self.depth += takes
+        for stmt in node.body:
+            self.visit(stmt)
+        self.depth -= takes
+
+    visit_AsyncWith = visit_With
+
+    def _nested(self, node) -> None:
+        # closure bodies run later, lock released: depth resets to 0
+        saved = self.depth
+        self.depth = 0
+        for stmt in getattr(node, "body", []):
+            self.visit(stmt) if isinstance(stmt, ast.stmt) else None
+        self.depth = saved
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._nested(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        # lambdas INHERIT depth: the overwhelmingly common shapes
+        # (sorted key=, dict.get default=) run synchronously under the
+        # lock that encloses them — unlike named closures, which are
+        # the deferred-callback idiom here
+        self.visit(node.body)
+
+    # -- accesses ------------------------------------------------------
+    def _record(self, attr: str, kind: str, line: int) -> None:
+        if attr in self.lock_attrs:
+            return
+        self.accesses.append(
+            _Access(attr, kind, line, self.depth, self.method))
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        a = _self_attr(node)
+        if a is not None:
+            kind = "write" if isinstance(node.ctx,
+                                         (ast.Store, ast.Del)) else "read"
+            self._record(a, kind, node.lineno)
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        # self.X[k] = v / del self.X[k]: a write to guarded X
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            a = _self_attr(node.value)
+            if a is not None:
+                self._record(a, "write", node.lineno)
+                self.visit(node.slice)
+                return
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        a = _self_attr(node.target)
+        if a is not None:
+            # += is a read-modify-write: record as write (the racier half)
+            self._record(a, "write", node.lineno)
+        else:
+            self.visit(node.target)
+        self.visit(node.value)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        # self.X.append(...) mutates guarded X; self.helper_locked()
+        # outside a lock breaks the suffix contract
+        if isinstance(node.func, ast.Attribute):
+            recv = _self_attr(node.func.value)
+            if recv is not None and node.func.attr in _MUTATORS:
+                self._record(recv, "write", node.lineno)
+            helper = _self_attr(node.func)
+            if helper is not None and helper.endswith("_locked"):
+                self.locked_calls.append(
+                    (helper, node.lineno, self.depth))
+        self.generic_visit(node)
+
+
+@register
+class LockDisciplineChecker(Checker):
+    name = "locks"
+    code = "unlocked"
+
+    def run(self, index: ModuleIndex) -> List[Finding]:
+        out: List[Finding] = []
+        for sf in index.files("pinot_tpu/"):
+            for node in ast.walk(sf.tree):
+                if isinstance(node, ast.ClassDef):
+                    out.extend(self._check_class(sf, node))
+        return out
+
+    # ------------------------------------------------------------------
+    def _check_class(self, sf: SourceFile,
+                     cls: ast.ClassDef) -> List[Finding]:
+        methods = [n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))]
+        if not methods:
+            return []
+        # lock attrs: ctor-assigned lock objects + name heuristic on
+        # every `with self.X:` target
+        ctor_assigned: Set[str] = set()
+        for m in methods:
+            for n in ast.walk(m):
+                if isinstance(n, ast.Assign) and isinstance(n.value,
+                                                            ast.Call):
+                    ctor = dotted(n.value.func)
+                    if ctor in _LOCK_CTORS:
+                        for t in n.targets:
+                            a = _self_attr(t)
+                            if a is not None:
+                                ctor_assigned.add(a)
+        lock_attrs: Set[str] = set(ctor_assigned)
+        for m in methods:
+            for n in ast.walk(m):
+                if isinstance(n, (ast.With, ast.AsyncWith)):
+                    for item in n.items:
+                        for sub in ast.walk(item.context_expr):
+                            a = _self_attr(sub)
+                            if a is not None and _is_lock_attr(
+                                    a, ctor_assigned):
+                                lock_attrs.add(a)
+        if not lock_attrs:
+            return []
+
+        accesses: List[_Access] = []
+        locked_calls: List[Tuple[str, int, int, str]] = []
+        for m in methods:
+            base = 1 if m.name.endswith("_locked") else 0
+            sc = _MethodScanner(m.name, lock_attrs, base)
+            for stmt in m.body:
+                sc.visit(stmt)
+            accesses.extend(sc.accesses)
+            locked_calls.extend((h, ln, d, m.name)
+                                for h, ln, d in sc.locked_calls)
+
+        guarded = {a.attr for a in accesses
+                   if a.kind == "write" and a.depth > 0
+                   and a.method not in _CTOR_METHODS}
+        out: List[Finding] = []
+        seen: Set[Tuple[str, str, str, str]] = set()
+        for a in accesses:
+            if a.attr not in guarded or a.depth > 0 \
+                    or a.method in _CTOR_METHODS:
+                continue
+            ident = (cls.name, a.attr, a.method, a.kind)
+            if ident in seen:
+                continue
+            seen.add(ident)
+            out.append(self.finding(
+                sf, a.line,
+                key=f"{cls.name}.{a.attr}:{a.kind}@{a.method}",
+                message=(f"{a.kind} of lock-guarded attribute "
+                         f"'{a.attr}' outside any lock scope in "
+                         f"{cls.name}.{a.method} (attribute is written "
+                         f"under a lock elsewhere in the class)")))
+        for helper, line, depth, method in locked_calls:
+            if depth > 0 or method.endswith("_locked") \
+                    or method in _CTOR_METHODS:
+                continue
+            ident = (cls.name, helper, method, "call")
+            if ident in seen:
+                continue
+            seen.add(ident)
+            out.append(self.finding(
+                sf, line,
+                key=f"{cls.name}.{helper}:call@{method}",
+                message=(f"call of under-lock helper '{helper}' from "
+                         f"{cls.name}.{method} outside any lock scope "
+                         f"(the _locked suffix is a held-lock "
+                         f"contract)")))
+        return out

@@ -1,0 +1,218 @@
+"""Kernel-purity checker: jit'd factory functions stay tracer-pure.
+
+Functions handed to ``jax.jit`` / ``jax.vmap`` / ``shard_map`` in
+``ops/kernels.py`` execute at TRACE time and are then replayed as a
+compiled program: a ``time.*`` / ``random.*`` / ``np.random.*`` call
+inside one bakes a constant into the kernel (silently wrong), and a
+host-sync (``block_until_ready``, ``.item()``, ``np.asarray`` on a
+device value, ``jax.device_get``) inside one stalls the trace or
+retraces per call. Host syncs belong to the dispatch/fetch layer
+(``ops/dispatch.py`` — and ``ops/engine.py``'s assemble path), never
+inside the kernel factory.
+
+Resolution follows the factory idiom: the first argument of a
+jit/vmap/shard_map call is a lambda (checked inline), a local function
+name, or a ``make_*(plan)`` call — in which case every inner function
+of the factory is treated as traced. The traced set then closes over
+module-local calls (helpers like ``_eval_filter`` are traced too).
+
+A helper that is DELIBERATELY impure at trace time only (the
+``note_trace`` compile odometer) is vetted wholesale by a suppression
+on its ``def`` line: ``def note_trace(...):  # lint: impure(reason)``
+— the checker neither flags its body nor descends into it.
+
+Also flagged, module-wide in ``ops/`` (outside the dispatch/fetch
+modules): ``block_until_ready`` / ``device_get`` calls — the dispatch
+ring owns device synchronization; a stray sync elsewhere serializes
+the pipelined path.
+
+Suppression code: ``impure``.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from pinot_tpu.analysis.core import (
+    Checker, Finding, ModuleIndex, SourceFile, call_name, register,
+)
+
+_KERNEL_MODULES = ("pinot_tpu/ops/kernels.py",)
+#: modules that own device synchronization — host syncs are their job
+_SYNC_OK = {"pinot_tpu/ops/dispatch.py", "pinot_tpu/ops/engine.py",
+            "pinot_tpu/ops/residency.py"}
+_JIT_WRAPPERS = {"jax.jit", "jit", "jax.vmap", "vmap", "shard_map",
+                 "jax.experimental.shard_map.shard_map"}
+_BANNED_PREFIXES = ("time.", "random.", "np.random.", "numpy.random.",
+                    "datetime.")
+_BANNED_EXACT = {"time", "print"}
+_HOST_SYNC = {"jax.block_until_ready", "block_until_ready",
+              "jax.device_get", "device_get", "np.asarray",
+              "numpy.asarray", "np.array", "numpy.array"}
+
+
+def _first_arg_functions(call: ast.Call, by_name: Dict[str, List],
+                         ) -> Tuple[List, List[ast.Lambda]]:
+    """Resolve a jit/vmap/shard_map first argument to candidate traced
+    FunctionDefs (and/or lambdas)."""
+    if not call.args:
+        return [], []
+    arg = call.args[0]
+    if isinstance(arg, ast.Lambda):
+        return [], [arg]
+    if isinstance(arg, ast.Name):
+        return list(by_name.get(arg.id, [])), []
+    if isinstance(arg, ast.Call):
+        # make_kernel(plan): every inner def of the factory is traced
+        target = call_name(arg)
+        fns = []
+        for f in by_name.get(target, []):
+            fns.extend(n for n in ast.walk(f)
+                       if isinstance(n, ast.FunctionDef) and n is not f)
+        return fns, []
+    return [], []
+
+
+@register
+class KernelPurityChecker(Checker):
+    name = "purity"
+    code = "impure"
+
+    def run(self, index: ModuleIndex) -> List[Finding]:
+        out: List[Finding] = []
+        for mod in _KERNEL_MODULES:
+            sf = index.get(mod)
+            if sf is not None:
+                out.extend(self._check_kernels(sf))
+        for sf in index.files("pinot_tpu/ops/"):
+            if sf.relpath in _SYNC_OK or sf.relpath in _KERNEL_MODULES:
+                continue
+            out.extend(self._check_stray_syncs(sf))
+        return out
+
+    # ------------------------------------------------------------------
+    def _check_kernels(self, sf: SourceFile) -> List[Finding]:
+        by_name: Dict[str, List] = {}
+        module_names: Set[str] = set()
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.FunctionDef):
+                by_name.setdefault(node.name, []).append(node)
+        for node in sf.tree.body:  # type: ignore[attr-defined]
+            for t in (node.targets if isinstance(node, ast.Assign) else
+                      [node.target] if isinstance(node, ast.AnnAssign)
+                      else []):
+                if isinstance(t, ast.Name):
+                    module_names.add(t.id)
+
+        traced: List = []
+        traced_ids: Set[int] = set()
+        lambdas: List[ast.Lambda] = []
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Call) \
+                    and call_name(node) in _JIT_WRAPPERS:
+                fns, lams = _first_arg_functions(node, by_name)
+                for f in fns:
+                    if id(f) not in traced_ids:
+                        traced_ids.add(id(f))
+                        traced.append(f)
+                lambdas.extend(lams)
+
+        # close over module-local calls; a def-line 'impure' suppression
+        # vets the helper wholesale (trace-time-only by argument)
+        i = 0
+        while i < len(traced):
+            fn = traced[i]
+            i += 1
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    callee = call_name(node)
+                    for f in by_name.get(callee, []):
+                        if id(f) in traced_ids:
+                            continue
+                        if sf.suppressed(f.lineno, self.code):
+                            continue
+                        traced_ids.add(id(f))
+                        traced.append(f)
+
+        out: List[Finding] = []
+        seen: Set[Tuple[str, str]] = set()
+        for fn in traced:
+            out.extend(self._check_body(sf, fn.name, fn, module_names,
+                                        seen))
+        for lam in lambdas:
+            out.extend(self._check_body(sf, f"<lambda:{lam.lineno}>",
+                                        lam, module_names, seen))
+        return out
+
+    def _check_body(self, sf: SourceFile, name: str, fn,
+                    module_names: Set[str],
+                    seen: Set[Tuple[str, str]]) -> List[Finding]:
+        out: List[Finding] = []
+
+        def emit(node, what: str, why: str) -> None:
+            ident = (name, what)
+            if ident in seen:
+                return
+            seen.add(ident)
+            out.append(self.finding(
+                sf, node, key=f"{name}:{what}",
+                message=(f"traced kernel function '{name}' {why}")))
+
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Global):
+                emit(node, "global",
+                     "declares `global` — module-state mutation inside "
+                     "a traced function runs once per TRACE, not per "
+                     "call, and is a hidden retrace dependency")
+            if isinstance(node, ast.Call):
+                cn = call_name(node)
+                if not cn:
+                    if isinstance(node.func, ast.Attribute) \
+                            and node.func.attr == "item":
+                        emit(node, "item()",
+                             "calls .item() — a device->host sync "
+                             "inside the traced program")
+                    continue
+                if cn in _BANNED_EXACT or \
+                        any(cn.startswith(p) for p in _BANNED_PREFIXES):
+                    emit(node, cn,
+                         f"calls {cn}() — impure at trace time (the "
+                         f"result is baked into the compiled kernel "
+                         f"as a constant)")
+                elif cn in _HOST_SYNC:
+                    emit(node, cn,
+                         f"calls {cn}() — host sync belongs in the "
+                         f"dispatch/fetch modules, never inside the "
+                         f"kernel factory")
+                elif cn.endswith(".item"):
+                    emit(node, cn,
+                         "calls .item() — a device->host sync inside "
+                         "the traced program")
+                elif isinstance(node.func, ast.Attribute) \
+                        and isinstance(node.func.value, ast.Name) \
+                        and node.func.value.id in module_names \
+                        and node.func.attr in ("append", "add", "update",
+                                               "pop", "clear", "extend",
+                                               "setdefault"):
+                    emit(node, f"{cn}",
+                         f"mutates module-level state via {cn}() "
+                         f"inside a traced function")
+        return out
+
+    # ------------------------------------------------------------------
+    def _check_stray_syncs(self, sf: SourceFile) -> List[Finding]:
+        out: List[Finding] = []
+        dup: Dict[str, int] = {}
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Call):
+                cn = call_name(node)
+                if cn in ("jax.block_until_ready", "jax.device_get"):
+                    n = dup.get(cn, 0)
+                    dup[cn] = n + 1
+                    key = cn if n == 0 else f"{cn}#{n + 1}"
+                    out.append(self.finding(
+                        sf, node, key=key,
+                        message=(f"{cn}() outside the dispatch/fetch "
+                                 f"modules — the dispatch ring owns "
+                                 f"device synchronization")))
+        return out

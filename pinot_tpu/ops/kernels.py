@@ -49,6 +49,7 @@ _trace_log: "deque" = deque(maxlen=_TRACE_LOG_MAX)
 _trace_by_plan: Dict[str, int] = {}
 
 
+# lint: impure(the compile odometer is DELIBERATELY trace-time-impure: it runs once per trace to count retraces, mutates only under _trace_lock, and contributes nothing to the traced computation)
 def note_trace(kind: str = "kernel", plan_fp: str = "",
                bucket: tuple = ()) -> None:
     global _trace_count

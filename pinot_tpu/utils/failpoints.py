@@ -9,54 +9,13 @@ unarmed the call is a dict lookup + None check (sub-microsecond), so the
 hooks are safe to leave in hot-ish control paths (they are NOT placed in
 per-row loops).
 
-Sites currently compiled in:
-
-  broker.scatter.before    — before the broker fans a plan entry out
-  broker.group.scatter     — before a scatter to a replica-group member
-                             (ctx: server, table, group index — arm with
-                             where={"group": 0} to kill one fault domain)
-  cache.ring.node          — every cache-ring key->node resolution (ctx:
-                             node, key — arm with where={"node": addr}
-                             to fail one node's key range)
-  server.execute.before    — server-side, before a query executes
-  server.execute.segment   — per segment in the execution loop
-  server.dispatch.before   — kernel dispatch (ring + inline paths)
-  server.dispatch.batch    — per MEMBER inside the coalesced-batch path
-                             (ctx: table, mode, batch_size) — an erroring
-                             member fails only its own future; peers
-                             stay batched and complete
-  netframe.send            — every framed send (coordination, cache, stream)
-  connection.request       — broker->server request, response payload hook
-  cache.remote.get         — remote cache-tier GET
-  ingest.realtime.consume  — realtime consume loop (a SimulatedCrash
-                             here VANISHES the consumer mid-batch — the
-                             SIGKILL stand-in; recovery = new manager
-                             from the committed offset + snapshots)
-  ingest.tcp.frame         — TCP stream consumer edge
-  ingest.seal.build        — immutable-segment build start (both the
-                             async build-pool leg and the FSM path);
-                             errors retry with backoff, the sealed
-                             mutable keeps serving meanwhile
-  ingest.seal.swap         — before the warmed immutable swaps in over
-                             the sealed mutable (tdm.add_segment)
-  ingest.checkpoint        — replay-checkpoint persistence, payload hook
-                             (torn= truncates the offset payload: the
-                             manager persists NOTHING and retries —
-                             restart re-consumes, never corrupts)
-  ingest.upsert.apply      — per-row upsert metadata application,
-                             BEFORE any state lands (an armed error
-                             skips the row whole, never half-applied)
-  controller.task.assign      — task-fabric lease grant
-  controller.task.lease.renew — task-fabric heartbeat renewal
-  controller.segment.replace  — the atomic minion segment swap
-  minion.task.execute         — worker-side, as task execution starts
-  mse.dispatch.stage          — broker-side, before one stage dispatches
-  mse.mailbox.send            — every mailbox frame send (torn=, delay=)
-  mse.mailbox.recv            — every mailbox frame receive
-  mse.stage.execute           — worker-side, as a stage instance starts
-  mse.worker.crash            — MSE worker kill point: SimulatedCrash
-                                vanishes the worker (mailbox gone, no
-                                error frames — receivers must detect)
+The canonical site registry is the ``SITES`` table below — one entry
+per compiled-in site with its one-line contract. The static-analysis
+``failpoints`` checker (pinot_tpu/analysis) keeps it honest three ways:
+every ``fire("…")`` literal in production code must be a SITES entry,
+every SITES entry must be fired somewhere, and every SITES entry must
+be armed by at least one test. The README "Reliability" failpoint table
+derives from SITES; do not fork a second list.
 
 Policies are armed per site with deterministic, seeded behavior:
 
@@ -79,6 +38,82 @@ import random
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
+
+
+#: THE canonical failpoint-site registry: site name -> one-line
+#: contract. Enforced by the `failpoints` static-analysis checker
+#: (fired-somewhere, documented-here, armed-by-a-test — all three); the
+#: README failpoint table renders from this dict.
+SITES: Dict[str, str] = {
+    "broker.scatter.before":
+        "before the broker fans a plan entry out",
+    "broker.group.scatter":
+        "before a scatter to a replica-group member (ctx: server, "
+        "table, group index — arm with where={'group': 0} to kill one "
+        "fault domain)",
+    "cache.ring.node":
+        "every cache-ring key->node resolution (ctx: node, key — arm "
+        "with where={'node': addr} to fail one node's key range)",
+    "server.execute.before":
+        "server-side, before a query executes",
+    "server.execute.segment":
+        "per segment in the execution loop",
+    "server.dispatch.before":
+        "kernel dispatch (ring + inline paths)",
+    "server.dispatch.batch":
+        "per MEMBER inside the coalesced-batch path (ctx: table, mode, "
+        "batch_size) — an erroring member fails only its own future; "
+        "peers stay batched and complete",
+    "netframe.send":
+        "every framed send (coordination, cache, stream)",
+    "connection.request":
+        "broker->server request, response payload hook",
+    "cache.remote.get":
+        "remote cache-tier GET",
+    "ingest.realtime.consume":
+        "realtime consume loop (a SimulatedCrash here VANISHES the "
+        "consumer mid-batch — the SIGKILL stand-in; recovery = new "
+        "manager from the committed offset + snapshots)",
+    "ingest.tcp.frame":
+        "TCP stream consumer edge",
+    "ingest.seal.build":
+        "immutable-segment build start (async build-pool leg and the "
+        "FSM path); errors retry with backoff, the sealed mutable "
+        "keeps serving meanwhile",
+    "ingest.seal.swap":
+        "before the warmed immutable swaps in over the sealed mutable "
+        "(tdm.add_segment)",
+    "ingest.checkpoint":
+        "replay-checkpoint persistence, payload hook (torn= truncates "
+        "the offset payload: the manager persists NOTHING and retries "
+        "— restart re-consumes, never corrupts)",
+    "ingest.upsert.apply":
+        "per-row upsert metadata application, BEFORE any state lands "
+        "(an armed error skips the row whole, never half-applied)",
+    "controller.task.assign":
+        "task-fabric lease grant",
+    "controller.task.lease.renew":
+        "task-fabric heartbeat renewal",
+    "controller.segment.replace":
+        "the atomic minion segment swap",
+    "minion.task.execute":
+        "worker-side, as task execution starts",
+    "mse.dispatch.stage":
+        "broker-side, before one stage dispatches",
+    "mse.mailbox.send":
+        "every mailbox frame send (torn=, delay= keep stream framing "
+        "intact)",
+    "mse.mailbox.recv":
+        "every mailbox frame receive",
+    "mse.stage.execute":
+        "worker-side, as a stage instance starts",
+    "mse.stage.hedge":
+        "broker-side, as a leaf-stage hedge attempt is issued (the "
+        "PR-10 claim-book race — seeded journals replay byte-identical)",
+    "mse.worker.crash":
+        "MSE worker kill point: SimulatedCrash vanishes the worker "
+        "(mailbox gone, no error frames — receivers must detect)",
+}
 
 
 class FailpointError(RuntimeError):
@@ -189,6 +224,7 @@ class FailpointRegistry:
     # -- the hot call --------------------------------------------------
     def hit(self, site: str, payload: Optional[bytes] = None,
             **ctx) -> Optional[bytes]:
+        # lint: unlocked(deliberately lock-free hot path: unarmed cost must stay one dict lookup; arm/disarm replace the LIST atomically and the copy below tolerates concurrent disarm)
         fps = self._sites.get(site)
         if not fps:
             return payload

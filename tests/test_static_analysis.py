@@ -1,0 +1,714 @@
+"""Repo-native static analysis (ISSUE 13): framework + six checkers.
+
+Three layers of coverage:
+
+  * fixture snippets with KNOWN violations per checker — positive,
+    inline-suppressed, and clean variants — so a checker that silently
+    stops finding its bug class fails here, not in production;
+  * the framework itself: suppression parsing, baseline round-trip +
+    stale detection, CLI exit codes;
+  * THE TIER-1 GATE: zero unsuppressed findings across the real repo
+    (accepted pre-existing findings live in ANALYSIS_BASELINE.json,
+    each with a written reason) — plus behavioral tests arming the
+    failpoint sites the `failpoints` checker found never armed.
+"""
+import json
+import socket
+import textwrap
+import threading
+import time
+
+import pytest
+
+from pinot_tpu.analysis import (
+    ModuleIndex, load_baseline, run_analysis, write_baseline)
+from pinot_tpu.analysis.__main__ import main as cli_main
+from pinot_tpu.utils.failpoints import FailpointError, failpoints
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoints.clear()
+    yield
+    failpoints.clear()
+
+
+def _index(tmp_path, files):
+    """Materialize a fixture repo tree and index it."""
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return ModuleIndex(root=str(tmp_path))
+
+
+def _run(tmp_path, files, checker, baseline=None):
+    return run_analysis(_index(tmp_path, files), checkers=[checker],
+                        baseline=baseline)
+
+
+def _keys(report):
+    return {f.key for f in report.unsuppressed}
+
+
+# ---------------------------------------------------------------------------
+# lock-discipline race detector
+# ---------------------------------------------------------------------------
+
+LOCKED_CLASS = '''
+    import threading
+
+    class Counter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._hits = 0
+
+        def bump(self):
+            with self._lock:
+                self._hits += 1
+
+        def peek(self):
+            return self._hits{suffix}
+'''
+
+
+class TestLockChecker:
+    def test_unguarded_read_of_guarded_attr_flagged(self, tmp_path):
+        rep = _run(tmp_path, {
+            "pinot_tpu/mod.py": LOCKED_CLASS.format(suffix="")}, "locks")
+        assert _keys(rep) == {"Counter._hits:read@peek"}
+
+    def test_inline_suppression_with_reason_accepted(self, tmp_path):
+        rep = _run(tmp_path, {
+            "pinot_tpu/mod.py": LOCKED_CLASS.format(
+                suffix="  # lint: unlocked(meter only; torn reads ok)")},
+            "locks")
+        assert not rep.unsuppressed
+        assert len(rep.inline_suppressed) == 1
+        assert rep.inline_suppressed[0].reason == \
+            "meter only; torn reads ok"
+
+    def test_bare_suppression_without_reason_ignored(self, tmp_path):
+        rep = _run(tmp_path, {
+            "pinot_tpu/mod.py": LOCKED_CLASS.format(
+                suffix="  # lint: unlocked()")}, "locks")
+        assert _keys(rep) == {"Counter._hits:read@peek"}
+
+    def test_read_under_lock_clean(self, tmp_path):
+        rep = _run(tmp_path, {"pinot_tpu/mod.py": '''
+            import threading
+
+            class Counter:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._hits = 0
+
+                def bump(self):
+                    with self._lock:
+                        self._hits += 1
+
+                def peek(self):
+                    with self._lock:
+                        return self._hits
+        '''}, "locks")
+        assert not rep.unsuppressed
+
+    def test_named_closure_loses_lock_lambda_keeps_it(self, tmp_path):
+        """The deferred-callback race class: a named closure defined
+        under the lock runs LATER, lock released — flagged. A lambda
+        (sorted key=) runs synchronously under the lock — clean."""
+        rep = _run(tmp_path, {"pinot_tpu/mod.py": '''
+            import threading
+
+            class Book:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._rows = []
+
+                def add(self, fut, row):
+                    with self._lock:
+                        self._rows.append(row)
+                        self._rows.sort(key=lambda r: len(self._rows))
+
+                        def done(_f):
+                            self._rows.append(None)
+                        fut.add_done_callback(done)
+        '''}, "locks")
+        # the closure's append is BOTH a read of the attr and a mutation
+        assert _keys(rep) == {"Book._rows:write@add", "Book._rows:read@add"}
+
+    def test_locked_suffix_is_a_scope_and_a_contract(self, tmp_path):
+        """*_locked methods count as held-lock scopes; CALLING one from
+        outside any lock scope breaks the suffix contract."""
+        rep = _run(tmp_path, {"pinot_tpu/mod.py": '''
+            import threading
+
+            class Store:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._d = {}
+
+                def put(self, k, v):
+                    with self._lock:
+                        self._put_locked(k, v)
+
+                def _put_locked(self, k, v):
+                    self._d[k] = v
+
+                def sneaky(self, k, v):
+                    self._put_locked(k, v)
+        '''}, "locks")
+        assert _keys(rep) == {"Store._put_locked:call@sneaky"}
+
+    def test_ctor_writes_do_not_define_or_violate_guards(self, tmp_path):
+        rep = _run(tmp_path, {"pinot_tpu/mod.py": '''
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._x = 0
+        '''}, "locks")
+        assert not rep.unsuppressed
+
+
+# ---------------------------------------------------------------------------
+# hang-risk lint
+# ---------------------------------------------------------------------------
+
+class TestHangChecker:
+    def test_unbounded_result_wait_get_flagged(self, tmp_path):
+        rep = _run(tmp_path, {"pinot_tpu/broker/mod.py": '''
+            def gather(fut, ev, inbox):
+                a = fut.result()
+                ev.wait()
+                b = inbox.queue.get()
+                return a, b
+        '''}, "hangs")
+        assert _keys(rep) == {"gather:fut.result", "gather:ev.wait",
+                              "gather:inbox.queue.get"}
+
+    def test_bounded_variants_clean(self, tmp_path):
+        rep = _run(tmp_path, {"pinot_tpu/broker/mod.py": '''
+            def gather(fut, ev, inbox, deadline):
+                a = fut.result(timeout=deadline)
+                ev.wait(0.5)
+                b = inbox.queue.get(timeout=deadline)
+                c = inbox.queue.get(block=False)
+                return a, b, c
+        '''}, "hangs")
+        assert not rep.unsuppressed
+
+    def test_non_serving_modules_out_of_scope(self, tmp_path):
+        rep = _run(tmp_path, {"pinot_tpu/segment/mod.py": '''
+            def build(fut):
+                return fut.result()
+        '''}, "hangs")
+        assert not rep.unsuppressed
+
+    def test_duplicate_sites_get_distinct_keys(self, tmp_path):
+        rep = _run(tmp_path, {"pinot_tpu/ops/mod.py": '''
+            def drain(futs):
+                return [f.result() for f in futs] + \\
+                    [f.result() for f in reversed(futs)]
+        '''}, "hangs")
+        assert len(_keys(rep)) == 2
+
+
+# ---------------------------------------------------------------------------
+# failpoint-site registry
+# ---------------------------------------------------------------------------
+
+FP_FILES = {
+    "pinot_tpu/utils/failpoints.py": '''
+        SITES = {
+            "good.site": "armed and fired",
+            "unarmed.site": "fired but no test arms it",
+            "phantom.site": "documented but never fired",
+        }
+    ''',
+    "pinot_tpu/prod.py": '''
+        def work():
+            fire("good.site")
+            fire("unarmed.site")
+            fire("rogue.site")
+    ''',
+    "tests/test_chaos.py": '''
+        def test_arming():
+            with failpoints.armed("good.site", delay=0.1):
+                pass
+    ''',
+}
+
+
+class TestFailpointChecker:
+    def test_three_promises(self, tmp_path):
+        rep = _run(tmp_path, FP_FILES, "failpoints")
+        assert _keys(rep) == {"undocumented:rogue.site",
+                              "dead:phantom.site",
+                              "unarmed:unarmed.site"}
+
+    def test_missing_sites_table_is_itself_a_finding(self, tmp_path):
+        files = dict(FP_FILES)
+        files["pinot_tpu/utils/failpoints.py"] = "X = 1\n"
+        rep = _run(tmp_path, files, "failpoints")
+        assert _keys(rep) == {"SITES:missing"}
+
+
+# ---------------------------------------------------------------------------
+# config-knob checker
+# ---------------------------------------------------------------------------
+
+KNOB_FILES = {
+    "pinot_tpu/utils/config.py": '''
+        KEYS = {
+            "pinot.good.knob": 1,
+            "pinot.dead.knob": 2,
+            "pinot.undocumented.knob": 3,
+        }
+    ''',
+    "pinot_tpu/prod.py": '''
+        def setup(cfg):
+            a = cfg.get_int("pinot.good.knob")
+            b = cfg.get("pinot.typo.knob")
+            c = cfg.get_bool("pinot.undocumented.knob")
+            return a, b, c
+    ''',
+    "README.md": "| `pinot.good.knob` | 1 | documented |\n",
+}
+
+
+class TestKnobChecker:
+    def test_both_directions(self, tmp_path):
+        rep = _run(tmp_path, KNOB_FILES, "knobs")
+        assert _keys(rep) == {
+            "unknown:pinot.typo.knob",       # read, not in catalog
+            "dead:pinot.dead.knob",          # catalog, read nowhere
+            "undocumented:pinot.dead.knob",  # catalog, not in README
+            "undocumented:pinot.undocumented.knob",
+        }
+
+    def test_dynamic_key_composition_out_of_scope(self, tmp_path):
+        files = dict(KNOB_FILES)
+        files["pinot_tpu/prod.py"] = '''
+            def setup(cfg, table):
+                a = cfg.get_int("pinot.good.knob")
+                b = cfg.get("pinot.good.knob." + table)
+                c = cfg.get(f"pinot.undocumented.knob.{table}")
+                return a, b, c
+        '''
+        rep = _run(tmp_path, files, "knobs")
+        assert "unknown:pinot.good.knob." not in {
+            k.split("+")[0] for k in _keys(rep)}
+        assert not any(k.startswith("unknown:") for k in _keys(rep))
+
+
+# ---------------------------------------------------------------------------
+# kernel-purity checker
+# ---------------------------------------------------------------------------
+
+class TestPurityChecker:
+    def test_impure_calls_inside_factory_flagged(self, tmp_path):
+        rep = _run(tmp_path, {"pinot_tpu/ops/kernels.py": '''
+            import time
+            import jax
+
+            def make_kernel(plan):
+                def kern(cols):
+                    t = time.time()
+                    return cols[0] * t
+                return kern
+
+            def compile_it(plan):
+                return jax.jit(make_kernel(plan))
+        '''}, "purity")
+        assert _keys(rep) == {"kern:time.time"}
+
+    def test_host_sync_and_module_mutation_flagged(self, tmp_path):
+        rep = _run(tmp_path, {"pinot_tpu/ops/kernels.py": '''
+            import jax
+            import numpy as np
+
+            _cache = {}
+
+            def make_kernel(plan):
+                def kern(cols):
+                    _cache.update({"k": 1})
+                    return np.asarray(cols[0])
+                return kern
+
+            def compile_it(plan):
+                return jax.jit(make_kernel(plan))
+        '''}, "purity")
+        assert _keys(rep) == {"kern:np.asarray", "kern:_cache.update"}
+
+    def test_traced_closure_over_helpers(self, tmp_path):
+        """The traced set must close over module-local helper calls —
+        impurity one call away is the same bug."""
+        rep = _run(tmp_path, {"pinot_tpu/ops/kernels.py": '''
+            import random
+            import jax
+
+            def _helper(x):
+                return x * random.random()
+
+            def make_kernel(plan):
+                def kern(cols):
+                    return _helper(cols[0])
+                return kern
+
+            def compile_it(plan):
+                return jax.jit(make_kernel(plan))
+        '''}, "purity")
+        assert _keys(rep) == {"_helper:random.random"}
+
+    def test_def_line_suppression_vets_helper_wholesale(self, tmp_path):
+        rep = _run(tmp_path, {"pinot_tpu/ops/kernels.py": '''
+            import jax
+
+            # lint: impure(trace-time odometer; contributes nothing traced)
+            def _odometer():
+                global _count
+                _count += 1
+
+            def make_kernel(plan):
+                def kern(cols):
+                    _odometer()
+                    return cols[0]
+                return kern
+
+            def compile_it(plan):
+                return jax.jit(make_kernel(plan))
+        '''}, "purity")
+        assert not rep.unsuppressed
+
+    def test_stray_sync_outside_dispatch_modules(self, tmp_path):
+        rep = _run(tmp_path, {"pinot_tpu/ops/helper.py": '''
+            import jax
+
+            def fetch(x):
+                return jax.block_until_ready(x)
+        '''}, "purity")
+        assert _keys(rep) == {"jax.block_until_ready"}
+
+    def test_pure_kernel_clean(self, tmp_path):
+        rep = _run(tmp_path, {"pinot_tpu/ops/kernels.py": '''
+            import jax
+            import jax.numpy as jnp
+
+            def make_kernel(plan):
+                def kern(cols):
+                    return jnp.sum(cols[0])
+                return kern
+
+            def compile_it(plan):
+                return jax.jit(make_kernel(plan))
+        '''}, "purity")
+        assert not rep.unsuppressed
+
+
+# ---------------------------------------------------------------------------
+# exposition checker (the PR-12 lint, framework edition)
+# ---------------------------------------------------------------------------
+
+class TestExpositionChecker:
+    def test_dup_kind_flagged(self, tmp_path):
+        rep = _run(tmp_path, {"pinot_tpu/a.py": '''
+            def f(m):
+                m.add_meter("whoops")
+        ''', "pinot_tpu/b.py": '''
+            def g(m):
+                m.set_gauge("whoops", 1)
+        '''}, "exposition")
+        assert _keys(rep) == {"dup-kind:whoops"}
+
+    def test_wrapped_emission_still_linted(self, tmp_path):
+        """The name literal on the line AFTER the open paren (the
+        dominant 79-col style in this repo) must still be scanned."""
+        rep = _run(tmp_path, {"pinot_tpu/a.py": '''
+            def f(m):
+                m.add_meter(
+                    "wrapped_name")
+
+            def g(m):
+                m.set_gauge(
+                    "wrapped_name", 1)
+        '''}, "exposition")
+        assert _keys(rep) == {"dup-kind:wrapped_name"}
+
+    def test_single_kind_clean_and_empty_scan_is_a_finding(self, tmp_path):
+        rep = _run(tmp_path, {"pinot_tpu/a.py": '''
+            def f(m):
+                m.add_meter("fine")
+                m.set_gauge("also_fine", 1)
+        '''}, "exposition")
+        assert not rep.unsuppressed
+        rep = _run(tmp_path, {"pinot_tpu/a.py": "x = 1\n"}, "exposition")
+        assert _keys(rep) == {"scan:empty"}
+
+
+# ---------------------------------------------------------------------------
+# framework: parse errors, baseline round-trip, CLI
+# ---------------------------------------------------------------------------
+
+class TestFramework:
+    def test_syntax_error_fails_gate_not_tool(self, tmp_path):
+        rep = _run(tmp_path, {"pinot_tpu/bad.py": "def broken(:\n"},
+                   "exposition")
+        assert any(f.checker == "parse" for f in rep.unsuppressed)
+
+    def test_baseline_round_trip_and_stale_detection(self, tmp_path):
+        files = {"pinot_tpu/mod.py": LOCKED_CLASS.format(suffix="")}
+        rep = _run(tmp_path, files, "locks")
+        assert rep.unsuppressed
+
+        # bootstrap skeleton -> TODO reasons do NOT count
+        bpath = tmp_path / "BASE.json"
+        write_baseline(str(bpath), rep.unsuppressed)
+        skeleton = json.loads(bpath.read_text())
+        assert all(e["reason"].startswith("TODO")
+                   for e in skeleton["findings"])
+
+        # a written reason accepts the finding
+        skeleton["findings"][0]["reason"] = "gauge read; torn value ok"
+        bpath.write_text(json.dumps(skeleton))
+        rep2 = _run(tmp_path, files, "locks",
+                    baseline=load_baseline(str(bpath)))
+        assert not rep2.unsuppressed
+        assert len(rep2.baselined) == 1
+        assert rep2.baselined[0].reason == "gauge read; torn value ok"
+
+        # an EMPTY reason is ignored (the ledger, not a mute button)
+        skeleton["findings"][0]["reason"] = ""
+        bpath.write_text(json.dumps(skeleton))
+        rep3 = _run(tmp_path, files, "locks",
+                    baseline=load_baseline(str(bpath)))
+        assert rep3.unsuppressed
+
+        # fixing the bug turns the entry stale (surfaced, not failing)
+        skeleton["findings"][0]["reason"] = "valid reason"
+        bpath.write_text(json.dumps(skeleton))
+        fixed = {"pinot_tpu/mod.py": LOCKED_CLASS.format(suffix="")
+                 .replace("return self._hits",
+                          "with self._lock:\n"
+                          "                return self._hits")}
+        rep4 = _run(tmp_path, fixed, "locks",
+                    baseline=load_baseline(str(bpath)))
+        assert not rep4.unsuppressed
+        assert len(rep4.stale_baseline) == 1
+
+    def test_baseline_key_survives_line_drift(self, tmp_path):
+        """Keys are built from stable names, not line numbers — an
+        unrelated edit above the finding must not churn the baseline."""
+        files = {"pinot_tpu/mod.py": LOCKED_CLASS.format(suffix="")}
+        rep = _run(tmp_path, files, "locks")
+        key = rep.unsuppressed[0].key
+        shifted = {"pinot_tpu/mod.py":
+                   "# a new comment\n# another\n\n" +
+                   textwrap.dedent(LOCKED_CLASS.format(suffix=""))}
+        rep2 = _run(tmp_path, shifted, "locks")
+        assert rep2.unsuppressed[0].key == key
+
+    def test_cli_exit_codes_and_json(self, tmp_path, capsys):
+        for rel, src in FP_FILES.items():
+            p = tmp_path / rel
+            p.parent.mkdir(parents=True, exist_ok=True)
+            p.write_text(textwrap.dedent(src))
+        rc = cli_main(["--root", str(tmp_path), "--checker", "failpoints",
+                       "--no-baseline", "--json"])
+        report = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert report["counts"]["unsuppressed"] == 3
+
+        # write a baseline, justify every entry, gate goes green
+        bpath = tmp_path / "B.json"
+        rc = cli_main(["--root", str(tmp_path), "--checker", "failpoints",
+                       "--no-baseline", "--write-baseline", str(bpath)])
+        assert rc == 0
+        data = json.loads(bpath.read_text())
+        for e in data["findings"]:
+            e["reason"] = "accepted for the fixture"
+        bpath.write_text(json.dumps(data))
+        rc = cli_main(["--root", str(tmp_path), "--checker", "failpoints",
+                       "--baseline", str(bpath)])
+        assert rc == 0
+        capsys.readouterr()
+
+    def test_cli_missing_baseline_is_usage_error(self, tmp_path):
+        rc = cli_main(["--root", str(tmp_path),
+                       "--baseline", str(tmp_path / "nope.json")])
+        assert rc == 2
+
+
+# ---------------------------------------------------------------------------
+# arming the sites the checker found never armed (chaos coverage gaps)
+# ---------------------------------------------------------------------------
+
+class TestFailpointArming:
+    """Behavioral tests for the five sites the `failpoints` checker
+    surfaced as never armed by any test — each exercises the degrade
+    contract the site's SITES entry documents."""
+
+    def test_netframe_send_torn_frames_cleanly_content_fails(self):
+        """netframe.send torn=: the frame arrives WHOLE (length prefix
+        matches the truncated bytes — stream framing never desyncs) but
+        its content no longer decodes."""
+        from pinot_tpu.utils.netframe import recv_raw_frame, send_raw_frame
+        a, b = socket.socketpair()
+        try:
+            payload = json.dumps({"op": "set", "key": "x" * 64}).encode()
+            with failpoints.armed("netframe.send", torn=True, times=1):
+                send_raw_frame(a, payload)
+            got = fired = recv_raw_frame(b)
+            assert fired is not None and len(got) < len(payload)
+            with pytest.raises(json.JSONDecodeError):
+                json.loads(got)
+            # the stream is NOT desynced: the next frame decodes fine
+            send_raw_frame(a, payload)
+            assert json.loads(recv_raw_frame(b)) == json.loads(payload)
+        finally:
+            a.close()
+            b.close()
+
+    def test_cache_remote_get_error_degrades_to_miss_then_breaker(self):
+        """cache.remote.get: a dying remote tier must read as a MISS
+        (total-function contract), count errors, and trip the breaker
+        after consecutive failures — never raise to the query path."""
+        from pinot_tpu.cache.remote import (
+            CIRCUIT_CLOSED, CIRCUIT_OPEN, RemoteCacheBackend)
+        from pinot_tpu.utils.metrics import MetricsRegistry
+        m = MetricsRegistry()
+        be = RemoteCacheBackend("127.0.0.1:1", failure_threshold=2,
+                                reset_seconds=60.0, metrics=m,
+                                labels={"tier": "t"})
+        assert be.breaker.state == CIRCUIT_CLOSED
+        with failpoints.armed("cache.remote.get",
+                              error=FailpointError("remote tier dying")):
+            assert be.get("k1") is None
+            assert be.get("k2") is None
+        assert be.breaker.state == CIRCUIT_OPEN
+        assert m.meter("remote_cache_errors", labels={"tier": "t"}) >= 2
+
+    def test_controller_task_assign_error_leaves_task_pending(self):
+        """controller.task.assign: a raise in the grant leaves the task
+        PENDING — the lease was never handed out, so no worker believes
+        it owns work the queue never recorded as leased."""
+        from pinot_tpu.controller.task_manager import (
+            LEASED, PENDING, TaskConfig, TaskQueue)
+        q = TaskQueue()
+        e = q.submit(TaskConfig("PurgeTask", "t_OFFLINE", ["s0"]))
+        with failpoints.armed("controller.task.assign",
+                              error=FailpointError("grant chaos"),
+                              times=1):
+            with pytest.raises(FailpointError):
+                q.lease("worker-1")
+        assert q.get(e.task_id).state == PENDING
+        got = q.lease("worker-1")
+        assert got is not None and got.state == LEASED
+        assert got.task_id == e.task_id
+
+    def test_mse_mailbox_recv_torn_payload_surfaces_truncated(self):
+        """mse.mailbox.recv: the receive-side payload hook — a torn
+        frame surfaces to the fold layer truncated (typed decode error
+        there), and the queue still drains on EOS."""
+        from pinot_tpu.mse.mailbox import FLAG_EOS, MailboxService
+        svc = MailboxService("inst_sa_recv")
+        svc.start()
+        try:
+            svc.send(svc.address, "qsa|1|0|0", b"0123456789", FLAG_EOS)
+            with failpoints.armed("mse.mailbox.recv", torn=True, times=1):
+                got = list(svc.receive_all("qsa|1|0|0", num_senders=1,
+                                           timeout=5.0))
+            assert got == [b"01234"]
+            assert svc.queue_count() == 0
+        finally:
+            svc.stop()
+
+    @pytest.mark.chaos
+    def test_connection_request_torn_response_retries_clean(
+            self, tmp_path_factory):
+        """connection.request torn=: a truncated broker<-server response
+        payload must surface as that server's failure and re-scatter to
+        the replica — the query answers exactly, zero exceptions."""
+        from pinot_tpu.cluster.mini import MiniCluster
+        from tests.queries.harness import (
+            build_segments, synthetic_columns, synthetic_schema,
+            synthetic_table_config)
+        tmp = tmp_path_factory.mktemp("conn_req_chaos")
+        docs = 200
+        segs = build_segments(
+            tmp, synthetic_schema(), synthetic_table_config(),
+            [synthetic_columns(docs, seed=31 + i) for i in range(2)])
+        c = MiniCluster(num_servers=2)
+        c.start()
+        try:
+            c.add_table("testTable")
+            for i, seg in enumerate(segs):
+                c.add_segment("testTable", seg, server_idx=i % 2,
+                              replicas=[(i + 1) % 2])
+            sql = ("SELECT COUNT(*) FROM testTable "
+                   "OPTION(skipCache=true)")
+            baseline = c.query(sql)
+            assert not baseline.exceptions
+            with failpoints.armed("connection.request", torn=True,
+                                  times=1) as fp:
+                resp = c.query(sql)
+            assert fp.fired >= 1
+            assert not resp.exceptions
+            assert resp.rows == baseline.rows
+        finally:
+            c.stop()
+
+
+# ---------------------------------------------------------------------------
+# THE TIER-1 GATE
+# ---------------------------------------------------------------------------
+
+class TestRepoGate:
+    """Zero unsuppressed findings across the real repo. A failure here
+    names the violation and the fix paths: correct the code, suppress
+    inline with `# lint: <code>(<reason>)` where the site is
+    correct-by-argument, or (pre-existing accepted findings only) add an
+    ANALYSIS_BASELINE.json entry with a written reason."""
+
+    @pytest.fixture(scope="class")
+    def report(self):
+        from pinot_tpu.analysis import default_baseline_path
+        import os
+        baseline = {}
+        if os.path.exists(default_baseline_path()):
+            baseline = load_baseline(default_baseline_path())
+        return run_analysis(baseline=baseline)
+
+    def test_zero_unsuppressed_findings(self, report):
+        rendered = "\n".join(f.render() for f in report.unsuppressed)
+        assert not report.unsuppressed, (
+            f"{len(report.unsuppressed)} unsuppressed static-analysis "
+            f"finding(s):\n{rendered}")
+
+    def test_no_stale_baseline_entries(self, report):
+        stale = "\n".join(" ".join(k) for k in report.stale_baseline)
+        assert not report.stale_baseline, (
+            f"baseline entries matching no current finding (fix landed? "
+            f"remove them):\n{stale}")
+
+    def test_every_baseline_entry_has_a_real_reason(self):
+        from pinot_tpu.analysis import default_baseline_path
+        import os
+        path = default_baseline_path()
+        if not os.path.exists(path):
+            pytest.skip("no baseline committed")
+        data = json.loads(open(path).read())
+        bad = [e for e in data["findings"]
+               if not str(e.get("reason", "")).strip()
+               or str(e["reason"]).startswith("TODO")]
+        assert not bad, f"baseline entries without written reasons: {bad}"
+
+    def test_all_six_checkers_registered_and_ran(self, report):
+        from pinot_tpu.analysis import CHECKERS
+        assert set(CHECKERS) == {"locks", "hangs", "failpoints", "knobs",
+                                 "purity", "exposition"}
+        ran = {f.checker for f in report.findings}
+        # lock/knob findings exist (baselined); the others may be clean,
+        # which the per-checker fixture tests above keep honest
+        assert "locks" in ran

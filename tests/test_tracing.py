@@ -565,59 +565,22 @@ class TestMetricsEveryRole:
 
 
 # ---------------------------------------------------------------------------
-# satellite: static exposition lint — one kind per metric name
+# satellite: static exposition lint — MIGRATED into the analysis framework
+# (pinot_tpu/analysis/checkers/exposition.py, gated by
+# tests/test_static_analysis.py). Only the live-registry belt-and-braces
+# check stays here.
 # ---------------------------------------------------------------------------
 
-class TestExpositionLint:
-    KINDS = {
-        "add_meter": "counter", "_meter": "counter",
-        "set_gauge": "gauge",
-        "add_timing": "timer", "time": "timer", "observe": "timer",
-    }
-    #: literal first-arg metric emissions; dynamically composed names
-    #: (f-strings with prefixes) are out of scope — they are namespaced
-    #: by construction (metric_prefix / remote_cache_)
-    PATTERN = re.compile(
-        r'\.(add_meter|set_gauge|add_timing|observe|_meter|time)\('
-        r'\s*"([A-Za-z_][A-Za-z0-9_]*)"')
-
-    def test_no_metric_name_used_as_two_kinds(self):
-        """Duplicate-kind names produce two `# TYPE` families for one
-        name — invalid exposition that Prometheus scrapers reject
-        WHOLESALE. Lint every literal emission in the package."""
-        root = os.path.join(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))), "pinot_tpu")
-        uses: dict = {}
-        sites: dict = {}
-        for dirpath, _dirs, files in os.walk(root):
-            for fn in files:
-                if not fn.endswith(".py"):
-                    continue
-                path = os.path.join(dirpath, fn)
-                with open(path) as f:
-                    src = f.read()
-                for m in self.PATTERN.finditer(src):
-                    call, name = m.groups()
-                    kind = self.KINDS[call]
-                    uses.setdefault(name, set()).add(kind)
-                    sites.setdefault(name, []).append(
-                        (os.path.relpath(path, root), call))
-        assert uses, "lint scan found no metric emissions (regex rot?)"
-        conflicts = {n: k for n, k in uses.items() if len(k) > 1}
-        assert not conflicts, (
-            "metric names used as more than one kind (invalid "
-            f"exposition): { {n: (k, sites[n]) for n, k in conflicts.items()} }")
-
+class TestExpositionLive:
     def test_live_exposition_has_one_type_per_name(self):
-        """Belt-and-braces on a real registry page."""
+        """Belt-and-braces on a real registry page (the static lint
+        itself now lives in the analysis framework)."""
         reg = MetricsRegistry("lint")
         reg.add_meter("a", labels={"x": "1"})
         reg.add_meter("a", labels={"x": "2"})
         reg.set_gauge("b", 1.0)
         reg.add_timing("c", 5.0)
         text = reg.prometheus_text()
-        types = [ln.split()[2] for ln in text.splitlines()
-                 if ln.startswith("# TYPE")]
         names = [ln.split()[2] for ln in text.splitlines()
                  if ln.startswith("# TYPE")]
         assert len(names) == len(set(names))
