@@ -1,0 +1,163 @@
+"""Metric-docs checker: the metric-name catalog stays drift-proof.
+
+The exposition analog of the ``knobs`` checker, with the same
+both-direction dead-entry detection:
+
+  * every LITERAL metric name emitted through a registry
+    (``add_meter`` / ``set_gauge`` / ``add_timing`` / ``time`` /
+    ``observe`` / pass-through ``_meter`` helpers) must have an entry in
+    the ``METRICS`` catalog in ``utils/metrics_catalog.py`` — an
+    uncataloged metric ships with no ``# HELP`` line and no docs;
+  * every catalog entry must be EMITTED somewhere in ``pinot_tpu/`` — a
+    catalog row nothing emits documents a series that does not exist;
+  * every catalog entry must appear in a README metrics-reference table
+    — operators discover series there, not in the catalog source.
+
+Prefix-composed emissions are namespaced by construction and OUT of
+scope: a ``_meter``/``_gauge_bytes``-style helper whose body builds the
+name with an f-string (``f"{prefix}_{name}"``, cache/core.py) marks its
+call-site literals as suffixes, not family names — detected statically
+from the helper's own def in the same module. Dynamically composed
+names passed to the registry directly (f-strings at the call site) are
+likewise skipped; only plain string literals are checked.
+
+Suppression code: ``metricdoc``.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from pinot_tpu.analysis.core import (
+    Checker, Finding, ModuleIndex, register, str_const,
+)
+
+_CATALOG_MODULE = "pinot_tpu/utils/metrics_catalog.py"
+#: registry methods whose literal first argument is a metric family name
+_EMITTERS = {"add_meter", "set_gauge", "add_timing", "time", "observe",
+             "remove_gauge", "set_exemplar", "meter", "_meter"}
+
+
+def parse_metrics_catalog(index: ModuleIndex) -> Optional[Dict[str, int]]:
+    """METRICS metric name -> line number, parsed statically."""
+    sf = index.get(_CATALOG_MODULE)
+    if sf is None:
+        return None
+    for node in ast.walk(sf.tree):
+        target = None
+        if isinstance(node, ast.AnnAssign) \
+                and isinstance(node.target, ast.Name):
+            target, value = node.target.id, node.value
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            target, value = node.targets[0].id, node.value
+        if target != "METRICS" or not isinstance(value, ast.Dict):
+            continue
+        out: Dict[str, int] = {}
+        for k in value.keys:
+            ks = str_const(k)
+            if ks is not None:
+                out[ks] = k.lineno
+        return out
+    return None
+
+
+def _composing_helpers(tree: ast.AST) -> Set[str]:
+    """Names of module-local methods that COMPOSE the metric name
+    (f-string in their body reaching a registry call) — their call-site
+    literals are namespaced suffixes, not family names."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if node.name not in _EMITTERS:
+            continue
+        for inner in ast.walk(node):
+            if isinstance(inner, ast.JoinedStr):
+                out.add(node.name)
+                break
+    return out
+
+
+@register
+class MetricsDocsChecker(Checker):
+    name = "metrics_docs"
+    code = "metricdoc"
+
+    def run(self, index: ModuleIndex) -> List[Finding]:
+        catalog = parse_metrics_catalog(index)
+        cat_sf = index.get(_CATALOG_MODULE)
+        if catalog is None or cat_sf is None:
+            # the catalog module vanishing is itself drift — but the
+            # fixture trees the unit tests build have no catalog at all;
+            # report only when the package looks real (has the registry)
+            reg_sf = index.get("pinot_tpu/utils/metrics.py")
+            if reg_sf is not None:
+                return [self.finding(
+                    reg_sf, 1, key="catalog:missing",
+                    message="utils/metrics_catalog.py METRICS catalog "
+                            "not found — # HELP exposition and the "
+                            "README metrics reference have no source")]
+            return []
+        emitted: Dict[str, List[Tuple]] = {}
+        scanned = 0
+        for sf in index.files("pinot_tpu/"):
+            if sf.relpath == _CATALOG_MODULE:
+                continue
+            composing = _composing_helpers(sf.tree)
+            for node in ast.walk(sf.tree):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _EMITTERS and node.args):
+                    continue
+                if node.func.attr in composing:
+                    continue  # namespaced by construction
+                arg = node.args[0]
+                # conditional names ("hedge_won" if won else
+                # "hedge_wasted") emit BOTH branches' literals
+                branches = ([arg.body, arg.orelse]
+                            if isinstance(arg, ast.IfExp) else [arg])
+                names = [n for n in map(str_const, branches)
+                         if n is not None]
+                if not names:
+                    continue  # dynamically composed — out of scope
+                scanned += 1
+                for name in names:
+                    emitted.setdefault(name, []).append((sf, node))
+        if not emitted:
+            files = index.files("pinot_tpu/")
+            if files:
+                return [self.finding(
+                    files[0], 1, key="scan:empty",
+                    message="metrics-docs scan matched zero literal "
+                            "metric emissions — pattern rot?")]
+            return []
+        out: List[Finding] = []
+        for name, sites in sorted(emitted.items()):
+            if name not in catalog:
+                sf, node = sites[0]
+                out.append(self.finding(
+                    sf, node, key=f"uncataloged:{name}",
+                    message=(f'metric "{name}" is emitted but has no '
+                             f"METRICS catalog entry "
+                             f"(utils/metrics_catalog.py) — it ships "
+                             f"with no # HELP line and no docs")))
+        readme = os.path.join(index.root, "README.md")
+        readme_text = ""
+        if os.path.exists(readme):
+            with open(readme, encoding="utf-8") as f:
+                readme_text = f.read()
+        for name, line in sorted(catalog.items()):
+            if name not in emitted:
+                out.append(self.finding(
+                    cat_sf, line, key=f"dead:{name}",
+                    message=(f'catalog metric "{name}" is emitted '
+                             f"nowhere in pinot_tpu/ — dead entry")))
+            if readme_text and name not in readme_text:
+                out.append(self.finding(
+                    cat_sf, line, key=f"undocumented:{name}",
+                    message=(f'catalog metric "{name}" appears in no '
+                             f"README metrics-reference table — "
+                             f"operators cannot discover it")))
+        return out

@@ -125,6 +125,7 @@ class BrokerRequestHandler:
                 "pinot.broker.slow.query.threshold.ms")
             self._trace_capacity = config.get_int(
                 "pinot.trace.store.capacity")
+            self._slo_p99_ms = config.get_float("pinot.slo.query.p99.ms")
         else:
             self._negative_cache = NegativeResultCache(
                 metrics=self._metrics, labels=neg_labels)
@@ -134,6 +135,7 @@ class BrokerRequestHandler:
             self._trace_enabled = True
             self._slow_threshold_ms = 10000.0
             self._trace_capacity = None
+            self._slo_p99_ms = 0.0
         #: query ids must be unique ACROSS brokers — two brokers' counters
         #: both start at 1, and the server's accountant keys cancels by id
         self._broker_nonce = uuid.uuid4().hex[:6]
@@ -255,7 +257,9 @@ class BrokerRequestHandler:
         slow-query log line even with trace=false. With
         pinot.trace.enabled=false none of this machinery exists."""
         if not self._trace_enabled:
-            return self._handle_inner(sql)
+            resp = self._handle_inner(sql)
+            self._meter_response(resp)
+            return resp
         rt = tracing.RequestTrace(sampled=False)
         inflight = trace_store.get_inflight("broker")
         inflight.begin(rt.trace_id, sql=sql, trace_id=rt.trace_id)
@@ -264,9 +268,15 @@ class BrokerRequestHandler:
                 resp = self._handle_inner(sql)
         finally:
             inflight.end(rt.trace_id)
+        self._meter_response(resp)
         dur = rt.root.duration_ms
         self._metrics.add_timing("broker_query_ms", dur,
                                  exemplar=rt.trace_id)
+        if self._slo_p99_ms and dur > self._slo_p99_ms:
+            # the latency-SLO burn numerator (health/slo.py): a
+            # windowed bad-queries counter, counted where the latency
+            # is measured
+            self._metrics.add_meter("slo_latency_bad")
         slow = (self._slow_threshold_ms > 0
                 and dur >= self._slow_threshold_ms)
         if rt.sampled:
@@ -284,6 +294,18 @@ class BrokerRequestHandler:
                     exceptions=len(resp.exceptions or []))
                 self._metrics.add_meter("slow_queries")
         return resp
+
+    def _meter_response(self, resp) -> None:
+        """Per-response counters the SLO error-rate burn reads
+        (health/slo.py _ERROR_FAMILIES / _QUERY_FAMILIES): total
+        queries, responses carrying any exception, and responses
+        carrying an errorCode-250 (deadline) entry specifically."""
+        self._metrics.add_meter("broker_queries")
+        excs = [e for e in (resp.exceptions or []) if isinstance(e, dict)]
+        if excs:
+            self._metrics.add_meter("broker_query_errors")
+        if any(e.get("errorCode") == 250 for e in excs):
+            self._metrics.add_meter("broker_error_code_250")
 
     def _timed_request(self, conn, server, physical_table, sql,
                        segment_names, request_id, extra_filter,
@@ -487,6 +509,11 @@ class BrokerRequestHandler:
         fut_map: Dict = {}
         attempt_seq = [0]
         tenant = self._tenant_of(ctx.table)
+        if req_trace is not None:
+            # /debug/queries actionability: the in-flight entry carries
+            # WHOSE query this is and how much budget remains
+            trace_store.get_inflight("broker").annotate(
+                req_trace.trace_id, tenant=tenant, deadline=deadline)
 
         #: per-query memo for (table, server) -> group index: the
         #: derivation scans every segment's replica list, which is too

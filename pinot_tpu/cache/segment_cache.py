@@ -136,7 +136,15 @@ class SegmentResultCache:
             return None
         payload = self._cache.get(
             (segment.name, segment_version(segment), plan_fp))
-        return self._decode(payload) if payload is not None else None
+        if payload is None:
+            return None
+        # workload accounting: serving this partial cost the cache tier
+        # these bytes instead of a re-execution (per-query attribution)
+        from pinot_tpu.utils.accounting import current_slip
+        slip = current_slip()
+        if slip is not None:
+            slip.add(cache_hit_bytes=len(payload))
+        return self._decode(payload)
 
     def put(self, segment: Any, plan_fp: str, result: Any) -> bool:
         if not self.enabled or not is_cacheable_segment(segment):
@@ -144,6 +152,12 @@ class SegmentResultCache:
         payload = self._encode(result)
         if payload is None:
             return False
+        # a put is the byte-priced face of a MISS: these bytes had to be
+        # computed (and written) because no tier held them
+        from pinot_tpu.utils.accounting import current_slip
+        slip = current_slip()
+        if slip is not None:
+            slip.add(cache_miss_bytes=len(payload))
         return self._cache.put(
             (segment.name, segment_version(segment), plan_fp), payload)
 

@@ -81,12 +81,22 @@ def run_controller(state_dir: str, port: int = 0, host: str = "127.0.0.1",
         "pinot.coordination.liveness.ttl.seconds")
     server.start()
     tasks.start()
+    # fleet health plane: the controller samples its OWN registry like
+    # every role, and sweeps the fleet (the periodic-health-task analog)
+    from pinot_tpu.health.history import start_sampling, stop_sampling
+    from pinot_tpu.health.rollup import make_cluster_monitor
+    start_sampling("controller", cfg)
+    monitor = None
+    if cfg.get_bool("pinot.cluster.health.enabled", True):
+        monitor = make_cluster_monitor(state, server, config=cfg)
+        monitor.start()
     rest = None
     if http_port is not None:
         from pinot_tpu.controller.http_api import ControllerHttpServer
         rest = ControllerHttpServer(state, coordination=server,
                                     host=host, port=http_port,
-                                    task_manager=tasks)
+                                    task_manager=tasks,
+                                    health_monitor=monitor)
         rest.start()
         print(f"controller REST on {rest.host}:{rest.port}", flush=True)
     print(f"controller listening on {server.address}", flush=True)
@@ -107,6 +117,9 @@ def run_controller(state_dir: str, port: int = 0, host: str = "127.0.0.1",
     finally:
         if rest is not None:
             rest.stop()
+        if monitor is not None:
+            monitor.stop()
+        stop_sampling("controller")
         tasks.stop()
         server.stop()
 
@@ -134,6 +147,8 @@ def run_cache_server(port: int = 0, host: str = "127.0.0.1", config=None,
     server.start()
     admin = _start_admin(cfg, "pinot.cache.server.admin.port",
                          ["cache_server"])
+    from pinot_tpu.health.history import start_sampling, stop_sampling
+    start_sampling("cache_server", cfg)
     if admin is not None:
         print(f"cache server admin http on {admin.host}:{admin.port}",
               flush=True)
@@ -145,6 +160,7 @@ def run_cache_server(port: int = 0, host: str = "127.0.0.1", config=None,
         while not stop.wait(2.0):
             pass
     finally:
+        stop_sampling("cache_server")
         if admin is not None:
             admin.stop()
         server.stop()
@@ -168,9 +184,19 @@ def run_minion(instance_id: str, coordinator: str,
                           task_types=task_types, config=cfg)
     worker.start()
     admin = _start_admin(cfg, "pinot.minion.admin.port", ["minion"])
+    from pinot_tpu.health.history import start_sampling, stop_sampling
+    start_sampling("minion", cfg)
     if admin is not None:
         print(f"minion admin http on {admin.host}:{admin.port}",
               flush=True)
+        # re-register with the scrape URL so the controller's
+        # cluster-health sweep reads this worker's /debug/health
+        try:
+            worker.client.register_instance(
+                instance_id, "127.0.0.1", 0, tags=["minion"],
+                admin_url=f"http://{admin.host}:{admin.port}")
+        except (ConnectionError, OSError, RuntimeError):
+            pass
     print(f"minion {instance_id} polling {coordinator}", flush=True)
     if ready_event is not None:
         ready_event.set()
@@ -182,6 +208,7 @@ def run_minion(instance_id: str, coordinator: str,
             except (ConnectionError, OSError, RuntimeError):
                 pass
     finally:
+        stop_sampling("minion")
         if admin is not None:
             admin.stop()
         worker.stop()
@@ -252,13 +279,22 @@ class ServerRole:
         if self.admin_http is not None:
             log.info("server %s admin http on %s:%s", self.instance_id,
                      self.admin_http.host, self.admin_http.port)
+        # fleet health plane: the background registry sampler (metrics
+        # history + SLO watchdog hook) for this process's server role
+        from pinot_tpu.health.history import start_sampling
+        start_sampling("server", self.config)
         self.client.register_instance(
             self.instance_id, self.transport.host, self.transport.port,
-            tags=[f"tenant:{self.tenant}"] if self.tenant else None)
+            tags=[f"tenant:{self.tenant}"] if self.tenant else None,
+            admin_url=(f"http://{self.admin_http.host}:"
+                       f"{self.admin_http.port}"
+                       if self.admin_http is not None else ""))
         self.reconcile()
         self.client.watch(lambda _v: self.reconcile())
 
     def stop(self) -> None:
+        from pinot_tpu.health.history import stop_sampling
+        stop_sampling("server")
         if self.admin_http is not None:
             self.admin_http.stop()
             self.admin_http = None
@@ -567,7 +603,8 @@ class BrokerRole:
     """One broker process: HTTP edge + routing rebuilt from watches."""
 
     def __init__(self, coordinator: str, http_port: int = 0,
-                 host: str = "127.0.0.1", config=None):
+                 host: str = "127.0.0.1", config=None,
+                 instance_id: Optional[str] = None):
         from pinot_tpu.broker.adaptive import AdaptiveServerSelector
         from pinot_tpu.broker.http_api import BrokerHttpServer
         from pinot_tpu.broker.quota import QueryQuotaManager
@@ -589,14 +626,26 @@ class BrokerRole:
             max_fanout_threads=cfg.get_int("pinot.broker.fanout.threads"),
             quota_manager=self.quotas, config=cfg)
         self.http = BrokerHttpServer(self.handler, host=host, port=http_port)
+        self._host = host
+        self.instance_id = instance_id or f"Broker_{host}_{self.http.port}"
         self._rebuild_lock = threading.Lock()
 
     def start(self) -> None:
         self.rebuild()
         self.client.watch(lambda _v: self.rebuild())
         self.http.start()
+        from pinot_tpu.health.history import start_sampling
+        start_sampling("broker", self._config)
+        # join the scrapeable fleet: the "broker" role tag keeps segment
+        # assignment away (cluster_state.NON_SERVER_TAGS); the broker's
+        # own HTTP edge serves /debug/health + /debug/metrics/sample
+        self.client.register_instance(
+            self.instance_id, self._host, 0, tags=["broker"],
+            admin_url=f"http://{self._host}:{self.http.port}")
 
     def stop(self) -> None:
+        from pinot_tpu.health.history import stop_sampling
+        stop_sampling("broker")
         self.client.close()
         self.http.stop()
         # snapshot under the rebuild lock: the coordinator-watch thread's
@@ -705,6 +754,12 @@ def run_broker(coordinator: str, http_port: int = 0, config=None,
     stop = stop_event or threading.Event()
     try:
         while not stop.wait(2.0):
-            pass
+            try:
+                # liveness for the health sweep: a broker that stops
+                # heartbeating reads "stale" in /cluster/health
+                role.client.request("heartbeat",
+                                    instance_id=role.instance_id)
+            except (ConnectionError, OSError, RuntimeError):
+                pass
     finally:
         role.stop()

@@ -51,6 +51,12 @@ DEFAULT_TENANT = "DefaultTenant"
 #: instance tag prefix that assigns a server to a tenant pool
 TENANT_TAG_PREFIX = "tenant:"
 
+#: role tags whose instances never receive segment assignments (ref
+#: Helix instance tags gating assignment): minion workers and — since
+#: the cluster-health sweep made every role register for scraping —
+#: brokers and cache servers too
+NON_SERVER_TAGS = {"minion", "broker", "cache_server"}
+
 
 @dataclass
 class InstanceState:
@@ -62,6 +68,9 @@ class InstanceState:
     #: physical table -> HBM-resident bytes this server advertises
     #: (heartbeat payload; feeds residency-aware broker replica choice)
     residency: Dict[str, int] = field(default_factory=dict)
+    #: the instance's /metrics + /debug HTTP surface, scraped by the
+    #: controller's cluster-health sweep ("" = not scrapeable)
+    admin_url: str = ""
 
     @property
     def tenant(self) -> str:
@@ -126,7 +135,7 @@ class ClusterState:
         segments land only on its tenant's servers."""
         with self._lock:
             out = [i for i in self.instances.values()
-                   if i.enabled and "minion" not in i.tags]
+                   if i.enabled and not NON_SERVER_TAGS & set(i.tags)]
         if tenant is not None:
             out = [i for i in out if i.tenant == tenant]
         return out
@@ -142,7 +151,7 @@ class ClusterState:
         upload over a transient blip."""
         with self._lock:
             out = [i for i in self.instances.values()
-                   if "minion" not in i.tags]
+                   if not NON_SERVER_TAGS & set(i.tags)]
         if tenant is not None:
             out = [i for i in out if i.tenant == tenant]
         return out

@@ -375,10 +375,15 @@ class CoordinationClient:
                      schema=schema.to_dict())
 
     def register_instance(self, instance_id: str, host: str, port: int,
-                          tags: Optional[List[str]] = None) -> None:
+                          tags: Optional[List[str]] = None,
+                          admin_url: str = "") -> None:
+        """admin_url: the instance's /metrics + /debug HTTP surface —
+        the controller's cluster-health sweep scrapes it (empty = not
+        scrapeable; the sweep reports liveness only)."""
         self.request("register_instance", instance={
             "instance_id": instance_id, "host": host, "port": port,
-            "enabled": True, "tags": tags or []})
+            "enabled": True, "tags": tags or [],
+            "admin_url": admin_url})
 
     def upload_segment(self, table: str, seg_dir: str,
                        table_type: str = "OFFLINE",

@@ -38,13 +38,15 @@ from pinot_tpu.models import Schema, TableConfig
 class ControllerHttpServer:
     def __init__(self, state: ClusterState, coordination=None,
                  host: str = "127.0.0.1", port: int = 0,
-                 task_manager=None):
+                 task_manager=None, health_monitor=None):
         self.state = state
         self.coordination = coordination  # CoordinationServer (optional)
         # task fabric (controller/task_manager.py); falls back to the
         # coordination server's manager so both wirings expose /tasks
         self.task_manager = task_manager or getattr(
             coordination, "task_manager", None)
+        #: health/rollup.ClusterHealthMonitor behind /cluster/* (optional)
+        self.health_monitor = health_monitor
         api = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -103,6 +105,16 @@ class ControllerHttpServer:
                         return self._reply(404,
                                            {"error": f"no route {path}"})
                     return self._reply(200, payload)
+                if method == "GET" and path in ("/cluster/health",
+                                                "/cluster/metrics"):
+                    mon = api.health_monitor
+                    if mon is None:
+                        return self._reply(
+                            503, {"error": "no cluster health monitor"})
+                    return self._reply(
+                        200, mon.cluster_health()
+                        if path == "/cluster/health"
+                        else mon.cluster_metrics())
                 if path == "/tasks" or path.startswith("/tasks/"):
                     return self._route_tasks(method, path, query)
                 if path == "/tables" and method == "GET":

@@ -1,0 +1,29 @@
+"""Fleet health plane (PR 14): metrics history, cluster rollup,
+workload accounting, SLO burn-rate watchdog.
+
+Four layers, bottom up:
+
+* ``history`` — a bounded per-role ring of timestamped
+  ``MetricsRegistry.sample()`` snapshots, filled by a background
+  sampler thread; ``/debug/metrics/history`` serves it on every role,
+  and ``timeseries/engine.py`` can query it (selfmetrics — the
+  time-series engine's first real consumer).
+* ``workload`` — per-(tenant, table, plan-fingerprint) cost rollup fed
+  from ``utils/accounting.QueryUsage`` at query finish; top-K by cost
+  at ``/debug/workload``.
+* ``slo`` — declarative targets (``pinot.slo.*``) evaluated as
+  multi-window burn rates over the history: ``slo_burn_rate`` gauges, a
+  structured ``SLO_BREACH`` log line, and a degraded verdict.
+* ``rollup`` — the controller's cluster-wide sweep: scrape every live
+  instance's ``/debug/health`` + ``/debug/metrics/sample`` into
+  ``GET /cluster/metrics`` and ``GET /cluster/health``.
+"""
+from pinot_tpu.health.history import (  # noqa: F401
+    MetricsHistory, MetricsSampler, get_history, start_sampling,
+    stop_sampling)
+from pinot_tpu.health.workload import WorkloadRegistry, get_workload  # noqa: F401,E501
+from pinot_tpu.health.slo import SloWatchdog, get_watchdog  # noqa: F401
+from pinot_tpu.health.rollup import (  # noqa: F401
+    ClusterHealthMonitor, ScrapeTarget, make_cluster_monitor,
+    role_health_summary)
+from pinot_tpu.health.selfmetrics import query_history  # noqa: F401

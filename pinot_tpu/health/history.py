@@ -1,0 +1,252 @@
+"""Per-role metrics history: a bounded ring of registry samples.
+
+Reference parity: the controller's periodic health tasks over the typed
+role registries (pinot-controller periodictask/ — e.g.
+SegmentStatusChecker sampling cluster metrics on a cadence). Here each
+role keeps its OWN recent history in memory: a background
+:class:`MetricsSampler` appends one ``MetricsRegistry.sample()``
+snapshot per ``pinot.metrics.history.interval.ms``, the ring holds
+``pinot.metrics.history.window.seconds`` worth, ``/debug/metrics/
+history`` serves it raw, the SLO watchdog evaluates burn rates over it,
+and ``health/selfmetrics.py`` exposes it as a table the time-series
+engine can query.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Tuple
+
+from pinot_tpu.utils.metrics import get_registry
+
+
+def family_items(mapping: Dict[str, float], family: str):
+    """(flat name, value) pairs of one metric family across its label
+    sets: a flat sample key matches when it IS the family name or
+    starts with ``family{``. THE series-identity rule every health
+    consumer shares — if MetricsRegistry.sample key formatting ever
+    changes, this is the one predicate to update."""
+    for k, v in mapping.items():
+        if k == family or k.startswith(family + "{"):
+            yield k, v
+
+
+class MetricsHistory:
+    """Bounded FIFO of flat registry samples for one role."""
+
+    def __init__(self, capacity: int = 512):
+        self.capacity = max(2, int(capacity))
+        self._samples: deque = deque()
+        self._lock = threading.Lock()
+
+    def append(self, sample: dict) -> None:
+        with self._lock:
+            self._samples.append(sample)
+            while len(self._samples) > self.capacity:
+                self._samples.popleft()
+
+    def samples(self, window_s: Optional[float] = None,
+                now: Optional[float] = None) -> List[dict]:
+        """Oldest-first samples; window_s restricts to the trailing
+        window (sample ts >= now - window_s)."""
+        with self._lock:
+            out = list(self._samples)
+        if window_s is None:
+            return out
+        cutoff = (now if now is not None else time.time()) - window_s
+        return [s for s in out if s.get("ts", 0.0) >= cutoff]
+
+    def latest(self) -> Optional[dict]:
+        with self._lock:
+            return self._samples[-1] if self._samples else None
+
+    def counter_delta(self, flat_name: str, window_s: float,
+                      now: Optional[float] = None
+                      ) -> Tuple[float, float]:
+        """(value delta, elapsed seconds) between the oldest and newest
+        sample in the window for one flat counter series. A negative
+        delta (registry reset between samples) clamps to the newest
+        value — a restart must not read as negative traffic."""
+        win = self.samples(window_s, now=now)
+        if len(win) < 2:
+            return 0.0, 0.0
+        first, last = win[0], win[-1]
+        v0 = float(first.get("counters", {}).get(flat_name, 0.0))
+        v1 = float(last.get("counters", {}).get(flat_name, 0.0))
+        delta = v1 - v0
+        if delta < 0:
+            delta = v1
+        return delta, max(0.0, float(last["ts"]) - float(first["ts"]))
+
+    def counter_sum_delta(self, name_prefix: str, window_s: float,
+                          now: Optional[float] = None
+                          ) -> Tuple[float, float]:
+        """Like counter_delta but summed over every series whose flat
+        name is ``name_prefix`` or starts with ``name_prefix{`` (all
+        label sets of one family)."""
+        win = self.samples(window_s, now=now)
+        if len(win) < 2:
+            return 0.0, 0.0
+
+        def fam_total(sample: dict) -> float:
+            return sum(float(v) for _k, v in family_items(
+                sample.get("counters", {}), name_prefix))
+
+        first, last = win[0], win[-1]
+        delta = fam_total(last) - fam_total(first)
+        if delta < 0:
+            delta = fam_total(last)
+        return delta, max(0.0, float(last["ts"]) - float(first["ts"]))
+
+    def timer_series(self, name_prefix: str, field: str,
+                     window_s: float, now: Optional[float] = None
+                     ) -> List[Tuple[float, float]]:
+        """(ts, value) per sample in the window for one timer family
+        field (p99/p50/...), taking the WORST (max) value across label
+        sets — the conservative fleet view of a latency quantile."""
+        out: List[Tuple[float, float]] = []
+        for s in self.samples(window_s, now=now):
+            best: Optional[float] = None
+            for _k, t in family_items(s.get("timers", {}), name_prefix):
+                v = float(t.get(field, 0.0))
+                if best is None or v > best:
+                    best = v
+            if best is not None:
+                out.append((float(s["ts"]), best))
+        return out
+
+    def gauge_max(self, name_prefix: str) -> Optional[float]:
+        """Max over label sets of one gauge family in the LATEST sample
+        (e.g. worst ingestion_delay_ms across partitions)."""
+        last = self.latest()
+        if last is None:
+            return None
+        best: Optional[float] = None
+        for _k, v in family_items(last.get("gauges", {}), name_prefix):
+            if best is None or float(v) > best:
+                best = float(v)
+        return best
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._samples)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._samples.clear()
+
+
+class MetricsSampler:
+    """Background thread appending one registry sample per interval to
+    the role's history, then running registered hooks (the SLO watchdog
+    evaluates there). ``sample_once()`` is the synchronous unit tests
+    and the rollup drive directly."""
+
+    def __init__(self, role: str, interval_s: float = 1.0,
+                 history: Optional[MetricsHistory] = None,
+                 registry=None):
+        self.role = role
+        self.interval_s = max(0.01, float(interval_s))
+        self.history = history if history is not None else get_history(role)
+        self._registry = registry if registry is not None \
+            else get_registry(role)
+        self._hooks: List[Callable[[], None]] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def add_hook(self, fn: Callable[[], None]) -> None:
+        self._hooks.append(fn)
+
+    def sample_once(self) -> dict:
+        sample = self._registry.sample()
+        self.history.append(sample)
+        self._registry.add_meter("metrics_history_samples")
+        for fn in list(self._hooks):
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — a hook bug must not
+                # stop the sampling cadence (history feeds /cluster/health;
+                # losing it would blind the fleet exactly when it's sick)
+                import logging
+                logging.getLogger(__name__).exception(
+                    "metrics-sampler hook failed (role=%s)", self.role)
+        return sample
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"metrics-sampler-{self.role}")
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.sample_once()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2.0)
+        self._thread = None
+
+
+# -- per-role singletons (the get_registry pattern) -------------------------
+_histories: Dict[str, MetricsHistory] = {}
+_samplers: Dict[str, MetricsSampler] = {}
+_lock = threading.Lock()
+
+
+def get_history(role: str = "server",
+                capacity: Optional[int] = None) -> MetricsHistory:
+    with _lock:
+        h = _histories.get(role)
+        if h is None:
+            h = _histories[role] = MetricsHistory(capacity or 512)
+        elif capacity is not None:
+            h.capacity = max(2, int(capacity))
+        return h
+
+
+def start_sampling(role: str, config=None) -> Optional[MetricsSampler]:
+    """Idempotently start the role's background sampler (plus its SLO
+    watchdog hook) from config knobs. Returns None when
+    ``pinot.metrics.history.enabled`` is off — the bench's A-side runs
+    with NO history machinery at all."""
+    from pinot_tpu.utils.config import PinotConfiguration
+    cfg = config or PinotConfiguration()
+    if not cfg.get_bool("pinot.metrics.history.enabled", True):
+        return None
+    interval_s = max(0.01, cfg.get_float(
+        "pinot.metrics.history.interval.ms", 1000.0) / 1000.0)
+    window_s = max(interval_s, cfg.get_float(
+        "pinot.metrics.history.window.seconds", 300.0))
+    capacity = max(8, int(window_s / interval_s) + 1)
+    # resolve the history BEFORE taking the module lock — get_history
+    # takes the same (non-reentrant) lock
+    history = get_history(role, capacity=capacity)
+    with _lock:
+        existing = _samplers.get(role)
+        if existing is not None:
+            return existing
+        sampler = MetricsSampler(role, interval_s=interval_s,
+                                 history=history)
+        _samplers[role] = sampler
+    from pinot_tpu.health.slo import SloWatchdog, _register_watchdog
+    dog = SloWatchdog(role, sampler.history, config=cfg)
+    _register_watchdog(role, dog)
+    sampler.add_hook(dog.evaluate)
+    sampler.start()
+    return sampler
+
+
+def stop_sampling(role: str) -> None:
+    with _lock:
+        sampler = _samplers.pop(role, None)
+    if sampler is not None:
+        sampler.stop()
+    from pinot_tpu.health.slo import _register_watchdog
+    _register_watchdog(role, None)

@@ -1,0 +1,343 @@
+"""Cluster-wide health rollup: the controller's periodic fleet sweep.
+
+Reference parity: pinot-controller periodictask/ (SegmentStatusChecker
+and friends sampling cluster health on a cadence) over the typed role
+registries. Here a :class:`ClusterHealthMonitor` periodically scrapes
+every instance's ``/debug/health`` + ``/debug/metrics/sample`` (the
+per-role admin surface every role mounts) and folds the results —
+together with coordination-heartbeat liveness — into:
+
+* ``GET /cluster/health`` — one JSON verdict per instance and
+  subsystem: liveness, circuit-breaker states, ingestion lag /
+  backpressure, task-queue depth, deadline-miss (errorCode-250) rates,
+  SLO burn verdicts. A scrape failure marks the instance DEGRADED with
+  the reason attached; the sweep itself never throws.
+* ``GET /cluster/metrics`` — summed counters across instances (one
+  fleet-wide number per family+labels) plus per-instance gauges.
+
+The per-instance half lives in :func:`role_health_summary`: the local
+verdict a role serves at ``/debug/health``, built from its latest
+registry sample, its history, and its SLO watchdog.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from pinot_tpu.health.history import family_items as _family_items
+from pinot_tpu.utils.metrics import get_registry
+
+log = logging.getLogger(__name__)
+
+#: remote-tier circuit breaker gauge values (cache/remote.py)
+_BREAKER_CLOSED = 0.0
+
+
+def role_health_summary(role: str, config=None,
+                        registry=None) -> dict:
+    """The per-role /debug/health payload: a live/degraded verdict per
+    subsystem from the role's OWN metrics + SLO watchdog. Cheap enough
+    for every scrape tick — one registry sample, no history walk."""
+    from pinot_tpu.health.history import get_history
+    from pinot_tpu.health.slo import get_watchdog
+    reg = registry if registry is not None else get_registry(role)
+    sample = reg.sample()
+    gauges = sample.get("gauges", {})
+    counters = sample.get("counters", {})
+    subsystems: Dict[str, dict] = {}
+
+    # circuit breakers (remote cache tiers): any non-closed breaker is a
+    # degraded data path — queries still serve, L2 is dark for its range
+    breakers = {k: v for k, v in _family_items(
+        gauges, "remote_cache_breaker_state")}
+    open_breakers = {k: v for k, v in breakers.items()
+                     if v != _BREAKER_CLOSED}
+    subsystems["breakers"] = {
+        "ok": not open_breakers,
+        "open": sorted(open_breakers),
+        "total": len(breakers)}
+
+    # ingestion: worst per-partition lag + backpressure pause pressure
+    lags = [v for _k, v in _family_items(gauges, "ingestion_delay_ms")]
+    paused = [v for _k, v in _family_items(
+        gauges, "ingest_consumer_paused")]
+    subsystems["ingestion"] = {
+        "ok": not any(paused),
+        "maxDelayMs": round(max(lags), 3) if lags else None,
+        "pausedPartitions": int(sum(1 for p in paused if p))}
+
+    # task fabric: queue depth + worker occupancy (report-only — a deep
+    # queue is load, not sickness; lease expiry handles stuck workers)
+    depth = gauges.get("task_queue_depth")
+    subsystems["tasks"] = {"ok": True, "queueDepth": depth}
+
+    # deadline pressure: errorCode-250 partials + killed queries as a
+    # running total (rates are the history/SLO layer's job)
+    killed = sum(v for _k, v in _family_items(counters, "queries_killed"))
+    code250 = sum(v for _k, v in _family_items(
+        counters, "broker_error_code_250"))
+    expired = sum(v for _k, v in _family_items(
+        counters, "deadline_expired"))
+    subsystems["deadlines"] = {
+        "ok": True,
+        "errorCode250": code250, "queriesKilled": killed,
+        "gatherExpired": expired}
+
+    # SLO watchdog: the only subsystem allowed to flip the verdict from
+    # burn-rate math (multi-window — resistant to blips by construction)
+    dog = get_watchdog(role)
+    slo_verdicts = dog.verdicts() if dog is not None else {}
+    slo_breached = any(v.get("breached") for v in slo_verdicts.values())
+    subsystems["slo"] = {"ok": not slo_breached, "targets": slo_verdicts}
+
+    degraded = [name for name, sub in subsystems.items()
+                if not sub.get("ok", True)]
+    return {
+        "role": role,
+        "verdict": "degraded" if degraded else "live",
+        "degraded": degraded,
+        "subsystems": subsystems,
+        "historySamples": len(get_history(role)),
+        "ts": sample["ts"],
+    }
+
+
+@dataclass
+class ScrapeTarget:
+    """One scrapeable instance: either an HTTP base url (a role's admin
+    / controller / broker surface) or an in-process fetch callable
+    (embedded clusters) returning the same payload shape."""
+
+    instance_id: str
+    url: str = ""
+    #: () -> {"health": <role_health_summary>, "sample": <registry sample>}
+    fetch: Optional[Callable[[], dict]] = None
+    role: str = "server"
+    extra: dict = field(default_factory=dict)
+
+
+class ClusterHealthMonitor:
+    """Periodic fleet sweep over scrape targets + heartbeat liveness.
+
+    ``targets_fn`` re-resolves per sweep (instances come and go);
+    ``liveness_fn`` returns {instance_id: heartbeat age seconds} (absent
+    id = no liveness signal, reported as "unknown"). Every per-target
+    failure is caught and folded into that instance's verdict — a sweep
+    NEVER raises, because the health plane failing is exactly when the
+    operator needs it."""
+
+    def __init__(self, targets_fn: Callable[[], List[ScrapeTarget]],
+                 liveness_fn: Optional[Callable[[], Dict[str, float]]] = None,
+                 interval_s: float = 5.0, timeout_s: float = 2.0,
+                 liveness_ttl_s: float = 15.0, metrics=None,
+                 role: str = "controller"):
+        self.targets_fn = targets_fn
+        self.liveness_fn = liveness_fn
+        self.interval_s = max(0.05, float(interval_s))
+        self.timeout_s = max(0.1, float(timeout_s))
+        self.liveness_ttl_s = float(liveness_ttl_s)
+        self._metrics = metrics if metrics is not None \
+            else get_registry(role)
+        self._last: Optional[dict] = None
+        self._samples: Dict[str, dict] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- scraping -------------------------------------------------------
+    def _scrape(self, t: ScrapeTarget) -> dict:
+        if t.fetch is not None:
+            return t.fetch()
+        out = {}
+        for key, path in (("health", "/debug/health"),
+                          ("sample", "/debug/metrics/sample")):
+            with urllib.request.urlopen(t.url.rstrip("/") + path,
+                                        timeout=self.timeout_s) as resp:
+                out[key] = json.loads(resp.read())
+        return out
+
+    def _try_scrape(self, t: ScrapeTarget):
+        """(payload, None) on success, (None, reason) on any failure —
+        the pool-safe wrapper sweep() fans out over."""
+        try:
+            return self._scrape(t), None
+        except Exception as e:  # noqa: BLE001 — degraded, never throw
+            return None, f"{type(e).__name__}: {e}"
+
+    def sweep(self, now: Optional[float] = None) -> dict:
+        """One full pass; returns (and retains) the /cluster/health
+        payload. Never raises."""
+        now = now if now is not None else time.time()
+        try:
+            targets = list(self.targets_fn())
+        except Exception:  # noqa: BLE001 — the sweep must survive
+            log.exception("health sweep: targets_fn failed")
+            targets = []
+        ages: Dict[str, float] = {}
+        if self.liveness_fn is not None:
+            try:
+                ages = dict(self.liveness_fn())
+            except Exception:  # noqa: BLE001
+                log.exception("health sweep: liveness_fn failed")
+        instances: Dict[str, dict] = {}
+        samples: Dict[str, dict] = {}
+        # scrape CONCURRENTLY: serially, a handful of accept-but-hang
+        # instances would each eat a full scrape timeout and blow the
+        # sweep past its interval for the whole fleet
+        if targets:
+            with ThreadPoolExecutor(
+                    max_workers=min(8, len(targets)),
+                    thread_name_prefix="health-scrape") as pool:
+                scraped_by_id = dict(pool.map(
+                    lambda t: (t.instance_id, self._try_scrape(t)),
+                    targets))
+        for t in targets:
+            entry: dict = {"role": t.role, **t.extra}
+            age = ages.get(t.instance_id)
+            if age is None:
+                entry["liveness"] = "unknown"
+            else:
+                entry["lastHeartbeatAgeSeconds"] = round(age, 3)
+                entry["liveness"] = ("live" if age <= self.liveness_ttl_s
+                                     else "stale")
+            scraped, err = scraped_by_id.get(t.instance_id, (None, None))
+            if scraped is not None:
+                health = scraped.get("health") or {}
+                entry["reachable"] = True
+                entry["verdict"] = health.get("verdict", "live")
+                entry["degraded"] = health.get("degraded", [])
+                entry["subsystems"] = health.get("subsystems", {})
+                sample = scraped.get("sample")
+                if sample:
+                    samples[t.instance_id] = sample
+            else:
+                self._metrics.add_meter("cluster_scrape_failures")
+                entry["reachable"] = False
+                entry["verdict"] = "degraded"
+                entry["reason"] = f"scrape failed: {err}"
+            if entry.get("liveness") == "stale":
+                entry["verdict"] = "degraded"
+                entry.setdefault("reason", "heartbeat stale")
+            instances[t.instance_id] = entry
+        live = sum(1 for e in instances.values()
+                   if e.get("verdict") == "live")
+        degraded = len(instances) - live
+        self._metrics.set_gauge("cluster_instances_live", live)
+        self._metrics.set_gauge("cluster_instances_degraded", degraded)
+        payload = {
+            "ts": now,
+            "verdict": "degraded" if degraded else "live",
+            "instancesLive": live,
+            "instancesDegraded": degraded,
+            "instances": instances,
+        }
+        with self._lock:
+            self._last = payload
+            self._samples = samples
+        return payload
+
+    # -- payloads -------------------------------------------------------
+    def cluster_health(self) -> dict:
+        """Last sweep's verdict payload (sweeps synchronously when no
+        sweep has run yet — the first GET must not answer empty)."""
+        with self._lock:
+            last = self._last
+        return last if last is not None else self.sweep()
+
+    def cluster_metrics(self) -> dict:
+        """Fleet-wide rollup from the last sweep's samples: counters
+        summed across instances per family+labels, gauges kept
+        per-instance (a gauge sum across hosts is rarely meaningful)."""
+        with self._lock:
+            samples = dict(self._samples)
+            swept = self._last is not None
+        if not samples and not swept:
+            self.sweep()  # first GET before the first tick: answer live
+            with self._lock:
+                samples = dict(self._samples)
+        counters: Dict[str, float] = {}
+        gauges: Dict[str, Dict[str, float]] = {}
+        for iid, s in sorted(samples.items()):
+            for k, v in s.get("counters", {}).items():
+                counters[k] = counters.get(k, 0.0) + float(v)
+            for k, v in s.get("gauges", {}).items():
+                gauges.setdefault(iid, {})[k] = v
+        return {"ts": time.time(), "instances": sorted(samples),
+                "counters": counters, "gaugesByInstance": gauges}
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="cluster-health-monitor")
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.sweep()
+            except Exception:  # noqa: BLE001 — belt over sweep's braces
+                log.exception("cluster health sweep failed")
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2.0)
+        self._thread = None
+
+
+def _role_of_tags(tags) -> str:
+    for t in ("minion", "broker", "cache_server"):
+        if t in tags:
+            return t
+    return "server"
+
+
+def make_cluster_monitor(state, coordination=None,
+                         config=None) -> ClusterHealthMonitor:
+    """The controller's fleet monitor over its live cluster state:
+    targets re-resolve per sweep from registered instances carrying an
+    ``admin_url`` (servers' DebugHttpServer, brokers' HTTP edge, minion
+    workers), plus an in-process self-target for the controller role;
+    liveness rides the coordination server's heartbeat ages."""
+    from pinot_tpu.utils.config import PinotConfiguration
+    cfg = config or PinotConfiguration()
+    controller_cfg = cfg
+
+    def controller_self() -> dict:
+        return {"health": role_health_summary("controller",
+                                              config=controller_cfg),
+                "sample": get_registry("controller").sample()}
+
+    def targets_fn():
+        out = [ScrapeTarget(instance_id="controller",
+                            fetch=controller_self, role="controller")]
+        with state._lock:
+            insts = list(state.instances.values())
+        for inst in insts:
+            if not inst.admin_url:
+                continue
+            out.append(ScrapeTarget(
+                instance_id=inst.instance_id, url=inst.admin_url,
+                role=_role_of_tags(inst.tags)))
+        return out
+
+    liveness_fn = (coordination.heartbeat_ages
+                   if coordination is not None else None)
+    ttl = (coordination.LIVENESS_TTL_S if coordination is not None
+           else cfg.get_float("pinot.coordination.liveness.ttl.seconds"))
+    return ClusterHealthMonitor(
+        targets_fn, liveness_fn=liveness_fn,
+        interval_s=cfg.get_float("pinot.cluster.health.interval.seconds"),
+        timeout_s=cfg.get_float(
+            "pinot.cluster.health.scrape.timeout.seconds"),
+        liveness_ttl_s=ttl)

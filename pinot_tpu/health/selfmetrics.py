@@ -1,0 +1,123 @@
+"""selfmetrics: the per-role metrics history as a queryable table.
+
+The dogfood leg of the health plane (ROADMAP item 5's first real
+consumer): a role's :class:`~pinot_tpu.health.history.MetricsHistory`
+ring materializes into a real immutable segment — table ``selfmetrics``,
+one row per (sample, numeric series) — and the time-series engine
+(timeseries/engine.py simpleql) queries it through the regular
+:class:`~pinot_tpu.query.executor.QueryExecutor` leaf bridge. The
+system answers questions about itself with its own query engine:
+
+    fetch(selfmetrics, value, ts, 1000, 1060, 10)
+      | where(family = 'queries') | sum() | rate()
+
+Columns:
+
+* ``ts``     — sample wall-clock time, whole seconds (LONG)
+* ``name``   — full flat series name incl. labels + timer field suffix
+               (``query_execution{table="t"}:p99``)
+* ``family`` — bare metric family (``query_execution``) — the usual
+               ``where(family = '…')`` filter key
+* ``kind``   — counter | gauge | timer
+* ``role``   — the sampled role
+* ``value``  — the numeric observation (DOUBLE); counters are cumulative
+               (pipe through ``rate()`` for per-second rates)
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+from typing import List, Optional
+
+from pinot_tpu.health.history import MetricsHistory, get_history
+
+#: timer snapshot fields worth exposing as series (suffixing the name)
+_TIMER_FIELDS = ("count", "sum_ms", "max_ms", "p50", "p95", "p99")
+
+
+def _family(flat_name: str) -> str:
+    return flat_name.partition("{")[0]
+
+
+def history_rows(history: MetricsHistory, role: str = "server",
+                 window_s: Optional[float] = None) -> List[tuple]:
+    """(ts, name, family, kind, role, value) per numeric series per
+    sample, oldest first."""
+    rows: List[tuple] = []
+    for s in history.samples(window_s):
+        ts = int(s.get("ts", 0.0))
+        srole = s.get("role", role)
+        for k, v in s.get("counters", {}).items():
+            rows.append((ts, k, _family(k), "counter", srole, float(v)))
+        for k, v in s.get("gauges", {}).items():
+            rows.append((ts, k, _family(k), "gauge", srole, float(v)))
+        for k, t in s.get("timers", {}).items():
+            for f in _TIMER_FIELDS:
+                rows.append((ts, f"{k}:{f}", _family(k), "timer", srole,
+                             float(t.get(f, 0.0))))
+    return rows
+
+
+def materialize_segment(out_dir: str, role: str = "server",
+                        history: Optional[MetricsHistory] = None,
+                        window_s: Optional[float] = None,
+                        segment_name: str = "selfmetrics_0"):
+    """Build + load one immutable ``selfmetrics`` segment from the
+    role's history ring. Raises ValueError on an empty history — a
+    zero-doc segment would answer every query with silence that is
+    indistinguishable from 'sampler never ran'."""
+    import numpy as np
+
+    from pinot_tpu.models import (DataType, FieldSpec, FieldType, Schema,
+                                  TableConfig)
+    from pinot_tpu.segment.creator import SegmentCreator
+    from pinot_tpu.segment.loader import load_segment
+
+    rows = history_rows(history if history is not None
+                        else get_history(role), role=role,
+                        window_s=window_s)
+    if not rows:
+        raise ValueError(
+            f"no metrics-history samples for role {role!r} — is the "
+            f"sampler running (pinot.metrics.history.enabled)?")
+    schema = Schema("selfmetrics", [
+        FieldSpec("ts", DataType.LONG, FieldType.DIMENSION),
+        FieldSpec("name", DataType.STRING, FieldType.DIMENSION),
+        FieldSpec("family", DataType.STRING, FieldType.DIMENSION),
+        FieldSpec("kind", DataType.STRING, FieldType.DIMENSION),
+        FieldSpec("role", DataType.STRING, FieldType.DIMENSION),
+        FieldSpec("value", DataType.DOUBLE, FieldType.METRIC)])
+    cols = {
+        "ts": np.array([r[0] for r in rows], np.int64),
+        "name": np.array([r[1] for r in rows], object),
+        "family": np.array([r[2] for r in rows], object),
+        "kind": np.array([r[3] for r in rows], object),
+        "role": np.array([r[4] for r in rows], object),
+        "value": np.array([r[5] for r in rows], np.float64),
+    }
+    seg_dir = os.path.join(out_dir, segment_name)
+    SegmentCreator(TableConfig(name="selfmetrics"), schema).build(
+        cols, seg_dir, segment_name)
+    return load_segment(seg_dir)
+
+
+def query_history(simpleql: str, role: str = "server",
+                  history: Optional[MetricsHistory] = None,
+                  window_s: Optional[float] = None):
+    """Answer a simpleql query over the role's own metrics history:
+    materialize the ring into a throwaway segment and run the
+    time-series plan through the regular single-process executor (the
+    engine's leaf bridge — full SQL pushdown, device offload when the
+    shape qualifies). Returns a TimeSeriesBlock."""
+    from pinot_tpu.query.executor import QueryExecutor
+    from pinot_tpu.timeseries.engine import query as ts_query
+
+    tmp = tempfile.mkdtemp(prefix="selfmetrics-")
+    try:
+        seg = materialize_segment(tmp, role=role, history=history,
+                                  window_s=window_s)
+        ex = QueryExecutor([seg], use_tpu=False)
+        return ts_query(simpleql, ex)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)

@@ -1,0 +1,221 @@
+"""SLO burn-rate watchdog over the per-role metrics history.
+
+Declarative targets (``pinot.slo.*`` knobs) evaluated as MULTI-WINDOW
+burn rates (the Google SRE workbook alerting shape): each target's
+error-budget consumption rate is computed over a short and a long
+trailing window of :class:`~pinot_tpu.health.history.MetricsHistory`
+samples, and a breach requires BOTH windows over the threshold — the
+short window makes the alert fast, the long window keeps a one-sample
+blip from paging anyone. Outputs, per evaluation:
+
+* ``slo_burn_rate{slo=…}`` gauge (the short-window burn — the fast
+  signal dashboards plot);
+* on a breach ONSET, one structured ``SLO_BREACH`` JSON log line and an
+  ``slo_breaches{slo=…}`` meter bump (onset-only: a sustained breach is
+  one incident, not one log line per sampling tick);
+* a per-target verdict served inside ``/debug/health`` and rolled into
+  the controller's ``/cluster/health``.
+
+Targets (a knob left at 0 disables its target):
+
+* ``pinot.slo.query.p99.ms`` — queries whose measured latency exceeded
+  the target, counted at the recording sites into the
+  ``slo_latency_bad`` meter and read back as WINDOWED counter deltas:
+  burn = (bad queries / total queries over the window) /
+  ``pinot.slo.latency.budget``. Deliberately NOT the registry timer
+  p99s: those quantiles come from a lifetime equal-probability
+  reservoir (utils/metrics.py Timer, algorithm R), so every history
+  sample carries the same slowly-moving cumulative value — a burn
+  computed from them would stay breached long after latency recovered
+  and the short/long windows could never disagree.
+* ``pinot.slo.error.rate`` — error responses (exceptions + deadline
+  kills) per query over the window must stay at/under the target rate.
+  burn = observed rate / target rate.
+* ``pinot.slo.freshness.ms`` — worst per-partition ingestion lag per
+  sample must stay at/under the target; the budget is the allowed
+  bad-sample fraction. burn = observed bad fraction / budget.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from pinot_tpu.health.history import MetricsHistory, family_items
+from pinot_tpu.utils.metrics import get_registry
+
+slo_log = logging.getLogger("pinot_tpu.slo")
+
+#: counter the latency burn reads: queries over the configured p99
+#: target, bumped where the latency is measured (broker handle(),
+#: server _execute_inner) — see the module docstring for why this is a
+#: counter and not the registry timer quantiles
+_LATENCY_BAD_FAMILY = "slo_latency_bad"
+#: counter families summed into the error-rate numerator. NOT
+#: broker_error_code_250: the broker bumps broker_query_errors for ANY
+#: exception entry, deadline partials included, so adding the
+#: 250-specific family would double-count every deadline miss (it
+#: stays a /cluster/health diagnostic). Server-side kills vs raises
+#: are mutually exclusive branches — both belong.
+_ERROR_FAMILIES = ("broker_query_errors", "query_exceptions",
+                   "queries_killed")
+_QUERY_FAMILIES = ("broker_queries", "queries")
+
+
+class SloWatchdog:
+    """Evaluates the configured targets over one role's history; runs as
+    a :class:`~pinot_tpu.health.history.MetricsSampler` hook (once per
+    sampling tick) or synchronously via :meth:`evaluate` in tests."""
+
+    def __init__(self, role: str, history: MetricsHistory, config=None,
+                 metrics=None):
+        from pinot_tpu.utils.config import PinotConfiguration
+        cfg = config or PinotConfiguration()
+        self.role = role
+        self.history = history
+        self._metrics = metrics if metrics is not None \
+            else get_registry(role)
+        self.p99_target_ms = cfg.get_float("pinot.slo.query.p99.ms")
+        self.error_rate_target = cfg.get_float("pinot.slo.error.rate")
+        self.freshness_target_ms = cfg.get_float("pinot.slo.freshness.ms")
+        self.short_s = max(1.0, cfg.get_float(
+            "pinot.slo.window.short.seconds"))
+        self.long_s = max(self.short_s, cfg.get_float(
+            "pinot.slo.window.long.seconds"))
+        self.burn_threshold = max(0.0, cfg.get_float(
+            "pinot.slo.burn.threshold"))
+        self.latency_budget = min(1.0, max(1e-6, cfg.get_float(
+            "pinot.slo.latency.budget")))
+        #: slo name -> currently-breached flag (onset edge detection)
+        self._breached: Dict[str, bool] = {}
+        self._verdicts: Dict[str, dict] = {}
+        self._lock = threading.Lock()
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.p99_target_ms or self.error_rate_target
+                    or self.freshness_target_ms)
+
+    # -- burn-rate math -------------------------------------------------
+    def _bad_fraction_burn(self, series: List[Tuple[float, float]],
+                           target: float) -> float:
+        """Burn for sample-fraction targets (freshness): the fraction
+        of window samples whose value exceeded the target, divided by
+        the budgeted fraction. 0.0 with no samples — an idle role has
+        burned no budget."""
+        if not series:
+            return 0.0
+        bad = sum(1 for _ts, v in series if v > target)
+        return (bad / len(series)) / self.latency_budget
+
+    def _latency_burn(self, window_s: float, now: float) -> float:
+        """(bad queries / total queries over the window) / budget —
+        windowed counter deltas, 0.0 when the role served nothing."""
+        bad = self.history.counter_sum_delta(
+            _LATENCY_BAD_FAMILY, window_s, now=now)[0]
+        queries = sum(self.history.counter_sum_delta(f, window_s, now=now)[0]
+                      for f in _QUERY_FAMILIES)
+        if queries <= 0:
+            return 0.0
+        return (bad / queries) / self.latency_budget
+
+    def _error_burn(self, window_s: float, now: float) -> float:
+        errors = sum(self.history.counter_sum_delta(f, window_s, now=now)[0]
+                     for f in _ERROR_FAMILIES)
+        queries = sum(self.history.counter_sum_delta(f, window_s, now=now)[0]
+                      for f in _QUERY_FAMILIES)
+        if queries <= 0:
+            return 0.0
+        return (errors / queries) / self.error_rate_target
+
+    def _freshness_series(self, window_s: float,
+                          now: float) -> List[Tuple[float, float]]:
+        """Per-sample worst ingestion lag across partitions."""
+        out: List[Tuple[float, float]] = []
+        for s in self.history.samples(window_s, now=now):
+            worst: Optional[float] = None
+            for _k, v in family_items(s.get("gauges", {}),
+                                      "ingestion_delay_ms"):
+                if worst is None or float(v) > worst:
+                    worst = float(v)
+            if worst is not None:
+                out.append((float(s["ts"]), worst))
+        return out
+
+    # -- evaluation -----------------------------------------------------
+    def evaluate(self, now: Optional[float] = None) -> Dict[str, dict]:
+        """One multi-window pass over every configured target. Returns
+        (and retains, for /debug/health) {slo name: verdict dict}."""
+        now = now if now is not None else time.time()
+        targets = []
+        if self.p99_target_ms:
+            targets.append(("query.p99.ms", self.p99_target_ms,
+                            self._latency_burn))
+        if self.error_rate_target:
+            targets.append(("error.rate", self.error_rate_target,
+                            self._error_burn))
+        if self.freshness_target_ms:
+            targets.append((
+                "freshness.ms", self.freshness_target_ms,
+                lambda w, n: self._bad_fraction_burn(
+                    self._freshness_series(w, n), self.freshness_target_ms)))
+        verdicts: Dict[str, dict] = {}
+        for name, target, burn_fn in targets:
+            burn_short = burn_fn(self.short_s, now)
+            burn_long = burn_fn(self.long_s, now)
+            breached = (burn_short > self.burn_threshold
+                        and burn_long > self.burn_threshold)
+            self._metrics.set_gauge("slo_burn_rate", round(burn_short, 4),
+                                    labels={"slo": name})
+            with self._lock:
+                was = self._breached.get(name, False)
+                self._breached[name] = breached
+            if breached and not was:
+                self._metrics.add_meter("slo_breaches",
+                                        labels={"slo": name})
+                slo_log.warning("SLO_BREACH %s", json.dumps({
+                    "role": self.role, "slo": name, "target": target,
+                    "burnShort": round(burn_short, 4),
+                    "burnLong": round(burn_long, 4),
+                    "windowShortS": self.short_s,
+                    "windowLongS": self.long_s,
+                    "threshold": self.burn_threshold}, default=str))
+            verdicts[name] = {
+                "target": target,
+                "burnShort": round(burn_short, 4),
+                "burnLong": round(burn_long, 4),
+                "breached": breached,
+            }
+        with self._lock:
+            self._verdicts = verdicts
+        return verdicts
+
+    def verdicts(self) -> Dict[str, dict]:
+        """Last evaluation's per-target verdicts (may be empty before
+        the first tick or with no targets configured)."""
+        with self._lock:
+            return dict(self._verdicts)
+
+    def breached(self) -> bool:
+        with self._lock:
+            return any(v.get("breached") for v in self._verdicts.values())
+
+
+# -- per-role singletons (populated by history.start_sampling) ---------------
+_watchdogs: Dict[str, SloWatchdog] = {}
+_lock = threading.Lock()
+
+
+def get_watchdog(role: str = "server") -> Optional[SloWatchdog]:
+    with _lock:
+        return _watchdogs.get(role)
+
+
+def _register_watchdog(role: str, dog: Optional[SloWatchdog]) -> None:
+    with _lock:
+        if dog is None:
+            _watchdogs.pop(role, None)
+        else:
+            _watchdogs[role] = dog

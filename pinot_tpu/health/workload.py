@@ -1,0 +1,185 @@
+"""Per-(tenant, table, plan-fingerprint) workload cost rollup.
+
+Answers "which tenant/table/plan is eating the cluster": every finished
+query's :class:`~pinot_tpu.utils.accounting.QueryUsage` — device kernel
+ms (coalesced launches split by doc share), rows/bytes scanned,
+host->device transfer bytes, cache hit/miss bytes, CPU ns, wall ms —
+accumulates into one :class:`WorkloadStats` bucket per attribution key.
+``/debug/workload`` serves the top-K by cost; per-tenant cost gauges
+(``workload_tenant_cost_ms``) feed dashboards and the cluster rollup.
+
+Cost is defined as ``device_kernel_ms + cpu_ms``: the two resources a
+query actually occupies exclusively. Wall ms is reported beside it but
+not summed into cost — wall time overlaps across concurrent queries.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple
+
+from pinot_tpu.utils.accounting import QueryUsage
+from pinot_tpu.utils.metrics import get_registry
+
+_Key = Tuple[str, str, str]  # (tenant, table, plan fingerprint)
+
+
+@dataclass
+class WorkloadStats:
+    tenant: str
+    table: str
+    plan_fingerprint: str
+    queries: int = 0
+    errors: int = 0
+    wall_ms: float = 0.0
+    cpu_ms: float = 0.0
+    device_kernel_ms: float = 0.0
+    rows_scanned: int = 0
+    bytes_scanned: int = 0
+    transfer_bytes: int = 0
+    cache_hit_bytes: int = 0
+    cache_miss_bytes: int = 0
+    last_seen: float = field(default_factory=time.time)
+
+    @property
+    def cost_ms(self) -> float:
+        return self.device_kernel_ms + self.cpu_ms
+
+    def to_dict(self) -> dict:
+        return {
+            "tenant": self.tenant, "table": self.table,
+            "planFingerprint": self.plan_fingerprint,
+            "queries": self.queries, "errors": self.errors,
+            "costMs": round(self.cost_ms, 3),
+            "wallMs": round(self.wall_ms, 3),
+            "cpuMs": round(self.cpu_ms, 3),
+            "deviceKernelMs": round(self.device_kernel_ms, 3),
+            "rowsScanned": self.rows_scanned,
+            "bytesScanned": self.bytes_scanned,
+            "transferBytes": self.transfer_bytes,
+            "cacheHitBytes": self.cache_hit_bytes,
+            "cacheMissBytes": self.cache_miss_bytes,
+            "lastSeen": self.last_seen,
+        }
+
+
+#: fallback attribution values — a blank key would make distinct
+#: workloads collide silently
+UNATTRIBUTED = "-"
+
+
+class WorkloadRegistry:
+    """Bounded per-role rollup; eviction drops the cheapest-and-oldest
+    entry so the expensive workloads an operator hunts survive churn."""
+
+    MAX_ENTRIES = 512
+
+    def __init__(self, role: str = "server", metrics=None,
+                 max_entries: Optional[int] = None):
+        self.role = role
+        self._entries: Dict[_Key, WorkloadStats] = {}
+        self._tenant_cost_ms: Dict[str, float] = {}
+        self._lock = threading.Lock()
+        self._metrics = metrics if metrics is not None \
+            else get_registry(role)
+        self.max_entries = max_entries or self.MAX_ENTRIES
+
+    # -- write side ----------------------------------------------------
+    def record_usage(self, usage: QueryUsage, *, wall_ms: float = 0.0,
+                     error: bool = False) -> None:
+        """Fold one finished query's usage record in (the server path:
+        ServerQueryExecutor charges usage during execution and records
+        it at finish_query)."""
+        self.record(
+            tenant=usage.tenant, table=usage.table,
+            fingerprint=usage.plan_fingerprint,
+            wall_ms=wall_ms or (time.time() - usage.start_time) * 1e3,
+            cpu_ms=usage.cpu_ns / 1e6,
+            device_kernel_ms=usage.device_kernel_ms,
+            rows_scanned=usage.rows_scanned,
+            bytes_scanned=usage.bytes_scanned,
+            transfer_bytes=usage.transfer_bytes,
+            cache_hit_bytes=usage.cache_hit_bytes,
+            cache_miss_bytes=usage.cache_miss_bytes,
+            error=error)
+
+    def record(self, *, tenant: str, table: str, fingerprint: str,
+               wall_ms: float = 0.0, cpu_ms: float = 0.0,
+               device_kernel_ms: float = 0.0, rows_scanned: int = 0,
+               bytes_scanned: int = 0, transfer_bytes: int = 0,
+               cache_hit_bytes: int = 0, cache_miss_bytes: int = 0,
+               error: bool = False) -> None:
+        key = (tenant or UNATTRIBUTED, table or UNATTRIBUTED,
+               fingerprint or UNATTRIBUTED)
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                if len(self._entries) >= self.max_entries:
+                    self._evict_locked()
+                e = self._entries[key] = WorkloadStats(*key)
+            e.queries += 1
+            if error:
+                e.errors += 1
+            e.wall_ms += float(wall_ms)
+            e.cpu_ms += float(cpu_ms)
+            e.device_kernel_ms += float(device_kernel_ms)
+            e.rows_scanned += int(rows_scanned)
+            e.bytes_scanned += int(bytes_scanned)
+            e.transfer_bytes += int(transfer_bytes)
+            e.cache_hit_bytes += int(cache_hit_bytes)
+            e.cache_miss_bytes += int(cache_miss_bytes)
+            e.last_seen = time.time()
+            tcost = self._tenant_cost_ms.get(key[0], 0.0) \
+                + float(device_kernel_ms) + float(cpu_ms)
+            self._tenant_cost_ms[key[0]] = tcost
+        # gauge OUTSIDE the registry lock (the metrics registry has its
+        # own); per-tenant cost is the dashboard-facing series
+        self._metrics.set_gauge("workload_tenant_cost_ms", round(tcost, 3),
+                                labels={"tenant": key[0]})
+
+    def _evict_locked(self) -> None:
+        """Drop the lowest-(cost, recency) entry to admit a new one."""
+        victim = min(self._entries.values(),
+                     key=lambda e: (e.cost_ms, e.last_seen))
+        del self._entries[(victim.tenant, victim.table,
+                           victim.plan_fingerprint)]
+
+    # -- read side -----------------------------------------------------
+    def top(self, k: int = 20, by: str = "cost_ms") -> list:
+        with self._lock:
+            entries = list(self._entries.values())
+        entries.sort(key=lambda e: getattr(e, by, 0.0), reverse=True)
+        return [e.to_dict() for e in entries[:max(1, int(k))]]
+
+    def tenants(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._tenant_cost_ms)
+
+    def payload(self, k: int = 20) -> dict:
+        """The /debug/workload JSON: top-K by cost + per-tenant totals."""
+        return {"role": self.role, "topK": self.top(k),
+                "tenantCostMs": {t: round(v, 3)
+                                 for t, v in self.tenants().items()}}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._tenant_cost_ms.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
+# -- per-role singletons ----------------------------------------------------
+_registries: Dict[str, WorkloadRegistry] = {}
+_reg_lock = threading.Lock()
+
+
+def get_workload(role: str = "server") -> WorkloadRegistry:
+    with _reg_lock:
+        reg = _registries.get(role)
+        if reg is None:
+            reg = _registries[role] = WorkloadRegistry(role)
+        return reg

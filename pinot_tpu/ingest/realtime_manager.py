@@ -909,10 +909,11 @@ class IngestionDelayTracker:
 
     The `ingestion_delay_ms{partition=...}` gauge refreshes on every
     record(); `remove_partition` (wired to consumer stop) drops state and
-    zeroes the gauge so a reassigned/stopped partition never reports
-    stale lag forever; record() clamps event timestamps against clock
-    skew — an event stamped in the future would otherwise surface as
-    negative lag."""
+    REMOVES the labeled gauge series so a reassigned/stopped partition
+    never reports stale lag forever — zeroing it kept the dead series on
+    /metrics, where dashboards aggregated it as live data; record()
+    clamps event timestamps against clock skew — an event stamped in
+    the future would otherwise surface as negative lag."""
 
     def __init__(self, metrics=None, labels: Optional[Dict[str, str]] = None):
         self._latest: Dict[int, int] = {}
@@ -945,10 +946,16 @@ class IngestionDelayTracker:
 
     def remove_partition(self, partition_id: int) -> None:
         """Wired to consumer stop: a reassigned partition's lag must not
-        linger (the gauge zeroes; delay_ms returns None)."""
+        linger (the labeled series leaves /metrics; delay_ms returns
+        None). Dropping the series — not zeroing it — matters: a zeroed
+        gauge stays in the exposition forever and reads as a live
+        partition with zero lag."""
         with self._lock:
             self._latest.pop(partition_id, None)
-        self._gauge(partition_id, 0.0)
+        if self._metrics is not None:
+            self._metrics.remove_gauge(
+                "ingestion_delay_ms",
+                labels={**self._labels, "partition": str(partition_id)})
 
     def partitions(self) -> List[int]:
         with self._lock:

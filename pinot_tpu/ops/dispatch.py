@@ -217,6 +217,33 @@ def compiled_batched_kernel(plan, B: int, stacked: bool = False):
     return kernels.compiled_batched_kernel(plan, B, stacked)
 
 
+def split_charge(live: List["Launch"], kernel_ms: float) -> None:
+    """Workload accounting for one launch: charge its device kernel ms
+    across the coalesced members by DOC SHARE — a member that brought
+    90% of the scanned docs bought 90% of the launch. The invariant the
+    property test pins: the per-member charges sum to the launch total
+    (each share is an exact fraction of kernel_ms over the live-member
+    doc total). Members whose query detached (no slip: warmup, MSE
+    internal calls, finished queries) still count in the denominator —
+    their share is simply unrecorded, never redistributed, so an
+    attributed member's bill does not depend on its neighbors'
+    bookkeeping."""
+    if kernel_ms is None or kernel_ms <= 0:
+        return
+    total_docs = sum(max(0, it.docs) for it in live)
+    n = len(live)
+    for it in live:
+        if it.slip is None:
+            continue
+        share = (kernel_ms * (max(0, it.docs) / total_docs)
+                 if total_docs > 0 else kernel_ms / n)
+        try:
+            it.slip.add(device_kernel_ms=share)
+        except Exception:  # noqa: BLE001 — accounting must never
+            # fail a query's result delivery
+            pass
+
+
 class Launch:
     """One staged device launch waiting in the ring.
 
@@ -237,7 +264,7 @@ class Launch:
     __slots__ = ("call", "plan", "cols", "params", "num_docs", "D", "G",
                  "batch_key", "cols_key", "factory", "dedup_factory",
                  "collective", "cancel_check", "site_ctx", "future",
-                 "span", "enq_ts")
+                 "span", "enq_ts", "slip", "docs")
 
     def __init__(self, call: Callable[[], Any], plan=None, cols=None,
                  params=None, num_docs=None, D: int = 0, G: int = 0,
@@ -248,7 +275,7 @@ class Launch:
                  collective: bool = False,
                  cancel_check: Optional[Callable[[], None]] = None,
                  site_ctx: Optional[Dict[str, Any]] = None,
-                 span=None):
+                 span=None, slip=None, docs: int = 0):
         self.call = call
         self.plan = plan
         self.cols = cols
@@ -271,6 +298,13 @@ class Launch:
         #: don't flow into the ring/launch/fetch pools) — the dispatcher
         #: attaches queue-wait / batch / kernel / fetch attrs through it
         self.span = span
+        #: accounting.ChargeSlip captured on the CALLER thread (same
+        #: discipline as span): the dispatcher charges this launch's
+        #: device kernel ms through it — a coalesced launch's bill
+        #: splits across members by `docs` share (split_charge)
+        self.slip = slip
+        #: real docs staged for this member (the cost-split weight)
+        self.docs = int(docs)
         self.enq_ts = 0.0
 
 
@@ -491,6 +525,7 @@ class KernelDispatcher:
             finally:
                 self._busy_end()
                 self._meter_traces()
+            kernel_ms = (time.monotonic() - t0) * 1e3
             if launch.span is not None:
                 # inline path: kernel + fetch are one sync round trip
                 launch.span.set(
@@ -498,8 +533,9 @@ class KernelDispatcher:
                         (t0 - launch.enq_ts) * 1e3, 3)
                     if launch.enq_ts else 0.0,
                     batchSize=1, variant="inline",
-                    kernelMs=round((time.monotonic() - t0) * 1e3, 3),
+                    kernelMs=round(kernel_ms, 3),
                     fetchMs=0.0)
+            split_charge([launch], kernel_ms)
             launch.future.set_result(packed)
         except BaseException as e:  # noqa: BLE001 — future carries it
             launch.future.set_exception(e)
@@ -757,6 +793,8 @@ class KernelDispatcher:
                 it.span.set(fetchMs=round(fetch_ms, 3),
                             **({"kernelMs": round(kernel_ms, 3)}
                                if kernel_ms is not None else {}))
+        if kernel_ms is not None:
+            split_charge(live, kernel_ms)
         try:
             if batched:
                 for member, it in zip(split_packed(arr, len(live)), live):

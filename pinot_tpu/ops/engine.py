@@ -315,7 +315,7 @@ class TpuOperatorExecutor:
 
     def _prepare_agg(self, segments: List[ImmutableSegment],
                      ctx: QueryContext, cancel_check=None,
-                     parent_span=None):
+                     parent_span=None, slip=None):
         """Plan + stage under the engine lock (they mutate the block
         caches), then wrap the launch for the dispatch ring. Returns
         (plan, slots_of_fn, S_real, Launch), or None -> host fallback.
@@ -335,10 +335,13 @@ class TpuOperatorExecutor:
         if parent_span is not None:
             dsp = parent_span.child("DeviceDispatch", table=ctx.table,
                                     mode="agg")
+        from pinot_tpu.ops import residency as residency_mod
         busy0 = self._dispatcher.busy_ms()
         with self._engine_lock:
             # snapshot INSIDE the lock: the diff must cover exactly this
-            # query's staging, not a concurrent stager's
+            # query's staging, not a concurrent stager's (the transfer
+            # odometer diff below is exact per query for the same reason)
+            xfer0 = residency_mod.transfer_bytes() if slip is not None else 0
             stage_info = self._staging_snapshot(dsp)
             plan_info = self._plan(segments, ctx)
             if plan_info is None:
@@ -375,6 +378,9 @@ class TpuOperatorExecutor:
                 return None
             self._staging_attrs(dsp, stage_info, S=int(num_docs.shape[0]),
                                 D=D, G=G)
+            if slip is not None:
+                slip.add(transfer_bytes=int(
+                    residency_mod.transfer_bytes() - xfer0))
         overlap = self._dispatcher.busy_ms() - busy0
         if overlap > 0:
             self._dispatcher.observe("staging_overlap_ms", overlap)
@@ -400,7 +406,8 @@ class TpuOperatorExecutor:
             factory=factory, dedup_factory=dedup_factory,
             collective=self._needs_cpu_ordering(kernel),
             cancel_check=cancel_check,
-            site_ctx={"table": ctx.table, "mode": "agg"}, span=dsp)
+            site_ctx={"table": ctx.table, "mode": "agg"}, span=dsp,
+            slip=slip, docs=sum(s.num_docs for s in segments))
         return plan, slots_of_fn, S_real, launch
 
     # -- staging trace attrs -------------------------------------------
@@ -452,8 +459,10 @@ class TpuOperatorExecutor:
             return self._execute_distinct(segments, ctx, cancel_check)
         if not ctx.aggregations:
             return self._execute_topn(segments, ctx, cancel_check)
+        from pinot_tpu.utils import accounting
         with self._dispatcher.active():
-            prep = self._prepare_agg(segments, ctx, cancel_check)
+            prep = self._prepare_agg(segments, ctx, cancel_check,
+                                     slip=accounting.current_slip())
             if prep is None:
                 return [], segments
             plan, slots_of_fn, S_real, launch = prep
@@ -490,13 +499,17 @@ class TpuOperatorExecutor:
         self._dispatcher.enter_active()
         out.add_done_callback(lambda _f: self._dispatcher.exit_active())
         # capture on the CALLER thread: staging runs on the staging pool
-        # where the trace contextvar doesn't flow
+        # where neither the trace contextvar nor the accounting
+        # thread-local flows
+        from pinot_tpu.utils import accounting
         parent_span = tracing.capture()
+        slip = accounting.current_slip()
 
         def stage_and_enqueue():
             try:
                 prep = self._prepare_agg(segments, ctx, cancel_check,
-                                         parent_span=parent_span)
+                                         parent_span=parent_span,
+                                         slip=slip)
                 if prep is None:
                     out.set_result(([], segments))
                     return
@@ -554,12 +567,16 @@ class TpuOperatorExecutor:
         paying one XLA launch per stage per query. Caller must hold no
         engine state; returns (S_real, Launch) or None -> host path.
         Must be called with doc_axis == 1 (sharded top-K stays host)."""
+        from pinot_tpu.ops import residency as residency_mod
+        from pinot_tpu.utils import accounting
         dsp = None
         parent_span = tracing.capture()
+        slip = accounting.current_slip()
         if parent_span is not None:
             dsp = parent_span.child("DeviceDispatch", table=ctx.table,
                                     mode=mode)
         with self._engine_lock:
+            xfer0 = residency_mod.transfer_bytes() if slip is not None else 0
             stage_info = self._staging_snapshot(dsp)
             plan = self._plan_topn(segments, ctx)
             if plan is None:
@@ -577,6 +594,9 @@ class TpuOperatorExecutor:
                 return None
             self._staging_attrs(dsp, stage_info, S=int(num_docs.shape[0]),
                                 D=D)
+            if slip is not None:
+                slip.add(transfer_bytes=int(
+                    residency_mod.transfer_bytes() - xfer0))
         batch_key = None
         if batchable and self._dispatcher.batch_max > 1:
             if self._cross_table and D <= self._doc_bucket_max:
@@ -593,7 +613,8 @@ class TpuOperatorExecutor:
                      kernels.compiled_batched_topn_kernel(_p, B, stacked)),
             collective=self._needs_cpu_ordering(kernel),
             cancel_check=cancel_check,
-            site_ctx={"table": ctx.table, "mode": mode}, span=dsp)
+            site_ctx={"table": ctx.table, "mode": mode}, span=dsp,
+            slip=slip, docs=sum(s.num_docs for s in segments))
         return S_real, launch
 
     def _execute_topn(self, segments, ctx: QueryContext, cancel_check=None):

@@ -135,6 +135,13 @@ class QueryExecutor:
                 if device_fut is not None:
                     remaining = []
 
+        # captured on the REQUEST thread: run_one executes on pool
+        # workers where the accounting thread-local doesn't flow (the
+        # span-handle discipline) — cache puts there still charge the
+        # query's miss bytes
+        from pinot_tpu.utils import accounting
+        slip = accounting.current_slip()
+
         def run_one(s):
             # cooperative cancel poll per segment: a deadline-expired
             # or broker-cancelled query stops HERE instead of
@@ -144,9 +151,10 @@ class QueryExecutor:
                 self._cancel_check()
             fire("server.execute.segment",
                  segment=getattr(s, "name", None))
-            r = executor_cpu.execute_segment(s, ctx)
-            if plan_fp is not None:
-                cache.put(s, plan_fp, r)  # no-op for mutable segments
+            with accounting.charging(slip):
+                r = executor_cpu.execute_segment(s, ctx)
+                if plan_fp is not None:
+                    cache.put(s, plan_fp, r)  # no-op for mutable segments
             return r
 
         def run_host(seg_list):

@@ -81,11 +81,20 @@ class ServerQueryExecutor:
             self._trace_enabled = True
             self._slow_threshold_ms = 0.0
             self._trace_capacity = None
+        #: latency-SLO target — queries over it bump the slo_latency_bad
+        #: counter the burn-rate watchdog reads as windowed deltas
+        self._slo_p99_ms = (config.get_float("pinot.slo.query.p99.ms")
+                            if config is not None else 0.0)
         if config is not None:
             # the catalog default applies whenever a config is present
             # (the class attribute only backs config-less construction)
             self.STREAM_CHUNK_SEGMENTS = config.get_int(
                 "pinot.server.stream.chunk.segments")
+        #: per-query workload accounting (ChargeSlip + WorkloadStats
+        #: rollup); off = the bench --health A-side
+        self._accounting_enabled = (
+            config is None or config.get_bool(
+                "pinot.workload.accounting.enabled", True))
         #: ONE engine for the server's lifetime — it owns the HBM block
         #: cache, which must survive across requests
         self._engine = None
@@ -242,7 +251,8 @@ class ServerQueryExecutor:
                 query_id=None, timeout_ms: Optional[float] = None,
                 deadline: Optional[float] = None,
                 trace_ctx: Optional[dict] = None,
-                arrival_s: Optional[float] = None):
+                arrival_s: Optional[float] = None,
+                tenant: Optional[str] = None):
         """Returns serialized DataTable bytes (see _execute_inner for the
         execution semantics). trace_ctx: the broker-shipped TraceContext
         wire dict — when present (and tracing is enabled) this server
@@ -258,7 +268,7 @@ class ServerQueryExecutor:
         if tc is None or not self._trace_enabled:
             return self._execute_inner(table_name, sql_or_ctx, segments,
                                        extra_filter, query_id, timeout_ms,
-                                       deadline)
+                                       deadline, tenant=tenant)
         rt = tracing.RequestTrace(
             request_id=str(query_id or ""), operator="ServerRequest",
             trace_id=tc.trace_id, sampled=tc.sampled,
@@ -270,13 +280,13 @@ class ServerQueryExecutor:
         key = f"{tc.trace_id}:{query_id}"
         sql_text = sql_or_ctx if isinstance(sql_or_ctx, str) else ""
         inflight.begin(key, sql=sql_text, trace_id=tc.trace_id,
-                       detail=table_name)
+                       detail=table_name, tenant=tenant, deadline=deadline)
         inflight.phase(key, "execute", table_name)
         try:
             with rt:
                 payload = self._execute_inner(
                     table_name, sql_or_ctx, segments, extra_filter,
-                    query_id, timeout_ms, deadline)
+                    query_id, timeout_ms, deadline, tenant=tenant)
         finally:
             inflight.end(key)
         dur = rt.root.duration_ms
@@ -310,7 +320,8 @@ class ServerQueryExecutor:
                        segments: Optional[List[str]] = None,
                        extra_filter: Optional[str] = None,
                        query_id=None, timeout_ms: Optional[float] = None,
-                       deadline: Optional[float] = None):
+                       deadline: Optional[float] = None,
+                       tenant: Optional[str] = None):
         """Returns serialized DataTable bytes. extra_filter (an expression
         string, e.g. the hybrid time-boundary predicate) is ANDed into the
         filter tree — the reference rewrites the BrokerRequest the same way.
@@ -326,8 +337,11 @@ class ServerQueryExecutor:
         metrics.add_meter("queries", labels={"table": table_name})
         timer = metrics.time("query_execution", labels={"table": table_name})
         timer.__enter__()
+        slo_t0 = time.perf_counter()
+        from pinot_tpu.utils.accounting import charging
         qid = None if query_id is None else str(query_id)
         cancel_check = None
+        slip = None
         if qid is not None:
             if deadline is not None:
                 timeout_s = deadline - time.time()
@@ -336,6 +350,9 @@ class ServerQueryExecutor:
                              + self.deadline_grace_s if timeout_ms else None)
             self.accountant.begin_query(qid, timeout_s)
             cancel_check = self.accountant.checker(qid)
+            if self._accounting_enabled:
+                slip = self.accountant.slip(qid)
+        error = False
         try:
             fire("server.execute.before",
                  instance=self.data_manager.instance_id, table=table_name)
@@ -343,6 +360,12 @@ class ServerQueryExecutor:
                    else QueryContext.from_sql(sql_or_ctx))
             from pinot_tpu.query.context import merge_extra_filter
             merge_extra_filter(ctx, extra_filter)
+            if slip is not None:
+                # attribution dimensions the per-(tenant, table, plan)
+                # workload rollup keys on
+                self.accountant.annotate(
+                    qid, tenant=tenant or "", table=table_name,
+                    plan_fingerprint=ctx.fingerprint())
             self._record_plan(table_name, ctx, sql_or_ctx, extra_filter)
             tdm = self.data_manager.table(table_name, create=False)
             if tdm is None:
@@ -355,7 +378,20 @@ class ServerQueryExecutor:
                                    engine=self._shared_engine(),
                                    segment_cache=self.segment_cache,
                                    cancel_check=cancel_check)
-                results, prune_stats = ex.execute_context(ctx)
+                # the slip rides the thread-local for the execution scope:
+                # engine staging (transfer bytes), the dispatch ring
+                # (kernel ms, batch-split), and the tier-2 cache
+                # (hit/miss bytes) all charge this query through it
+                with charging(slip):
+                    results, prune_stats = ex.execute_context(ctx)
+                if slip is not None:
+                    rows = sum(r.stats.num_docs_scanned for r in results)
+                    entries = sum(r.stats.num_entries_scanned_in_filter
+                                  + r.stats.num_entries_scanned_post_filter
+                                  for r in results)
+                    # bytes: dict-encoded scan entries are int32 ids —
+                    # 4 bytes per entry is the storage-traffic cost
+                    slip.add(rows_scanned=rows, bytes_scanned=4 * entries)
                 return datatable.serialize_results(results,
                                                    extra_stats=prune_stats)
             finally:
@@ -364,16 +400,27 @@ class ServerQueryExecutor:
             # late work is CANCELLED, not silently finished: drop any
             # half-built partials (merging them would risk double counts
             # against a hedged replica) and answer with the typed 250
+            error = True
             metrics.add_meter("queries_killed", labels={"table": table_name})
             return _timeout_response(e)
         except Exception as e:  # noqa: BLE001 — server must answer, not die
+            error = True
             metrics.add_meter("query_exceptions", labels={"table": table_name})
             return datatable.serialize_results(
                 [], [{"errorCode": 200, "message": f"{type(e).__name__}: {e}"}])
         finally:
             if qid is not None:
-                self.accountant.finish_query(qid)
+                usage = self.accountant.finish_query(qid)
+                if usage is not None and slip is not None:
+                    # fold the finished query's bill into the
+                    # per-(tenant, table, plan) workload rollup
+                    from pinot_tpu.health.workload import get_workload
+                    get_workload("server").record_usage(usage, error=error)
             timer.__exit__(None, None, None)
+            if self._slo_p99_ms and (time.perf_counter() - slo_t0) \
+                    * 1000.0 > self._slo_p99_ms:
+                metrics.add_meter("slo_latency_bad",
+                                  labels={"table": table_name})
 
     #: segments per streamed response frame
     STREAM_CHUNK_SEGMENTS = 4
@@ -504,7 +551,8 @@ class QueryServer:
                         r.get("extraFilter"),
                         query_id=r.get("queryId") or r.get("requestId"),
                         timeout_ms=r.get("timeoutMs"), deadline=d,
-                        trace_ctx=r.get("traceContext"), arrival_s=a),
+                        trace_ctx=r.get("traceContext"), arrival_s=a,
+                        tenant=r.get("tenant")),
                     table=req.get("tableName", ""),
                     workload=req.get("workload", "primary"),
                     deadline=deadline,

@@ -11,6 +11,7 @@ Tracing.ThreadAccountantOps.sample() the same way, DocIdSetOperator.java:70).
 """
 from __future__ import annotations
 
+import contextlib
 import os
 import threading
 import time
@@ -42,6 +43,61 @@ class QueryUsage:
     threads: int = 0
     #: absolute wall-clock deadline (time.time() domain); None = no budget
     deadline: Optional[float] = None
+    # -- workload-accounting charges (PR 14): device + data-path costs
+    # charged per query where PR 12 already measures them (dispatch ring,
+    # residency odometer, tiered cache). Coalesced batch members split
+    # the shared launch's kernel ms by doc share (dispatch.split_charge).
+    device_kernel_ms: float = 0.0
+    rows_scanned: int = 0
+    bytes_scanned: int = 0
+    transfer_bytes: int = 0
+    cache_hit_bytes: int = 0
+    cache_miss_bytes: int = 0
+    # -- attribution dimensions (the WorkloadStats rollup key)
+    tenant: str = ""
+    table: str = ""
+    plan_fingerprint: str = ""
+
+
+class ChargeSlip:
+    """Thread-safe cost-charging handle for ONE query: a (accountant,
+    query id) pair whose :meth:`add` lands deltas on the query's
+    :class:`QueryUsage` under the accountant lock. Captured on the
+    request thread and handed across pool boundaries explicitly (the
+    dispatch ring's launch/fetch pools, the engine staging pool) — the
+    same discipline as tracing.SpanHandle, because thread-locals don't
+    flow into pools."""
+
+    __slots__ = ("_accountant", "query_id")
+
+    def __init__(self, accountant: "ResourceAccountant", query_id: str):
+        self._accountant = accountant
+        self.query_id = query_id
+
+    def add(self, **deltas) -> None:
+        self._accountant.charge(self.query_id, **deltas)
+
+
+_slip_tls = threading.local()
+
+
+def current_slip() -> Optional[ChargeSlip]:
+    """The calling thread's active charge slip (None when the request
+    is not being accounted) — capture it where the request thread is
+    live, pass it to pool work explicitly."""
+    return getattr(_slip_tls, "slip", None)
+
+
+@contextlib.contextmanager
+def charging(slip: Optional[ChargeSlip]):
+    """Make ``slip`` the thread's active charge slip for the scope —
+    the accounting analog of a RequestTrace activation."""
+    prev = getattr(_slip_tls, "slip", None)
+    _slip_tls.slip = slip
+    try:
+        yield slip
+    finally:
+        _slip_tls.slip = prev
 
 
 class ResourceAccountant:
@@ -89,6 +145,45 @@ class ResourceAccountant:
         """Zero-arg closure for hot loops: raises when the query is
         cancelled or past its deadline, else returns None."""
         return lambda: self.check_query(query_id)
+
+    # -- workload charging (PR 14) -------------------------------------
+    def slip(self, query_id: str) -> ChargeSlip:
+        """A thread-safe charging handle for the query (see ChargeSlip)."""
+        return ChargeSlip(self, query_id)
+
+    def charge(self, query_id: str, *, device_kernel_ms: float = 0.0,
+               rows_scanned: int = 0, bytes_scanned: int = 0,
+               transfer_bytes: int = 0, cache_hit_bytes: int = 0,
+               cache_miss_bytes: int = 0) -> None:
+        """Accumulate workload-cost deltas on the query's usage record.
+        Charges landing after finish_query (a fetch-pool straggler) drop
+        silently — the usage record already left for the rollup."""
+        with self._lock:
+            u = self._queries.get(query_id)
+            if u is None:
+                return
+            u.device_kernel_ms += float(device_kernel_ms)
+            u.rows_scanned += int(rows_scanned)
+            u.bytes_scanned += int(bytes_scanned)
+            u.transfer_bytes += int(transfer_bytes)
+            u.cache_hit_bytes += int(cache_hit_bytes)
+            u.cache_miss_bytes += int(cache_miss_bytes)
+
+    def annotate(self, query_id: str, *, tenant: Optional[str] = None,
+                 table: Optional[str] = None,
+                 plan_fingerprint: Optional[str] = None) -> None:
+        """Stamp the attribution dimensions (tenant, table, plan
+        fingerprint) the WorkloadStats rollup keys on."""
+        with self._lock:
+            u = self._queries.get(query_id)
+            if u is None:
+                return
+            if tenant is not None:
+                u.tenant = tenant
+            if table is not None:
+                u.table = table
+            if plan_fingerprint is not None:
+                u.plan_fingerprint = plan_fingerprint
 
     # -- per-thread registration (ref setupRunner / clear) -------------------
     def setup_worker(self, query_id: str) -> None:

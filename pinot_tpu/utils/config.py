@@ -235,6 +235,44 @@ KEYS: Dict[str, Any] = {
     "pinot.server.admin.port": 0,
     "pinot.minion.admin.port": 0,
     "pinot.cache.server.admin.port": 0,
+    # -- fleet health plane (pinot_tpu/health/) -------------------------
+    # metrics history: a background sampler appends one flat
+    # MetricsRegistry.sample() per interval to a bounded per-role ring
+    # holding window.seconds worth — /debug/metrics/history serves it,
+    # the SLO watchdog evaluates burn rates over it, and the selfmetrics
+    # connector exposes it to the time-series engine. enabled=False
+    # builds NO history machinery at all (the bench.py --health A-side).
+    "pinot.metrics.history.enabled": True,
+    "pinot.metrics.history.interval.ms": 1000.0,
+    "pinot.metrics.history.window.seconds": 300.0,
+    # SLO watchdog (health/slo.py): declarative targets evaluated as
+    # multi-window burn rates over the history; a target left at 0 is
+    # disabled. query.p99.ms bounds the role's per-sample latency p99;
+    # error.rate bounds (exceptions + errorCode-250) per query;
+    # freshness.ms bounds the worst per-partition ingestion lag.
+    # latency.budget is the fraction of samples ALLOWED over a
+    # sample-fraction target (burn = bad fraction / budget); a breach
+    # needs BOTH the short and long window burn over burn.threshold.
+    "pinot.slo.query.p99.ms": 0.0,
+    "pinot.slo.error.rate": 0.0,
+    "pinot.slo.freshness.ms": 0.0,
+    "pinot.slo.window.short.seconds": 60.0,
+    "pinot.slo.window.long.seconds": 300.0,
+    "pinot.slo.burn.threshold": 1.0,
+    "pinot.slo.latency.budget": 0.01,
+    # per-query workload accounting (utils/accounting.ChargeSlip +
+    # health/workload.py): device kernel ms, rows/bytes scanned,
+    # transfer bytes, cache hit/miss bytes charged per query and rolled
+    # into per-(tenant, table, plan) WorkloadStats at /debug/workload.
+    # False = no slips, no rollup (the bench.py --health A-side).
+    "pinot.workload.accounting.enabled": True,
+    # cluster rollup (health/rollup.py): the controller's periodic
+    # fleet sweep over every registered instance's admin_url into
+    # GET /cluster/health + /cluster/metrics; scrape failures mark the
+    # instance degraded, never throw.
+    "pinot.cluster.health.enabled": True,
+    "pinot.cluster.health.interval.seconds": 5.0,
+    "pinot.cluster.health.scrape.timeout.seconds": 2.0,
 }
 
 

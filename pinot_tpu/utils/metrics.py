@@ -109,6 +109,16 @@ class MetricsRegistry:
         with self._lock:
             self._gauges[_key(name, labels)] = value
 
+    def remove_gauge(self, name: str,
+                     labels: Optional[Dict[str, str]] = None) -> bool:
+        """Drop one labeled gauge series entirely. A gauge whose subject
+        is GONE (a removed ingestion partition, an unloaded segment) must
+        leave the exposition — zeroing it keeps the stale labeled series
+        on /metrics forever, and dashboards aggregate it as live data.
+        Returns whether the series existed."""
+        with self._lock:
+            return self._gauges.pop(_key(name, labels), None) is not None
+
     def add_timing(self, name: str, ms: float,
                    labels: Optional[Dict[str, str]] = None,
                    exemplar: Optional[str] = None) -> None:
@@ -174,31 +184,63 @@ class MetricsRegistry:
         with self._lock:
             return self._exemplars.get(_key(name, labels))
 
+    def sample(self) -> dict:
+        """One timestamped FLAT snapshot of the whole registry — the
+        unit the metrics history ring stores and the cluster rollup
+        scrapes. Keys are ``name`` or ``name{k="v",...}`` (the exposition
+        label syntax, so history consumers and /metrics agree on series
+        identity); timers collapse to count/sum/max plus the reservoir
+        quantiles. Taken under the registry lock: one sample is
+        internally consistent."""
+        with self._lock:
+            counters = {f"{n}{_fmt(ls)}": v
+                        for (n, ls), v in self._meters.items()}
+            gauges = {f"{n}{_fmt(ls)}": v
+                      for (n, ls), v in self._gauges.items()}
+            timers = {}
+            for (n, ls), t in self._timers.items():
+                timers[f"{n}{_fmt(ls)}"] = {
+                    "count": t.count,
+                    "sum_ms": round(t.total_ms, 3),
+                    "max_ms": round(t.max_ms, 3),
+                    "p50": round(t.quantile(0.5), 3),
+                    "p95": round(t.quantile(0.95), 3),
+                    "p99": round(t.quantile(0.99), 3),
+                }
+        return {"ts": time.time(), "role": self.role,
+                "counters": counters, "gauges": gauges, "timers": timers}
+
     def prometheus_text(self) -> str:
         """Prometheus exposition format (the JMX-reporter analog).
 
         `# TYPE` is emitted once per metric NAME — two label sets of the
         same metric share one family header (duplicate TYPE lines are
-        invalid exposition and make scrapers reject the whole page)."""
+        invalid exposition and make scrapers reject the whole page).
+        `# HELP` rides beside it from the metric-name catalog
+        (utils/metrics_catalog.py) for every cataloged family."""
+        from pinot_tpu.utils.metrics_catalog import METRICS
         out: List[str] = []
         prefix = f"pinot_tpu_{self.role}_"
         typed: set = set()
 
-        def type_line(base: str, kind: str) -> None:
+        def type_line(base: str, kind: str, name: str = "") -> None:
             if base not in typed:
                 typed.add(base)
+                desc = METRICS.get(name)
+                if desc:
+                    out.append(f"# HELP {base} {_escape_help(desc)}")
                 out.append(f"# TYPE {base} {kind}")
 
         with self._lock:
             for (name, labels), v in sorted(self._meters.items()):
-                type_line(f"{prefix}{name}", "counter")
+                type_line(f"{prefix}{name}", "counter", name)
                 out.append(f"{prefix}{name}{_fmt(labels)} {v:g}")
             for (name, labels), v in sorted(self._gauges.items()):
-                type_line(f"{prefix}{name}", "gauge")
+                type_line(f"{prefix}{name}", "gauge", name)
                 out.append(f"{prefix}{name}{_fmt(labels)} {v:g}")
             for (name, labels), t in sorted(self._timers.items()):
                 base = f"{prefix}{name}"
-                type_line(base, "summary")
+                type_line(base, "summary", name)
                 for q in (0.5, 0.95, 0.99):
                     qlabels = labels + (("quantile", f"{q:g}"),)
                     out.append(f"{base}{_fmt(qlabels)} {t.quantile(q):g}")
@@ -220,6 +262,12 @@ def _escape(v: str) -> str:
     newline."""
     return (str(v).replace("\\", "\\\\").replace('"', '\\"')
             .replace("\n", "\\n"))
+
+
+def _escape_help(v: str) -> str:
+    """HELP-text escaping per the exposition spec: backslash, newline
+    (quotes stay literal in HELP lines)."""
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n")
 
 
 def _fmt(labels: Tuple[Tuple[str, str], ...]) -> str:

@@ -1,0 +1,123 @@
+"""Metric-name catalog: one description per metric family.
+
+The exposition analog of the ``KEYS`` knob catalog in utils/config.py:
+every literal metric name emitted through a registry
+(``add_meter``/``set_gauge``/``add_timing``/``time``/``observe``) has an
+entry here, ``MetricsRegistry.prometheus_text`` emits the description as
+the family's ``# HELP`` line, and the README "Metrics reference"
+appendix is generated from the same text — so /metrics, the docs, and
+the code can't drift apart. The ``metrics_docs`` static-analysis checker
+(analysis/checkers/metrics_docs.py) enforces all three legs in tier-1.
+
+Prefix-composed families (cache/core.py's ``<prefix>_hits/misses/...``,
+cache/remote.py's ``remote_cache_<name>``) are namespaced by
+construction and documented as families in the README prose; their
+short suffixes are not catalog entries.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+#: metric name -> one-line HELP description (kind lives at the emission
+#: site; the exposition checker keeps each name single-kind)
+METRICS: Dict[str, str] = {
+    # -- broker query path ------------------------------------------------
+    "broker_query_ms": "end-to-end broker latency per query (ms)",
+    "broker_queries": "queries handled by this broker",
+    "broker_query_errors": "broker responses carrying any exception",
+    "broker_error_code_250":
+        "broker responses carrying an errorCode-250 (deadline) entry",
+    "deadline_expired":
+        "queries whose gather abandoned servers at the deadline",
+    "hedge_issued": "hedged scatter attempts issued",
+    "hedge_won": "hedge attempts that beat the primary",
+    "hedge_wasted": "hedge attempts the primary beat",
+    "hedge_split": "hedges split across replicas (partial layouts)",
+    "slow_queries": "queries at/over the slow-query threshold",
+    # -- server query path ------------------------------------------------
+    "queries": "queries executed by this server",
+    "queries_killed": "queries stopped by deadline/cancel",
+    "query_exceptions": "queries that raised server-side",
+    "query_execution": "server-side execution latency per query (ms)",
+    "scheduler_inflight": "queries currently inside the scheduler",
+    # -- dispatch ring / kernel factory ----------------------------------
+    "dispatch_queue_depth": "launches waiting in the dispatch ring",
+    "dispatch_batch_size": "coalesced members per launch",
+    "dispatch_batch_cross_table":
+        "batch members coalesced across tables (stacked/dedup variants)",
+    "dispatch_batch_dedup":
+        "batch members sharing a stack entry via same-cols grouping",
+    "staging_overlap_ms":
+        "staging wall time overlapped with another query's kernel (ms)",
+    "kernel_retrace": "kernel retraces (steady-state retraces are bugs)",
+    "kernel_retrace_by_plan":
+        "kernel retraces attributed per plan fingerprint",
+    # -- memory tiers (HBM residency) ------------------------------------
+    "hbm_cache_bytes": "assembled [S, D] block-cache bytes on device",
+    "hbm_block_hit": "assembled-block cache hits",
+    "hbm_block_miss": "assembled-block cache misses",
+    "hbm_resident_hit": "resident-row tier hits",
+    "hbm_resident_miss": "resident-row tier misses",
+    "hbm_admission_rejected": "rows the TinyLFU admission duel rejected",
+    "hbm_evicted": "rows evicted from the resident tier",
+    "hbm_transfer_bytes": "host->device bytes shipped by residency",
+    "host_row_cache_bytes": "host padded-row cache bytes",
+    "host_row_hit": "host row-cache hits",
+    "host_row_miss": "host row-cache misses",
+    "host_row_evicted": "host row-cache evictions",
+    # -- ingestion --------------------------------------------------------
+    "ingest_rows_indexed": "rows indexed into mutable segments",
+    "ingest_rows_skipped": "rows dropped by transforms/poison guards",
+    "ingest_segments_sealed": "mutable segments sealed",
+    "ingest_seal_build_failures": "immutable builds that failed (retried)",
+    "ingest_checkpoint_torn": "torn checkpoint writes detected",
+    "ingest_backpressure_pauses": "consumer pauses at the memory budget",
+    "ingest_lag_shed_seals": "force-seals shed by the lag ceiling",
+    "ingestion_delay_ms": "per-partition end-to-end ingestion lag (ms)",
+    # -- caches / remote fabric ------------------------------------------
+    "remote_cache_request": "remote cache-tier round-trip latency (ms)",
+    "remote_cache_errors": "remote cache-tier request failures",
+    "remote_cache_breaker_state":
+        "remote-tier circuit breaker (0 closed, 1 open, 2 half-open)",
+    "remote_cache_compressed_bytes":
+        "bytes saved by remote-tier payload compression",
+    "segment_warmup_segments": "segments warmed before first serve",
+    "segment_warmup_entries": "cache entries populated by warmup",
+    # -- multi-stage engine ----------------------------------------------
+    "mse_queries": "multi-stage queries dispatched",
+    "mse_cancelled": "multi-stage queries cancelled",
+    "mse_deadline_expired": "multi-stage queries past their budget",
+    "mse_mailbox_sent_frames": "mailbox frames sent",
+    "mse_mailbox_sent_bytes": "mailbox bytes sent",
+    "mse_mailbox_recv_frames": "mailbox frames received",
+    "mse_mailbox_recv_bytes": "mailbox bytes received",
+    "mse_mailbox_retries": "mailbox sends retried on a fresh socket",
+    "mse_mailbox_poisoned": "mailbox queues poisoned by abort",
+    "mse_stage_hedge_issued": "MSE stage hedges issued",
+    "mse_stage_hedge_won": "MSE stage hedges that won",
+    "mse_stage_hedge_wasted": "MSE stage hedges the primary beat",
+    "mse_stage_cache_remote_hits":
+        "leaf-stage cache hits served from the shared remote tier",
+    # -- minion task fabric ----------------------------------------------
+    "task_queue_depth": "active (non-terminal) tasks in the queue",
+    "minion_running_tasks": "tasks currently executing on this worker",
+    "minion_tasks_completed": "tasks completed by this worker",
+    "minion_tasks_failed": "tasks failed by this worker",
+    "minion_tasks_retried": "expired leases requeued for retry",
+    "minion_task_duration_ms": "per-type task execution latency (ms)",
+    "minion_manifest_resumes": "crash-mid-commit manifest resumes",
+    # -- fleet health plane (PR 14) --------------------------------------
+    "metrics_history_samples": "registry samples appended to the history",
+    "slo_burn_rate":
+        "short-window SLO error-budget burn rate (label slo=<target>)",
+    "slo_latency_bad":
+        "queries over the pinot.slo.query.p99.ms target "
+        "(the latency-burn numerator)",
+    "slo_breaches": "SLO breach onsets (multi-window burn over threshold)",
+    "workload_tenant_cost_ms":
+        "accumulated per-tenant cost (device kernel ms + cpu ms)",
+    "cluster_scrape_failures": "instance scrapes that failed",
+    "cluster_instances_live": "instances the last sweep verdicted live",
+    "cluster_instances_degraded":
+        "instances the last sweep verdicted degraded",
+}

@@ -102,12 +102,17 @@ class InflightRegistry:
         self._lock = threading.Lock()
 
     def begin(self, key: str, *, sql: str = "", trace_id: str = "",
-              detail: str = "") -> None:
+              detail: str = "", tenant: Optional[str] = None,
+              deadline: Optional[float] = None) -> None:
+        """tenant/deadline: attribution + the absolute wall-clock
+        deadline (time.time() domain) — /debug/queries surfaces both so
+        an incident responder sees WHOSE query is in flight and how much
+        budget it has left, not just how long it has run."""
         with self._lock:
             self._entries[key] = {
                 "queryId": key, "sql": sql, "traceId": trace_id,
                 "startedAt": time.time(), "phase": "started",
-                "detail": detail}
+                "detail": detail, "tenant": tenant, "deadline": deadline}
 
     def phase(self, key: str, phase: str, detail: str = "") -> None:
         with self._lock:
@@ -116,6 +121,18 @@ class InflightRegistry:
                 e["phase"] = phase
                 if detail:
                     e["detail"] = detail
+
+    def annotate(self, key: str, *, tenant: Optional[str] = None,
+                 deadline: Optional[float] = None) -> None:
+        """Late attribution: the broker learns tenant + deadline only
+        after parse/route, well inside the entry's lifetime."""
+        with self._lock:
+            e = self._entries.get(key)
+            if e is not None:
+                if tenant is not None:
+                    e["tenant"] = tenant
+                if deadline is not None:
+                    e["deadline"] = deadline
 
     def end(self, key: str) -> None:
         with self._lock:
@@ -127,6 +144,10 @@ class InflightRegistry:
             entries = [dict(e) for e in self._entries.values()]
         for e in entries:
             e["elapsedMs"] = round((now - e.pop("startedAt")) * 1000.0, 3)
+            deadline = e.pop("deadline", None)
+            e["remainingDeadlineMs"] = (
+                round((deadline - now) * 1000.0, 3)
+                if deadline is not None else None)
         entries.sort(key=lambda e: -e["elapsedMs"])
         return entries
 
@@ -174,7 +195,9 @@ def log_slow_query(role: str, trace_id: str, sql: str, duration_ms: float,
 
 def debug_payload(role: str, path: str) -> Optional[Any]:
     """The /debug router shared by every HTTP surface. Returns the JSON
-    payload for the path, or None when the path isn't a debug route."""
+    payload for the path, or None when the path isn't a debug route.
+    Health-plane routes (PR 14) import lazily — the trace store must not
+    drag the health package in at module import."""
     if path == "/debug/traces":
         return {"role": role, "traces": get_store(role).recent()}
     if path.startswith("/debug/traces/"):
@@ -184,6 +207,18 @@ def debug_payload(role: str, path: str) -> Optional[Any]:
             else {"error": f"no trace {tid}", "role": role}
     if path == "/debug/queries":
         return {"role": role, "queries": get_inflight(role).snapshot()}
+    if path == "/debug/metrics/sample":
+        from pinot_tpu.utils.metrics import get_registry
+        return get_registry(role).sample()
+    if path == "/debug/metrics/history":
+        from pinot_tpu.health.history import get_history
+        return {"role": role, "samples": get_history(role).samples()}
+    if path == "/debug/health":
+        from pinot_tpu.health.rollup import role_health_summary
+        return role_health_summary(role)
+    if path == "/debug/workload":
+        from pinot_tpu.health.workload import get_workload
+        return get_workload(role).payload()
     return None
 
 

@@ -451,12 +451,15 @@ class TestDelayTracker:
         assert 0.0 <= t.delay_ms(1) < 1000
         assert t.partitions() == [0, 1]
         assert t.max_delay_ms() >= 3000
-        # a stopped/reassigned partition stops reporting
+        # a stopped/reassigned partition stops reporting, and its
+        # labeled gauge series leaves /metrics entirely (ISSUE 14) —
+        # a zeroed ghost series would still render forever
         t.remove_partition(0)
         assert t.delay_ms(0) is None
         assert t.partitions() == [1]
         assert m.gauge("ingestion_delay_ms",
-                       {"instance": "s0", "partition": "0"}) == 0.0
+                       {"instance": "s0", "partition": "0"}) is None
+        assert 'partition="0"' not in m.prometheus_text()
 
 
 @pytest.mark.chaos

@@ -34,10 +34,12 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _spawn(args):
+def _spawn(args, extra_env=None):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    if extra_env:
+        env.update(extra_env)
     return subprocess.Popen(
         [sys.executable, "-m", "pinot_tpu.tools.admin", *args],
         env=env, cwd=REPO, stdout=subprocess.PIPE,
@@ -55,6 +57,15 @@ def _wait(predicate, timeout=30.0, interval=0.2, desc="condition"):
             last_err = e
         time.sleep(interval)
     raise AssertionError(f"timeout waiting for {desc}: {last_err}")
+
+
+def _non_broker_instances(client) -> list:
+    """Registered instances minus brokers — since the cluster-health
+    sweep made every role register (ISSUE 14), brokers appear in the
+    instance registry too; segment-placement assertions count the
+    server/minion population only."""
+    return [i for i in client.get_state()["instances"].values()
+            if "broker" not in (i.get("tags") or [])]
 
 
 def _post_query(port: int, sql: str) -> dict:
@@ -88,7 +99,7 @@ def test_cluster_of_processes_with_server_kill(tmp_path):
              "--http-port", str(http_port)])
 
         client = CoordinationClient(coordinator)
-        _wait(lambda: len(client.get_state()["instances"]) == 2,
+        _wait(lambda: len(_non_broker_instances(client)) == 2,
               desc="2 servers registered")
 
         # table + segments (replication 2: every segment on both servers)
@@ -198,7 +209,7 @@ def test_minion_process_runs_merge_task(tmp_path):
         client = CoordinationClient(coordinator)
         # the server registers as assignable; the minion registers
         # tagged and must NOT receive segments
-        _wait(lambda: len(client.get_state()["instances"]) == 2,
+        _wait(lambda: len(_non_broker_instances(client)) == 2,
               desc="server + minion registered")
 
         from pinot_tpu.segment.fs import SegmentDeepStore
@@ -298,7 +309,7 @@ def test_server_restart_recovers_from_deep_store(tmp_path):
             ["StartBroker", "--coordinator", coordinator,
              "--http-port", str(http_port)])
         client = CoordinationClient(coordinator)
-        _wait(lambda: len(client.get_state()["instances"]) == 1,
+        _wait(lambda: len(_non_broker_instances(client)) == 1,
               desc="server registered")
 
         schema = Schema("ds", [
@@ -383,7 +394,7 @@ def test_multiprocess_upsert_restart_recovers_snapshot(tmp_path):
              "--http-port", str(http_port)])
 
         client = CoordinationClient(coordinator)
-        _wait(lambda: len(client.get_state()["instances"]) == 1,
+        _wait(lambda: len(_non_broker_instances(client)) == 1,
               desc="server registered")
 
         prod = StreamProducer(stream.address)
@@ -501,7 +512,7 @@ def test_multiprocess_realtime_replicas_over_tcp_stream(tmp_path):
              "--http-port", str(http_port)])
 
         client = CoordinationClient(coordinator)
-        _wait(lambda: len(client.get_state()["instances"]) == 2,
+        _wait(lambda: len(_non_broker_instances(client)) == 2,
               desc="servers registered")
 
         prod = StreamProducer(stream.address)
@@ -577,6 +588,96 @@ def test_multiprocess_realtime_replicas_over_tcp_stream(tmp_path):
               desc="restarted replica resumed from checkpoint")
     finally:
         stream.stop()
+        for name, proc in procs.items():
+            if proc.poll() is None:
+                proc.terminate()
+        for name, proc in procs.items():
+            try:
+                out, _ = proc.communicate(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                out, _ = proc.communicate()
+            if out:
+                print(f"--- {name} ---\n{out[-2000:]}")
+
+
+def _get_json(port: int, path: str) -> dict:
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+        return json.loads(r.read())
+
+
+def test_cluster_health_sweep_and_server_kill(tmp_path):
+    """Fleet health plane acceptance (ISSUE 14): GET /cluster/health on
+    a real multi-role cluster reports every role live; SIGKILLing a
+    server flips its verdict to degraded within a couple of sweep
+    intervals, with ZERO controller errors (the sweep degrades, never
+    throws)."""
+    coord_port = _free_port()
+    http_port = _free_port()
+    coordinator = f"127.0.0.1:{coord_port}"
+    fast_sweep = {
+        "PINOT_TPU_CLUSTER_HEALTH_INTERVAL_SECONDS": "0.5",
+        "PINOT_TPU_CLUSTER_HEALTH_SCRAPE_TIMEOUT_SECONDS": "1.0",
+    }
+    procs = {}
+    try:
+        procs["controller"] = _spawn(
+            ["StartController", "--state-dir", str(tmp_path / "state"),
+             "--port", str(coord_port), "--http-port", str(http_port)],
+            extra_env=fast_sweep)
+        _wait(lambda: _coord_up(coordinator), desc="controller up")
+        for i in range(2):
+            procs[f"server_{i}"] = _spawn(
+                ["StartServer", "--instance-id", f"server_{i}",
+                 "--coordinator", coordinator])
+        procs["broker"] = _spawn(
+            ["StartBroker", "--coordinator", coordinator,
+             "--http-port", str(_free_port())])
+
+        # every role converges to live: controller self-target + two
+        # servers (DebugHttpServer admin_url) + the broker's HTTP edge
+        def all_live():
+            h = _get_json(http_port, "/cluster/health")
+            inst = h["instances"]
+            roles = {e["role"] for e in inst.values()}
+            return (len(inst) >= 4
+                    and {"controller", "server", "broker"} <= roles
+                    and h["instancesDegraded"] == 0
+                    and all(e["verdict"] == "live"
+                            for e in inst.values()))
+        _wait(all_live, timeout=60, desc="every role live in the sweep")
+
+        # fleet metrics roll up: per-family counters summed across
+        # instances, per-instance gauges preserved
+        m = _get_json(http_port, "/cluster/metrics")
+        assert m["instances"], m
+        assert any(k.startswith("metrics_history_samples")
+                   for k in m["counters"]), sorted(m["counters"])[:10]
+
+        # ---- SIGKILL one server: verdict flips, controller survives ---
+        victim = procs.pop("server_1")
+        os.kill(victim.pid, signal.SIGKILL)
+        victim.wait(timeout=10)
+        t_kill = time.time()
+
+        def victim_degraded():
+            h = _get_json(http_port, "/cluster/health")
+            e = h["instances"].get("server_1")
+            return e is not None and e["verdict"] == "degraded" \
+                and not e.get("reachable", True)
+        _wait(victim_degraded, timeout=20,
+              desc="killed server verdicted degraded")
+        # promptness: a dead admin port refuses instantly, so the flip
+        # lands within a few 0.5s sweep intervals, not the liveness TTL
+        assert time.time() - t_kill < 15.0
+        # zero controller errors: the process is alive and still serves
+        # a parseable cluster verdict naming the survivor live
+        assert procs["controller"].poll() is None
+        h = _get_json(http_port, "/cluster/health")
+        assert h["instances"]["server_0"]["verdict"] == "live"
+        assert h["verdict"] == "degraded"
+    finally:
         for name, proc in procs.items():
             if proc.poll() is None:
                 proc.terminate()

@@ -448,6 +448,98 @@ class TestExpositionChecker:
 
 
 # ---------------------------------------------------------------------------
+# metrics_docs checker (catalog <-> emissions <-> README, both directions)
+# ---------------------------------------------------------------------------
+
+_MD_CATALOG = '''
+    METRICS = {
+        "documented_and_emitted": "a real metric",
+        "documented_never_emitted": "a ghost",
+    }
+'''
+
+
+class TestMetricsDocsChecker:
+    def _files(self, emit_src, readme="documented_and_emitted "
+                                      "documented_never_emitted"):
+        return {"pinot_tpu/utils/metrics_catalog.py": _MD_CATALOG,
+                "pinot_tpu/a.py": emit_src,
+                "README.md": readme}
+
+    def test_both_directions(self, tmp_path):
+        rep = _run(tmp_path, self._files('''
+            def f(m):
+                m.add_meter("documented_and_emitted")
+                m.set_gauge("emitted_never_documented", 1)
+        '''), "metrics_docs")
+        assert _keys(rep) == {"uncataloged:emitted_never_documented",
+                              "dead:documented_never_emitted"}
+
+    def test_readme_leg(self, tmp_path):
+        files = self._files('''
+            def f(m):
+                m.add_meter("documented_and_emitted")
+                m.add_timing("documented_never_emitted", 1.0)
+        ''', readme="only mentions documented_and_emitted")
+        rep = _run(tmp_path, files, "metrics_docs")
+        assert _keys(rep) == {"undocumented:documented_never_emitted"}
+
+    def test_conditional_name_emits_both_branches(self, tmp_path):
+        """'a' if won else 'b' counts BOTH literals as emissions —
+        the hedge_won/hedge_wasted shape must not read as dead."""
+        rep = _run(tmp_path, {
+            "pinot_tpu/utils/metrics_catalog.py": '''
+                METRICS = {"won": "w", "lost": "l"}
+            ''',
+            "pinot_tpu/a.py": '''
+                def f(m, is_win):
+                    m.add_meter("won" if is_win else "lost")
+            ''',
+            "README.md": "won lost"}, "metrics_docs")
+        assert not rep.unsuppressed
+
+    def test_prefix_composing_helper_out_of_scope(self, tmp_path):
+        """A module-local _meter that f-string-composes the name marks
+        its call-site literals as namespaced suffixes (cache/core.py),
+        while a pass-through _meter's literals are real family names."""
+        rep = _run(tmp_path, {
+            "pinot_tpu/utils/metrics_catalog.py": '''
+                METRICS = {"real_family": "r"}
+            ''',
+            "pinot_tpu/composed.py": '''
+                class Cache:
+                    def _meter(self, name):
+                        self._m.add_meter(f"{self._prefix}_{name}")
+
+                    def hit(self):
+                        self._meter("hits")
+            ''',
+            "pinot_tpu/passthrough.py": '''
+                class Residency:
+                    def _meter(self, name, value=1):
+                        self._m.add_meter(name, value)
+
+                    def touch(self):
+                        self._meter("real_family")
+                        self._meter("sneaky_unlisted")
+            ''',
+            "README.md": "real_family"}, "metrics_docs")
+        assert _keys(rep) == {"uncataloged:sneaky_unlisted"}
+
+    def test_missing_catalog_is_a_finding_in_real_package(self, tmp_path):
+        rep = _run(tmp_path, {
+            "pinot_tpu/utils/metrics.py": "x = 1\n",
+            "pinot_tpu/a.py": 'def f(m):\n    m.add_meter("x")\n'},
+            "metrics_docs")
+        assert _keys(rep) == {"catalog:missing"}
+        # fixture trees without the registry module stay silent (a
+        # FRESH tree: _index materializes cumulatively into tmp_path)
+        rep = _run(tmp_path / "bare", {"pinot_tpu/b.py": "y = 1\n"},
+                   "metrics_docs")
+        assert not rep.unsuppressed
+
+
+# ---------------------------------------------------------------------------
 # framework: parse errors, baseline round-trip, CLI
 # ---------------------------------------------------------------------------
 
@@ -704,10 +796,10 @@ class TestRepoGate:
                or str(e["reason"]).startswith("TODO")]
         assert not bad, f"baseline entries without written reasons: {bad}"
 
-    def test_all_six_checkers_registered_and_ran(self, report):
+    def test_all_checkers_registered_and_ran(self, report):
         from pinot_tpu.analysis import CHECKERS
         assert set(CHECKERS) == {"locks", "hangs", "failpoints", "knobs",
-                                 "purity", "exposition"}
+                                 "purity", "exposition", "metrics_docs"}
         ran = {f.checker for f in report.findings}
         # lock/knob findings exist (baselined); the others may be clean,
         # which the per-checker fixture tests above keep honest
