@@ -24,7 +24,8 @@ Suppression syntax (same line or the line directly above)::
     self._hits += 1          # lint: unlocked(meter only; torn reads ok)
 
 Every checker has a short code (``unlocked``, ``hang``, ``failpoint``,
-``knob``, ``impure``, ``exposition``); a suppression must carry a
+``knob``, ``impure``, ``exposition``, ``metricdoc``, ``errorcode``); a
+suppression must carry a
 non-empty reason or it does not count. Accepted pre-existing findings
 live in ``ANALYSIS_BASELINE.json`` at the repo root — each entry keyed
 by a line-number-independent fingerprint and a written reason, so the

@@ -1,6 +1,6 @@
 """The repo-specific checkers. Importing this package registers all of
 them in :data:`pinot_tpu.analysis.core.CHECKERS`."""
 from pinot_tpu.analysis.checkers import (  # noqa: F401
-    exposition, failpoint_sites, hangs, knobs, locks, metrics_docs,
-    purity,
+    errorcodes, exposition, failpoint_sites, hangs, knobs, locks,
+    metrics_docs, purity,
 )

@@ -1,0 +1,225 @@
+"""Error-code registry checker: ``errorCode`` literals stay canonical.
+
+``pinot_tpu/utils/errorcodes.py`` is the one place a query errorCode
+integer may be written down (the SITES/KEYS pattern for the error
+plane). This checker keeps three promises:
+
+* **no bare ints** — every literal errorCode emission or comparison in
+  production code references the catalog: flagged shapes are an int
+  literal as the value of an ``"errorCode"`` dict key, an int literal
+  compared against an expression mentioning ``errorCode`` (``e.get(
+  "errorCode") == 250``), an int default in ``.get("errorCode", 200)``,
+  an int literal as the code argument of an ``_error_response(...)``
+  helper call, and ``ERROR_CODE = <int>`` class-attribute assignments;
+* **no phantom codes** — every catalog name is referenced somewhere in
+  production code outside the catalog module;
+* **documented** — every catalog name appears in the README error-code
+  table.
+
+The catalog is parsed statically from the module AST (module-level
+``NAME = <int>`` assignments plus the ``CODES`` name->description
+dict); the analysis never imports production code.
+
+Suppression code: ``errorcode``.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from pinot_tpu.analysis.core import (
+    Checker, Finding, ModuleIndex, call_name, register, str_const,
+)
+
+_EC_MODULE = "pinot_tpu/utils/errorcodes.py"
+#: helper functions whose first positional argument is an errorCode
+_CODE_ARG_HELPERS = {"_error_response", "error_response"}
+
+
+def parse_registry(index: ModuleIndex
+                   ) -> Optional[Tuple[Dict[str, int], Dict[str, int]]]:
+    """({name: value}, {name: lineno}) from module-level NAME = <int>
+    assignments in the catalog module; None when the module is gone."""
+    sf = index.get(_EC_MODULE)
+    if sf is None:
+        return None
+    values: Dict[str, int] = {}
+    lines: Dict[str, int] = {}
+    for node in sf.tree.body:  # module level only
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Constant) \
+                and type(node.value.value) is int:
+            name = node.targets[0].id
+            values[name] = node.value.value
+            lines[name] = node.lineno
+    return values, lines
+
+
+def parse_descriptions(index: ModuleIndex) -> Optional[Set[str]]:
+    """Names documented in the CODES dict."""
+    sf = index.get(_EC_MODULE)
+    if sf is None:
+        return None
+    for node in ast.walk(sf.tree):
+        target = None
+        if isinstance(node, ast.AnnAssign) \
+                and isinstance(node.target, ast.Name):
+            target, value = node.target.id, node.value
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            target, value = node.targets[0].id, node.value
+        if target != "CODES" or not isinstance(value, ast.Dict):
+            continue
+        return {str_const(k) for k in value.keys
+                if str_const(k) is not None}
+    return None
+
+
+def _int_const(node: ast.AST) -> Optional[int]:
+    if isinstance(node, ast.Constant) and type(node.value) is int:
+        return node.value
+    return None
+
+
+def _mentions_errorcode(node: ast.AST) -> bool:
+    """True when the expression textually involves an errorCode lookup
+    (``x["errorCode"]``, ``x.get("errorCode")``, a name containing
+    ERROR_CODE...)."""
+    for sub in ast.walk(node):
+        s = str_const(sub)
+        if s == "errorCode":
+            return True
+        if isinstance(sub, ast.Name) and "ERROR_CODE" in sub.id:
+            return True
+        if isinstance(sub, ast.Attribute) and "ERROR_CODE" in sub.attr:
+            return True
+    return False
+
+
+@register
+class ErrorCodeChecker(Checker):
+    name = "errorcodes"
+    code = "errorcode"
+
+    def run(self, index: ModuleIndex) -> List[Finding]:
+        reg = parse_registry(index)
+        ec_sf = index.get(_EC_MODULE)
+        if reg is None or ec_sf is None:
+            # the catalog vanishing is itself drift — but only report
+            # when the tree looks like the real package (fixture trees
+            # in the unit tests have no catalog at all)
+            acct = index.get("pinot_tpu/utils/accounting.py")
+            if acct is not None:
+                return [Finding(
+                    checker=self.name, code=self.code,
+                    file="pinot_tpu/utils/accounting.py", line=1,
+                    key="registry:missing",
+                    message="utils/errorcodes.py registry not found — "
+                            "the canonical errorCode catalog is gone")]
+            return []
+        values, reg_lines = reg
+        described = parse_descriptions(index) or set()
+        out: List[Finding] = []
+        referenced: Set[str] = set()
+        for sf in index.files("pinot_tpu/"):
+            if sf.relpath == _EC_MODULE:
+                continue
+            for node in ast.walk(sf.tree):
+                # references to catalog names (leg 2's evidence)
+                if isinstance(node, ast.Attribute) \
+                        and node.attr in values:
+                    referenced.add(node.attr)
+                elif isinstance(node, ast.Name) and node.id in values:
+                    referenced.add(node.id)
+                # violation shapes (leg 1)
+                if isinstance(node, ast.Dict):
+                    for k, v in zip(node.keys, node.values):
+                        if str_const(k) == "errorCode" \
+                                and _int_const(v) is not None:
+                            out.append(self.finding(
+                                sf, v,
+                                key=f"literal:dict:{_int_const(v)}",
+                                message=(
+                                    f'literal errorCode {_int_const(v)} '
+                                    f"in a dict emission — reference "
+                                    f"utils/errorcodes.py instead")))
+                elif isinstance(node, ast.Compare):
+                    sides = [node.left, *node.comparators]
+                    ints = [s for s in sides
+                            if _int_const(s) is not None]
+                    if ints and _mentions_errorcode(node):
+                        out.append(self.finding(
+                            sf, node,
+                            key=f"literal:cmp:{_int_const(ints[0])}",
+                            message=(
+                                f"literal errorCode "
+                                f"{_int_const(ints[0])} in a comparison "
+                                f"— reference utils/errorcodes.py "
+                                f"instead")))
+                elif isinstance(node, ast.Call):
+                    fn = call_name(node)
+                    if fn.split(".")[-1] in _CODE_ARG_HELPERS \
+                            and node.args \
+                            and _int_const(node.args[0]) is not None:
+                        out.append(self.finding(
+                            sf, node,
+                            key=(f"literal:call:"
+                                 f"{_int_const(node.args[0])}"),
+                            message=(
+                                f"literal errorCode "
+                                f"{_int_const(node.args[0])} passed to "
+                                f"{fn}() — reference "
+                                f"utils/errorcodes.py instead")))
+                    elif fn.endswith(".get") and len(node.args) >= 2 \
+                            and str_const(node.args[0]) == "errorCode" \
+                            and _int_const(node.args[1]) is not None:
+                        out.append(self.finding(
+                            sf, node,
+                            key=(f"literal:default:"
+                                 f"{_int_const(node.args[1])}"),
+                            message=(
+                                f"literal errorCode default "
+                                f"{_int_const(node.args[1])} in "
+                                f'.get("errorCode", ...) — reference '
+                                f"utils/errorcodes.py instead")))
+                elif isinstance(node, ast.Assign) \
+                        and _int_const(node.value) is not None:
+                    for t in node.targets:
+                        tname = (t.id if isinstance(t, ast.Name)
+                                 else t.attr if isinstance(t, ast.Attribute)
+                                 else "")
+                        if "ERROR_CODE" in tname:
+                            out.append(self.finding(
+                                sf, node,
+                                key=f"literal:assign:{tname}",
+                                message=(
+                                    f"literal errorCode assigned to "
+                                    f"{tname} — reference "
+                                    f"utils/errorcodes.py instead")))
+        for name in sorted(values):
+            if name not in referenced:
+                out.append(self.finding(
+                    ec_sf, reg_lines[name], key=f"dead:{name}",
+                    message=(f'errorcodes.{name} is referenced nowhere '
+                             f"in production code — phantom code")))
+            if name not in described:
+                out.append(self.finding(
+                    ec_sf, reg_lines[name], key=f"undescribed:{name}",
+                    message=(f'errorcodes.{name} has no CODES registry '
+                             f"description — the README table renders "
+                             f"from it")))
+        readme = os.path.join(index.root, "README.md")
+        if os.path.exists(readme):
+            with open(readme, encoding="utf-8") as f:
+                readme_text = f.read()
+            for name in sorted(values):
+                if name not in readme_text:
+                    out.append(self.finding(
+                        ec_sf, reg_lines[name],
+                        key=f"undocumented:{name}",
+                        message=(f'errorcodes.{name} appears in no '
+                                 f"README error-code table — clients "
+                                 f"cannot discover it")))
+        return out

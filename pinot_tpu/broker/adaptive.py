@@ -1,4 +1,4 @@
-"""Adaptive server selection: route around slow replicas.
+"""Adaptive server selection + broker self-protection primitives.
 
 Reference parity: pinot-broker
 routing/adaptiveserverselector/{LatencySelector, NumInFlightReqSelector,
@@ -10,6 +10,13 @@ Scores are 'lower is better':
   latency   — EWMA of observed request seconds
   inflight  — current outstanding requests
   hybrid    — ewma_latency * (1 + inflight)   (the default)
+
+:class:`RetryBudget` is the broker's anti-amplification governor
+(Finagle's RetryBudget shape): retries and hedges are paid for out of a
+per-table token bucket that only clean primary responses refill, so a
+failing or overloaded fleet sees offered load CONVERGE toward the
+organic rate instead of multiplying — the retry-storm failure mode
+("The Tail at Scale"; DAGOR, SOSP 2018).
 """
 from __future__ import annotations
 
@@ -18,6 +25,79 @@ import threading
 from typing import Dict, List, Optional, Set
 
 from pinot_tpu.utils.metrics import Timer
+
+
+class RetryBudget:
+    """Per-table token bucket: every clean primary response DEPOSITS
+    ``ratio`` tokens (capped at ``cap``), every retry/hedge attempt
+    WITHDRAWS one. A table starts with ``min_tokens`` so a cold broker
+    can still salvage the odd failure; a table drowning in failures
+    runs dry and its failures surface as typed partials instead of
+    re-offered load. Disabled = every withdrawal granted (the pre-PR-15
+    behavior, and the bench --overload unprotected A/B leg)."""
+
+    def __init__(self, ratio: float = 0.2, min_tokens: float = 3.0,
+                 cap: float = 10.0, enabled: bool = True,
+                 metrics=None):
+        self.enabled = bool(enabled)
+        self.ratio = max(0.0, float(ratio))
+        self.min_tokens = max(0.0, float(min_tokens))
+        self.cap = max(self.min_tokens, float(cap))
+        self._tokens: Dict[str, float] = {}
+        self._lock = threading.Lock()
+        self._metrics = metrics
+
+    @classmethod
+    def from_config(cls, config, metrics=None) -> "RetryBudget":
+        if config is None:
+            return cls(metrics=metrics)
+        return cls(
+            ratio=config.get_float("pinot.broker.retry.budget.ratio"),
+            min_tokens=config.get_float("pinot.broker.retry.budget.min"),
+            cap=config.get_float("pinot.broker.retry.budget.cap"),
+            enabled=config.get_bool("pinot.broker.retry.budget.enabled",
+                                    True),
+            metrics=metrics)
+
+    def _gauge(self, table: str, tokens: float) -> None:
+        if self._metrics is not None:
+            self._metrics.set_gauge("broker_retry_budget_tokens",
+                                    round(tokens, 3),
+                                    labels={"table": table})
+
+    def deposit(self, table: str) -> None:
+        """One clean primary response earns ``ratio`` retries' worth."""
+        if not self.enabled:
+            return
+        with self._lock:
+            cur = self._tokens.get(table, self.min_tokens)
+            cur = min(self.cap, cur + self.ratio)
+            self._tokens[table] = cur
+        self._gauge(table, cur)
+
+    def try_withdraw(self, table: str, cost: float = 1.0) -> bool:
+        """Spend one retry/hedge; False = budget exhausted (the caller
+        surfaces the failure typed instead of retrying)."""
+        if not self.enabled:
+            return True
+        with self._lock:
+            cur = self._tokens.get(table, self.min_tokens)
+            if cur < cost:
+                granted = False
+            else:
+                granted = True
+                cur -= cost
+                self._tokens[table] = cur
+        if not granted:
+            if self._metrics is not None:
+                self._metrics.add_meter("broker_retry_budget_exhausted")
+            return False
+        self._gauge(table, cur)
+        return True
+
+    def tokens(self, table: str) -> float:
+        with self._lock:
+            return self._tokens.get(table, self.min_tokens)
 
 
 class AdaptiveServerSelector:

@@ -21,9 +21,29 @@ from pinot_tpu.query.reduce import BrokerResponse, reduce_results
 from pinot_tpu.server import datatable
 from pinot_tpu.server.query_server import ServerConnection
 from pinot_tpu.broker.routing import BrokerRoutingManager
-from pinot_tpu.utils import tracing, trace_store
+from pinot_tpu.utils import errorcodes, tracing, trace_store
 from pinot_tpu.utils.accounting import BrokerTimeoutError
-from pinot_tpu.utils.failpoints import fire
+from pinot_tpu.utils.failpoints import FailpointError, fire
+
+
+def _overload_entry(server_exc) -> Optional[dict]:
+    """The typed 211 admission rejection, when that is ALL the server
+    said (a payload carrying real results or other errors is handled by
+    the normal merge/fallback machinery, not the overload path)."""
+    if not server_exc:
+        return None
+    entries = [e for e in server_exc if isinstance(e, dict)
+               and e.get("errorCode") == errorcodes.SERVER_OVERLOADED]
+    if len(entries) == len(server_exc):
+        return entries[0]
+    return None
+
+
+def _retry_after_s(entry: dict) -> Optional[float]:
+    """The in-band retryAfterMs hint from a 211 message, in seconds
+    (format/parse single-sourced in utils/errorcodes.py)."""
+    ms = errorcodes.parse_retry_after(entry.get("message", ""))
+    return ms / 1000.0 if ms is not None else None
 
 
 class _ScatterUnit:
@@ -147,6 +167,12 @@ class BrokerRequestHandler:
         self.tenants: Dict[str, str] = {}
         #: adaptive selector stats feed (routing.selector, may be None)
         self._selector = getattr(routing, "selector", None)
+        #: per-table retry/hedge budget (broker/adaptive.py RetryBudget):
+        #: clean primary responses refill it, every retry/hedge spends
+        #: from it — failures cannot amplify into retry storms
+        from pinot_tpu.broker.adaptive import RetryBudget
+        self._retry_budget = RetryBudget.from_config(
+            config, metrics=self._metrics)
         #: multi-stage dispatcher (mse/dispatcher.py); when set, queries the
         #: single-stage grammar rejects (joins, subqueries) — or that opt in
         #: via useMultistageEngine — go through it (ref
@@ -232,12 +258,33 @@ class BrokerRequestHandler:
         """Adaptive hedge trigger: p95 over the selector's pooled
         per-server latency reservoirs (true per-request tails, not
         smoothed means), clamped to the configured floor/ceiling. None
-        when hedging is off."""
+        when hedging is off — including AUTO-disabled: under brownout
+        (rung 1) or while any server's overload horizon is open,
+        speculative duplicate load is exactly the wrong medicine for a
+        fleet already shedding (maybe_hedge re-checks per tick, so the
+        gate is live mid-gather too)."""
         if not self._hedge_enabled:
+            return None
+        from pinot_tpu.health.brownout import engaged
+        if engaged("broker", "hedge_off") \
+                or self.failure_detector.any_overloaded():
             return None
         base = (self._selector.latency_quantile(0.95)
                 if self._selector is not None else 0.0)
         return min(max(base, self._hedge_min_s), self._hedge_max_s)
+
+    def _spend_retry(self, table: str) -> bool:
+        """One retry/hedge attempt's budget withdrawal. The
+        `broker.retry.budget` failpoint fires on every withdrawal —
+        seeded chaos forces exhaustion deterministically (armed with
+        error=FailpointError), and its decision journal replays
+        byte-identical."""
+        try:
+            fire("broker.retry.budget", table=table)
+        except FailpointError:
+            self._metrics.add_meter("broker_retry_budget_exhausted")
+            return False
+        return self._retry_budget.try_withdraw(table)
 
     @staticmethod
     def _phase(phase: str, detail: str = "") -> None:
@@ -304,8 +351,14 @@ class BrokerRequestHandler:
         excs = [e for e in (resp.exceptions or []) if isinstance(e, dict)]
         if excs:
             self._metrics.add_meter("broker_query_errors")
-        if any(e.get("errorCode") == 250 for e in excs):
+        if any(e.get("errorCode") == errorcodes.EXECUTION_TIMEOUT
+               for e in excs):
             self._metrics.add_meter("broker_error_code_250")
+        if any(e.get("errorCode") == errorcodes.SERVER_OVERLOADED
+               for e in excs):
+            # the brownout shed-rate numerator: overload rejections that
+            # no replica absorbed and surfaced to the client as partials
+            self._metrics.add_meter("broker_overload_partials")
 
     def _timed_request(self, conn, server, physical_table, sql,
                        segment_names, request_id, extra_filter,
@@ -359,7 +412,8 @@ class BrokerRequestHandler:
                     parsed = parse_mse_sql(sql)
                 except (SqlParseError, ValueError):
                     return _error_response(
-                        150, f"SQLParsingError: {e}", start)
+                        errorcodes.SQL_PARSING,
+                        f"SQLParsingError: {e}", start)
                 # MSE queries are NOT a quota bypass: meter EVERY table
                 # the tree reads (set operands + subquery roots included)
                 # in ONE all-or-nothing acquisition — a rejection must
@@ -371,14 +425,16 @@ class BrokerRequestHandler:
                         [base_table_name(t) for t in _mse_tables(parsed)])
                     if reason:
                         return _error_response(
-                            429, f"QuotaExceededError: {reason}", start)
+                            errorcodes.QUOTA_EXCEEDED,
+                            f"QuotaExceededError: {reason}", start)
                 # the MSE query enters with the same end-to-end budget
                 # resolution as the single-stage path: OPTION(timeoutMs)
                 # wins inside the dispatcher, this broker's configured
                 # default is the fallback
                 return self.mse_dispatcher.submit(
                     sql, parsed, default_timeout_ms=self._default_timeout_ms)
-            return _error_response(150, f"SQLParsingError: {e}", start)
+            return _error_response(errorcodes.SQL_PARSING,
+                                   f"SQLParsingError: {e}", start)
         if req_trace is not None:
             # the client's trace=true upgrades the shadow trace to a
             # sampled one: the stitched tree returns as traceInfo
@@ -388,7 +444,8 @@ class BrokerRequestHandler:
         quota_reason = self._check_quota(ctx.table)
         if quota_reason:
             return _error_response(
-                429, f"QuotaExceededError: {quota_reason}", start)
+                errorcodes.QUOTA_EXCEEDED,
+                f"QuotaExceededError: {quota_reason}", start)
         if self.mse_dispatcher is not None and \
                 query.options.get("useMultistageEngine", "").lower() == "true":
             return self.mse_dispatcher.submit(
@@ -397,7 +454,8 @@ class BrokerRequestHandler:
         route = self.routing.get_route(ctx.table)
         if route is None:
             return _error_response(
-                190, f"TableDoesNotExistError: {ctx.table}", start)
+                errorcodes.TABLE_DOES_NOT_EXIST,
+                f"TableDoesNotExistError: {ctx.table}", start)
 
         # -- tier-1 whole-result cache ---------------------------------
         # keyed by (query fingerprint, table, routing epoch): the epoch
@@ -417,11 +475,19 @@ class BrokerRequestHandler:
                 epoch = route.epoch()
                 if not epoch.startswith("<torn:"):
                     # a torn epoch never repeats: a get can't hit and a
-                    # put would leak an unaddressable entry — skip both
+                    # put would leak an unaddressable entry — skip both.
+                    # Under brownout rung 2 an expired-but-retained
+                    # entry may serve, flagged staleResult=true: a
+                    # correct-but-old dashboard beats a shed query.
+                    from pinot_tpu.health.brownout import engaged
                     cache_key = (ctx.fingerprint(), ctx.table, epoch)
-                    hit = self.result_cache.get(*cache_key)
+                    hit = self.result_cache.get(
+                        *cache_key,
+                        allow_stale=engaged("broker", "stale_cache"))
                     if hit is not None:
                         hit.cache_hit = True
+                        if hit.stale_result:
+                            self._metrics.add_meter("stale_results_served")
                         hit.time_used_ms = (time.time() - start) * 1000.0
                         return hit
 
@@ -550,7 +616,7 @@ class BrokerRequestHandler:
                 # a silently skipped server would return a clean-looking
                 # partial aggregate; surface it as a server error
                 exceptions.append(
-                    {"errorCode": 427,
+                    {"errorCode": errorcodes.SERVER_ERROR,
                      "message": f"ServerNotConnected: {server}"})
                 if unit.table.endswith("_OFFLINE"):
                     offline_failed[0] = True
@@ -617,13 +683,32 @@ class BrokerRequestHandler:
                 server_stats.append(stats_extra)
             responded += 1
 
-        def resolve_failed(L: _ScatterUnit, error) -> None:
+        def typed_failure(error, overload: Optional[dict],
+                          suffix: str = "") -> dict:
+            """The exception entry a dead logical unit surfaces: an
+            overload rejection stays a typed 211 (its retryAfterMs hint
+            intact) — NEVER a raw 427, which would read as a dead
+            server and double-penalize a merely saturated one."""
+            if overload is not None:
+                return {"errorCode": errorcodes.SERVER_OVERLOADED,
+                        "message": str(overload.get("message", error))
+                        + suffix}
+            return {"errorCode": errorcodes.SERVER_ERROR,
+                    "message": f"ServerError: {error}{suffix}"}
+
+        def resolve_failed(L: _ScatterUnit, error,
+                           overload: Optional[dict] = None) -> None:
             """Every attempt of logical unit L is dead: salvage held-back
             errored payloads for still-unanswered segment sets, then
             retry ONLY the unanswered remainder on surviving replicas —
-            sharing, not resetting, the original deadline budget. For
-            grouped tables the exclusion demotes each failed server's
-            whole group, so the re-scatter lands on a surviving group."""
+            sharing, not resetting, the original deadline budget, and
+            PAYING for the retry from the per-table budget (exhausted
+            budget = typed partial, not re-offered load). For grouped
+            tables the exclusion demotes each failed server's whole
+            group, so the re-scatter lands on a surviving group.
+            overload: the typed 211 entry when the unit died of
+            admission rejection — retried on at most one other replica
+            (retry units never re-retry) and surfaced typed."""
             L.done = True
             for c in L.children:
                 c.done = True
@@ -641,8 +726,14 @@ class BrokerRequestHandler:
             if not pending:
                 return
             if L.retried:
-                exceptions.append({"errorCode": 427,
-                                   "message": f"ServerError: {error}"})
+                exceptions.append(typed_failure(error, overload))
+                return
+            if not self._spend_retry(L.table):
+                # budget dry: surface typed instead of amplifying —
+                # a fleet-wide failure under load must converge offered
+                # load toward the organic rate, not multiply it
+                exceptions.append(typed_failure(
+                    error, overload, suffix=" (retry budget exhausted)"))
                 return
             # exclude everything known-bad: this round's failures, the
             # detector's unhealthy set, AND every failed server's whole
@@ -658,15 +749,15 @@ class BrokerRequestHandler:
             if unplaced:
                 # segments with no surviving replica: surface the
                 # loss instead of a clean-looking partial answer
-                exceptions.append({
-                    "errorCode": 427,
-                    "message": (f"ServerError: {error} "
-                                f"(segments lost: {unplaced})")})
+                exceptions.append(typed_failure(
+                    error, overload, suffix=f" (segments lost: {unplaced})"))
             for rserver, rtable, rnames, rextra in rerouted:
                 child = _ScatterUnit(rserver, rtable, rnames, rextra,
                                      retried=True)
                 units.append(child)
-                if not launch(child, rserver):
+                if launch(child, rserver):
+                    self._metrics.add_meter("broker_retries_issued")
+                else:
                     child.done = True
 
         def process(fut) -> None:
@@ -703,7 +794,35 @@ class BrokerRequestHandler:
                     return
                 resolve_failed(L, e)
                 return
+            overload = _overload_entry(server_exc)
+            if overload is not None:
+                # typed 211 admission rejection: the server is alive and
+                # shedding — cool it lightly (NOT a failure mark), stop
+                # hedging into the saturation, and retry the unit on at
+                # most one other replica if the budget allows; otherwise
+                # the rejection surfaces as a typed partial, never a 427
+                self._metrics.add_meter("broker_overload_rejections")
+                self.failure_detector.mark_overload(
+                    server, retry_after_s=_retry_after_s(overload))
+                if sp is not None:
+                    sp.graft(server_trace)
+                    sp.end(outcome="overloaded")
+                if unit.parent is not None:
+                    unit.done = True
+                if L.done or L.family_live() > 0:
+                    # a twin already merged (or is still racing): this
+                    # rejection loses/defers
+                    return
+                resolve_failed(L, overload.get("message", "overloaded"),
+                               overload=overload)
+                return
             self.failure_detector.mark_success(server)
+            if unit.parent is None and not unit.retried and not is_hedge:
+                # a clean-channel primary response refills the table's
+                # retry budget (errored payloads still count: the
+                # SERVER answered — amplification risk is about load,
+                # not correctness)
+                self._retry_budget.deposit(unit.table)
             if sp is not None:
                 # the server's own span tree stitches under this
                 # attempt's scatter span — ONE cross-process tree
@@ -775,6 +894,12 @@ class BrokerRequestHandler:
             groups make partial overlap the norm)."""
             if hedge_at is None or time.time() < hedge_at:
                 return
+            if self._hedge_delay_s() is None:
+                # live auto-disable: a server reported overload (or the
+                # brownout ladder climbed) AFTER this query started —
+                # speculative duplicate load must stop immediately, not
+                # at the next query
+                return
             for unit in list(units):
                 if unit.done or unit.live == 0 or unit.hedge_tried \
                         or unit.retried or unit.parent is not None:
@@ -790,6 +915,8 @@ class BrokerRequestHandler:
                     continue  # some segment has no other healthy replica
                 if (deadline - time.time()) * 1000.0 < 1.0:
                     continue  # no budget left to hedge into
+                if not self._spend_retry(unit.table):
+                    continue  # hedges are retries too: budget governs both
                 if len(entries) == 1:
                     if launch(unit, entries[0][0], is_hedge=True):
                         unit.hedged = True
@@ -980,11 +1107,13 @@ class StreamingMixin:
         quota_reason = self._check_quota(ctx.table)
         if quota_reason:
             return _error_response(
-                429, f"QuotaExceededError: {quota_reason}", start)
+                errorcodes.QUOTA_EXCEEDED,
+                f"QuotaExceededError: {quota_reason}", start)
         route = self.routing.get_route(ctx.table)
         if route is None:
             return _error_response(
-                190, f"TableDoesNotExistError: {ctx.table}", start)
+                errorcodes.TABLE_DOES_NOT_EXIST,
+                f"TableDoesNotExistError: {ctx.table}", start)
         plan = route.route(ctx, unhealthy=self.failure_detector
                            .unhealthy_servers())
         request_id = self._next_id()
@@ -995,8 +1124,9 @@ class StreamingMixin:
         for server, physical_table, names, extra in plan:
             conn = self.connections.get(server)
             if conn is None:
-                exceptions.append({"errorCode": 427,
-                                   "message": f"ServerNotConnected: {server}"})
+                exceptions.append(
+                    {"errorCode": errorcodes.SERVER_ERROR,
+                     "message": f"ServerNotConnected: {server}"})
                 continue
             if self._selector is not None:
                 self._selector.record_start(server)
@@ -1018,7 +1148,7 @@ class StreamingMixin:
                 self.failure_detector.mark_success(server)
             except Exception as e:  # noqa: BLE001
                 self.failure_detector.mark_failure(server)
-                exceptions.append({"errorCode": 427,
+                exceptions.append({"errorCode": errorcodes.SERVER_ERROR,
                                    "message": f"ServerError: {e}"})
             finally:
                 if self._selector is not None:

@@ -118,7 +118,7 @@ class BrokerResultCache:
     def __init__(self, max_bytes: int = 64 << 20, ttl_seconds: float = 60.0,
                  enabled: bool = True, cache_realtime: bool = False,
                  metrics=None, labels: Optional[dict] = None,
-                 backend=None):
+                 backend=None, stale_grace_seconds: float = 0.0):
         """labels: metric labels (e.g. {'broker': id}) — several broker
         handlers in one process share the 'broker' registry, so unlabeled
         gauges would clobber each other.
@@ -138,10 +138,10 @@ class BrokerResultCache:
             self._cache = backend
             self._wire = getattr(backend, "wire_codec", False)
         else:
-            self._cache = LruTtlCache(max_bytes, ttl_seconds,
-                                      metrics=metrics,
-                                      metric_prefix="result_cache",
-                                      labels=labels)
+            self._cache = LruTtlCache(
+                max_bytes, ttl_seconds, metrics=metrics,
+                metric_prefix="result_cache", labels=labels,
+                stale_grace_seconds=stale_grace_seconds)
             self._wire = False
 
     @classmethod
@@ -162,18 +162,38 @@ class BrokerResultCache:
             enabled=config.get_bool("pinot.broker.result.cache.enabled"),
             cache_realtime=config.get_bool(
                 "pinot.broker.result.cache.realtime"),
-            metrics=metrics, labels=labels, backend=backend)
+            metrics=metrics, labels=labels, backend=backend,
+            # retention past TTL costs budget on every expiry — pay it
+            # only when brownout (the sole stale reader) can engage
+            stale_grace_seconds=(config.get_float(
+                "pinot.brownout.stale.ttl.grace.seconds")
+                if config.get_bool("pinot.brownout.enabled", True)
+                else 0.0))
 
     # ------------------------------------------------------------------
-    def get(self, fingerprint: str, table: str,
-            epoch: str) -> Optional[BrokerResponse]:
+    def get(self, fingerprint: str, table: str, epoch: str,
+            allow_stale: bool = False) -> Optional[BrokerResponse]:
+        """allow_stale (brownout rung 2, health/brownout.py): on a
+        fresh miss, an expired-but-retained entry within the stale
+        grace window may serve, marked ``stale_result=True`` so the
+        client sees staleResult=true. Local backend only — a tiered/
+        remote backend without get_stale simply never serves stale."""
         if not self.enabled:
             return None
         payload = self._cache.get((fingerprint, table, epoch))
+        stale = False
+        if payload is None and allow_stale:
+            get_stale = getattr(self._cache, "get_stale", None)
+            if get_stale is not None:
+                payload = get_stale((fingerprint, table, epoch))
+                stale = payload is not None
         if payload is None:
             return None
-        return (wire_loads_response(payload) if self._wire
+        resp = (wire_loads_response(payload) if self._wire
                 else loads(payload))
+        if resp is not None and stale:
+            resp.stale_result = True
+        return resp
 
     def put(self, fingerprint: str, table: str, epoch: str,
             resp: BrokerResponse) -> bool:

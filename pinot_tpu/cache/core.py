@@ -11,6 +11,8 @@ from __future__ import annotations
 import pickle
 import threading
 import time
+
+from pinot_tpu.utils import errorcodes
 from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Any, Callable, Hashable, Optional, Tuple
@@ -42,9 +44,15 @@ class LruTtlCache:
     def __init__(self, max_bytes: int, ttl_seconds: float,
                  metrics=None, metric_prefix: str = "cache",
                  labels: Optional[dict] = None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 stale_grace_seconds: float = 0.0):
         self.max_bytes = int(max_bytes)
         self.ttl_seconds = float(ttl_seconds)
+        #: brownout stale-serving window: expired entries are RETAINED
+        #: (LRU-evictable, still misses for normal gets) for this long
+        #: past TTL so get_stale can serve them flagged; 0 restores
+        #: delete-on-expiry exactly
+        self.stale_grace_seconds = max(0.0, float(stale_grace_seconds))
         self.stats = CacheStats()
         self._entries: "OrderedDict[Hashable, Tuple[float, bytes]]" = \
             OrderedDict()
@@ -89,9 +97,10 @@ class LruTtlCache:
             expires_at, payload = entry
             now = self._clock()
             if now >= expires_at:
-                del self._entries[key]
-                self._bytes -= len(payload)
-                self.stats.expirations += 1
+                if now >= expires_at + self.stale_grace_seconds:
+                    del self._entries[key]
+                    self._bytes -= len(payload)
+                    self.stats.expirations += 1
                 self.stats.misses += 1
                 self._meter("misses")
                 self._gauge_bytes()
@@ -100,6 +109,28 @@ class LruTtlCache:
             self.stats.hits += 1
             self._meter("hits")
             return payload, expires_at - now
+
+    def get_stale(self, key: Hashable) -> Optional[bytes]:
+        """An entry within TTL *or* the stale grace window — the
+        brownout rung-2 read path (health/brownout.py): past TTL the
+        payload is knowingly stale, the caller flags it staleResult.
+        None when absent or past TTL + grace (which also reclaims)."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                return None
+            expires_at, payload = entry
+            now = self._clock()
+            if now >= expires_at + self.stale_grace_seconds:
+                del self._entries[key]
+                self._bytes -= len(payload)
+                self.stats.expirations += 1
+                self._gauge_bytes()
+                return None
+            self._entries.move_to_end(key)
+            if now >= expires_at:
+                self._meter("stale_hits")
+            return payload
 
     def put(self, key: Hashable, payload: bytes,
             ttl_seconds: Optional[float] = None) -> bool:
@@ -254,7 +285,8 @@ def wire_dumps_response(resp: Any) -> Optional[bytes]:
                        [tuple(r) for r in rt.rows]))
         blob = (
             table,
-            [(int(e.get("errorCode", 200)), str(e.get("message", "")))
+            [(int(e.get("errorCode", errorcodes.QUERY_EXECUTION)),
+              str(e.get("message", "")))
              for e in resp.exceptions],
             datatable._stats_tuple(resp.stats),
             int(resp.num_servers_queried),

@@ -13,6 +13,8 @@ import urllib.error
 import urllib.request
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from pinot_tpu.utils import errorcodes
+
 
 class PinotClientError(Exception):
     """Query rejected or failed broker-side (carries the exceptions)."""
@@ -33,7 +35,28 @@ class PinotTimeoutError(PinotClientError):
         self.result_set = result_set
 
 
-_TIMEOUT_ERROR_CODE = 250
+class PinotOverloadError(PinotClientError):
+    """The fleet REFUSED the query at admission (errorCode 211,
+    server-side overload protection) rather than running it into a
+    deadline miss. ``retry_after_ms`` carries the server's drain hint
+    (None when absent) — back off at least that long before retrying;
+    ``result_set`` carries whatever partial answer other replicas
+    assembled (partialResult=true)."""
+
+    def __init__(self, message: str, exceptions: Optional[list] = None,
+                 result_set: Optional["ResultSet"] = None):
+        super().__init__(message, exceptions)
+        self.result_set = result_set
+        self.retry_after_ms: Optional[float] = None
+        for x in self.exceptions:
+            hint = errorcodes.parse_retry_after(x.get("message", ""))
+            if hint is not None and (self.retry_after_ms is None
+                                     or hint > self.retry_after_ms):
+                self.retry_after_ms = hint
+
+
+_TIMEOUT_ERROR_CODE = errorcodes.EXECUTION_TIMEOUT
+_OVERLOAD_ERROR_CODE = errorcodes.SERVER_OVERLOADED
 
 
 class ResultSet:
@@ -114,6 +137,11 @@ class Connection:
                 # typed miss: the partial rides along instead of vanishing
                 raise PinotTimeoutError(message, rs.exceptions,
                                         result_set=rs)
+            if any(x.get("errorCode") == _OVERLOAD_ERROR_CODE
+                   for x in rs.exceptions):
+                # typed shed: retry-after hint + partial ride along
+                raise PinotOverloadError(message, rs.exceptions,
+                                         result_set=rs)
             raise PinotClientError(message, rs.exceptions)
         return rs
 

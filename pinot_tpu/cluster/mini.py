@@ -29,7 +29,15 @@ class MiniClusterServer:
         self.data_manager = InstanceDataManager(instance_id)
         self.executor = ServerQueryExecutor(self.data_manager,
                                             use_tpu=use_tpu, config=config)
-        self.transport = QueryServer(self.executor)
+        # honor the worker-pool/scheduler knobs like the real ServerRole
+        # does (the overload bench sizes capacity through them; defaults
+        # match QueryServer's own)
+        from pinot_tpu.utils.config import PinotConfiguration as _PC
+        _cfg = config or _PC()
+        self.transport = QueryServer(
+            self.executor,
+            num_threads=_cfg.get_int("pinot.server.query.num.threads"),
+            scheduler=_cfg.get_str("pinot.server.query.scheduler"))
         # multi-stage worker endpoint (mailbox data plane + stage executor);
         # leaf aggregates route through the single-stage executor and its
         # shared device engine (ref QueryRunner.java:258)

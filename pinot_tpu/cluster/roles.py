@@ -268,6 +268,26 @@ class ServerRole:
         self._reconcile_lock = threading.Lock()
         #: per-role ops surface: /metrics + /debug/traces + /debug/queries
         self.admin_http = None
+        # admission memory shedding reuses the ingest accounting: the
+        # worst partition's non-durable bytes against the per-consumer
+        # budget (0 budget = never sheds on ingest memory)
+        self.executor.add_memory_pressure_source(self._ingest_pressure)
+
+    def _ingest_pressure(self) -> float:
+        """Worst per-partition ingest-memory fraction (mutable + sealed
+        pending-build bytes vs pinot.server.ingest.memory.bytes)."""
+        budget = self.config.get_int("pinot.server.ingest.memory.bytes")
+        if budget <= 0:
+            return 0.0
+        # lint: unlocked(point-in-time snapshot; dict ops are atomic under the GIL and a racing reconcile add only delays one pressure read)
+        managers = list(self._rt_managers.values())
+        worst = 0.0
+        for mgr in managers:
+            try:
+                worst = max(worst, mgr.ingest_bytes() / budget)
+            except Exception:  # noqa: BLE001 — a dying consumer must
+                pass           # not take admission down
+        return worst
 
     #: partition-discovery refresh interval
     RT_PARTITION_TTL_S = 30.0

@@ -1,0 +1,237 @@
+"""Brownout mode: the degradation ladder closing the SLO observe->act loop.
+
+PR 14 gave every role eyes — metrics history, the multi-window SLO
+burn-rate watchdog — but no hands: a breached SLO paged a human. This
+module is the actuator ("brownout": trade optional quality for capacity,
+Klein et al., ICSE 2014; DAGOR's cooperative degradation, SOSP 2018).
+Per role, a :class:`BrownoutController` runs as a metrics-sampler hook
+beside the watchdog and walks a four-rung ladder, cheapest sacrifice
+first:
+
+====  ================  ====================================================
+rung  name              effect while engaged (level >= rung)
+====  ================  ====================================================
+1     hedge_off         hedged scatter auto-disables (broker) — speculative
+                        duplicate load is the first thing to stop
+2     stale_cache       the broker result cache may serve entries up to
+                        ``pinot.brownout.stale.ttl.grace.seconds`` past
+                        TTL, flagged ``staleResult=true`` — stale beats
+                        shed for dashboard traffic
+3     batch_shrink      dispatch-ring batch windows shrink by
+                        ``pinot.brownout.batch.window.scale`` (server) —
+                        trade coalescing efficiency for queue latency
+4     shed_secondary    admission rejects secondary workloads whole
+                        (server) — primary traffic gets every thread
+====  ================  ====================================================
+
+Climb signal (either suffices): the role's SLO watchdog reports a
+sustained multi-window breach, OR the shed rate — admission rejections
+plus overload partials per query over the short history window — is at/
+over ``pinot.brownout.shed.rate.threshold``. Hysteresis both ways: one
+rung UP only after the signal has held ``pinot.brownout.up.seconds``
+since the last transition; one rung DOWN only after it has stayed clear
+(below HALF the entry threshold, and the watchdog quiet) for
+``pinot.brownout.down.seconds``. Transitions are logged onset-only
+(one ``BROWNOUT_TRANSITION`` JSON line per rung move, not per tick),
+metered (``brownout_transitions{direction=}``), gauged
+(``brownout_level``), and served in ``/debug/health`` (and therefore
+``/cluster/health``) via :func:`payload`.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from typing import Dict, Optional
+
+from pinot_tpu.utils.metrics import get_registry
+
+brownout_log = logging.getLogger("pinot_tpu.brownout")
+
+#: the ladder, cheapest sacrifice first; level N = rungs 1..N engaged
+RUNGS = ("hedge_off", "stale_cache", "batch_shrink", "shed_secondary")
+
+#: counter families in the shed-rate numerator / denominator, per role
+_SHED_FAMILIES = ("server_admission_rejected", "broker_overload_partials")
+_QUERY_FAMILIES = ("broker_queries", "queries")
+
+
+class BrownoutController:
+    """Walks the ladder for ONE role over that role's history +
+    watchdog. ``evaluate`` is the sampler hook; ``now`` is injectable
+    so hysteresis unit tests need no real sleeps."""
+
+    def __init__(self, role: str, history, config=None, watchdog=None,
+                 metrics=None):
+        from pinot_tpu.utils.config import PinotConfiguration
+        cfg = config or PinotConfiguration()
+        self.role = role
+        self.history = history
+        self._watchdog = watchdog
+        self._metrics = metrics if metrics is not None \
+            else get_registry(role)
+        self.enabled = cfg.get_bool("pinot.brownout.enabled", True)
+        self.shed_threshold = max(1e-6, cfg.get_float(
+            "pinot.brownout.shed.rate.threshold"))
+        self.up_s = max(0.0, cfg.get_float("pinot.brownout.up.seconds"))
+        self.down_s = max(0.0, cfg.get_float(
+            "pinot.brownout.down.seconds"))
+        self.window_s = max(1.0, cfg.get_float(
+            "pinot.slo.window.short.seconds"))
+        self.batch_window_scale = min(1.0, max(0.0, cfg.get_float(
+            "pinot.brownout.batch.window.scale")))
+        self.stale_grace_s = max(0.0, cfg.get_float(
+            "pinot.brownout.stale.ttl.grace.seconds"))
+        self._lock = threading.Lock()
+        self._level = 0
+        self._signal_since: Optional[float] = None
+        self._clear_since: Optional[float] = None
+        self._last_change = 0.0
+        self._last_shed_rate = 0.0
+        self._last_signal = False
+
+    # -- signal ---------------------------------------------------------
+    def _shed_rate(self, now: float) -> float:
+        shed = sum(self.history.counter_sum_delta(f, self.window_s,
+                                                  now=now)[0]
+                   for f in _SHED_FAMILIES)
+        queries = sum(self.history.counter_sum_delta(f, self.window_s,
+                                                     now=now)[0]
+                      for f in _QUERY_FAMILIES)
+        if queries <= 0:
+            return 0.0
+        return shed / queries
+
+    def _signal_locked(self, now: float) -> bool:
+        """True = degrade. Entry threshold for the shed rate; the
+        watchdog's own multi-window logic is its debounce."""
+        if self._watchdog is not None and self._watchdog.breached():
+            return True
+        return self._last_shed_rate >= self.shed_threshold
+
+    def _clear_locked(self, now: float) -> bool:
+        """True = recovery evidence. HALF the entry threshold (classic
+        hysteresis: the exit bar is lower than the entry bar, so a
+        shed rate hovering at the threshold cannot flap the ladder)."""
+        if self._watchdog is not None and self._watchdog.breached():
+            return False
+        return self._last_shed_rate < 0.5 * self.shed_threshold
+
+    # -- evaluation (sampler hook) --------------------------------------
+    def evaluate(self, now: Optional[float] = None) -> int:
+        if not self.enabled:
+            return 0
+        now = now if now is not None else time.time()
+        shed_rate = self._shed_rate(now)
+        with self._lock:
+            self._last_shed_rate = shed_rate
+            sig = self._signal_locked(now)
+            clear = self._clear_locked(now)
+            self._last_signal = sig
+            if sig:
+                self._clear_since = None
+                if self._signal_since is None:
+                    self._signal_since = now
+                if self._level < len(RUNGS) \
+                        and now - self._signal_since >= self.up_s \
+                        and now - self._last_change >= self.up_s:
+                    self._move_locked(+1, now, shed_rate)
+            elif clear:
+                self._signal_since = None
+                if self._clear_since is None:
+                    self._clear_since = now
+                if self._level > 0 \
+                        and now - self._clear_since >= self.down_s \
+                        and now - self._last_change >= self.down_s:
+                    self._move_locked(-1, now, shed_rate)
+            else:
+                # between the exit and entry thresholds: hold the rung,
+                # reset both hysteresis clocks
+                self._signal_since = None
+                self._clear_since = None
+            level = self._level
+        self._metrics.set_gauge("brownout_level", level)
+        return level
+
+    def _move_locked(self, step: int, now: float,
+                     shed_rate: float) -> None:
+        self._level += step
+        self._last_change = now
+        # re-arm the hysteresis clocks so multi-rung moves each take a
+        # full sustain period
+        self._signal_since = now if step > 0 else None
+        self._clear_since = now if step < 0 else None
+        direction = "up" if step > 0 else "down"
+        self._metrics.add_meter("brownout_transitions",
+                                labels={"direction": direction})
+        brownout_log.warning("BROWNOUT_TRANSITION %s", json.dumps({
+            "role": self.role, "direction": direction,
+            "level": self._level,
+            "rung": RUNGS[self._level - 1] if self._level else None,
+            "shedRate": round(shed_rate, 4),
+            "sloBreached": bool(self._watchdog is not None
+                                and self._watchdog.breached())},
+            default=str))
+
+    # -- read side ------------------------------------------------------
+    def level(self) -> int:
+        with self._lock:
+            return self._level
+
+    def engaged(self, rung: str) -> bool:
+        idx = RUNGS.index(rung) + 1
+        with self._lock:
+            return self.enabled and self._level >= idx
+
+    def payload(self) -> dict:
+        """The /debug/health brownout subsystem verdict."""
+        with self._lock:
+            level = self._level
+            shed = self._last_shed_rate
+            sig = self._last_signal
+        return {
+            "ok": level == 0,
+            "level": level,
+            "rung": RUNGS[level - 1] if level else None,
+            "engaged": list(RUNGS[:level]),
+            "shedRate": round(shed, 4),
+            "signal": sig,
+        }
+
+
+# -- per-role singletons (populated by history.start_sampling) ---------------
+_controllers: Dict[str, BrownoutController] = {}
+_lock = threading.Lock()
+
+
+def get_brownout(role: str = "server") -> Optional[BrownoutController]:
+    with _lock:
+        return _controllers.get(role)
+
+
+def _register_brownout(role: str,
+                       ctrl: Optional[BrownoutController]) -> None:
+    with _lock:
+        if ctrl is None:
+            _controllers.pop(role, None)
+        else:
+            _controllers[role] = ctrl
+
+
+def engaged(role: str, rung: str) -> bool:
+    """Actuation predicate the hot paths call: False when no controller
+    is registered (no sampler running) or the rung is above the current
+    level — so with brownout absent everything behaves exactly as
+    before."""
+    ctrl = get_brownout(role)
+    return ctrl is not None and ctrl.engaged(rung)
+
+
+def window_scale(role: str = "server") -> float:
+    """Dispatch batch-window multiplier: 1.0 normally, the configured
+    shrink factor while the ``batch_shrink`` rung is engaged."""
+    ctrl = get_brownout(role)
+    if ctrl is None or not ctrl.engaged("batch_shrink"):
+        return 1.0
+    return ctrl.batch_window_scale

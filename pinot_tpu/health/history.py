@@ -239,6 +239,15 @@ def start_sampling(role: str, config=None) -> Optional[MetricsSampler]:
     dog = SloWatchdog(role, sampler.history, config=cfg)
     _register_watchdog(role, dog)
     sampler.add_hook(dog.evaluate)
+    # the SLO observe->act loop: the brownout ladder evaluates AFTER
+    # the watchdog each tick, so it acts on this tick's verdicts
+    if cfg.get_bool("pinot.brownout.enabled", True):
+        from pinot_tpu.health.brownout import (BrownoutController,
+                                               _register_brownout)
+        ctrl = BrownoutController(role, sampler.history, config=cfg,
+                                  watchdog=dog)
+        _register_brownout(role, ctrl)
+        sampler.add_hook(ctrl.evaluate)
     sampler.start()
     return sampler
 
@@ -248,5 +257,7 @@ def stop_sampling(role: str) -> None:
         sampler = _samplers.pop(role, None)
     if sampler is not None:
         sampler.stop()
+    from pinot_tpu.health.brownout import _register_brownout
     from pinot_tpu.health.slo import _register_watchdog
     _register_watchdog(role, None)
+    _register_brownout(role, None)

@@ -96,6 +96,14 @@ def role_health_summary(role: str, config=None,
     slo_breached = any(v.get("breached") for v in slo_verdicts.values())
     subsystems["slo"] = {"ok": not slo_breached, "targets": slo_verdicts}
 
+    # brownout ladder (health/brownout.py): any engaged rung means the
+    # role is deliberately degraded — visible here and, through the
+    # sweep, in /cluster/health
+    from pinot_tpu.health.brownout import get_brownout
+    ctrl = get_brownout(role)
+    if ctrl is not None:
+        subsystems["brownout"] = ctrl.payload()
+
     degraded = [name for name, sub in subsystems.items()
                 if not sub.get("ok", True)]
     return {

@@ -25,7 +25,7 @@ from pinot_tpu.mse.runtime import MseWorker, ScanFn, StageContext, run_stage
 from pinot_tpu.mse.sql import parse_mse_sql
 from pinot_tpu.query.reduce import BrokerResponse, ResultTable
 from pinot_tpu.query.results import ExecutionStats
-from pinot_tpu.utils import tracing
+from pinot_tpu.utils import errorcodes, tracing
 from pinot_tpu.utils.accounting import (
     BrokerTimeoutError, QueryCancelledError)
 from pinot_tpu.utils.failpoints import fire
@@ -398,7 +398,7 @@ class QueryDispatcher:
         except Exception as e:  # noqa: BLE001 — broker answers, never dies
             resp = BrokerResponse(
                 result_table=None,
-                exceptions=[{"errorCode": 200,
+                exceptions=[{"errorCode": errorcodes.QUERY_EXECUTION,
                              "message": f"{type(e).__name__}: {e}"}],
                 stats=ExecutionStats())
             resp.time_used_ms = (time.time() - start) * 1000.0

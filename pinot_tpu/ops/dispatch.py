@@ -460,11 +460,17 @@ class KernelDispatcher:
 
     def current_window_s(self) -> float:
         """The coalesce wait in effect: static knob, or the clamped
-        inter-arrival EWMA under window.ms=auto."""
+        inter-arrival EWMA under window.ms=auto — scaled down while the
+        brownout ladder's batch_shrink rung is engaged
+        (health/brownout.py): under overload, queue latency buys more
+        goodput than coalescing efficiency."""
+        from pinot_tpu.health.brownout import window_scale
+        scale = window_scale("server")
         if not self.window_auto or self._arrival_ewma_s is None:
-            return self.window_s
-        return min(self._window_ceil_s,
-                   max(self._window_floor_s, self._arrival_ewma_s))
+            return self.window_s * scale
+        return scale * min(self._window_ceil_s,
+                           max(self._window_floor_s,
+                               self._arrival_ewma_s))
 
     # -- submission ----------------------------------------------------
     def submit(self, launch: Launch) -> Future:

@@ -52,6 +52,11 @@ class BrokerResponse:
     #: mid-query, or segments had no surviving replica) — the exceptions
     #: list carries the why (ref BrokerResponseNative partialResult)
     partial_result: bool = False
+    #: True when the answer was served from the result cache PAST its
+    #: TTL under brownout (health/brownout.py rung 2): correct as of
+    #: when it was cached, knowingly stale now — clients choose whether
+    #: stale beats failed
+    stale_result: bool = False
 
     def to_dict(self) -> dict:
         d = {
@@ -70,6 +75,7 @@ class BrokerResponse:
             "timeUsedMs": self.time_used_ms,
             "cacheHit": self.cache_hit,
             "partialResult": self.partial_result,
+            "staleResult": self.stale_result,
         }
         if self.trace is not None:
             d["traceInfo"] = self.trace

@@ -1,0 +1,276 @@
+"""Server admission control: reject early, reject cheap.
+
+The overload-protection policy layer at the query transport edge
+("The Tail at Scale", Dean & Barroso, CACM 2013; DAGOR in "Overload
+Control for Scaling WeChat Microservices", SOSP 2018). Before a request
+enters the scheduler, :meth:`AdmissionController.admit` decides whether
+the server can plausibly answer it inside its deadline budget; a
+rejection costs one dict of work and surfaces as a typed errorCode-211
+entry with a ``retryAfterMs=`` drain hint, instead of the query
+queueing toward a guaranteed errorCode-250 after consuming a worker
+thread.
+
+Decision order (first hit wins), all O(1):
+
+1. **chaos** — the ``server.admission.reject`` failpoint (seeded,
+   journal-replayable) may force a rejection;
+2. **workload** — under full brownout (health/brownout.py rung
+   ``shed_secondary``) secondary workloads are shed whole;
+3. **memory** — HBM/host memory pressure (the residency tier's bytes
+   against its budget plus any registered source, e.g. realtime-ingest
+   bytes against ``pinot.server.ingest.memory.bytes``) at/over the
+   threshold sheds new work before the allocators do it the hard way;
+4. **queue** — the bounded queue is full (the schedulers enforce the
+   same bound internally as a race backstop);
+5. **deadline** — the query's remaining budget is below the
+   EWMA-estimated queue wait + execution time: it WILL miss, so fail it
+   now in O(1) (deadline-aware admission, the PR-3 pick-up guard moved
+   to the front door);
+6. **tenant** — past ``shed.start`` queue occupancy, tenants shed
+   lowest-weight-first: the occupancy-scaled weight cutoff rises toward
+   the heaviest tenant's weight as the queue fills (DAGOR's
+   business-priority shedding over the existing
+   TokenPriorityScheduler weights).
+
+Estimates feed from :class:`_Ticket` hooks the transport wraps around
+every admitted query (queue wait observed at pick-up, execution wall
+time at completion), so the controller needs no scheduler internals.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from pinot_tpu.utils.accounting import ServerOverloadedError
+from pinot_tpu.utils.failpoints import FailpointError, fire
+
+#: retry-after hint clamps: never tell a client "now" (it would tight-
+#: loop) and never park it for more than 5s (the fleet drains faster)
+_MIN_RETRY_AFTER_MS = 10.0
+_MAX_RETRY_AFTER_MS = 5000.0
+
+
+def _clamp_hint(ms: float) -> float:
+    return min(_MAX_RETRY_AFTER_MS, max(_MIN_RETRY_AFTER_MS, ms))
+
+
+class _Ticket:
+    """In-flight accounting handle for ONE admitted query: registered at
+    submit, released exactly once when its future resolves (done
+    callback), with queue-wait/exec observations recorded from the
+    worker thread in between. ``run`` is the worker-side wrapper the
+    transport submits."""
+
+    __slots__ = ("_ctrl", "_submit_t", "_start_t", "_released")
+
+    def __init__(self, ctrl: "AdmissionController"):
+        self._ctrl = ctrl
+        self._submit_t = time.monotonic()
+        self._start_t: Optional[float] = None
+        self._released = False
+
+    def run(self, fn):
+        """Execute fn on the worker thread, recording the observed queue
+        wait (submit -> pick-up) and execution wall time. Runs only for
+        queries that survived the deadline guard, so the EWMAs are fed
+        by genuine executions, not by O(1) pick-up kills."""
+        self._start_t = time.monotonic()
+        self._ctrl._note_wait(self._start_t - self._submit_t)
+        try:
+            return fn()
+        finally:
+            self._ctrl._note_exec(time.monotonic() - self._start_t)
+
+    def release(self) -> None:
+        """Idempotent in-flight decrement — wired as the future's done
+        callback so cancelled/never-run submissions can't leak the
+        count."""
+        ctrl = self._ctrl
+        with ctrl._lock:
+            if self._released:
+                return
+            self._released = True
+            ctrl._inflight -= 1
+
+
+class AdmissionController:
+    def __init__(self, num_threads: int = 8, enabled: bool = True,
+                 queue_limit: int = 128, shed_start: float = 0.5,
+                 memory_threshold: float = 0.95, ewma_alpha: float = 0.2,
+                 tenant_weights_fn: Optional[Callable[[], Dict[str, float]]]
+                 = None,
+                 memory_pressure_fn: Optional[Callable[[], float]] = None,
+                 metrics=None, labels: Optional[dict] = None):
+        self.enabled = bool(enabled)
+        self.num_threads = max(1, int(num_threads))
+        self.queue_limit = max(0, int(queue_limit))
+        self.shed_start = min(1.0, max(0.0, float(shed_start)))
+        self.memory_threshold = float(memory_threshold)
+        self.alpha = min(1.0, max(0.01, float(ewma_alpha)))
+        self._tenant_weights_fn = tenant_weights_fn
+        self._memory_pressure_fn = memory_pressure_fn
+        self._metrics = metrics
+        self._labels = labels
+        self._lock = threading.Lock()
+        self._inflight = 0
+        self._exec_ewma_s: Optional[float] = None
+        self._wait_ewma_s: Optional[float] = None
+        #: memoized memory pressure (the fn may sum per-partition ingest
+        #: bytes — cheap, but not per-request cheap at 10k qps)
+        self._pressure = 0.0
+        self._pressure_at = 0.0
+
+    PRESSURE_TTL_S = 0.1
+
+    @classmethod
+    def from_config(cls, config, num_threads: int = 8,
+                    **kwargs) -> "AdmissionController":
+        if config is None:
+            return cls(num_threads=num_threads, **kwargs)
+        return cls(
+            num_threads=num_threads,
+            enabled=config.get_bool("pinot.server.admission.enabled", True),
+            queue_limit=config.get_int("pinot.server.admission.queue.limit"),
+            shed_start=config.get_float("pinot.server.admission.shed.start"),
+            memory_threshold=config.get_float(
+                "pinot.server.admission.memory.threshold"),
+            ewma_alpha=config.get_float(
+                "pinot.server.admission.exec.ewma.alpha"),
+            **kwargs)
+
+    # -- estimate feeds -------------------------------------------------
+    def _note_wait(self, wait_s: float) -> None:
+        with self._lock:
+            cur = self._wait_ewma_s
+            self._wait_ewma_s = wait_s if cur is None else \
+                (1 - self.alpha) * cur + self.alpha * wait_s
+
+    def _note_exec(self, exec_s: float) -> None:
+        with self._lock:
+            cur = self._exec_ewma_s
+            self._exec_ewma_s = exec_s if cur is None else \
+                (1 - self.alpha) * cur + self.alpha * exec_s
+
+    def register(self) -> _Ticket:
+        t = _Ticket(self)
+        with self._lock:
+            self._inflight += 1
+        return t
+
+    # -- introspection (tests + /debug) --------------------------------
+    def snapshot(self) -> dict:
+        with self._lock:
+            queued = max(0, self._inflight - self.num_threads)
+            return {"inflight": self._inflight, "queued": queued,
+                    "execEwmaMs": (None if self._exec_ewma_s is None
+                                   else round(self._exec_ewma_s * 1e3, 3)),
+                    "waitEwmaMs": (None if self._wait_ewma_s is None
+                                   else round(self._wait_ewma_s * 1e3, 3))}
+
+    # -- the decision ---------------------------------------------------
+    def _reject(self, reason_label: str, message: str,
+                retry_after_ms: float) -> ServerOverloadedError:
+        if self._metrics is not None:
+            labels = dict(self._labels or {})
+            labels["reason"] = reason_label
+            self._metrics.add_meter("server_admission_rejected",
+                                    labels=labels)
+        return ServerOverloadedError(message,
+                                     retry_after_ms=_clamp_hint(
+                                         retry_after_ms))
+
+    def memory_pressure(self) -> float:
+        """Memoized worst-of pressure fraction from the wired source."""
+        fn = self._memory_pressure_fn
+        if fn is None:
+            return 0.0
+        now = time.monotonic()
+        with self._lock:
+            if now - self._pressure_at < self.PRESSURE_TTL_S:
+                return self._pressure
+        try:
+            p = float(fn())
+        except Exception:  # noqa: BLE001 — a broken gauge must not
+            p = 0.0        # take admission (and with it the server) down
+        with self._lock:
+            self._pressure = p
+            self._pressure_at = now
+        return p
+
+    def admit(self, table: str = "", tenant: Optional[str] = None,
+              workload: str = "primary",
+              deadline: Optional[float] = None,
+              now: Optional[float] = None
+              ) -> Optional[ServerOverloadedError]:
+        """None = admitted; otherwise the typed rejection to answer
+        with. Never raises — chaos-forced rejections are returned like
+        policy ones so the transport has exactly one rejection path."""
+        try:
+            fire("server.admission.reject", table=table,
+                 tenant=tenant or "", workload=workload)
+        except (ServerOverloadedError, FailpointError) as e:
+            retry = getattr(e, "retry_after_ms", 0.0)
+            return self._reject("chaos", f"chaos rejection: {e}", retry)
+        if not self.enabled:
+            return None
+        if workload == "secondary":
+            from pinot_tpu.health.brownout import engaged
+            if engaged("server", "shed_secondary"):
+                return self._reject(
+                    "workload",
+                    "secondary workloads shed under brownout", 1000.0)
+        pressure = self.memory_pressure()
+        if self.memory_threshold > 0 and pressure >= self.memory_threshold:
+            return self._reject(
+                "memory",
+                f"memory pressure {pressure:.2f} >= "
+                f"{self.memory_threshold:.2f}", 250.0)
+        with self._lock:
+            queued = max(0, self._inflight - self.num_threads)
+            exec_s = self._exec_ewma_s
+            wait_s = self._wait_ewma_s
+        # estimated wait ahead of a NEW arrival: everything queued, one
+        # service time at a time across the worker pool — blended with
+        # the observed-wait EWMA so a mis-modeled scheduler (priority
+        # buckets, binary pools) still converges on reality. The blend
+        # applies ONLY while a queue exists: the EWMAs are fed by
+        # executed queries, so if the observed wait froze high and kept
+        # rejecting everything, nothing would ever run to pull it back
+        # down — an empty queue means zero wait, whatever history says.
+        est_wait_s = 0.0
+        if exec_s is not None and queued > 0:
+            est_wait_s = queued * exec_s / self.num_threads
+            if wait_s is not None:
+                est_wait_s = max(est_wait_s, wait_s)
+        if self.queue_limit and queued >= self.queue_limit:
+            return self._reject(
+                "queue",
+                f"admission queue full ({queued} >= {self.queue_limit})",
+                est_wait_s * 1e3 or 100.0)
+        if deadline is not None and exec_s is not None:
+            remaining_s = deadline - (now if now is not None
+                                      else time.time())
+            need_s = est_wait_s + exec_s
+            if remaining_s < need_s:
+                return self._reject(
+                    "deadline",
+                    f"remaining budget {remaining_s * 1e3:.0f}ms < "
+                    f"estimated wait+exec {need_s * 1e3:.0f}ms",
+                    (need_s - remaining_s) * 1e3)
+        if self.queue_limit and queued / self.queue_limit > self.shed_start \
+                and self._tenant_weights_fn is not None:
+            weights = self._tenant_weights_fn()
+            if weights:
+                occupancy = min(1.0, queued / self.queue_limit)
+                frac = (occupancy - self.shed_start) \
+                    / max(1e-9, 1.0 - self.shed_start)
+                cutoff = frac * max(weights.values())
+                w = weights.get(tenant or "", 1.0) if tenant else 1.0
+                if w < cutoff:
+                    return self._reject(
+                        "tenant",
+                        f"tenant weight {w:g} below shed cutoff "
+                        f"{cutoff:.2f} at {occupancy:.0%} occupancy",
+                        est_wait_s * 1e3 or 250.0)
+        return None

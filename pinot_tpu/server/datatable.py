@@ -22,6 +22,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from pinot_tpu.utils import errorcodes
 from pinot_tpu.query.aggregation.sketches import (
     HyperLogLog, KLLSketch, TDigest, ThetaSketch)
 from pinot_tpu.query.results import (
@@ -334,7 +335,8 @@ def deserialize_results_ex(buf: bytes) -> Tuple[
 
 
 def _exc_tuple(e: dict) -> tuple:
-    return (int(e.get("errorCode", 200)), str(e.get("message", "")))
+    return (int(e.get("errorCode", errorcodes.QUERY_EXECUTION)),
+            str(e.get("message", "")))
 
 
 def _exc_from(t: tuple) -> dict:

@@ -26,9 +26,11 @@ from pinot_tpu.query.context import QueryContext
 from pinot_tpu.query.executor import QueryExecutor
 from pinot_tpu.server import datatable
 from pinot_tpu.server.data_manager import InstanceDataManager, TableDataManager
+from pinot_tpu.utils import errorcodes
 from pinot_tpu.utils.accounting import (BrokerTimeoutError,
                                         QueryCancelledError,
-                                        ResourceAccountant)
+                                        ResourceAccountant,
+                                        ServerOverloadedError)
 from pinot_tpu.utils.failpoints import fire
 
 _LEN = struct.Struct("<I")
@@ -47,6 +49,21 @@ def _timeout_response(e: BaseException) -> bytes:
     return datatable.serialize_results(
         [], [{"errorCode": BrokerTimeoutError.ERROR_CODE,
               "message": f"BrokerTimeoutError: {e}"}])
+
+
+def _overload_response(e: ServerOverloadedError) -> bytes:
+    """The typed admission-rejection payload: errorCode-211, no
+    results, the drain hint embedded in the message (the exception wire
+    format is (code, message) tuples — see datatable._exc_tuple — so
+    the hint travels in-band, formatted/parsed through the shared
+    errorcodes helpers). The hint is floored even for scheduler-
+    backstop rejections that carry retry_after_ms=0 — a client told
+    "retry now" would tight-loop against the saturated server."""
+    hint = errorcodes.format_retry_after(max(10.0, e.retry_after_ms))
+    return datatable.serialize_results(
+        [], [{"errorCode": ServerOverloadedError.ERROR_CODE,
+              "message": f"ServerOverloadedError: {e.reason or e} "
+                         f"{hint}"}])
 
 
 class ServerQueryExecutor:
@@ -99,6 +116,10 @@ class ServerQueryExecutor:
         #: cache, which must survive across requests
         self._engine = None
         self._engine_lock = threading.Lock()
+        #: extra memory-pressure inputs for admission (0..1 fractions):
+        #: ServerRole registers realtime-ingest bytes vs budget here;
+        #: the residency tier is consulted built-in (memory_pressure)
+        self._pressure_sources = []
         #: tier-2 per-segment partial-result cache — shared across requests
         #: for the same reason as the engine. Version-keyed entries go
         #: stale-unaddressable on replace; the data-manager hook below
@@ -237,6 +258,33 @@ class ServerQueryExecutor:
                 out[table] = total
         return out
 
+    def add_memory_pressure_source(self, fn) -> None:
+        """Register a () -> 0..1 fraction the admission controller folds
+        into its memory-pressure decision (worst-of across sources)."""
+        self._pressure_sources.append(fn)
+
+    def memory_pressure(self) -> float:
+        """Worst-of memory-pressure fraction across this server's
+        accountings: the HBM residency tier's bytes against its budget,
+        plus every registered source (realtime-ingest bytes against the
+        ingest memory budget, wired by ServerRole). 0.0 when nothing is
+        budgeted — an unbudgeted server never sheds on memory."""
+        worst = 0.0
+        # lint: unlocked(reference snapshot; _shared_engine publishes the engine once under its lock and never unsets it)
+        engine = self._engine
+        res = getattr(engine, "_residency", None) \
+            if engine is not None else None
+        if res is not None and getattr(res, "enabled", False):
+            budget = getattr(res, "budget_bytes", 0)
+            if budget > 0:
+                worst = max(worst, res.bytes() / budget)
+        for fn in list(self._pressure_sources):
+            try:
+                worst = max(worst, float(fn()))
+            except Exception:  # noqa: BLE001 — a broken source must not
+                pass           # take admission down with it
+        return worst
+
     def cancel(self, query_id) -> bool:
         """Broker-initiated cancel (rides ResourceAccountant.cancel): the
         next cooperative check in the query's segment loop raises and the
@@ -370,7 +418,8 @@ class ServerQueryExecutor:
             tdm = self.data_manager.table(table_name, create=False)
             if tdm is None:
                 return datatable.serialize_results(
-                    [], [{"errorCode": 190, "message": f"table {table_name} not found"}])
+                    [], [{"errorCode": errorcodes.TABLE_DOES_NOT_EXIST,
+                          "message": f"table {table_name} not found"}])
             sdms = tdm.acquire_segments(segments)
             try:
                 ex = QueryExecutor([s.segment for s in sdms],
@@ -407,7 +456,8 @@ class ServerQueryExecutor:
             error = True
             metrics.add_meter("query_exceptions", labels={"table": table_name})
             return datatable.serialize_results(
-                [], [{"errorCode": 200, "message": f"{type(e).__name__}: {e}"}])
+                [], [{"errorCode": errorcodes.QUERY_EXECUTION,
+                      "message": f"{type(e).__name__}: {e}"}])
         finally:
             if qid is not None:
                 usage = self.accountant.finish_query(qid)
@@ -442,7 +492,7 @@ class ServerQueryExecutor:
             tdm = self.data_manager.table(table_name, create=False)
             if tdm is None:
                 yield datatable.serialize_results(
-                    [], [{"errorCode": 190,
+                    [], [{"errorCode": errorcodes.TABLE_DOES_NOT_EXIST,
                           "message": f"table {table_name} not found"}])
                 return
             sdms = tdm.acquire_segments(segments)
@@ -461,7 +511,7 @@ class ServerQueryExecutor:
                 TableDataManager.release_all(sdms)
         except Exception as e:  # noqa: BLE001
             yield datatable.serialize_results(
-                [], [{"errorCode": 200,
+                [], [{"errorCode": errorcodes.QUERY_EXECUTION,
                       "message": f"{type(e).__name__}: {e}"}])
 
 
@@ -471,6 +521,7 @@ class QueryServer:
     def __init__(self, executor: ServerQueryExecutor, host: str = "127.0.0.1",
                  port: int = 0, num_threads: int = 8,
                  scheduler: str = "fcfs"):
+        from pinot_tpu.server.admission import AdmissionController
         from pinot_tpu.server.scheduler import make_scheduler
         from pinot_tpu.utils.metrics import get_registry
         self.executor = executor
@@ -482,6 +533,18 @@ class QueryServer:
             scheduler, num_threads, metrics=get_registry("server"),
             labels={"instance": executor.data_manager.instance_id})
         self.scheduler.start()
+        #: overload protection at the transport edge (server/admission.py):
+        #: deadline-aware, memory-aware, tenant-weighted rejection BEFORE
+        #: the scheduler queue; the scheduler's own bounded queue is the
+        #: backstop for submissions racing the controller's estimate
+        self.admission = AdmissionController.from_config(
+            executor.config, num_threads=num_threads,
+            tenant_weights_fn=self.scheduler.tenant_weights,
+            memory_pressure_fn=executor.memory_pressure,
+            metrics=get_registry("server"),
+            labels={"instance": executor.data_manager.instance_id})
+        self.scheduler.set_queue_limit(
+            self.admission.queue_limit if self.admission.enabled else 0)
         self._server: Optional[asyncio.AbstractServer] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._thread: Optional[threading.Thread] = None
@@ -514,6 +577,23 @@ class QueryServer:
                 deadline = (time.time() + float(timeout_ms) / 1000.0
                             + self.executor.deadline_grace_s
                             if timeout_ms else None)
+                # -- admission: reject in O(1) BEFORE the scheduler when
+                # the query cannot plausibly answer inside its budget
+                # (queue full / deadline unservable / memory pressure /
+                # shed priority class) — a typed 211 with a retry-after
+                # hint, having consumed no worker thread
+                rejection = self.admission.admit(
+                    table=req.get("tableName", ""),
+                    tenant=req.get("tenant"),
+                    workload=req.get("workload", "primary"),
+                    deadline=deadline)
+                if rejection is not None:
+                    resp = _overload_response(rejection)
+                    writer.write(_LEN.pack(len(resp)) + resp)
+                    if req.get("streaming"):
+                        writer.write(_LEN.pack(0))  # EOS
+                    await writer.drain()
+                    continue
                 if req.get("streaming"):
                     # per-block response stream (ref GrpcQueryServer.Submit
                     # server-stream): generator creation is cheap; EACH
@@ -524,12 +604,24 @@ class QueryServer:
                         req["tableName"], req["sql"], req.get("segments"),
                         req.get("extraFilter"))
                     while True:
-                        fut = self.scheduler.submit(
-                            lambda g=gen: next(g, None),
-                            table=req.get("tableName", ""),
-                            workload=req.get("workload", "primary"),
-                            deadline=deadline,
-                            tenant=req.get("tenant"))
+                        ticket = self.admission.register()
+                        try:
+                            fut = self.scheduler.submit(
+                                lambda g=gen, t=ticket:
+                                t.run(lambda: next(g, None)),
+                                table=req.get("tableName", ""),
+                                workload=req.get("workload", "primary"),
+                                deadline=deadline,
+                                tenant=req.get("tenant"))
+                        except ServerOverloadedError as e:
+                            # the scheduler's bounded-queue backstop won
+                            # the race against the admission estimate
+                            ticket.release()
+                            frame = _overload_response(e)
+                            writer.write(_LEN.pack(len(frame)) + frame)
+                            break
+                        fut.add_done_callback(
+                            lambda _f, t=ticket: t.release())
                         try:
                             frame = await asyncio.wrap_future(fut)
                         except (QueryCancelledError, BrokerTimeoutError) as e:
@@ -544,19 +636,28 @@ class QueryServer:
                     await writer.drain()
                     continue
                 arrival = time.time()
-                fut = self.scheduler.submit(
-                    lambda r=req, d=deadline, a=arrival:
-                    self.executor.execute(
-                        r["tableName"], r["sql"], r.get("segments"),
-                        r.get("extraFilter"),
-                        query_id=r.get("queryId") or r.get("requestId"),
-                        timeout_ms=r.get("timeoutMs"), deadline=d,
-                        trace_ctx=r.get("traceContext"), arrival_s=a,
-                        tenant=r.get("tenant")),
-                    table=req.get("tableName", ""),
-                    workload=req.get("workload", "primary"),
-                    deadline=deadline,
-                    tenant=req.get("tenant"))
+                ticket = self.admission.register()
+                try:
+                    fut = self.scheduler.submit(
+                        lambda r=req, d=deadline, a=arrival, t=ticket:
+                        t.run(lambda: self.executor.execute(
+                            r["tableName"], r["sql"], r.get("segments"),
+                            r.get("extraFilter"),
+                            query_id=r.get("queryId") or r.get("requestId"),
+                            timeout_ms=r.get("timeoutMs"), deadline=d,
+                            trace_ctx=r.get("traceContext"), arrival_s=a,
+                            tenant=r.get("tenant"))),
+                        table=req.get("tableName", ""),
+                        workload=req.get("workload", "primary"),
+                        deadline=deadline,
+                        tenant=req.get("tenant"))
+                except ServerOverloadedError as e:
+                    ticket.release()
+                    resp = _overload_response(e)
+                    writer.write(_LEN.pack(len(resp)) + resp)
+                    await writer.drain()
+                    continue
+                fut.add_done_callback(lambda _f, t=ticket: t.release())
                 try:
                     resp = await asyncio.wrap_future(fut)
                 except (QueryCancelledError, BrokerTimeoutError) as e:
@@ -619,17 +720,45 @@ class QueryServer:
 
 
 class ServerConnection:
-    """Broker-side long-lived channel to one server (ref ServerChannels:65)."""
+    """Broker-side channel POOL to one server (ref ServerChannels:65).
 
-    def __init__(self, host: str, port: int):
+    The original single-socket channel held its lock for the whole
+    request round trip, which silently serialized scatter concurrency
+    to ONE in-flight request per (broker, server) pair — the server's
+    scheduler queue (where admission control watches) could never form,
+    and the real overload queue hid inside a broker-side lock nobody
+    measures. Now each request takes its own socket: up to
+    ``pool_size`` idle sockets are retained for reuse, an empty pool
+    dials fresh, so per-server concurrency is bounded by the fan-out
+    pool (the intended bound), not by channel serialization."""
+
+    #: idle sockets retained per server (concurrency itself is bounded
+    #: by the broker's fan-out pool, not by this)
+    POOL_SIZE = 4
+
+    def __init__(self, host: str, port: int,
+                 pool_size: Optional[int] = None):
         self.host, self.port = host, port
-        self._sock: Optional[socket.socket] = None
+        self._idle: List[socket.socket] = []
         self._lock = threading.Lock()
+        self.pool_size = pool_size if pool_size is not None \
+            else self.POOL_SIZE
 
-    def _connect(self) -> socket.socket:
-        if self._sock is None:
-            self._sock = socket.create_connection((self.host, self.port), timeout=30)
-        return self._sock
+    def _take(self) -> tuple:
+        """(socket, was_pooled). A pooled socket may be stale (server
+        restarted since); callers retry once on a fresh dial."""
+        with self._lock:
+            if self._idle:
+                return self._idle.pop(), True
+        return socket.create_connection((self.host, self.port),
+                                        timeout=30), False
+
+    def _give(self, sock: socket.socket) -> None:
+        with self._lock:
+            if len(self._idle) < self.pool_size:
+                self._idle.append(sock)
+                return
+        _close_quietly(sock)
 
     def request(self, table_name: str, sql: str,
                 segments: Optional[List[str]] = None,
@@ -639,7 +768,7 @@ class ServerConnection:
                 query_id=None, tenant: Optional[str] = None,
                 trace_ctx: Optional[dict] = None) -> bytes:
         """timeout_ms: remaining query budget, shipped to the server AND
-        used as this channel's read timeout (+grace) so a dead server
+        used as this socket's read timeout (+grace) so a dead server
         can't pin a broker fan-out thread past the deadline. tenant:
         the weighted-fair scheduling group the server charges this
         query's wall time to (from TableConfig tenant tags). trace_ctx:
@@ -650,26 +779,36 @@ class ServerConnection:
             "segments": segments, "extraFilter": extra_filter,
             "timeoutMs": timeout_ms, "tenant": tenant,
             "queryId": query_id, "traceContext": trace_ctx}).encode()
-        with self._lock:
+        sock, pooled = self._take()
+        try:
+            self._set_timeout(sock, timeout_ms)
+            sock.sendall(_LEN.pack(len(payload)) + payload)
+            resp = self._read_frame(sock)
+        except socket.timeout:
+            # a slow query, NOT a dead channel: retransmitting would run
+            # it twice server-side; drop the socket and surface the
+            # timeout (ref: the reference fails the query, the failure
+            # detector handles the server)
+            _close_quietly(sock)
+            raise
+        except ConnectionError:
+            # one retry on a FRESH dial (ref channel re-establish) —
+            # pooled sockets go stale across server restarts, and even
+            # a fresh socket gets the one reconnect the old channel had
+            _close_quietly(sock)
+            sock = socket.create_connection((self.host, self.port),
+                                            timeout=30)
             try:
-                sock = self._connect()
                 self._set_timeout(sock, timeout_ms)
                 sock.sendall(_LEN.pack(len(payload)) + payload)
-                return self._fire_response(self._read_frame(sock))
-            except socket.timeout:
-                # a slow query, NOT a dead channel: retransmitting would run
-                # it twice server-side; drop the channel and surface the
-                # timeout (ref: the reference fails the query, the failure
-                # detector handles the server)
-                self.close()
+                resp = self._read_frame(sock)
+            except (socket.timeout, ConnectionError):
+                _close_quietly(sock)
                 raise
-            except ConnectionError:
-                # one reconnect attempt (ref channel re-establish)
-                self.close()
-                sock = self._connect()
-                self._set_timeout(sock, timeout_ms)
-                sock.sendall(_LEN.pack(len(payload)) + payload)
-                return self._fire_response(self._read_frame(sock))
+        # return the (clean — full frame read) socket BEFORE the chaos
+        # hook: an armed torn/error policy must not leak the socket
+        self._give(sock)
+        return self._fire_response(resp)
 
     def _fire_response(self, payload: bytes) -> bytes:
         """Chaos site on the response payload: torn bytes here exercise
@@ -704,29 +843,30 @@ class ServerConnection:
                           extra_filter: Optional[str] = None):
         """Generator of per-block DataTable payloads until the server's
         zero-length EOS frame (ref GrpcQueryServer server-stream). The
-        channel lock is held for the whole stream — frames of one query
-        must not interleave with another request's."""
+        stream owns its socket exclusively — frames of one query cannot
+        interleave with another request's."""
         payload = json.dumps({
             "requestId": request_id, "tableName": table_name, "sql": sql,
             "segments": segments, "extraFilter": extra_filter,
             "streaming": True}).encode()
-        with self._lock:
-            completed = False
-            try:
-                sock = self._connect()
-                sock.sendall(_LEN.pack(len(payload)) + payload)
-                while True:
-                    frame = self._read_frame(sock, allow_empty=True)
-                    if not frame:
-                        completed = True
-                        return  # EOS
-                    yield frame
-            finally:
-                if not completed:
-                    # consumer aborted (or the read failed) mid-stream:
-                    # unread frames would poison the next request on this
-                    # channel — drop it and let request() re-dial
-                    self.close()
+        sock, _pooled = self._take()
+        completed = False
+        try:
+            sock.sendall(_LEN.pack(len(payload)) + payload)
+            while True:
+                frame = self._read_frame(sock, allow_empty=True)
+                if not frame:
+                    completed = True
+                    return  # EOS
+                yield frame
+        finally:
+            if completed:
+                self._give(sock)
+            else:
+                # consumer aborted (or the read failed) mid-stream:
+                # unread frames would poison the next request on this
+                # socket — drop it, the pool dials fresh
+                _close_quietly(sock)
 
     @staticmethod
     def _read_frame(sock: socket.socket, allow_empty: bool = False) -> bytes:
@@ -746,8 +886,14 @@ class ServerConnection:
         return bytes(buf)
 
     def close(self) -> None:
-        if self._sock is not None:
-            try:
-                self._sock.close()
-            finally:
-                self._sock = None
+        with self._lock:
+            idle, self._idle = self._idle, []
+        for sock in idle:
+            _close_quietly(sock)
+
+
+def _close_quietly(sock: socket.socket) -> None:
+    try:
+        sock.close()
+    except OSError:
+        pass

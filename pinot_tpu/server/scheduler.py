@@ -19,7 +19,8 @@ from collections import deque
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Callable, Deque, Dict, Optional
 
-from pinot_tpu.utils.accounting import BrokerTimeoutError
+from pinot_tpu.utils.accounting import (BrokerTimeoutError,
+                                        ServerOverloadedError)
 
 
 class QueryScheduler:
@@ -27,7 +28,36 @@ class QueryScheduler:
     fn(). deadline is an absolute time.time() timestamp: work that is
     STILL QUEUED when its deadline passes must not occupy a worker thread
     — the future completes with BrokerTimeoutError instead (ref
-    QueryScheduler.java's timeout handling around the query runners)."""
+    QueryScheduler.java's timeout handling around the query runners).
+
+    Every scheduler's queue is BOUNDED when ``max_pending`` > 0 (wired
+    from ``pinot.server.admission.queue.limit``): a submit past the
+    bound raises :class:`ServerOverloadedError` instead of queueing work
+    the deadline will kill anyway. This is the hard backstop under the
+    policy-level admission controller (server/admission.py), which
+    rejects earlier and with better reasons — the scheduler bound only
+    fires when submissions race the controller's estimate."""
+
+    #: bounded-queue backstop: > 0 = max queued (submitted, not yet
+    #: picked up) submissions before submit() raises; 0 = unbounded
+    #: (the pre-overload-protection behavior)
+    max_pending = 0
+
+    def set_queue_limit(self, n: int) -> None:
+        self.max_pending = max(0, int(n))
+
+    def pending_count(self) -> int:
+        """Submissions queued but not yet picked up by a worker."""
+        return 0
+
+    # -- tenant weights (TokenPriorityScheduler overrides) -------------
+    def tenant_weight(self, tenant: Optional[str]) -> float:
+        return 1.0
+
+    def tenant_weights(self) -> Dict[str, float]:
+        """Known tenant -> weight map; empty for tenant-blind
+        schedulers (admission then skips weighted shedding)."""
+        return {}
 
     #: optional metrics hookup (attach_metrics): scheduler_inflight gauge
     #: tracks submitted-but-unfinished queries — with the dispatch ring
@@ -82,6 +112,32 @@ class QueryScheduler:
         without tenant awareness accept and ignore it."""
         raise NotImplementedError
 
+    # -- bounded-queue helper for pool-backed schedulers ----------------
+    def _bounded(self, fn: Callable[[], bytes]) -> Callable[[], bytes]:
+        """Count fn as queued from submit until pick-up and refuse at
+        the bound. Pool-backed schedulers (FCFS, binary) call this with
+        a ``self._qlock``/``self._queued`` pair initialized in their
+        constructors; the token scheduler enforces the bound inline
+        under its own condition lock instead."""
+        if not self.max_pending:
+            return fn
+        with self._qlock:
+            if self._queued >= self.max_pending:
+                m = self._metrics
+                if m is not None:
+                    m.add_meter("scheduler_queue_rejected",
+                                labels=self._labels)
+                raise ServerOverloadedError(
+                    f"scheduler queue full ({self._queued} pending >= "
+                    f"limit {self.max_pending})")
+            self._queued += 1
+
+        def run():
+            with self._qlock:
+                self._queued -= 1
+            return fn()
+        return run
+
     @staticmethod
     def _guard(fn: Callable[[], bytes],
                deadline: Optional[float]) -> Callable[[], bytes]:
@@ -110,13 +166,21 @@ class FCFSQueryScheduler(QueryScheduler):
     """Ref FCFSQueryScheduler — a plain pool in arrival order."""
 
     def __init__(self, num_threads: int = 8):
+        self.num_threads = num_threads
         self._pool = ThreadPoolExecutor(max_workers=num_threads,
                                         thread_name_prefix="query-fcfs")
+        self._qlock = threading.Lock()
+        self._queued = 0
+
+    def pending_count(self) -> int:
+        with self._qlock:
+            return self._queued
 
     def submit(self, fn, table: str = "", workload: str = "primary",
                deadline: Optional[float] = None,
                tenant: Optional[str] = None) -> Future:
-        return self._track(self._pool.submit(self._guard(fn, deadline)))
+        run = self._bounded(self._guard(fn, deadline))
+        return self._track(self._pool.submit(run))
 
     def stop(self) -> None:
         self._pool.shutdown(wait=False)
@@ -174,6 +238,22 @@ class TokenPriorityScheduler(QueryScheduler):
         self._lock = threading.Condition()
         self._stopped = False
         self._threads = []
+        #: queued-but-unpicked submissions across every tenant/table
+        #: bucket (kept incrementally — the bound check must not walk
+        #: all deques per submit)
+        self._pending_total = 0
+
+    def tenant_weight(self, tenant: Optional[str]) -> float:
+        with self._lock:
+            return self._weights.get(tenant or DEFAULT_TENANT, 1.0)
+
+    def tenant_weights(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._weights)
+
+    def pending_count(self) -> int:
+        with self._lock:
+            return self._pending_total
 
     def set_tenant_weight(self, tenant: str, weight: float) -> None:
         """Fed from TableConfig tenant weights (broker/controller push):
@@ -206,6 +286,13 @@ class TokenPriorityScheduler(QueryScheduler):
         fut: Future = Future()
         tenant = tenant or DEFAULT_TENANT
         with self._lock:
+            if self.max_pending and self._pending_total >= self.max_pending:
+                if self._metrics is not None:
+                    self._metrics.add_meter("scheduler_queue_rejected",
+                                            labels=self._labels)
+                raise ServerOverloadedError(
+                    f"scheduler queue full ({self._pending_total} pending "
+                    f">= limit {self.max_pending})")
             tg = self._tenants.get(tenant)
             if tg is None:
                 tg = self._tenants[tenant] = _TenantGroup(
@@ -215,6 +302,7 @@ class TokenPriorityScheduler(QueryScheduler):
             if g is None:
                 g = tg.tables[table] = _Group(self.tokens_per_interval)
             g.pending.append((fut, self._guard(fn, deadline)))
+            self._pending_total += 1
             self._lock.notify()
         return self._track(fut)
 
@@ -252,6 +340,7 @@ class TokenPriorityScheduler(QueryScheduler):
             if best is None or g.tokens > best.tokens:
                 best = g
         fut, fn = best.pending.popleft()
+        self._pending_total -= 1
         return best_tenant, best, fut, fn
 
     def _worker(self) -> None:
@@ -288,17 +377,25 @@ class BinaryWorkloadScheduler(QueryScheduler):
     crowd out production traffic."""
 
     def __init__(self, num_threads: int = 8, secondary_threads: int = 1):
+        self.num_threads = num_threads
         self._primary = ThreadPoolExecutor(
             max_workers=num_threads, thread_name_prefix="query-primary")
         self._secondary = ThreadPoolExecutor(
             max_workers=max(secondary_threads, 1),
             thread_name_prefix="query-secondary")
+        self._qlock = threading.Lock()
+        self._queued = 0
+
+    def pending_count(self) -> int:
+        with self._qlock:
+            return self._queued
 
     def submit(self, fn, table: str = "", workload: str = "primary",
                deadline: Optional[float] = None,
                tenant: Optional[str] = None) -> Future:
         pool = self._primary if workload != "secondary" else self._secondary
-        return self._track(pool.submit(self._guard(fn, deadline)))
+        run = self._bounded(self._guard(fn, deadline))
+        return self._track(pool.submit(run))
 
     def stop(self) -> None:
         self._primary.shutdown(wait=False)

@@ -18,6 +18,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from pinot_tpu.utils import errorcodes
+
 
 class QueryCancelledError(RuntimeError):
     pass
@@ -30,7 +32,26 @@ class BrokerTimeoutError(RuntimeError):
     the segment loop — the response carries it as an errorCode-250 entry
     with partialResult=true, never a hang."""
 
-    ERROR_CODE = 250
+    ERROR_CODE = errorcodes.EXECUTION_TIMEOUT
+
+
+class ServerOverloadedError(RuntimeError):
+    """The server REFUSED a query at admission instead of queueing it
+    toward a deadline miss (ref "Overload Control for Scaling WeChat
+    Microservices", SOSP 2018 — reject early, reject cheap). Raised by
+    the bounded scheduler queues and the admission controller
+    (server/admission.py); the transport answers a typed
+    errorCode-211 entry whose message carries a ``retryAfterMs=`` drain
+    hint, having consumed no execution resources. Distinct from the 250
+    deadline miss by construction: a 250 burned budget, a 211 did not.
+    """
+
+    ERROR_CODE = errorcodes.SERVER_OVERLOADED
+
+    def __init__(self, reason: str, retry_after_ms: float = 0.0):
+        super().__init__(reason)
+        self.reason = reason
+        self.retry_after_ms = float(retry_after_ms)
 
 
 @dataclass

@@ -83,6 +83,24 @@ KEYS: Dict[str, Any] = {
     # server-side grace added to the broker-shipped remaining budget
     # before the local deadline trips (absorbs clock skew + queue jitter)
     "pinot.server.query.deadline.grace.ms": 50,
+    # -- server admission control (server/admission.py) -----------------
+    # Overload protection at the transport edge: a query is REJECTED
+    # with a typed errorCode-211 (+ retryAfterMs hint) instead of
+    # queueing toward a deadline miss when (a) the scheduler's bounded
+    # queue is full (.queue.limit, also enforced inside the schedulers
+    # as a backstop; 0 = unbounded), (b) its remaining deadline budget
+    # is below the EWMA-estimated queue wait + execution time
+    # (.exec.ewma.alpha smooths the estimates), (c) memory/HBM pressure
+    # (residency-tier + realtime-ingest bytes vs their budgets) is at/
+    # over .memory.threshold, or (d) the queue is past .shed.start
+    # occupancy and the query's tenant weight ranks below the
+    # occupancy-scaled cutoff (lowest-priority tenants shed first,
+    # DAGOR-style).
+    "pinot.server.admission.enabled": True,
+    "pinot.server.admission.queue.limit": 128,
+    "pinot.server.admission.shed.start": 0.5,
+    "pinot.server.admission.memory.threshold": 0.95,
+    "pinot.server.admission.exec.ewma.alpha": 0.2,
     # realtime ingestion backpressure (ingest/realtime_manager.py):
     # .memory.bytes bounds one partition consumer's mutable bytes plus
     # sealed-segments-awaiting-build bytes — approaching the budget
@@ -112,6 +130,35 @@ KEYS: Dict[str, Any] = {
     "pinot.broker.hedge.enabled": False,
     "pinot.broker.hedge.delay.min.ms": 25,
     "pinot.broker.hedge.delay.max.ms": 1000,
+    # -- broker retry budget (broker/adaptive.py RetryBudget) -----------
+    # Finagle-style per-table retry budget so failures and overload
+    # rejections cannot amplify into retry storms: every clean primary
+    # response DEPOSITS .ratio tokens (capped at .cap), every retry or
+    # hedge WITHDRAWS one; a table starts with .min tokens so a cold
+    # broker can still salvage the odd failure. Exhausted budget means
+    # the failure surfaces as a typed partial instead of re-offering
+    # the load that is sinking the fleet.
+    "pinot.broker.retry.budget.enabled": True,
+    "pinot.broker.retry.budget.ratio": 0.2,
+    "pinot.broker.retry.budget.min": 3.0,
+    "pinot.broker.retry.budget.cap": 10.0,
+    # -- brownout mode (health/brownout.py) -----------------------------
+    # Graceful degradation closing the SLO observe->act loop: sustained
+    # SLO burn (the PR-14 watchdog) or sustained shed rate (admission
+    # rejections + overload partials per query over the short window at/
+    # over .shed.rate.threshold) climbs a per-role degradation ladder —
+    # disable hedging -> serve result-cache entries up to
+    # .stale.ttl.grace.seconds past TTL with staleResult=true -> shrink
+    # dispatch batch windows by .batch.window.scale -> shed secondary
+    # workloads at admission. Hysteresis: one rung up only after the
+    # signal holds .up.seconds, one rung down only after it stays clear
+    # .down.seconds (exit threshold is half the entry threshold).
+    "pinot.brownout.enabled": True,
+    "pinot.brownout.shed.rate.threshold": 0.1,
+    "pinot.brownout.up.seconds": 10.0,
+    "pinot.brownout.down.seconds": 30.0,
+    "pinot.brownout.batch.window.scale": 0.25,
+    "pinot.brownout.stale.ttl.grace.seconds": 120.0,
     # multi-stage engine budget: OPTION(timeoutMs=...) > this knob >
     # pinot.broker.timeout.ms — the budget travels in every stage and is
     # enforced on every mailbox wait ("" = inherit the broker default)

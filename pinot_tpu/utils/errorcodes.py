@@ -1,0 +1,107 @@
+"""Canonical query errorCode registry.
+
+Reference parity: pinot-common QueryException / QueryErrorCode — every
+error a broker response can carry has ONE assigned integer, defined in
+one place. Before this module the literals (150, 190, 200, 250, 427,
+429) were scattered across broker/server/mse/client modules; a typo'd
+code would ship silently and the client's typed-error mapping would
+miss it.
+
+This is the error-code analog of the ``SITES`` failpoint table and the
+``KEYS`` knob catalog: the ``errorcodes`` static-analysis checker
+(analysis/checkers/errorcodes.py) enforces that
+
+* every literal ``errorCode`` emission/comparison in production code
+  references a name defined here (no bare ints);
+* every name defined here is referenced somewhere in production code
+  (no phantom codes);
+* every name appears in the README error-code table.
+
+The README "Error codes" table renders from :data:`CODES`; do not fork
+a second list.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+#: SQL failed to parse under both engines' grammars
+#: (ref QueryException.SQL_PARSING_ERROR_CODE)
+SQL_PARSING = 150
+
+#: the queried table exists in no routing table
+#: (ref QueryException.TABLE_DOES_NOT_EXIST_ERROR_CODE)
+TABLE_DOES_NOT_EXIST = 190
+
+#: server-side execution raised (the catch-all execution failure,
+#: ref QueryException.QUERY_EXECUTION_ERROR_CODE)
+QUERY_EXECUTION = 200
+
+#: the server REFUSED the query at admission — queue full, deadline
+#: budget unservable, memory pressure, or load-shed priority class
+#: (ref QueryException.SERVER_OUT_OF_CAPACITY_ERROR_CODE). Distinct
+#: from 250 by design: the query consumed no execution resources and
+#: the message carries a ``retryAfterMs=`` hint; the client maps it to
+#: PinotOverloadError, the broker retries it on at most one other
+#: replica and never escalates it to a raw 427.
+SERVER_OVERLOADED = 211
+
+#: the query exceeded its end-to-end deadline budget
+#: (ref QueryException.EXECUTION_TIMEOUT_ERROR_CODE)
+EXECUTION_TIMEOUT = 250
+
+#: a server could not be reached / answered with a hard failure and no
+#: surviving replica could cover its segments
+#: (ref QueryException.SERVER_NOT_RESPONDING_ERROR_CODE)
+SERVER_ERROR = 427
+
+#: the query was rejected by a table/tenant QPS quota
+#: (ref QueryException.TOO_MANY_REQUESTS_ERROR_CODE)
+QUOTA_EXCEEDED = 429
+
+# -- the SERVER_OVERLOADED retryAfterMs in-band contract ---------------------
+# The exception wire format is (code, message) tuples, so the drain
+# hint travels inside the 211 message. Format and parse live HERE, next
+# to the code they belong to — the server response builder, the broker
+# retry path, and the client error mapping all share this pair instead
+# of three hand-rolled regexes drifting apart.
+
+_RETRY_AFTER_RE = None
+
+
+def format_retry_after(ms: float) -> str:
+    """The hint fragment appended to a 211 message. Floored at 1ms:
+    'retry now' is never an honest hint from a shedding server."""
+    return f"(retryAfterMs={int(round(max(1.0, ms)))})"
+
+
+def parse_retry_after(message: str):
+    """The hint parsed back out of a 211 message; None when absent."""
+    global _RETRY_AFTER_RE
+    if _RETRY_AFTER_RE is None:
+        import re
+        _RETRY_AFTER_RE = re.compile(r"retryAfterMs=(\d+(?:\.\d+)?)")
+    m = _RETRY_AFTER_RE.search(str(message))
+    return float(m.group(1)) if m else None
+
+
+#: THE canonical registry: code name -> one-line contract. The
+#: ``errorcodes`` checker keeps it in lockstep with the constants above
+#: and with the README error-code table.
+CODES: Dict[str, str] = {
+    "SQL_PARSING":
+        "SQL rejected by both the single-stage and MSE grammars",
+    "TABLE_DOES_NOT_EXIST":
+        "no routing table knows the queried table",
+    "QUERY_EXECUTION":
+        "server-side execution raised (catch-all failure)",
+    "SERVER_OVERLOADED":
+        "rejected at server admission (queue/deadline/memory/priority "
+        "shed) — carries a retryAfterMs hint, consumed no execution",
+    "EXECUTION_TIMEOUT":
+        "end-to-end deadline budget exhausted; response is a typed "
+        "partial",
+    "SERVER_ERROR":
+        "server unreachable or hard-failed with no surviving replica",
+    "QUOTA_EXCEEDED":
+        "table or tenant QPS quota rejected the query",
+}

@@ -54,6 +54,14 @@ SITES: Dict[str, str] = {
     "cache.ring.node":
         "every cache-ring key->node resolution (ctx: node, key — arm "
         "with where={'node': addr} to fail one node's key range)",
+    "server.admission.reject":
+        "server admission decision point (ctx: table, tenant, workload) "
+        "— arm with error=ServerOverloadedError(...) to force seeded "
+        "rejections; decisions journal for byte-identical replay",
+    "broker.retry.budget":
+        "broker-side, at every retry/hedge budget withdrawal (ctx: "
+        "table) — arm with error=FailpointError() to force seeded "
+        "budget exhaustion",
     "server.execute.before":
         "server-side, before a query executes",
     "server.execute.segment":

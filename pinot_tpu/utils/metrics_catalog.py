@@ -37,6 +37,29 @@ METRICS: Dict[str, str] = {
     # -- server query path ------------------------------------------------
     "queries": "queries executed by this server",
     "queries_killed": "queries stopped by deadline/cancel",
+    # -- overload protection (PR 15) --------------------------------------
+    "server_admission_rejected":
+        "queries rejected at server admission (label reason=queue|"
+        "deadline|memory|tenant|workload|chaos)",
+    "scheduler_queue_rejected":
+        "submissions refused by a scheduler's bounded queue backstop",
+    "broker_overload_rejections":
+        "server overload (211) rejections received by this broker",
+    "broker_overload_partials":
+        "responses where an overload rejection surfaced as a typed "
+        "partial (no replica absorbed the retry)",
+    "broker_retries_issued": "scatter retry units launched after failures",
+    "broker_retry_budget_exhausted":
+        "retries/hedges suppressed by an exhausted per-table budget",
+    "broker_retry_budget_tokens":
+        "per-table retry-budget tokens remaining (label table=)",
+    "brownout_level":
+        "current brownout ladder level (0 = healthy, 4 = full brownout)",
+    "brownout_transitions":
+        "brownout ladder moves (label direction=up|down)",
+    "stale_results_served":
+        "result-cache entries served past TTL under brownout "
+        "(staleResult=true)",
     "query_exceptions": "queries that raised server-side",
     "query_execution": "server-side execution latency per query (ms)",
     "scheduler_inflight": "queries currently inside the scheduler",

@@ -1,0 +1,868 @@
+"""Overload protection & graceful degradation (ISSUE 15).
+
+Layers under test:
+
+  * AdmissionController decision order: chaos site, queue bound,
+    deadline-aware rejection from EWMA estimates, memory-pressure shed,
+    tenant-weighted shedding over TokenPriorityScheduler weights;
+  * bounded scheduler queues (the submit-time backstop) across fcfs /
+    priority / binary;
+  * the typed errorCode-211 plane end to end: server rejection ->
+    broker one-replica retry -> typed partial (never a raw 427) ->
+    client PinotOverloadError with the parsed retryAfterMs hint;
+  * RetryBudget token bucket + the retry-storm regression (flapping
+    replica under multi-client load must not multiply offered load);
+  * failure-detector rework: capped-exponential mark_timeout with
+    jitter, lighter-weight mark_overload, hedge auto-disable;
+  * brownout ladder hysteresis (unit, injectable clock) and the
+    end-to-end MiniCluster SLO-burn -> climb -> recover round trip;
+  * seeded chaos replay: server.admission.reject and
+    broker.retry.budget decision journals byte-identical;
+  * concurrent admission race: every query exactly one typed terminal
+    outcome;
+  * the bench --overload smoke leg (tier-1 goodput gate).
+"""
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from pinot_tpu.broker.adaptive import RetryBudget
+from pinot_tpu.broker.failure_detector import ConnectionFailureDetector
+from pinot_tpu.health.brownout import (RUNGS, BrownoutController,
+                                       _register_brownout, engaged,
+                                       get_brownout, window_scale)
+from pinot_tpu.health.history import MetricsHistory
+from pinot_tpu.server.admission import AdmissionController
+from pinot_tpu.server.scheduler import make_scheduler
+from pinot_tpu.utils import errorcodes
+from pinot_tpu.utils.accounting import ServerOverloadedError
+from pinot_tpu.utils.config import PinotConfiguration
+from pinot_tpu.utils.failpoints import (FailpointError, FaultSchedule,
+                                        failpoints)
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoints.clear()
+    yield
+    failpoints.clear()
+
+
+def _build_segment(tmp_path, name="s0", docs=500, seed=7):
+    from pinot_tpu.models import (DataType, FieldSpec, FieldType, Schema,
+                                  TableConfig)
+    from pinot_tpu.segment.creator import SegmentCreator
+    from pinot_tpu.segment.loader import load_segment
+    schema = Schema("t", [
+        FieldSpec("k", DataType.INT, FieldType.DIMENSION),
+        FieldSpec("v", DataType.INT, FieldType.METRIC)])
+    rng = np.random.default_rng(seed)
+    d = str(tmp_path / name)
+    SegmentCreator(TableConfig(name="t"), schema).build(
+        {"k": rng.integers(0, 100, docs).astype(np.int32),
+         "v": rng.integers(0, 10, docs).astype(np.int32)}, d, name)
+    return load_segment(d)
+
+
+QUERY = "SELECT COUNT(*), SUM(v) FROM t OPTION(skipCache=true)"
+
+
+def _mini_cluster(tmp_path, overrides=None, num_servers=2,
+                  replicate=True, num_segments=2):
+    from pinot_tpu.cluster.mini import MiniCluster
+    cfg = PinotConfiguration(overrides=dict(overrides or {}))
+    c = MiniCluster(num_servers=num_servers, config=cfg)
+    c.start()
+    c.add_table("t")
+    for i in range(num_segments):
+        seg = _build_segment(tmp_path, name=f"s{i}", seed=11 + i)
+        if replicate and num_servers > 1:
+            c.add_segment("t", seg, server_idx=0,
+                          replicas=list(range(1, num_servers)))
+        else:
+            c.add_segment("t", seg, server_idx=i % num_servers)
+    return c
+
+
+# ---------------------------------------------------------------------------
+# AdmissionController unit behavior
+# ---------------------------------------------------------------------------
+
+class TestAdmissionController:
+    def test_admits_when_idle(self):
+        a = AdmissionController(num_threads=2, queue_limit=4)
+        assert a.admit(table="t", deadline=time.time() + 10) is None
+
+    def test_queue_bound_rejects(self):
+        a = AdmissionController(num_threads=2, queue_limit=4)
+        tickets = [a.register() for _ in range(2 + 4)]
+        rej = a.admit(table="t")
+        assert isinstance(rej, ServerOverloadedError)
+        assert "queue full" in str(rej)
+        assert rej.retry_after_ms >= 10.0
+        for t in tickets:
+            t.release()
+        assert a.admit(table="t") is None
+
+    def test_deadline_aware_rejection_from_ewma(self):
+        """A query whose remaining budget is below estimated wait+exec
+        fails NOW in O(1) instead of timing out after consuming a
+        worker — the heart of deadline-aware admission."""
+        a = AdmissionController(num_threads=1, queue_limit=100,
+                                ewma_alpha=1.0)
+        # teach it: executions take ~200ms
+        t = a.register()
+        t.run(lambda: time.sleep(0.0))  # wait observation
+        a._note_exec(0.2)
+        t.release()
+        # 6 queued ahead on 1 worker -> est wait ~1.2s
+        tickets = [a.register() for _ in range(7)]
+        rej = a.admit(table="t", deadline=time.time() + 0.3)
+        assert isinstance(rej, ServerOverloadedError)
+        assert "estimated wait" in str(rej)
+        assert rej.retry_after_ms > 0
+        # a roomy budget still admits through the same queue
+        assert a.admit(table="t", deadline=time.time() + 30) is None
+        for t in tickets:
+            t.release()
+
+    def test_memory_pressure_sheds(self):
+        pressure = [0.0]
+        a = AdmissionController(num_threads=2, queue_limit=4,
+                                memory_threshold=0.9,
+                                memory_pressure_fn=lambda: pressure[0])
+        assert a.admit(table="t") is None
+        pressure[0] = 0.97
+        a._pressure_at = 0.0  # expire the memo
+        rej = a.admit(table="t")
+        assert isinstance(rej, ServerOverloadedError)
+        assert "memory pressure" in str(rej)
+
+    def test_tenant_weight_shed_lowest_first(self):
+        """Past shed.start occupancy the weight cutoff rises toward the
+        heaviest tenant: the light tenant sheds first, the heavy one
+        keeps flowing until the hard queue bound."""
+        weights = {"gold": 4.0, "bronze": 1.0}
+        a = AdmissionController(num_threads=1, queue_limit=10,
+                                shed_start=0.5,
+                                tenant_weights_fn=lambda: weights)
+        a._note_exec(0.01)
+        tickets = [a.register() for _ in range(1 + 9)]  # 90% occupancy
+        rej = a.admit(table="t", tenant="bronze")
+        assert isinstance(rej, ServerOverloadedError)
+        assert "shed cutoff" in str(rej)
+        assert a.admit(table="t", tenant="gold") is None
+        for t in tickets:
+            t.release()
+
+    def test_disabled_admits_everything(self):
+        a = AdmissionController(num_threads=1, queue_limit=1,
+                                enabled=False)
+        tickets = [a.register() for _ in range(50)]
+        assert a.admit(table="t", deadline=time.time() + 0.001) is None
+        for t in tickets:
+            t.release()
+
+    def test_ticket_release_idempotent(self):
+        a = AdmissionController(num_threads=1)
+        t = a.register()
+        t.release()
+        t.release()
+        assert a.snapshot()["inflight"] == 0
+
+    def test_chaos_rejection_site(self):
+        a = AdmissionController(num_threads=2, queue_limit=4)
+        with failpoints.armed(
+                "server.admission.reject",
+                error=ServerOverloadedError("chaos", retry_after_ms=77)):
+            rej = a.admit(table="t")
+        assert isinstance(rej, ServerOverloadedError)
+        assert rej.retry_after_ms == 77.0
+        assert a.admit(table="t") is None
+
+
+# ---------------------------------------------------------------------------
+# bounded scheduler queues (the backstop)
+# ---------------------------------------------------------------------------
+
+class TestBoundedSchedulers:
+    @pytest.mark.parametrize("kind", ["fcfs", "priority", "binary"])
+    def test_full_queue_raises_typed(self, kind):
+        gate = threading.Event()
+        sched = make_scheduler(kind, num_threads=1)
+        sched.start()
+        try:
+            sched.set_queue_limit(2)
+            started = threading.Event()
+
+            def first():
+                started.set()
+                gate.wait(10)
+                return b""
+
+            futs = [sched.submit(first)]
+            assert started.wait(5), "worker never picked up"
+            # worker occupied: exactly `limit` submissions may queue,
+            # the next must be REFUSED typed, not silently queued
+            futs += [sched.submit(lambda: gate.wait(10))
+                     for _ in range(2)]
+            with pytest.raises(ServerOverloadedError) as ei:
+                sched.submit(lambda: gate.wait(10))
+            assert ei.value.ERROR_CODE == errorcodes.SERVER_OVERLOADED
+            gate.set()
+            for f in futs:
+                f.result(timeout=5)
+            # drained queue admits again
+            sched.submit(lambda: b"").result(timeout=5)
+        finally:
+            gate.set()
+            sched.stop()
+
+    def test_unbounded_by_default(self):
+        sched = make_scheduler("fcfs", num_threads=1)
+        sched.start()
+        try:
+            gate = threading.Event()
+            futs = [sched.submit(gate.wait) for _ in range(64)]
+            gate.set()
+            for f in futs:
+                f.result(timeout=5)
+        finally:
+            sched.stop()
+
+    def test_tenant_weights_exposed(self):
+        sched = make_scheduler("priority", num_threads=1)
+        sched.set_tenant_weight("gold", 4.0)
+        assert sched.tenant_weights() == {"gold": 4.0}
+        assert sched.tenant_weight("gold") == 4.0
+        assert sched.tenant_weight("unknown") == 1.0
+
+
+# ---------------------------------------------------------------------------
+# RetryBudget
+# ---------------------------------------------------------------------------
+
+class TestRetryBudget:
+    def test_min_tokens_then_exhaustion(self):
+        b = RetryBudget(ratio=0.0, min_tokens=2.0, cap=5.0)
+        assert b.try_withdraw("t")
+        assert b.try_withdraw("t")
+        assert not b.try_withdraw("t")
+
+    def test_successes_refill_up_to_cap(self):
+        b = RetryBudget(ratio=0.5, min_tokens=1.0, cap=2.0)
+        assert b.try_withdraw("t")
+        assert not b.try_withdraw("t")
+        for _ in range(10):
+            b.deposit("t")
+        assert b.tokens("t") == 2.0  # capped
+        assert b.try_withdraw("t")
+        assert b.try_withdraw("t")
+        assert not b.try_withdraw("t")
+
+    def test_tables_isolated(self):
+        b = RetryBudget(ratio=0.0, min_tokens=1.0)
+        assert b.try_withdraw("a")
+        assert not b.try_withdraw("a")
+        assert b.try_withdraw("b")
+
+    def test_disabled_always_grants(self):
+        b = RetryBudget(ratio=0.0, min_tokens=0.0, enabled=False)
+        for _ in range(100):
+            assert b.try_withdraw("t")
+
+
+# ---------------------------------------------------------------------------
+# failure detector: timeout backoff + overload marks
+# ---------------------------------------------------------------------------
+
+class TestFailureDetectorBackoff:
+    def test_timeout_backoff_grows_and_caps(self):
+        d = ConnectionFailureDetector(base_backoff_s=1.0,
+                                      max_backoff_s=8.0, jitter_seed=3)
+        spans = []
+        for _ in range(6):
+            before = time.time()
+            d.mark_timeout("s")
+            with d._lock:
+                spans.append(d._entries["s"].retry_at - before)
+        # capped exponential: grows (jitter in [0.5, 1.0] cannot mask a
+        # doubling) and never exceeds the ceiling
+        assert spans[2] > spans[0]
+        assert all(s <= 8.0 + 0.01 for s in spans)
+        assert spans[-1] >= 2.0  # well past the old flat single base
+
+    def test_timeout_jitter_is_seeded(self):
+        a = ConnectionFailureDetector(jitter_seed=42)
+        b = ConnectionFailureDetector(jitter_seed=42)
+        now = time.time()
+        a.mark_timeout("s")
+        b.mark_timeout("s")
+        with a._lock:
+            ra = a._entries["s"].retry_at - now
+        with b._lock:
+            rb = b._entries["s"].retry_at - now
+        assert abs(ra - rb) < 0.05
+
+    def test_overload_lighter_than_timeout(self):
+        """The same number of overload marks must exile a server for
+        LESS time than timeout marks — saturated is not dead."""
+        t = ConnectionFailureDetector(base_backoff_s=1.0,
+                                      max_backoff_s=60.0, jitter_seed=1)
+        o = ConnectionFailureDetector(base_backoff_s=1.0,
+                                      max_backoff_s=60.0, jitter_seed=1)
+        now = time.time()
+        for _ in range(6):
+            t.mark_timeout("s")
+            o.mark_overload("s")
+        with t._lock:
+            t_span = t._entries["s"].retry_at - now
+        with o._lock:
+            o_span = o._entries["s"].retry_at - now
+        assert o_span < t_span
+        assert o_span <= 60.0 / 4.0 + 0.01  # quarter ceiling
+
+    def test_overload_horizon_and_success_clears(self):
+        d = ConnectionFailureDetector(base_backoff_s=0.2, jitter_seed=2)
+        assert not d.any_overloaded()
+        d.mark_overload("s", retry_after_s=5.0)
+        assert d.any_overloaded()
+        assert d.overloaded_servers() == {"s"}
+        d.mark_success("s")
+        assert not d.any_overloaded()
+        assert d.is_healthy("s")
+
+    def test_retry_after_hint_respected(self):
+        d = ConnectionFailureDetector(base_backoff_s=0.01,
+                                      max_backoff_s=60.0, jitter_seed=4)
+        now = time.time()
+        d.mark_overload("s", retry_after_s=3.0)
+        with d._lock:
+            span = d._entries["s"].overload_until - now
+        assert 2.9 <= span <= 60.0 / 4.0 + 0.01
+
+
+# ---------------------------------------------------------------------------
+# the typed 211 plane end to end
+# ---------------------------------------------------------------------------
+
+class TestOverloadEndToEnd:
+    def test_forced_rejection_surfaces_typed_partial(self, tmp_path):
+        """Both replicas rejecting: the broker retries once, then
+        surfaces a typed 211 (retryAfterMs intact) — never a 427."""
+        c = _mini_cluster(tmp_path)
+        try:
+            assert not c.query(QUERY).exceptions
+            with failpoints.armed(
+                    "server.admission.reject",
+                    error=ServerOverloadedError("drill",
+                                                retry_after_ms=42)):
+                resp = c.query(QUERY)
+            assert resp.partial_result
+            codes = {e["errorCode"] for e in resp.exceptions}
+            assert codes == {errorcodes.SERVER_OVERLOADED}
+            assert any("retryAfterMs=42" in e["message"]
+                       for e in resp.exceptions)
+        finally:
+            c.stop()
+
+    def test_one_replica_overloaded_other_absorbs(self, tmp_path):
+        """A single saturated replica: the overload retries onto the
+        twin and the query answers CLEAN — overload protection must be
+        invisible while capacity exists elsewhere."""
+        c = _mini_cluster(tmp_path)
+        try:
+            baseline = c.query(QUERY)
+            assert not baseline.exceptions
+            with failpoints.armed(
+                    "server.admission.reject",
+                    error=ServerOverloadedError("saturated",
+                                                retry_after_ms=30),
+                    where={"table": "t_OFFLINE"}, times=1):
+                resp = c.query(QUERY)
+            assert not resp.exceptions, resp.exceptions
+            assert resp.rows == baseline.rows
+            # the rejecting server was cooled at overload weight: its
+            # overload horizon is open, so hedging is auto-disabled
+            assert c.broker.failure_detector.any_overloaded()
+            assert c.broker._hedge_delay_s() is None
+        finally:
+            c.stop()
+
+    def test_budget_exhaustion_stops_the_retry(self, tmp_path):
+        """broker.retry.budget armed to exhaust: the overload surfaces
+        typed WITHOUT a second server attempt — rejections cannot
+        amplify."""
+        c = _mini_cluster(tmp_path)
+        try:
+            assert not c.query(QUERY).exceptions
+            before = self._server_queries(c)
+            with failpoints.armed(
+                    "server.admission.reject",
+                    error=ServerOverloadedError("drill",
+                                                retry_after_ms=10),
+                    times=1), \
+                 failpoints.armed("broker.retry.budget",
+                                  error=FailpointError("budget dry")):
+                resp = c.query(QUERY)
+            codes = {e["errorCode"] for e in resp.exceptions}
+            assert codes == {errorcodes.SERVER_OVERLOADED}
+            assert any("retry budget exhausted" in e["message"]
+                       for e in resp.exceptions)
+            # exactly ONE server attempt (the rejected one — rejections
+            # don't execute, so the counter must not move at all)
+            assert self._server_queries(c) == before
+        finally:
+            c.stop()
+
+    @staticmethod
+    def _server_queries(c) -> float:
+        from pinot_tpu.utils.metrics import get_registry
+        counters = get_registry("server").sample()["counters"]
+        return sum(v for k, v in counters.items()
+                   if k == "queries" or k.startswith("queries{"))
+
+    def test_client_maps_overload_error(self, tmp_path):
+        from pinot_tpu.client.connection import (PinotOverloadError,
+                                                 connect)
+        c = _mini_cluster(tmp_path)
+        try:
+            from pinot_tpu.broker.http_api import BrokerHttpServer
+            http = BrokerHttpServer(c.broker)
+            http.start()
+            try:
+                conn = connect(f"127.0.0.1:{http.port}")
+                with failpoints.armed(
+                        "server.admission.reject",
+                        error=ServerOverloadedError(
+                            "drill", retry_after_ms=55)):
+                    with pytest.raises(PinotOverloadError) as ei:
+                        conn.execute(QUERY)
+                assert ei.value.retry_after_ms == 55.0
+                assert ei.value.result_set is not None
+                assert ei.value.result_set.partial_result
+            finally:
+                http.stop()
+        finally:
+            c.stop()
+
+
+# ---------------------------------------------------------------------------
+# seeded chaos replay (byte-identical journals)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+class TestOverloadChaosReplay:
+    def _run_schedule(self, tmp_path, sub, seed):
+        sched = FaultSchedule([
+            ("server.admission.reject",
+             {"error": ServerOverloadedError("chaos", retry_after_ms=20),
+              "probability": 0.5, "seed": seed}),
+            ("broker.retry.budget",
+             {"error": FailpointError("chaos budget"),
+              "probability": 0.5, "seed": seed + 1}),
+        ])
+        c = _mini_cluster(tmp_path / sub)
+        sched.arm()
+        outcomes = []
+        try:
+            for _ in range(24):
+                resp = c.query(QUERY)
+                outcomes.append(tuple(sorted(
+                    e["errorCode"] for e in resp.exceptions)))
+        finally:
+            decisions = sched.decisions()
+            sched.disarm()
+            c.stop()
+        return decisions, outcomes
+
+    def test_same_seed_replays_byte_identical(self, tmp_path):
+        d1, o1 = self._run_schedule(tmp_path, "a", seed=97)
+        d2, o2 = self._run_schedule(tmp_path, "b", seed=97)
+        assert d1 == d2          # per-site decision journals, exactly
+        assert o1 == o2          # and the query outcomes they drove
+        assert any(fired for log in d1 for fired, _ in log), \
+            "schedule never fired — replay proves nothing"
+
+    def test_different_seed_differs(self, tmp_path):
+        d1, _ = self._run_schedule(tmp_path, "a", seed=97)
+        d2, _ = self._run_schedule(tmp_path, "b", seed=1234)
+        assert d1 != d2
+
+
+# ---------------------------------------------------------------------------
+# retry-storm regression + concurrent admission race
+# ---------------------------------------------------------------------------
+
+class TestRetryStormRegression:
+    def test_flapping_replica_bounded_retry_ratio(self, tmp_path):
+        """One replica flapping (50% connection drops) under 8-client
+        load: server-side attempts must stay within the budgeted
+        multiple of offered queries — no storm."""
+        from pinot_tpu.utils.metrics import get_registry
+        c = _mini_cluster(tmp_path, overrides={
+            "pinot.broker.retry.budget.ratio": 0.2,
+            "pinot.broker.retry.budget.min": 3.0})
+        try:
+            assert not c.query(QUERY).exceptions
+            b0 = self._counter(get_registry("broker"), "broker_queries")
+            r0 = self._counter(get_registry("broker"),
+                               "broker_retries_issued")
+            n_per_client, clients = 12, 8
+            with failpoints.armed("broker.scatter.before",
+                                  error=ConnectionError("flap"),
+                                  probability=0.5, seed=5,
+                                  where={"server": "server_1"}):
+                def loop():
+                    for _ in range(n_per_client):
+                        c.query(QUERY)  # partials allowed; hangs not
+                with ThreadPoolExecutor(max_workers=clients) as pool:
+                    for f in [pool.submit(loop) for _ in range(clients)]:
+                        f.result(timeout=60)
+            queries = self._counter(get_registry("broker"),
+                                    "broker_queries") - b0
+            retries = self._counter(get_registry("broker"),
+                                    "broker_retries_issued") - r0
+            assert queries == n_per_client * clients + 1 or \
+                queries >= n_per_client * clients
+            # the bound: ratio * queries + the min floor + slack for the
+            # deposits earned by clean responses mid-run
+            assert retries <= 0.2 * queries + 3.0 + 2.0, \
+                (retries, queries)
+        finally:
+            c.stop()
+
+    @staticmethod
+    def _counter(reg, family) -> float:
+        counters = reg.sample()["counters"]
+        return sum(v for k, v in counters.items()
+                   if k == family or k.startswith(family + "{"))
+
+
+class TestConcurrentAdmissionRace:
+    def test_every_query_one_typed_terminal_outcome(self, tmp_path):
+        """N clients racing a tiny queue: every query returns exactly
+        one outcome — clean rows, or a typed 211/250 partial. No hangs,
+        no untyped raises, no silent drops."""
+        c = _mini_cluster(tmp_path, overrides={
+            "pinot.server.query.num.threads": 1,
+            "pinot.server.admission.queue.limit": 2,
+            "pinot.broker.timeout.ms": 4000})
+        try:
+            assert not c.query(QUERY).exceptions
+            outcomes = []
+            lock = threading.Lock()
+            with failpoints.armed("server.execute.before", delay=0.03):
+                def loop():
+                    for _ in range(10):
+                        resp = c.query(QUERY)
+                        codes = tuple(sorted(
+                            e["errorCode"] for e in resp.exceptions))
+                        with lock:
+                            outcomes.append((codes, len(resp.rows)))
+                with ThreadPoolExecutor(max_workers=12) as pool:
+                    for f in [pool.submit(loop) for _ in range(12)]:
+                        f.result(timeout=120)
+            assert len(outcomes) == 120
+            allowed = {errorcodes.SERVER_OVERLOADED,
+                       errorcodes.EXECUTION_TIMEOUT}
+            for codes, rows in outcomes:
+                if codes:
+                    assert set(codes) <= allowed, codes
+                else:
+                    assert rows == 1
+            # the race actually exercised the rejection path
+            assert any(errorcodes.SERVER_OVERLOADED in codes
+                       for codes, _ in outcomes), \
+                "queue never overflowed — race not exercised"
+        finally:
+            c.stop()
+
+
+# ---------------------------------------------------------------------------
+# brownout ladder
+# ---------------------------------------------------------------------------
+
+def _brownout(history=None, watchdog=None, **knobs):
+    cfg = PinotConfiguration(overrides={
+        "pinot.brownout.up.seconds": 1.0,
+        "pinot.brownout.down.seconds": 2.0,
+        "pinot.brownout.shed.rate.threshold": 0.1,
+        "pinot.slo.window.short.seconds": 10.0,
+        **knobs})
+    # NOT `history or ...`: an EMPTY MetricsHistory is falsy (__len__)
+    return BrownoutController(
+        "testrole",
+        history if history is not None else MetricsHistory(64),
+        config=cfg, watchdog=watchdog)
+
+
+class _FakeWatchdog:
+    def __init__(self):
+        self.is_breached = False
+
+    def breached(self):
+        return self.is_breached
+
+
+class TestBrownoutHysteresis:
+    def test_climbs_only_after_sustained_signal(self):
+        dog = _FakeWatchdog()
+        b = _brownout(watchdog=dog)
+        t0 = 1000.0
+        dog.is_breached = True
+        assert b.evaluate(now=t0) == 0          # signal starts
+        assert b.evaluate(now=t0 + 0.5) == 0    # not sustained yet
+        assert b.evaluate(now=t0 + 1.1) == 1    # one rung after up_s
+        # the next rung needs ANOTHER full sustain period
+        assert b.evaluate(now=t0 + 1.5) == 1
+        assert b.evaluate(now=t0 + 2.2) == 2
+
+    def test_blip_does_not_climb(self):
+        dog = _FakeWatchdog()
+        b = _brownout(watchdog=dog)
+        t0 = 1000.0
+        dog.is_breached = True
+        b.evaluate(now=t0)
+        dog.is_breached = False
+        b.evaluate(now=t0 + 0.5)                # signal cleared
+        dog.is_breached = True
+        b.evaluate(now=t0 + 0.9)
+        assert b.evaluate(now=t0 + 1.5) == 0    # clock restarted at 0.9
+
+    def test_descends_only_after_sustained_clear(self):
+        dog = _FakeWatchdog()
+        b = _brownout(watchdog=dog)
+        t0 = 1000.0
+        dog.is_breached = True
+        b.evaluate(now=t0)
+        b.evaluate(now=t0 + 1.1)
+        assert b.level() == 1
+        dog.is_breached = False
+        assert b.evaluate(now=t0 + 2.0) == 1    # clear starts
+        assert b.evaluate(now=t0 + 3.0) == 1    # not sustained
+        assert b.evaluate(now=t0 + 4.1) == 0    # down after down_s
+
+    def test_shed_rate_hysteresis_band_holds_rung(self):
+        """Between exit (half the entry threshold) and entry thresholds
+        the ladder HOLDS: no climb, no descent — the anti-flap band.
+        The 10s shed-rate window slides, so each phase feeds its own
+        sample pair and evaluates with only that pair in window."""
+        hist = MetricsHistory(64)
+
+        def feed(shed, queries, ts):
+            hist.append({"ts": ts, "counters": {
+                "server_admission_rejected": shed,
+                "queries": queries}, "gauges": {}, "timers": {}})
+
+        b = _brownout(history=hist)
+        # phase A — rate 0.2 over the window: signal, climb after up_s
+        feed(0, 0, 1000.0)
+        feed(20, 100, 1005.0)
+        b.evaluate(now=1005.0)
+        assert b.evaluate(now=1006.1) == 1
+        # phase B — rate 7/100 = 0.07: below entry 0.1, above exit 0.05
+        feed(27, 200, 1016.0)
+        feed(34, 300, 1018.0)
+        for now in (1018.0, 1019.5, 1021.0, 1024.0):
+            assert b.evaluate(now=now) == 1
+        # phase C — rate 0: clear, descends only after down_s
+        feed(34, 400, 1029.0)
+        feed(34, 500, 1031.0)
+        assert b.evaluate(now=1031.0) == 1
+        assert b.evaluate(now=1033.2) == 0
+
+    def test_rung_engagement_order_and_window_scale(self):
+        dog = _FakeWatchdog()
+        b = _brownout(watchdog=dog)
+        _register_brownout("testrole", b)
+        try:
+            dog.is_breached = True
+            t0 = 2000.0
+            b.evaluate(now=t0)
+            for i, rung in enumerate(RUNGS):
+                b.evaluate(now=t0 + (i + 1) * 1.1)
+                assert b.engaged(rung), (i, rung)
+                assert all(b.engaged(r) for r in RUNGS[:i + 1])
+                assert not any(b.engaged(r) for r in RUNGS[i + 1:])
+            assert engaged("testrole", "shed_secondary")
+            assert window_scale("testrole") == 0.25
+            assert window_scale("some_other_role") == 1.0
+            payload = b.payload()
+            assert payload["level"] == 4 and not payload["ok"]
+            assert payload["engaged"] == list(RUNGS)
+        finally:
+            _register_brownout("testrole", None)
+        assert not engaged("testrole", "hedge_off")
+
+    def test_disabled_never_moves(self):
+        dog = _FakeWatchdog()
+        b = _brownout(watchdog=dog, **{"pinot.brownout.enabled": False})
+        dog.is_breached = True
+        for dt in (0.0, 2.0, 10.0):
+            assert b.evaluate(now=1000.0 + dt) == 0
+
+
+class TestBrownoutActuation:
+    def test_stale_cache_serving_flagged(self):
+        from pinot_tpu.cache.core import LruTtlCache
+        clock = [0.0]
+        cache = LruTtlCache(1 << 20, ttl_seconds=1.0,
+                            clock=lambda: clock[0],
+                            stale_grace_seconds=10.0)
+        cache.put("k", b"payload")
+        assert cache.get("k") == b"payload"
+        clock[0] = 2.0            # past TTL, inside grace
+        assert cache.get("k") is None          # normal read: miss
+        assert cache.get_stale("k") == b"payload"
+        clock[0] = 12.0           # past TTL + grace
+        assert cache.get_stale("k") is None
+        assert len(cache) == 0    # reclaimed
+
+    def test_stale_grace_zero_restores_delete_on_expiry(self):
+        from pinot_tpu.cache.core import LruTtlCache
+        clock = [0.0]
+        cache = LruTtlCache(1 << 20, ttl_seconds=1.0,
+                            clock=lambda: clock[0])
+        cache.put("k", b"payload")
+        clock[0] = 2.0
+        assert cache.get("k") is None
+        assert len(cache) == 0
+        assert cache.get_stale("k") is None
+
+    def test_broker_result_cache_stale_path(self):
+        from pinot_tpu.cache.broker_cache import BrokerResultCache
+        from pinot_tpu.query.reduce import BrokerResponse, ResultTable
+        cache = BrokerResultCache(ttl_seconds=0.05,
+                                  stale_grace_seconds=60.0)
+        resp = BrokerResponse(result_table=ResultTable(
+            ["c"], ["LONG"], [(1,)]))
+        resp.num_servers_queried = resp.num_servers_responded = 1
+        assert cache.put("fp", "t", "e1", resp)
+        time.sleep(0.08)
+        assert cache.get("fp", "t", "e1") is None
+        stale = cache.get("fp", "t", "e1", allow_stale=True)
+        assert stale is not None and stale.stale_result
+        assert stale.rows == [(1,)]
+        # staleResult surfaces in the client payload
+        assert stale.to_dict()["staleResult"] is True
+
+    def test_secondary_workload_shed_at_full_brownout(self):
+        dog = _FakeWatchdog()
+        hist = MetricsHistory(8)
+        cfg = PinotConfiguration(overrides={
+            "pinot.brownout.up.seconds": 0.0,
+            "pinot.brownout.down.seconds": 10.0})
+        b = BrownoutController("server", hist, config=cfg, watchdog=dog)
+        _register_brownout("server", b)
+        try:
+            dog.is_breached = True
+            for i in range(len(RUNGS)):
+                b.evaluate(now=3000.0 + i)
+            assert b.level() == len(RUNGS)
+            a = AdmissionController(num_threads=2, queue_limit=4)
+            rej = a.admit(table="t", workload="secondary")
+            assert isinstance(rej, ServerOverloadedError)
+            assert "secondary workloads shed" in str(rej)
+            assert a.admit(table="t", workload="primary") is None
+        finally:
+            _register_brownout("server", None)
+
+    def test_hedge_off_rung_disables_broker_hedging(self, tmp_path):
+        dog = _FakeWatchdog()
+        cfg = PinotConfiguration(overrides={
+            "pinot.brownout.up.seconds": 0.0})
+        b = BrownoutController("broker", MetricsHistory(8), config=cfg,
+                               watchdog=dog)
+        c = _mini_cluster(tmp_path, overrides={
+            "pinot.broker.hedge.enabled": True})
+        try:
+            assert c.broker._hedge_delay_s() is not None
+            _register_brownout("broker", b)
+            dog.is_breached = True
+            b.evaluate(now=4000.0)
+            assert b.engaged("hedge_off")
+            assert c.broker._hedge_delay_s() is None
+        finally:
+            _register_brownout("broker", None)
+            c.stop()
+
+
+@pytest.mark.chaos
+class TestBrownoutEndToEnd:
+    def test_slo_burn_drives_ladder_up_and_down(self, tmp_path):
+        """The full observe->act loop on a live MiniCluster: a forced
+        error burn breaches the SLO watchdog, the sampler-hooked
+        brownout controller climbs; the burn stops, the windows clear,
+        the ladder walks back down. Uses the REAL start_sampling wiring
+        (watchdog + brownout hooks, per-role registration)."""
+        from pinot_tpu.health.history import (get_history, start_sampling,
+                                              stop_sampling)
+        from pinot_tpu.health.rollup import role_health_summary
+        from pinot_tpu.health.slo import get_watchdog
+        overrides = {
+            "pinot.slo.error.rate": 0.01,
+            "pinot.slo.window.short.seconds": 1.0,
+            "pinot.slo.window.long.seconds": 2.0,
+            "pinot.slo.burn.threshold": 1.0,
+            "pinot.metrics.history.interval.ms": 50.0,
+            "pinot.brownout.up.seconds": 0.3,
+            "pinot.brownout.down.seconds": 0.6,
+        }
+        cfg = PinotConfiguration(overrides=overrides)
+        c = _mini_cluster(tmp_path, overrides=overrides)
+        get_history("broker").clear()
+        sampler = start_sampling("broker", cfg)
+        assert sampler is not None
+        try:
+            ctrl = get_brownout("broker")
+            assert ctrl is not None and get_watchdog("broker") is not None
+            # -- burn: every query errors (way past the 1% target) -----
+            deadline = time.time() + 12.0
+            with failpoints.armed("server.execute.before",
+                                  error=RuntimeError("burn")):
+                while time.time() < deadline and ctrl.level() == 0:
+                    resp = c.query(QUERY)
+                    assert resp.exceptions
+                    time.sleep(0.01)
+            assert ctrl.level() >= 1, "burn never climbed the ladder"
+            payload = role_health_summary("broker")
+            assert payload["subsystems"]["brownout"]["level"] >= 1
+            assert not payload["subsystems"]["brownout"]["ok"]
+            assert "brownout" in payload["degraded"] or \
+                payload["verdict"] == "degraded"
+            # -- recover: clean traffic until the windows forget -------
+            deadline = time.time() + 25.0
+            while time.time() < deadline and ctrl.level() > 0:
+                resp = c.query(QUERY)
+                assert not resp.exceptions
+                time.sleep(0.01)
+            assert ctrl.level() == 0, "ladder never walked back down"
+            assert role_health_summary(
+                "broker")["subsystems"]["brownout"]["ok"]
+        finally:
+            stop_sampling("broker")
+            c.stop()
+        assert get_brownout("broker") is None
+
+
+# ---------------------------------------------------------------------------
+# tier-1 smoke of the acceptance driver
+# ---------------------------------------------------------------------------
+
+class TestOverloadBenchSmoke:
+    def test_overload_bench_smoke(self, tmp_path):
+        """The --overload acceptance scenario at smoke scale: protected
+        goodput holds under 4x offered load, the unprotected A/B leg
+        degrades, zero hung queries, CI-tolerant overhead bound."""
+        import bench
+        out = str(tmp_path / "BENCH_overload_smoke.json")
+        bench.overload_main(smoke=True, out_path=out)
+        import json
+        data = json.loads(open(out).read())
+        assert data["smoke"] is True
+        assert data["hung_queries_total"] == 0
+        assert data["admission_rejects"] > 0

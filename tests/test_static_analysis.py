@@ -753,6 +753,117 @@ class TestFailpointArming:
 
 
 # ---------------------------------------------------------------------------
+# error-code registry checker
+# ---------------------------------------------------------------------------
+
+EC_REGISTRY = '''
+    SERVER_ERROR = 427
+    EXECUTION_TIMEOUT = 250
+
+    CODES = {
+        "SERVER_ERROR": "server unreachable",
+        "EXECUTION_TIMEOUT": "deadline exhausted",
+    }
+'''
+
+
+class TestErrorCodeChecker:
+    def _files(self, emitter):
+        return {"pinot_tpu/utils/errorcodes.py": EC_REGISTRY,
+                "pinot_tpu/utils/accounting.py": "X = 1\n",
+                "pinot_tpu/broker/mod.py": emitter}
+
+    def test_literal_dict_emission_flagged(self, tmp_path):
+        rep = _run(tmp_path, self._files('''
+            from pinot_tpu.utils import errorcodes
+            USE = (errorcodes.SERVER_ERROR, errorcodes.EXECUTION_TIMEOUT)
+            def fail():
+                return {"errorCode": 427, "message": "boom"}
+        '''), "errorcodes")
+        assert _keys(rep) == {"literal:dict:427"}
+
+    def test_literal_comparison_and_get_default_flagged(self, tmp_path):
+        rep = _run(tmp_path, self._files('''
+            from pinot_tpu.utils import errorcodes
+            USE = (errorcodes.SERVER_ERROR, errorcodes.EXECUTION_TIMEOUT)
+            def check(e):
+                if e.get("errorCode") == 250:
+                    return int(e.get("errorCode", 200))
+        '''), "errorcodes")
+        assert _keys(rep) == {"literal:cmp:250", "literal:default:200"}
+
+    def test_error_response_helper_and_assign_flagged(self, tmp_path):
+        rep = _run(tmp_path, self._files('''
+            from pinot_tpu.utils import errorcodes
+            USE = (errorcodes.SERVER_ERROR, errorcodes.EXECUTION_TIMEOUT)
+            def _error_response(code, msg):
+                return (code, msg)
+            class Boom(Exception):
+                ERROR_CODE = 427
+            def fail():
+                return _error_response(427, "x")
+        '''), "errorcodes")
+        assert _keys(rep) == {"literal:call:427",
+                              "literal:assign:ERROR_CODE"}
+
+    def test_catalog_reference_clean(self, tmp_path):
+        rep = _run(tmp_path, self._files('''
+            from pinot_tpu.utils import errorcodes
+            def fail():
+                return {"errorCode": errorcodes.SERVER_ERROR,
+                        "message": "boom"}
+            def check(e):
+                return e.get("errorCode") == errorcodes.EXECUTION_TIMEOUT
+        '''), "errorcodes")
+        assert not rep.unsuppressed
+
+    def test_phantom_code_flagged(self, tmp_path):
+        rep = _run(tmp_path, {
+            "pinot_tpu/utils/errorcodes.py": '''
+                SERVER_ERROR = 427
+                NEVER_USED = 999
+
+                CODES = {"SERVER_ERROR": "x", "NEVER_USED": "y"}
+            ''',
+            "pinot_tpu/utils/accounting.py": "X = 1\n",
+            "pinot_tpu/broker/mod.py": '''
+                from pinot_tpu.utils import errorcodes
+                USE = errorcodes.SERVER_ERROR
+            '''}, "errorcodes")
+        assert _keys(rep) == {"dead:NEVER_USED"}
+
+    def test_undescribed_code_flagged(self, tmp_path):
+        rep = _run(tmp_path, {
+            "pinot_tpu/utils/errorcodes.py": '''
+                SERVER_ERROR = 427
+
+                CODES = {}
+            ''',
+            "pinot_tpu/utils/accounting.py": "X = 1\n",
+            "pinot_tpu/broker/mod.py": '''
+                from pinot_tpu.utils import errorcodes
+                USE = errorcodes.SERVER_ERROR
+            '''}, "errorcodes")
+        assert _keys(rep) == {"undescribed:SERVER_ERROR"}
+
+    def test_missing_registry_module_flagged(self, tmp_path):
+        rep = _run(tmp_path, {
+            "pinot_tpu/utils/accounting.py": "X = 1\n"}, "errorcodes")
+        assert _keys(rep) == {"registry:missing"}
+
+    def test_inline_suppression_accepted(self, tmp_path):
+        rep = _run(tmp_path, self._files('''
+            from pinot_tpu.utils import errorcodes
+            USE = (errorcodes.SERVER_ERROR, errorcodes.EXECUTION_TIMEOUT)
+            def fail():
+                # lint: errorcode(wire-compat shim for a foreign code)
+                return {"errorCode": 599, "message": "boom"}
+        '''), "errorcodes")
+        assert not rep.unsuppressed
+        assert len(rep.inline_suppressed) == 1
+
+
+# ---------------------------------------------------------------------------
 # THE TIER-1 GATE
 # ---------------------------------------------------------------------------
 
@@ -799,7 +910,8 @@ class TestRepoGate:
     def test_all_checkers_registered_and_ran(self, report):
         from pinot_tpu.analysis import CHECKERS
         assert set(CHECKERS) == {"locks", "hangs", "failpoints", "knobs",
-                                 "purity", "exposition", "metrics_docs"}
+                                 "purity", "exposition", "metrics_docs",
+                                 "errorcodes"}
         ran = {f.checker for f in report.findings}
         # lock/knob findings exist (baselined); the others may be clean,
         # which the per-checker fixture tests above keep honest
