@@ -2102,6 +2102,293 @@ def batching_main(smoke: bool = False, out_path: str = None):
 
 
 # ---------------------------------------------------------------------------
+# --startree: device star-tree pre-agg vs scan (ISSUE 16)
+# ---------------------------------------------------------------------------
+
+def startree_main(smoke: bool = False, out_path: str = None):
+    """--startree [--smoke]: A/B the device star-tree pre-agg leg
+    (ISSUE 16) against the device scan path.
+
+    Scaling leg — the same dimensional distribution is built at a base
+    row count and at ``factor``x rows (100x in the full run), each with
+    a star-tree. Two engines run every query: one serving from the
+    pre-agg leg, one with ``pinot.server.startree.enabled=false`` (the
+    scan path). Both end-to-end p50 and the DEVICE-level steady-state
+    launch+sync time are recorded. The star-tree table's pre-agg record
+    count is bounded by the dimension-combination space, not the row
+    count, so its kernel reads the SAME [S, D] shape at both sizes —
+    device time stays ~flat while the scan kernel's D bucket grows with
+    the data. (End-to-end p50 carries fixed per-query host work — parse,
+    plan, result assembly — so the device-level ratio is the asserted
+    signal; the p50s are reported for color.)
+
+    Coalesce leg — 8 clients loop fingerprint-equal star-tree queries
+    (same plan, different predicate constants) against one pipelined
+    engine: the unified-factory coalesce key (plan fingerprint + shape
+    bucket) must batch them (`dispatch_batch_size` max > 1) with ZERO
+    steady-state retraces after the shape buckets are warmed.
+
+    Every query is parity-checked against the scan engine (1e-6
+    relative, the repo's device-parity standard — the pre-agg leg runs
+    f32 like the scan path). Writes BENCH_startree.json. --smoke
+    shrinks rows/iters/windows to fit the tier-1 timeout."""
+    import contextlib
+    import statistics as stats
+    import tempfile
+    import threading
+
+    import jax
+
+    from pinot_tpu.models import (DataType, FieldSpec, FieldType, Schema,
+                                  StarTreeIndexConfig, TableConfig,
+                                  TableType)
+    from pinot_tpu.ops import dispatch as dispatch_mod
+    from pinot_tpu.ops import kernels
+    from pinot_tpu.ops.engine import TpuOperatorExecutor
+    from pinot_tpu.query.executor import QueryExecutor
+    from pinot_tpu.segment.creator import SegmentCreator
+    from pinot_tpu.segment.loader import load_segment
+    from pinot_tpu.utils.config import PinotConfiguration
+
+    base_docs = 1_200 if smoke else 3_000
+    factor = 10 if smoke else 100
+    num_segments = 2 if smoke else 4
+    p50_iters = 6 if smoke else 30
+    dev_iters = 8 if smoke else 25
+    window_s = 0.8 if smoke else 2.5
+    clients = 8
+
+    tmp = tempfile.mkdtemp(prefix="bench_startree_")
+    schema = Schema("stb", [
+        FieldSpec("country", DataType.STRING),
+        FieldSpec("browser", DataType.STRING),
+        FieldSpec("locale", DataType.STRING),
+        FieldSpec("impressions", DataType.LONG, FieldType.METRIC),
+        FieldSpec("cost", DataType.DOUBLE, FieldType.METRIC),
+    ])
+    tc = TableConfig("stb", TableType.OFFLINE)
+    tc.indexing.star_tree_configs = [StarTreeIndexConfig(
+        dimensions_split_order=["country", "browser", "locale"],
+        function_column_pairs=["SUM__impressions", "MAX__cost",
+                               "SUM__cost"],
+        max_leaf_records=10)]
+    creator = SegmentCreator(tc, schema)
+
+    def build(tag, docs_per_seg, seed):
+        segs = []
+        for i in range(num_segments):
+            rng = np.random.default_rng(seed + i)
+            out = os.path.join(tmp, f"stb_{tag}_{i}")
+            creator.build({
+                "country": [f"c{v}" for v in
+                            rng.integers(0, 20, docs_per_seg)],
+                "browser": [f"b{v}" for v in
+                            rng.integers(0, 6, docs_per_seg)],
+                "locale": [f"l{v}" for v in
+                           rng.integers(0, 10, docs_per_seg)],
+                "impressions": rng.integers(
+                    0, 1000, docs_per_seg).astype(np.int64),
+                "cost": rng.random(docs_per_seg) * 100,
+            }, out, f"stb_{tag}_{i}")
+            segs.append(load_segment(out))
+        return segs
+
+    sizes = {"1x": build("1x", base_docs // num_segments, 4000),
+             f"{factor}x": build("nx", base_docs * factor // num_segments,
+                                 5000)}
+
+    def parity_sqls(alt):
+        return [
+            "SELECT SUM(impressions), COUNT(*) FROM stb "
+            f"WHERE country = 'c{alt}'",
+            "SELECT SUM(impressions) FROM stb "
+            f"WHERE country IN ('c1','c2','c{alt}') AND browser = 'b2'",
+            "SELECT MAX(cost), SUM(cost), COUNT(*) FROM stb",
+            "SELECT browser, SUM(impressions), COUNT(*) FROM stb "
+            f"WHERE locale = 'l{alt % 10}' "
+            "GROUP BY browser ORDER BY browser LIMIT 100",
+        ]
+
+    p50_sql = parity_sqls(3)[0]
+
+    def rows_close(a, b):
+        if len(a) != len(b):
+            return False
+        for x, y in zip(a, b):
+            if isinstance(x, float) or isinstance(y, float):
+                if not (abs(float(x) - float(y))
+                        <= 1e-6 * max(1.0, abs(float(x)))):
+                    return False
+            elif x != y:
+                return False
+        return True
+
+    labels = {"bench_leg": "startree"}
+    eng_tree = TpuOperatorExecutor(
+        config=PinotConfiguration(), metrics_labels=labels)
+    eng_scan = TpuOperatorExecutor(
+        config=PinotConfiguration(overrides={
+            "pinot.server.startree.enabled": False}),
+        metrics_labels={"bench_leg": "startree_scan"})
+    reg = eng_tree._dispatcher._metrics
+
+    from pinot_tpu.query.context import QueryContext
+
+    def timed_device(launch, iters):
+        guard = dispatch_mod._CPU_COLLECTIVE_LOCK if launch.collective \
+            else contextlib.nullcontext()
+        with guard:
+            jax.block_until_ready(launch.call())  # warm
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                jax.block_until_ready(launch.call())
+            return (time.perf_counter() - t0) / iters * 1e3
+
+    report_sizes = {}
+    for tag, segs in sizes.items():
+        ex_tree = QueryExecutor(segs, use_tpu=True, engine=eng_tree)
+        ex_scan = QueryExecutor(segs, use_tpu=True, engine=eng_scan)
+        served0 = reg.meter("startree_served", labels=labels)
+        for sql in parity_sqls(3) + parity_sqls(7):
+            rt = ex_tree.execute(sql)
+            rs = ex_scan.execute(sql)
+            assert not rt.exceptions and not rs.exceptions, (tag, sql)
+            ra = sorted(map(str, rt.result_table.rows))
+            rb = sorted(map(str, rs.result_table.rows))
+            assert len(ra) == len(rb), (tag, sql)
+            for a, b in zip(ra, rb):
+                assert rows_close(eval(a), eval(b)), (tag, sql, a, b)
+        served = reg.meter("startree_served", labels=labels) - served0
+        assert served > 0, f"{tag}: no query served from the pre-agg leg"
+
+        # device-level steady state: one launch+sync, params cache warm
+        ctx = QueryContext.from_sql(p50_sql)
+        prep_t = eng_tree._prepare_startree(segs, ctx)
+        assert prep_t is not None, f"{tag}: pre-agg leg refused to stage"
+        launch_t = prep_t[4]
+        prep_s = eng_scan._prepare_agg(segs, QueryContext.from_sql(p50_sql))
+        assert prep_s is not None
+        launch_s = prep_s[3]
+        dev_tree_ms = timed_device(launch_t, dev_iters)
+        dev_scan_ms = timed_device(launch_s, dev_iters)
+
+        def p50(ex):
+            lat = []
+            for _ in range(p50_iters):
+                t0 = time.perf_counter()
+                ex.execute(p50_sql)
+                lat.append((time.perf_counter() - t0) * 1e3)
+            return stats.median(lat)
+
+        report_sizes[tag] = {
+            "docs": sum(s.num_docs for s in segs),
+            "preagg_records": sum(
+                int(f.tree.meta.num_records) for f in prep_t[2]),
+            "device_tree_ms": round(dev_tree_ms, 3),
+            "device_scan_ms": round(dev_scan_ms, 3),
+            "p50_tree_ms": round(p50(ex_tree), 2),
+            "p50_scan_ms": round(p50(ex_scan), 2),
+            "startree_served": int(served),
+        }
+
+    big = f"{factor}x"
+    tree_growth = report_sizes[big]["device_tree_ms"] \
+        / max(report_sizes["1x"]["device_tree_ms"], 1e-9)
+    scan_growth = report_sizes[big]["device_scan_ms"] \
+        / max(report_sizes["1x"]["device_scan_ms"], 1e-9)
+
+    # -- coalesce leg: fingerprint-equal queries share one launch -----
+    segs = sizes[big]
+    ex_tree = QueryExecutor(segs, use_tpu=True, engine=eng_tree)
+    coal_sqls = [parity_sqls(i)[0] for i in range(clients)]
+    for sql in coal_sqls:  # stage + params-cache every predicate
+        ex_tree.execute(sql)
+    launch = eng_tree._prepare_startree(
+        segs, QueryContext.from_sql(coal_sqls[0]))[4]
+    guard = dispatch_mod._CPU_COLLECTIVE_LOCK if launch.collective \
+        else contextlib.nullcontext()
+    b = 2
+    while b <= dispatch_mod._pow2(clients):
+        kern = launch.factory(b, False)
+        with guard:
+            jax.block_until_ready(kern(
+                launch.cols, (launch.params,) * b, launch.num_docs,
+                D=launch.D, G=launch.G))
+        b *= 2
+    traces0 = kernels.trace_count()
+    batch_t0 = reg.timer("dispatch_batch_size", labels=labels)
+    count0, max0 = batch_t0.count, batch_t0.max_ms
+
+    stop_at = time.perf_counter() + window_s
+    done = [0] * clients
+
+    def client(ci):
+        j = 0
+        while time.perf_counter() < stop_at:
+            ex_tree.execute(coal_sqls[(ci + j) % clients])
+            done[ci] += 1
+            j += 1
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    retraces = kernels.trace_count() - traces0
+    batch_t = reg.timer("dispatch_batch_size", labels=labels)
+    coalesce = {
+        "clients": clients,
+        "queries_completed": int(sum(done)),
+        "qps": round(sum(done) / wall, 2),
+        "batch_launches": batch_t.count - count0,
+        "batch_size_max": max(batch_t.max_ms, max0),
+        "retraces_steady": retraces,
+    }
+
+    out = {
+        "metric": "startree_device_time_growth_at_{}".format(big),
+        "value": round(tree_growth, 2),
+        "unit": "x",
+        "scan_growth": round(scan_growth, 2),
+        "smoke": smoke,
+        "platform": jax.devices()[0].platform,
+        "sizes": report_sizes,
+        "coalesce": coalesce,
+        "asserted": {
+            "parity": "pre-agg rows == scan rows, 1e-6 relative",
+            "max_steady_retraces": 0,
+            "min_batch_size": 2,
+            "full_run_only": "device tree growth ~flat (< 3x) while "
+                             "rows grow {}x; scan growth exceeds "
+                             "tree growth".format(factor),
+        },
+    }
+    if out_path is None:
+        out_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "BENCH_startree.json")
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps(out))
+    assert coalesce["retraces_steady"] == 0, \
+        f"steady-state retraces: {coalesce['retraces_steady']}"
+    assert coalesce["batch_size_max"] >= 2, \
+        "fingerprint-equal star-tree queries never coalesced"
+    if not smoke:
+        assert tree_growth < 3.0, \
+            f"pre-agg device time grew {tree_growth:.2f}x at {big} rows"
+        assert scan_growth > tree_growth, \
+            f"scan growth {scan_growth:.2f}x did not exceed tree " \
+            f"growth {tree_growth:.2f}x"
+        assert report_sizes[big]["device_tree_ms"] \
+            < report_sizes[big]["device_scan_ms"], \
+            "pre-agg kernel slower than the scan kernel at scale"
+
+
+# ---------------------------------------------------------------------------
 # --ingest: production ingestion under mixed read/write load (ISSUE 11)
 # ---------------------------------------------------------------------------
 
@@ -2659,9 +2946,18 @@ def health_main(smoke: bool = False, out_path: "str | None" = None):
             for phase in (0, 1):
                 sampling = (phase == 0) == run_first
                 if sampling:
+                    ticks_before = len(hist)
                     sampler.start()
                 lat = [one(c_off) for _ in range(block_n)]
                 if sampling:
+                    # a fast block can finish inside the sampler's first
+                    # 50ms wait; hold it open (latencies are already
+                    # collected) until it has ticked so every sampling
+                    # block actually exercises the sample+watchdog path
+                    deadline = time.perf_counter() + 2.0
+                    while (len(hist) == ticks_before
+                           and time.perf_counter() < deadline):
+                        time.sleep(0.005)
                     sampler.stop()
                     with_s.append(stats.median(lat))
                 else:
@@ -3157,6 +3453,8 @@ if __name__ == "__main__":
         groups_main(smoke="--smoke" in sys.argv)
     elif "--batching" in sys.argv:
         batching_main(smoke="--smoke" in sys.argv)
+    elif "--startree" in sys.argv:
+        startree_main(smoke="--smoke" in sys.argv)
     elif "--ingest" in sys.argv:
         ingest_main(smoke="--smoke" in sys.argv)
     elif "--health" in sys.argv:

@@ -37,7 +37,8 @@ from pinot_tpu.analysis.core import (
     Checker, Finding, ModuleIndex, SourceFile, call_name, register,
 )
 
-_KERNEL_MODULES = ("pinot_tpu/ops/kernels.py",)
+_KERNEL_MODULES = ("pinot_tpu/ops/kernels.py",
+                   "pinot_tpu/ops/startree_device.py")
 #: modules that own device synchronization — host syncs are their job
 _SYNC_OK = {"pinot_tpu/ops/dispatch.py", "pinot_tpu/ops/engine.py",
             "pinot_tpu/ops/residency.py"}

@@ -490,12 +490,32 @@ class TaskManager:
             self.state, f"{cfg.name}_OFFLINE",
             max_segments_per_task=int(params.get("maxSegmentsPerTask", 16)))
 
+    def _gen_startree_build(self, cfg, params) -> List[TaskConfig]:
+        # no tree config anywhere -> nothing the executor could build;
+        # upsert tables never build (TableConfig.validate rejects the
+        # combination — pre-agg records cannot apply validDocIds)
+        if cfg.upsert:
+            return []
+        if not (params.get("starTreeIndexConfigs")
+                or cfg.indexing.star_tree_configs):
+            return []
+        from pinot_tpu.controller.tasks import generate_startree_build_tasks
+        types = params.get("tableTypes") or ["REALTIME", "OFFLINE"]
+        out: List[TaskConfig] = []
+        for t in types:
+            out += generate_startree_build_tasks(
+                self.state, f"{cfg.name}_{t}",
+                max_segments_per_task=int(
+                    params.get("maxSegmentsPerTask", 16)))
+        return out
+
     #: task-config key -> generator method; a table opts in per type via
     #: ``TableConfig.task_configs[<task type>]`` (taskTypeConfigsMap)
     GENERATORS = {
         "MergeRollupTask": _gen_merge_rollup,
         "RealtimeToOfflineSegmentsTask": _gen_realtime_to_offline,
         "PurgeTask": _gen_purge,
+        "StarTreeBuildTask": _gen_startree_build,
     }
 
     def generate_tasks(self) -> int:

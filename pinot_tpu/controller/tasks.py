@@ -237,6 +237,75 @@ class PurgeTaskExecutor(TaskExecutor):
         return {"purgedSegments": purged}
 
 
+class StarTreeBuildTaskExecutor(TaskExecutor):
+    """Grow star-trees on already-sealed segments WITHOUT re-ingest:
+    rebuild each segment from its own columns under a config carrying
+    starTreeIndexConfigs, and commit through the same publish/retire
+    (manifest + replace_segments) swap as every other rewrite task.
+    This is how a realtime table whose seal path skipped tree building
+    (or whose tree config was added after the fact) converges onto the
+    device star-tree serving path, one routing-epoch swap per segment.
+
+    The tree config comes from task params ("starTreeIndexConfigs",
+    list of StarTreeIndexConfig dicts) or, absent that, the table's
+    indexing config. Build output is deterministic in the input segment
+    bytes + config (the builder has no randomness and the output name
+    is a pure function of the input name), so a re-leased crashed task
+    rebuilds byte-identical trees and the commit stays idempotent.
+    Convergence marker is the segment metadata's "starTree" entry — not
+    a name suffix — so the generator never rescans a built segment."""
+    task_type = "StarTreeBuildTask"
+
+    def execute(self, task: TaskConfig, ctx: TaskContext) -> Dict[str, Any]:
+        import copy
+
+        from pinot_tpu.models import StarTreeIndexConfig
+        from pinot_tpu.utils.failpoints import fire
+        table = task.table
+        cfg = ctx.table_config(table)
+        if cfg.upsert:
+            raise ValueError(
+                "StarTreeBuildTask on upsert table: pre-aggregated "
+                "records cannot apply validDocIds")
+        schema = ctx.schema_for(table)
+        st_cfgs = [StarTreeIndexConfig.from_dict(d)
+                   for d in task.params.get("starTreeIndexConfigs") or []]
+        if not st_cfgs:
+            st_cfgs = list(cfg.indexing.star_tree_configs)
+        if not st_cfgs:
+            raise ValueError(
+                "StarTreeBuildTask needs starTreeIndexConfigs (task "
+                "params or table indexing config)")
+        build_cfg = copy.deepcopy(cfg)
+        build_cfg.indexing.star_tree_configs = st_cfgs
+        built = []
+        for seg_name in task.segments:
+            # chaos site: a crash here leaves the source segment
+            # serving via the scan path; the re-leased task rebuilds
+            # the SAME tree bytes (deterministic build + output name)
+            fire("minion.startree.build", table=table, segment=seg_name)
+            seg = ctx.load(table, seg_name)
+            columns = {}
+            for spec in schema.fields:
+                if spec.virtual:
+                    continue
+                columns[spec.name] = np.asarray(
+                    seg.data_source(spec.name).values())
+            name = f"{seg_name}_sttree"
+            out_dir = os.path.join(ctx.output_dir, name)
+            SegmentCreator(build_cfg, schema).build(columns, out_dir, name)
+            m = load_segment(out_dir).metadata
+            old_state = ctx.segment_state(table, seg_name)
+            ctx.publish_segment(SegmentState(
+                name=name, table=table,
+                instances=list(old_state.instances), dir_path=out_dir,
+                num_docs=m.num_docs, start_time=m.start_time,
+                end_time=m.end_time, crc=m.crc))
+            ctx.retire_segment(table, seg_name)
+            built.append(name)
+        return {"builtSegments": built}
+
+
 # -- generators (ref PinotTaskGenerator) ------------------------------------
 
 def generate_merge_rollup_tasks(state: ClusterState, table: str,
@@ -313,6 +382,34 @@ def generate_purge_tasks(state: ClusterState, table: str,
     return tasks
 
 
+def generate_startree_build_tasks(state: ClusterState, table: str,
+                                  max_segments_per_task: int = 16
+                                  ) -> List[TaskConfig]:
+    """Batch ONLINE segments that carry NO star-tree into build tasks.
+    The convergence marker is the segment metadata's "starTree" entry
+    (one json peek per candidate — no segment load), so the scan
+    self-quiesces after one pass instead of rebuilding its own output;
+    segments whose metadata isn't locally readable (deep-store URIs not
+    yet localized) are skipped this tick rather than churned."""
+    import json
+
+    def has_tree(s: SegmentState) -> bool:
+        try:
+            with open(os.path.join(s.dir_path, "metadata.json")) as f:
+                return bool(json.load(f).get("starTree"))
+        except (OSError, ValueError):
+            return True  # unreadable here -> leave it alone
+    segs = sorted((s for s in state.table_segments(table)
+                   if s.status == "ONLINE" and not has_tree(s)),
+                  key=lambda s: s.name)
+    tasks: List[TaskConfig] = []
+    for i in range(0, len(segs), max_segments_per_task):
+        chunk = segs[i:i + max_segments_per_task]
+        tasks.append(TaskConfig("StarTreeBuildTask", table,
+                                [c.name for c in chunk]))
+    return tasks
+
+
 _EXECUTORS: Dict[str, TaskExecutor] = {}
 
 
@@ -338,3 +435,4 @@ def run_task(task: TaskConfig, ctx: TaskContext) -> Dict[str, Any]:
 register_executor(MergeRollupTaskExecutor())
 register_executor(RealtimeToOfflineTaskExecutor())
 register_executor(PurgeTaskExecutor())
+register_executor(StarTreeBuildTaskExecutor())

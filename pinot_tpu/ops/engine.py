@@ -31,6 +31,7 @@ import jax.numpy as jnp
 
 from pinot_tpu.ops import dispatch as dispatch_mod
 from pinot_tpu.ops import kernels
+from pinot_tpu.ops import startree_device
 from pinot_tpu.ops.dispatch import KernelDispatcher, Launch
 from pinot_tpu.ops.plan_ir import DeviceLeaf, DevicePlan
 from pinot_tpu.query.context import QueryContext
@@ -174,6 +175,14 @@ class TpuOperatorExecutor:
             and self._dispatcher.batch_max > 1)
         self._doc_bucket_max = _cfg.get_int(
             "pinot.server.dispatch.doc.bucket.max")
+        #: star-tree device leg (ops/startree_device.py): fitted queries
+        #: aggregate pre-agg records through the kernel factory instead
+        #: of scanning raw rows; hbm.resident admits the pre-agg
+        #: pseudo-columns into the per-(segment, column) residency tier
+        self._startree_enabled = _cfg.get_bool(
+            "pinot.server.startree.enabled", True)
+        self._st_resident = _cfg.get_bool(
+            "pinot.server.startree.hbm.resident", True)
         self._metrics = self._dispatcher._metrics
         self._residency._metrics = self._metrics
 
@@ -410,6 +419,243 @@ class TpuOperatorExecutor:
             slip=slip, docs=sum(s.num_docs for s in segments))
         return plan, slots_of_fn, S_real, launch
 
+    # ------------------------------------------------------------------
+    # star-tree device leg (ops/startree_device.py)
+    # ------------------------------------------------------------------
+    def _startree_candidate(self, segments) -> bool:
+        """Cheap structural gate before the star-tree planner runs: only
+        batches where EVERY segment carries a tree reach the planner, so
+        treeless tables pay one getattr per segment and the fallback
+        meter never fires where a tree could never serve (its reason
+        labels stay meaningful). Upsert guard (PR 11): a partially-valid
+        bitmap means pre-agg records include retracted rows, which no
+        selection mask over the PRE-AGG table can subtract — scan path
+        only (the host star-tree executor applies the same rule).
+        Doc-sharded meshes keep the scan leg: the star-tree kernel has
+        no shard_map variant, and pre-agg tables are small enough that
+        sharding them buys nothing."""
+        if not self._startree_enabled or self._doc_axis > 1:
+            return False
+        for s in segments:
+            reader = getattr(s, "star_tree", None)
+            if reader is None or not reader.trees:
+                return False
+            vd = getattr(s, "valid_doc_ids", None)
+            if vd is not None and not vd.is_full():
+                return False
+        return True
+
+    def _st_fallback(self, reason: str) -> None:
+        """startree_fallback{reason=}: why a tree-carrying batch went to
+        the scan path (labeled like server_admission_rejected)."""
+        if self._metrics is None:
+            return
+        labels = dict(self._labels or {})
+        labels["reason"] = reason
+        self._metrics.add_meter("startree_fallback", labels=labels)
+
+    def _prepare_startree(self, segments: List[ImmutableSegment],
+                          ctx: QueryContext, cancel_check=None,
+                          parent_span=None, slip=None):
+        """Star-tree leg of prepare: fit check + host tree traversal
+        (startree_device.plan_startree), then stage the fitted trees'
+        pre-agg pseudo-columns and wrap the residual-aggregation launch
+        for the dispatch ring. Returns (plan, needed, fits, S_real,
+        Launch), or None -> the caller falls through to the scan-path
+        prepare (and transitively to the host path). Mirrors
+        _prepare_agg's lock/span/odometer discipline exactly; the
+        DeviceDispatch span carries starTree=true so traces distinguish
+        pre-agg serves from scans."""
+        if parent_span is None:
+            parent_span = tracing.capture()
+        dsp = None
+        if parent_span is not None:
+            dsp = parent_span.child("DeviceDispatch", table=ctx.table,
+                                    mode="startree", starTree=True)
+        from pinot_tpu.ops import residency as residency_mod
+        busy0 = self._dispatcher.busy_ms()
+        with self._engine_lock:
+            xfer0 = residency_mod.transfer_bytes() if slip is not None else 0
+            stage_info = self._staging_snapshot(dsp)
+            plan, needed, fits, reason = startree_device.plan_startree(
+                segments, ctx)
+            if plan is None:
+                self._st_fallback(reason)
+                if dsp is not None:
+                    dsp.end(outcome="scanFallback", reason=reason)
+                return None
+            kernel = startree_device.compiled_startree_kernel(plan)
+            batchable = isinstance(kernel, jax.stages.Wrapped)
+            factory = (lambda B, stacked, _p=plan:
+                       startree_device.compiled_batched_startree_kernel(
+                           _p, B, stacked))
+            try:
+                cols, params, num_docs, S_real, D = self._stage_startree_locked(
+                    segments, ctx, plan, fits, batchable=batchable)
+            except _NotStageable:
+                self._st_fallback("staging")
+                if dsp is not None:
+                    dsp.end(outcome="scanFallback", reason="staging")
+                return None
+            self._staging_attrs(dsp, stage_info, S=int(num_docs.shape[0]),
+                                D=D, G=plan.num_groups)
+            if slip is not None:
+                slip.add(transfer_bytes=int(
+                    residency_mod.transfer_bytes() - xfer0))
+        overlap = self._dispatcher.busy_ms() - busy0
+        if overlap > 0:
+            self._dispatcher.observe("staging_overlap_ms", overlap)
+        self._meter("startree_served")
+        batch_key = None
+        if batchable and self._dispatcher.batch_max > 1:
+            if self._cross_table and D <= self._doc_bucket_max:
+                # the same kernel-factory coalesce key as scans: plan
+                # fingerprint + shape bucket — fingerprint-equal
+                # star-tree queries (same slots/radix, any predicate
+                # constants) share ONE jit(vmap) launch
+                S = int(num_docs.shape[0])
+                batch_key = (plan, S, D, 0, _shape_sig(cols, params))
+            else:
+                batch_key = (plan, _batch_id(segments), D, 0)
+        # the staged-block identity carries the fitted tree indexes:
+        # members whose filters fit DIFFERENT trees of one segment must
+        # stack, not share a broadcast block
+        tis = tuple(f.ti for f in fits)
+        launch = Launch(
+            call=lambda: kernel(cols, params, num_docs, D=D, G=0),
+            plan=plan, cols=cols, params=params, num_docs=num_docs,
+            D=D, G=0, batch_key=batch_key,
+            cols_key=(_batch_id(segments), tis),
+            factory=factory, dedup_factory=None,
+            collective=self._needs_cpu_ordering(kernel),
+            cancel_check=cancel_check,
+            site_ctx={"table": ctx.table, "mode": "startree"}, span=dsp,
+            slip=slip, docs=sum(s.num_docs for s in segments))
+        return plan, needed, fits, S_real, launch
+
+    def _stage_startree_locked(self, segments, ctx: QueryContext, plan, fits,
+                        batchable: bool = True):
+        """Stage the fitted trees' pre-agg metric/dim-code rows as
+        `(segment, "__startree__<ti>/<col>")` pseudo-columns through the
+        same host-row / residency / assembled-block tiers as real
+        columns, plus the per-query [S, D] selection mask (the traversal
+        result) as kernel params. D is the pow2 bucket of the LARGEST
+        fitted tree's record count: star records make num_records exceed
+        num_docs, so the scan path's bucket cannot be reused."""
+        S_real = len(segments)
+        max_recs = max(int(f.tree.meta.num_records) for f in fits)
+        if max_recs > MAX_DOCS_PER_SEGMENT:
+            raise _NotStageable()
+        D = _pow2(max_recs)
+        if D % self._doc_axis:
+            a = self._doc_axis
+            D = ((D + a - 1) // a) * a
+        S = self._padded_S(
+            S_real, bucket=batchable and D <= self._doc_bucket_max)
+        vdt = np.float64 if jax.config.read("jax_enable_x64") else np.float32
+
+        cols: Dict[str, jnp.ndarray] = {}
+        for ckey, form, dtype in startree_device.staged_columns(plan, vdt):
+            cols[ckey] = self._st_block_locked(segments, fits, S, D, ckey, form,
+                                        dtype)
+
+        # selection mask + record counts: cached like predicate params —
+        # a repeat query (same batch, same plan shape, same filter)
+        # re-traverses nothing and uploads nothing. The fitted tree
+        # indexes are deterministic in (segments, plan, filter), so the
+        # scan-path key form is sufficient here too.
+        pkey = (_batch_id(segments), plan, ctx.filter, "__startree__", S, D)
+        cached = self._params_cache.get(pkey)
+        if cached is not None:
+            csegs, cparams, cnum_docs = cached
+            if all(a is b for a, b in zip(csegs, segments)):
+                self._params_cache.move_to_end(pkey)
+                return cols, dict(cparams), cnum_docs, S_real, D
+        sel = startree_device.selection_mask(fits, S, D)
+        params = {"sel": self._put(sel, block=True)}
+        num_docs = np.zeros(S, dtype=np.int32)
+        num_docs[:S_real] = [int(f.tree.meta.num_records) for f in fits]
+        num_docs_dev = self._put(num_docs)
+        self._params_cache[pkey] = (tuple(segments), dict(params),
+                                    num_docs_dev)
+        self._params_cache.move_to_end(pkey)
+        while len(self._params_cache) > self.PARAMS_CACHE_ENTRIES:
+            self._params_cache.popitem(last=False)
+        return cols, params, num_docs_dev, S_real, D
+
+    def _st_block_locked(self, segments, fits, S, D, ckey, form, dtype):
+        """One staged [S, D] pre-agg block. Mirrors _block /
+        _assemble_resident, with per-SEGMENT pseudo-column names
+        (`__startree__<ti>/<col>`): one segment can hold several trees
+        materializing the same pair over different record layouts, and
+        host/resident rows must key on the tree actually fitted — the
+        batch-level key carries the whole ti tuple for the same reason.
+        Residency admission honors pinot.server.startree.hbm.resident;
+        off, blocks still cache at the assembled tier but rows don't
+        compete for resident-tier bytes."""
+        dtype_str = np.dtype(dtype).str
+        tis = tuple(f.ti for f in fits)
+        bkey = (_batch_id(segments), "startree", (ckey, tis), S, D,
+                dtype_str)
+        entry = self._block_cache.get(bkey)
+        if entry is not None and all(a is b
+                                     for a, b in zip(entry[0], segments)):
+            self._block_cache.move_to_end(bkey)
+            self._meter("hbm_block_hit")
+            return entry[1]
+        self._meter("hbm_block_miss")
+        names = [f"__startree__{f.ti}/{ckey}" for f in fits]
+        fetchers = [
+            (lambda seg, _t=f.tree: startree_device.fetch_row(_t, form,
+                                                              dtype))
+            for f in fits]
+        if self._residency.enabled and self._st_resident:
+            dev_rows: List[Any] = []
+            missing: List[int] = []
+            for seg, name in zip(segments, names):
+                row = self._residency.get(seg, "startree", name, dtype_str)
+                dev_rows.append(row)
+                if row is None:
+                    missing.append(len(dev_rows) - 1)
+            if missing:
+                # rows pad to the tree's OWN pow2 record bucket
+                # (batch-independent, so every batch composition shares
+                # them); the on-device assembler pads the tail to D
+                host_rows = [self._host_row(
+                    segments[i], names[i], "startree", fetchers[i], dtype,
+                    pad_to=_pow2(int(fits[i].tree.meta.num_records)))
+                    for i in missing]
+                if len(host_rows) > 1 and sum(
+                        a.nbytes for a in host_rows
+                ) >= self.UPLOAD_FANOUT_BYTES:
+                    futs = [dispatch_mod.upload_pool().submit(
+                        self._put_row, a) for a in host_rows]
+                    uploaded = [dispatch_mod.wait_result(
+                        f, max_wait_s=self.LAUNCH_WAIT_CAP_S)
+                        for f in futs]
+                else:
+                    uploaded = [self._put_row(a) for a in host_rows]
+                for i, arr, dev in zip(missing, host_rows, uploaded):
+                    self._residency.admit(segments[i], "startree",
+                                          names[i], dtype_str, dev,
+                                          arr.nbytes)
+                    dev_rows[i] = dev
+            assembler = kernels.compiled_row_assembler(
+                S, D, tuple(int(r.shape[0]) for r in dev_rows), dtype_str)
+            dev = self._reshard_block(assembler(tuple(dev_rows)))
+            nbytes = S * D * np.dtype(dtype).itemsize
+        else:
+            rows = [self._host_row(seg, name, "startree", fetch, dtype,
+                                   pad_to=D)
+                    for seg, name, fetch in zip(segments, names, fetchers)]
+            block = np.stack(rows) if len(rows) == S else \
+                np.concatenate([np.stack(rows),
+                                np.zeros((S - len(rows), D), dtype=dtype)])
+            dev = self._put(block, block=True)
+            nbytes = block.nbytes
+        self._insert_block(bkey, (tuple(segments), dev), nbytes)
+        return dev
+
     # -- staging trace attrs -------------------------------------------
     def _staging_snapshot(self, dsp):
         """Counters to diff across a traced staging pass (None span ->
@@ -460,12 +706,22 @@ class TpuOperatorExecutor:
         if not ctx.aggregations:
             return self._execute_topn(segments, ctx, cancel_check)
         from pinot_tpu.utils import accounting
+        slip = accounting.current_slip()
         with self._dispatcher.active():
-            prep = self._prepare_agg(segments, ctx, cancel_check,
-                                     slip=accounting.current_slip())
-            if prep is None:
-                return [], segments
-            plan, slots_of_fn, S_real, launch = prep
+            # star-tree leg first: a fitted tree answers from pre-agg
+            # records; any fallback reason drops through to the scan
+            # prepare below (and transitively to the host path)
+            st = self._prepare_startree(segments, ctx, cancel_check,
+                                        slip=slip) \
+                if self._startree_candidate(segments) else None
+            if st is not None:
+                st_plan, needed, fits, S_real, launch = st
+            else:
+                prep = self._prepare_agg(segments, ctx, cancel_check,
+                                         slip=slip)
+                if prep is None:
+                    return [], segments
+                plan, slots_of_fn, S_real, launch = prep
             try:
                 # deadline-bounded: the checker carries the query's
                 # remaining budget; the cap backstops budget-less callers
@@ -475,6 +731,9 @@ class TpuOperatorExecutor:
             finally:
                 if launch.span is not None:
                     launch.span.end()
+        if st is not None:
+            return startree_device.assemble(segments, ctx, st_plan, needed,
+                                            fits, packed), []
         results = self._assemble(segments, ctx, plan, packed, S_real, slots_of_fn)
         return results, []
 
@@ -507,6 +766,29 @@ class TpuOperatorExecutor:
 
         def stage_and_enqueue():
             try:
+                st = self._prepare_startree(segments, ctx, cancel_check,
+                                            parent_span=parent_span,
+                                            slip=slip) \
+                    if self._startree_candidate(segments) else None
+                if st is not None:
+                    st_plan, needed, fits, _S_real, launch = st
+                    lfut = self._dispatcher.submit(launch)
+
+                    def finish_st(f):
+                        try:
+                            # lint: hang(done-callback: f is already resolved)
+                            packed = f.result()
+                            out.set_result((startree_device.assemble(
+                                segments, ctx, st_plan, needed, fits,
+                                packed), []))
+                        except BaseException as e:  # noqa: BLE001
+                            out.set_exception(e)
+                        finally:
+                            if launch.span is not None:
+                                launch.span.end()
+
+                    lfut.add_done_callback(finish_st)
+                    return
                 prep = self._prepare_agg(segments, ctx, cancel_check,
                                          parent_span=parent_span,
                                          slip=slip)
@@ -1769,6 +2051,23 @@ class TpuOperatorExecutor:
         if not segments or ctx.distinct or not self.supports(ctx):
             return False
         with self._engine_lock:
+            if ctx.aggregations and self._startree_candidate(segments):
+                # star-tree leg first, mirroring execute's routing: a
+                # plan that will serve from pre-agg records must warm
+                # THOSE blocks, not the raw scan columns
+                st_plan, _needed, fits, _reason = \
+                    startree_device.plan_startree(segments, ctx)
+                if st_plan is not None:
+                    kern = startree_device.compiled_startree_kernel(
+                        st_plan)
+                    try:
+                        self._stage_startree_locked(
+                            segments, ctx, st_plan, fits,
+                            batchable=isinstance(kern,
+                                                 jax.stages.Wrapped))
+                        return True
+                    except _NotStageable:
+                        pass
             if ctx.aggregations:
                 plan_info = self._plan(segments, ctx)
                 plan = plan_info[0] if plan_info is not None else None

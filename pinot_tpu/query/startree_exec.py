@@ -18,10 +18,7 @@ from pinot_tpu.query.context import QueryContext
 from pinot_tpu.query.expressions import Expression, Function, Identifier
 from pinot_tpu.query.filter import resolve_predicate
 from pinot_tpu.query.results import AggregationResult, ExecutionStats, GroupByResult
-
-#: range predicates expand to explicit id lists during traversal; wider
-#: ranges fall back to the scan path (ids stay compact in dictId space)
-_MAX_RANGE_IDS = 100_000
+from pinot_tpu.segment.startree import DimFilter
 
 
 def _agg_pairs_needed(ctx: QueryContext) -> Optional[List[List[Tuple[str, str]]]]:
@@ -48,18 +45,19 @@ def _agg_pairs_needed(ctx: QueryContext) -> Optional[List[List[Tuple[str, str]]]
 
 
 def _filter_id_sets(seg, expr: Optional[Expression], dims: List[str]
-                    ) -> Optional[Dict[str, Optional[np.ndarray]]]:
-    """AND-only filter tree -> per-dim matching dictId arrays, or None when
+                    ) -> Optional[Dict[str, Optional[DimFilter]]]:
+    """AND-only filter tree -> per-dim matching DimFilters, or None when
     the filter doesn't fit (non-AND composition, non-tree dim, unsupported
-    predicate)."""
-    sets: Dict[str, Optional[np.ndarray]] = {d: None for d in dims}
+    predicate). Range predicates stay as [lo, hi] intervals end to end —
+    never materialized into dictId arrays — so arbitrarily wide ranges
+    fit the tree path."""
+    sets: Dict[str, Optional[DimFilter]] = {d: None for d in dims}
     if expr is None:
         return sets
 
-    def add(pred_col: str, ids: np.ndarray) -> bool:
+    def add(pred_col: str, f: DimFilter) -> bool:
         cur = sets.get(pred_col)
-        sets[pred_col] = ids if cur is None else \
-            np.intersect1d(cur, ids)
+        sets[pred_col] = f if cur is None else cur.intersect(f)
         return True
 
     def walk(e: Expression) -> bool:
@@ -78,13 +76,11 @@ def _filter_id_sets(seg, expr: Optional[Expression], dims: List[str]
         if p.kind == "all":
             return True
         if p.kind == "none":
-            return add(col, np.empty(0, dtype=np.int32))
+            return add(col, DimFilter.from_ids(np.empty(0, dtype=np.int32)))
         if p.kind == "range":
-            if p.hi - p.lo + 1 > _MAX_RANGE_IDS:
-                return False
-            return add(col, np.arange(p.lo, p.hi + 1, dtype=np.int32))
+            return add(col, DimFilter.from_range(p.lo, p.hi))
         if p.kind == "set":
-            return add(col, p.ids)
+            return add(col, DimFilter.from_ids(p.ids))
         return False  # notset / null kinds -> scan path
 
     if not walk(expr):

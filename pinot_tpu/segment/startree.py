@@ -37,6 +37,59 @@ STAR = -1  # wildcard dim value (ref StarTreeNode.ALL)
 # node record: dim_id, dim_value, start_doc, end_doc, child_start, num_children
 _NODE_FIELDS = 6
 
+
+class DimFilter:
+    """One dim's matching dictId set in compressed form: a dense
+    inclusive ``[lo, hi]`` interval (range predicates are never
+    materialized into id arrays) or an explicit sorted-unique id array.
+    Intersections stay in interval space whenever one side is an
+    interval, so arbitrarily wide BETWEEN / comparison predicates cost
+    O(1) instead of O(hi-lo) arange + intersect1d."""
+
+    __slots__ = ("lo", "hi", "ids")
+
+    def __init__(self, lo: Optional[int] = None, hi: Optional[int] = None,
+                 ids: Optional[np.ndarray] = None):
+        self.lo = lo
+        self.hi = hi
+        self.ids = ids
+
+    @classmethod
+    def from_range(cls, lo: int, hi: int) -> "DimFilter":
+        return cls(lo=int(lo), hi=int(hi))
+
+    @classmethod
+    def from_ids(cls, ids) -> "DimFilter":
+        return cls(ids=np.unique(np.asarray(ids, dtype=np.int64)))
+
+    def is_empty(self) -> bool:
+        if self.ids is not None:
+            return len(self.ids) == 0
+        return self.hi < self.lo
+
+    def intersect(self, other: "DimFilter") -> "DimFilter":
+        if self.ids is None and other.ids is None:
+            return DimFilter(lo=max(self.lo, other.lo),
+                             hi=min(self.hi, other.hi))
+        if self.ids is None:
+            return other.intersect(self)
+        if other.ids is None:  # clip the id list to the interval
+            ids = self.ids
+            return DimFilter(ids=ids[(ids >= other.lo) & (ids <= other.hi)])
+        return DimFilter(ids=np.intersect1d(self.ids, other.ids))
+
+    def contains(self, v: int) -> bool:
+        if self.ids is None:
+            return self.lo <= v <= self.hi
+        i = int(np.searchsorted(self.ids, v))
+        return i < len(self.ids) and int(self.ids[i]) == v
+
+    def mask(self, codes: np.ndarray) -> np.ndarray:
+        """Boolean membership mask over a code array (leaf residual)."""
+        if self.ids is None:
+            return (codes >= self.lo) & (codes <= self.hi)
+        return np.isin(codes, self.ids)
+
 _SUPPORTED_FUNCS = {"SUM", "COUNT", "MIN", "MAX"}
 
 
@@ -260,15 +313,35 @@ class StarTreeV2:
             func, col = parse_pair(p)
             self.metrics[(func, col)] = np.frombuffer(data, np.float64, n, off)
             off += 8 * n
+        self._pair_bounds: Dict[Tuple[str, str], Tuple[float, float, bool]] = {}
 
-    def traverse(self, dim_id_sets: Dict[str, Optional[np.ndarray]],
+    def pair_bounds(self, pair: Tuple[str, str]) -> Tuple[float, float, bool]:
+        """(min, max, integral) over one pre-agg metric column, cached —
+        the device staging admission data (ops/startree_device.py picks
+        an exact int-plane slot vs a float32 slot from these)."""
+        cached = self._pair_bounds.get(pair)
+        if cached is None:
+            v = self.metrics[pair]
+            if len(v) == 0:
+                cached = (0.0, 0.0, True)
+            else:
+                cached = (float(v.min()), float(v.max()),
+                          bool(np.all(v == np.floor(v))))
+            self._pair_bounds[pair] = cached
+        return cached
+
+    def traverse(self, dim_id_sets: Dict[str, Optional["DimFilter"]],
                  group_dims: set) -> np.ndarray:
         """Record mask for the query (ref StarTreeFilterOperator.java:90).
 
-        dim_id_sets: dim -> matching dictIds (None = no predicate).
+        dim_id_sets: dim -> matching DimFilter (None = no predicate;
+        plain dictId arrays are accepted and wrapped).
         group_dims: dims that must keep real values (no star substitution).
         Returns selected record indices into the pre-agg table.
         """
+        filters = {d: f if (f is None or isinstance(f, DimFilter))
+                   else DimFilter.from_ids(f)
+                   for d, f in dim_id_sets.items()}
         selected: List[np.ndarray] = []
 
         def visit(node: int):
@@ -283,16 +356,16 @@ class StarTreeV2:
                 # child below
                 idx = np.arange(start, end)
                 keep = np.ones(len(idx), dtype=bool)
-                for d, ids in dim_id_sets.items():
-                    if ids is not None:
-                        keep &= np.isin(self.dim_codes[d][idx], ids)
+                for d, f in filters.items():
+                    if f is not None:
+                        keep &= f.mask(self.dim_codes[d][idx])
                 selected.append(idx[keep])
                 return
             child_dim = self.nodes[child_start][0]
             dname = self.meta.dims[child_dim]
-            ids = dim_id_sets.get(dname)
+            f = filters.get(dname)
             children = range(child_start, child_start + n_children)
-            if ids is None and dname not in group_dims:
+            if f is None and dname not in group_dims:
                 # no predicate, not grouped: take the star child if present
                 for c in children:
                     if self.nodes[c][1] == STAR:
@@ -301,12 +374,11 @@ class StarTreeV2:
                 for c in children:  # star skipped: take all real children
                     visit(c)
                 return
-            id_set = set(ids.tolist()) if ids is not None else None
             for c in children:
                 v = self.nodes[c][1]
                 if v == STAR:
                     continue
-                if id_set is None or int(v) in id_set:
+                if f is None or f.contains(int(v)):
                     visit(c)
         visit(0)
         if not selected:

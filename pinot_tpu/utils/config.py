@@ -64,6 +64,11 @@ KEYS: Dict[str, Any] = {
     "pinot.server.hbm.admission.enabled": True,
     "pinot.server.hbm.admission.sample": 4096,
     "pinot.server.host.row.cache.bytes": 16 << 30,
+    # star-tree device leg (ops/startree_device.py): fitted aggregations
+    # answer from pre-agg records through the kernel factory; .hbm.resident
+    # admits the pre-agg pseudo-columns into the resident-row tier
+    "pinot.server.startree.enabled": True,
+    "pinot.server.startree.hbm.resident": True,
     "pinot.server.segment.cache.enabled": True,   # tier-2 partial cache
     "pinot.server.segment.cache.bytes": 256 << 20,
     "pinot.server.segment.cache.ttl.seconds": 300.0,

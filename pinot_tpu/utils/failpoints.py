@@ -106,6 +106,10 @@ SITES: Dict[str, str] = {
         "the atomic minion segment swap",
     "minion.task.execute":
         "worker-side, as task execution starts",
+    "minion.startree.build":
+        "per segment inside StarTreeBuildTask, before the rebuild (a "
+        "SimulatedCrash leaves the source segment serving via the scan "
+        "path; the re-leased task rebuilds byte-identical tree output)",
     "mse.dispatch.stage":
         "broker-side, before one stage dispatches",
     "mse.mailbox.send":

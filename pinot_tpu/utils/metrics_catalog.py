@@ -75,6 +75,12 @@ METRICS: Dict[str, str] = {
     "kernel_retrace": "kernel retraces (steady-state retraces are bugs)",
     "kernel_retrace_by_plan":
         "kernel retraces attributed per plan fingerprint",
+    "startree_served":
+        "queries answered by the device star-tree pre-agg leg",
+    "startree_fallback":
+        "tree-carrying batches routed to the scan path (label reason="
+        "disabled|aggregation|groupBy|noTree|fit|filter|precision|"
+        "groups|staging)",
     # -- memory tiers (HBM residency) ------------------------------------
     "hbm_cache_bytes": "assembled [S, D] block-cache bytes on device",
     "hbm_block_hit": "assembled-block cache hits",

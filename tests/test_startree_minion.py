@@ -1,0 +1,258 @@
+"""Minion StarTreeBuildTask (ISSUE 16): grow star-trees on sealed
+segments without re-ingest.
+
+  * generator — `taskConfigs` tables emit one task over ONLINE segments
+    whose metadata carries no tree; a second tick generates NOTHING
+    (the metadata "starTree" marker is the convergence signal)
+  * executor — rebuilds each segment from its own columns under the
+    grafted tree config, commits via publish/retire; the rebuilt
+    segment serves the DEVICE pre-agg path
+  * chaos, `minion.startree.build` — a SimulatedCrash before the
+    rebuild leaves the source segment serving via the scan path; the
+    re-leased task rebuilds BYTE-IDENTICAL tree buffers (deterministic
+    build + output names)
+  * chaos, `controller.segment.replace` — a permanently failing swap
+    exhausts retries to FAILED with the source segment still routed and
+    serving; disarm + resubmit converges onto the tree segments
+"""
+import os
+import time
+
+import numpy as np
+import pytest
+
+from pinot_tpu.controller.cluster_state import ClusterState, SegmentState
+from pinot_tpu.controller.task_manager import (COMPLETED, FAILED, PENDING,
+                                               TaskManager)
+from pinot_tpu.controller.tasks import TaskConfig, TaskContext, run_task
+from pinot_tpu.models import (DataType, FieldSpec, FieldType, Schema,
+                              TableConfig)
+from pinot_tpu.query.executor import QueryExecutor
+from pinot_tpu.segment import index_types as it
+from pinot_tpu.segment.creator import SegmentCreator
+from pinot_tpu.segment.loader import load_segment
+from pinot_tpu.utils.config import PinotConfiguration
+from pinot_tpu.utils.failpoints import (FailpointError, SimulatedCrash,
+                                        failpoints)
+
+TREE_CFG = {"dimensionsSplitOrder": ["d"],
+            "functionColumnPairs": ["SUM__m", "MAX__m"],
+            "maxLeafRecords": 5}
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoints.clear()
+    yield
+    failpoints.clear()
+
+
+def make_schema():
+    return Schema("ct", [
+        FieldSpec("d", DataType.STRING),
+        FieldSpec("ts", DataType.LONG, FieldType.DATE_TIME),
+        FieldSpec("m", DataType.LONG, FieldType.METRIC),
+    ])
+
+
+def build_seg(tmp, name, n=100, seed=0, ts_base=0):
+    """A sealed segment WITHOUT a tree (the seal path had no config)."""
+    rng = np.random.default_rng(seed)
+    cols = {"d": [f"k{v}" for v in rng.integers(0, 5, n)],
+            "ts": (ts_base + np.arange(n)).astype(np.int64),
+            "m": rng.integers(0, 50, n).astype(np.int64)}
+    out = str(tmp / name)
+    SegmentCreator(TableConfig("ct"), make_schema()).build(cols, out, name)
+    return out
+
+
+def setup_state(tmp, n_segments=2, table_type="REALTIME"):
+    cfg = TableConfig("ct")
+    cfg.task_configs = {"StarTreeBuildTask": {
+        "starTreeIndexConfigs": [TREE_CFG]}}
+    state = ClusterState()
+    state.add_table(cfg, make_schema())
+    for i in range(n_segments):
+        d = build_seg(tmp, f"s{i}", seed=i, ts_base=i * 1000)
+        m = load_segment(d).metadata
+        state.upsert_segment(SegmentState(
+            f"s{i}", f"ct_{table_type}", [], dir_path=d, num_docs=100,
+            start_time=m.start_time, end_time=m.end_time))
+    return state
+
+
+def _manager(state):
+    return TaskManager(state, config=PinotConfiguration(overrides={
+        "pinot.controller.task.generators.enabled": True,
+        "pinot.controller.task.retry.backoff.seconds": 0.0}))
+
+
+def _tree_buffers(seg):
+    """Raw star-tree index bytes — the byte-identity unit."""
+    out = []
+    for ti in range(len(seg.star_tree.trees)):
+        out.append(bytes(seg.dir.get_buffer(f"__startree_{ti}",
+                                            it.STARTREE)))
+        out.append(bytes(seg.dir.get_buffer(f"__startree_{ti}",
+                                            it.STARTREE_DATA)))
+    return out
+
+
+class TestGeneratorAndBuild:
+    def test_build_converges_and_serves_device_path(self, tmp_path):
+        state = setup_state(tmp_path)
+        tm = _manager(state)
+        assert tm.run_once()["generated"] == 1
+        task = tm.queue.lease("w0")
+        res = run_task(
+            TaskConfig(task.task_type, task.table, list(task.segments),
+                       dict(task.params), task_id=task.task_id),
+            TaskContext(state, str(tmp_path / "out"),
+                        task_id=task.task_id))
+        assert sorted(res["builtSegments"]) == ["s0_sttree", "s1_sttree"]
+        tm.queue.complete(task.task_id, "w0", res)
+        # source segments retired, rebuilt ones registered with trees
+        names = {s.name for s in state.table_segments("ct_REALTIME")}
+        assert names == {"s0_sttree", "s1_sttree"}
+        rebuilt = [load_segment(state.segments["ct_REALTIME"][n].dir_path)
+                   for n in sorted(names)]
+        for seg in rebuilt:
+            assert seg.star_tree is not None and seg.star_tree.trees
+            assert seg.num_docs == 100
+        # the rebuilt segments serve the DEVICE pre-agg leg
+        from pinot_tpu.ops.engine import TpuOperatorExecutor
+        eng = TpuOperatorExecutor(
+            metrics_labels={"st_test": "minion_serve"})
+        ex = QueryExecutor(rebuilt, use_tpu=True, engine=eng)
+        r = ex.execute("SELECT SUM(m), COUNT(*) FROM ct WHERE d = 'k1'")
+        assert not r.exceptions
+        assert eng._metrics.meter(
+            "startree_served", labels={"st_test": "minion_serve"}) == 1
+        # parity with a raw scan over the ORIGINAL segments
+        orig = [load_segment(str(tmp_path / f"s{i}")) for i in range(2)]
+        want = QueryExecutor(orig, use_tpu=False).execute(
+            "SELECT SUM(m), COUNT(*) FROM ct WHERE d = 'k1'")
+        assert r.result_table.rows == want.result_table.rows
+        # second tick: metadata "starTree" marker -> nothing to do
+        assert tm.run_once()["generated"] == 0
+
+    def test_no_tree_config_generates_nothing(self, tmp_path):
+        state = setup_state(tmp_path)
+        state.tables["ct"].task_configs = {"StarTreeBuildTask": {}}
+        assert _manager(state).run_once()["generated"] == 0
+
+    def test_upsert_table_generates_nothing(self, tmp_path):
+        from pinot_tpu.models import UpsertConfig
+        state = setup_state(tmp_path)
+        state.tables["ct"].upsert = UpsertConfig(mode="FULL")
+        assert _manager(state).run_once()["generated"] == 0
+
+
+class TestBuildChaos:
+    def _run_flow(self, tmp_path, tag, chaos):
+        """generate -> lease -> (crash -> expire -> re-lease) -> build;
+        returns the rebuilt segments' tree buffers."""
+        tmp = tmp_path / tag
+        tmp.mkdir()
+        state = setup_state(tmp)
+        tm = _manager(state)
+        assert tm.run_once()["generated"] == 1
+        (entry,) = tm.queue.list(PENDING)
+        task = tm.queue.lease("w0", lease_ttl_s=0.01)
+        cfg = TaskConfig(task.task_type, task.table, list(task.segments),
+                         dict(task.params), task_id=task.task_id)
+        ctx = TaskContext(state, str(tmp / "out"), task_id=task.task_id)
+        if chaos:
+            failpoints.arm("minion.startree.build",
+                           error=SimulatedCrash("chaos kill"), times=1)
+            with pytest.raises(SimulatedCrash):
+                run_task(cfg, ctx)
+            # the crash fired BEFORE any rebuild: sources untouched,
+            # still serving via the scan path
+            segs = [load_segment(s.dir_path)
+                    for s in state.table_segments("ct_REALTIME")]
+            assert {s.name for s in segs} == {"s0", "s1"}
+            r = QueryExecutor(segs, use_tpu=False).execute(
+                "SELECT COUNT(*) FROM ct")
+            assert r.rows[0][0] == 200
+            # worker vanished: the lease expires and requeues the task
+            time.sleep(0.02)
+            assert tm.queue.expire_leases() == [entry.task_id]
+            task = tm.queue.lease("w1")
+            assert task.task_id == entry.task_id
+        res = run_task(cfg, ctx)
+        tm.queue.complete(task.task_id, task.worker, res)
+        assert sorted(res["builtSegments"]) == ["s0_sttree", "s1_sttree"]
+        return {
+            n: _tree_buffers(load_segment(
+                state.segments["ct_REALTIME"][n].dir_path))
+            for n in res["builtSegments"]}
+
+    def test_crashed_build_releases_and_rebuilds_byte_identical(
+            self, tmp_path):
+        baseline = self._run_flow(tmp_path, "nochaos", chaos=False)
+        chaosed = self._run_flow(tmp_path, "chaos", chaos=True)
+        assert baseline == chaosed  # tree BYTES, not just answers
+
+
+class TestSwapChaos:
+    def _cluster(self, tmp_path):
+        from pinot_tpu.cluster.mini import MiniCluster
+        c = MiniCluster(num_servers=1, minions=1,
+                        config=PinotConfiguration(overrides={
+                            "pinot.controller.task.max.attempts": 2,
+                            "pinot.controller.task.retry.backoff.seconds":
+                                0.05,
+                            "pinot.minion.poll.seconds": 0.05,
+                            "pinot.minion.heartbeat.seconds": 0.2}))
+        c.start()
+        cfg = TableConfig("ct")
+        cfg.retention.time_column = "ts"
+        c.add_table("ct", time_column="ts", table_config=cfg,
+                    schema=make_schema())
+        names = []
+        for i in range(2):
+            d = build_seg(tmp_path, f"seg_{i}", n=60, seed=i,
+                          ts_base=i * 1000)
+            c.add_segment("ct", load_segment(d), server_idx=0)
+            names.append(f"seg_{i}")
+        return c, names
+
+    def test_mid_swap_failure_leaves_scan_serving_then_converges(
+            self, tmp_path):
+        """A permanently failing atomic swap exhausts retries: the task
+        FAILS with the SOURCE segments still routed and answering (scan
+        path). Disarm + resubmit converges onto the tree segments."""
+        c, names = self._cluster(tmp_path)
+        try:
+            assert c.query("SELECT COUNT(*) FROM ct").rows[0][0] == 120
+            failpoints.arm("controller.segment.replace",
+                           error=FailpointError("swap chaos"))
+            e = c.submit_task(TaskConfig(
+                "StarTreeBuildTask", "ct_OFFLINE", names,
+                {"starTreeIndexConfigs": [TREE_CFG]}))
+            done = c.wait_task(e["task_id"], timeout_s=30)
+            assert done["state"] == FAILED, done
+            # sources still routed + serving (scan path, no trees)
+            rt = c.routing.get_route("ct")
+            assert sorted(rt.offline.segments) == names
+            assert c.query("SELECT COUNT(*) FROM ct").rows[0][0] == 120
+            # chaos over: the next attempt swaps in the rebuilt segments
+            failpoints.clear()
+            e = c.submit_task(TaskConfig(
+                "StarTreeBuildTask", "ct_OFFLINE", names,
+                {"starTreeIndexConfigs": [TREE_CFG]}))
+            done = c.wait_task(e["task_id"], timeout_s=30)
+            assert done["state"] == COMPLETED, done
+            rt = c.routing.get_route("ct")
+            assert sorted(rt.offline.segments) == \
+                ["seg_0_sttree", "seg_1_sttree"]
+            assert c.query("SELECT COUNT(*) FROM ct").rows[0][0] == 120
+            from pinot_tpu.segment.fs import localize_segment
+            (st0, _) = sorted(
+                c.cluster_state.table_segments("ct_OFFLINE"),
+                key=lambda s: s.name)
+            local = localize_segment(st0.dir_path, str(tmp_path / "dl"))
+            assert load_segment(local).star_tree.trees
+        finally:
+            c.stop()
