@@ -3370,6 +3370,523 @@ def overload_main(smoke: bool = False, out_path: "str | None" = None):
              f"(bound {bound:.2f}%, A/A floor {noise_pct:.2f}%)")
 
 
+# ---------------------------------------------------------------------------
+# --logs: CLP log-analytics workload (ISSUE 17)
+# ---------------------------------------------------------------------------
+
+_LOG_TEMPLATES = (
+    lambda r: f"INFO  request req-{int(r.integers(0, 10**6))} served in "
+              f"{int(r.integers(1, 500))} ms from host h{int(r.integers(0, 8))}",
+    lambda r: f"WARN  GC pause of {round(float(r.random()) * 4, 2)} seconds "
+              f"detected at offset {int(r.integers(0, 10**9))}",
+    lambda r: f"ERROR Connection to 10.0.{int(r.integers(0, 32))}."
+              f"{int(r.integers(1, 255))}:{int(r.integers(1000, 9000))} "
+              f"refused after {int(r.integers(1, 6))} retries",
+    lambda r: f"INFO  user u{int(r.integers(0, 500))} logged in from "
+              f"10.1.{int(r.integers(0, 32))}.{int(r.integers(1, 255))}",
+    lambda r: f"ERROR task t{int(r.integers(0, 9999))} failed on host "
+              f"h{int(r.integers(0, 8))}: code={int(r.integers(400, 600))}",
+    lambda r: f"WARN  disk /dev/sd{chr(97 + int(r.integers(0, 4)))}1 at "
+              f"{int(r.integers(1, 99))}% capacity",
+)
+
+
+def _log_corpus(rng, n):
+    k = len(_LOG_TEMPLATES)
+    return [_LOG_TEMPLATES[int(rng.integers(0, k))](rng) for _ in range(n)]
+
+
+def logs_main(smoke: bool = False, out_path: "str | None" = None):
+    """--logs [--smoke]: the CLP log-analytics acceptance driver
+    (ISSUE 17). Four legs over a realistic templated log corpus:
+
+    * pushdown A/B — the SAME LIKE queries through the device CLP
+      pushdown leg (logtype/dict/encoded-var match kernels over staged
+      int32 pseudo-columns, no string decode) and through the host
+      decode path; every answer parity-checked bit-exact, p50 ratio
+      reported. Gate: device >= 2x host on the CPU stand-in (>= 5x on
+      accelerators) — the host path pays string matching over the
+      decoded column, the device path reads fixed-width ids.
+    * coalesce — N clients loop fingerprint-equal LIKE queries whose
+      pattern CONSTANTS differ (patterns live in staged params, never
+      in the plan): batched launches must form with ZERO steady-state
+      retraces once the pow2 shape buckets are warm.
+    * ingest — realtime log ingestion into the mutable CLP column
+      (template dictionary built AT INGEST, not at seal), sustained
+      events/s with >= 2 seal rotations and exactly-once visibility,
+      then a seeded SimulatedCrash (`ingest.realtime.consume`) killing
+      the consumer MID-BATCH: a fresh manager recovers from the
+      committed offset + sealed segments and converges to exactly-once
+      (COUNT and SUM(ts) both exact) with ZERO failed queries.
+    * mixed tenants — one MiniCluster serving an OLAP table (tenant
+      weight 4) and the log table (weight 1) through the PR-8/15
+      weighted-fair + brownout broker stack: the OLAP fleet's p99
+      during mixed traffic must stay within its SLO target.
+
+    Writes BENCH_logs.json (backend-gated bars). --smoke shrinks
+    corpus/windows to fit tier-1 (tests/test_clp_device.py).
+    """
+    import contextlib
+    import statistics as stats
+    import tempfile
+    import threading
+
+    import jax
+
+    from pinot_tpu.cluster.mini import MiniCluster
+    from pinot_tpu.ingest.memory_stream import InMemoryStream
+    from pinot_tpu.ingest.realtime_manager import RealtimeSegmentDataManager
+    from pinot_tpu.ingest.stream import LongMsgOffset, StreamConfig
+    from pinot_tpu.models import (DataType, FieldSpec, FieldType, Schema,
+                                  TableConfig, TableType)
+    from pinot_tpu.ops import dispatch as dispatch_mod
+    from pinot_tpu.ops import kernels
+    from pinot_tpu.ops.engine import TpuOperatorExecutor
+    from pinot_tpu.query.context import QueryContext
+    from pinot_tpu.query.executor import QueryExecutor
+    from pinot_tpu.segment import index_types as seg_it
+    from pinot_tpu.segment.creator import SegmentCreator
+    from pinot_tpu.segment.loader import load_segment
+    from pinot_tpu.server.data_manager import TableDataManager
+    from pinot_tpu.utils.config import PinotConfiguration
+    from pinot_tpu.utils.failpoints import SimulatedCrash, failpoints
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    if smoke:
+        docs, num_segments, p50_iters = 1_500, 2, 6
+        clients, window_s = 6, 0.8
+        max_events, flush_rows = 4_000, 600
+        chaos_events, chaos_flush = 2_500, 400
+        mix_window_s, olap_clients, log_clients = 1.0, 3, 3
+    else:
+        docs, num_segments, p50_iters = 25_000, 4, 30
+        clients, window_s = 8, 2.5
+        max_events, flush_rows = 60_000, 8_000
+        chaos_events, chaos_flush = 20_000, 3_000
+        mix_window_s, olap_clients, log_clients = 4.0, 4, 4
+
+    tmp = tempfile.mkdtemp(prefix="bench_logs_")
+    schema = Schema("logs", [
+        FieldSpec("ts", DataType.LONG, FieldType.DATE_TIME),
+        FieldSpec("message", DataType.STRING),
+    ])
+    tc = TableConfig("logs", TableType.OFFLINE)
+    tc.indexing.clp_columns = ["message"]
+    segs, raw_bytes, clp_bytes = [], 0, 0
+    for i in range(num_segments):
+        rng = np.random.default_rng(1700 + i)
+        msgs = _log_corpus(rng, docs)
+        out_dir = os.path.join(tmp, f"logs_{i}")
+        SegmentCreator(tc, schema).build(
+            {"ts": np.arange(docs, dtype=np.int64), "message": msgs},
+            out_dir, f"logs_{i}")
+        seg = load_segment(out_dir)
+        segs.append(seg)
+        raw_bytes += sum(len(m.encode()) for m in msgs)
+        clp_bytes += len(bytes(seg.dir.get_buffer("message", seg_it.CLP)))
+
+    labels = {"bench_leg": "logs"}
+    eng = TpuOperatorExecutor(config=PinotConfiguration(),
+                              metrics_labels=labels)
+    reg = eng._dispatcher._metrics
+    dev = QueryExecutor(segs, use_tpu=True, engine=eng)
+    host = QueryExecutor(segs, use_tpu=False)
+
+    # ------------------------------------------------------------------
+    # leg 1: pushdown A/B — parity + p50 ratio
+    # ------------------------------------------------------------------
+    needles = ["%refused%", "%failed on host%", "INFO%", "%capacity",
+               "%logged in%"]
+    sqls = [f"SELECT COUNT(*) FROM logs WHERE message LIKE '{p}'"
+            for p in needles]
+    served0 = reg.meter("clp_served", labels=labels)
+    for sql in sqls:
+        a, b = dev.execute(sql), host.execute(sql)
+        assert not a.exceptions and not b.exceptions, sql
+        assert a.result_table.rows[0][0] == b.result_table.rows[0][0], \
+            (sql, a.result_table.rows, b.result_table.rows)
+    served = reg.meter("clp_served", labels=labels) - served0
+    assert served == len(sqls), \
+        f"only {served}/{len(sqls)} LIKE queries served device-side"
+
+    def p50(ex, sql):
+        lat = []
+        for _ in range(p50_iters):
+            t0 = time.perf_counter()
+            ex.execute(sql)
+            lat.append((time.perf_counter() - t0) * 1e3)
+        return stats.median(lat)
+
+    ab = {}
+    for p, sql in zip(needles[:3], sqls[:3]):
+        d, h = p50(dev, sql), p50(host, sql)
+        ab[p] = {"device_p50_ms": round(d, 3), "host_p50_ms": round(h, 3),
+                 "speedup": round(h / max(d, 1e-9), 2)}
+    speedup_min = min(v["speedup"] for v in ab.values())
+
+    # ------------------------------------------------------------------
+    # leg 2: coalesce — constant-different LIKE queries, zero retraces
+    # ------------------------------------------------------------------
+    coal_sqls = [f"SELECT COUNT(*) FROM logs WHERE message LIKE "
+                 f"'%failed on host h{i % 8}:%'" for i in range(clients)]
+    for sql in coal_sqls:   # stage blocks + params, trace b=1
+        assert not dev.execute(sql).exceptions
+    launch = eng._prepare_agg(
+        segs, QueryContext.from_sql(coal_sqls[0]))[3]
+    guard = dispatch_mod._CPU_COLLECTIVE_LOCK if launch.collective \
+        else contextlib.nullcontext()
+    b = 2
+    while b <= dispatch_mod._pow2(clients):  # warm pow2 batch buckets
+        kern = launch.factory(b, False)
+        with guard:
+            jax.block_until_ready(kern(
+                launch.cols, (launch.params,) * b, launch.num_docs,
+                D=launch.D, G=launch.G))
+        b *= 2
+    traces0 = kernels.trace_count()
+    batch_t0 = reg.timer("dispatch_batch_size", labels=labels)
+    count0, max0 = batch_t0.count, batch_t0.max_ms
+    stop_at = time.perf_counter() + window_s
+    done = [0] * clients
+
+    def coal_client(ci):
+        j = 0
+        while time.perf_counter() < stop_at:
+            dev.execute(coal_sqls[(ci + j) % clients])
+            done[ci] += 1
+            j += 1
+
+    threads = [threading.Thread(target=coal_client, args=(i,))
+               for i in range(clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    batch_t = reg.timer("dispatch_batch_size", labels=labels)
+    coalesce = {
+        "clients": clients,
+        "queries_completed": int(sum(done)),
+        "qps": round(sum(done) / wall, 2),
+        "batch_launches": batch_t.count - count0,
+        "batch_size_max": max(batch_t.max_ms, max0),
+        "retraces_steady": kernels.trace_count() - traces0,
+    }
+
+    # ------------------------------------------------------------------
+    # leg 3: realtime ingest — events/s, then seeded mid-batch kill
+    # ------------------------------------------------------------------
+    def rt_cfg():
+        c = TableConfig("logs", TableType.REALTIME)
+        c.indexing.clp_columns = ["message"]
+        return c
+
+    def query_fleet(serving, stop_evt, n_clients, sql_of):
+        lats, fails = [], []
+        lock = threading.Lock()
+
+        def client(ci):
+            i = ci
+            while not stop_evt.is_set():
+                i += 1
+                t0 = time.time()
+                try:
+                    tdm = serving["tdm"]
+                    sdms = tdm.acquire_segments()
+                    try:
+                        r = QueryExecutor(
+                            [s.segment for s in sdms],
+                            use_tpu=False).execute(sql_of(i))
+                        if r.exceptions:
+                            raise RuntimeError(str(r.exceptions[:1]))
+                    finally:
+                        TableDataManager.release_all(sdms)
+                    with lock:
+                        lats.append(time.time() - t0)
+                except Exception as e:  # noqa: BLE001
+                    with lock:
+                        fails.append(repr(e))
+        ts = [threading.Thread(target=client, args=(ci,))
+              for ci in range(n_clients)]
+        for t in ts:
+            t.start()
+        return ts, lats, fails
+
+    log_sql = "SELECT COUNT(*) FROM logs WHERE message LIKE '%refused%'"
+
+    # -- 3a: sustained throughput + exactly-once at rest ---------------
+    topic = InMemoryStream("bench_logs_ingest", 1)
+    store = tempfile.mkdtemp(prefix="bench_logs_rt_")
+    tdm = TableDataManager("logs_REALTIME")
+    commits = []
+    rng = np.random.default_rng(77)
+    mgr = RealtimeSegmentDataManager(
+        rt_cfg(), schema, StreamConfig(
+            stream_type="inmemory", topic="bench_logs_ingest",
+            flush_threshold_rows=flush_rows),
+        0, tdm, store, on_commit=lambda n, o: commits.append((n, o)))
+    for i in range(max_events):  # pre-published deterministic log
+        topic.publish({"ts": i, "message": _log_corpus(rng, 1)[0]})
+    stop_evt = threading.Event()
+    fleet, lats, fails = query_fleet(
+        {"tdm": tdm}, stop_evt, 2, lambda i: log_sql)
+    t_start = time.time()
+    mgr.start()
+    deadline = time.time() + 300
+    while time.time() < deadline and mgr.rows_indexed < max_events:
+        time.sleep(0.02)
+    elapsed = time.time() - t_start
+    stop_evt.set()
+    for t in fleet:
+        t.join(timeout=10)
+    drained = mgr.rows_indexed
+    mgr.stop(drain=True)
+    events_per_sec = drained / max(elapsed, 1e-9)
+    sdms = tdm.acquire_segments()
+    try:
+        r = QueryExecutor([s.segment for s in sdms],
+                          use_tpu=False).execute(
+            "SELECT COUNT(*), SUM(ts) FROM logs LIMIT 5")
+        exact = (int(r.rows[0][0]), float(r.rows[0][1]))
+    finally:
+        TableDataManager.release_all(sdms)
+    want = (max_events, float(max_events * (max_events - 1) // 2))
+    InMemoryStream.delete("bench_logs_ingest")
+
+    # -- 3b: seeded mid-batch kill -> restart -> exactly-once ----------
+    topic3 = InMemoryStream("bench_logs_chaos", 1)
+    store3 = tempfile.mkdtemp(prefix="bench_logs_chaos_")
+    tdm3 = TableDataManager("logs_REALTIME")
+    commits3 = []
+    rng3 = np.random.default_rng(88)
+    for i in range(chaos_events):
+        topic3.publish({"ts": i, "message": _log_corpus(rng3, 1)[0]})
+    # probability tuned so the seeded kill lands MID-STREAM: the full
+    # run has ~200 fetch hits, so p=0.01 fires deep enough that sealed
+    # segments exist to recover; smoke's 25 hits need a hotter trigger
+    fp = failpoints.arm("ingest.realtime.consume",
+                        error=SimulatedCrash("kill"), times=1,
+                        probability=0.05 if smoke else 0.01,
+                        seed=20260807)
+    sc3 = StreamConfig(stream_type="inmemory", topic="bench_logs_chaos",
+                       flush_threshold_rows=chaos_flush)
+    m3 = RealtimeSegmentDataManager(
+        rt_cfg(), schema, sc3, 0, tdm3, store3,
+        on_commit=lambda n, o: commits3.append((n, o)))
+    serving = {"tdm": tdm3}
+    stop3 = threading.Event()
+    fleet3, lats3, fails3 = query_fleet(serving, stop3, 2,
+                                        lambda i: log_sql)
+    m3.start()
+    deadline = time.time() + 120
+    while time.time() < deadline and not m3._crashed:
+        time.sleep(0.01)
+    crashed = m3._crashed
+    m3.stop()  # joins the dead thread
+    # restart exactly as a fresh server process would: committed offset
+    # + sealed segments from the store; the crashed mutable VANISHES
+    resume = max((int(str(o)) for _n, o in commits3), default=0)
+    tdm4 = TableDataManager("logs_REALTIME")
+    recovered = []
+    for nm in sorted(os.listdir(store3)):
+        path = os.path.join(store3, nm)
+        if os.path.isdir(path) and not nm.startswith("_"):
+            seg = load_segment(path)
+            tdm4.add_segment(seg)
+            recovered.append(seg)
+    m4 = RealtimeSegmentDataManager(
+        rt_cfg(), schema, sc3, 0, tdm4, store3,
+        start_offset=LongMsgOffset(resume), start_seq=len(recovered),
+        recover_segments=recovered)
+    m4.start()
+    serving["tdm"] = tdm4  # queries swap to the recovered view
+    chaos_want = (chaos_events,
+                  float(chaos_events * (chaos_events - 1) // 2))
+    chaos_got = (None, None)
+    deadline = time.time() + 180
+    while time.time() < deadline:
+        sdms = tdm4.acquire_segments()
+        try:
+            r = QueryExecutor([s.segment for s in sdms],
+                              use_tpu=False).execute(
+                "SELECT COUNT(*), SUM(ts) FROM logs LIMIT 5")
+        finally:
+            TableDataManager.release_all(sdms)
+        if not r.exceptions:
+            chaos_got = (int(r.rows[0][0]), float(r.rows[0][1]))
+            if chaos_got == chaos_want:
+                break
+        time.sleep(0.05)
+    stop3.set()
+    for t in fleet3:
+        t.join(timeout=10)
+    m4.stop(drain=True)
+    decisions = list(fp.decisions)
+    failpoints.disarm("ingest.realtime.consume")
+    InMemoryStream.delete("bench_logs_chaos")
+
+    # ------------------------------------------------------------------
+    # leg 4: mixed tenants — OLAP p99 within SLO under log traffic
+    # ------------------------------------------------------------------
+    slo_ms = 400.0 if on_cpu else 100.0
+    olap_schema = Schema("ssb", [
+        FieldSpec("k", DataType.INT, FieldType.DIMENSION),
+        FieldSpec("v", DataType.INT, FieldType.METRIC),
+    ])
+    olap_creator = SegmentCreator(TableConfig("ssb", TableType.OFFLINE),
+                                  olap_schema)
+    c = MiniCluster(num_servers=1, config=PinotConfiguration(overrides={
+        "pinot.slo.query.p99.ms": slo_ms}))
+    c.start()
+    c.add_table("ssb", tenant="olap", tenant_weight=4.0)
+    c.add_table("logs", tenant="logs", tenant_weight=1.0)
+    for i in range(2):
+        rngo = np.random.default_rng(40 + i)
+        d = os.path.join(tmp, f"ssb_{i}")
+        olap_creator.build(
+            {"k": rngo.integers(0, 1000, 4000).astype(np.int32),
+             "v": rngo.integers(0, 100, 4000).astype(np.int32)},
+            d, f"ssb_{i}")
+        c.add_segment("ssb", load_segment(d), server_idx=0)
+    for seg in segs[:2]:
+        c.add_segment("logs", seg, server_idx=0)
+    olap_sql = ("SELECT SUM(v), COUNT(*) FROM ssb "
+                "WHERE k BETWEEN 100 AND 800 OPTION(skipCache=true)")
+
+    def mix_window(with_logs):
+        stop_m = threading.Event()
+        olap_lat, log_lat, mfails = [], [], []
+        lock = threading.Lock()
+
+        def olap_client():
+            while not stop_m.is_set():
+                t0 = time.perf_counter()
+                r = c.query(olap_sql)
+                dt = (time.perf_counter() - t0) * 1e3
+                with lock:
+                    if r.exceptions:
+                        mfails.append(str(r.exceptions[:1]))
+                    else:
+                        olap_lat.append(dt)
+
+        def log_client(ci):
+            j = ci
+            while not stop_m.is_set():
+                j += 1
+                t0 = time.perf_counter()
+                r = c.query("SELECT COUNT(*) FROM logs WHERE message "
+                            f"LIKE '%failed on host h{j % 8}:%' "
+                            "OPTION(skipCache=true)")
+                dt = (time.perf_counter() - t0) * 1e3
+                with lock:
+                    if r.exceptions:
+                        mfails.append(str(r.exceptions[:1]))
+                    else:
+                        log_lat.append(dt)
+
+        ts = [threading.Thread(target=olap_client)
+              for _ in range(olap_clients)]
+        if with_logs:
+            ts += [threading.Thread(target=log_client, args=(i,))
+                   for i in range(log_clients)]
+        for t in ts:
+            t.start()
+        time.sleep(mix_window_s)
+        stop_m.set()
+        for t in ts:
+            t.join(timeout=10)
+        return olap_lat, log_lat, mfails
+
+    c.query(olap_sql)  # warm both paths before measuring
+    c.query("SELECT COUNT(*) FROM logs WHERE message LIKE '%refused%'")
+    iso_lat, _, iso_fails = mix_window(with_logs=False)
+    mixed_lat, mixed_log_lat, mixed_fails = mix_window(with_logs=True)
+    c.stop()
+    mixed = {
+        "slo_p99_ms": slo_ms,
+        "olap_tenant_weight": 4.0,
+        "logs_tenant_weight": 1.0,
+        "olap_iso_p50_ms": round(_pct(0.50, iso_lat), 2),
+        "olap_iso_p99_ms": round(_pct(0.99, iso_lat), 2),
+        "olap_mixed_p50_ms": round(_pct(0.50, mixed_lat), 2),
+        "olap_mixed_p99_ms": round(_pct(0.99, mixed_lat), 2),
+        "log_mixed_p50_ms": round(_pct(0.50, mixed_log_lat), 2),
+        "olap_queries": len(iso_lat) + len(mixed_lat),
+        "log_queries": len(mixed_log_lat),
+        "failed_queries": len(iso_fails) + len(mixed_fails),
+    }
+
+    out = {
+        "metric": "clp_device_like_speedup_vs_host_decode",
+        "value": speedup_min,
+        "unit": "x",
+        "docs": num_segments * docs,
+        "clp_compression_ratio": round(raw_bytes / max(clp_bytes, 1), 2),
+        "pushdown_ab": ab,
+        "clp_served": int(served),
+        "coalesce": coalesce,
+        "ingest": {
+            "events_per_sec": round(events_per_sec),
+            "events_published": max_events,
+            "events_indexed": int(drained),
+            "seals": len(commits),
+            "exact": [list(exact), list(want)],
+            "query_p50_ms": round(_pct(0.50, lats) * 1e3, 2),
+            "failed_queries": len(fails),
+        },
+        "chaos": {
+            "crashed": bool(crashed),
+            "converged": chaos_got == chaos_want,
+            "got": list(chaos_got),
+            "want": list(chaos_want),
+            "seals_before_kill": len(commits3),
+            "resume_offset": resume,
+            "decisions": len(decisions),
+            "failed_queries": len(fails3),
+        },
+        "mixed_tenants": mixed,
+        "host_cpu_cores": os.cpu_count(),
+        "backend": jax.devices()[0].platform,
+        "smoke": smoke,
+        "asserted": {
+            "parity": "device LIKE == host LIKE, bit-exact counts",
+            "min_speedup": 2.0 if on_cpu else 5.0,
+            "max_steady_retraces": 0,
+            "min_batch_size": 2,
+            "exactly_once": True,
+            "olap_p99_within_slo": True,
+            "failed_queries": 0,
+        },
+    }
+    if out_path is None:
+        out_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "BENCH_logs.json")
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps(out))
+
+    # -- gates ---------------------------------------------------------
+    assert coalesce["retraces_steady"] == 0, \
+        f"steady-state retraces: {coalesce['retraces_steady']}"
+    assert coalesce["batch_size_max"] >= 2, \
+        "fingerprint-equal CLP queries never coalesced"
+    assert drained == max_events and exact == want, (exact, want)
+    assert len(commits) >= 2, "no seal rotations — widen the window"
+    assert len(fails) == 0, f"ingest-window queries failed: {fails[:3]}"
+    assert crashed, "chaos never fired"
+    assert chaos_got == chaos_want, (chaos_got, chaos_want)
+    assert len(fails3) == 0, f"chaos-window queries failed: {fails3[:3]}"
+    assert mixed["failed_queries"] == 0, "mixed-traffic queries failed"
+    if not smoke:
+        gate = 2.0 if on_cpu else 5.0
+        assert speedup_min >= gate, \
+            f"device LIKE speedup {speedup_min}x under the {gate}x bar"
+        assert mixed["olap_mixed_p99_ms"] <= slo_ms, \
+            (f"OLAP p99 {mixed['olap_mixed_p99_ms']}ms broke the "
+             f"{slo_ms}ms SLO under mixed traffic")
+
+
 def main():
     os.makedirs(DATA_DIR, exist_ok=True)
     build_data()
@@ -3461,5 +3978,7 @@ if __name__ == "__main__":
         health_main(smoke="--smoke" in sys.argv)
     elif "--overload" in sys.argv:
         overload_main(smoke="--smoke" in sys.argv)
+    elif "--logs" in sys.argv:
+        logs_main(smoke="--smoke" in sys.argv)
     else:
         main()

@@ -127,7 +127,8 @@ class TaskQueue:
                  lease_ttl_s: float = 30.0, max_attempts: int = 3,
                  backoff_s: float = 1.0, backoff_cap_s: float = 30.0,
                  journal_max_bytes: int = 1 << 20, max_done: int = 256,
-                 metrics=None):
+                 metrics=None,
+                 tenant_weight_of: Optional[Callable[[str], float]] = None):
         self.lease_ttl_s = float(lease_ttl_s)
         self.max_attempts = max(1, int(max_attempts))
         self.backoff_s = float(backoff_s)
@@ -135,10 +136,18 @@ class TaskQueue:
         self.max_done = max(1, int(max_done))
         self._tasks: "Dict[str, TaskEntry]" = {}
         self._lock = threading.Lock()
-        #: per-table last-lease stamp for round-robin fairness (0 =
-        #: never served; smaller = longer since last lease)
-        self._table_served: Dict[str, int] = {}
-        self._serve_seq = 0
+        #: per-table virtual lease time for tenant-weighted fairness:
+        #: each lease advances the table's clock by 1/weight, and the
+        #: slowest clock goes first — weight 2.0 tables lease twice as
+        #: often as weight 1.0 under contention (the minion analog of
+        #: the per-tenant weighted-fair query scheduler). Weight 1.0
+        #: everywhere degenerates to the old plain round-robin.
+        self._table_vtime: Dict[str, float] = {}
+        #: new tables join at the floor (the last-served table's clock),
+        #: not at 0 — a late-arriving table gets round-robin parity, not
+        #: a catch-up burst over everyone's backlog
+        self._vtime_floor = 0.0
+        self._tenant_weight_of = tenant_weight_of
         self._metrics = metrics
         self.journal_path = journal_path
         self.journal_max_bytes = max(4096, int(journal_max_bytes))
@@ -271,11 +280,14 @@ class TaskQueue:
               task_types: Optional[List[str]] = None,
               lease_ttl_s: Optional[float] = None) -> Optional[TaskEntry]:
         """Grant one leasable PENDING task matching the worker's declared
-        task types. Lease order is (priority desc, round-robin over
-        tables, FIFO): within the highest waiting priority tier the
-        least-recently-served TABLE goes first, so a flood of one
-        table's tasks cannot starve another table's — and within a table
-        it is oldest-first, as before. Sweeps expired leases first so a
+        task types. Lease order is (priority desc, tenant-weighted
+        round-robin over tables, FIFO): within the highest waiting
+        priority tier the table with the SLOWEST virtual lease clock
+        goes first, and each grant advances the winner's clock by
+        1/tenant-weight — so a flood of one table's tasks cannot starve
+        another table's, and a weight-2 tenant's tables lease twice as
+        often as weight-1 under contention. Within a table it is
+        oldest-first, as before. Sweeps expired leases first so a
         polling worker (not just the cadence loop) recovers crashed
         peers' work."""
         now = time.time()
@@ -287,13 +299,21 @@ class TaskQueue:
                  if e.state == PENDING and e.not_before <= now
                  and (not task_types or e.task_type in task_types)),
                 key=lambda e: (-e.priority,
-                               self._table_served.get(e.table, 0),
+                               self._table_vtime.get(e.table,
+                                                     self._vtime_floor),
                                e.created_at, e.task_id))
             if not candidates:
                 return None
             e = candidates[0]
-            self._serve_seq += 1
-            self._table_served[e.table] = self._serve_seq
+            v = self._table_vtime.get(e.table, self._vtime_floor)
+            self._vtime_floor = v
+            w = 1.0
+            if self._tenant_weight_of is not None:
+                try:
+                    w = float(self._tenant_weight_of(e.table) or 1.0)
+                except Exception:  # noqa: BLE001 — fairness, not safety
+                    w = 1.0
+            self._table_vtime[e.table] = v + 1.0 / max(w, 1e-6)
             # chaos hook: delay/fail the grant itself (a raise leaves the
             # task PENDING — the lease was never handed out)
             fire("controller.task.assign", task_id=e.task_id,
@@ -439,9 +459,15 @@ class TaskManager:
                 "pinot.controller.task.retry.backoff.cap.seconds"),
             journal_max_bytes=cfg.get_int(
                 "pinot.controller.task.journal.max.bytes"),
-            metrics=self._metrics)
+            metrics=self._metrics,
+            tenant_weight_of=self._tenant_weight)
         self.generators_enabled = cfg.get_bool(
             "pinot.controller.task.generators.enabled")
+        #: injectable workload source for the auto star-tree generator
+        #: (tests substitute a canned registry; production reads the
+        #: server-role rollup that backs /debug/workload)
+        from pinot_tpu.health.workload import get_workload
+        self.workload_provider: Callable = lambda: get_workload("server")
         #: callback(adds: [SegmentState], removes: [(table, name)]) fired
         #: AFTER a segment-replace commits — embedded harnesses
         #: (MiniCluster) push the swap into their servers/routing with it
@@ -454,6 +480,15 @@ class TaskManager:
         self._replace_lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+
+    def _tenant_weight(self, physical_table: str) -> float:
+        """Lease-fairness weight of a physical table = its tenant
+        config's scheduler weight (TableConfig.tenants.weight) — minion
+        capacity follows the same per-tenant shares as query capacity."""
+        base = physical_table.rsplit("_", 1)[0]
+        cfg = self.state.tables.get(base)
+        tenants = getattr(cfg, "tenants", None) if cfg is not None else None
+        return float(getattr(tenants, "weight", 1.0) or 1.0)
 
     # -- scheduler cadence ---------------------------------------------
     def run_once(self) -> Dict[str, int]:
@@ -509,6 +544,44 @@ class TaskManager:
                     params.get("maxSegmentsPerTask", 16)))
         return out
 
+    def _gen_clp_compaction(self, cfg, params) -> List[TaskConfig]:
+        # nothing to compact without configured log columns (task params
+        # or table indexing config)
+        if not (params.get("clpColumns") or cfg.indexing.clp_columns):
+            return []
+        from pinot_tpu.controller.tasks import generate_clp_compaction_tasks
+        types = params.get("tableTypes") or ["REALTIME", "OFFLINE"]
+        out: List[TaskConfig] = []
+        for t in types:
+            out += generate_clp_compaction_tasks(
+                self.state, f"{cfg.name}_{t}",
+                max_segments_per_task=int(
+                    params.get("maxSegmentsPerTask", 16)))
+        return out
+
+    def _gen_auto_startree(self, cfg, params) -> List[TaskConfig]:
+        """Workload-driven star-tree scheduling: only schedule builds
+        for tables the observed workload rollup (/debug/workload) shows
+        as HOT — repeated plan fingerprints above a cost floor. Opt-in
+        via task_configs["AutoStarTreeTask"]; emits plain
+        StarTreeBuildTask configs, so the executor/commit path is
+        identical to explicitly scheduled builds."""
+        if cfg.upsert:
+            return []
+        if not (params.get("starTreeIndexConfigs")
+                or cfg.indexing.star_tree_configs):
+            return []
+        reg = self.workload_provider()
+        min_cost = float(params.get("minCostMs", 100.0))
+        min_queries = int(params.get("minQueries", 2))
+        names = {cfg.name, f"{cfg.name}_OFFLINE", f"{cfg.name}_REALTIME"}
+        hot = [w for w in reg.top(int(params.get("topK", 20)), by="cost_ms")
+               if w["table"] in names and w["costMs"] >= min_cost
+               and w["queries"] >= min_queries]
+        if not hot:
+            return []
+        return self._gen_startree_build(cfg, params)
+
     #: task-config key -> generator method; a table opts in per type via
     #: ``TableConfig.task_configs[<task type>]`` (taskTypeConfigsMap)
     GENERATORS = {
@@ -516,6 +589,8 @@ class TaskManager:
         "RealtimeToOfflineSegmentsTask": _gen_realtime_to_offline,
         "PurgeTask": _gen_purge,
         "StarTreeBuildTask": _gen_startree_build,
+        "ClpCompactionTask": _gen_clp_compaction,
+        "AutoStarTreeTask": _gen_auto_startree,
     }
 
     def generate_tasks(self) -> int:

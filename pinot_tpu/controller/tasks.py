@@ -306,6 +306,69 @@ class StarTreeBuildTaskExecutor(TaskExecutor):
         return {"builtSegments": built}
 
 
+class ClpCompactionTaskExecutor(TaskExecutor):
+    """Re-encode sealed log segments into CLP forward-index form (the
+    y-scope fork's compaction of realtime text columns into
+    CLPForwardIndexCreatorV2 segments): rebuild each segment from its
+    own columns under a config whose indexing.clp_columns carries the
+    log columns, and commit through the same publish/retire (manifest +
+    replace_segments) swap as every other rewrite task. Once swapped,
+    LIKE/regex over the log column serves from the device pushdown leg
+    (ops/clp_device.py) instead of host-side full decode.
+
+    The column list comes from task params ("clpColumns") or, absent
+    that, the table's indexing config. The rebuild is deterministic in
+    the input segment bytes + config (encode_message has no randomness;
+    the output name is a pure function of the input name), so a
+    re-leased crashed task rebuilds byte-identical segments and the
+    commit stays idempotent. Convergence marker is it.CLP in the column
+    metadata's index list — not a name suffix — so the generator never
+    rescans a compacted segment."""
+    task_type = "ClpCompactionTask"
+
+    def execute(self, task: TaskConfig, ctx: TaskContext) -> Dict[str, Any]:
+        import copy
+
+        from pinot_tpu.utils.failpoints import fire
+        table = task.table
+        cfg = ctx.table_config(table)
+        schema = ctx.schema_for(table)
+        clp_cols = list(task.params.get("clpColumns") or
+                        cfg.indexing.clp_columns)
+        if not clp_cols:
+            raise ValueError(
+                "ClpCompactionTask needs clpColumns (task params or "
+                "table indexing config)")
+        build_cfg = copy.deepcopy(cfg)
+        build_cfg.indexing.clp_columns = clp_cols
+        compacted = []
+        for seg_name in task.segments:
+            # chaos site: a crash here leaves the source segment
+            # serving via the host decode path; the re-leased task
+            # re-encodes the SAME bytes (deterministic codec + name)
+            fire("minion.clp.compact", table=table, segment=seg_name)
+            seg = ctx.load(table, seg_name)
+            columns = {}
+            for spec in schema.fields:
+                if spec.virtual:
+                    continue
+                columns[spec.name] = np.asarray(
+                    seg.data_source(spec.name).values())
+            name = f"{seg_name}_clp"
+            out_dir = os.path.join(ctx.output_dir, name)
+            SegmentCreator(build_cfg, schema).build(columns, out_dir, name)
+            m = load_segment(out_dir).metadata
+            old_state = ctx.segment_state(table, seg_name)
+            ctx.publish_segment(SegmentState(
+                name=name, table=table,
+                instances=list(old_state.instances), dir_path=out_dir,
+                num_docs=m.num_docs, start_time=m.start_time,
+                end_time=m.end_time, crc=m.crc))
+            ctx.retire_segment(table, seg_name)
+            compacted.append(name)
+        return {"compactedSegments": compacted, "clpColumns": clp_cols}
+
+
 # -- generators (ref PinotTaskGenerator) ------------------------------------
 
 def generate_merge_rollup_tasks(state: ClusterState, table: str,
@@ -410,6 +473,47 @@ def generate_startree_build_tasks(state: ClusterState, table: str,
     return tasks
 
 
+def generate_clp_compaction_tasks(state: ClusterState, table: str,
+                                  max_segments_per_task: int = 16
+                                  ) -> List[TaskConfig]:
+    """Batch ONLINE segments whose configured CLP columns are NOT yet
+    CLP-encoded into compaction tasks. Convergence marker: it.CLP in
+    the column's metadata index list (one json peek per candidate — no
+    segment load), so the scan self-quiesces after one pass; segments
+    whose metadata isn't locally readable (deep-store URIs not yet
+    localized) are skipped this tick rather than churned."""
+    import json
+
+    from pinot_tpu.segment import index_types as it
+    base = table.rsplit("_", 1)[0]
+    cfg = state.tables.get(base)
+    clp_cols = list(getattr(cfg.indexing, "clp_columns", None) or []) \
+        if cfg is not None else []
+    if not clp_cols:
+        return []
+
+    def compacted(s: SegmentState) -> bool:
+        try:
+            with open(os.path.join(s.dir_path, "metadata.json")) as f:
+                cols = json.load(f).get("columns", {})
+        except (OSError, ValueError):
+            return True  # unreadable here -> leave it alone
+        for c in clp_cols:
+            cm = cols.get(c)
+            if cm is not None and it.CLP not in cm.get("indexes", []):
+                return False
+        return True
+    segs = sorted((s for s in state.table_segments(table)
+                   if s.status == "ONLINE" and not compacted(s)),
+                  key=lambda s: s.name)
+    tasks: List[TaskConfig] = []
+    for i in range(0, len(segs), max_segments_per_task):
+        chunk = segs[i:i + max_segments_per_task]
+        tasks.append(TaskConfig("ClpCompactionTask", table,
+                                [c.name for c in chunk]))
+    return tasks
+
+
 _EXECUTORS: Dict[str, TaskExecutor] = {}
 
 
@@ -436,3 +540,4 @@ register_executor(MergeRollupTaskExecutor())
 register_executor(RealtimeToOfflineTaskExecutor())
 register_executor(PurgeTaskExecutor())
 register_executor(StarTreeBuildTaskExecutor())
+register_executor(ClpCompactionTaskExecutor())

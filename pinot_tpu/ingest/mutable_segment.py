@@ -89,6 +89,75 @@ class _MutableColumn:
         return Bitmap.from_indices(n, nulls)
 
 
+class _MutableClpColumn(_MutableColumn):
+    """CLP-encoded mutable log column (ref the y-scope fork's realtime
+    CLPMutableForwardIndex): rows append through segment/clp.py's
+    encode_message into a growing logtype dictionary + variable stores,
+    so the consuming segment holds templates and variables — not the
+    raw message text. Queries decode per snapshot (consuming segments
+    run host-side and are flush-capped small); sealing decodes once and
+    SegmentCreator re-encodes into the immutable CLP forward index, so
+    the seal->build->warm->swap pipeline rides unchanged.
+
+    `distinct` is the logtype index dict: metadata cardinality reports
+    TEMPLATE cardinality, the quantity that stays small and meaningful
+    for log columns (raw-message distinct would defeat the encoding)."""
+
+    def __init__(self, spec: FieldSpec):
+        super().__init__(spec)
+        self._logtypes: List[str] = []
+        self._lt_index: Dict[str, int] = {}
+        self._lt_ids: List[int] = []
+        self._var_index: Dict[str, int] = {}
+        self._var_ids: List[int] = []
+        self._dv_counts: List[int] = []
+        self._enc: List[int] = []
+        self._enc_counts: List[int] = []
+        self.distinct = self._lt_index
+
+    def append(self, doc_id: int, value: Any) -> None:
+        from pinot_tpu.segment.clp import encode_message
+        spec = self.spec
+        if value is None:
+            self._null_docs.append(doc_id)
+            value = spec.default_null_value
+        lt, dv, ev = encode_message(str(value))
+        lid = self._lt_index.get(lt)
+        if lid is None:
+            lid = len(self._logtypes)
+            self._lt_index[lt] = lid
+            self._logtypes.append(lt)
+            self.nbytes_est += self._OBJ_OVERHEAD + len(lt)
+        self._lt_ids.append(lid)
+        for tok in dv:
+            vid = self._var_index.get(tok)
+            if vid is None:
+                vid = len(self._var_index)
+                self._var_index[tok] = vid
+                self.nbytes_est += self._OBJ_OVERHEAD + len(tok)
+            self._var_ids.append(vid)
+        self._dv_counts.append(len(dv))
+        self._enc.extend(ev)
+        self._enc_counts.append(len(ev))
+        # per-doc fixed cost: logtype id + var ids + encoded vars
+        self.nbytes_est += 4 + 4 * len(dv) + 8 * len(ev)
+
+    def values_snapshot(self, n: int):
+        from pinot_tpu.segment.clp import decode_message
+        vd = list(self._var_index)
+        out = np.empty(n, dtype=object)
+        di = ei = 0
+        for d in range(n):
+            ndv, nev = self._dv_counts[d], self._enc_counts[d]
+            out[d] = decode_message(
+                self._logtypes[self._lt_ids[d]],
+                [vd[i] for i in self._var_ids[di:di + ndv]],
+                self._enc[ei:ei + nev])
+            di += ndv
+            ei += nev
+        return out
+
+
 class _MutableDataSource:
     """Snapshot view implementing the DataSource duck type the executors
     consume (values + metadata; no sorted dict, no aux indexes)."""
@@ -153,8 +222,14 @@ class MutableSegment:
         self.segment_name = segment_name
         self.table_config = table_config
         self.schema = schema
+        clp_cols = set(getattr(table_config.indexing, "clp_columns",
+                               None) or [])
         self._cols: Dict[str, _MutableColumn] = {
-            s.name: _MutableColumn(s) for s in schema.fields if not s.virtual}
+            s.name: (_MutableClpColumn(s)
+                     if (s.name in clp_cols and s.single_value
+                         and s.data_type == DataType.STRING)
+                     else _MutableColumn(s))
+            for s in schema.fields if not s.virtual}
         self._num_docs = 0
         self._lock = threading.Lock()
         self.start_consumption_time = time.time()

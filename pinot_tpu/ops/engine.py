@@ -29,6 +29,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from pinot_tpu.ops import clp_device
 from pinot_tpu.ops import dispatch as dispatch_mod
 from pinot_tpu.ops import kernels
 from pinot_tpu.ops import startree_device
@@ -183,6 +184,15 @@ class TpuOperatorExecutor:
             "pinot.server.startree.enabled", True)
         self._st_resident = _cfg.get_bool(
             "pinot.server.startree.hbm.resident", True)
+        #: CLP log-column LIKE/regex pushdown (ops/clp_device.py):
+        #: patterns compile to logtype LUTs + variable-slot conditions
+        #: evaluated as 'clp' filter leaves through the same kernel
+        #: factory; hbm.resident admits the logtype-id / var-slot
+        #: pseudo-columns into the per-(segment, column) residency tier
+        self._clp_enabled = _cfg.get_bool(
+            "pinot.server.clp.enabled", True)
+        self._clp_resident = _cfg.get_bool(
+            "pinot.server.clp.hbm.resident", True)
         self._metrics = self._dispatcher._metrics
         self._residency._metrics = self._metrics
 
@@ -453,6 +463,36 @@ class TpuOperatorExecutor:
         labels = dict(self._labels or {})
         labels["reason"] = reason
         self._metrics.add_meter("startree_fallback", labels=labels)
+
+    def _clp_fallback(self, reason: str) -> None:
+        """clp_fallback{reason=}: why a LIKE/regex over a CLP column left
+        the device path (pattern outside the pushable subset, slot caps,
+        staging failure, ...) — vocabulary in clp_device.FALLBACK_REASONS."""
+        if self._metrics is None:
+            return
+        labels = dict(self._labels or {})
+        labels["reason"] = reason
+        self._metrics.add_meter("clp_fallback", labels=labels)
+
+    def _clp_leaf(self, e: Function, segments, col: str):
+        """'clp' DeviceLeaf for a LIKE/regexp_like predicate over a
+        CLP-indexed column, or None (fallback metered with a reason).
+        The pattern itself stays OUT of the leaf — like every other leaf
+        kind, constants resolve at parameter staging so fingerprint-equal
+        queries with different patterns share one compiled kernel."""
+        if not self._clp_enabled:
+            self._clp_fallback("disabled")
+            return None
+        if e.name not in ("like", "regexp_like") or len(e.args) != 2 \
+                or not isinstance(e.args[1], Literal):
+            self._clp_fallback("predicate")
+            return None
+        meta, reason = clp_device.plan_leaf(
+            segments, col, str(e.args[1].value), e.name == "like")
+        if meta is None:
+            self._clp_fallback(reason)
+            return None
+        return DeviceLeaf("clp", col, meta)
 
     def _prepare_startree(self, segments: List[ImmutableSegment],
                           ctx: QueryContext, cancel_check=None,
@@ -1169,6 +1209,7 @@ class TpuOperatorExecutor:
             dict_cols=tuple(sorted(dict_cols)),
             raw_cols=tuple(sorted(raw_cols - raw64)),
             raw64_cols=tuple(sorted(raw64)),
+            clp_cols=clp_device.staged_cols(leaves),
             valid_mask=self._needs_valid_mask(segments),
         )
         return plan, slots_of_fn
@@ -1269,6 +1310,7 @@ class TpuOperatorExecutor:
             dict_cols=tuple(sorted(dict_cols)),
             raw_cols=tuple(sorted(raw_cols - raw64)),
             raw64_cols=tuple(sorted(raw64)),
+            clp_cols=clp_device.staged_cols(leaves),
             mode="topn", topn_k=k, topn_asc=bool(topn_asc),
             valid_mask=self._needs_valid_mask(segments))
 
@@ -1328,6 +1370,15 @@ class TpuOperatorExecutor:
         if not e.args or not isinstance(e.args[0], Identifier):
             return None
         col = e.args[0].name
+        if clp_device.is_clp_column(seg0, col):
+            # CLP log columns never classify (STRING, no dictionary
+            # block) — LIKE/regex push down through their own leaf kind
+            # instead, against the logtype/var-slot pseudo-columns
+            leaf = self._clp_leaf(e, segments, col)
+            if leaf is None:
+                return None
+            leaves.append(leaf)
+            return ("leaf", len(leaves) - 1)
         if not classify(col):
             return None
         m = seg0.metadata.columns[col]
@@ -1452,6 +1503,40 @@ class TpuOperatorExecutor:
                 segments, S, D, col, "vallo",
                 lambda ds: (ds.values().astype(np.int64) & 0xFFFFFF
                             ).astype(np.int32), np.int32)
+        for col, kd, ke in plan.clp_cols:
+            # CLP log columns stage as a pseudo-column family instead of
+            # values: the logtype-id row plus kd dict-var-slot id rows
+            # and ke encoded-var (hi, lo) i32 split rows — the 'clp'
+            # leaf matches against these without ever materializing the
+            # decoded strings (ops/clp_device.py)
+            def clp_fetch(fn, _c=col):
+                def fetch_row(seg):
+                    try:
+                        r = seg.data_source(_c).clp_reader
+                    except (KeyError, ValueError, AttributeError):
+                        r = None
+                    if r is None:
+                        raise _NotStageable()
+                    return fn(r)
+                return fetch_row
+            cols["clpid:" + col] = self._block(
+                segments, S, D, col, "clpid",
+                clp_fetch(clp_device.row_ids), np.int32,
+                resident=self._clp_resident)
+            for j in range(kd):
+                cols[f"clpdv{j}:{col}"] = self._block(
+                    segments, S, D, col, f"clpdv{j}",
+                    clp_fetch(lambda r, _j=j: clp_device.row_dict_slot(
+                        r, _j)), np.int32, resident=self._clp_resident)
+            for j in range(ke):
+                cols[f"clpehi{j}:{col}"] = self._block(
+                    segments, S, D, col, f"clpehi{j}",
+                    clp_fetch(lambda r, _j=j: clp_device.row_enc_hi(
+                        r, _j)), np.int32, resident=self._clp_resident)
+                cols[f"clpelo{j}:{col}"] = self._block(
+                    segments, S, D, col, f"clpelo{j}",
+                    clp_fetch(lambda r, _j=j: clp_device.row_enc_lo(
+                        r, _j)), np.int32, resident=self._clp_resident)
 
         # value columns: stage MATERIALIZED values (dictionary take done
         # host-side at staging, cached in HBM) rather than in-kernel
@@ -1492,6 +1577,8 @@ class TpuOperatorExecutor:
             if all(a is b for a, b in zip(csegs, segments)):
                 self._params_cache.move_to_end(pkey)  # LRU refresh
                 params.update(cparams)
+                if plan.clp_cols:
+                    self._meter("clp_served")
                 return cols, params, cnum_docs, S_real, D, G
         # histogram sketch slots: bucket bounds from segment metadata
         # (missing min/max -> host fallback)
@@ -1563,6 +1650,15 @@ class TpuOperatorExecutor:
                     else:
                         raise _NotStageable()
                 params[f"leaf{i}:idx"] = self._put(idx)
+            elif leaf.kind == "clp":
+                try:
+                    arrs = clp_device.leaf_params(
+                        i, leaf, segments, str(expr.args[1].value),
+                        expr.name == "like", S)
+                except ValueError:
+                    raise _NotStageable()
+                for k, arr in arrs.items():
+                    params[k] = self._put(arr)
             elif leaf.kind == "lut":
                 C = _pow2(max(s.metadata.columns[leaf.column].cardinality
                               for s in segments), floor=8)
@@ -1596,6 +1692,8 @@ class TpuOperatorExecutor:
         self._params_cache.move_to_end(pkey)
         while len(self._params_cache) > self.PARAMS_CACHE_ENTRIES:
             self._params_cache.popitem(last=False)  # evict coldest only
+        if plan.clp_cols:
+            self._meter("clp_served")
         return cols, params, num_docs_dev, S_real, D, G
 
     # ------------------------------------------------------------------
@@ -1825,7 +1923,10 @@ class TpuOperatorExecutor:
         return self._block(segments, S, D, col, kind, fetch_row, dtype)
 
     def _block(self, segments, S, D, col, kind, fetch_row, dtype,
-               host_cache: bool = True):
+               host_cache: bool = True, resident: bool = True):
+        """resident=False (clp.hbm.resident off): skip the per-row
+        residency tier for this block family — host stack + whole-block
+        upload, so opted-out pseudo-columns never evict scan columns."""
         dtype_str = np.dtype(dtype).str
         bkey = (_batch_id(segments), kind, col, S, D, dtype_str)
         entry = self._block_cache.get(bkey)
@@ -1835,7 +1936,7 @@ class TpuOperatorExecutor:
             self._meter("hbm_block_hit")
             return entry[1]
         self._meter("hbm_block_miss")
-        if self._residency.enabled:
+        if self._residency.enabled and resident:
             dev = self._assemble_resident(segments, S, D, col, kind,
                                           fetch_row, dtype, host_cache)
             nbytes = S * D * np.dtype(dtype).itemsize

@@ -22,6 +22,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from pinot_tpu.ops import clp_device
 from pinot_tpu.ops.plan_ir import DeviceLeaf, DevicePlan
 
 # group-by cardinality below which the one-hot matmul path (MXU-friendly)
@@ -176,6 +177,10 @@ def _eval_filter(node, plan: DevicePlan, cols: Dict[str, jnp.ndarray],
         ge = (vhi > a_hi) | ((vhi == a_hi) & (vlo >= a_lo))
         le = (vhi < b_hi) | ((vhi == b_hi) & (vlo <= b_lo))
         return ge & le
+    if leaf.kind == "clp":
+        # LIKE/regex over a CLP log column: candidate-logtype LUT plus
+        # variable-slot conditions (ops/clp_device.py)
+        return clp_device.eval_leaf(i, leaf, cols, params)
     raise ValueError(f"unknown leaf kind {leaf.kind}")
 
 

@@ -19,6 +19,11 @@ Leaf kinds (resolved per-segment into parameter arrays, see ops/engine.py):
               on big-int columns staged as (hi, lo) i32 split planes
               (hi = v >> 24, lo = v & 0xFFFFFF); works with x64 OFF where
               f32 staging would alias values above 2^24 (epoch millis)
+    'clp'   : LIKE/regex over a CLP log column, evaluated against the
+              column's logtype-id + variable-slot pseudo-columns
+              (ops/clp_device.py compiles the pattern to per-segment
+              candidate-logtype LUTs + encoded/dict variable conditions;
+              leaf.meta = (mode, Kd, Ke) picks the staged slot layout)
 
 Value IR (aggregation inputs / in-kernel transforms):
     ('col', name)       -- column values (dict gather or raw staged block)
@@ -35,8 +40,11 @@ from typing import Optional, Tuple
 
 @dataclass(frozen=True)
 class DeviceLeaf:
-    kind: str         # 'range' | 'neq' | 'lut' | 'vrange' | 'vrange64'
+    kind: str         # 'range' | 'neq' | 'lut' | 'vrange' | 'vrange64' | 'clp'
     column: str
+    #: kind-specific static shape info folded into the plan signature
+    #: ('clp': (mode, Kd, Ke) — see ops/clp_device.py)
+    meta: Tuple = ()
 
 
 @dataclass(frozen=True)
@@ -67,6 +75,10 @@ class DevicePlan:
     raw_cols: Tuple[str, ...] = ()
     #: big-int columns staged as (hi, lo) i32 split planes, filter-only
     raw64_cols: Tuple[str, ...] = ()
+    #: CLP log columns staged as (name, Kd, Ke) pseudo-column families:
+    #: logtype-id block + Kd dict-var-slot id blocks + Ke encoded-var
+    #: (hi, lo) i32 split slot blocks (ops/clp_device.py), filter-only
+    clp_cols: Tuple[Tuple[str, int, int], ...] = ()
     #: 'agg' (default) | 'topn' — topn plans compute per-segment top-K doc
     #: indices by value_irs[0] (or first-K matching when it is None) for
     #: selection / selection-order-by offload

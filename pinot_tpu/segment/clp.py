@@ -36,34 +36,45 @@ _TOKEN_RE = re.compile(r"[^\s=:,\[\]\(\)\"']+")
 _HAS_DIGIT = re.compile(r"\d")
 
 
+def encode_token(tok: str) -> Tuple[str, Any]:
+    """Classify one token exactly as :func:`encode_message` would:
+    ``("static", tok)`` | ``("int", int64_value)`` | ``("float",
+    ieee_bits_as_int64)`` | ``("dict", tok)``. The single source of
+    truth for variable extraction — the device pushdown planner
+    (ops/clp_device.py) mirrors the codec through this function."""
+    if not _HAS_DIGIT.search(tok):
+        return "static", tok
+    # exact-roundtrip int
+    try:
+        v = int(tok)
+        if str(v) == tok and -(2**63) <= v < 2**63:
+            return "int", v
+    except ValueError:
+        pass
+    # exact-roundtrip float
+    try:
+        f = float(tok)
+        if repr(f) == tok:
+            return "float", struct.unpack("<q", struct.pack("<d", f))[0]
+    except ValueError:
+        pass
+    return "dict", tok
+
+
 def encode_message(msg: str) -> Tuple[str, List[str], List[int]]:
     """message -> (logtype, dict_vars, encoded_vars)."""
     dict_vars: List[str] = []
     encoded: List[int] = []
 
     def repl(m: re.Match) -> str:
-        tok = m.group()
-        if not _HAS_DIGIT.search(tok):
-            return tok  # static text
-        # exact-roundtrip int
-        try:
-            v = int(tok)
-            if str(v) == tok and -(2**63) <= v < 2**63:
-                encoded.append(v)
-                return INT_PH
-        except ValueError:
-            pass
-        # exact-roundtrip float
-        try:
-            f = float(tok)
-            if repr(f) == tok:
-                encoded.append(
-                    struct.unpack("<q", struct.pack("<d", f))[0])
-                return FLOAT_PH
-        except ValueError:
-            pass
-        dict_vars.append(tok)
-        return DICT_PH
+        kind, val = encode_token(m.group())
+        if kind == "static":
+            return val
+        if kind == "dict":
+            dict_vars.append(val)
+            return DICT_PH
+        encoded.append(val)
+        return INT_PH if kind == "int" else FLOAT_PH
 
     logtype = _TOKEN_RE.sub(repl, msg)
     return logtype, dict_vars, encoded
@@ -213,6 +224,8 @@ class CLPForwardIndexReader:
         return out, 4 * (count + 1) + int(offsets[-1])
 
     def get(self, doc_id: int) -> str:
+        """Random access: one doc from the prefix-offset indexes — never a
+        full-column decode (ref CLPForwardIndexReaderV2.getString)."""
         lt = self.logtypes[self.logtype_ids[doc_id]]
         dv = [self.var_dictionary[i] for i in
               self.var_ids[self.dv_offsets[doc_id]:self.dv_offsets[doc_id + 1]]]
@@ -220,8 +233,46 @@ class CLPForwardIndexReader:
         return decode_message(lt, dv, ev.tolist())
 
     def decode_all(self) -> np.ndarray:
-        return np.array([self.get(i) for i in range(self.num_docs)],
-                        dtype=object)
+        """Whole-column decode into ONE object array allocation; the
+        int arrays convert to python lists once up front instead of a
+        numpy scalar boxing per element per doc."""
+        n = self.num_docs
+        out = np.empty(n, dtype=object)
+        lts = self.logtypes
+        vd = self.var_dictionary
+        lt_ids = self.logtype_ids.tolist()
+        var_ids = self.var_ids.tolist()
+        dvo = self.dv_offsets.tolist()
+        eco = self.enc_offsets.tolist()
+        enc = self.encoded_vars.tolist()
+        for d in range(n):
+            dv = [vd[i] for i in var_ids[dvo[d]:dvo[d + 1]]]
+            out[d] = decode_message(lts[lt_ids[d]], dv, enc[eco[d]:eco[d + 1]])
+        return out
+
+    @property
+    def max_dict_vars(self) -> int:
+        """Widest per-doc dictionary-variable count (device slot sizing)."""
+        if getattr(self, "_max_dv", None) is None:
+            self._max_dv = int(np.diff(self.dv_offsets).max()) \
+                if self.num_docs else 0
+        return self._max_dv
+
+    @property
+    def max_encoded_vars(self) -> int:
+        """Widest per-doc encoded-variable count (device slot sizing)."""
+        if getattr(self, "_max_ev", None) is None:
+            self._max_ev = int(np.diff(self.enc_offsets).max()) \
+                if self.num_docs else 0
+        return self._max_ev
+
+    @property
+    def var_index(self) -> dict:
+        """token -> var-dictionary id (planner-side group pruning)."""
+        if getattr(self, "_var_index", None) is None:
+            self._var_index = {v: i for i, v in
+                               enumerate(self.var_dictionary)}
+        return self._var_index
 
 
 def clp_enricher(fields: Sequence[str]):

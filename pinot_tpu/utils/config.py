@@ -69,6 +69,12 @@ KEYS: Dict[str, Any] = {
     # admits the pre-agg pseudo-columns into the resident-row tier
     "pinot.server.startree.enabled": True,
     "pinot.server.startree.hbm.resident": True,
+    # CLP log-column LIKE/regex pushdown (ops/clp_device.py): patterns
+    # compile to logtype LUTs + variable-slot conditions evaluated as
+    # device filter leaves; .hbm.resident admits the logtype-id/var-slot
+    # pseudo-columns into the resident-row tier
+    "pinot.server.clp.enabled": True,
+    "pinot.server.clp.hbm.resident": True,
     "pinot.server.segment.cache.enabled": True,   # tier-2 partial cache
     "pinot.server.segment.cache.bytes": 256 << 20,
     "pinot.server.segment.cache.ttl.seconds": 300.0,

@@ -110,6 +110,11 @@ SITES: Dict[str, str] = {
         "per segment inside StarTreeBuildTask, before the rebuild (a "
         "SimulatedCrash leaves the source segment serving via the scan "
         "path; the re-leased task rebuilds byte-identical tree output)",
+    "minion.clp.compact":
+        "per segment inside ClpCompactionTask, before the re-encode (a "
+        "SimulatedCrash leaves the source segment serving via the host "
+        "decode path; the re-leased task re-encodes byte-identical CLP "
+        "output)",
     "mse.dispatch.stage":
         "broker-side, before one stage dispatches",
     "mse.mailbox.send":

@@ -81,6 +81,12 @@ METRICS: Dict[str, str] = {
         "tree-carrying batches routed to the scan path (label reason="
         "disabled|aggregation|groupBy|noTree|fit|filter|precision|"
         "groups|staging)",
+    "clp_served":
+        "queries whose CLP-column LIKE/regex filter served device-side",
+    "clp_fallback":
+        "CLP-column LIKE/regex filters routed to the host decode path "
+        "(label reason=disabled|predicate|charWildcard|regex|wildcard|"
+        "partial|slots|alignments|staging)",
     # -- memory tiers (HBM residency) ------------------------------------
     "hbm_cache_bytes": "assembled [S, D] block-cache bytes on device",
     "hbm_block_hit": "assembled-block cache hits",
