@@ -3887,6 +3887,350 @@ def logs_main(smoke: bool = False, out_path: "str | None" = None):
              f"{slo_ms}ms SLO under mixed traffic")
 
 
+def _rebalance_build_cluster(tmp: str, num_segments: int, docs: int):
+    """3 servers, replication 2: every segment lives on servers 0 and 1,
+    server 2 is empty — the rebalance target and the repair headroom.
+    Returns (cluster, segment_names, expected_answers) where
+    expected_answers[k] = (count, sum) for ``WHERE k >= k``."""
+    import numpy as np
+
+    from pinot_tpu.cluster.mini import MiniCluster
+    from pinot_tpu.models.schema import Schema
+    from pinot_tpu.models.table_config import TableConfig
+    from pinot_tpu.segment.creator import SegmentCreator
+    from pinot_tpu.segment.loader import load_segment
+    from pinot_tpu.utils.config import PinotConfiguration
+
+    schema = Schema.from_dict({
+        "schemaName": "rb",
+        "dimensionFieldSpecs": [{"name": "k", "dataType": "LONG"}],
+        "metricFieldSpecs": [{"name": "v", "dataType": "LONG"}]})
+    tc = TableConfig.from_dict(
+        {"tableName": "rb", "tableType": "OFFLINE",
+         "segmentsConfig": {"replication": 2}})
+    creator = SegmentCreator(tc, schema)
+    # roomy retry budget: when a server is killed mid-loop, all 8
+    # clients' in-flight queries retry at once — availability, not
+    # retry-storm damping, is what this bench measures
+    cfg = PinotConfiguration().with_overrides(
+        {"pinot.broker.retry.budget.min": 64.0,
+         "pinot.broker.retry.budget.cap": 256.0})
+    cluster = MiniCluster(num_servers=3, config=cfg)
+    cluster.start()
+    cluster.add_table("rb", table_config=tc, schema=schema)
+    ks, vs, names = [], [], []
+    for i in range(num_segments):
+        rng = np.random.default_rng(300 + i)
+        k = rng.integers(0, 8, docs).astype(np.int64)
+        v = rng.integers(0, 1000, docs).astype(np.int64)
+        d = os.path.join(tmp, f"rb_{i}")
+        creator.build({"k": k, "v": v}, d, f"rb_{i}")
+        seg = load_segment(d)
+        cluster.add_segment("rb", seg, server_idx=i % 2,
+                            replicas=[(i + 1) % 2])
+        ks.append(k)
+        vs.append(v)
+        names.append(seg.name)
+    k = np.concatenate(ks)
+    v = np.concatenate(vs)
+    expected = {kk: (int((k >= kk).sum()), int(v[k >= kk].sum()))
+                for kk in range(5)}
+    return cluster, names, expected
+
+
+def _rebalance_chaos_journal(tmp: str, sub: str, seed: int,
+                             num_segments: int):
+    """One seeded chaos run of a pure-state rebalance plan (engine only,
+    max.parallel.moves=1): returns (journal sha1, failpoint decisions).
+    Two same-seed runs must match byte for byte."""
+    import hashlib
+
+    from pinot_tpu.controller.cluster_state import (
+        ClusterState, InstanceState, SegmentState)
+    from pinot_tpu.controller.rebalancer import Rebalancer
+    from pinot_tpu.models.schema import Schema
+    from pinot_tpu.models.table_config import TableConfig
+    from pinot_tpu.utils.config import PinotConfiguration
+    from pinot_tpu.utils.failpoints import FaultSchedule
+    from pinot_tpu.utils.metrics import MetricsRegistry
+
+    st = ClusterState()
+    for i in range(3):
+        st.register_instance(InstanceState(f"server_{i}"))
+    st.add_table(
+        TableConfig.from_dict({"tableName": "rb", "tableType": "OFFLINE"}),
+        Schema.from_dict({"schemaName": "rb", "dimensionFieldSpecs":
+                          [{"name": "k", "dataType": "LONG"}]}))
+    for i in range(num_segments):
+        st.upsert_segment(SegmentState(f"rb_{i}", "rb_OFFLINE",
+                                       [f"server_{i % 2}"],
+                                       dir_path=f"/deep/rb_{i}"))
+    jp = os.path.join(tmp, f"chaos_{sub}.journal")
+    rb = Rebalancer(
+        st, load_fn=lambda *a: None, unload_fn=lambda *a: None,
+        config=PinotConfiguration().with_overrides(
+            {"pinot.controller.rebalance.max.parallel.moves": 1}),
+        journal_path=jp, metrics=MetricsRegistry("controller"))
+    sched = FaultSchedule([
+        ("controller.rebalance.move",
+         {"delay": 0.002, "probability": 0.5, "seed": seed}),
+    ])
+    sched.arm()
+    try:
+        job = rb.run("rb_OFFLINE", {
+            f"rb_{i}": {"from": [f"server_{i % 2}"],
+                        "to": [f"server_{(i + 1) % 3}"]}
+            for i in range(num_segments)})
+    finally:
+        sched.disarm()
+        rb.close()
+    assert job.status == "DONE", job.progress()
+    with open(jp, "rb") as f:
+        digest = hashlib.sha1(f.read()).hexdigest()
+    return digest, sched.decisions()
+
+
+def rebalance_main(smoke: bool = False, out_path: "str | None" = None):
+    """--rebalance [--smoke]: self-healing acceptance (ISSUE 18).
+
+    Leg A — **live rebalance, zero downtime**: an 8-client closed loop
+    runs while EVERY segment moves from servers {0,1} to {1,2} through
+    the journaled move engine (load+warm target -> one batched
+    assignment/routing commit -> drain source, never below the
+    availability floor). Asserts zero failed queries, zero wrong
+    answers (a query routed to an unloaded target, or a source drained
+    early, would return silently short rows), and a commit-time guard
+    that every instance in the new assignment already holds its
+    segment (the flip-before-load regression the one-shot assignment
+    flip had).
+
+    Leg B — **kill + automatic repair**: server 1 is killed
+    (SIGKILL-equivalent) mid-loop; the RepairChecker debounces the dead
+    heartbeat (two stale ticks), re-replicates its segments from their
+    dirs onto the surviving server through the same move engine, and
+    `segments_missing_replicas` drains to 0. Asserts zero failed
+    queries (broker failover bridges the gap) and repair convergence.
+
+    Leg C — **seeded chaos determinism**: the same plan under a seeded
+    delay schedule at `controller.rebalance.move` (parallelism 1) runs
+    twice; move journals must be byte-identical and the failpoint
+    decision logs equal.
+
+    Writes BENCH_rebalance.json. --smoke shrinks data + durations and
+    skips the throughput-floor assert; zero-failures, correctness,
+    convergence, and replay-identical are asserted always."""
+    import tempfile
+    import threading
+
+    from pinot_tpu.utils.metrics import MetricsRegistry
+
+    num_segments = 4 if smoke else 8
+    docs = 800 if smoke else 20_000
+    duration_s = 1.2 if smoke else 5.0
+    clients = 8
+
+    tmp = tempfile.mkdtemp(prefix="bench_rebalance_")
+    cluster, seg_names, expected = _rebalance_build_cluster(
+        tmp, num_segments, docs)
+
+    lock = threading.Lock()
+
+    def closed_loop(duration: float):
+        """8-client closed loop; returns (latencies, failures, wrong)."""
+        stop_at = time.perf_counter() + duration
+        lat, failures, wrong = [], [], []
+
+        def client(cid: int):
+            i = cid
+            while time.perf_counter() < stop_at:
+                kk = i % 5
+                t0 = time.perf_counter()
+                resp = cluster.query(
+                    f"SELECT COUNT(*), SUM(v) FROM rb WHERE k >= {kk}")
+                dt = time.perf_counter() - t0
+                with lock:
+                    lat.append(dt)
+                    if resp.exceptions:
+                        failures.append(resp.exceptions)
+                    elif (resp.rows[0][0], resp.rows[0][1]) != expected[kk]:
+                        wrong.append((kk, resp.rows[0], expected[kk]))
+                i += clients
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return lat, failures, wrong
+
+    def p(q, vals):
+        if not vals:
+            return 0.0
+        return sorted(vals)[min(len(vals) - 1,
+                                max(0, round(q * len(vals)) - 1))]
+
+    for i in range(4):  # warm parse/plan/serde
+        resp = cluster.query(f"SELECT COUNT(*), SUM(v) FROM rb "
+                             f"WHERE k >= {i % 5}")
+        assert not resp.exceptions, resp.exceptions
+
+    lat_base, fail_base, wrong_base = closed_loop(duration_s)
+    qps_base = len(lat_base) / duration_s
+
+    # -- leg A: live rebalance under load ------------------------------
+    rb = cluster.make_rebalancer(
+        journal_path=os.path.join(tmp, "rebalance.journal"))
+    inner_commit = rb.commit_fn
+    guard_violations = []
+
+    def checked_commit(table, assignment):
+        # flip-before-load guard: at commit time, EVERY instance in the
+        # new assignment must already hold the segment (loaded+warmed)
+        for name, insts in assignment.items():
+            for iid in insts:
+                srv = next(s for s in cluster.servers
+                           if s.instance_id == iid)
+                tdm = srv.data_manager.table(table, create=False)
+                if tdm is None or tdm.current_segment(name) is None:
+                    guard_violations.append((name, iid))
+        inner_commit(table, assignment)
+
+    rb.commit_fn = checked_commit
+    move_result = {}
+
+    def run_move():
+        try:
+            job = rb.run("rb_OFFLINE", {
+                name: {"from": ["server_0", "server_1"],
+                       "to": ["server_1", "server_2"]}
+                for name in seg_names})
+            move_result["status"] = job.status
+            move_result["moves_done"] = job.progress()["done"]
+        except Exception as exc:  # noqa: BLE001 — surface, don't hang
+            move_result["status"] = f"error: {exc!r}"
+
+    mover = threading.Timer(duration_s * 0.25, run_move)
+    mover.start()
+    lat_move, fail_move, wrong_move = closed_loop(duration_s)
+    mover.join()
+    qps_move = len(lat_move) / duration_s
+    drained = all(
+        cluster.servers[0].data_manager.table(
+            "rb_OFFLINE").current_segment(n) is None for n in seg_names)
+
+    # -- leg B: kill server_1 + automatic repair under load ------------
+    reg = MetricsRegistry("controller")
+    rb.metrics = reg
+    rep = cluster.make_repair_checker(rb)
+    rep.metrics = reg
+    rep.grace_s = 0.02
+    repair_result = {"converged": False, "ticks": 0,
+                     "convergence_s": None}
+
+    def kill_and_repair():
+        time.sleep(duration_s * 0.25)
+        t_kill = time.perf_counter()
+        cluster.kill_server(1)
+        deadline = time.perf_counter() + max(duration_s * 4, 20.0)
+        while time.perf_counter() < deadline:
+            out = rep.check_once()
+            repair_result["ticks"] += 1
+            missing = reg.sample()["gauges"].get(
+                'segments_missing_replicas{table="rb_OFFLINE"}')
+            if out["stale"] and out["repaired"] == {} and missing == 0:
+                repair_result["converged"] = True
+                repair_result["convergence_s"] = round(
+                    time.perf_counter() - t_kill, 3)
+                return
+            time.sleep(0.03)
+
+    repairer = threading.Thread(target=kill_and_repair)
+    repairer.start()
+    lat_kill, fail_kill, wrong_kill = closed_loop(duration_s)
+    repairer.join()
+    qps_kill = len(lat_kill) / duration_s
+    rb.close()
+    cluster.stop()
+
+    # -- leg C: same-seed chaos -> byte-identical journals -------------
+    seed = 20260807
+    dig_a, dec_a = _rebalance_chaos_journal(tmp, "a", seed, num_segments)
+    dig_b, dec_b = _rebalance_chaos_journal(tmp, "b", seed, num_segments)
+    journals_identical = dig_a == dig_b and dec_a == dec_b
+
+    out = {
+        "metric": "self_healing_failed_queries",
+        "value": len(fail_move) + len(fail_kill),
+        "unit": "queries",
+        "rebalance": {
+            "failed_queries": len(fail_move),
+            "wrong_answers": len(wrong_move),
+            "guard_violations": len(guard_violations),
+            "job_status": move_result.get("status"),
+            "moves_done": move_result.get("moves_done"),
+            "sources_drained": drained,
+            "qps_during_move": round(qps_move, 1),
+            "p99_during_move_ms": round(p(0.99, lat_move) * 1e3, 2),
+        },
+        "repair": {
+            "failed_queries": len(fail_kill),
+            "wrong_answers": len(wrong_kill),
+            "converged": repair_result["converged"],
+            "convergence_s": repair_result["convergence_s"],
+            "repair_ticks": repair_result["ticks"],
+            "qps_during_kill_repair": round(qps_kill, 1),
+            "p99_during_kill_repair_ms": round(p(0.99, lat_kill) * 1e3, 2),
+        },
+        "determinism": {
+            "journals_identical": journals_identical,
+            "journal_digest": dig_a[:16],
+        },
+        "baseline": {
+            "failed_queries": len(fail_base),
+            "wrong_answers": len(wrong_base),
+            "qps": round(qps_base, 1),
+            "p50_ms": round(p(0.50, lat_base) * 1e3, 2),
+            "p99_ms": round(p(0.99, lat_base) * 1e3, 2),
+        },
+        "queries_total": len(lat_base) + len(lat_move) + len(lat_kill),
+        "num_segments": num_segments,
+        "docs_per_segment": docs,
+        "clients": clients,
+        "smoke": smoke,
+        "asserted": {"failed_queries": 0, "wrong_answers": 0,
+                     "guard_violations": 0, "converged": True,
+                     "journals_identical": True,
+                     "min_qps_frac": None if smoke else 0.25},
+    }
+    if out_path is None:
+        out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "BENCH_rebalance.json")
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps(out))
+    assert move_result.get("status") == "DONE", move_result
+    assert not guard_violations, \
+        f"routing flipped before load: {guard_violations[:3]}"
+    assert not fail_base and not fail_move and not fail_kill, \
+        (f"failed queries: base={len(fail_base)} move={len(fail_move)} "
+         f"kill={len(fail_kill)}: "
+         f"{(fail_base + fail_move + fail_kill)[:3]}")
+    assert not wrong_base and not wrong_move and not wrong_kill, \
+        (f"wrong answers: {wrong_base[:2]} {wrong_move[:2]} "
+         f"{wrong_kill[:2]}")
+    assert drained, "sources not drained after the move"
+    assert repair_result["converged"], \
+        f"repair did not converge: {repair_result}"
+    assert journals_identical, "same-seed chaos journals diverged"
+    if not smoke:
+        assert qps_move >= 0.25 * qps_base, \
+            f"rebalance collapsed throughput: {qps_move:.0f} vs " \
+            f"{qps_base:.0f} baseline QPS"
+        assert qps_kill >= 0.25 * qps_base, \
+            f"kill+repair collapsed throughput: {qps_kill:.0f} vs " \
+            f"{qps_base:.0f} baseline QPS"
+
+
 def main():
     os.makedirs(DATA_DIR, exist_ok=True)
     build_data()
@@ -3980,5 +4324,7 @@ if __name__ == "__main__":
         overload_main(smoke="--smoke" in sys.argv)
     elif "--logs" in sys.argv:
         logs_main(smoke="--smoke" in sys.argv)
+    elif "--rebalance" in sys.argv:
+        rebalance_main(smoke="--smoke" in sys.argv)
     else:
         main()

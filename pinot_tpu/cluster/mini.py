@@ -172,19 +172,27 @@ class MiniCluster:
         self._mse_placement_memo: Dict[str, tuple] = {}
         #: opt-in tier-1 broker result cache (cache/broker_cache.py)
         self._result_cache_enabled = result_cache
+        # -- controller-lite state (always on: the rebalance/repair
+        # engine and the task fabric both diff against it) -------------
+        from pinot_tpu.controller.cluster_state import (ClusterState,
+                                                        InstanceState)
+        self.cluster_state = ClusterState()
+        for s in self.servers:
+            self.cluster_state.register_instance(
+                InstanceState(s.instance_id))
+        #: instance_id -> wall-clock kill time; feeds heartbeat_ages()
+        #: so the repair checker sees a killed server's age grow
+        self._killed: Dict[str, float] = {}
         # -- minion task fabric (ISSUE 5) ------------------------------
-        self.cluster_state = None
         self.task_manager = None
         self.coordination = None
         self.minions: List = []
         self._minion_tmp: Optional[str] = None
         if self._num_minions:
-            from pinot_tpu.controller.cluster_state import ClusterState
             from pinot_tpu.controller.task_manager import TaskManager
             self._minion_tmp = tempfile.mkdtemp(prefix="pinot_tpu_fabric_")
             self.deep_store_uri = \
                 f"file://{os.path.join(self._minion_tmp, 'store')}"
-            self.cluster_state = ClusterState()
             self.task_manager = TaskManager(
                 self.cluster_state, config=self.config,
                 journal_path=os.path.join(self._minion_tmp,
@@ -511,9 +519,11 @@ class MiniCluster:
         a killed process leaves. Brokers discover it the hard way
         (connection error -> failure detector -> group demotion).
         Idempotent; `query_server.QueryServer.stop` tolerates repeats."""
+        import time as _time
         s = self.servers[idx]
         s.mse_worker.stop()
         s.transport.stop()
+        self._killed.setdefault(s.instance_id, _time.time())
 
     def kill_replica_group(self, table_name: str, group: int,
                            table_type: str = "OFFLINE") -> List[str]:
@@ -632,3 +642,113 @@ class MiniCluster:
         for logical, _ttype in by_route:
             for b in self.brokers:
                 b.on_segments_replaced(logical)
+
+    # -- self-healing maintenance (ISSUE 18) ---------------------------
+    def heartbeat_ages(self) -> Dict[str, float]:
+        """Instance -> heartbeat age (seconds). Embedded servers don't
+        heartbeat over a wire; a live server's age is 0.0 and a killed
+        one's age is the wall-clock time since kill_server() — exactly
+        the signal shape RepairChecker debounces on."""
+        import time as _time
+        now = _time.time()
+        return {s.instance_id: (now - self._killed[s.instance_id]
+                                if s.instance_id in self._killed else 0.0)
+                for s in self.servers}
+
+    def make_rebalancer(self, config=None, journal_path=None):
+        """A Rebalancer wired to the embedded servers: load = load+warm
+        the segment dir on the target's data manager, commit = flip
+        ClusterState assignment AND the broker routes atomically, unload
+        = drop from the source's data manager, live = not killed."""
+        from pinot_tpu.controller.rebalancer import Rebalancer
+        from pinot_tpu.segment.loader import load_segment
+        id_to_server = {s.instance_id: s for s in self.servers}
+
+        def load(instance_id, table, st):
+            if st is None or not st.dir_path:
+                return
+            srv = id_to_server[instance_id]
+            tdm = srv.data_manager.table(table)
+            if tdm.current_segment(st.name) is not None:
+                return  # idempotent resume: already loaded+warmed
+            tdm.add_segment(load_segment(st.dir_path))
+
+        def unload(instance_id, table, name):
+            srv = id_to_server.get(instance_id)
+            if srv is None:
+                return
+            tdm = srv.data_manager.table(table, create=False)
+            if tdm is not None:
+                tdm.remove_segment(name)
+
+        def commit(table, assignment):
+            self.cluster_state.commit_moves(table, assignment)
+            self._commit_routes(table, assignment)
+
+        rb = Rebalancer(self.cluster_state, load_fn=load, unload_fn=unload,
+                        commit_fn=commit,
+                        live_fn=lambda iid: iid not in self._killed,
+                        config=config if config is not None else self.config,
+                        journal_path=journal_path)
+        # embedded brokers route from point-in-time snapshots; give
+        # in-flight queries planned pre-commit a beat before the source
+        # stops serving (data_manager silently skips missing segments)
+        rb.drain_grace_s = 0.05
+        return rb
+
+    def make_repair_checker(self, rebalancer, config=None):
+        from pinot_tpu.controller.repair import RepairChecker
+        return RepairChecker(self.cluster_state, rebalancer,
+                             self.heartbeat_ages,
+                             config=config if config is not None
+                             else self.config)
+
+    def _commit_routes(self, physical: str,
+                       assignment: Dict[str, List[str]]) -> None:
+        """Mirror a committed assignment into broker routing with the
+        _apply_replacement atomic-swap discipline: ONE reference
+        assignment per route + a mutation bump, then negative-cache
+        invalidation — queries see the old or the new replica set,
+        never half a batch."""
+        import dataclasses
+        from pinot_tpu.broker.routing import _ObservedSegments
+        from pinot_tpu.models import split_physical_table_name
+        logical, ttype = split_physical_table_name(physical)
+        rt = self._routes.get(logical)
+        route = None if rt is None else (
+            rt.offline if (ttype or "OFFLINE") == "OFFLINE" else rt.realtime)
+        if route is None:
+            return
+        snap = dict(route.segments)
+        changed = False
+        for name, insts in assignment.items():
+            info = snap.get(name)
+            if info is not None:
+                snap[name] = dataclasses.replace(info, servers=list(insts))
+                changed = True
+        if not changed:
+            return
+        route.segments = _ObservedSegments(route, snap)
+        route.mutation_version = next(route._mut_counter)
+        for b in self.brokers:
+            b.on_segments_replaced(logical)
+
+    def run_retention(self, now_ms=None) -> Dict[str, List[str]]:
+        """Close the retention loop end to end: purge expired segments
+        from ClusterState, then actually unload them from every server,
+        drop them from routing (epoch bump), and invalidate broker
+        caches — expired data stops being served AND its cache entries
+        go unaddressable, in one call."""
+        from pinot_tpu.controller import maintenance
+        from pinot_tpu.models import split_physical_table_name
+        removed: Dict[str, List[str]] = {}
+        for seg in maintenance.run_retention(self.cluster_state,
+                                             now_ms=now_ms):
+            removed.setdefault(seg.table, []).append(seg.name)
+        for physical, names in removed.items():
+            logical, ttype = split_physical_table_name(physical)
+            for name in names:
+                self.remove_segment(logical, name, ttype or "OFFLINE")
+            for b in self.brokers:
+                b.on_segments_replaced(logical)
+        return removed

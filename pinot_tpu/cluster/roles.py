@@ -21,7 +21,7 @@ import logging
 import os
 import threading
 import time
-from typing import Dict, Optional, Set
+from typing import Dict, List, Optional, Set
 
 from pinot_tpu.controller.coordination import CoordinationClient
 
@@ -57,8 +57,13 @@ def run_controller(state_dir: str, port: int = 0, host: str = "127.0.0.1",
                    ready_event: Optional[threading.Event] = None,
                    stop_event: Optional[threading.Event] = None) -> None:
     from pinot_tpu.controller.cluster_state import ClusterState
+    from pinot_tpu.controller.controller import Controller
     from pinot_tpu.controller.coordination import CoordinationServer
     from pinot_tpu.controller.maintenance import run_retention
+    from pinot_tpu.controller.rebalancer import (Rebalancer,
+                                                 make_staged_load_fn)
+    from pinot_tpu.controller.repair import (RepairChecker,
+                                             update_replication_gauges)
     from pinot_tpu.controller.task_manager import TaskManager
     from pinot_tpu.utils.config import PinotConfiguration
 
@@ -81,6 +86,28 @@ def run_controller(state_dir: str, port: int = 0, host: str = "127.0.0.1",
         "pinot.coordination.liveness.ttl.seconds")
     server.start()
     tasks.start()
+    # self-healing plane: journaled move engine + automatic repair.
+    # Watch-driven wiring — load_fn STAGES the replica (servers
+    # reconcile+warm staged segments, brokers keep routing by
+    # `instances`) and waits for the server's load ack; the source
+    # drains via the servers' own reconcile once commit_moves drops it
+    # from the assignment, so unload_fn is a no-op here.
+    rebalancer = Rebalancer(
+        state,
+        load_fn=make_staged_load_fn(state, server.segment_is_loaded),
+        unload_fn=lambda _inst, _table, _name: None,
+        live_fn=lambda iid: server.heartbeat_ages().get(
+            iid, 0.0) <= server.LIVENESS_TTL_S,
+        config=cfg,
+        journal_path=os.path.join(state_dir, "rebalance.journal"))
+    repair = RepairChecker(state, rebalancer, server.heartbeat_ages,
+                           config=cfg)
+    controller_api = Controller(state=state, config=cfg)
+    controller_api.rebalancer = rebalancer  # share the journaled engine
+    # a restart resumes half-finished move plans from the journal —
+    # async: staged loads block on server acks, which need the fleet up
+    threading.Thread(target=rebalancer.resume, daemon=True,
+                     name="rebalance-resume").start()
     # fleet health plane: the controller samples its OWN registry like
     # every role, and sweeps the fleet (the periodic-health-task analog)
     from pinot_tpu.health.history import start_sampling, stop_sampling
@@ -96,7 +123,8 @@ def run_controller(state_dir: str, port: int = 0, host: str = "127.0.0.1",
         rest = ControllerHttpServer(state, coordination=server,
                                     host=host, port=http_port,
                                     task_manager=tasks,
-                                    health_monitor=monitor)
+                                    health_monitor=monitor,
+                                    controller=controller_api)
         rest.start()
         print(f"controller REST on {rest.host}:{rest.port}", flush=True)
     print(f"controller listening on {server.address}", flush=True)
@@ -105,15 +133,33 @@ def run_controller(state_dir: str, port: int = 0, host: str = "127.0.0.1",
     stop = stop_event or threading.Event()
     retention_every = cfg.get_float(
         "pinot.controller.retention.frequency.seconds")
+    repair_every = cfg.get_float(
+        "pinot.controller.repair.frequency.seconds")
     last_maintenance = time.time()
+    last_repair = time.time()
     try:
         while not stop.wait(1.0):
             if time.time() - last_maintenance > retention_every:
                 last_maintenance = time.time()
                 try:
+                    # removals notify watchers: servers reconcile the
+                    # expired segments away, brokers rebuild routes (the
+                    # routing epoch moves, so cached results for the
+                    # dropped segments become unaddressable)
                     run_retention(state)
                 except Exception:  # noqa: BLE001 — periodic must survive
                     log.exception("retention pass failed")
+            if repair_every > 0 \
+                    and time.time() - last_repair > repair_every:
+                last_repair = time.time()
+                try:
+                    # SegmentStatusChecker + RebalanceChecker tick:
+                    # refresh the replication gauges, then repair any
+                    # debounced-dead instance's segments
+                    update_replication_gauges(state)
+                    repair.check_once()
+                except Exception:  # noqa: BLE001 — periodic must survive
+                    log.exception("repair pass failed")
     finally:
         if rest is not None:
             rest.stop()
@@ -121,6 +167,7 @@ def run_controller(state_dir: str, port: int = 0, host: str = "127.0.0.1",
             monitor.stop()
         stop_sampling("controller")
         tasks.stop()
+        rebalancer.close()
         server.stop()
 
 
@@ -366,9 +413,15 @@ class ServerRole:
                         sched.set_tenant_weight(
                             tn["server"], float(tn.get("weight", 1.0)))
             wanted: Set[tuple] = set()
+            acks: List[tuple] = []
             for table, segs in blob.get("segments", {}).items():
                 for name, st in segs.items():
-                    if self.instance_id in st.get("instances", ()) \
+                    # a STAGED replica (rebalance load-before-route)
+                    # loads+warms exactly like an assigned one — brokers
+                    # just don't route to it until the move commits
+                    staged = self.instance_id in st.get("staged", ())
+                    if (self.instance_id in st.get("instances", ())
+                            or staged) \
                             and st.get("status") == "ONLINE" \
                             and st.get("dir_path"):
                         wanted.add((table, name))
@@ -380,6 +433,8 @@ class ServerRole:
                                 # already serving a local copy (realtime
                                 # commit on this server) — leave it to its
                                 # owner, don't re-download or track it
+                                if staged:
+                                    acks.append((table, name))
                                 continue
                             try:
                                 seg = load_segment(
@@ -387,16 +442,30 @@ class ServerRole:
                                 self.data_manager.table(table) \
                                     .add_segment(seg)
                                 self._loaded.add((table, name))
+                                if staged:
+                                    acks.append((table, name))
                                 log.info("loaded %s/%s", table, name)
                             except Exception:  # noqa: BLE001
                                 log.exception("failed to load %s/%s",
                                               table, name)
+                        elif staged:
+                            # already loaded: re-ack — the controller's
+                            # ack book may be fresh after a restart
+                            acks.append((table, name))
             for table, name in list(self._loaded - wanted):
                 tdm = self.data_manager.table(table, create=False)
                 if tdm is not None:
                     tdm.remove_segment(name)
                 self._loaded.discard((table, name))
                 log.info("unloaded %s/%s", table, name)
+            for table, name in acks:
+                try:
+                    # load ack: the rebalancer's staged-load barrier —
+                    # routing only flips once the target reports servable
+                    self.client.segment_loaded(table, name,
+                                               self.instance_id)
+                except Exception:  # noqa: BLE001 — ack is best-effort;
+                    pass           # the load barrier times out and retries
             self._ensure_realtime(blob)
 
     def _ensure_realtime(self, blob: dict) -> None:

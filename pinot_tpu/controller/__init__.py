@@ -11,9 +11,11 @@ control plane of SURVEY.md §7.4).
 """
 from pinot_tpu.controller.cluster_state import ClusterState, SegmentState
 from pinot_tpu.controller.controller import Controller
+from pinot_tpu.controller.rebalancer import Rebalancer
+from pinot_tpu.controller.repair import RepairChecker
 
-__all__ = ["ClusterState", "SegmentState", "Controller", "TaskManager",
-           "TaskQueue"]
+__all__ = ["ClusterState", "SegmentState", "Controller", "Rebalancer",
+           "RepairChecker", "TaskManager", "TaskQueue"]
 
 
 def __getattr__(name):

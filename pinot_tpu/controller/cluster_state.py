@@ -35,6 +35,10 @@ class SegmentState:
     #: content CRC — feeds the broker routing epoch so replacing a
     #: segment invalidates result-cache entries cluster-wide
     crc: int = 0
+    #: replicas loading+warming ahead of a rebalance commit: servers
+    #: reconcile (load) staged segments, brokers route by ``instances``
+    #: only — the rebalancer's load-before-route half-state
+    staged: List[str] = field(default_factory=list)
 
     def to_dict(self) -> dict:
         return self.__dict__.copy()
@@ -242,6 +246,51 @@ class ClusterState:
             for name, instances in assignment.items():
                 if name in seg_map:
                     seg_map[name].instances = list(instances)
+        self._persist()
+        self._notify(table)
+
+    def stage_replicas(self, table: str,
+                       staging: Dict[str, List[str]]) -> None:
+        """Mark replicas as loading/warming ahead of a rebalance commit:
+        servers reconcile (load+warm) staged segments, but brokers keep
+        routing by ``instances`` — no query reaches a staged replica."""
+        with self._lock:
+            seg_map = self.segments.get(table, {})
+            for name, insts in staging.items():
+                st = seg_map.get(name)
+                if st is not None:
+                    st.staged = sorted(set(st.staged) | set(insts))
+        self._persist()
+        self._notify(table)
+
+    def unstage_replicas(self, table: str,
+                         staging: Dict[str, List[str]]) -> None:
+        """Roll staged replicas back (cancelled move): servers unload
+        them on the next reconcile."""
+        with self._lock:
+            seg_map = self.segments.get(table, {})
+            for name, insts in staging.items():
+                st = seg_map.get(name)
+                if st is not None:
+                    st.staged = [i for i in st.staged if i not in set(insts)]
+        self._persist()
+        self._notify(table)
+
+    def commit_moves(self, table: str,
+                     assignment: Dict[str, List[str]]) -> None:
+        """Rebalance batch commit: flip ``instances`` to the target and
+        clear staging for those segments under ONE lock hold, ONE
+        persist, ONE notification — watchers see one routing-epoch bump
+        per batch, and only replicas that already finished load+warm
+        become routable."""
+        with self._lock:
+            seg_map = self.segments.get(table, {})
+            for name, instances in assignment.items():
+                st = seg_map.get(name)
+                if st is not None:
+                    st.instances = list(instances)
+                    st.staged = [i for i in st.staged
+                                 if i not in set(instances)]
         self._persist()
         self._notify(table)
 

@@ -14,17 +14,20 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
-from pinot_tpu.controller import maintenance
+from pinot_tpu.controller import maintenance, repair as repair_mod
 from pinot_tpu.controller.assignment import assign_for_table
 from pinot_tpu.controller.cluster_state import (
     ClusterState, InstanceState, SegmentState)
+from pinot_tpu.controller.rebalancer import Rebalancer
 from pinot_tpu.models import Schema, TableConfig
 from pinot_tpu.segment.loader import load_segment
 
 
 class Controller:
     def __init__(self, state: Optional[ClusterState] = None,
-                 task_output_dir: Optional[str] = None):
+                 task_output_dir: Optional[str] = None,
+                 config=None, rebalance_journal: Optional[str] = None,
+                 heartbeat_ages_fn: Optional[Callable] = None):
         self.state = state or ClusterState()
         self.task_output_dir = task_output_dir or os.path.join(
             os.getcwd(), "controller_tasks")
@@ -33,6 +36,27 @@ class Controller:
         self._server_hooks: Dict[str, tuple] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        #: the journaled move engine behind rebalance + repair
+        self.rebalancer = Rebalancer(
+            self.state, load_fn=self._hook_load, unload_fn=self._hook_unload,
+            config=config, journal_path=rebalance_journal)
+        self.repair: Optional[repair_mod.RepairChecker] = None
+        if heartbeat_ages_fn is not None:
+            self.repair = repair_mod.RepairChecker(
+                self.state, self.rebalancer, heartbeat_ages_fn,
+                config=config)
+
+    # hook adapters: the move engine speaks (instance, table, SegmentState)
+    def _hook_load(self, instance_id: str, table: str,
+                   st: Optional[SegmentState]) -> None:
+        hooks = self._server_hooks.get(instance_id)
+        if hooks is not None and st is not None and st.dir_path:
+            hooks[0](table, st.dir_path)
+
+    def _hook_unload(self, instance_id: str, table: str, name: str) -> None:
+        hooks = self._server_hooks.get(instance_id)
+        if hooks is not None:
+            hooks[1](table, name)
 
     # -- instance / server wiring -------------------------------------------
     def register_server(self, instance_id: str, load_fn: Callable,
@@ -85,13 +109,15 @@ class Controller:
                 hooks = self._server_hooks.get(inst)
                 if hooks is not None:
                     hooks[1](st.table, st.name)
-        status = {}
-        for cfg in list(self.state.tables.values()):
-            t = cfg.table_name_with_type
-            status[t] = maintenance.segment_status(
-                self.state, t, cfg.retention.replication)
-        return {"retentionRemoved": [s.name for s in removed],
-                "status": status}
+        # SegmentStatusChecker leg: per-table replication gauges feed
+        # the /debug/health "replication" subsystem + /cluster/health
+        status = repair_mod.update_replication_gauges(self.state)
+        out: Dict[str, object] = {
+            "retentionRemoved": [s.name for s in removed],
+            "status": status}
+        if self.repair is not None:
+            out["repair"] = self.repair.check_once()
+        return out
 
     def start_periodic(self, interval_s: float = 60.0) -> None:
         def loop():
@@ -112,30 +138,43 @@ class Controller:
             self._thread.join(timeout=5)
 
     # -- rebalance (ref TableRebalancer REST) --------------------------------
-    def rebalance(self, logical_table: str, table_type: str = "OFFLINE",
-                  dry_run: bool = False) -> Dict[str, dict]:
+    def plan_rebalance(self, logical_table: str,
+                       table_type: str = "OFFLINE") -> Dict[str, dict]:
+        """Dry-run diff: {segment: {"from": [...], "to": [...]}} for
+        segments the target assignment would move. Commits nothing."""
         cfg = self.state.tables[logical_table]
         physical = f"{logical_table}_{table_type}"
-        before = {s.name: list(s.instances)
-                  for s in self.state.table_segments(physical)}
-        moves = maintenance.rebalance_table(
+        return maintenance.rebalance_table(
             self.state, physical, replication=cfg.retention.replication,
             num_replica_groups=cfg.routing.num_replica_groups or None,
-            tenant=cfg.tenants.server, dry_run=dry_run)
-        if dry_run:
+            tenant=cfg.tenants.server, dry_run=True)
+
+    def rebalance(self, logical_table: str, table_type: str = "OFFLINE",
+                  dry_run: bool = False) -> Dict[str, dict]:
+        """Move the table to its target assignment through the journaled
+        move engine: each segment's new replica loads+warms BEFORE the
+        assignment commits (no flip-before-load window), sources drain
+        after, never below the availability floor."""
+        moves = self.plan_rebalance(logical_table, table_type)
+        if dry_run or not moves:
             return moves
-        # apply to servers: load on new instances, then unload from old
-        # (minimal-disruption ordering, ref TableRebalancer)
-        for name, mv in moves.items():
-            st = self.state.segments[physical][name]
-            for inst in mv["to"]:
-                if inst not in mv["from"]:
-                    hooks = self._server_hooks.get(inst)
-                    if hooks is not None and st.dir_path:
-                        hooks[0](physical, st.dir_path)
-            for inst in mv["from"]:
-                if inst not in mv["to"]:
-                    hooks = self._server_hooks.get(inst)
-                    if hooks is not None:
-                        hooks[1](physical, name)
+        physical = f"{logical_table}_{table_type}"
+        self.rebalancer.run(physical, moves)
         return moves
+
+    def rebalance_async(self, logical_table: str,
+                        table_type: str = "OFFLINE") -> Optional[str]:
+        """Async-job variant (REST POST /tables/{t}/rebalance): returns
+        a job id to poll via GET /rebalance/{jobId}, or None when the
+        table is already at target."""
+        moves = self.plan_rebalance(logical_table, table_type)
+        if not moves:
+            return None
+        physical = f"{logical_table}_{table_type}"
+        return self.rebalancer.start(physical, moves)
+
+    def rebalance_status(self, job_id: str) -> Optional[dict]:
+        return self.rebalancer.status(job_id)
+
+    def rebalance_cancel(self, job_id: str) -> bool:
+        return self.rebalancer.cancel(job_id)

@@ -67,6 +67,11 @@ class CoordinationServer:
         #: the same socket would interleave frames and desync the stream
         self._send_lock = threading.Lock()
         self._last_seen: Dict[str, float] = {}
+        #: (table, segment) -> instances that acked loading it — the
+        #: rebalancer's staged-load barrier (ExternalView-converged
+        #: analog): a move only commits routing once the target server
+        #: reports the segment servable
+        self._loaded_acks: Dict[tuple, set] = {}
         coord = self
 
         class _Handler(socketserver.BaseRequestHandler):
@@ -186,6 +191,13 @@ class CoordinationServer:
                     inst.enabled = True  # recovered: rejoin pool
                     self._notify()
             return {"ok": True}
+        if op == "segment_loaded":
+            # server -> controller ack: a (re)loaded segment is servable
+            with self._lock:
+                self._loaded_acks.setdefault(
+                    (req["table"], req["segment"]), set()).add(
+                        req["instance_id"])
+            return {"ok": True}
         if op == "upload_segment":
             self._sweep_liveness()
             return self._upload_segment(req)
@@ -277,6 +289,14 @@ class CoordinationServer:
     #: instances silent for this long are disabled (heartbeats come every
     #: ~2s from run_server) so new segments stop landing on corpses
     LIVENESS_TTL_S = 15.0
+
+    def segment_is_loaded(self, table: str, segment: str,
+                          instance_id: str) -> bool:
+        """Has `instance_id` acked loading (table, segment)? The staged
+        load_fn polls this before committing a move's routing."""
+        with self._lock:
+            return instance_id in self._loaded_acks.get((table, segment),
+                                                        ())
 
     def heartbeat_ages(self) -> Dict[str, float]:
         """Seconds since each instance's last heartbeat/registration —
@@ -384,6 +404,13 @@ class CoordinationClient:
             "instance_id": instance_id, "host": host, "port": port,
             "enabled": True, "tags": tags or [],
             "admin_url": admin_url})
+
+    def segment_loaded(self, table: str, segment: str,
+                       instance_id: str) -> None:
+        """Ack that this server loaded (table, segment) and it is
+        servable — the rebalancer's load-before-route barrier."""
+        self.request("segment_loaded", table=table, segment=segment,
+                     instance_id=instance_id)
 
     def upload_segment(self, table: str, seg_dir: str,
                        table_type: str = "OFFLINE",

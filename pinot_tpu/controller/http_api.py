@@ -22,6 +22,10 @@ is not).
   POST   /tasks                       <- {"taskType", "table", "segments",
                                           "params"} (submit)
   POST   /tasks/{id}/cancel
+  POST   /tables/{name}/rebalance     <- {"tableType", "dryRun"} -> async
+                                         {"jobId"} (or the dry-run diff)
+  GET    /rebalance/{jobId}           -> move-plan progress (byState, done)
+  POST   /rebalance/{jobId}/cancel    -> consistent prefix stays applied
 """
 from __future__ import annotations
 
@@ -38,7 +42,7 @@ from pinot_tpu.models import Schema, TableConfig
 class ControllerHttpServer:
     def __init__(self, state: ClusterState, coordination=None,
                  host: str = "127.0.0.1", port: int = 0,
-                 task_manager=None, health_monitor=None):
+                 task_manager=None, health_monitor=None, controller=None):
         self.state = state
         self.coordination = coordination  # CoordinationServer (optional)
         # task fabric (controller/task_manager.py); falls back to the
@@ -47,6 +51,10 @@ class ControllerHttpServer:
             coordination, "task_manager", None)
         #: health/rollup.ClusterHealthMonitor behind /cluster/* (optional)
         self.health_monitor = health_monitor
+        #: Controller facade (or any object with plan_rebalance /
+        #: rebalance_async / rebalance_status / rebalance_cancel) —
+        #: backs the async rebalance-job surface
+        self.controller = controller
         api = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -117,6 +125,9 @@ class ControllerHttpServer:
                         else mon.cluster_metrics())
                 if path == "/tasks" or path.startswith("/tasks/"):
                     return self._route_tasks(method, path, query)
+                if path.startswith("/rebalance/") or \
+                        re.fullmatch(r"/tables/[^/]+/rebalance", path):
+                    return self._route_rebalance(method, path)
                 if path == "/tables" and method == "GET":
                     with api.state._lock:
                         names = sorted(api.state.tables)
@@ -201,6 +212,45 @@ class ControllerHttpServer:
                             "seg_dir": body["segDir"],
                             "table_type": body.get("tableType", "OFFLINE")})
                         return self._reply(200, r)
+                self._reply(404, {"error": f"no route {method} {path}"})
+
+            def _route_rebalance(self, method: str, path: str):
+                """Async rebalance jobs (ref TableRebalancer REST +
+                rebalance job ZK metadata): POST starts a journaled move
+                plan, GET polls it, cancel keeps the applied prefix."""
+                ctl = api.controller
+                if ctl is None:
+                    return self._reply(503, {"error": "no controller"})
+                m = re.fullmatch(r"/tables/([^/]+)/rebalance", path)
+                if m and method == "POST":
+                    body = self._body()
+                    name = m.group(1)
+                    if name not in api.state.tables:
+                        return self._reply(404,
+                                           {"error": f"no table {name}"})
+                    ttype = body.get("tableType", "OFFLINE")
+                    if body.get("dryRun"):
+                        return self._reply(200, {
+                            "dryRun": True,
+                            "moves": ctl.plan_rebalance(name, ttype)})
+                    job_id = ctl.rebalance_async(name, ttype)
+                    if job_id is None:
+                        return self._reply(200, {"status": "NO_OP",
+                                                 "jobId": None})
+                    return self._reply(200, {"status": "IN_PROGRESS",
+                                             "jobId": job_id})
+                m = re.fullmatch(r"/rebalance/([^/]+)", path)
+                if m and method == "GET":
+                    prog = ctl.rebalance_status(m.group(1))
+                    if prog is None:
+                        return self._reply(
+                            404, {"error": f"no job {m.group(1)}"})
+                    return self._reply(200, prog)
+                m = re.fullmatch(r"/rebalance/([^/]+)/cancel", path)
+                if m and method == "POST":
+                    ok = ctl.rebalance_cancel(m.group(1))
+                    return self._reply(200, {"cancelled": bool(ok),
+                                             "jobId": m.group(1)})
                 self._reply(404, {"error": f"no route {method} {path}"})
 
             def _route_tasks(self, method: str, path: str, query: str):

@@ -48,9 +48,17 @@ def rebalance_table(state: ClusterState, table: str, replication: int = 1,
                     num_replica_groups: Optional[int] = None,
                     tenant: Optional[str] = None,
                     dry_run: bool = False) -> Dict[str, dict]:
-    """Move the table to its target assignment (ref TableRebalancer).
-    Returns {segment: {'from': [...], 'to': [...]}} for segments that move.
-    tenant: restrict the candidate pool to the table's tenant servers."""
+    """Compute (and with dry_run=False, commit) the target-assignment
+    diff (ref TableRebalancer's plan step). Returns
+    {segment: {'from': [...], 'to': [...]}} for segments that move.
+    tenant: restrict the candidate pool to the table's tenant servers.
+
+    NOTE: the non-dry-run path is the STATE-ONLY assignment flip — no
+    server loads happen here, so routing can point at replicas that do
+    not hold the data yet. Live clusters must go through
+    ``rebalancer.Rebalancer`` (Controller.rebalance does), which
+    loads+warms targets first and commits per warmed batch; this
+    function's dry_run=True diff is its planning input."""
     target = target_assignment(state, table, replication, num_replica_groups,
                                tenant=tenant)
     moves: Dict[str, dict] = {}
@@ -65,10 +73,18 @@ def rebalance_table(state: ClusterState, table: str, replication: int = 1,
 
 
 def segment_status(state: ClusterState, table: str,
-                   expected_replication: int = 1) -> Dict[str, int]:
-    """Ref SegmentStatusChecker gauges."""
+                   expected_replication: int = 1,
+                   live: Optional[set] = None) -> Dict[str, int]:
+    """Ref SegmentStatusChecker gauges. ``live``: when given (the
+    repair checker's view of heartbeat-healthy instances), only
+    replicas hosted on live instances count toward replication — a
+    dead server's copies are missing even while the assignment still
+    names it."""
     segs = state.table_segments(table)
-    missing = sum(1 for s in segs if len(s.instances) < expected_replication)
+    missing = sum(
+        1 for s in segs
+        if len([i for i in s.instances if live is None or i in live])
+        < expected_replication)
     offline = sum(1 for s in segs if s.status == "OFFLINE")
     return {"numSegments": len(segs), "segmentsMissingReplicas": missing,
             "segmentsOffline": offline}

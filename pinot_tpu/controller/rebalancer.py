@@ -1,0 +1,490 @@
+"""Minimal-disruption rebalancer: a journaled per-segment move engine.
+
+Reference parity: helix/core/rebalance/TableRebalancer.java — the
+reference walks the cluster from the current to the target assignment in
+availability-preserving steps (bring the new replica ONLINE, wait for
+the ExternalView to converge, only then drop the old one), never letting
+a segment's live replica count fall below
+``min(replication, minAvailableReplicas)``. Here every segment move is
+an explicit state machine
+
+    PLANNED -> LOADING -> WARMED -> ROUTED -> DRAINED -> DONE
+
+journaled as JSON lines with the TaskQueue journal discipline
+(append-only, flushed per line, last snapshot per key wins on replay,
+torn tails skipped line-by-line, atomic tmp+rename compaction) so a
+controller restart resumes a half-finished plan without re-moving
+segments that already completed. The target replica is loaded AND
+warmed first (``TableDataManager.add_segment`` runs the warmup hook
+before publishing, so load implies warm by construction); only then is
+the segment's assignment committed and the routing epoch advanced, and
+only then is the source unloaded — closing the flip-before-load window
+the one-shot ``maintenance.rebalance_table`` assignment flip has.
+
+Determinism: journal lines carry no timestamps and job ids are
+per-table counters, so a same-seed chaos run replays a byte-identical
+journal. Seeded replay legs should run with
+``pinot.controller.rebalance.max.parallel.moves = 1`` — parallel load
+batches interleave journal appends nondeterministically.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+from collections import OrderedDict
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from pinot_tpu.controller.cluster_state import ClusterState, SegmentState
+from pinot_tpu.utils.failpoints import fire
+
+#: move state machine, in commit order
+MOVE_STATES = ("PLANNED", "LOADING", "WARMED", "ROUTED", "DRAINED", "DONE",
+               "CANCELLED")
+_TERMINAL = {"DONE", "CANCELLED"}
+
+
+@dataclass
+class SegmentMove:
+    """One segment's journey from its current replicas to the target."""
+    segment: str
+    table: str
+    src: List[str] = field(default_factory=list)
+    dst: List[str] = field(default_factory=list)
+    state: str = "PLANNED"
+    note: str = ""
+
+    def entry(self, job_id: str) -> dict:
+        e = {"kind": "move", "job": job_id, "segment": self.segment,
+             "table": self.table, "from": list(self.src),
+             "to": list(self.dst), "state": self.state}
+        if self.note:
+            e["note"] = self.note
+        return e
+
+
+class MoveJournal:
+    """JSON-lines journal of job + move snapshots (TaskQueue discipline).
+
+    Line kinds: ``{"kind": "job", "job", "table", "status"}`` and
+    ``{"kind": "move", "job", "segment", "table", "from", "to",
+    "state"}``. Replay keeps the LAST snapshot per job / per
+    (job, segment); unparseable (torn) lines are skipped — a torn tail
+    means that transition re-executes on resume (moves are idempotent),
+    never a corrupt plan. Journal IO errors are swallowed: memory is the
+    source of truth, the journal is the recovery record.
+    """
+
+    def __init__(self, path: Optional[str], max_bytes: int = 1 << 20):
+        self.path = path
+        self.max_bytes = max_bytes
+        self._latest: "OrderedDict[tuple, dict]" = OrderedDict()
+        self._fh = None
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _key(e: dict) -> Optional[tuple]:
+        kind = e.get("kind")
+        if kind == "job":
+            return ("job", e.get("job"))
+        if kind == "move":
+            return ("move", e.get("job"), e.get("segment"))
+        return None
+
+    def replay(self) -> List[dict]:
+        """Last-snapshot-per-key entries, in first-seen key order."""
+        latest: "OrderedDict[tuple, dict]" = OrderedDict()
+        if self.path and os.path.exists(self.path):
+            try:
+                with open(self.path, "rb") as f:
+                    for line in f:
+                        line = line.strip()
+                        if not line:
+                            continue
+                        try:
+                            e = json.loads(line)
+                        except ValueError:
+                            continue  # torn/corrupt line: skip, don't abort
+                        key = self._key(e) if isinstance(e, dict) else None
+                        if key is not None:
+                            latest[key] = e
+            except OSError:
+                pass
+        with self._lock:
+            self._latest = latest
+            return list(latest.values())
+
+    def append(self, entry: dict) -> None:
+        with self._lock:
+            key = self._key(entry)
+            if key is not None:
+                self._latest.pop(key, None)
+                self._latest[key] = entry
+            if not self.path:
+                return
+            try:
+                raw = json.dumps(entry, separators=(",", ":")).encode()
+                # payload hook: an armed torn= policy truncates the line —
+                # replay skips it and resume re-executes that transition
+                raw = fire("controller.rebalance.journal", payload=raw,
+                           kind=entry.get("kind"), job=entry.get("job"),
+                           segment=entry.get("segment"),
+                           state=entry.get("state") or entry.get("status"))
+                if self._fh is None:
+                    self._fh = open(self.path, "ab")
+                self._fh.write(raw + b"\n")
+                self._fh.flush()
+                if self._fh.tell() > self.max_bytes:
+                    self._compact_locked()
+            except OSError:
+                pass
+
+    def _compact_locked(self) -> None:
+        tmp = self.path + ".tmp"
+        with open(tmp, "wb") as f:
+            for e in self._latest.values():
+                f.write(json.dumps(e, separators=(",", ":")).encode() + b"\n")
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+        os.replace(tmp, self.path)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass
+                self._fh = None
+
+
+class RebalanceJob:
+    """One async rebalance: a plan of SegmentMoves walked by the engine."""
+
+    def __init__(self, job_id: str, table: str, moves: List[SegmentMove]):
+        self.job_id = job_id
+        self.table = table
+        self.moves = moves
+        self.status = "RUNNING"   # RUNNING | DONE | CANCELLED | FAILED
+        self.error = ""
+        self._cancel = threading.Event()
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancel.is_set()
+
+    def cancel(self) -> None:
+        self._cancel.set()
+
+    def entry(self) -> dict:
+        return {"kind": "job", "job": self.job_id, "table": self.table,
+                "status": self.status}
+
+    def progress(self) -> dict:
+        by_state: Dict[str, int] = {}
+        for m in self.moves:
+            by_state[m.state] = by_state.get(m.state, 0) + 1
+        out = {"jobId": self.job_id, "table": self.table,
+               "status": self.status, "totalMoves": len(self.moves),
+               "done": by_state.get("DONE", 0), "byState": by_state}
+        if self.error:
+            out["error"] = self.error
+        return out
+
+
+class Rebalancer:
+    """The move engine: plans, executes, journals, resumes, cancels.
+
+    load_fn(instance_id, table, seg_state) must load+warm the segment on
+    the target and only return once it is servable (idempotent — resume
+    may call it again). unload_fn(instance_id, table, segment_name)
+    drops it from the source. commit_fn(table, {segment: [instances]})
+    makes ONE routing-visible assignment change per batch (defaults to
+    ``ClusterState.commit_moves`` — one persist, one notification, one
+    routing-epoch bump). live_fn(instance_id) gates drains: a dead
+    source is never unloaded over the wire, and the availability floor
+    only counts live holders.
+    """
+
+    def __init__(self, state: ClusterState,
+                 load_fn: Callable[[str, str, Optional[SegmentState]], None],
+                 unload_fn: Callable[[str, str, str], None],
+                 commit_fn: Optional[Callable[[str, Dict[str, List[str]]],
+                                              None]] = None,
+                 live_fn: Optional[Callable[[str], bool]] = None,
+                 config=None, journal_path: Optional[str] = None,
+                 metrics=None):
+        from pinot_tpu.utils.config import PinotConfiguration
+        from pinot_tpu.utils.metrics import get_registry
+        cfg = config or PinotConfiguration()
+        self.state = state
+        self.load_fn = load_fn
+        self.unload_fn = unload_fn
+        self.commit_fn = commit_fn or state.commit_moves
+        self.live_fn = live_fn or self._default_live
+        self.min_available = max(0, cfg.get_int(
+            "pinot.controller.rebalance.min.available.replicas", 1))
+        self.max_parallel = max(1, cfg.get_int(
+            "pinot.controller.rebalance.max.parallel.moves", 4))
+        self.journal = MoveJournal(journal_path, max_bytes=cfg.get_int(
+            "pinot.controller.rebalance.journal.max.bytes", 1 << 20))
+        self.metrics = metrics if metrics is not None \
+            else get_registry("controller")
+        #: seconds the source keeps serving AFTER its batch commits,
+        #: before drain unloads it — queries routed on the pre-commit
+        #: snapshot still land on a replica that holds the data
+        #: (embedded clusters set this; watch-driven ones drain through
+        #: the servers' own reconcile, which lags naturally)
+        self.drain_grace_s = 0.0
+        self.jobs: Dict[str, RebalanceJob] = {}
+        self._lock = threading.Lock()
+        self._threads: Dict[str, threading.Thread] = {}
+        self._load_journaled_jobs()
+
+    # -- construction / recovery --------------------------------------------
+    def _default_live(self, instance_id: str) -> bool:
+        inst = self.state.instances.get(instance_id)
+        return inst is None or inst.enabled
+
+    def _load_journaled_jobs(self) -> None:
+        jobs_meta: Dict[str, dict] = {}
+        moves_by_job: Dict[str, List[dict]] = {}
+        for e in self.journal.replay():
+            if e.get("kind") == "job":
+                jobs_meta[e["job"]] = e
+            elif e.get("kind") == "move":
+                moves_by_job.setdefault(e["job"], []).append(e)
+        for jid, meta in jobs_meta.items():
+            moves = [SegmentMove(segment=e["segment"],
+                                 table=e.get("table", meta.get("table", "")),
+                                 src=list(e.get("from", [])),
+                                 dst=list(e.get("to", [])),
+                                 state=e.get("state", "PLANNED"),
+                                 note=e.get("note", ""))
+                     for e in moves_by_job.get(jid, [])]
+            job = RebalanceJob(jid, meta.get("table", ""), moves)
+            job.status = meta.get("status", "RUNNING")
+            with self._lock:
+                self.jobs[jid] = job
+
+    def _next_job_id(self, table: str) -> str:
+        # deterministic per-table counter (no uuid/time): same plan
+        # sequence -> same job ids -> byte-identical journals
+        prefix = f"rebalance_{table}_"
+        n = 0
+        # lint: unlocked(caller _register holds self._lock; the lock is not reentrant)
+        for jid in self.jobs:
+            if jid.startswith(prefix):
+                try:
+                    n = max(n, int(jid[len(prefix):]) + 1)
+                except ValueError:
+                    pass
+        return f"{prefix}{n}"
+
+    # -- planning ------------------------------------------------------------
+    def plan(self, table: str, moves: Dict[str, dict]) -> List[SegmentMove]:
+        """moves: {segment: {"from": [...], "to": [...]}} (the
+        maintenance.rebalance_table dry-run shape). Sorted by segment
+        name for a deterministic execution order."""
+        return [SegmentMove(segment=name, table=table,
+                            src=list(mv.get("from", [])),
+                            dst=list(mv.get("to", [])))
+                for name, mv in sorted(moves.items())]
+
+    # -- job lifecycle -------------------------------------------------------
+    def start(self, table: str, moves: Dict[str, dict]) -> str:
+        """Plan + execute asynchronously; returns the job id."""
+        job = self._register(table, moves)
+        t = threading.Thread(target=self._run_job, args=(job,), daemon=True,
+                             name=f"rebalance-{job.job_id}")
+        with self._lock:
+            self._threads[job.job_id] = t
+        t.start()
+        return job.job_id
+
+    def run(self, table: str, moves: Dict[str, dict]) -> RebalanceJob:
+        """Plan + execute synchronously; returns the finished job."""
+        return self.execute(self._register(table, moves))
+
+    def _register(self, table: str, moves: Dict[str, dict]) -> RebalanceJob:
+        with self._lock:
+            job = RebalanceJob(self._next_job_id(table), table,
+                               self.plan(table, moves))
+            self.jobs[job.job_id] = job
+        # journal the WHOLE plan up front: a crash right after start
+        # still leaves resume() the full move list, not a truncated one
+        self.journal.append(job.entry())
+        for m in job.moves:
+            self.journal.append(m.entry(job.job_id))
+        return job
+
+    def _run_job(self, job: RebalanceJob) -> None:
+        try:
+            self.execute(job)
+        except Exception as exc:  # noqa: BLE001 — async job must not die silently
+            # in-memory FAILED only: the journal keeps RUNNING so a
+            # restart resumes the plan instead of abandoning it
+            job.status = "FAILED"
+            job.error = f"{type(exc).__name__}: {exc}"
+
+    def status(self, job_id: str) -> Optional[dict]:
+        with self._lock:
+            job = self.jobs.get(job_id)
+        return None if job is None else job.progress()
+
+    def cancel(self, job_id: str) -> bool:
+        with self._lock:
+            job = self.jobs.get(job_id)
+        if job is None or job.status != "RUNNING":
+            return False
+        job.cancel()
+        return True
+
+    def wait(self, job_id: str, timeout: float = 30.0) -> Optional[dict]:
+        with self._lock:
+            t = self._threads.get(job_id)
+        if t is not None:
+            t.join(timeout=timeout)
+        return self.status(job_id)
+
+    def resume(self) -> List[str]:
+        """Re-execute journaled RUNNING jobs (controller restart).
+        DONE/CANCELLED moves are skipped; LOADING redoes its idempotent
+        loads; WARMED goes straight to commit; ROUTED drains only."""
+        resumed = []
+        with self._lock:
+            jids = sorted(self.jobs)
+        for jid in jids:
+            job = self.jobs[jid]  # lint: unlocked(jobs entries are never removed; the snapshot above fixes the iteration set)
+            if job.status == "RUNNING":
+                self.execute(job)
+                resumed.append(jid)
+        return resumed
+
+    # -- the engine ----------------------------------------------------------
+    def execute(self, job: RebalanceJob) -> RebalanceJob:
+        pending = [m for m in job.moves if m.state not in _TERMINAL]
+        while pending:
+            if job.cancelled:
+                # consistent prefix: finished batches stay applied,
+                # unstarted moves are cancelled whole
+                for m in pending:
+                    self._set_state(job, m, "CANCELLED")
+                job.status = "CANCELLED"
+                self.journal.append(job.entry())
+                return job
+            batch = pending[:self.max_parallel]
+            pending = pending[self.max_parallel:]
+            self._run_batch(job, batch)
+        job.status = "DONE"
+        self.journal.append(job.entry())
+        return job
+
+    def _run_batch(self, job: RebalanceJob, batch: List[SegmentMove]) -> None:
+        # phase 1: load+warm every target replica in the batch
+        to_load = [m for m in batch if m.state in ("PLANNED", "LOADING")]
+        if len(to_load) > 1 and self.max_parallel > 1:
+            with ThreadPoolExecutor(max_workers=len(to_load)) as pool:
+                # list() re-raises the first load failure in this thread
+                list(pool.map(lambda m: self._load_move(job, m), to_load))
+        else:
+            for m in to_load:
+                self._load_move(job, m)
+        # phase 2: ONE assignment commit = one routing-epoch bump for
+        # the whole batch (resumed ROUTED moves are already committed)
+        warmed = [m for m in batch if m.state == "WARMED"]
+        if warmed:
+            assignment = {m.segment: list(m.dst) for m in warmed}
+            fire("controller.rebalance.move", table=job.table, stage="commit",
+                 segment=warmed[0].segment)
+            self.commit_fn(job.table, assignment)
+            for m in warmed:
+                self._set_state(job, m, "ROUTED")
+        # phase 3: drain sources, never below the availability floor
+        if warmed and self.drain_grace_s > 0:
+            import time as _time
+            _time.sleep(self.drain_grace_s)
+        for m in batch:
+            if m.state == "ROUTED":
+                self._drain_move(job, m)
+            elif m.state == "DRAINED":
+                # resume: crashed between DRAINED and DONE
+                self._set_state(job, m, "DONE")
+                self.metrics.add_meter("rebalance_moves_completed")
+
+    def _load_move(self, job: RebalanceJob, m: SegmentMove) -> None:
+        self._set_state(job, m, "LOADING")
+        st = self._seg_state(m.table, m.segment)
+        if st is None:
+            m.note = "segment gone"
+        else:
+            for inst in sorted(set(m.dst) - set(m.src)):
+                fire("controller.rebalance.move", segment=m.segment,
+                     table=m.table, instance=inst, stage="load")
+                self.load_fn(inst, m.table, st)
+        self._set_state(job, m, "WARMED")
+
+    def _drain_move(self, job: RebalanceJob, m: SegmentMove) -> None:
+        floor = max(1, min(len(m.dst), self.min_available))
+        holders = set(m.src) | set(m.dst)
+        for inst in sorted(set(m.src) - set(m.dst)):
+            fire("controller.rebalance.move", segment=m.segment,
+                 table=m.table, instance=inst, stage="drain")
+            live_remaining = [i for i in holders - {inst} if self.live_fn(i)]
+            if len(live_remaining) < floor:
+                m.note = f"source {inst} retained (availability floor)"
+                continue
+            if self.live_fn(inst):
+                try:
+                    self.unload_fn(inst, m.table, m.segment)
+                except Exception:  # noqa: BLE001 — drain is best-effort
+                    m.note = f"unload failed on {inst}"
+            holders.discard(inst)
+        self._set_state(job, m, "DRAINED")
+        self._set_state(job, m, "DONE")
+        self.metrics.add_meter("rebalance_moves_completed")
+
+    # -- helpers -------------------------------------------------------------
+    def _set_state(self, job: RebalanceJob, m: SegmentMove,
+                   state: str) -> None:
+        m.state = state
+        self.journal.append(m.entry(job.job_id))
+
+    def _seg_state(self, table: str, name: str) -> Optional[SegmentState]:
+        for s in self.state.table_segments(table):
+            if s.name == name:
+                return s
+        return None
+
+    def close(self) -> None:
+        self.journal.close()
+
+
+def make_staged_load_fn(state: ClusterState,
+                        ack_fn: Callable[[str, str, str], bool],
+                        timeout_s: float = 30.0,
+                        poll_s: float = 0.05) -> Callable:
+    """load_fn for watch-driven clusters (roles.py): stage the replica
+    in ClusterState (servers reconcile ``staged`` segments and load+warm
+    them, brokers route by ``instances`` only), then wait for the
+    server's load ack. ack_fn(table, segment, instance) -> loaded?"""
+    import time as _time
+
+    def load(instance_id: str, table: str,
+             st: Optional[SegmentState]) -> None:
+        if st is None:
+            return
+        state.stage_replicas(table, {st.name: [instance_id]})
+        deadline = _time.monotonic() + timeout_s
+        while _time.monotonic() < deadline:
+            if ack_fn(table, st.name, instance_id):
+                return
+            _time.sleep(poll_s)
+        raise TimeoutError(
+            f"segment {st.name} not acked on {instance_id} "
+            f"within {timeout_s}s")
+
+    return load

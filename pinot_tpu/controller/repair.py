@@ -1,0 +1,152 @@
+"""Automatic failure repair: the RebalanceChecker / periodic-repair analog.
+
+Reference parity: pinot-controller's RebalanceChecker +
+SegmentStatusChecker periodic tasks — watch instance liveness, mark a
+dead instance's segments under-replicated, and re-replicate them onto
+healthy tenant-matched instances through the same minimal-disruption
+move engine a manual rebalance uses. ``segments_missing_replicas``
+draining back to zero is the convergence signal.
+
+Debounce: an instance only counts as failed once its heartbeat age has
+exceeded ``pinot.controller.repair.grace.seconds`` on TWO consecutive
+check ticks — a flapping instance (stale one tick, heartbeating the
+next) never triggers replica churn, and an instance that returns after
+repair simply drops out of the assignment (its copies were already
+replaced; nothing moves back, so rejoin costs zero moves).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Set
+
+from pinot_tpu.controller import maintenance
+from pinot_tpu.controller.cluster_state import ClusterState
+from pinot_tpu.controller.rebalancer import Rebalancer
+from pinot_tpu.utils.failpoints import fire
+
+
+def update_replication_gauges(state: ClusterState, metrics=None,
+                              live: Optional[Set[str]] = None
+                              ) -> Dict[str, Dict[str, int]]:
+    """SegmentStatusChecker gauges: per-table
+    ``segments_missing_replicas{table=}`` / ``segments_offline{table=}``
+    on the controller registry (the /debug/health ``replication``
+    subsystem and /cluster/health read these). Returns the per-table
+    status dicts. ``live``: when given, only replicas on live instances
+    count toward replication."""
+    if metrics is None:
+        from pinot_tpu.utils.metrics import get_registry
+        metrics = get_registry("controller")
+    out: Dict[str, Dict[str, int]] = {}
+    for cfg in list(state.tables.values()):
+        t = cfg.table_name_with_type
+        st = maintenance.segment_status(
+            state, t, max(1, cfg.retention.replication), live=live)
+        metrics.set_gauge("segments_missing_replicas",
+                          st["segmentsMissingReplicas"],
+                          labels={"table": t})
+        metrics.set_gauge("segments_offline", st["segmentsOffline"],
+                          labels={"table": t})
+        out[t] = st
+    return out
+
+
+class RepairChecker:
+    """Periodic repair loop: heartbeat ages in, repair moves out.
+
+    heartbeat_ages_fn() -> {instance_id: seconds since last heartbeat}.
+    Instances absent from the map are treated as live (statically wired
+    deployments report no ages)."""
+
+    def __init__(self, state: ClusterState, rebalancer: Rebalancer,
+                 heartbeat_ages_fn: Callable[[], Dict[str, float]],
+                 config=None, metrics=None):
+        from pinot_tpu.utils.config import PinotConfiguration
+        cfg = config or PinotConfiguration()
+        self.state = state
+        self.rebalancer = rebalancer
+        self.heartbeat_ages_fn = heartbeat_ages_fn
+        self.grace_s = cfg.get_float(
+            "pinot.controller.repair.grace.seconds", 30.0)
+        self.enabled = cfg.get_bool("pinot.controller.repair.enabled", True)
+        if metrics is None:
+            from pinot_tpu.utils.metrics import get_registry
+            metrics = get_registry("controller")
+        self.metrics = metrics
+        #: instance -> consecutive stale ticks (the debounce state)
+        self._stale_streak: Dict[str, int] = {}
+        self._ages: Dict[str, float] = {}
+
+    def stale_instances(self) -> Set[str]:
+        """One debounce tick: update streaks from current heartbeat
+        ages, return instances stale for >= 2 consecutive ticks."""
+        ages = dict(self.heartbeat_ages_fn() or {})
+        stale: Set[str] = set()
+        for iid, age in ages.items():
+            if age > self.grace_s:
+                n = self._stale_streak.get(iid, 0) + 1
+                self._stale_streak[iid] = n
+                if n >= 2:
+                    stale.add(iid)
+            else:
+                # heartbeat returned: clear the streak — a flapping
+                # instance never accumulates enough to trigger churn
+                self._stale_streak.pop(iid, None)
+        self._ages = ages
+        return stale
+
+    def check_once(self) -> dict:
+        """One repair pass. Returns {"stale": [...], "repaired":
+        {table: [segments]}} and leaves the replication gauges updated
+        (with repairs applied, so convergence reads as missing == 0)."""
+        if not self.enabled:
+            return {"stale": [], "repaired": {}}
+        stale = self.stale_instances()
+        repaired: Dict[str, list] = {}
+        if stale:
+            for cfg_t in list(self.state.tables.values()):
+                segs = self._repair_table(cfg_t, stale)
+                if segs:
+                    repaired[cfg_t.table_name_with_type] = segs
+        live = {i.instance_id for i in self.state.server_instances()
+                if i.instance_id not in stale}
+        update_replication_gauges(self.state, metrics=self.metrics,
+                                  live=live)
+        return {"stale": sorted(stale), "repaired": repaired}
+
+    def _repair_table(self, cfg_t, stale: Set[str]) -> list:
+        table = cfg_t.table_name_with_type
+        expected = max(1, cfg_t.retention.replication)
+        # healthy tenant-matched candidate pool, residency-preferred:
+        # a target already serving bytes of this table warms fastest
+        candidates = [
+            i for i in self.state.server_instances(cfg_t.tenants.server)
+            if i.enabled and i.instance_id not in stale
+            and self._ages.get(i.instance_id, 0.0) <= self.grace_s]
+        moves: Dict[str, dict] = {}
+        for seg in self.state.table_segments(table):
+            live = [i for i in seg.instances if i not in stale]
+            if len(live) >= expected:
+                continue
+            if not seg.dir_path:
+                continue  # no deep-store / surviving dir to replicate from
+            pool = sorted(
+                (c for c in candidates if c.instance_id not in live),
+                key=lambda c: (-c.residency.get(table, 0), c.instance_id))
+            targets = [c.instance_id for c in pool[:expected - len(live)]]
+            if not targets:
+                continue
+            try:
+                for tgt in targets:
+                    fire("controller.repair.replicate", segment=seg.name,
+                         table=table, target=tgt)
+            except Exception:  # noqa: BLE001 — chaos/skip: retry next tick
+                continue
+            moves[seg.name] = {"from": list(seg.instances),
+                               "to": live + targets}
+        if not moves:
+            return []
+        job = self.rebalancer.run(table, moves)
+        if job.status != "DONE":
+            return []
+        self.metrics.add_meter("repair_replications", len(moves))
+        return sorted(moves)

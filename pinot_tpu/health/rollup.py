@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import json
 import logging
+import re
 import threading
 import time
 import urllib.request
@@ -88,6 +89,27 @@ def role_health_summary(role: str, config=None,
         "ok": True,
         "errorCode250": code250, "queriesKilled": killed,
         "gatherExpired": expired}
+
+    # replication (controller): SegmentStatusChecker gauges — ANY table
+    # with segments under their configured replication flips the role
+    # (and, through the sweep, /cluster/health) to degraded; repair
+    # draining segments_missing_replicas to zero is the recovery signal
+    missing_by_table = {k: v for k, v in _family_items(
+        gauges, "segments_missing_replicas")}
+    offline = sum(v for _k, v in _family_items(gauges, "segments_offline"))
+    if missing_by_table or offline:
+        def _table_of(key: str) -> str:
+            # segments_missing_replicas{table="x_OFFLINE"} -> x_OFFLINE
+            m = re.search(r'table="([^"]*)"', key)
+            return m.group(1) if m else key
+
+        under = sorted(_table_of(k) for k, v in missing_by_table.items()
+                       if v)
+        subsystems["replication"] = {
+            "ok": not under and not offline,
+            "segmentsMissingReplicas": int(sum(missing_by_table.values())),
+            "segmentsOffline": int(offline),
+            "underReplicated": under}
 
     # SLO watchdog: the only subsystem allowed to flip the verdict from
     # burn-rate math (multi-window — resistant to blips by construction)

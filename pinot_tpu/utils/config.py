@@ -250,6 +250,19 @@ KEYS: Dict[str, Any] = {
     "pinot.controller.deep.store.uri": "",
     "pinot.controller.retention.frequency.seconds": 60,
     "pinot.coordination.liveness.ttl.seconds": 15.0,
+    # minimal-disruption rebalancer (controller/rebalancer.py): a move
+    # never drops a segment below min(replication, min.available.replicas)
+    # live loaded copies; max.parallel.moves moves share one batched
+    # routing-epoch bump (set 1 for byte-identical seeded chaos replays)
+    "pinot.controller.rebalance.min.available.replicas": 1,
+    "pinot.controller.rebalance.max.parallel.moves": 4,
+    "pinot.controller.rebalance.journal.max.bytes": 1 << 20,
+    # automatic failure repair (controller/repair.py): an instance whose
+    # heartbeat age exceeds grace on two consecutive ticks (debounced —
+    # flapping never churns replicas) gets its segments re-replicated
+    "pinot.controller.repair.enabled": True,
+    "pinot.controller.repair.grace.seconds": 30.0,
+    "pinot.controller.repair.frequency.seconds": 10.0,
     # minion task fabric, controller side (controller/task_manager.py):
     # lease TTL + heartbeat-renewed leases; an expired lease requeues the
     # task with capped exponential backoff until max.attempts

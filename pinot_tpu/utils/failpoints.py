@@ -98,6 +98,20 @@ SITES: Dict[str, str] = {
     "ingest.upsert.apply":
         "per-row upsert metadata application, BEFORE any state lands "
         "(an armed error skips the row whole, never half-applied)",
+    "controller.rebalance.move":
+        "per move-engine step (ctx: segment, table, instance, stage="
+        "load|commit|drain) — arm with where={'stage': 'commit'} + "
+        "SimulatedCrash to kill the controller between LOADING and "
+        "ROUTED; seeded delays journal for byte-identical replay",
+    "controller.rebalance.journal":
+        "move-journal line write, payload hook (torn= truncates the "
+        "JSON line: replay SKIPS it and resume re-executes that "
+        "idempotent transition — a torn write means resume, never a "
+        "corrupt plan)",
+    "controller.repair.replicate":
+        "repair checker, before re-replicating one segment onto a "
+        "healthy target (ctx: segment, table, target) — an armed error "
+        "skips that segment this tick; the next tick retries",
     "controller.task.assign":
         "task-fabric lease grant",
     "controller.task.lease.renew":

@@ -155,4 +155,13 @@ METRICS: Dict[str, str] = {
     "cluster_instances_live": "instances the last sweep verdicted live",
     "cluster_instances_degraded":
         "instances the last sweep verdicted degraded",
+    # -- self-healing maintenance (PR 18) ---------------------------------
+    "segments_missing_replicas":
+        "segments below their configured replication (label table=; "
+        "repair draining this to zero is the convergence signal)",
+    "segments_offline": "segments in OFFLINE status (label table=)",
+    "rebalance_moves_completed":
+        "segment moves the rebalance engine completed (DONE)",
+    "repair_replications":
+        "segments re-replicated by the automatic failure repair loop",
 }

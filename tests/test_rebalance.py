@@ -1,0 +1,876 @@
+"""Self-healing cluster maintenance (ISSUE 18): the journaled
+minimal-disruption move engine, automatic failure repair, and the
+closed retention loop.
+
+Covers:
+  * MoveJournal discipline (last snapshot wins, torn lines skipped,
+    compaction) and the PLANNED->LOADING->WARMED->ROUTED->DRAINED->DONE
+    state machine: load+warm BEFORE commit BEFORE drain, availability
+    floor, cancel leaves a consistent prefix.
+  * Controller restart mid-rebalance: a SimulatedCrash armed at
+    `controller.rebalance.move` (where stage=commit) kills the engine
+    between LOADING and ROUTED; a new Rebalancer on the same journal
+    resumes WITHOUT re-executing finished loads and converges to the
+    exact target. A torn `controller.rebalance.journal` write replays
+    as skip-line, never a corrupt plan.
+  * Same-seed chaos runs replay byte-identical journals.
+  * RepairChecker: two-tick debounce, flap immunity, residency-preferred
+    targets, `controller.repair.replicate` chaos = skip-this-tick.
+  * MiniCluster end to end: live rebalance and kill+repair with zero
+    failed queries and correct results throughout; replication gauges
+    drain to zero on convergence; /debug/health `replication` verdict.
+  * Retention closes the loop: expired segments stop being served AND
+    their broker-cache entries go unaddressable (routing-epoch bump).
+  * REST async jobs: POST /tables/{t}/rebalance, GET /rebalance/{jobId},
+    cancel.
+"""
+import hashlib
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pinot_tpu.controller import ClusterState, Controller, SegmentState
+from pinot_tpu.controller.cluster_state import InstanceState
+from pinot_tpu.controller.rebalancer import (
+    MoveJournal, Rebalancer)
+from pinot_tpu.controller.repair import (
+    RepairChecker, update_replication_gauges)
+from pinot_tpu.models import (DataType, FieldSpec, FieldType, Schema,
+                              TableConfig, TableType)
+from pinot_tpu.utils.config import PinotConfiguration
+from pinot_tpu.utils.failpoints import (
+    FailpointError, FaultSchedule, SimulatedCrash, failpoints)
+from pinot_tpu.utils.metrics import MetricsRegistry
+
+
+def make_schema():
+    return Schema("rb", [
+        FieldSpec("d", DataType.STRING),
+        FieldSpec("ts", DataType.LONG, FieldType.DATE_TIME),
+        FieldSpec("m", DataType.LONG, FieldType.METRIC),
+    ])
+
+
+def make_config(replication=1, **kw):
+    tc = TableConfig("rb", TableType.OFFLINE)
+    tc.retention.time_column = "ts"
+    tc.retention.replication = replication
+    for k, v in kw.items():
+        setattr(tc.retention, k, v)
+    return tc
+
+
+def make_state(n_servers=3, replication=2, n_segments=3):
+    st = ClusterState()
+    for i in range(n_servers):
+        st.register_instance(InstanceState(f"server_{i}"))
+    st.add_table(make_config(replication=replication), make_schema())
+    for i in range(n_segments):
+        st.upsert_segment(SegmentState(
+            f"s{i}", "rb_OFFLINE",
+            [f"server_{j % n_servers}" for j in (i, i + 1)][:replication],
+            dir_path=f"/deep/s{i}"))
+    return st
+
+
+class _Recorder:
+    """Fake load/unload/commit endpoints that log call order."""
+
+    def __init__(self, fail_loads=(), registry=None):
+        self.calls = []
+        self.fail_loads = set(fail_loads)
+        self._lock = threading.Lock()
+
+    def load(self, instance_id, table, st):
+        with self._lock:
+            self.calls.append(("load", instance_id, st.name if st else None))
+        if instance_id in self.fail_loads:
+            raise RuntimeError(f"load refused on {instance_id}")
+
+    def unload(self, instance_id, table, name):
+        with self._lock:
+            self.calls.append(("unload", instance_id, name))
+
+    def commit(self, table, assignment):
+        with self._lock:
+            self.calls.append(
+                ("commit", tuple(sorted(assignment)),
+                 {k: tuple(v) for k, v in assignment.items()}))
+
+    def ops(self, kind):
+        return [c for c in self.calls if c[0] == kind]
+
+
+def make_rebalancer(st, rec, journal_path=None, overrides=None, **kw):
+    cfg = PinotConfiguration().with_overrides(overrides or {})
+    return Rebalancer(st, load_fn=rec.load, unload_fn=rec.unload,
+                      commit_fn=rec.commit, config=cfg,
+                      journal_path=journal_path,
+                      metrics=MetricsRegistry("controller"), **kw)
+
+
+# ---------------------------------------------------------------------------
+# journal discipline
+# ---------------------------------------------------------------------------
+
+class TestMoveJournal:
+    def test_last_snapshot_wins(self, tmp_path):
+        j = MoveJournal(str(tmp_path / "j"))
+        for state in ("PLANNED", "LOADING", "WARMED"):
+            j.append({"kind": "move", "job": "a", "segment": "s0",
+                      "state": state})
+        j.append({"kind": "job", "job": "a", "status": "RUNNING"})
+        j.close()
+        out = MoveJournal(str(tmp_path / "j")).replay()
+        assert len(out) == 2
+        move = next(e for e in out if e["kind"] == "move")
+        assert move["state"] == "WARMED"
+
+    def test_torn_line_skipped_not_fatal(self, tmp_path):
+        path = tmp_path / "j"
+        j = MoveJournal(str(path))
+        j.append({"kind": "move", "job": "a", "segment": "s0",
+                  "state": "DONE"})
+        j.close()
+        with open(path, "ab") as f:  # torn tail: half a line, no newline
+            f.write(b'{"kind":"move","job":"a","seg')
+        out = MoveJournal(str(path)).replay()
+        assert [e["state"] for e in out] == ["DONE"]
+
+    def test_compaction_preserves_latest(self, tmp_path):
+        path = tmp_path / "j"
+        j = MoveJournal(str(path), max_bytes=256)
+        for i in range(50):
+            j.append({"kind": "move", "job": "a", "segment": "s0",
+                      "state": f"S{i}"})
+        j.close()
+        assert path.stat().st_size < 4096  # compacted, not 50 lines
+        out = MoveJournal(str(path)).replay()
+        assert out[-1]["state"] == "S49"
+
+
+class TestStagedReplicas:
+    def test_stage_commit_unstage(self):
+        st = make_state(n_servers=3, replication=1, n_segments=1)
+        st.stage_replicas("rb_OFFLINE", {"s0": ["server_2"]})
+        seg = st.table_segments("rb_OFFLINE")[0]
+        assert seg.staged == ["server_2"]
+        assert "server_2" not in seg.instances  # brokers route instances only
+        st.commit_moves("rb_OFFLINE", {"s0": ["server_2"]})
+        seg = st.table_segments("rb_OFFLINE")[0]
+        assert seg.instances == ["server_2"]
+        assert seg.staged == []  # promotion clears the staging mark
+        st.stage_replicas("rb_OFFLINE", {"s0": ["server_1"]})
+        st.unstage_replicas("rb_OFFLINE", {"s0": ["server_1"]})
+        assert st.table_segments("rb_OFFLINE")[0].staged == []
+
+    def test_commit_moves_single_notification(self):
+        st = make_state(n_servers=3, replication=1, n_segments=3)
+        events = []
+        st.add_listener(events.append)
+        st.commit_moves("rb_OFFLINE", {"s0": ["server_2"],
+                                       "s1": ["server_2"]})
+        assert events == ["rb_OFFLINE"]  # one batch = one epoch bump
+
+
+# ---------------------------------------------------------------------------
+# the move engine
+# ---------------------------------------------------------------------------
+
+class TestRebalancerEngine:
+    def test_load_then_commit_then_drain_order(self, tmp_path):
+        st = make_state()
+        rec = _Recorder()
+        rb = make_rebalancer(st, rec, str(tmp_path / "j"))
+        job = rb.run("rb_OFFLINE", {
+            "s0": {"from": ["server_0", "server_1"],
+                   "to": ["server_1", "server_2"]}})
+        assert job.status == "DONE"
+        kinds = [c[0] for c in rec.calls]
+        assert kinds == ["load", "commit", "unload"]
+        # only the NEW replica loads, only the RETIRED one drains
+        assert rec.ops("load")[0][1] == "server_2"
+        assert rec.ops("unload")[0][1] == "server_0"
+        assert st.table_segments("rb_OFFLINE") != []
+
+    def test_no_op_move_touches_nothing(self, tmp_path):
+        st = make_state()
+        rec = _Recorder()
+        rb = make_rebalancer(st, rec, str(tmp_path / "j"))
+        job = rb.run("rb_OFFLINE", {
+            "s0": {"from": ["server_0", "server_1"],
+                   "to": ["server_0", "server_1"]}})
+        assert job.status == "DONE"
+        assert rec.ops("load") == [] and rec.ops("unload") == []
+
+    def test_availability_floor_retains_source(self, tmp_path):
+        st = make_state()
+        rec = _Recorder()
+        # target replicas are NOT live: draining the source would leave
+        # zero live copies -> the engine must keep it
+        rb = make_rebalancer(st, rec, str(tmp_path / "j"),
+                             live_fn=lambda iid: iid == "server_0")
+        job = rb.run("rb_OFFLINE", {
+            "s0": {"from": ["server_0"], "to": ["server_2"]}})
+        assert job.status == "DONE"
+        assert rec.ops("unload") == []
+        assert "availability floor" in job.moves[0].note
+
+    def test_dead_source_never_unloaded_over_wire(self, tmp_path):
+        st = make_state()
+        rec = _Recorder()
+        rb = make_rebalancer(st, rec, str(tmp_path / "j"),
+                             live_fn=lambda iid: iid != "server_0")
+        job = rb.run("rb_OFFLINE", {
+            "s0": {"from": ["server_0", "server_1"],
+                   "to": ["server_1", "server_2"]}})
+        assert job.status == "DONE"
+        assert rec.ops("unload") == []  # dead source: nothing to call
+        assert rec.ops("commit") != []  # but the flip still happened
+
+    def test_cancel_leaves_consistent_prefix(self, tmp_path):
+        st = ClusterState()
+        for i in range(3):
+            st.register_instance(InstanceState(f"server_{i}"))
+        st.add_table(make_config(), make_schema())
+        for i in range(6):
+            st.upsert_segment(SegmentState(f"s{i}", "rb_OFFLINE",
+                                           ["server_0"], dir_path="/d"))
+        rec = _Recorder()
+        rb = make_rebalancer(
+            st, rec, str(tmp_path / "j"),
+            overrides={"pinot.controller.rebalance.max.parallel.moves": 1})
+        moves = {f"s{i}": {"from": ["server_0"], "to": ["server_1"]}
+                 for i in range(6)}
+        job = rb._register("rb_OFFLINE", moves)
+        job.cancel()  # cancelled before the engine starts a batch
+        rb.execute(job)
+        assert job.status == "CANCELLED"
+        assert all(m.state == "CANCELLED" for m in job.moves)
+        assert rec.ops("commit") == []  # nothing half-applied
+        # journal agrees: a fresh engine sees the terminal job
+        rb.close()
+        rb2 = make_rebalancer(st, _Recorder(), str(tmp_path / "j"))
+        assert rb2.jobs[job.job_id].status == "CANCELLED"
+        assert rb2.resume() == []
+
+    def test_deterministic_job_ids(self, tmp_path):
+        st = make_state()
+        rb = make_rebalancer(st, _Recorder(), str(tmp_path / "j"))
+        a = rb.run("rb_OFFLINE", {"s0": {"from": ["server_0"],
+                                         "to": ["server_1"]}})
+        b = rb.run("rb_OFFLINE", {"s1": {"from": ["server_1"],
+                                         "to": ["server_2"]}})
+        assert a.job_id == "rebalance_rb_OFFLINE_0"
+        assert b.job_id == "rebalance_rb_OFFLINE_1"
+
+    def test_batched_commits(self, tmp_path):
+        st = ClusterState()
+        for i in range(3):
+            st.register_instance(InstanceState(f"server_{i}"))
+        st.add_table(make_config(), make_schema())
+        for i in range(5):
+            st.upsert_segment(SegmentState(f"s{i}", "rb_OFFLINE",
+                                           ["server_0"], dir_path="/d"))
+        rec = _Recorder()
+        rb = make_rebalancer(
+            st, rec, str(tmp_path / "j"),
+            overrides={"pinot.controller.rebalance.max.parallel.moves": 2})
+        moves = {f"s{i}": {"from": ["server_0"], "to": ["server_1"]}
+                 for i in range(5)}
+        job = rb.run("rb_OFFLINE", moves)
+        assert job.status == "DONE"
+        # 5 moves at max_parallel=2 -> ceil(5/2)=3 batch commits, each a
+        # single routing-epoch bump covering its whole batch
+        commits = rec.ops("commit")
+        assert [len(c[1]) for c in commits] == [2, 2, 1]
+
+
+# ---------------------------------------------------------------------------
+# restart / crash / torn-write resilience (the chaos satellites)
+# ---------------------------------------------------------------------------
+
+class TestCrashResume:
+    def test_restart_mid_rebalance_resumes_from_journal(self, tmp_path):
+        """Kill the controller between LOADING and ROUTED (the armed
+        crash fires at the commit stage); a NEW engine on the same
+        journal resumes: finished loads are NOT re-executed, the plan
+        converges to the exact target."""
+        st = make_state(n_servers=3, replication=1, n_segments=2)
+        rec = _Recorder()
+        jp = str(tmp_path / "j")
+        rb = make_rebalancer(
+            st, rec, jp,
+            overrides={"pinot.controller.rebalance.max.parallel.moves": 1})
+        moves = {"s0": {"from": ["server_0"], "to": ["server_1"]},
+                 "s1": {"from": ["server_1"], "to": ["server_2"]}}
+        with failpoints.armed("controller.rebalance.move",
+                              error=SimulatedCrash("controller died"),
+                              where={"stage": "commit"}, times=1):
+            with pytest.raises(SimulatedCrash):
+                rb.run("rb_OFFLINE", moves)
+        rb.close()
+        # crash window: s0 loaded+WARMED but never committed
+        assert ("load", "server_1", "s0") in rec.calls
+        assert rec.ops("commit") == []
+        # "restart": fresh engine, fresh endpoints, same journal
+        rec2 = _Recorder()
+        rb2 = make_rebalancer(
+            st, rec2, jp,
+            overrides={"pinot.controller.rebalance.max.parallel.moves": 1})
+        resumed = rb2.resume()
+        assert len(resumed) == 1
+        job = rb2.jobs[resumed[0]]
+        assert job.status == "DONE"
+        # s0 was already WARMED -> resume must NOT reload it
+        assert ("load", "server_1", "s0") not in rec2.calls
+        assert ("load", "server_2", "s1") in rec2.calls
+        # exact target reached, both segments committed
+        committed = {}
+        for c in rec2.ops("commit"):
+            committed.update(c[2])
+        assert committed == {"s0": ("server_1",), "s1": ("server_2",)}
+        rb2.close()
+
+    def test_torn_journal_write_resumes_not_corrupts(self, tmp_path):
+        """A torn journal line (armed at controller.rebalance.journal)
+        replays as skip-line: the move's LAST GOOD snapshot wins and
+        resume re-executes the lost idempotent transition."""
+        st = make_state(n_servers=3, replication=1, n_segments=1)
+        rec = _Recorder()
+        jp = str(tmp_path / "j")
+        rb = make_rebalancer(st, rec, jp)
+        # tear the move's final DONE snapshot as it is written
+        with failpoints.armed("controller.rebalance.journal", torn=True,
+                              where={"kind": "move", "state": "DONE"},
+                              times=1):
+            job = rb.run("rb_OFFLINE", {"s0": {"from": ["server_0"],
+                                               "to": ["server_1"]}})
+        assert job.status == "DONE"
+        rb.close()
+        # the job line said DONE, the move's DONE line tore -> replay
+        # falls back to DRAINED; a fresh engine sees a consistent plan
+        rb2 = make_rebalancer(st, _Recorder(), jp)
+        assert rb2.jobs[job.job_id].moves[0].state == "DRAINED"
+        assert rb2.jobs[job.job_id].status == "DONE"
+        rb2.close()
+
+    def test_crash_at_drain_resumes_without_reload_or_recommit(
+            self, tmp_path):
+        """Engine dies AFTER commit (stage=drain): the journal says
+        ROUTED, so resume neither reloads nor recommits — it only
+        finishes the drain."""
+        st = make_state(n_servers=3, replication=1, n_segments=1)
+        rec = _Recorder()
+        jp = str(tmp_path / "j")
+        rb = make_rebalancer(st, rec, jp)
+        with failpoints.armed("controller.rebalance.move",
+                              error=SimulatedCrash("died at drain"),
+                              where={"stage": "drain"}, times=1):
+            with pytest.raises(SimulatedCrash):
+                rb.run("rb_OFFLINE", {"s0": {"from": ["server_0"],
+                                             "to": ["server_1"]}})
+        rb.close()
+        assert len(rec.ops("commit")) == 1
+        rec2 = _Recorder()
+        rb2 = make_rebalancer(st, rec2, jp)
+        assert rb2.jobs and rb2.resume()
+        job = next(iter(rb2.jobs.values()))
+        assert job.status == "DONE"
+        assert job.moves[0].state == "DONE"
+        assert rec2.ops("load") == []    # load not re-executed
+        assert rec2.ops("commit") == []  # routing not flipped twice
+        assert rec2.ops("unload") == [("unload", "server_0", "s0")]
+        rb2.close()
+
+    def test_same_seed_chaos_replays_byte_identical_journal(self, tmp_path):
+        """Two runs of the same plan under the same seeded FaultSchedule
+        produce byte-identical decision journals (no timestamps, no
+        uuids, deterministic job ids + execution order)."""
+        def one_run(sub, seed):
+            st = make_state(n_servers=3, replication=1, n_segments=3)
+            rec = _Recorder()
+            jp = str(tmp_path / sub)
+            rb = make_rebalancer(st, rec, jp, overrides={
+                "pinot.controller.rebalance.max.parallel.moves": 1})
+            sched = FaultSchedule([
+                ("controller.rebalance.move",
+                 {"delay": 0.003, "probability": 0.5, "seed": seed}),
+            ])
+            sched.arm()
+            try:
+                job = rb.run("rb_OFFLINE", {
+                    f"s{i}": {"from": [f"server_{i % 3}"],
+                              "to": [f"server_{(i + 1) % 3}"]}
+                    for i in range(3)})
+            finally:
+                sched.disarm()
+                rb.close()
+            assert job.status == "DONE"
+            with open(jp, "rb") as f:
+                return hashlib.sha1(f.read()).hexdigest(), sched.decisions()
+
+        h1, d1 = one_run("run1", seed=42)
+        h2, d2 = one_run("run2", seed=42)
+        assert h1 == h2
+        assert d1 == d2
+
+
+# ---------------------------------------------------------------------------
+# automatic failure repair
+# ---------------------------------------------------------------------------
+
+class _FakeAges:
+    def __init__(self):
+        self.ages = {}
+
+    def __call__(self):
+        return dict(self.ages)
+
+
+def make_repair(st, rec=None, grace=1.0, overrides=None, journal=None):
+    rec = rec or _Recorder()
+    cfg = PinotConfiguration().with_overrides({
+        "pinot.controller.repair.grace.seconds": grace,
+        **(overrides or {})})
+
+    def commit(table, assignment):  # record AND apply
+        rec.commit(table, assignment)
+        st.commit_moves(table, assignment)
+
+    rb = Rebalancer(st, load_fn=rec.load, unload_fn=rec.unload,
+                    commit_fn=commit, config=cfg, journal_path=journal,
+                    metrics=MetricsRegistry("controller"))
+    ages = _FakeAges()
+    rep = RepairChecker(st, rb, ages, config=cfg,
+                        metrics=MetricsRegistry("controller"))
+    return rep, rb, rec, ages
+
+
+class TestRepairChecker:
+    def test_two_tick_debounce(self):
+        st = make_state()
+        rep, _rb, rec, ages = make_repair(st)
+        ages.ages = {"server_0": 5.0, "server_1": 0.0, "server_2": 0.0}
+        first = rep.check_once()
+        assert first["stale"] == [] and first["repaired"] == {}
+        assert rec.ops("load") == []  # one stale tick repairs NOTHING
+        second = rep.check_once()
+        assert second["stale"] == ["server_0"]
+        assert second["repaired"] != {}
+
+    def test_flapping_instance_never_triggers_churn(self):
+        st = make_state()
+        rep, _rb, rec, ages = make_repair(st)
+        for _ in range(4):  # stale, fresh, stale, fresh ...
+            ages.ages = {"server_0": 5.0}
+            assert rep.check_once()["repaired"] == {}
+            ages.ages = {"server_0": 0.0}
+            assert rep.check_once()["repaired"] == {}
+        assert rec.ops("load") == []
+
+    def test_rejoin_after_repair_costs_zero_moves(self):
+        st = make_state(n_servers=3, replication=2, n_segments=2)
+        rep, _rb, rec, ages = make_repair(st)
+        ages.ages = {"server_0": 9.0}
+        rep.check_once()
+        out = rep.check_once()
+        assert out["repaired"] != {}
+        n_loads = len(rec.ops("load"))
+        ages.ages = {"server_0": 0.0}  # the instance comes back
+        for _ in range(2):
+            assert rep.check_once()["repaired"] == {}
+        assert len(rec.ops("load")) == n_loads  # nothing moved back
+
+    def test_targets_prefer_residency(self):
+        st = ClusterState()
+        st.register_instance(InstanceState("server_0"))
+        st.register_instance(InstanceState(
+            "server_cold", residency={}))
+        st.register_instance(InstanceState(
+            "server_warm", residency={"rb_OFFLINE": 1 << 30}))
+        st.add_table(make_config(replication=2), make_schema())
+        st.upsert_segment(SegmentState("s0", "rb_OFFLINE",
+                                       ["server_0", "server_dead"],
+                                       dir_path="/d"))
+        rep, _rb, rec, ages = make_repair(st)
+        ages.ages = {"server_dead": 9.0}
+        rep.check_once()
+        out = rep.check_once()
+        assert out["repaired"] == {"rb_OFFLINE": ["s0"]}
+        assert rec.ops("load")[0][1] == "server_warm"
+
+    def test_no_dir_path_skipped(self):
+        st = ClusterState()
+        for i in range(2):
+            st.register_instance(InstanceState(f"server_{i}"))
+        st.add_table(make_config(replication=2), make_schema())
+        st.upsert_segment(SegmentState("s0", "rb_OFFLINE",
+                                       ["server_0", "server_9"]))  # no dir
+        rep, _rb, rec, ages = make_repair(st)
+        ages.ages = {"server_9": 9.0}
+        rep.check_once()
+        assert rep.check_once()["repaired"] == {}
+
+    def test_disabled_knob(self):
+        st = make_state()
+        rep, _rb, rec, ages = make_repair(
+            st, overrides={"pinot.controller.repair.enabled": False})
+        ages.ages = {"server_0": 99.0}
+        for _ in range(3):
+            assert rep.check_once() == {"stale": [], "repaired": {}}
+
+    def test_replicate_failpoint_skips_then_retries(self):
+        """An armed error at controller.repair.replicate skips the
+        segment THIS tick; the next tick (failpoint exhausted) repairs
+        it — chaos in the repair path degrades to retry, never crash."""
+        st = make_state(n_servers=3, replication=2, n_segments=1)
+        rep, _rb, rec, ages = make_repair(st)
+        ages.ages = {"server_0": 9.0}
+        rep.check_once()
+        with failpoints.armed("controller.repair.replicate",
+                              error=FailpointError("deep store hiccup"),
+                              times=1):
+            out = rep.check_once()
+        assert out["stale"] == ["server_0"] and out["repaired"] == {}
+        out = rep.check_once()
+        assert out["repaired"] != {}
+
+    def test_gauges_track_convergence(self):
+        st = make_state(n_servers=3, replication=2, n_segments=2)
+        reg = MetricsRegistry("controller")
+        rep, _rb, _rec, ages = make_repair(st)
+        rep.metrics = reg
+        ages.ages = {"server_0": 9.0}
+        rep.check_once()
+        rep.check_once()
+        gauges = reg.sample()["gauges"]
+        assert gauges['segments_missing_replicas{table="rb_OFFLINE"}'] == 0
+
+
+# ---------------------------------------------------------------------------
+# health plane: the replication subsystem
+# ---------------------------------------------------------------------------
+
+class TestHealthReplication:
+    def test_replication_subsystem_verdict(self):
+        from pinot_tpu.health.rollup import role_health_summary
+        reg = MetricsRegistry("controller")
+        st = make_state(n_servers=3, replication=2, n_segments=2)
+        update_replication_gauges(st, metrics=reg)
+        ok = role_health_summary("controller", registry=reg)
+        assert ok["subsystems"]["replication"]["ok"] is True
+        assert "replication" not in ok["degraded"]
+        # a dead holder flips the verdict...
+        update_replication_gauges(st, metrics=reg,
+                                  live={"server_1", "server_2"})
+        bad = role_health_summary("controller", registry=reg)
+        sub = bad["subsystems"]["replication"]
+        assert sub["ok"] is False
+        assert sub["underReplicated"] == ["rb_OFFLINE"]
+        assert sub["segmentsMissingReplicas"] > 0
+        # ...and convergence (missing back to 0) restores it
+        update_replication_gauges(st, metrics=reg)
+        again = role_health_summary("controller", registry=reg)
+        assert again["subsystems"]["replication"]["ok"] is True
+
+    def test_roles_without_gauges_grow_no_subsystem(self):
+        from pinot_tpu.health.rollup import role_health_summary
+        reg = MetricsRegistry("broker")
+        out = role_health_summary("broker", registry=reg)
+        assert "replication" not in out["subsystems"]
+
+
+# ---------------------------------------------------------------------------
+# REST: async rebalance jobs
+# ---------------------------------------------------------------------------
+
+class TestRebalanceHttpApi:
+    @pytest.fixture()
+    def rest(self, tmp_path):
+        from pinot_tpu.controller.http_api import ControllerHttpServer
+        st = ClusterState()
+        for i in range(2):
+            st.register_instance(InstanceState(f"server_{i}"))
+        st.add_table(make_config(), make_schema())
+        for i in range(4):  # piled on server_0: a rebalance has work
+            st.upsert_segment(SegmentState(f"s{i}", "rb_OFFLINE",
+                                           ["server_0"], dir_path="/d"))
+        ctl = Controller(state=st,
+                         rebalance_journal=str(tmp_path / "j"))
+        ctl.rebalancer.metrics = MetricsRegistry("controller")
+        srv = ControllerHttpServer(st, controller=ctl)
+        srv.start()
+        yield srv, ctl, st
+        srv.stop()
+        ctl.rebalancer.close()
+
+    def _post(self, srv, path, body=None):
+        req = urllib.request.Request(
+            f"http://{srv.host}:{srv.port}{path}",
+            data=json.dumps(body or {}).encode(), method="POST",
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return json.loads(r.read())
+
+    def _get(self, srv, path):
+        with urllib.request.urlopen(
+                f"http://{srv.host}:{srv.port}{path}", timeout=10) as r:
+            return json.loads(r.read())
+
+    def test_dry_run_then_job_lifecycle(self, rest):
+        srv, ctl, st = rest
+        dry = self._post(srv, "/tables/rb/rebalance", {"dryRun": True})
+        assert dry["dryRun"] is True and dry["moves"]
+        before = {s.name: list(s.instances)
+                  for s in st.table_segments("rb_OFFLINE")}
+        out = self._post(srv, "/tables/rb/rebalance", {})
+        assert out["status"] == "IN_PROGRESS" and out["jobId"]
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            prog = self._get(srv, f"/rebalance/{out['jobId']}")
+            if prog["status"] != "RUNNING":
+                break
+            time.sleep(0.02)
+        assert prog["status"] == "DONE"
+        assert prog["done"] == prog["totalMoves"] > 0
+        after = {s.name: list(s.instances)
+                 for s in st.table_segments("rb_OFFLINE")}
+        assert after != before
+        # balanced: and now a second POST is a NO_OP
+        noop = self._post(srv, "/tables/rb/rebalance", {})
+        assert noop == {"status": "NO_OP", "jobId": None}
+
+    def test_unknown_table_404(self, rest):
+        srv, _ctl, _st = rest
+        with pytest.raises(urllib.error.HTTPError) as e:
+            self._post(srv, "/tables/nope/rebalance", {})
+        assert e.value.code == 404
+
+    def test_unknown_job_404_and_cancel(self, rest):
+        srv, _ctl, _st = rest
+        with pytest.raises(urllib.error.HTTPError) as e:
+            self._get(srv, "/rebalance/rebalance_x_0")
+        assert e.value.code == 404
+        out = self._post(srv, "/rebalance/rebalance_x_0/cancel")
+        assert out["cancelled"] is False
+
+
+# ---------------------------------------------------------------------------
+# end to end on the embedded cluster
+# ---------------------------------------------------------------------------
+
+def _mini(tmp_path, num_servers=3, replication=2, n_segs=4, num_docs=400,
+          **kw):
+    from pinot_tpu.cluster.mini import MiniCluster
+    from tests.queries.harness import (build_segments, synthetic_columns,
+                                       synthetic_schema,
+                                       synthetic_table_config)
+    data = [synthetic_columns(num_docs, seed=11 + i) for i in range(n_segs)]
+    segs = build_segments(tmp_path, synthetic_schema(),
+                          synthetic_table_config(), data)
+    tc = synthetic_table_config()
+    tc.retention.replication = replication
+    c = MiniCluster(num_servers=num_servers, **kw)
+    c.start()
+    c.add_table("testTable", table_config=tc, schema=synthetic_schema())
+    for i, seg in enumerate(segs):
+        c.add_segment("testTable", seg, server_idx=i % 2,
+                      replicas=[(i + 1) % 2])
+    return c, segs, num_docs * n_segs
+
+
+class TestMiniClusterSelfHealing:
+    def test_live_rebalance_zero_failed_queries(self, tmp_path):
+        """A closed query loop runs WHILE segments move to a new server:
+        every query succeeds with the exact pre-move answer, and the
+        move engine never routes to the target before it loaded."""
+        c, segs, total = _mini(tmp_path)
+        try:
+            rb = c.make_rebalancer(journal_path=str(tmp_path / "j"))
+            # flip-before-load guard: at commit time every instance in
+            # the assignment must already hold the segment
+            inner_commit = rb.commit_fn
+
+            def checked_commit(table, assignment):
+                for name, insts in assignment.items():
+                    for iid in insts:
+                        srv = next(s for s in c.servers
+                                   if s.instance_id == iid)
+                        tdm = srv.data_manager.table(table, create=False)
+                        assert tdm is not None and \
+                            tdm.current_segment(name) is not None, \
+                            f"routing flipped before {name} loaded on {iid}"
+                inner_commit(table, assignment)
+
+            rb.commit_fn = checked_commit
+            failures, answers, stop = [], [], threading.Event()
+
+            def client():
+                while not stop.is_set():
+                    try:
+                        resp = c.query("SELECT COUNT(*) FROM testTable")
+                        if resp.exceptions:
+                            failures.append(repr(resp.exceptions))
+                        else:
+                            answers.append(resp.rows[0][0])
+                    except Exception as exc:  # noqa: BLE001
+                        failures.append(repr(exc))
+
+            threads = [threading.Thread(target=client) for _ in range(4)]
+            for t in threads:
+                t.start()
+            moves = {segs[i].name: {
+                "from": ["server_0", "server_1"]
+                if i % 2 == 0 else ["server_1", "server_0"],
+                "to": ["server_1", "server_2"]} for i in range(len(segs))}
+            job = rb.run("testTable_OFFLINE", moves)
+            stop.set()
+            for t in threads:
+                t.join()
+            rb.close()
+            assert job.status == "DONE"
+            assert failures == []
+            assert answers and set(answers) == {total}
+            # sources actually drained; the target now serves
+            for seg in segs:
+                assert c.servers[0].data_manager.table(
+                    "testTable_OFFLINE").current_segment(seg.name) is None
+                assert c.servers[2].data_manager.table(
+                    "testTable_OFFLINE").current_segment(seg.name) is not None
+        finally:
+            c.stop()
+
+    def test_kill_server_repair_converges(self, tmp_path):
+        """Kill one server mid-loop: queries keep succeeding through
+        broker failover, the repair checker re-replicates the dead
+        server's segments, and segments_missing_replicas drains to 0.
+        A roomy retry budget covers the burst of simultaneous retries
+        the instant the server dies (4 clients all hit it at once)."""
+        c, segs, total = _mini(
+            tmp_path,
+            config=PinotConfiguration().with_overrides(
+                {"pinot.broker.retry.budget.min": 64.0,
+                 "pinot.broker.retry.budget.cap": 128.0}))
+        reg = MetricsRegistry("controller")
+        try:
+            rb = c.make_rebalancer(journal_path=str(tmp_path / "j"))
+            rb.metrics = reg
+            rep = c.make_repair_checker(rb)
+            rep.metrics = reg
+            rep.grace_s = 0.01
+            failures, answers, stop = [], [], threading.Event()
+
+            def client():
+                while not stop.is_set():
+                    try:
+                        resp = c.query("SELECT COUNT(*) FROM testTable")
+                        if resp.exceptions:
+                            failures.append(repr(resp.exceptions))
+                        else:
+                            answers.append(resp.rows[0][0])
+                    except Exception as exc:  # noqa: BLE001
+                        failures.append(repr(exc))
+
+            threads = [threading.Thread(target=client) for _ in range(4)]
+            for t in threads:
+                t.start()
+            c.kill_server(0)
+            time.sleep(0.05)
+            deadline = time.time() + 15
+            converged = None
+            while time.time() < deadline:
+                out = rep.check_once()
+                missing = reg.sample()["gauges"].get(
+                    'segments_missing_replicas{table="testTable_OFFLINE"}')
+                if out["repaired"] == {} and out["stale"] and missing == 0:
+                    converged = out
+                    break
+                time.sleep(0.02)
+            stop.set()
+            for t in threads:
+                t.join()
+            rb.close()
+            assert converged is not None, "repair did not converge"
+            assert failures == []
+            assert answers and set(answers) == {total}
+            # every segment has `replication` LIVE copies again
+            for seg in c.cluster_state.table_segments("testTable_OFFLINE"):
+                live = [i for i in seg.instances if i != "server_0"]
+                assert len(live) >= 2, (seg.name, seg.instances)
+        finally:
+            c.stop()
+
+
+class TestRetentionClosesTheLoop:
+    def test_expired_segment_stops_serving_and_cache_unaddressable(
+            self, tmp_path):
+        """run_retention purges state AND servers AND routing AND broker
+        caches: the expired rows disappear from results, and the cached
+        whole-table answer is unaddressable (epoch moved), not stale."""
+        from pinot_tpu.cluster.mini import MiniCluster
+        from pinot_tpu.segment.creator import SegmentCreator
+        from pinot_tpu.segment.loader import load_segment
+        now = int(time.time() * 1000)
+        tc = make_config(retention_time_value=1, retention_time_unit="DAYS")
+        schema = make_schema()
+
+        def build(name, ts_base, n=50):
+            cols = {"d": [f"k{i % 5}" for i in range(n)],
+                    "ts": (ts_base + np.arange(n)).astype(np.int64),
+                    "m": np.arange(n).astype(np.int64)}
+            out = str(tmp_path / name)
+            SegmentCreator(tc, schema).build(cols, out, name)
+            return load_segment(out)
+
+        old = build("old", ts_base=now - 3 * 86_400_000)
+        new = build("new", ts_base=now - 1000)
+        c = MiniCluster(num_servers=2, result_cache=True)
+        c.start()
+        c.add_table("rb", time_column="ts", table_config=tc, schema=schema)
+        c.add_segment("rb", old, 0)
+        c.add_segment("rb", new, 1)
+        try:
+            r1 = c.query("SELECT COUNT(*) FROM rb")
+            assert r1.rows[0][0] == 100
+            r2 = c.query("SELECT COUNT(*) FROM rb")  # cached answer
+            assert r2.rows[0][0] == 100
+            removed = c.run_retention(now_ms=now)
+            assert removed == {"rb_OFFLINE": ["old"]}
+            # the expired segment is unloaded everywhere...
+            for s in c.servers:
+                tdm = s.data_manager.table("rb_OFFLINE", create=False)
+                assert tdm is None or tdm.current_segment("old") is None
+            # ...and the post-retention answer reflects it (the cached
+            # 100-row entry went unaddressable with the routing epoch)
+            r3 = c.query("SELECT COUNT(*) FROM rb")
+            assert r3.rows[0][0] == 50
+        finally:
+            c.stop()
+
+
+# ---------------------------------------------------------------------------
+# tier-1 smoke of the acceptance driver
+# ---------------------------------------------------------------------------
+
+class TestRebalanceBenchSmoke:
+    def test_rebalance_bench_smoke(self, tmp_path):
+        """The --rebalance acceptance scenario at smoke scale: live
+        rebalance + kill/repair under a closed query loop with ZERO
+        failed queries, and the same-seed chaos leg replays identical
+        journals (the full-scale bars live in BENCH_rebalance.json)."""
+        import bench
+        out = str(tmp_path / "BENCH_rebalance_smoke.json")
+        bench.rebalance_main(smoke=True, out_path=out)
+        with open(out) as f:
+            data = json.load(f)
+        assert data["smoke"] is True
+        assert data["rebalance"]["failed_queries"] == 0
+        assert data["repair"]["failed_queries"] == 0
+        assert data["repair"]["converged"] is True
+        assert data["determinism"]["journals_identical"] is True
