@@ -39,7 +39,8 @@ from pinot_tpu.analysis.core import (
 
 _KERNEL_MODULES = ("pinot_tpu/ops/kernels.py",
                    "pinot_tpu/ops/startree_device.py",
-                   "pinot_tpu/ops/clp_device.py")
+                   "pinot_tpu/ops/clp_device.py",
+                   "pinot_tpu/ops/collective.py")
 #: modules that own device synchronization — host syncs are their job
 _SYNC_OK = {"pinot_tpu/ops/dispatch.py", "pinot_tpu/ops/engine.py",
             "pinot_tpu/ops/residency.py"}

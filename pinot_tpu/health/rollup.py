@@ -78,6 +78,37 @@ def role_health_summary(role: str, config=None,
     depth = gauges.get("task_queue_depth")
     subsystems["tasks"] = {"ok": True, "queueDepth": depth}
 
+    # HBM plane (report-only): pooled device-tier bytes plus, on a
+    # multi-chip mesh, the per-chip split — admission sheds on the
+    # MOST-loaded chip, so the max/total pair is what an operator needs
+    # to see a skewed mesh before it starts rejecting
+    cache_items = list(_family_items(gauges, "hbm_cache_bytes"))
+    if cache_items:
+        def _device_of(key: str) -> Optional[str]:
+            m = re.search(r'device="([^"]*)"', key)
+            return m.group(1) if m else None
+
+        per_device = {_device_of(k): v for k, v in cache_items
+                      if _device_of(k) is not None}
+        pooled = [v for k, v in cache_items if _device_of(k) is None]
+        resident = {d: v for d, v in
+                    ((_device_of(k), v) for k, v in _family_items(
+                        gauges, "hbm_resident_bytes"))
+                    if d is not None}
+        hbm: dict = {"ok": True,
+                     "totalBytes": int(sum(pooled)) if pooled else
+                     int(sum(per_device.values()))}
+        if per_device:
+            worst = max(per_device, key=per_device.get)
+            hbm["maxDevice"] = worst
+            hbm["maxDeviceBytes"] = int(per_device[worst])
+            hbm["perDeviceBytes"] = {d: int(v) for d, v in
+                                     sorted(per_device.items())}
+            if resident:
+                hbm["residentBytesByDevice"] = {
+                    d: int(v) for d, v in sorted(resident.items())}
+        subsystems["hbm"] = hbm
+
     # deadline pressure: errorCode-250 partials + killed queries as a
     # running total (rates are the history/SLO layer's job)
     killed = sum(v for _k, v in _family_items(counters, "queries_killed"))

@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 
 from pinot_tpu.ops import clp_device
+from pinot_tpu.ops import collective
 from pinot_tpu.ops import dispatch as dispatch_mod
 from pinot_tpu.ops import kernels
 from pinot_tpu.ops import startree_device
@@ -43,6 +44,7 @@ from pinot_tpu.query.results import (
     AggregationResult, ExecutionStats, GroupByResult)
 from pinot_tpu.segment.loader import DataSource, ImmutableSegment
 from pinot_tpu.utils import tracing
+from pinot_tpu.utils.failpoints import fire
 
 MAX_DEVICE_GROUPS = 1 << 20
 #: cap on the [S, G, slots] group-by result buffer (f32/f64 accumulators)
@@ -80,6 +82,11 @@ class TpuOperatorExecutor:
         metrics_labels: labels for the dispatcher's metrics (the server
         passes its instance id)."""
         self._doc_axis = 1
+        #: collective broker merge engages only on an EXPLICIT mesh: the
+        #: implicit >1-device segments mesh below keeps per-segment
+        #: partials so the tier-2 segment cache and stacked-batch dedup
+        #: (both keyed per segment) work exactly as on one device
+        self._explicit_mesh = mesh is not None
         if mesh is not None:
             self._mesh = mesh
             self.devices = list(mesh.devices.flat)
@@ -140,7 +147,8 @@ class TpuOperatorExecutor:
             admission=_cfg.get_bool("pinot.server.hbm.admission.enabled",
                                     True),
             sample_window=_cfg.get_int("pinot.server.hbm.admission.sample"),
-            labels=metrics_labels)
+            labels=metrics_labels,
+            devices=self.devices)
         #: staging lock only: cache mutation (plan/stage/evict) serializes,
         #: but kernel dispatch + result fetch run OUTSIDE it so concurrent
         #: queries overlap their device round trips (the host<->TPU link
@@ -193,6 +201,20 @@ class TpuOperatorExecutor:
             "pinot.server.clp.enabled", True)
         self._clp_resident = _cfg.get_bool(
             "pinot.server.clp.hbm.resident", True)
+        #: collective broker merge (ops/collective.py): on a mesh engine
+        #: the per-segment partial fold becomes one on-device
+        #: psum/pmin/pmax over the whole mesh; the host IndexedTable
+        #: fold stays reachable as the escape hatch when this is off
+        self._collective_merge = _cfg.get_bool(
+            "pinot.server.mesh.collective.merge", True)
+        #: host-factorized global group-key remap params per
+        #: (segment batch, plan) — built once, re-used across queries
+        self._gmap_cache: "OrderedDict[tuple, Any]" = OrderedDict()
+        #: round-robin upload target over the mesh devices: resident
+        #: rows spread across every chip's HBM instead of pooling on
+        #: device 0 (per-chip budgets in ops/residency.py account them)
+        import itertools as _itertools
+        self._row_rr = _itertools.count()
         self._metrics = self._dispatcher._metrics
         self._residency._metrics = self._metrics
 
@@ -397,12 +419,51 @@ class TpuOperatorExecutor:
                 return None
             self._staging_attrs(dsp, stage_info, S=int(num_docs.shape[0]),
                                 D=D, G=G)
+            # collective broker merge (ops/collective.py): fold the
+            # per-segment partials on device — one psum/pmin/pmax over
+            # the whole mesh — instead of shipping [S, ...] rows to the
+            # host IndexedTable fold. Any gate trips back to the
+            # per-segment launch below, metered by reason
+            minfo = None
+            if self._explicit_mesh and len(self.devices) > 1 \
+                    and batchable:
+                if not self._collective_merge:
+                    self._merge_fallback("disabled")
+                else:
+                    chaos = False
+                    try:
+                        fire("server.mesh.collective", table=ctx.table,
+                             mode="agg")
+                    except BaseException:  # noqa: BLE001 — armed chaos
+                        self._merge_fallback("chaos")  # -> host fold
+                        chaos = True
+                    if not chaos:
+                        try:
+                            params, minfo = self._merged_prepare(
+                                segments, plan, params, S_real,
+                                int(num_docs.shape[0]), G)
+                        except _MergeFallback as e:
+                            self._merge_fallback(e.reason)
+                        except Exception:  # noqa: BLE001 — never fail
+                            self._merge_fallback("staging")  # the query
             if slip is not None:
                 slip.add(transfer_bytes=int(
                     residency_mod.transfer_bytes() - xfer0))
         overlap = self._dispatcher.busy_ms() - busy0
         if overlap > 0:
             self._dispatcher.observe("staging_overlap_ms", overlap)
+        G_eff = G
+        if minfo is not None:
+            self._meter("mesh_merge_served")
+            G_eff = minfo["G"]
+            kernel = collective.compiled_merged_kernel(plan, self._mesh)
+            factory = (lambda B, stacked, _p=plan, _m=self._mesh:
+                       collective.compiled_batched_merged_kernel(
+                           _p, _m, B, stacked))
+            dedup_factory = None  # merged in_specs are per-member
+        # the mesh shape rides the coalesce key: launches never pair
+        # across differently-sharded engines (or merged with unmerged)
+        mesh_sig = ("mesh", self._mesh, self._doc_axis, minfo is not None)
         batch_key = None
         if batchable and self._dispatcher.batch_max > 1:
             if self._cross_table and D <= self._doc_bucket_max:
@@ -413,21 +474,154 @@ class TpuOperatorExecutor:
                 # catches per-table variation: LUT cardinality pads, id
                 # dtype width)
                 S = int(num_docs.shape[0])
-                batch_key = (plan, S, D, G, _shape_sig(cols, params))
+                batch_key = (plan, S, D, G_eff, _shape_sig(cols, params),
+                             mesh_sig)
             else:
                 # legacy key: identical staged segment batch only
-                batch_key = (plan, _batch_id(segments), D, G)
+                batch_key = (plan, _batch_id(segments), D, G_eff, mesh_sig)
         launch = Launch(
-            call=lambda: kernel(cols, params, num_docs, D=D, G=G),
+            call=lambda: kernel(cols, params, num_docs, D=D, G=G_eff),
             plan=plan, cols=cols, params=params, num_docs=num_docs,
-            D=D, G=G, batch_key=batch_key,
+            D=D, G=G_eff, batch_key=batch_key,
             cols_key=self._cols_key(segments, plan),
             factory=factory, dedup_factory=dedup_factory,
             collective=self._needs_cpu_ordering(kernel),
             cancel_check=cancel_check,
             site_ctx={"table": ctx.table, "mode": "agg"}, span=dsp,
             slip=slip, docs=sum(s.num_docs for s in segments))
-        return plan, slots_of_fn, S_real, launch
+        return plan, slots_of_fn, S_real, launch, minfo
+
+    # ------------------------------------------------------------------
+    # collective broker merge (ops/collective.py)
+    # ------------------------------------------------------------------
+    #: cap on the host-factorized group-remap params shipped per
+    #: (segment batch, plan) — past this the remap upload would rival
+    #: the partial rows it saves, so the host fold wins
+    GMAP_MAX_BYTES = 1 << 26
+    GMAP_CACHE_ENTRIES = 64
+
+    def _merge_fallback(self, reason: str) -> None:
+        """mesh_merge_fallback{reason=}: why an eligible mesh launch kept
+        the host IndexedTable fold (labeled like startree_fallback)."""
+        if self._metrics is None:
+            return
+        labels = dict(self._labels or {})
+        labels["reason"] = reason
+        self._metrics.add_meter("mesh_merge_fallback", 1, labels=labels)
+
+    def _merged_prepare(self, segments, plan: DevicePlan, params,
+                        S_real: int, S: int, G_local: int):
+        """Gate + group-key factorization for the collective merge.
+        Returns (params with the remap entries merged in, minfo) or
+        raises _MergeFallback(reason). Caller holds the engine lock."""
+        if kernels._value_dtype() == jnp.float32:
+            # merged counts/isum halves sum ACROSS segments: exactness
+            # needs total docs < 2^24 and < 4096 real segments (the
+            # per-segment path only needs it per segment)
+            total = sum(int(seg.num_docs) for seg in segments)
+            if total >= MAX_DOCS_PER_SEGMENT or S_real >= 4096:
+                raise _MergeFallback("precision")
+        if not plan.group_cols:
+            return params, {"S": S, "G": 0}
+        gparams, G_m, n_real, decode = self._merged_group_params(
+            segments, plan, S, G_local)
+        params = dict(params)
+        params.update(gparams)
+        return params, {"S": S, "G": G_m, "n_real": n_real,
+                        "decode": decode}
+
+    def _merged_group_params(self, segments, plan: DevicePlan, S: int,
+                             G_local: int):
+        """Factorize a GLOBAL group-key space once host-side: dictIds
+        and compact codes are segment-local, so the device can only
+        merge groups through a remap to shared indices. Compact plans
+        ship one [S, G_local] code->global table; dense plans ship
+        per-column [S, Cpad] dictId->union-index tables plus the traced
+        [S, k] global strides (mixed radix over UNION cardinalities —
+        stride changes re-upload KBs, never retrace). Cached per
+        (segment batch, plan); returns (params, G pad, real group
+        count, decode info for _assemble_merged)."""
+        key = (_batch_id(segments), plan, S, G_local)
+        ent = self._gmap_cache.get(key)
+        if ent is not None:
+            self._gmap_cache.move_to_end(key)
+            return ent
+        n_slots = max(len(plan.agg_ops), 1)
+        if plan.group_compact:
+            per_seg = []
+            for seg in segments:
+                # lint: unlocked(called from _prepare_agg's merged branch, which runs under the engine RLock)
+                _codes, table = self._segment_gkey_locked(seg, plan)
+                dicts = [seg.data_source(c).dictionary
+                         for c in plan.group_cols]
+                cols_vals = [d.get_values(table[:, j])
+                             for j, d in enumerate(dicts)]
+                per_seg.append([tuple(_py(c[i]) for c in cols_vals)
+                                for i in range(table.shape[0])])
+            union = sorted(set().union(*map(set, per_seg))) \
+                if per_seg else []
+            n_real = len(union)
+            G_m = _pow2(max(n_real, 1), floor=8)
+            if G_m > MAX_DEVICE_GROUPS \
+                    or S * G_m * n_slots * 8 > MAX_GROUP_RESULT_BYTES \
+                    or S * G_local * 4 > self.GMAP_MAX_BYTES:
+                raise _MergeFallback("groups")
+            index = {t: i for i, t in enumerate(union)}
+            gmap = np.zeros((S, G_local), np.int32)
+            for s, tuples in enumerate(per_seg):
+                for code, t in enumerate(tuples):
+                    gmap[s, code] = index[t]
+            gparams = {"gmap": self._put(gmap)}
+            decode = union  # global index -> key value tuple
+        else:
+            unions = []
+            per_col_vals = []
+            for colname in plan.group_cols:
+                vals = []
+                for seg in segments:
+                    card = max(
+                        int(seg.metadata.columns[colname].cardinality), 1)
+                    d = seg.data_source(colname).dictionary
+                    vals.append(np.asarray(
+                        d.get_values(np.arange(card))))
+                per_col_vals.append(vals)
+                unions.append(np.unique(np.concatenate(vals)))
+            cards = [len(u) for u in unions]
+            n_real = 1
+            for c in cards:
+                n_real *= max(c, 1)
+                if n_real > MAX_DEVICE_GROUPS:
+                    raise _MergeFallback("groups")
+            G_m = _pow2(max(n_real, 1), floor=8)
+            gbytes = sum(
+                S * _pow2(max(len(v) for v in vals), floor=8) * 4
+                for vals in per_col_vals)
+            if G_m > MAX_DEVICE_GROUPS \
+                    or S * G_m * n_slots * 8 > MAX_GROUP_RESULT_BYTES \
+                    or gbytes > self.GMAP_MAX_BYTES:
+                raise _MergeFallback("groups")
+            strides = []
+            st = n_real
+            for c in cards:
+                st //= max(c, 1)
+                strides.append(st)
+            gparams = {}
+            for ci, (union, vals) in enumerate(zip(unions,
+                                                   per_col_vals)):
+                Cpad = _pow2(max(len(v) for v in vals), floor=8)
+                gm = np.zeros((S, Cpad), np.int32)
+                for s, v in enumerate(vals):
+                    gm[s, :len(v)] = np.searchsorted(union, v)
+                gparams[f"gmap{ci}"] = self._put(gm)
+            gstride = np.ascontiguousarray(np.broadcast_to(
+                np.asarray(strides, np.int32), (S, len(strides))))
+            gparams["gstride"] = self._put(gstride)
+            decode = (tuple(strides), tuple(cards), tuple(unions))
+        ent = (gparams, G_m, n_real, decode)
+        self._gmap_cache[key] = ent
+        while len(self._gmap_cache) > self.GMAP_CACHE_ENTRIES:
+            self._gmap_cache.popitem(last=False)
+        return ent
 
     # ------------------------------------------------------------------
     # star-tree device leg (ops/startree_device.py)
@@ -554,9 +748,11 @@ class TpuOperatorExecutor:
                 # star-tree queries (same slots/radix, any predicate
                 # constants) share ONE jit(vmap) launch
                 S = int(num_docs.shape[0])
-                batch_key = (plan, S, D, 0, _shape_sig(cols, params))
+                batch_key = (plan, S, D, 0, _shape_sig(cols, params),
+                             ("mesh", self._mesh, self._doc_axis))
             else:
-                batch_key = (plan, _batch_id(segments), D, 0)
+                batch_key = (plan, _batch_id(segments), D, 0,
+                             ("mesh", self._mesh, self._doc_axis))
         # the staged-block identity carries the fitted tree indexes:
         # members whose filters fit DIFFERENT trees of one segment must
         # stack, not share a broadcast block
@@ -678,8 +874,12 @@ class TpuOperatorExecutor:
                 for i, arr, dev in zip(missing, host_rows, uploaded):
                     self._residency.admit(segments[i], "startree",
                                           names[i], dtype_str, dev,
-                                          arr.nbytes)
+                                          arr.nbytes,
+                                          device=self._dev_label(dev))
                     dev_rows[i] = dev
+            if self._mesh is not None and len(self.devices) > 1:
+                anchor = self.devices[0]
+                dev_rows = [jax.device_put(r, anchor) for r in dev_rows]
             assembler = kernels.compiled_row_assembler(
                 S, D, tuple(int(r.shape[0]) for r in dev_rows), dtype_str)
             dev = self._reshard_block(assembler(tuple(dev_rows)))
@@ -761,7 +961,7 @@ class TpuOperatorExecutor:
                                          slip=slip)
                 if prep is None:
                     return [], segments
-                plan, slots_of_fn, S_real, launch = prep
+                plan, slots_of_fn, S_real, launch, minfo = prep
             try:
                 # deadline-bounded: the checker carries the query's
                 # remaining budget; the cap backstops budget-less callers
@@ -774,6 +974,9 @@ class TpuOperatorExecutor:
         if st is not None:
             return startree_device.assemble(segments, ctx, st_plan, needed,
                                             fits, packed), []
+        if minfo is not None:
+            return self._assemble_merged(segments, ctx, plan, packed,
+                                         S_real, slots_of_fn, minfo), []
         results = self._assemble(segments, ctx, plan, packed, S_real, slots_of_fn)
         return results, []
 
@@ -835,13 +1038,18 @@ class TpuOperatorExecutor:
                 if prep is None:
                     out.set_result(([], segments))
                     return
-                plan, slots_of_fn, S_real, launch = prep
+                plan, slots_of_fn, S_real, launch, minfo = prep
                 lfut = self._dispatcher.submit(launch)
 
                 def finish(f):
                     try:
                         # lint: hang(done-callback: f is already resolved)
                         packed = f.result()
+                        if minfo is not None:
+                            out.set_result((self._assemble_merged(
+                                segments, ctx, plan, packed, S_real,
+                                slots_of_fn, minfo), []))
+                            return
                         out.set_result((self._assemble(
                             segments, ctx, plan, packed, S_real,
                             slots_of_fn), []))
@@ -923,9 +1131,11 @@ class TpuOperatorExecutor:
         if batchable and self._dispatcher.batch_max > 1:
             if self._cross_table and D <= self._doc_bucket_max:
                 S = int(num_docs.shape[0])
-                batch_key = (plan, S, D, 0, _shape_sig(cols, params))
+                batch_key = (plan, S, D, 0, _shape_sig(cols, params),
+                             ("mesh", self._mesh, self._doc_axis))
             else:
-                batch_key = (plan, _batch_id(segments), D, 0)
+                batch_key = (plan, _batch_id(segments), D, 0,
+                             ("mesh", self._mesh, self._doc_axis))
         launch = Launch(
             call=lambda: kernel(cols, params, num_docs, D=D),
             plan=plan, cols=cols, params=params, num_docs=num_docs,
@@ -1794,8 +2004,12 @@ class TpuOperatorExecutor:
                 dev = self._put_row(arr)
                 self._residency.admit(seg, f"vmask:{stamps[i]}",
                                       "__valid__", dtype_str, dev,
-                                      arr.nbytes)
+                                      arr.nbytes,
+                                      device=self._dev_label(dev))
                 dev_rows[i] = dev
+            if self._mesh is not None and len(self.devices) > 1:
+                anchor = self.devices[0]
+                dev_rows = [jax.device_put(r, anchor) for r in dev_rows]
             assembler = kernels.compiled_row_assembler(
                 S, D, tuple(int(r.shape[0]) for r in dev_rows), dtype_str)
             dev = self._reshard_block(assembler(tuple(dev_rows)))
@@ -1990,8 +2204,15 @@ class TpuOperatorExecutor:
                 uploaded = [self._put_row(a) for a in host_rows]
             for i, arr, dev in zip(missing, host_rows, uploaded):
                 self._residency.admit(segments[i], kind, col, dtype_str,
-                                      dev, arr.nbytes)
+                                      dev, arr.nbytes,
+                                      device=self._dev_label(dev))
                 dev_rows[i] = dev
+        if self._mesh is not None and len(self.devices) > 1:
+            # resident rows round-robin across chips; the jit'd
+            # assembler needs colocated inputs, so anchor the stack on
+            # device 0 (chip-to-chip copies — never the host link)
+            anchor = self.devices[0]
+            dev_rows = [jax.device_put(r, anchor) for r in dev_rows]
         assembler = kernels.compiled_row_assembler(
             S, D, tuple(int(r.shape[0]) for r in dev_rows), dtype_str)
         return self._reshard_block(assembler(tuple(dev_rows)))
@@ -2024,14 +2245,30 @@ class TpuOperatorExecutor:
         return arr
 
     def _put_row(self, arr: np.ndarray):
-        """Upload ONE residency row to the default device (rows are
-        unsharded; the assembled block is resharded over the mesh). Runs
-        on upload-pool threads for multi-row bursts — pure, touches no
-        engine state."""
+        """Upload ONE residency row. On a multi-chip mesh rows
+        round-robin across the mesh devices so resident bytes (and the
+        per-chip admission pressure they feed) spread instead of piling
+        onto device 0; the assembled block is resharded over the mesh
+        regardless of where its rows live. Runs on upload-pool threads
+        for multi-row bursts — the shared round-robin counter is the
+        only engine state touched (itertools.count is atomic)."""
         from pinot_tpu.ops import residency as residency_mod
         residency_mod.note_transfer(arr.nbytes, column=True)
         self._meter("hbm_transfer_bytes", arr.nbytes)
+        if self._mesh is not None and len(self.devices) > 1:
+            dev = self.devices[next(self._row_rr) % len(self.devices)]
+            return jax.device_put(arr, dev)
         return jnp.asarray(arr)
+
+    @staticmethod
+    def _dev_label(arr) -> str:
+        """`platform:id` label of the device holding a committed row —
+        the key the per-chip residency ledger and `device=` gauges use."""
+        try:
+            d = next(iter(arr.devices()))
+            return f"{d.platform}:{d.id}"
+        except Exception:  # pragma: no cover — non-array stand-ins
+            return "cpu:0"
 
     def _reshard_block(self, dev):
         """Move an assembled single-device block onto the mesh sharding
@@ -2055,6 +2292,23 @@ class TpuOperatorExecutor:
             labels=self._labels)
         self._metrics.set_gauge("host_row_cache_bytes", self._host_bytes,
                                 labels=self._labels)
+        if len(self.devices) > 1:
+            # per-chip split: assembled blocks are sharded evenly over
+            # the mesh (equal per-chip share of _cache_bytes); resident
+            # rows are committed whole to one chip each, so their bytes
+            # attribute exactly (the skew admission control watches)
+            by_dev = self._residency.bytes_by_device()
+            share = self._cache_bytes // len(self.devices)
+            for d in self.devices:
+                lab = f"{d.platform}:{d.id}"
+                labels = dict(self._labels or {})
+                labels["device"] = lab
+                self._metrics.set_gauge(
+                    "hbm_cache_bytes", share + by_dev.get(lab, 0),
+                    labels=labels)
+                self._metrics.set_gauge(
+                    "hbm_resident_bytes", by_dev.get(lab, 0),
+                    labels=labels)
 
     def _insert_block(self, key, entry, nbytes: int) -> None:
         if key not in self._block_cache:
@@ -2423,6 +2677,95 @@ class TpuOperatorExecutor:
             groups[key] = inters
         return GroupByResult(groups, stats)
 
+    def _assemble_merged(self, segments, ctx: QueryContext,
+                         plan: DevicePlan, packed: np.ndarray,
+                         S_real: int, mappings: List[Dict[str, int]],
+                         minfo) -> List[Any]:
+        """ONE result covering the whole segment batch, from the
+        collective-merge kernel's packed row (layout documented in
+        ops/collective.py). The [S] matched tail carries exactly the
+        per-segment facts the host fold would have summed, so the
+        ExecutionStats equal folding the per-segment path's stats."""
+        S = minfo["S"]
+        matched_i = [int(round(float(m)))
+                     for m in np.asarray(packed[-S:][:S_real])]
+        total_matched = sum(matched_i)
+        filter_cols = len(set(ctx.filter_columns()))
+        n_valued_aggs = sum(
+            1 for node in ctx.aggregations
+            if node.args and not (isinstance(node.args[0], Identifier)
+                                  and node.args[0].name == "*"))
+        stats = ExecutionStats(
+            num_docs_scanned=total_matched,
+            num_entries_scanned_in_filter=(
+                sum(seg.num_docs for seg in segments[:S_real])
+                * filter_cols if ctx.filter is not None else 0),
+            num_entries_scanned_post_filter=total_matched * n_valued_aggs,
+            num_segments_processed=S_real,
+            num_segments_matched=sum(1 for m in matched_i if m),
+            total_docs=sum(seg.num_docs for seg in segments[:S_real]))
+        if plan.group_cols:
+            return [self._assemble_merged_group(ctx, plan, packed,
+                                                mappings, minfo, stats)]
+        widths = [kernels.slot_width(op) for op, _v, _f in plan.agg_ops]
+        slot_offsets = np.concatenate(
+            [[0], np.cumsum(widths)]).astype(int)
+        hist_bounds = {
+            j: self._hist_bounds(segments, plan.value_irs[vidx][1])
+            for j, (op, vidx, _f) in enumerate(plan.agg_ops)
+            if op.startswith("hist:")}
+        inters = []
+        for fn, mapping in zip(ctx.agg_functions, mappings):
+            slots = {}
+            for op, j in mapping.items():
+                off = int(slot_offsets[j])  # no leading matched column
+                w = widths[j]
+                plan_op = plan.agg_ops[j][0]
+                if plan_op == "isum":
+                    slots[op] = _isum_value(packed[off:off + w])
+                    continue
+                if plan_op.startswith("isum:u"):
+                    slots[op] = _isum_u_value(packed[off:off + w])
+                    continue
+                slots[op] = packed[off] if w == 1 \
+                    else packed[off:off + w]
+                if op.startswith("hist:"):
+                    lo, span = hist_bounds[j]
+                    slots["hist_lo"] = lo
+                    slots["hist_width"] = span / w
+            inters.append(fn.from_device_slots(slots))
+        return [AggregationResult(inters, stats)]
+
+    def _assemble_merged_group(self, ctx, plan: DevicePlan, packed,
+                               mappings, minfo, stats):
+        G = minfo["G"]
+        n_slots = len(plan.agg_ops)
+        gp = np.asarray(packed[:G * n_slots]).reshape(G, n_slots)
+        count_j = None
+        for j, (op, _vidx, fidx) in enumerate(plan.agg_ops):
+            if op == "count" and fidx is None:
+                count_j = j
+                break
+        assert count_j is not None  # _plan guarantees a count slot
+        present = np.nonzero(gp[:, count_j] > 0)[0]
+        present = present[present < minfo["n_real"]]
+        decode = minfo["decode"]
+        if plan.group_compact:
+            keys = [decode[g] for g in present]
+        else:
+            strides, cards, unions = decode
+            keys = [tuple(_py(unions[ci][(g // strides[ci]) % cards[ci]])
+                          for ci in range(len(plan.group_cols)))
+                    for g in present]
+        groups: Dict[tuple, list] = {}
+        for gi, g in enumerate(present):
+            inters = []
+            for fn, mapping in zip(ctx.agg_functions, mappings):
+                slots = {op: gp[g, j] for op, j in mapping.items()}
+                inters.append(fn.from_device_slots(slots))
+            groups[keys[gi]] = inters
+        return GroupByResult(groups, stats)
+
 
 def _isum_value(planes: np.ndarray) -> float:
     """Rebuild the exact int sum from the isum slot's packed planes
@@ -2475,6 +2818,15 @@ def _shape_sig(cols: Dict[str, Any], params: Dict[str, Any]) -> tuple:
 
 class _NotStageable(Exception):
     pass
+
+
+class _MergeFallback(Exception):
+    """A collective-merge gate tripped; the launch keeps the per-segment
+    kernel and the host fold (reason feeds mesh_merge_fallback)."""
+
+    def __init__(self, reason: str):
+        super().__init__(reason)
+        self.reason = reason
 
 
 def _vrange_bounds(e: Function, vdt=np.float64) -> Tuple[float, float]:

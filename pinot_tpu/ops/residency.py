@@ -98,18 +98,29 @@ class ResidencyManager:
 
     def __init__(self, budget_bytes: int, admission: bool = True,
                  sample_window: int = 4096, metrics=None,
-                 labels: Optional[Dict[str, str]] = None):
+                 labels: Optional[Dict[str, str]] = None,
+                 devices=None):
         self.budget_bytes = max(0, int(budget_bytes))
         self.enabled = self.budget_bytes > 0
         self.admission = bool(admission)
         self.sample_window = max(64, int(sample_window))
+        #: mesh devices (multi-chip engines): a resident row commits
+        #: whole to ONE chip, so the pooled budget splits evenly into
+        #: per-chip shares and eviction/pressure watch the most-loaded
+        #: chip — one hot chip OOMs alone long before the pool looks full
+        self.devices = list(devices) if devices else []
+        n = len(self.devices)
+        self.device_budget_bytes = \
+            self.budget_bytes // n if n > 1 else self.budget_bytes
         self._metrics = metrics
         self._labels = labels
         self._lock = threading.RLock()
-        #: key -> (segment, device row, nbytes); LRU order
-        self._entries: "OrderedDict[tuple, Tuple[Any, Any, int]]" = \
+        #: key -> (segment, device row, nbytes, device label); LRU order
+        self._entries: "OrderedDict[tuple, Tuple[Any, Any, int, str]]" = \
             OrderedDict()
         self._bytes = 0
+        #: device label -> resident bytes (labeled admissions only)
+        self._dev_bytes: Dict[str, int] = {}
         #: (segment name, kind, col) -> access count (TinyLFU sketch —
         #: a plain dict is exact and bounded by the halving pass)
         self._freq: Dict[tuple, int] = {}
@@ -184,11 +195,17 @@ class ResidencyManager:
             return None
 
     def admit(self, seg, kind: str, col: str, dtype_str: str, dev_row,
-              nbytes: int) -> bool:
+              nbytes: int, device: Optional[str] = None) -> bool:
         """Offer an uploaded row for retention. Returns True if resident.
         Rejection never fails the query — the caller keeps its transient
-        reference; the tier just declines to retain the bytes."""
+        reference; the tier just declines to retain the bytes. `device`
+        names the chip holding the row (multi-chip meshes): the row then
+        charges THAT chip's share of the budget, so a skewed chip evicts
+        (or declines) on its own while the others stay warm."""
         if not self.enabled or nbytes > self.budget_bytes:
+            return False
+        dlabel = device or ""
+        if dlabel and nbytes > self.device_budget_bytes:
             return False
         key = self._key(seg, kind, col, dtype_str)
         fkey = self._fkey(seg, kind, col)
@@ -196,26 +213,50 @@ class ResidencyManager:
             old = self._entries.pop(key, None)
             if old is not None:
                 self._bytes -= old[2]
+                if old[3]:
+                    self._dev_bytes[old[3]] -= old[2]
             seeded = self.seeding_active
             cand = self._freq.get(fkey, 0)
-            while self._bytes + nbytes > self.budget_bytes and self._entries:
-                vkey = next(iter(self._entries))
-                vfreq = self._freq.get((vkey[1], vkey[2], vkey[3]), 0)
-                if self.admission and not seeded and cand <= vfreq:
-                    # the victim is at least as hot: decline retention —
-                    # this is what stops a cold scan flushing the
-                    # working set
-                    self.rejected += 1
-                    self._meter("hbm_admission_rejected")
+
+            # the candidate's own chip first: on a mesh the per-chip
+            # share is the binding constraint (a row never spans chips)
+            while dlabel and self._dev_bytes.get(dlabel, 0) + nbytes \
+                    > self.device_budget_bytes:
+                vkey = next((k for k, e in self._entries.items()
+                             if e[3] == dlabel), None)
+                if vkey is None:
+                    break
+                if not self._evict_one_locked(vkey, cand, seeded):
                     return False
-                _vseg, _vdev, vnb = self._entries.pop(vkey)
-                self._bytes -= vnb
-                self.evicted += 1
-                self._meter("hbm_evicted")
-            self._entries[key] = (seg, dev_row, int(nbytes))
+            while self._bytes + nbytes > self.budget_bytes and self._entries:
+                if not self._evict_one_locked(next(iter(self._entries)),
+                                              cand, seeded):
+                    return False
+            self._entries[key] = (seg, dev_row, int(nbytes), dlabel)
             self._bytes += int(nbytes)
+            if dlabel:
+                self._dev_bytes[dlabel] = \
+                    self._dev_bytes.get(dlabel, 0) + int(nbytes)
             self.admitted += 1
             return True
+
+    def _evict_one_locked(self, vkey, cand: int, seeded: bool) -> bool:
+        """TinyLFU duel for one eviction victim (caller holds the lock).
+        Returns False when the victim is at least as hot as the admission
+        candidate — decline retention; this is what stops a cold scan
+        flushing the working set."""
+        vfreq = self._freq.get((vkey[1], vkey[2], vkey[3]), 0)
+        if self.admission and not seeded and cand <= vfreq:
+            self.rejected += 1
+            self._meter("hbm_admission_rejected")
+            return False
+        _vseg, _vdev, vnb, vlab = self._entries.pop(vkey)
+        self._bytes -= vnb
+        if vlab:
+            self._dev_bytes[vlab] -= vnb
+        self.evicted += 1
+        self._meter("hbm_evicted")
+        return True
 
     # -- invalidation ---------------------------------------------------
     def invalidate_segment(self, name: str, keep=None) -> int:
@@ -228,8 +269,10 @@ class ResidencyManager:
             stale = [k for k, e in self._entries.items()
                      if k[1] == name and (keep is None or e[0] is not keep)]
             for k in stale:
-                _seg, _dev, nb = self._entries.pop(k)
+                _seg, _dev, nb, lab = self._entries.pop(k)
                 self._bytes -= nb
+                if lab:
+                    self._dev_bytes[lab] -= nb
                 self.evicted += 1
                 self._meter("hbm_evicted")
             return len(stale)
@@ -247,8 +290,10 @@ class ResidencyManager:
                      if e[0] is seg and k[3] == col
                      and k[2].startswith(kind_prefix) and k[2] != keep_kind]
             for k in stale:
-                _seg, _dev, nb = self._entries.pop(k)
+                _seg, _dev, nb, lab = self._entries.pop(k)
                 self._bytes -= nb
+                if lab:
+                    self._dev_bytes[lab] -= nb
                 self.evicted += 1
                 self._meter("hbm_evicted")
             return len(stale)
@@ -259,12 +304,40 @@ class ResidencyManager:
         with self._lock:
             self._entries.clear()
             self._bytes = 0
+            self._dev_bytes.clear()
 
     # -- introspection --------------------------------------------------
     @property
     def bytes(self) -> int:
         with self._lock:
             return self._bytes
+
+    def bytes_by_device(self) -> Dict[str, int]:
+        """Resident bytes per chip label. Only labeled admissions count —
+        single-device engines never label, so this is empty there."""
+        with self._lock:
+            return dict(self._dev_bytes)
+
+    def max_device_bytes(self) -> int:
+        """The most-loaded chip's resident bytes (pooled bytes when no
+        admission was ever labeled — one device IS the max chip)."""
+        with self._lock:
+            if self._dev_bytes:
+                return max(self._dev_bytes.values())
+            return self._bytes
+
+    def pressure(self) -> float:
+        """Budget fraction the admission plane gates on: the most-loaded
+        chip's fill of its per-chip share on a mesh (one hot chip OOMs
+        alone — the pooled number hides that), the pooled fill
+        otherwise. 0.0 when unbudgeted."""
+        with self._lock:
+            if not self.enabled:
+                return 0.0
+            if self._dev_bytes and self.device_budget_bytes:
+                return max(self._dev_bytes.values()) \
+                    / self.device_budget_bytes
+            return self._bytes / self.budget_bytes
 
     def __len__(self) -> int:
         with self._lock:

@@ -265,19 +265,19 @@ class ServerQueryExecutor:
 
     def memory_pressure(self) -> float:
         """Worst-of memory-pressure fraction across this server's
-        accountings: the HBM residency tier's bytes against its budget,
-        plus every registered source (realtime-ingest bytes against the
-        ingest memory budget, wired by ServerRole). 0.0 when nothing is
-        budgeted — an unbudgeted server never sheds on memory."""
+        accountings: the HBM residency tier's fill — on a multi-chip
+        mesh the MOST-LOADED chip against its per-chip share, not the
+        pooled total (ResidencyManager.pressure) — plus every registered
+        source (realtime-ingest bytes against the ingest memory budget,
+        wired by ServerRole). 0.0 when nothing is budgeted — an
+        unbudgeted server never sheds on memory."""
         worst = 0.0
         # lint: unlocked(reference snapshot; _shared_engine publishes the engine once under its lock and never unsets it)
         engine = self._engine
         res = getattr(engine, "_residency", None) \
             if engine is not None else None
         if res is not None and getattr(res, "enabled", False):
-            budget = getattr(res, "budget_bytes", 0)
-            if budget > 0:
-                worst = max(worst, res.bytes() / budget)
+            worst = max(worst, res.pressure())
         for fn in list(self._pressure_sources):
             try:
                 worst = max(worst, float(fn()))

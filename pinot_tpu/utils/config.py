@@ -64,6 +64,10 @@ KEYS: Dict[str, Any] = {
     "pinot.server.hbm.admission.enabled": True,
     "pinot.server.hbm.admission.sample": 4096,
     "pinot.server.host.row.cache.bytes": 16 << 30,
+    # collective broker merge (ops/collective.py): on a multi-chip mesh
+    # the cross-segment partial fold runs as ONE on-device collective;
+    # False is the escape hatch back to the host IndexedTable fold
+    "pinot.server.mesh.collective.merge": True,
     # star-tree device leg (ops/startree_device.py): fitted aggregations
     # answer from pre-agg records through the kernel factory; .hbm.resident
     # admits the pre-agg pseudo-columns into the resident-row tier

@@ -144,6 +144,11 @@ SITES: Dict[str, str] = {
     "mse.worker.crash":
         "MSE worker kill point: SimulatedCrash vanishes the worker "
         "(mailbox gone, no error frames — receivers must detect)",
+    "server.mesh.collective":
+        "server-side, before the collective-merge path stages a query "
+        "(ctx: table, mode) — an armed error falls back to the host "
+        "IndexedTable fold with mesh_merge_fallback{reason=chaos}; "
+        "seeded decisions journal byte-identical",
 }
 
 

@@ -87,8 +87,19 @@ METRICS: Dict[str, str] = {
         "CLP-column LIKE/regex filters routed to the host decode path "
         "(label reason=disabled|predicate|charWildcard|regex|wildcard|"
         "partial|slots|alignments|staging)",
+    "mesh_merge_served":
+        "mesh queries whose cross-segment partial merge ran as ONE "
+        "on-device collective (no host IndexedTable fold)",
+    "mesh_merge_fallback":
+        "mesh queries routed to the host partial fold (label reason="
+        "disabled|chaos|precision|groups|staging)",
     # -- memory tiers (HBM residency) ------------------------------------
-    "hbm_cache_bytes": "assembled [S, D] block-cache bytes on device",
+    "hbm_cache_bytes":
+        "assembled [S, D] block-cache bytes on device (multi-chip "
+        "engines also emit a per-chip split under a device= label)",
+    "hbm_resident_bytes":
+        "resident-row tier bytes per chip (label device=platform:id — "
+        "the skew the per-chip admission pressure gates on)",
     "hbm_block_hit": "assembled-block cache hits",
     "hbm_block_miss": "assembled-block cache misses",
     "hbm_resident_hit": "resident-row tier hits",
