@@ -4613,6 +4613,463 @@ def main():
     print(json.dumps(out))
 
 
+# ---------------------------------------------------------------------------
+# --vector: ANN top-K as a batched device matmul (ISSUE 20)
+# ---------------------------------------------------------------------------
+
+def vector_main(smoke: bool = False, out_path: str = None):
+    """--vector [--smoke]: the vector-similarity device leg's acceptance
+    driver (ISSUE 20).
+
+    Compute A/B — the same K-nearest query answered two ways: the HOST
+    path walks the segments serially (per-segment VectorIndex.top_k:
+    a [n, d] matmul + full lexsort each) and merges; the DEVICE path is
+    ONE batched einsum + jax.lax.top_k over the staged [S, docs, d]
+    block with a trivial cross-segment merge. Speedup gates at 2x on the
+    CPU stand-in and 5x on a real accelerator (full run only).
+
+    Exact parity — on a table below the IVF threshold the device leg
+    must return doc ids BIT-IDENTICAL to VectorIndex.top_k (both sides
+    break score ties toward the lower doc id by construction).
+
+    Recall — on the IVF table, device answers (nprobe-pruned via the
+    staged cell mask) score recall@K against the exact ground truth
+    computed from the same stored vectors; gate 0.9.
+
+    Coalesce — 8 clients loop fingerprint-equal ANN queries (same
+    col/K/plan, DIFFERENT query vectors — the vectors ride params, not
+    the plan) against one pipelined engine: they must batch into shared
+    jit(vmap) launches (batch max > 1) with ZERO steady-state retraces.
+
+    Writes BENCH_vector.json. --smoke shrinks sizes to tier-1 budget."""
+    import contextlib
+    import statistics as stats
+    import tempfile
+    import threading
+
+    import jax
+
+    from pinot_tpu.models import (DataType, FieldSpec, FieldType, Schema,
+                                  TableConfig)
+    from pinot_tpu.ops import dispatch as dispatch_mod
+    from pinot_tpu.ops import kernels, vector_device
+    from pinot_tpu.ops.engine import TpuOperatorExecutor
+    from pinot_tpu.query.context import QueryContext
+    from pinot_tpu.query.executor import QueryExecutor
+    from pinot_tpu.segment.creator import SegmentCreator
+    from pinot_tpu.segment.loader import load_segment
+    from pinot_tpu.utils.config import PinotConfiguration
+
+    docs_per_seg = 4200 if smoke else 8192   # >= IVF_THRESHOLD: coarse layer
+    num_segments = 2 if smoke else 4
+    d, k = 16, 10
+    p50_iters = 5 if smoke else 25
+    dev_iters = 8 if smoke else 25
+    recall_queries = 8 if smoke else 50
+    window_s = 0.8 if smoke else 2.5
+    clients = 8
+
+    tmp = tempfile.mkdtemp(prefix="bench_vector_")
+
+    # clustered embeddings (a Gaussian mixture), not white noise: IVF
+    # recall on uniform-random data is meaningless — in d=16 the true
+    # neighbor set of a random point scatters across every cell. Real
+    # embedding spaces cluster, which is exactly what the coarse layer
+    # exploits; queries perturb stored vectors (the lookup workload).
+    centers = np.random.default_rng(5999).normal(size=(32, d)) * 2.0
+
+    def build_table(name, n_per_seg, nseg, seed):
+        schema = Schema(name, [
+            FieldSpec("id", DataType.INT, FieldType.DIMENSION),
+            FieldSpec("vec", DataType.STRING, FieldType.DIMENSION)])
+        tc = TableConfig(name=name)
+        tc.indexing.vector_index_columns = ["vec"]
+        creator = SegmentCreator(tc, schema)
+        segs = []
+        for i in range(nseg):
+            rng = np.random.default_rng(seed + i)
+            which = rng.integers(0, len(centers), n_per_seg)
+            vecs = (centers[which]
+                    + 0.3 * rng.normal(size=(n_per_seg, d))
+                    ).astype(np.float32)
+            out = os.path.join(tmp, f"{name}_{i}")
+            creator.build({
+                "id": np.arange(n_per_seg) + i * n_per_seg,
+                "vec": np.array([json.dumps([float(x) for x in row])
+                                 for row in vecs], object),
+            }, out, f"{name}_{i}")
+            segs.append(load_segment(out))
+        return segs
+
+    segs = build_table("emb", docs_per_seg, num_segments, 6000)
+    segs_exact = build_table("embx", 1000, 1, 6100)
+    indexes = [vector_device._index_of(s, "vec") for s in segs]
+    assert all(ix is not None and ix.centroids is not None
+               for ix in indexes), "IVF layer did not engage"
+
+    labels = {"bench_leg": "vector"}
+    eng = TpuOperatorExecutor(config=PinotConfiguration(),
+                              metrics_labels=labels)
+    reg = eng._dispatcher._metrics
+    ex_dev = QueryExecutor(segs, use_tpu=True, engine=eng)
+    ex_host = QueryExecutor(segs, use_tpu=False)
+
+    rng = np.random.default_rng(9)
+
+    def data_query():
+        # perturb a stored (already-normalized) vector — the ANN lookup
+        # workload: the query lives in the indexed embedding space
+        ix = indexes[int(rng.integers(0, num_segments))]
+        base = ix.vectors[int(rng.integers(0, len(ix.vectors)))]
+        return (base + 0.05 * rng.normal(size=d)).astype(np.float32)
+
+    def qsql(qv, table="emb", kk=k, lim=None):
+        lit = json.dumps([float(x) for x in qv])
+        sql = (f"SELECT id FROM {table} "
+               f"WHERE vector_similarity(vec, '{lit}', {kk})")
+        return sql if lim is None else f"{sql} LIMIT {lim}"
+
+    # -- exact parity: device ids bit-identical to VectorIndex.top_k --
+    ex_exact = QueryExecutor(segs_exact, use_tpu=True, engine=eng)
+    ix_exact = vector_device._index_of(segs_exact[0], "vec")
+    assert ix_exact.centroids is None  # exact path
+    for _ in range(5):
+        qv = rng.normal(size=d).astype(np.float32)
+        r = ex_exact.execute(qsql(qv, table="embx"))
+        assert not r.exceptions, r.exceptions
+        got = sorted(row[0] for row in r.rows)
+        want = sorted(int(i) for i in ix_exact.top_k(qv, k))
+        assert got == want, (got, want)
+
+    # -- IVF recall@k vs exact ground truth over the stored vectors.
+    # vector_similarity is a per-segment FILTER (K matches per segment,
+    # host contract) — ground truth is the union of per-segment exact
+    # top-k, and the query's LIMIT spans the whole union.
+    def exact_union(qv, kk):
+        qn = (qv / max(np.linalg.norm(qv), 1e-30)).astype(np.float32)
+        docs = set()
+        for si, ix in enumerate(indexes):
+            sc = ix.vectors @ qn
+            order = np.lexsort((np.arange(len(sc)), -sc))
+            docs |= {si * docs_per_seg + int(t) for t in order[:kk]}
+        return docs
+
+    recalls = []
+    for _ in range(recall_queries):
+        qv = data_query()
+        r = ex_dev.execute(qsql(qv, lim=k * num_segments))
+        assert not r.exceptions, r.exceptions
+        got = {row[0] for row in r.rows}
+        truth = exact_union(qv, k)
+        recalls.append(len(got & truth) / len(truth))
+    recall = float(np.mean(recalls))
+
+    # -- compute A/B: serialized host walk vs one batched launch ------
+    qv0 = data_query()
+    prep = eng._prepare_vector(segs, QueryContext.from_sql(qsql(qv0)),
+                               None)
+    assert prep is not None, "device leg refused the bench query"
+    launch = prep[2]
+    guard = dispatch_mod._CPU_COLLECTIVE_LOCK if launch.collective \
+        else contextlib.nullcontext()
+    with guard:
+        jax.block_until_ready(launch.call())  # warm
+        t0 = time.perf_counter()
+        for _ in range(dev_iters):
+            jax.block_until_ready(launch.call())
+        device_ms = (time.perf_counter() - t0) / dev_iters * 1e3
+
+    def host_walk():
+        cand = []
+        for si, ix in enumerate(indexes):
+            for t in ix.top_k(qv0, k):
+                cand.append(si * docs_per_seg + int(t))
+        return cand
+
+    host_walk()  # warm any lazy state
+    t0 = time.perf_counter()
+    for _ in range(dev_iters):
+        host_walk()
+    host_ms = (time.perf_counter() - t0) / dev_iters * 1e3
+    speedup = host_ms / max(device_ms, 1e-9)
+
+    def p50(ex, sql):
+        lat = []
+        for _ in range(p50_iters):
+            t0 = time.perf_counter()
+            ex.execute(sql)
+            lat.append((time.perf_counter() - t0) * 1e3)
+        return stats.median(lat)
+
+    p50_dev = p50(ex_dev, qsql(qv0))
+    p50_host = p50(ex_host, qsql(qv0))
+
+    # -- coalesce: 8 clients, same plan, different query vectors ------
+    coal_q = [data_query() for _ in range(clients)]
+    for qv in coal_q:          # params-cache every query vector
+        ex_dev.execute(qsql(qv))
+    b = 2
+    while b <= dispatch_mod._pow2(clients):   # warm the batch buckets
+        kern = launch.factory(b, False)
+        with guard:
+            jax.block_until_ready(kern(
+                launch.cols, (launch.params,) * b, launch.num_docs,
+                D=launch.D, G=launch.G))
+        b *= 2
+    traces0 = kernels.trace_count()
+    batch_t0 = reg.timer("dispatch_batch_size", labels=labels)
+    count0, max0 = batch_t0.count, batch_t0.max_ms
+    stop_at = time.perf_counter() + window_s
+    done = [0] * clients
+
+    def client(ci):
+        j = 0
+        while time.perf_counter() < stop_at:
+            ex_dev.execute(qsql(coal_q[(ci + j) % clients]))
+            done[ci] += 1
+            j += 1
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    retraces = kernels.trace_count() - traces0
+    batch_t = reg.timer("dispatch_batch_size", labels=labels)
+    platform = jax.devices()[0].platform
+    gate = 2.0 if platform == "cpu" else 5.0
+    out = {
+        "metric": "vector_device_vs_host_speedup",
+        "value": round(speedup, 2),
+        "unit": "x",
+        "smoke": smoke,
+        "platform": platform,
+        "docs": docs_per_seg * num_segments,
+        "dim": d, "k": k,
+        "device_ms": round(device_ms, 3),
+        "host_walk_ms": round(host_ms, 3),
+        "p50_device_ms": round(p50_dev, 2),
+        "p50_host_ms": round(p50_host, 2),
+        "recall_at_k": round(recall, 3),
+        "vector_served": int(reg.meter("vector_served", labels=labels)),
+        "coalesce": {
+            "clients": clients,
+            "queries_completed": int(sum(done)),
+            "qps": round(sum(done) / wall, 2),
+            "batch_launches": batch_t.count - count0,
+            "batch_size_max": max(batch_t.max_ms, max0),
+            "retraces_steady": retraces,
+        },
+        "asserted": {
+            "exact_parity": "device doc ids == VectorIndex.top_k",
+            "min_recall_at_k": 0.9,
+            "max_steady_retraces": 0,
+            "min_batch_size": 2,
+            "full_run_only": f"device >= {gate}x host "
+                             f"({platform} gate)",
+        },
+    }
+    if out_path is None:
+        out_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "BENCH_vector.json")
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps(out))
+    assert recall >= 0.9, f"IVF recall@{k} = {recall:.3f} < 0.9"
+    assert retraces == 0, f"steady-state retraces: {retraces}"
+    assert out["coalesce"]["batch_size_max"] >= 2, \
+        "fingerprint-equal ANN queries never coalesced"
+    if not smoke:
+        assert speedup >= gate, \
+            f"device {speedup:.2f}x host, below the {gate}x {platform} gate"
+
+
+# ---------------------------------------------------------------------------
+# --timeseries: dashboards as device group-bys (ISSUE 20)
+# ---------------------------------------------------------------------------
+
+def timeseries_main(smoke: bool = False, out_path: str = None):
+    """--timeseries [--smoke]: the device time-bucket leg's acceptance
+    driver (ISSUE 20).
+
+    A/B — the same simpleql dashboard query served (a) through the
+    device group-by kernel with floor((t-start)/step) FUSED into the
+    group key (pinot.server.timeseries.bucket.enabled=true) and (b) by
+    the host expression-column leaf (the pre-ISSUE-20 path, which the
+    device scan leg can't admit). Full run asserts the fused leg wins
+    end-to-end. A sliding-refresh loop (start advances every query, the
+    dashboard steady state) must cause ZERO retraces: start/step/count
+    ride params, only count_pad is in the plan.
+
+    Selfmetrics — the PR-14 dogfood dashboards run end-to-end through
+    the device leg (query_history(use_tpu=True)), making metrics
+    history a third device workload beside queries and log search.
+
+    Writes BENCH_timeseries.json. --smoke shrinks to tier-1 budget."""
+    import statistics as stats
+    import tempfile
+
+    import jax
+
+    from pinot_tpu.models import (DataType, FieldSpec, FieldType, Schema,
+                                  TableConfig)
+    from pinot_tpu.ops import kernels
+    from pinot_tpu.ops.engine import TpuOperatorExecutor
+    from pinot_tpu.query.executor import QueryExecutor
+    from pinot_tpu.segment.creator import SegmentCreator
+    from pinot_tpu.segment.loader import load_segment
+    from pinot_tpu.timeseries.engine import query as ts_query
+    from pinot_tpu.utils.config import PinotConfiguration
+
+    docs_per_seg = 10_000 if smoke else 100_000
+    num_segments = 2 if smoke else 4
+    n_tags = 8
+    # a 30-point dashboard panel: 32-pad buckets x 8 tags = 256 padded
+    # groups — inside the kernel's one-hot/MXU scatter regime on both
+    # backends (the one-hot cost is linear in padded groups, which is
+    # what the CPU stand-in pays; accelerators eat it on the MXU)
+    buckets = 30
+    step = 20
+    t0_, t1 = 100_000, 100_000 + buckets * step
+    p50_iters = 5 if smoke else 20
+    slide_iters = 6 if smoke else 20
+
+    tmp = tempfile.mkdtemp(prefix="bench_ts_")
+    schema = Schema("metrics", [
+        FieldSpec("ts", DataType.LONG, FieldType.DIMENSION),
+        FieldSpec("host", DataType.STRING, FieldType.DIMENSION),
+        FieldSpec("value", DataType.DOUBLE, FieldType.METRIC)])
+    creator = SegmentCreator(TableConfig(name="metrics"), schema)
+    segs = []
+    for i in range(num_segments):
+        rng = np.random.default_rng(7000 + i)
+        out_dir = os.path.join(tmp, f"m_{i}")
+        creator.build({
+            "ts": rng.integers(t0_, t1, docs_per_seg),
+            "host": np.array([f"h{v}" for v in
+                              rng.integers(0, n_tags, docs_per_seg)],
+                             object),
+            "value": rng.normal(size=docs_per_seg),
+        }, out_dir, f"m_{i}")
+        segs.append(load_segment(out_dir))
+
+    labels = {"bench_leg": "ts"}
+    eng_dev = TpuOperatorExecutor(config=PinotConfiguration(),
+                                  metrics_labels=labels)
+    eng_off = TpuOperatorExecutor(
+        config=PinotConfiguration(overrides={
+            "pinot.server.timeseries.bucket.enabled": False}),
+        metrics_labels={"bench_leg": "ts_off"})
+    reg = eng_dev._dispatcher._metrics
+    ex_dev = QueryExecutor(segs, use_tpu=True, engine=eng_dev)
+    ex_off = QueryExecutor(segs, use_tpu=True, engine=eng_off)
+
+    def dash(start):
+        return (f"fetch(metrics, value, ts, {start}, {t1}, {step}) "
+                f"| groupby(host) | sum(host) | keep_last_value()")
+
+    # -- parity: fused bucket leg == expression-column leaf -----------
+    served0 = reg.meter("timeseries_leaf_device", labels=labels)
+    bd = ts_query(dash(t0_), ex_dev)
+    bh = ts_query(dash(t0_), ex_off)
+    assert reg.meter("timeseries_leaf_device", labels=labels) > served0, \
+        "bucket group-by did not serve through the device leg"
+    dd = {s.tag_key(): s.values for s in bd.series}
+    hh = {s.tag_key(): s.values for s in bh.series}
+    assert set(dd) == set(hh), "series sets diverge"
+    for key in dd:
+        # f32 device sums of SIGNED values: cancellation makes relative
+        # error meaningless near zero, hence the atol floor
+        np.testing.assert_allclose(
+            dd[key], hh[key], rtol=1e-3, atol=1e-3, equal_nan=True)
+
+    # -- sliding refresh: params move, the kernel must not retrace ----
+    traces0 = kernels.trace_count()
+    for j in range(slide_iters):
+        ts_query(dash(t0_ + (j % 4) * step), ex_dev)
+    slide_retraces = kernels.trace_count() - traces0
+
+    def p50(ex):
+        lat = []
+        for _ in range(p50_iters):
+            t0 = time.perf_counter()
+            ts_query(dash(t0_), ex)
+            lat.append((time.perf_counter() - t0) * 1e3)
+        return stats.median(lat)
+
+    p50_dev = p50(ex_dev)
+    p50_off = p50(ex_off)
+
+    # -- selfmetrics dashboards through the device leg ----------------
+    from pinot_tpu.health.history import MetricsHistory, MetricsSampler
+    from pinot_tpu.health.selfmetrics import query_history
+    from pinot_tpu.utils.metrics import MetricsRegistry
+    role = "bench-ts"
+    sreg = MetricsRegistry(role)
+    hist = MetricsHistory(64)
+    sampler = MetricsSampler(role, history=hist, registry=sreg)
+    base = 1_000_000
+    for i in range(20):
+        sreg.add_meter("queries", 3)
+        s = sampler.sample_once()
+        s["ts"] = base + i
+    served0 = reg.meter("timeseries_leaf_device", labels=labels)
+    block = query_history(
+        f"fetch(selfmetrics, value, ts, {base}, {base + 20}, 1) "
+        f"| where(family = 'queries') | sum() | rate()",
+        role=role, history=hist, use_tpu=True, engine=eng_dev)
+    assert block.series and np.allclose(block.series[0].values[1:], 3.0)
+    selfm_device = reg.meter("timeseries_leaf_device",
+                             labels=labels) > served0
+
+    platform = jax.devices()[0].platform
+    leaf_gate = 1.1 if platform == "cpu" else 2.0
+    out = {
+        "metric": "timeseries_device_vs_expression_leaf_p50",
+        "value": round(p50_off / max(p50_dev, 1e-9), 2),
+        "unit": "x",
+        "smoke": smoke,
+        "platform": platform,
+        "docs": docs_per_seg * num_segments,
+        "buckets": buckets, "tags": n_tags,
+        "p50_device_ms": round(p50_dev, 2),
+        "p50_expression_leaf_ms": round(p50_off, 2),
+        "slide_retraces": slide_retraces,
+        "selfmetrics_device": bool(selfm_device),
+        "timeseries_leaf_device": int(
+            reg.meter("timeseries_leaf_device", labels=labels)),
+        "asserted": {
+            "parity": "fused bucket leg == expression leaf "
+                      "(1e-3 rel, 1e-3 abs — f32 signed sums)",
+            "max_slide_retraces": 0,
+            "selfmetrics_device": True,
+            "full_run_only": f"device >= {leaf_gate}x expression leaf "
+                             f"({platform} gate)",
+        },
+    }
+    if out_path is None:
+        out_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "BENCH_timeseries.json")
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps(out))
+    assert slide_retraces == 0, \
+        f"sliding refresh retraced {slide_retraces}x"
+    assert selfm_device, \
+        "selfmetrics dashboard bypassed the device bucket leg"
+    if not smoke:
+        ratio = p50_off / max(p50_dev, 1e-9)
+        assert ratio >= leaf_gate, \
+            f"device {p50_dev:.2f}ms only {ratio:.2f}x the expression " \
+            f"leaf ({p50_off:.2f}ms), below the {leaf_gate}x " \
+            f"{platform} gate"
+
+
 if __name__ == "__main__":
     if "--deadline-overhead" in sys.argv:
         deadline_overhead_main()
@@ -4642,5 +5099,9 @@ if __name__ == "__main__":
         rebalance_main(smoke="--smoke" in sys.argv)
     elif "--mesh" in sys.argv:
         mesh_main(smoke="--smoke" in sys.argv)
+    elif "--vector" in sys.argv:
+        vector_main(smoke="--smoke" in sys.argv)
+    elif "--timeseries" in sys.argv:
+        timeseries_main(smoke="--smoke" in sys.argv)
     else:
         main()

@@ -40,7 +40,9 @@ from pinot_tpu.analysis.core import (
 _KERNEL_MODULES = ("pinot_tpu/ops/kernels.py",
                    "pinot_tpu/ops/startree_device.py",
                    "pinot_tpu/ops/clp_device.py",
-                   "pinot_tpu/ops/collective.py")
+                   "pinot_tpu/ops/collective.py",
+                   "pinot_tpu/ops/vector_device.py",
+                   "pinot_tpu/ops/timeseries_device.py")
 #: modules that own device synchronization — host syncs are their job
 _SYNC_OK = {"pinot_tpu/ops/dispatch.py", "pinot_tpu/ops/engine.py",
             "pinot_tpu/ops/residency.py"}

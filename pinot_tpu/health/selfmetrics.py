@@ -104,12 +104,16 @@ def materialize_segment(out_dir: str, role: str = "server",
 
 def query_history(simpleql: str, role: str = "server",
                   history: Optional[MetricsHistory] = None,
-                  window_s: Optional[float] = None):
+                  window_s: Optional[float] = None,
+                  use_tpu: bool = False, engine=None):
     """Answer a simpleql query over the role's own metrics history:
     materialize the ring into a throwaway segment and run the
     time-series plan through the regular single-process executor (the
     engine's leaf bridge — full SQL pushdown, device offload when the
-    shape qualifies). Returns a TimeSeriesBlock."""
+    shape qualifies). Pass ``use_tpu=True`` (or an existing ``engine``)
+    to route the dashboard's bucket group-by through the device
+    time-bucket leg as a third tenant-isolated workload beside queries
+    and log search. Returns a TimeSeriesBlock."""
     from pinot_tpu.query.executor import QueryExecutor
     from pinot_tpu.timeseries.engine import query as ts_query
 
@@ -117,7 +121,7 @@ def query_history(simpleql: str, role: str = "server",
     try:
         seg = materialize_segment(tmp, role=role, history=history,
                                   window_s=window_s)
-        ex = QueryExecutor([seg], use_tpu=False)
+        ex = QueryExecutor([seg], use_tpu=use_tpu, engine=engine)
         return ts_query(simpleql, ex)
     finally:
         shutil.rmtree(tmp, ignore_errors=True)

@@ -34,6 +34,8 @@ from pinot_tpu.ops import collective
 from pinot_tpu.ops import dispatch as dispatch_mod
 from pinot_tpu.ops import kernels
 from pinot_tpu.ops import startree_device
+from pinot_tpu.ops import timeseries_device
+from pinot_tpu.ops import vector_device
 from pinot_tpu.ops.dispatch import KernelDispatcher, Launch
 from pinot_tpu.ops.plan_ir import DeviceLeaf, DevicePlan
 from pinot_tpu.query.context import QueryContext
@@ -201,6 +203,20 @@ class TpuOperatorExecutor:
             "pinot.server.clp.enabled", True)
         self._clp_resident = _cfg.get_bool(
             "pinot.server.clp.hbm.resident", True)
+        #: vector-similarity device leg (ops/vector_device.py): ANN
+        #: top-K as one batched matmul + lax.top_k over staged vector
+        #: blocks; hbm.resident admits the __vec__ pseudo-columns into
+        #: the per-(segment, column) residency tier
+        self._vector_enabled = _cfg.get_bool(
+            "pinot.server.vector.enabled", True)
+        self._vector_resident = _cfg.get_bool(
+            "pinot.server.vector.hbm.resident", True)
+        #: time-series device bucket leg (ops/timeseries_device.py):
+        #: floor((t - start) / step) group-bys fuse the bucket id into
+        #: the group-by kernel's scatter key instead of falling back to
+        #: the host expression-column path
+        self._ts_bucket_enabled = _cfg.get_bool(
+            "pinot.server.timeseries.bucket.enabled", True)
         #: collective broker merge (ops/collective.py): on a mesh engine
         #: the per-segment partial fold becomes one on-device
         #: psum/pmin/pmax over the whole mesh; the host IndexedTable
@@ -265,9 +281,20 @@ class TpuOperatorExecutor:
                     return False
             if node.name == "countmv":
                 return False
-        for g in ctx.group_by:
-            if not isinstance(g, Identifier):
-                return False
+        for i, g in enumerate(ctx.group_by):
+            if isinstance(g, Identifier):
+                continue
+            if (i == 0 and self._ts_bucket_enabled
+                    and not self._explicit_mesh and self._doc_axis == 1
+                    and timeseries_device.extract_bucket(g) is not None):
+                # time-series leaf shape: the leading floor((t-start)/
+                # step) group-by fuses into the kernel's scatter key
+                # (detailed window/metadata admission happens in _plan).
+                # The implicit >1-device segments mesh keeps per-segment
+                # partials through the SAME group-by kernel, so it
+                # qualifies; the explicit collective-merge mesh does not
+                continue
+            return False
         if ctx.filter is not None and not self._filter_shape_ok(ctx.filter):
             return False
         return True
@@ -292,6 +319,12 @@ class TpuOperatorExecutor:
         OrderByCombineOperator)."""
         if ctx.distinct or ctx.aggregations:
             return False
+        if ctx.filter is not None \
+                and vector_device.contains_vector(ctx.filter):
+            # ANN leg: vector_similarity is not a scan-filter leaf — it
+            # routes to the vector kernel (plan-time fallback with a
+            # metered reason keeps host parity on every miss)
+            return ctx.limit + ctx.offset > 0
         if len(ctx.order_by) > 1:
             return False
         if ctx.filter is None and not ctx.order_by:
@@ -687,6 +720,263 @@ class TpuOperatorExecutor:
             self._clp_fallback(reason)
             return None
         return DeviceLeaf("clp", col, meta)
+
+    # ------------------------------------------------------------------
+    # vector-similarity device leg (ops/vector_device.py)
+    # ------------------------------------------------------------------
+    def _vector_fallback(self, reason: str) -> None:
+        """vector_fallback{reason=}: why a vector_similarity query left
+        the device path for the host index search — vocabulary in
+        vector_device.FALLBACK_REASONS."""
+        if self._metrics is None:
+            return
+        labels = dict(self._labels or {})
+        labels["reason"] = reason
+        self._metrics.add_meter("vector_fallback", labels=labels)
+
+    def _plan_vector(self, segments, ctx: QueryContext):
+        """(VectorPlan, (vector fn, qvec, k), residual ctx) when the ANN
+        query admits the device path; (None, reason, None) otherwise.
+        The residual ctx carries the non-vector conjuncts ONLY — _stage's
+        leaf-expression walk must see exactly the tree the plan's leaves
+        were built from, and vector_similarity is not a leaf."""
+        if not self._vector_enabled:
+            return None, "disabled", None
+        fn, residual, reason = vector_device.split_filter(ctx.filter)
+        if fn is None:
+            return None, reason, None
+        if ctx.order_by:
+            # score order is implicit in the kernel; an explicit ORDER BY
+            # key on top would need a second sort the leg doesn't do
+            return None, "hybrid", None
+        try:
+            col, qvec, k = vector_device.parse_args(fn)
+        except (ValueError, TypeError):
+            return None, "hybrid", None
+        shape, reason = vector_device.admit(
+            segments, col, qvec, k, self.TOPN_MAX_K)
+        if shape is None:
+            return None, reason, None
+        dim_pad, ivf, cells_pad = shape
+        seg0 = segments[0]
+        classify, dict_cols, raw_cols = self._make_classifier(seg0)
+        leaves: List[DeviceLeaf] = []
+        filter_ir = None
+        if residual is not None:
+            filter_ir = self._build_filter_ir(residual, segments, leaves,
+                                              classify)
+            if filter_ir is None:
+                return None, "hybrid", None
+        raw64 = {lf.column for lf in leaves if lf.kind == "vrange64"}
+        plan = vector_device.VectorPlan(
+            col=col, dim_pad=dim_pad,
+            k_pad=vector_device._pow2(k), ivf=ivf, cells_pad=cells_pad,
+            filter_ir=filter_ir, leaves=tuple(leaves),
+            dict_cols=tuple(sorted(dict_cols)),
+            raw_cols=tuple(sorted(raw_cols - raw64)),
+            raw64_cols=tuple(sorted(raw64)),
+            clp_cols=clp_device.staged_cols(leaves),
+            valid_mask=self._needs_valid_mask(segments))
+        rctx = QueryContext(
+            table=ctx.table, select=ctx.select, aliases=ctx.aliases,
+            distinct=False, filter=residual, group_by=[], having=None,
+            order_by=[], limit=ctx.limit, offset=ctx.offset,
+            options=ctx.options)
+        return plan, (fn, qvec, k), rctx
+
+    def _stage_vector_locked(self, segments, rctx: QueryContext, plan,
+                             fn, qvec, k, batchable: bool = True):
+        """Residual-filter staging via the generic _stage (the VectorPlan
+        duck-types DevicePlan for every field it reads), plus the vector
+        block / IVF cell pseudo-columns and the per-QUERY params. Query
+        params cache under their own key — the vector fn expression, not
+        the residual filter — so two queries sharing a residual but not
+        a query vector can never alias."""
+        cols, params, num_docs, S_real, D, _G = self._stage(
+            segments, rctx, plan, batchable=batchable)
+        S = int(num_docs.shape[0])
+        dim_pad = plan.dim_pad
+        row_lens = tuple(_pow2(s.num_docs) * dim_pad for s in segments)
+        cols["vec:" + plan.col] = self._vec_block_locked(
+            segments, S, D * dim_pad, plan.col, "block",
+            (lambda seg: vector_device.vector_row(
+                seg, plan.col, dim_pad, _pow2(seg.num_docs))),
+            np.float32, row_lens)
+        if plan.ivf:
+            cols["vcell:" + plan.col] = self._vec_block_locked(
+                segments, S, D, plan.col, "cells",
+                (lambda seg: vector_device.cell_row(
+                    seg, plan.col, _pow2(seg.num_docs))),
+                np.int32, tuple(_pow2(s.num_docs) for s in segments))
+        pkey = (_batch_id(segments), plan, fn, "__vec__", S)
+        cached = self._params_cache.get(pkey)
+        if cached is not None:
+            csegs, cparams, _cnd = cached
+            if all(a is b for a, b in zip(csegs, segments)):
+                self._params_cache.move_to_end(pkey)
+                params.update(cparams)
+                return cols, params, num_docs, S_real, D
+        qp = vector_device.query_params(segments, plan, qvec, k, S)
+        vparams = {key: self._put(arr) for key, arr in qp.items()}
+        params.update(vparams)
+        self._params_cache[pkey] = (tuple(segments), vparams, num_docs)
+        self._params_cache.move_to_end(pkey)
+        while len(self._params_cache) > self.PARAMS_CACHE_ENTRIES:
+            self._params_cache.popitem(last=False)
+        return cols, params, num_docs, S_real, D
+
+    def _vec_block_locked(self, segments, S, W, col, leg, fetch, dtype,
+                          row_lens):
+        """One staged [S, W] vector pseudo-column block
+        (`(segment, "__vec__/<col>/<leg>")`), mirroring _st_block_locked:
+        per-segment rows pad to their OWN pow2 doc bucket (times dim_pad
+        for the flattened vector leg) so every batch composition shares
+        the resident rows; the on-device assembler pads the tail to W.
+        Residency admission honors pinot.server.vector.hbm.resident."""
+        dtype_str = np.dtype(dtype).str
+        bkey = (_batch_id(segments), "vector", (col, leg), S, W, dtype_str)
+        entry = self._block_cache.get(bkey)
+        if entry is not None and all(a is b
+                                     for a, b in zip(entry[0], segments)):
+            self._block_cache.move_to_end(bkey)
+            self._meter("hbm_block_hit")
+            return entry[1]
+        self._meter("hbm_block_miss")
+        name = f"__vec__/{col}/{leg}"
+        if self._residency.enabled and self._vector_resident:
+            dev_rows: List[Any] = []
+            missing: List[int] = []
+            for seg in segments:
+                row = self._residency.get(seg, "vector", name, dtype_str)
+                dev_rows.append(row)
+                if row is None:
+                    missing.append(len(dev_rows) - 1)
+            if missing:
+                host_rows = [self._host_row(
+                    segments[i], name, "vector", fetch, dtype,
+                    pad_to=row_lens[i]) for i in missing]
+                if len(host_rows) > 1 and sum(
+                        a.nbytes for a in host_rows
+                ) >= self.UPLOAD_FANOUT_BYTES:
+                    futs = [dispatch_mod.upload_pool().submit(
+                        self._put_row, a) for a in host_rows]
+                    uploaded = [dispatch_mod.wait_result(
+                        f, max_wait_s=self.LAUNCH_WAIT_CAP_S)
+                        for f in futs]
+                else:
+                    uploaded = [self._put_row(a) for a in host_rows]
+                for i, arr, dev in zip(missing, host_rows, uploaded):
+                    self._residency.admit(segments[i], "vector", name,
+                                          dtype_str, dev, arr.nbytes,
+                                          device=self._dev_label(dev))
+                    dev_rows[i] = dev
+            if self._mesh is not None and len(self.devices) > 1:
+                anchor = self.devices[0]
+                dev_rows = [jax.device_put(r, anchor) for r in dev_rows]
+            assembler = kernels.compiled_row_assembler(
+                S, W, tuple(int(r.shape[0]) for r in dev_rows), dtype_str)
+            dev = self._reshard_block(assembler(tuple(dev_rows)))
+            nbytes = S * W * np.dtype(dtype).itemsize
+        else:
+            rows = [self._host_row(seg, name, "vector", fetch, dtype,
+                                   pad_to=W)
+                    for seg in segments]
+            block = np.stack(rows) if len(rows) == S else \
+                np.concatenate([np.stack(rows),
+                                np.zeros((S - len(rows), W), dtype=dtype)])
+            dev = self._put(block, block=True)
+            nbytes = block.nbytes
+        self._insert_block(bkey, (tuple(segments), dev), nbytes)
+        return dev
+
+    def _prepare_vector(self, segments, ctx: QueryContext, cancel_check):
+        """Plan + stage an ANN launch through the kernel factory: the
+        launch carries the same (plan fingerprint, shape bucket) coalesce
+        key as scans, and the query vector/topK ride params — so
+        fingerprint-equal concurrent ANN queries (different vectors, same
+        shape) batch into ONE jit(vmap) launch. Returns
+        (plan, S_real, Launch) or None -> host path (reason metered)."""
+        from pinot_tpu.ops import residency as residency_mod
+        from pinot_tpu.utils import accounting
+        dsp = None
+        parent_span = tracing.capture()
+        slip = accounting.current_slip()
+        if parent_span is not None:
+            dsp = parent_span.child("DeviceDispatch", table=ctx.table,
+                                    mode="vector")
+        with self._engine_lock:
+            xfer0 = residency_mod.transfer_bytes() if slip is not None else 0
+            stage_info = self._staging_snapshot(dsp)
+            plan, qinfo, rctx = self._plan_vector(segments, ctx)
+            if plan is None:
+                self._vector_fallback(qinfo)
+                if dsp is not None:
+                    dsp.end(outcome="hostFallback", reason=qinfo)
+                return None
+            fn, qvec, k = qinfo
+            kernel = vector_device.compiled_vector_kernel(plan)
+            batchable = isinstance(kernel, jax.stages.Wrapped)
+            try:
+                cols, params, num_docs, S_real, D = \
+                    self._stage_vector_locked(segments, rctx, plan, fn,
+                                              qvec, k, batchable=batchable)
+            except _NotStageable:
+                self._vector_fallback("staging")
+                if dsp is not None:
+                    dsp.end(outcome="hostFallback", reason="staging")
+                return None
+            self._staging_attrs(dsp, stage_info, S=int(num_docs.shape[0]),
+                                D=D)
+            if slip is not None:
+                slip.add(transfer_bytes=int(
+                    residency_mod.transfer_bytes() - xfer0))
+        self._meter("vector_served")
+        batch_key = None
+        if batchable and self._dispatcher.batch_max > 1:
+            if self._cross_table and D <= self._doc_bucket_max:
+                S = int(num_docs.shape[0])
+                batch_key = (plan, S, D, 0, _shape_sig(cols, params),
+                             ("mesh", self._mesh, self._doc_axis))
+            else:
+                batch_key = (plan, _batch_id(segments), D, 0,
+                             ("mesh", self._mesh, self._doc_axis))
+        launch = Launch(
+            call=lambda: kernel(cols, params, num_docs, D=D),
+            plan=plan, cols=cols, params=params, num_docs=num_docs,
+            D=D, G=0, batch_key=batch_key,
+            cols_key=self._cols_key(segments, plan),
+            factory=(lambda B, stacked, _p=plan:
+                     vector_device.compiled_batched_vector_kernel(
+                         _p, B, stacked)),
+            collective=self._needs_cpu_ordering(kernel),
+            cancel_check=cancel_check,
+            site_ctx={"table": ctx.table, "mode": "vector"}, span=dsp,
+            slip=slip, docs=sum(s.num_docs for s in segments))
+        return plan, S_real, launch
+
+    def _execute_vector(self, segments, ctx: QueryContext,
+                        cancel_check=None):
+        """ANN leg of _execute_topn. Host fallback keeps exact parity:
+        query/filter._vector_similarity_mask serves any batch this
+        returns unserved."""
+        fire("server.vector.search", table=ctx.table)
+        if self._doc_axis > 1:
+            self._vector_fallback("staging")
+            return [], segments
+        prep = self._prepare_vector(segments, ctx, cancel_check)
+        if prep is None:
+            return [], segments
+        plan, S_real, launch = prep
+        with self._dispatcher.active():
+            try:
+                packed = dispatch_mod.wait_result(
+                    self._dispatcher.submit(launch), launch.cancel_check,
+                    max_wait_s=self.LAUNCH_WAIT_CAP_S)
+            finally:
+                if launch.span is not None:
+                    launch.span.end()
+        return vector_device.assemble(segments, ctx, plan,
+                                      np.asarray(packed), S_real), []
 
     def _prepare_startree(self, segments: List[ImmutableSegment],
                           ctx: QueryContext, cancel_check=None,
@@ -1150,6 +1440,9 @@ class TpuOperatorExecutor:
         return S_real, launch
 
     def _execute_topn(self, segments, ctx: QueryContext, cancel_check=None):
+        if ctx.filter is not None \
+                and vector_device.contains_vector(ctx.filter):
+            return self._execute_vector(segments, ctx, cancel_check)
         if self._doc_axis > 1:
             return [], segments  # top-K across doc shards: host path
         prep = self._prepare_topn(segments, ctx, cancel_check, "topn")
@@ -1352,9 +1645,29 @@ class TpuOperatorExecutor:
         group_strides: List[int] = []
         num_groups = 0
         group_compact = False
+        tbucket: Tuple = ()
         if ctx.group_by:
+            gb = list(ctx.group_by)
+            tb_spec = None
+            if gb and not isinstance(gb[0], Identifier):
+                # leading floor((t - start) / step): the fused device
+                # time-bucket leg (supports() admitted the shape; the
+                # window/metadata admission happens here). The bucket id
+                # becomes the key's LOWEST digit, so count_pad seeds the
+                # mixed radix ahead of the tag cardinalities.
+                tb_spec = timeseries_device.plan_bucket(
+                    gb[0], ctx.filter, segments)
+                if tb_spec is None:
+                    return None
+                if any(ir is not None and tb_spec.col in self._ir_cols(ir)
+                       for ir in value_irs):
+                    # the timestamp stages ONLY as split planes once the
+                    # bucket leg claims it — it can't also feed a value IR
+                    return None
+                tbucket = (tb_spec.col, tb_spec.count_pad)
+                gb = gb[1:]
             card_pads = []
-            for g in ctx.group_by:
+            for g in gb:
                 col = g.name  # Identifier, checked in supports
                 if not classify(col):
                     return None
@@ -1365,9 +1678,13 @@ class TpuOperatorExecutor:
                            for seg in segments)
                 group_cols.append(col)
                 card_pads.append(max(card, 1))
-            num_groups = 1
+            num_groups = tb_spec.count_pad if tb_spec is not None else 1
             for c in card_pads:
                 num_groups *= c
+            if tb_spec is not None and num_groups > MAX_DEVICE_GROUPS:
+                # compact per-segment keys can't carry the fused bucket
+                # digit — an over-wide dashboard stays on the host path
+                return None
             if num_groups > MAX_DEVICE_GROUPS:
                 # sparse key space: per-segment compacted keys replace the
                 # dense mixed-radix product (ref DictionaryBasedGroupKey
@@ -1399,6 +1716,10 @@ class TpuOperatorExecutor:
 
         raw64 = {lf.column for lf in leaves
                  if lf.kind == "vrange64"} | hll_cols
+        if tbucket:
+            # the bucket kernel reads the timestamp's (hi, lo) planes
+            # regardless of how its range leaf classified
+            raw64 |= {tbucket[0]}
         if group_compact:
             # the gkey block replaces per-column id planes for group-only
             # columns; keep ids only where filters/values still need them
@@ -1421,6 +1742,7 @@ class TpuOperatorExecutor:
             raw64_cols=tuple(sorted(raw64)),
             clp_cols=clp_device.staged_cols(leaves),
             valid_mask=self._needs_valid_mask(segments),
+            tbucket=tbucket,
         )
         return plan, slots_of_fn
 
@@ -1780,7 +2102,8 @@ class TpuOperatorExecutor:
         # the entry also carries hist slot bounds — they depend only on
         # (segments, plan), so a repeat query uploads NOTHING)
         pkey = (_batch_id(segments), plan, ctx.filter,
-                tuple(ctx.agg_filters), S)
+                tuple(ctx.agg_filters), S,
+                tuple(ctx.group_by) if plan.tbucket else None)
         cached = self._params_cache.get(pkey)
         if cached is not None:
             csegs, cparams, cnum_docs = cached
@@ -1789,7 +2112,20 @@ class TpuOperatorExecutor:
                 params.update(cparams)
                 if plan.clp_cols:
                     self._meter("clp_served")
+                if plan.tbucket:
+                    self._meter("timeseries_leaf_device")
                 return cols, params, cnum_docs, S_real, D, G
+        if plan.tbucket:
+            # fused time-bucket cells: start's (hi, lo) planes + step +
+            # live bucket count — the ONLY things that change across a
+            # dashboard's sliding refresh window (pkey carries group_by
+            # above: same filter + different bucket expr must not alias)
+            spec = timeseries_device.plan_bucket(
+                ctx.group_by[0], ctx.filter, segments)
+            if spec is None or spec.count_pad != plan.tbucket[1]:
+                raise _NotStageable()
+            for key, arr in timeseries_device.leaf_params(spec, S).items():
+                params[key] = self._put(arr)
         # histogram sketch slots: bucket bounds from segment metadata
         # (missing min/max -> host fallback)
         for j, (op, vidx, _fidx) in enumerate(plan.agg_ops):
@@ -1897,13 +2233,15 @@ class TpuOperatorExecutor:
         num_docs[:S_real] = [s.num_docs for s in segments]
         num_docs_dev = self._put(num_docs)
         leaf_params = {k: v for k, v in params.items()
-                       if k.startswith(("leaf", "slot"))}
+                       if k.startswith(("leaf", "slot", "tb:"))}
         self._params_cache[pkey] = (tuple(segments), leaf_params, num_docs_dev)
         self._params_cache.move_to_end(pkey)
         while len(self._params_cache) > self.PARAMS_CACHE_ENTRIES:
             self._params_cache.popitem(last=False)  # evict coldest only
         if plan.clp_cols:
             self._meter("clp_served")
+        if plan.tbucket:
+            self._meter("timeseries_leaf_device")
         return cols, params, num_docs_dev, S_real, D, G
 
     # ------------------------------------------------------------------
@@ -2645,6 +2983,7 @@ class TpuOperatorExecutor:
         present = np.nonzero(packed[s, :, count_j] > 0)[0]
 
         dicts = [seg.data_source(c).dictionary for c in plan.group_cols]
+        buckets = None
         if plan.group_compact:
             # compacted codes -> per-column dictIds via the decode table
             _codes, table = self._segment_gkey(seg, plan)
@@ -2660,16 +2999,26 @@ class TpuOperatorExecutor:
             for stride in plan.group_strides:
                 ids_per_col.append(rem // stride)
                 rem = rem % stride
+            if plan.tbucket:
+                # the fused time bucket is the key's lowest digit: after
+                # peeling every tag stride, the remainder IS the bucket
+                buckets = rem
             valid = np.ones(len(present), dtype=bool)
             for ids, card in zip(ids_per_col, cards):
                 valid &= ids < card
             present = present[valid]
             ids_per_col = [ids[valid] for ids in ids_per_col]
+            if buckets is not None:
+                buckets = buckets[valid]
 
         key_cols = [d.get_values(ids) for d, ids in zip(dicts, ids_per_col)]
         groups: Dict[tuple, list] = {}
         for gi, g in enumerate(present):
             key = tuple(_py(col[gi]) for col in key_cols)
+            if buckets is not None:
+                # host parity: floor() over the f64 division yields a
+                # float group key
+                key = (float(buckets[gi]),) + key
             inters = []
             for fn, mapping in zip(ctx.agg_functions, mappings):
                 slots = {op: packed[s, g, j] for op, j in mapping.items()}

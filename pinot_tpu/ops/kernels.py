@@ -22,7 +22,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from pinot_tpu.ops import clp_device
+from pinot_tpu.ops import clp_device, timeseries_device
 from pinot_tpu.ops.plan_ir import DeviceLeaf, DevicePlan
 
 # group-by cardinality below which the one-hot matmul path (MXU-friendly)
@@ -493,6 +493,18 @@ def _compute_slots(plan: DevicePlan, cols, params, valid, G: int = 0):
             keys = jnp.zeros(valid.shape, dtype=jnp.int32)
             for col, stride in zip(plan.group_cols, plan.group_strides):
                 keys = keys + cols["ids:" + col] * jnp.int32(stride)
+        if plan.tbucket:
+            # fused time bucket: floor((t - start) / step) from the
+            # (hi, lo) raw64 planes becomes the key's lowest digit;
+            # out-of-window rows gate out of every slot (their wrapped
+            # deltas never reach the scatter)
+            tcol, count_pad = plan.tbucket
+            b, tgate = timeseries_device.bucket_ids(
+                cols["valhi:" + tcol], cols["vallo:" + tcol],
+                params["tb:shi"], params["tb:slo"],
+                params["tb:step"], params["tb:count"], count_pad)
+            keys = keys + b
+            mask = mask & tgate
         for op, vidx, fidx in plan.agg_ops:
             vals = None if vidx is None else values[vidx]
             m = mask if fidx is None else mask & agg_masks[fidx]

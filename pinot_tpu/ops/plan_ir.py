@@ -92,3 +92,11 @@ class DevicePlan:
     #: rows are invisible to every slot exactly as the host executor's
     #: `mask &= valid.to_mask()` makes them (SURVEY §2.3)
     valid_mask: bool = False
+    #: device time-bucket leg (ops/timeseries_device.py): (ts_col,
+    #: count_pad) — floor((t - start) / step) fused into the group key
+    #: as its LOWEST digit (count_pad is the pow2 bucket of the window's
+    #: bucket count, so it multiplies into num_groups ahead of the tag
+    #: radices). start/step/count ride params ('tb:*' i32 cells), NOT
+    #: the plan, so a dashboard's sliding refresh window re-stages four
+    #: scalar rows instead of retracing the kernel.
+    tbucket: Tuple = ()

@@ -25,6 +25,13 @@ import numpy as np
 _HDR = struct.Struct("<IIIf")  # n, dim, n_cells, pad
 
 
+class VectorIndexCorruption(ValueError):
+    """A serialized vector index whose declared sizes disagree with the
+    payload actually present (torn write, truncated download, bit rot).
+    Typed so loaders can distinguish 'this segment file is damaged' from
+    a plain bad-argument ValueError."""
+
+
 def _normalize(v: np.ndarray) -> np.ndarray:
     n = np.linalg.norm(v, axis=-1, keepdims=True)
     return v / np.maximum(n, 1e-30)
@@ -92,16 +99,33 @@ class VectorIndex:
             scores = self.vectors @ q
             cand = np.arange(len(scores))
         else:
-            cell_scores = self.centroids @ q
-            probe = np.argsort(cell_scores)[::-1][:nprobe]
-            cand = np.nonzero(np.isin(self.assignments, probe))[0]
-            if len(cand) == 0:
+            probe = self.probe_cells(q, nprobe)
+            if probe is None:
                 cand = np.arange(len(self.vectors))
+            else:
+                cand = np.nonzero(np.isin(self.assignments, probe))[0]
             scores = self.vectors[cand] @ q
         k = min(k, len(cand))
-        top = np.argpartition(scores, -k)[-k:]
-        top = top[np.argsort(scores[top])[::-1]]
-        return cand[top].astype(np.int32)
+        # score-descending, ties toward the LOWER doc id: deterministic
+        # regardless of partition order, and bit-identical to the device
+        # kernel's jax.lax.top_k tie-break
+        order = np.lexsort((cand, -scores))
+        return cand[order[:k]].astype(np.int32)
+
+    def probe_cells(self, query, nprobe: int = 8) -> Optional[np.ndarray]:
+        """The coarse cells an IVF search would scan for this query
+        (score-descending argsort over the centroids), or None when the
+        probe set would be empty-candidate and search falls back to ALL
+        cells — shared by top_k and the device leg's staged cell mask so
+        probe selection is host-parity by construction."""
+        if self.centroids is None:
+            return None
+        q = np.asarray(query, dtype=np.float32).ravel()
+        cell_scores = self.centroids @ q
+        probe = np.argsort(cell_scores)[::-1][:nprobe]
+        if not np.isin(self.assignments, probe).any():
+            return None
+        return probe
 
     # ------------------------------------------------------------------
     def to_bytes(self) -> bytes:
@@ -118,7 +142,23 @@ class VectorIndex:
     @classmethod
     def from_bytes(cls, buf) -> "VectorIndex":
         buf = bytes(buf)
+        if len(buf) < _HDR.size + 1:
+            raise VectorIndexCorruption(
+                f"vector index payload truncated: {len(buf)} bytes is "
+                f"shorter than the {_HDR.size + 1}-byte header")
         n, d, ncells, _ = _HDR.unpack_from(buf, 0)
+        # the header is DECLARED sizes — validate against the bytes
+        # actually present before any frombuffer slices past the end
+        # (np would raise an opaque ValueError on a torn payload, or
+        # silently mis-shape on a short-but-aligned one)
+        need = _HDR.size + 1 + 4 * n * d
+        if ncells:
+            need += 4 * ncells * d + 4 * n
+        if len(buf) < need:
+            raise VectorIndexCorruption(
+                f"vector index payload truncated: header declares "
+                f"n={n} d={d} n_cells={ncells} ({need} bytes), got "
+                f"{len(buf)}")
         pos = _HDR.size
         metric = "cosine" if buf[pos:pos + 1] == b"C" else "l2"
         pos += 1

@@ -10,6 +10,7 @@ pinot-timeseries-m3ql's pipe syntax. The builtin language:
       [ | sum() | avg() | min() | max() ]        # cross-series, drop tags
       [ | sum(tag) ... ]                          # cross-series, keep tags
       [ | keep_last_value() | scale(x) | rate() ] # per-series transforms
+      [ | gapfill(c) | interpolate() ]            # NaN-bucket fills
 
 Leaf fetches ride the regular query engine (SQL GROUP BY over the time
 bucket + tags — device offload included when the engine supports the
@@ -22,12 +23,14 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from pinot_tpu.timeseries import gapfill
 from pinot_tpu.timeseries.spi import (BaseTimeSeriesPlanNode,
                                       LeafTimeSeriesPlanNode, TimeBuckets,
                                       TimeSeries, TimeSeriesAggregationNode,
                                       TimeSeriesBlock,
                                       TimeSeriesTransformNode,
                                       register_language)
+from pinot_tpu.utils.failpoints import fire
 
 
 def execute_plan(node: BaseTimeSeriesPlanNode, executor) -> TimeSeriesBlock:
@@ -42,7 +45,20 @@ def execute_plan(node: BaseTimeSeriesPlanNode, executor) -> TimeSeriesBlock:
     raise ValueError(f"unknown plan node {type(node).__name__}")
 
 
+def _leaf_group_cap(executor) -> int:
+    """The `pinot.timeseries.leaf.max.groups` knob: per-bucket group-row
+    ceiling on one leaf fetch. Reads the executor's config when it
+    carries one; otherwise a default PinotConfiguration (which still
+    honors PINOT_TPU_* env overrides)."""
+    cfg = getattr(executor, "config", None)
+    if cfg is None:
+        from pinot_tpu.utils.config import PinotConfiguration
+        cfg = PinotConfiguration()
+    return cfg.get_int("pinot.timeseries.leaf.max.groups")
+
+
 def _execute_leaf(node: LeafTimeSeriesPlanNode, executor) -> TimeSeriesBlock:
+    fire("timeseries.leaf.fetch", table=node.table)
     b = node.buckets
     bucket_expr = (f"floor(({node.time_column} - {b.start}) / {b.step})")
     tags = list(node.group_by_tags)
@@ -53,7 +69,7 @@ def _execute_leaf(node: LeafTimeSeriesPlanNode, executor) -> TimeSeriesBlock:
     if node.filter_sql:
         where += f" AND ({node.filter_sql})"
     group = ", ".join([bucket_expr] + tags)
-    limit = b.count * 10_000
+    limit = b.count * _leaf_group_cap(executor)
     # fetch limit+1 so exactly-limit results are distinguishable from
     # truncation
     sql = (f"SELECT {', '.join(select)} FROM {node.table} "
@@ -88,50 +104,44 @@ def _execute_leaf(node: LeafTimeSeriesPlanNode, executor) -> TimeSeriesBlock:
 
 def _aggregate(block: TimeSeriesBlock,
                node: TimeSeriesAggregationNode) -> TimeSeriesBlock:
-    groups: Dict[Tuple, List[TimeSeries]] = {}
-    for s in block.series:
+    if not block.series:
+        return TimeSeriesBlock(block.buckets, [])
+    # one scatter-accumulate over the whole [series, buckets] stack
+    # (timeseries/gapfill.py) instead of a vstack per group
+    uniq: Dict[Tuple, int] = {}
+    gids = np.empty(len(block.series), np.int64)
+    for i, s in enumerate(block.series):
         key = tuple((t, s.tags.get(t)) for t in node.by_tags)
-        groups.setdefault(key, []).append(s)
-    out = []
-    for key, members in groups.items():
-        stack = np.vstack([m.values for m in members])
-        with np.errstate(all="ignore"):
-            if node.agg == "sum":
-                vals = np.nansum(stack, axis=0)
-                vals[np.all(np.isnan(stack), axis=0)] = np.nan
-            elif node.agg == "avg":
-                vals = np.nanmean(stack, axis=0)
-            elif node.agg == "min":
-                vals = np.nanmin(stack, axis=0)
-            elif node.agg == "max":
-                vals = np.nanmax(stack, axis=0)
-            else:
-                raise ValueError(f"unknown series agg {node.agg!r}")
-        out.append(TimeSeries(tags=dict(key), values=vals))
+        gids[i] = uniq.setdefault(key, len(uniq))
+    stacked = np.vstack([s.values for s in block.series])
+    vals = gapfill.aggregate(stacked, gids, len(uniq), node.agg)
+    out = [TimeSeries(tags=dict(key), values=vals[g])
+           for key, g in uniq.items()]
     return TimeSeriesBlock(block.buckets, out)
 
 
 def _transform(block: TimeSeriesBlock,
                node: TimeSeriesTransformNode) -> TimeSeriesBlock:
-    out = []
-    for s in block.series:
-        v = s.values.copy()
-        if node.fn == "keep_last_value":
-            last = np.nan
-            for i in range(len(v)):
-                if np.isnan(v[i]):
-                    v[i] = last
-                else:
-                    last = v[i]
-        elif node.fn == "scale":
-            v = v * (node.arg if node.arg is not None else 1.0)
-        elif node.fn == "rate":
-            # per-second first derivative over the bucket step
-            dv = np.diff(v, prepend=np.nan)
-            v = dv / block.buckets.step
-        else:
-            raise ValueError(f"unknown transform {node.fn!r}")
-        out.append(TimeSeries(tags=dict(s.tags), values=v))
+    if not block.series:
+        return TimeSeriesBlock(block.buckets, [])
+    # every transform is one vectorized pass over the stacked grid
+    stacked = np.vstack([s.values for s in block.series])
+    if node.fn == "keep_last_value":
+        stacked = gapfill.keep_last_value(stacked)
+    elif node.fn == "gapfill":
+        stacked = gapfill.gapfill(
+            stacked, node.arg if node.arg is not None else 0.0)
+    elif node.fn == "interpolate":
+        stacked = gapfill.interpolate(stacked)
+    elif node.fn == "scale":
+        stacked = stacked * (node.arg if node.arg is not None else 1.0)
+    elif node.fn == "rate":
+        # per-unit first derivative over the bucket step
+        stacked = gapfill.rate(stacked, block.buckets.step)
+    else:
+        raise ValueError(f"unknown transform {node.fn!r}")
+    out = [TimeSeries(tags=dict(s.tags), values=stacked[i])
+           for i, s in enumerate(block.series)]
     return TimeSeriesBlock(block.buckets, out)
 
 
@@ -139,16 +149,58 @@ def _transform(block: TimeSeriesBlock,
 # builtin 'simpleql' pipe language (the m3ql-plugin analog)
 # ---------------------------------------------------------------------------
 
-_STAGE_RX = re.compile(r"(\w+)\s*\(([^)]*)\)\s*$")
+_STAGE_NAME_RX = re.compile(r"(\w+)\s*\(")
+
+
+def _split_top(text: str, sep: str) -> List[str]:
+    """Split on `sep` only at paren depth 0 — a where() predicate like
+    `host = 'a(1)' AND floor(x / 2) > 1` must stay one stage, and its
+    function-call commas one argument (the old `[^)]*` regex stopped at
+    the FIRST close paren and broke both)."""
+    parts: List[str] = []
+    depth = 0
+    cur: List[str] = []
+    for ch in text:
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        if ch == sep and depth == 0:
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    parts.append("".join(cur))
+    return parts
+
+
+def _parse_stage(raw: str) -> Tuple[str, str]:
+    """(name, argstr) from `name( ... )` with balanced parens."""
+    s = raw.strip()
+    m = _STAGE_NAME_RX.match(s)
+    if m is None or not s.endswith(")"):
+        raise ValueError(f"bad simpleql stage {raw!r}")
+    inner = s[m.end():-1]
+    depth = 0
+    for ch in inner:
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+            if depth < 0:
+                break
+    if depth != 0:
+        raise ValueError(f"unbalanced parens in simpleql stage {raw!r}")
+    return m.group(1), inner
 
 
 def _parse_simpleql(text: str, _ctx=None) -> BaseTimeSeriesPlanNode:
-    stages = [s.strip() for s in text.split("|")]
-    m = _STAGE_RX.match(stages[0])
-    if m is None or m.group(1) != "fetch":
+    stages = [s.strip() for s in _split_top(text, "|")]
+    name, argstr = _parse_stage(stages[0])
+    if name != "fetch":
         raise ValueError("simpleql must start with fetch(table, metric, "
                          "time_col, start, end, step)")
-    args = [a.strip() for a in m.group(2).split(",")]
+    args = [a.strip() for a in _split_top(argstr, ",")]
     if len(args) != 6:
         raise ValueError("fetch needs 6 arguments")
     table, metric, time_col = args[0], args[1], args[2]
@@ -159,13 +211,12 @@ def _parse_simpleql(text: str, _ctx=None) -> BaseTimeSeriesPlanNode:
     filter_sql: Optional[str] = None
     plan_stages = []
     for raw in stages[1:]:
-        m = _STAGE_RX.match(raw)
-        if m is None:
-            raise ValueError(f"bad simpleql stage {raw!r}")
-        name = m.group(1)
-        args = [a.strip() for a in m.group(2).split(",") if a.strip()]
+        name, argstr = _parse_stage(raw)
+        args = [a.strip() for a in _split_top(argstr, ",") if a.strip()]
         if name == "where":
-            filter_sql = m.group(2).strip()
+            # the predicate rides verbatim into the leaf SQL — commas
+            # and parens inside it are the SQL's business, not ours
+            filter_sql = argstr.strip()
         elif name == "groupby":
             group_tags = tuple(args)
         else:
@@ -177,11 +228,14 @@ def _parse_simpleql(text: str, _ctx=None) -> BaseTimeSeriesPlanNode:
         if name in ("sum", "avg", "min", "max"):
             node = TimeSeriesAggregationNode(node, agg=name,
                                              by_tags=tuple(args))
-        elif name in ("keep_last_value", "rate"):
+        elif name in ("keep_last_value", "rate", "interpolate"):
             node = TimeSeriesTransformNode(node, fn=name)
         elif name == "scale":
             node = TimeSeriesTransformNode(
                 node, fn="scale", arg=float(args[0]) if args else 1.0)
+        elif name == "gapfill":
+            node = TimeSeriesTransformNode(
+                node, fn="gapfill", arg=float(args[0]) if args else 0.0)
         else:
             raise ValueError(f"unknown simpleql stage {name!r}")
     return node

@@ -79,6 +79,19 @@ KEYS: Dict[str, Any] = {
     # pseudo-columns into the resident-row tier
     "pinot.server.clp.enabled": True,
     "pinot.server.clp.hbm.resident": True,
+    # vector-similarity device leg (ops/vector_device.py): ANN top-K as
+    # a batched matmul over staged vector blocks; .hbm.resident admits
+    # the __vec__ pseudo-columns into the resident-row tier
+    "pinot.server.vector.enabled": True,
+    "pinot.server.vector.hbm.resident": True,
+    # time-series device leg (ops/timeseries_device.py): fuse
+    # floor((t-start)/step) into the group-by kernel's key instead of
+    # falling back to the host expression path
+    "pinot.server.timeseries.bucket.enabled": True,
+    # time-series leaf fetch cap: a leaf SQL may return at most
+    # count * this many group rows before the engine fails loud
+    # (silent truncation would corrupt downstream sums)
+    "pinot.timeseries.leaf.max.groups": 10_000,
     "pinot.server.segment.cache.enabled": True,   # tier-2 partial cache
     "pinot.server.segment.cache.bytes": 256 << 20,
     "pinot.server.segment.cache.ttl.seconds": 300.0,

@@ -149,6 +149,16 @@ SITES: Dict[str, str] = {
         "(ctx: table, mode) — an armed error falls back to the host "
         "IndexedTable fold with mesh_merge_fallback{reason=chaos}; "
         "seeded decisions journal byte-identical",
+    "server.vector.search":
+        "server-side, as a vector_similarity top-K enters the device "
+        "leg (ctx: table) — an armed error surfaces as a query "
+        "exception (the broker's retry/hedge machinery owns recovery); "
+        "seeded decisions journal for byte-identical replay",
+    "timeseries.leaf.fetch":
+        "time-series engine, before a leaf plan node issues its "
+        "GROUP-BY SQL (ctx: table) — an armed error fails that panel's "
+        "fetch whole, never a half-filled bucket grid; seeded "
+        "decisions journal for byte-identical replay",
 }
 
 

@@ -87,6 +87,17 @@ METRICS: Dict[str, str] = {
         "CLP-column LIKE/regex filters routed to the host decode path "
         "(label reason=disabled|predicate|charWildcard|regex|wildcard|"
         "partial|slots|alignments|staging)",
+    "vector_served":
+        "vector_similarity top-K queries answered by the device "
+        "batched-matmul leg",
+    "vector_fallback":
+        "vector_similarity queries routed to the host index scan "
+        "(label reason=disabled|noIndex|metric|hybrid|staging|"
+        "precision)",
+    "timeseries_leaf_device":
+        "leaf group-bys whose time bucket fused into the device "
+        "group-by kernel (ops/timeseries_device.py) instead of the "
+        "host expression path",
     "mesh_merge_served":
         "mesh queries whose cross-segment partial merge ran as ONE "
         "on-device collective (no host IndexedTable fold)",

@@ -1,0 +1,407 @@
+"""Vector-similarity device leg (ISSUE 20): ANN as batched matmul.
+
+  * parity — `WHERE vector_similarity(col, qvec, K)` answers through the
+    device einsum + lax.top_k kernel BIT-IDENTICALLY to the host
+    VectorIndex.top_k walk (exact tables), including hybrid residual
+    conjuncts and IVF-pruned tables (probe selection is host-parity by
+    construction); served queries meter `vector_served`
+  * K-before-filter contract — the K winners are chosen over ALL docs
+    and the residual predicate intersects AFTER selection: a filter that
+    drops a winner SHRINKS the result, it never promotes the (K+1)-th
+    nearest (the host _vector_similarity_mask contract, pinned on both
+    paths)
+  * fallbacks — disabled knob / OR shapes / ORDER BY / missing index /
+    non-cosine metric route to the host path with EXACT structured
+    `vector_fallback{reason=}` meters; answers stay correct
+  * retraces — the query vector and topK ride staged params, never the
+    plan: fingerprint-equal ANN queries with fresh vectors replay ONE
+    compiled kernel (ZERO steady-state retraces)
+  * serialization — VectorIndex.to_bytes/from_bytes round-trips exactly
+    (cells included); torn payloads raise the typed
+    VectorIndexCorruption instead of reshaping garbage
+  * failpoints — `server.vector.search` arms with ctx matching and a
+    seeded decision schedule that replays exactly
+  * bench smoke — the --vector acceptance scenario rides tier-1 at
+    smoke scale (recall gate, coalesce batching, zero retraces)
+"""
+import json
+import os
+import types
+
+import numpy as np
+import pytest
+
+from pinot_tpu.models import (DataType, FieldSpec, FieldType, Schema,
+                              TableConfig)
+from pinot_tpu.ops import kernels, vector_device
+from pinot_tpu.ops.engine import TpuOperatorExecutor
+from pinot_tpu.query.executor import QueryExecutor
+from pinot_tpu.query.expressions import Function, Identifier, Literal
+from pinot_tpu.segment.creator import SegmentCreator
+from pinot_tpu.segment.loader import load_segment
+from pinot_tpu.segment.vector_index import (VectorIndex,
+                                            VectorIndexCorruption)
+from pinot_tpu.utils.config import PinotConfiguration
+from pinot_tpu.utils.failpoints import failpoints
+
+DIM = 8
+K = 5
+N_PER_SEG = 400
+N_SEG = 2
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoints.clear()
+    yield
+    failpoints.clear()
+
+
+def _vec_json(row):
+    return json.dumps([float(x) for x in row])
+
+
+def _build_segs(tmp, name, n_per_seg, nseg, seed=7, d=DIM):
+    """Clustered embeddings (Gaussian mixture) — the workload the IVF
+    coarse layer is built for; exact tables just stay under the
+    threshold."""
+    centers = np.random.default_rng(100).normal(size=(8, d)) * 2.0
+    schema = Schema(name, [
+        FieldSpec("id", DataType.INT, FieldType.DIMENSION),
+        FieldSpec("vec", DataType.STRING, FieldType.DIMENSION)])
+    tc = TableConfig(name=name)
+    tc.indexing.vector_index_columns = ["vec"]
+    creator = SegmentCreator(tc, schema)
+    segs = []
+    for i in range(nseg):
+        rng = np.random.default_rng(seed + i)
+        which = rng.integers(0, len(centers), n_per_seg)
+        vecs = (centers[which] + 0.3 * rng.normal(size=(n_per_seg, d))
+                ).astype(np.float32)
+        out = os.path.join(str(tmp), f"{name}_{i}")
+        creator.build({
+            "id": np.arange(n_per_seg) + i * n_per_seg,
+            "vec": np.array([_vec_json(r) for r in vecs], object),
+        }, out, f"{name}_{i}")
+        segs.append(load_segment(out))
+    return segs
+
+
+@pytest.fixture(scope="module")
+def segs(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("vecsegs")
+    return _build_segs(tmp, "emb", N_PER_SEG, N_SEG)
+
+
+def _engine(name, **overrides):
+    return TpuOperatorExecutor(
+        config=PinotConfiguration(overrides=overrides),
+        metrics_labels={"vec_test": name})
+
+
+def _meter(eng, name, reason=None):
+    labels = {"vec_test": eng._labels["vec_test"]}
+    if reason is not None:
+        labels["reason"] = reason
+    return eng._metrics.meter(name, labels=labels)
+
+
+def _query(rng, segs):
+    """Perturb a stored vector — the ANN lookup workload."""
+    ix = vector_device._index_of(
+        segs[int(rng.integers(0, len(segs)))], "vec")
+    base = ix.vectors[int(rng.integers(0, len(ix.vectors)))]
+    return (base + 0.05 * rng.normal(size=DIM)).astype(np.float32)
+
+
+def _sql(qv, kk=K, table="emb", lim=100):
+    return (f"SELECT id FROM {table} "
+            f"WHERE vector_similarity(vec, '{_vec_json(qv)}', {kk}) "
+            f"LIMIT {lim}")
+
+
+def _ids(resp):
+    assert not resp.exceptions, resp.exceptions
+    return sorted(int(r[0]) for r in resp.result_table.rows)
+
+
+# ---------------------------------------------------------------------------
+# device/host parity
+# ---------------------------------------------------------------------------
+class TestDeviceHostParity:
+    def test_exact_parity_and_meter(self, segs):
+        eng = _engine("parity")
+        dev = QueryExecutor(segs, use_tpu=True, engine=eng)
+        host = QueryExecutor(segs, use_tpu=False)
+        rng = np.random.default_rng(42)
+        for i in range(6):
+            qv = _query(rng, segs)
+            sql = _sql(qv)
+            got = _ids(dev.execute(sql))
+            assert got == _ids(host.execute(sql)), sql
+            # bit-identical to the index's own answer: per-segment K
+            # union (vector_similarity is a per-segment FILTER)
+            want = sorted(
+                int(ix.top_k(qv, K)[j]) + s * N_PER_SEG
+                for s, ix in enumerate(
+                    vector_device._index_of(seg, "vec") for seg in segs)
+                for j in range(K))
+            assert got == want, sql
+        assert _meter(eng, "vector_served") == 6
+
+    def test_hybrid_residual_parity(self, segs):
+        eng = _engine("hybrid_ok")
+        dev = QueryExecutor(segs, use_tpu=True, engine=eng)
+        host = QueryExecutor(segs, use_tpu=False)
+        rng = np.random.default_rng(43)
+        for cut in (120, 500, 790):
+            qv = _query(rng, segs)
+            sql = (f"SELECT id FROM emb WHERE id < {cut} AND "
+                   f"vector_similarity(vec, '{_vec_json(qv)}', {K}) "
+                   f"LIMIT 100")
+            assert _ids(dev.execute(sql)) == _ids(host.execute(sql)), sql
+        assert _meter(eng, "vector_served") == 3
+        assert _meter(eng, "vector_fallback", reason="hybrid") == 0
+
+    def test_k_before_filter_contract(self, segs):
+        """Satellite: the residual predicate intersects AFTER the K
+        winners are chosen. Dropping the nearest doc via the filter
+        SHRINKS the result to K-1 — the (K+1)-th nearest is NEVER
+        promoted — on the host path and the device path alike."""
+        seg0 = segs[0]
+        ix = vector_device._index_of(seg0, "vec")
+        qv = ix.vectors[17].astype(np.float32)
+        exact = ix.top_k(qv, K + 1)   # K winners + the would-be promotee
+        winners, runner_up = exact[:K], int(exact[K])
+        drop = int(winners[0])
+        sql = (f"SELECT id FROM emb WHERE id != {drop} AND "
+               f"vector_similarity(vec, '{_vec_json(qv)}', {K}) "
+               f"LIMIT 100")
+        host = QueryExecutor([seg0], use_tpu=False)
+        eng = _engine("kbefore")
+        dev = QueryExecutor([seg0], use_tpu=True, engine=eng)
+        want = sorted(int(i) for i in winners if int(i) != drop)
+        assert len(want) == K - 1
+        assert runner_up not in want
+        assert _ids(host.execute(sql)) == want
+        assert _ids(dev.execute(sql)) == want
+        assert _meter(eng, "vector_served") == 1
+
+    def test_ivf_pruned_parity(self, segs, monkeypatch, tmp_path):
+        """With the coarse layer engaged (threshold lowered so the
+        build stays test-sized), the device's staged probe-cell mask
+        answers exactly like VectorIndex.top_k's nprobe walk — probe
+        selection runs through the SAME probe_cells on both paths."""
+        monkeypatch.setattr(VectorIndex, "IVF_THRESHOLD", 64)
+        ivf_segs = _build_segs(tmp_path, "embivf", 256, 2, seed=50)
+        for seg in ivf_segs:
+            assert vector_device._index_of(
+                seg, "vec").centroids is not None
+        eng = _engine("ivf")
+        dev = QueryExecutor(ivf_segs, use_tpu=True, engine=eng)
+        host = QueryExecutor(ivf_segs, use_tpu=False)
+        rng = np.random.default_rng(44)
+        for _ in range(5):
+            qv = _query(rng, ivf_segs)
+            sql = _sql(qv, table="embivf")
+            assert _ids(dev.execute(sql)) == _ids(host.execute(sql)), sql
+        assert _meter(eng, "vector_served") == 5
+
+
+# ---------------------------------------------------------------------------
+# fallback reasons
+# ---------------------------------------------------------------------------
+class _StubSeg:
+    def __init__(self, index, n=10):
+        self._ix = index
+        self.num_docs = n
+
+    def data_source(self, col):
+        return types.SimpleNamespace(vector_index=self._ix)
+
+
+class TestFallbacks:
+    def test_knob_disables_the_leg(self, segs):
+        eng = _engine("knob", **{"pinot.server.vector.enabled": False})
+        dev = QueryExecutor(segs, use_tpu=True, engine=eng)
+        host = QueryExecutor(segs, use_tpu=False)
+        qv = _query(np.random.default_rng(45), segs)
+        sql = _sql(qv)
+        assert _ids(dev.execute(sql)) == _ids(host.execute(sql))
+        assert _meter(eng, "vector_served") == 0
+        assert _meter(eng, "vector_fallback", reason="disabled") >= 1
+
+    def test_order_by_and_or_shapes_are_hybrid(self, segs):
+        eng = _engine("hybrid_fb")
+        dev = QueryExecutor(segs, use_tpu=True, engine=eng)
+        host = QueryExecutor(segs, use_tpu=False)
+        qv = _query(np.random.default_rng(46), segs)
+        lit = _vec_json(qv)
+        for sql in [
+            f"SELECT id FROM emb "
+            f"WHERE vector_similarity(vec, '{lit}', {K}) "
+            f"ORDER BY id LIMIT 5",
+            f"SELECT id FROM emb "
+            f"WHERE vector_similarity(vec, '{lit}', {K}) OR id < 3 "
+            f"LIMIT 100",
+        ]:
+            assert _ids(dev.execute(sql)) == _ids(host.execute(sql)), sql
+        assert _meter(eng, "vector_served") == 0
+        assert _meter(eng, "vector_fallback", reason="hybrid") == 2
+
+    def test_admit_reasons_exact(self):
+        q = np.ones(4, np.float32)
+        ok = VectorIndex.build(np.eye(4, dtype=np.float32))
+        shape, reason = vector_device.admit([_StubSeg(ok)], "v", q, 2, 64)
+        assert shape is not None and reason is None
+        cases = [
+            ([_StubSeg(None)], q, 2, "noIndex"),
+            ([_StubSeg(VectorIndex(np.eye(4, dtype=np.float32),
+                                   metric="l2"))], q, 2, "metric"),
+            ([_StubSeg(ok)], np.ones(7, np.float32), 2, "precision"),
+            ([_StubSeg(ok)], q, 0, "precision"),
+            ([_StubSeg(ok)], q, 10_000, "precision"),
+        ]
+        for stubs, qv, k, want in cases:
+            shape, reason = vector_device.admit(stubs, "v", qv, k, 64)
+            assert shape is None and reason == want, (reason, want)
+            assert reason in vector_device.FALLBACK_REASONS
+
+    def test_split_filter_shapes(self):
+        vec = Function("vector_similarity",
+                       (Identifier("v"), Literal("[1, 0]"), Literal(2)))
+        resid = Function("lt", (Identifier("id"), Literal(5)))
+        fn, rest, reason = vector_device.split_filter(vec)
+        assert fn is vec and rest is None and reason is None
+        fn, rest, reason = vector_device.split_filter(
+            Function("and", (resid, vec)))
+        assert fn is vec and rest is resid
+        # OR around the vector fn / two vector conjuncts: host-side
+        for bad in (Function("or", (vec, resid)),
+                    Function("and", (vec, vec)),
+                    Function("not", (vec,))):
+            fn, rest, reason = vector_device.split_filter(bad)
+            assert fn is None and reason == "hybrid"
+
+
+# ---------------------------------------------------------------------------
+# zero steady-state retraces
+# ---------------------------------------------------------------------------
+class TestZeroRetrace:
+    def test_fresh_query_vectors_share_one_kernel(self, segs):
+        """The query vector and topK ride params, never the plan:
+        fingerprint-equal ANN queries replay the SAME compiled kernel
+        once the shape is warm."""
+        eng = _engine("retrace")
+        dev = QueryExecutor(segs, use_tpu=True, engine=eng)
+        host = QueryExecutor(segs, use_tpu=False)
+        rng = np.random.default_rng(47)
+        assert not dev.execute(_sql(_query(rng, segs))).exceptions
+        t0 = kernels.trace_count()
+        for _ in range(5):
+            sql = _sql(_query(rng, segs))
+            assert _ids(dev.execute(sql)) == _ids(host.execute(sql))
+        assert kernels.trace_count() == t0
+        assert _meter(eng, "vector_served") == 6
+
+
+# ---------------------------------------------------------------------------
+# serialization (satellite: typed corruption on torn payloads)
+# ---------------------------------------------------------------------------
+class TestVectorIndexSerialization:
+    def _index(self, n=96, d=6, n_cells=0):
+        rng = np.random.default_rng(48)
+        return VectorIndex.build(rng.normal(size=(n, d)), n_cells=n_cells)
+
+    def test_roundtrip_exact_and_ivf(self):
+        for ix in (self._index(), self._index(n_cells=4)):
+            back = VectorIndex.from_bytes(ix.to_bytes())
+            np.testing.assert_array_equal(back.vectors, ix.vectors)
+            if ix.centroids is None:
+                assert back.centroids is None
+            else:
+                np.testing.assert_array_equal(back.centroids,
+                                              ix.centroids)
+                np.testing.assert_array_equal(back.assignments,
+                                              ix.assignments)
+            q = np.ones(6, np.float32)
+            np.testing.assert_array_equal(back.top_k(q, 5),
+                                          ix.top_k(q, 5))
+
+    def test_torn_payloads_raise_typed_corruption(self):
+        """Every proper prefix fails LOUD with VectorIndexCorruption —
+        a torn download must never reshape into a silently-wrong
+        index."""
+        for ix in (self._index(), self._index(n_cells=4)):
+            buf = ix.to_bytes()
+            assert VectorIndex.from_bytes(buf) is not None
+            cuts = {0, 1, 4, len(buf) // 2, len(buf) - 4, len(buf) - 1}
+            for cut in cuts:
+                with pytest.raises(VectorIndexCorruption):
+                    VectorIndex.from_bytes(buf[:cut])
+        # the typed error is a ValueError (callers that predate the
+        # type still catch it) and names the declared-vs-actual sizes
+        buf = self._index().to_bytes()
+        with pytest.raises(VectorIndexCorruption, match="truncated"):
+            VectorIndex.from_bytes(buf[:-1])
+        assert issubclass(VectorIndexCorruption, ValueError)
+
+
+# ---------------------------------------------------------------------------
+# failpoint: server.vector.search
+# ---------------------------------------------------------------------------
+class TestVectorSearchFailpoint:
+    def test_armed_site_fires_with_ctx_match(self, segs):
+        eng = _engine("fp")
+        dev = QueryExecutor(segs, use_tpu=True, engine=eng)
+        qv = _query(np.random.default_rng(49), segs)
+        with failpoints.armed("server.vector.search",
+                              where={"table": "emb"}) as fp:
+            assert not dev.execute(_sql(qv)).exceptions
+            assert fp.fired == 1
+        # a non-matching ctx never fires
+        with failpoints.armed("server.vector.search",
+                              where={"table": "other"}) as fp:
+            assert not dev.execute(_sql(qv)).exceptions
+            assert fp.fired == 0
+
+    def test_seeded_decisions_replay_exactly(self, segs):
+        """Decision N is a pure function of (seed, N): re-arming the
+        same probability/seed schedule over the same query sequence
+        replays the identical fire pattern."""
+        eng = _engine("fp_seed")
+        dev = QueryExecutor(segs, use_tpu=True, engine=eng)
+        rng = np.random.default_rng(51)
+        queries = [_sql(_query(rng, segs)) for _ in range(8)]
+
+        def run():
+            with failpoints.armed("server.vector.search",
+                                  probability=0.5, seed=11) as fp:
+                for sql in queries:
+                    assert not dev.execute(sql).exceptions
+                return list(fp.decisions)
+
+        first, second = run(), run()
+        assert first == second
+        assert any(fired for fired, _ in first)
+        assert not all(fired for fired, _ in first)
+
+
+# ---------------------------------------------------------------------------
+# bench --vector smoke (the acceptance scenario rides tier-1)
+# ---------------------------------------------------------------------------
+class TestBenchSmoke:
+    def test_vector_bench_smoke(self, tmp_path):
+        import importlib
+        import sys
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        bench = importlib.import_module("bench")
+        out = str(tmp_path / "BENCH_vector_smoke.json")
+        bench.vector_main(smoke=True, out_path=out)
+        with open(out) as f:
+            data = json.load(f)
+        assert data["recall_at_k"] >= 0.9
+        assert data["coalesce"]["retraces_steady"] == 0
+        assert data["coalesce"]["batch_size_max"] >= 2
+        assert data["vector_served"] >= 1
